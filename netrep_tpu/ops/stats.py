@@ -1,0 +1,284 @@
+"""Masked pure-JAX kernels for the seven NetRep preservation statistics.
+
+These are the TPU-native equivalents of the reference's C++ statistic kernels
+(``netStats.cpp``, SURVEY.md §2.2 / BASELINE.json:5), redesigned for XLA:
+
+- everything is a pure function of arrays → jit/vmap/shard_map compose;
+- module-size variability is handled by **pad-to-bucket + mask** (SURVEY.md
+  §7 "Hard parts"): every kernel takes a ``(m,)`` validity mask and padded
+  entries are provably inert (they contribute zero weight to every mean,
+  correlation, Gram matrix, and power-iteration step);
+- the summary profile (top left singular vector) is computed by masked power
+  iteration on the node-space Gram matrix (fixed iteration count → static
+  control flow under jit), or optionally by batched ``eigh`` for exact parity
+  (SURVEY.md §7 "Batched SVD on TPU");
+- matmuls accumulate in float32 via ``preferred_element_type`` so bfloat16
+  inputs stay MXU-friendly without losing the statistics' precision.
+
+Semantics are defined by the NumPy oracle (:mod:`netrep_tpu.ops.oracle`);
+oracle-parity is enforced by ``tests/test_stats_oracle.py``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .oracle import N_STATS, STAT_NAMES  # noqa: F401  (canonical order)
+
+_EPS = 1e-30
+
+
+def _f32(x):
+    return jnp.asarray(x, dtype=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Masked building blocks
+# ---------------------------------------------------------------------------
+
+def masked_mean(x: jnp.ndarray, w: jnp.ndarray, axis=None) -> jnp.ndarray:
+    """Mean of ``x`` over entries where ``w`` (0/1 weights) is set."""
+    w = _f32(w)
+    tot = jnp.sum(w, axis=axis)
+    return jnp.sum(_f32(x) * w, axis=axis) / jnp.maximum(tot, _EPS)
+
+
+def masked_pearson(x: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Pearson correlation of ``x`` and ``y`` over the masked entries of the
+    last axis; NaN when either side is degenerate (oracle parity)."""
+    w = _f32(w)
+    x = _f32(x) * w
+    y = _f32(y) * w
+    n = jnp.maximum(jnp.sum(w, axis=-1), _EPS)
+    mx = jnp.sum(x, axis=-1) / n
+    my = jnp.sum(y, axis=-1) / n
+    xc = (x - mx[..., None]) * w
+    yc = (y - my[..., None]) * w
+    cov = jnp.sum(xc * yc, axis=-1)
+    vx = jnp.sum(xc * xc, axis=-1)
+    vy = jnp.sum(yc * yc, axis=-1)
+    denom = jnp.sqrt(vx) * jnp.sqrt(vy)
+    return jnp.where(denom > 0, cov / jnp.maximum(denom, _EPS), jnp.nan)
+
+
+def offdiag_mask(w: jnp.ndarray) -> jnp.ndarray:
+    """(m, m) pair mask: both endpoints valid, diagonal excluded."""
+    w = _f32(w)
+    pair = w[..., :, None] * w[..., None, :]
+    m = w.shape[-1]
+    return pair * (1.0 - jnp.eye(m, dtype=jnp.float32))
+
+
+def standardize_masked(data: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Column-standardize ``data`` (..., n_samples, m): mean 0, sd 1 (ddof=1)
+    per valid column; invalid or zero-variance columns become all-zero."""
+    data = _f32(data) * w[..., None, :]
+    ns = data.shape[-2]
+    mu = jnp.mean(data, axis=-2, keepdims=True)
+    xc = data - mu
+    var = jnp.sum(xc * xc, axis=-2, keepdims=True) / jnp.maximum(ns - 1, 1)
+    sd = jnp.sqrt(var)
+    good = sd > 0
+    z = jnp.where(good, xc / jnp.maximum(sd, _EPS), 0.0)
+    return z * w[..., None, :]
+
+
+def weighted_degree_masked(net: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Within-module weighted degree over valid nodes, diagonal excluded."""
+    pair = offdiag_mask(w)
+    return jnp.sum(_f32(net) * pair, axis=-1)
+
+
+def summary_profile_masked(
+    zdata: jnp.ndarray,
+    w: jnp.ndarray,
+    n_iter: int = 60,
+    method: str = "power",
+) -> jnp.ndarray:
+    """Summary profile of a (pre-standardized, masked) module data slice:
+    top left singular vector, sign-anchored to correlate positively with the
+    module's mean node profile (SURVEY.md §2.2).
+
+    ``method='power'`` runs fixed-count masked power iteration on the
+    node-space Gram matrix ``G = Z^T Z`` — static shapes and pure matmuls, the
+    MXU-friendly replacement for the reference's per-permutation Armadillo SVD
+    (SURVEY.md §7 "Batched SVD on TPU"). ``method='eigh'`` uses the exact
+    symmetric eigendecomposition (slower under vmap, used for parity tests).
+
+    Parameters
+    ----------
+    zdata : (..., n_samples, m) standardized masked data (columns of invalid
+        nodes all-zero — as produced by :func:`standardize_masked`).
+    w : (..., m) validity mask.
+
+    Returns
+    -------
+    (..., n_samples) unit-norm summary profile.
+    """
+    w = _f32(w)
+    gram = jnp.matmul(
+        jnp.swapaxes(zdata, -1, -2), zdata, preferred_element_type=jnp.float32
+    )
+    if method == "eigh":
+        _vals, vecs = jnp.linalg.eigh(gram)
+        v = vecs[..., :, -1] * w
+    elif method == "power":
+        def step(v, _):
+            v = jnp.einsum("...ij,...j->...i", gram, v)
+            v = v * w
+            v = v / jnp.maximum(jnp.linalg.norm(v, axis=-1, keepdims=True), _EPS)
+            return v, None
+
+        v0 = w / jnp.maximum(jnp.linalg.norm(w, axis=-1, keepdims=True), _EPS)
+        v, _ = jax.lax.scan(step, v0, None, length=n_iter)
+    else:
+        raise ValueError(f"unknown summary method: {method!r}")
+
+    prof = jnp.einsum("...si,...i->...s", zdata, v)
+    prof = prof / jnp.maximum(jnp.linalg.norm(prof, axis=-1, keepdims=True), _EPS)
+    anchor = jnp.sum(zdata, axis=-1)  # ∝ mean node profile over valid nodes
+    sign = jnp.sign(jnp.sum(prof * anchor, axis=-1, keepdims=True))
+    sign = jnp.where(sign == 0, 1.0, sign)
+    return prof * sign
+
+
+def node_contribution_masked(zdata: jnp.ndarray, prof: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Pearson correlation of each valid node's (standardized) data with the
+    summary profile. ``prof`` is mean-zero by construction (columns of
+    ``zdata`` are mean-zero), so this reduces to normalized dot products."""
+    p = prof - jnp.mean(prof, axis=-1, keepdims=True)
+    num = jnp.einsum("...si,...s->...i", zdata, p)
+    xn = jnp.linalg.norm(zdata, axis=-2)
+    pn = jnp.linalg.norm(p, axis=-1, keepdims=True)
+    denom = xn * pn
+    nc = jnp.where(denom > 0, num / jnp.maximum(denom, _EPS), 0.0)
+    return nc * w
+
+
+# ---------------------------------------------------------------------------
+# Discovery-side fixed properties (device-resident pytree)
+# ---------------------------------------------------------------------------
+
+class DiscProps(NamedTuple):
+    """Padded per-module discovery-side properties held fixed across the
+    permutation null (SURVEY.md §3.1). All arrays are padded to the module's
+    bucket capacity ``m`` and masked by ``mask``.
+
+    ``contrib``/``sign_contrib`` are all-zero (and ``has_data`` False) in the
+    data-less variant — the kernels then emit NaN for data statistics
+    (SURVEY.md §2.2).
+    """
+
+    corr: jnp.ndarray          # (..., m, m)
+    sign_corr: jnp.ndarray     # (..., m, m)
+    degree: jnp.ndarray        # (..., m)
+    contrib: jnp.ndarray       # (..., m)
+    sign_contrib: jnp.ndarray  # (..., m)
+    mask: jnp.ndarray          # (..., m) 0/1
+
+
+def make_disc_props(corr, net, data, mask, summary_method: str = "eigh") -> DiscProps:
+    """Build :class:`DiscProps` from padded discovery submatrices.
+
+    ``data`` may be None (data-less variant). Uses exact ``eigh`` summary by
+    default — this runs once per module, not in the hot loop.
+    """
+    corr = _f32(corr)
+    net = _f32(net)
+    mask = _f32(mask)
+    pair = offdiag_mask(mask)
+    corr = corr * pair  # zero padded rows/cols and diagonal influence
+    degree = jnp.sum(net * pair, axis=-1)
+    if data is not None:
+        z = standardize_masked(data, mask)
+        prof = summary_profile_masked(z, mask, method=summary_method)
+        contrib = node_contribution_masked(z, prof, mask)
+    else:
+        contrib = jnp.zeros_like(degree)
+    return DiscProps(
+        corr=corr,
+        sign_corr=jnp.sign(corr),
+        degree=degree,
+        contrib=contrib,
+        sign_contrib=jnp.sign(contrib),
+        mask=mask,
+    )
+
+
+# ---------------------------------------------------------------------------
+# The seven statistics on gathered (padded) test submatrices
+# ---------------------------------------------------------------------------
+
+def module_stats_masked(
+    disc: DiscProps,
+    test_corr: jnp.ndarray,   # (..., m, m)
+    test_net: jnp.ndarray,    # (..., m, m)
+    test_zdata: jnp.ndarray | None,  # (..., n_samples, m) standardized+masked
+    n_iter: int = 60,
+    summary_method: str = "power",
+) -> jnp.ndarray:
+    """Compute the seven statistics for one (batched) padded test node set.
+
+    Returns ``(..., 7)`` in :data:`~netrep_tpu.ops.oracle.STAT_NAMES` order.
+    Data statistics are NaN when ``test_zdata`` is None (SURVEY.md §2.2).
+    """
+    w = disc.mask
+    pair = offdiag_mask(w)
+    test_corr = _f32(test_corr) * pair
+    test_net = _f32(test_net) * pair
+    npair = jnp.maximum(jnp.sum(pair, axis=(-1, -2)), _EPS)
+
+    avg_weight = jnp.sum(test_net, axis=(-1, -2)) / npair
+
+    flat = lambda a: a.reshape(*a.shape[:-2], -1)
+    cor_cor = masked_pearson(flat(disc.corr), flat(test_corr), flat(pair))
+
+    test_degree = jnp.sum(test_net, axis=-1)
+    cor_degree = masked_pearson(disc.degree, test_degree, w)
+
+    if test_zdata is not None:
+        prof = summary_profile_masked(test_zdata, w, n_iter=n_iter, method=summary_method)
+        nc = node_contribution_masked(test_zdata, prof, w)
+        coherence = masked_mean(nc * nc, w, axis=-1)
+        cor_contrib = masked_pearson(disc.contrib, nc, w)
+        avg_cor = jnp.sum(disc.sign_corr * test_corr, axis=(-1, -2)) / npair
+        avg_contrib = masked_mean(disc.sign_contrib * nc, w, axis=-1)
+    else:
+        nanlike = jnp.full_like(avg_weight, jnp.nan)
+        coherence = cor_contrib = avg_cor = avg_contrib = nanlike
+
+    return jnp.stack(
+        [avg_weight, coherence, cor_cor, cor_degree, cor_contrib, avg_cor, avg_contrib],
+        axis=-1,
+    )
+
+
+def gather_and_stats(
+    disc: DiscProps,
+    idx: jnp.ndarray,          # (..., m) int32 test-node indices (padded)
+    test_corr: jnp.ndarray,    # (n, n)
+    test_net: jnp.ndarray,     # (n, n)
+    test_data: jnp.ndarray | None,  # (n_samples, n)
+    n_iter: int = 60,
+    summary_method: str = "power",
+) -> jnp.ndarray:
+    """Gather a module's test submatrices by index and compute the seven
+    statistics — the per-permutation unit of work in the reference's hot loop
+    (SURVEY.md §3.1: O(m²) gather + kernels), expressed as one fused XLA
+    computation. ``idx`` is a single module's ``(m,)`` index vector — batching
+    over permutations/modules is done by ``vmap`` of this function. ``idx``
+    may carry arbitrary in-range values at padded positions (the mask zeroes
+    their influence)."""
+    sub_corr = test_corr[idx[:, None], idx[None, :]]
+    sub_net = test_net[idx[:, None], idx[None, :]]
+    if test_data is not None:
+        sub_data = jnp.take(test_data, idx, axis=-1)
+        zdata = standardize_masked(sub_data, disc.mask)
+    else:
+        zdata = None
+    return module_stats_masked(
+        disc, sub_corr, sub_net, zdata, n_iter=n_iter, summary_method=summary_method
+    )

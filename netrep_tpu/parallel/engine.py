@@ -1,0 +1,341 @@
+"""The TPU-native permutation engine — the rebuild of the reference's C++
+``PermutationProcedure`` hot path (SURVEY.md §2.2, §3.1; BASELINE.json:5).
+
+Reference design → TPU design:
+
+- OpenMP threads claiming permutation chunks → ``vmap`` over a permutation
+  chunk, jit-compiled once per module-size bucket, dispatched chunk-by-chunk
+  from the host (SURVEY.md §2.3 row "data parallelism over permutations").
+- Per-permutation Armadillo submatrix gathers + SVD → fused XLA gather +
+  masked power iteration inside the vmapped kernel
+  (:func:`netrep_tpu.ops.stats.gather_and_stats`).
+- Disjoint null-array slices per thread → functional: each chunk returns its
+  slice, the host writes it into the preallocated null array.
+- Progress/interrupt polling from the R-facing thread → chunked dispatch:
+  Python regains control between device calls, so ``KeyboardInterrupt``
+  aborts cleanly with partial nulls retained (SURVEY.md §5).
+- Variable module sizes vs XLA static shapes → pad-to-bucket + masks
+  (SURVEY.md §7 "Hard parts"): modules are grouped into power-of-two-capacity
+  buckets; each bucket traces/compiles exactly once per chunk shape.
+
+Optional SPMD scale-out: pass a :class:`jax.sharding.Mesh` and the chunk's
+per-permutation key array is sharded along the mesh's permutation axis, so
+XLA partitions the whole chunk computation across devices over ICI
+(SURVEY.md §2.3, §5 "distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import stats as jstats
+from ..ops.oracle import N_STATS
+from ..utils.config import EngineConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ModuleSpec:
+    """One discovery module's overlap bookkeeping (SURVEY.md §3.1).
+
+    ``disc_idx`` / ``test_idx`` are aligned: position i refers to the same
+    node (by name) in the discovery and test datasets. Their common length is
+    ``nVarsPresent`` for this module.
+    """
+
+    label: str
+    disc_idx: np.ndarray
+    test_idx: np.ndarray
+
+    @property
+    def size(self) -> int:
+        return len(self.test_idx)
+
+
+@dataclasses.dataclass
+class _Bucket:
+    cap: int
+    module_pos: list[int]          # positions in the global module order
+    disc: jstats.DiscProps         # batched (K, cap[, cap]) discovery props
+    obs_idx: jnp.ndarray           # (K, cap) observed test indices (padded)
+    slices: list[tuple[int, int]]  # (offset, size) into the pooled permutation
+
+
+def _pad_to(a: np.ndarray, cap: int, axes: Sequence[int]) -> np.ndarray:
+    pad = [(0, 0)] * a.ndim
+    for ax in axes:
+        pad[ax] = (0, cap - a.shape[ax])
+    return np.pad(a, pad)
+
+
+class PermutationEngine:
+    """Permutation-null engine for one (discovery, test) dataset pair.
+
+    Parameters
+    ----------
+    disc_corr, disc_net : (n_d, n_d) discovery correlation / network.
+    disc_data : (n_samples_d, n_d) discovery data, or None (data-less
+        variant, SURVEY.md §2.2).
+    test_corr, test_net : (n_t, n_t) test correlation / network.
+    test_data : (n_samples_t, n_t) test data, or None.
+    modules : ordered module specs (global module order = this order).
+    pool : candidate test-node indices the null draws from — the overlap set
+        for ``null='overlap'`` or all test nodes for ``null='all'``
+        (SURVEY.md §3.1).
+    config : engine tuning knobs.
+    mesh : optional device mesh; when given, permutation chunks are sharded
+        along ``config.mesh_axis``.
+    """
+
+    def __init__(
+        self,
+        disc_corr: np.ndarray,
+        disc_net: np.ndarray,
+        disc_data: np.ndarray | None,
+        test_corr: np.ndarray,
+        test_net: np.ndarray,
+        test_data: np.ndarray | None,
+        modules: Sequence[ModuleSpec],
+        pool: np.ndarray,
+        config: EngineConfig = EngineConfig(),
+        mesh: Mesh | None = None,
+    ):
+        self.config = config
+        self.mesh = mesh
+        self.modules = list(modules)
+        self.has_data = disc_data is not None and test_data is not None
+        self.n_modules = len(self.modules)
+
+        dtype = jnp.dtype(config.dtype)
+        self._test_corr = jnp.asarray(test_corr, dtype)
+        self._test_net = jnp.asarray(test_net, dtype)
+        self._test_data = (
+            jnp.asarray(test_data, dtype) if self.has_data else None
+        )
+
+        sizes = [m.size for m in self.modules]
+        if min(sizes, default=1) < 2:
+            bad = [m.label for m in self.modules if m.size < 2]
+            raise ValueError(
+                f"modules {bad} have fewer than 2 nodes present in the test "
+                "dataset; preservation statistics are undefined"
+            )
+        self.total_take = int(np.sum(sizes))
+        self.pool = np.asarray(pool, dtype=np.int32)
+        if self.total_take > self.pool.size:
+            raise ValueError(
+                f"module sizes (total {self.total_take}) exceed the null "
+                f"candidate pool ({self.pool.size}); use null='all' or drop "
+                "modules"
+            )
+        self._pool_dev = jnp.asarray(self.pool)
+
+        # --- bucket construction: jit once per module-size bucket [B:5] ---
+        # Discovery submatrices are gathered on device (jnp.take) so large
+        # discovery matrices never need a host round-trip (Config D scale,
+        # SURVEY.md §6). Discovery inputs may be numpy or jax arrays.
+        offsets = np.concatenate([[0], np.cumsum(sizes)])
+        by_cap: dict[int, list[int]] = {}
+        for k, m in enumerate(self.modules):
+            by_cap.setdefault(config.rounded_cap(m.size), []).append(k)
+
+        d_corr = jnp.asarray(disc_corr, jnp.float32)
+        d_net = jnp.asarray(disc_net, jnp.float32)
+        d_data = (
+            jnp.asarray(disc_data, jnp.float32) if self.has_data else None
+        )
+
+        @jax.jit
+        def _disc_bucket(idx, mask):
+            # idx: (K, cap) padded discovery indices; mask: (K, cap)
+            sub = lambda mat, ix: mat[ix[:, None], ix[None, :]]
+            corr_b = jax.vmap(partial(sub, d_corr))(idx)
+            net_b = jax.vmap(partial(sub, d_net))(idx)
+            data_b = (
+                jax.vmap(lambda ix: jnp.take(d_data, ix, axis=1))(idx)
+                if d_data is not None
+                else None
+            )
+            return jstats.make_disc_props(corr_b, net_b, data_b, mask)
+
+        self.buckets: list[_Bucket] = []
+        for cap in sorted(by_cap):
+            pos = by_cap[cap]
+            didx_b, mask_b, obs_b, slices = [], [], [], []
+            for k in pos:
+                mod = self.modules[k]
+                didx_b.append(_pad_to(mod.disc_idx.astype(np.int32), cap, (0,)))
+                mask = np.zeros(cap, np.float32)
+                mask[: mod.size] = 1.0
+                mask_b.append(mask)
+                obs_b.append(_pad_to(mod.test_idx.astype(np.int32), cap, (0,)))
+                slices.append((int(offsets[k]), mod.size))
+
+            disc = _disc_bucket(
+                jnp.asarray(np.stack(didx_b)), jnp.asarray(np.stack(mask_b))
+            )
+            self.buckets.append(
+                _Bucket(cap, pos, disc, jnp.asarray(np.stack(obs_b)), slices)
+            )
+
+        self._chunk_fn_cached: Callable | None = None
+        self._observed_fn: Callable | None = None
+
+    # ------------------------------------------------------------------
+    # Observed pass (SURVEY.md §3.1 "observed pass")
+    # ------------------------------------------------------------------
+
+    def observed(self) -> np.ndarray:
+        """(n_modules, 7) observed statistics on the actual overlap sets."""
+        if self._observed_fn is None:
+            self._observed_fn = jax.jit(
+                jax.vmap(
+                    partial(
+                        jstats.gather_and_stats,
+                        n_iter=self.config.power_iters,
+                        summary_method="eigh",  # observed pass: exact, runs once
+                    ),
+                    in_axes=(0, 0, None, None, None),
+                )
+            )
+        out = np.full((self.n_modules, N_STATS), np.nan)
+        for b in self.buckets:
+            res = self._observed_fn(
+                b.disc, b.obs_idx, self._test_corr, self._test_net, self._test_data
+            )
+            out[b.module_pos] = np.asarray(res, dtype=np.float64)
+        return out
+
+    # ------------------------------------------------------------------
+    # Null chunks
+    # ------------------------------------------------------------------
+
+    def chunk_body(self) -> Callable:
+        """The unjitted chunk program: draw a node permutation per chunk
+        element, slice per-module index sets in the fixed module order
+        (disjoint within a permutation — the reference's label-shuffle
+        semantics, SURVEY.md §3.1), and run all bucket kernels. Signature:
+        ``chunk(keys: (C,) PRNG keys) -> [per-bucket (C, K_b, 7) arrays]``.
+        Jittable as-is (used by ``__graft_entry__.entry``)."""
+        cfg = self.config
+        buckets = self.buckets
+        pool = self._pool_dev
+        tc, tn, td = self._test_corr, self._test_net, self._test_data
+
+        def chunk(keys: jax.Array) -> list[jax.Array]:
+            # keys: (C,) typed PRNG keys, one per permutation
+            perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)
+            outs = []
+            for b in buckets:
+                cols = []
+                for off, size in b.slices:
+                    idx = perm[:, off: off + size]
+                    idx = jnp.pad(idx, ((0, 0), (0, b.cap - size)))
+                    cols.append(idx)
+                idx_b = jnp.stack(cols, axis=1)  # (C, K, cap)
+                inner = jax.vmap(
+                    partial(
+                        jstats.gather_and_stats,
+                        n_iter=cfg.power_iters,
+                        summary_method=cfg.summary_method,
+                    ),
+                    in_axes=(0, 0, None, None, None),
+                )
+                over_perms = jax.vmap(inner, in_axes=(None, 0, None, None, None))
+                outs.append(over_perms(b.disc, idx_b, tc, tn, td))
+            return outs
+
+        return chunk
+
+    def _build_chunk_fn(self) -> Callable:
+        """Jit the chunk body, sharding the per-permutation key array (and
+        outputs) along the mesh's permutation axis when a mesh is present —
+        XLA then partitions the whole chunk across devices over ICI
+        (SURVEY.md §2.3)."""
+        chunk = self.chunk_body()
+        cfg = self.config
+        buckets = self.buckets
+        if self.mesh is not None:
+            keys_sharding = NamedSharding(self.mesh, P(cfg.mesh_axis))
+            out_shardings = [
+                NamedSharding(self.mesh, P(cfg.mesh_axis)) for _ in buckets
+            ]
+            return jax.jit(
+                chunk, in_shardings=(keys_sharding,), out_shardings=out_shardings
+            )
+        return jax.jit(chunk)
+
+    def _chunk_fn(self) -> Callable:
+        if self._chunk_fn_cached is None:
+            self._chunk_fn_cached = self._build_chunk_fn()
+        return self._chunk_fn_cached
+
+    def run_null(
+        self,
+        n_perm: int,
+        key: jax.Array | int = 0,
+        progress: Callable[[int, int], None] | None = None,
+        nulls_init: np.ndarray | None = None,
+        start_perm: int = 0,
+    ) -> tuple[np.ndarray, int]:
+        """Compute the permutation null distribution.
+
+        Parameters
+        ----------
+        n_perm : total permutations.
+        key : PRNG key (or integer seed) — the engine's reproducibility
+            contract: same key + same inputs = same null, independent of
+            chunk size and mesh (SURVEY.md §7 "RNG semantics").
+        progress : optional callback ``(done, total)`` per chunk.
+        nulls_init, start_perm : resume support — a partially-filled null
+            array and the index to continue from (SURVEY.md §5
+            "checkpoint/resume").
+
+        Returns
+        -------
+        (nulls, completed) — ``(n_perm, n_modules, 7)`` array (NaN rows
+        beyond ``completed`` if interrupted) and the number of completed
+        permutations. A ``KeyboardInterrupt`` during the loop returns the
+        partial result instead of raising (the reference's Ctrl-C path,
+        SURVEY.md §5 "failure detection").
+        """
+        if isinstance(key, int):
+            key = jax.random.key(key)
+
+        C = self.config.chunk_size
+        if self.mesh is not None:
+            # pad chunk size to a multiple of the mesh axis
+            ax = self.mesh.shape[self.config.mesh_axis]
+            C = max(ax, (C // ax) * ax)
+
+        if nulls_init is not None:
+            nulls = nulls_init
+        else:
+            nulls = np.full((n_perm, self.n_modules, N_STATS), np.nan)
+        # Per-permutation keys derived by fold_in(perm_index): chunk-size and
+        # mesh independent.
+        fn = self._chunk_fn()
+        done = start_perm
+        try:
+            while done < n_perm:
+                take = min(C, n_perm - done)
+                keys = jax.vmap(partial(jax.random.fold_in, key))(
+                    jnp.arange(done, done + C, dtype=jnp.uint32)
+                )
+                outs = fn(keys)
+                for b, out in zip(self.buckets, outs):
+                    arr = np.asarray(out[:take], dtype=np.float64)
+                    nulls[done: done + take, b.module_pos] = arr
+                done += take
+                if progress is not None:
+                    progress(done, n_perm)
+        except KeyboardInterrupt:
+            pass
+        return nulls, done

@@ -1,0 +1,46 @@
+"""TPU engine configuration.
+
+The reference's knobs are plain function arguments (SURVEY.md §5 "Config /
+flag system" — args-only philosophy, kept for the public API); the handful of
+TPU-specific tuning parameters live in this small dataclass instead of
+growing the user-facing signatures.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Tuning knobs for the permutation engine (SURVEY.md §5).
+
+    Attributes
+    ----------
+    chunk_size : permutations evaluated per device dispatch. Chunking bounds
+        device memory, lets Python regain control between dispatches
+        (KeyboardInterrupt → clean partial results, SURVEY.md §5 "failure
+        detection"), and is the save/resume granularity.
+    summary_method : 'power' (masked power iteration — MXU-friendly, the
+        default) or 'eigh' (exact; used by parity tests).
+    power_iters : fixed power-iteration count (static under jit).
+    bucket_rounding : module bucket capacities are rounded up to the next
+        power of two and at least this value — fewer distinct compiled
+        programs (SURVEY.md §7: jit once per module-size bucket).
+    dtype : matrix element dtype on device ('float32' or 'bfloat16' for the
+        gather-bound large-n path; statistics always accumulate in f32).
+    mesh_axis : name of the permutation data-parallel mesh axis.
+    """
+
+    chunk_size: int = 128
+    summary_method: str = "power"
+    power_iters: int = 60
+    bucket_rounding: int = 8
+    dtype: str = "float32"
+    mesh_axis: str = "perm"
+
+    def rounded_cap(self, size: int) -> int:
+        cap = self.bucket_rounding
+        while cap < size:
+            cap *= 2
+        return cap

@@ -1,0 +1,172 @@
+"""Permutation-engine tests: oracle parity of the observed pass and the null
+distribution, chunking invariance, reproducibility, interrupt semantics
+(SURVEY.md §4 test strategy; §7 step 3)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from netrep_tpu.ops import oracle
+from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+from netrep_tpu.utils.config import EngineConfig
+
+
+def _make_setup(toy_pair):
+    d = toy_pair["discovery"]
+    t = toy_pair["test"]
+    labels = toy_pair["labels"]
+    tpos = {nm: i for i, nm in enumerate(t["names"])}
+
+    modules = []
+    for lab in sorted({v for v in labels.values() if v != "0"}):
+        disc_idx, test_idx = [], []
+        for i, nm in enumerate(d["names"]):
+            if labels[nm] == lab and nm in tpos:
+                disc_idx.append(i)
+                test_idx.append(tpos[nm])
+        modules.append(ModuleSpec(lab, np.array(disc_idx), np.array(test_idx)))
+
+    overlap_pool = np.array([tpos[nm] for nm in d["names"] if nm in tpos], dtype=np.int32)
+    return d, t, modules, overlap_pool
+
+
+@pytest.fixture
+def setup(toy_pair):
+    return _make_setup(toy_pair)
+
+
+def _engine(setup, **kw):
+    d, t, modules, pool = setup
+    cfg = kw.pop("config", EngineConfig(chunk_size=16, summary_method="eigh"))
+    return PermutationEngine(
+        d["correlation"], d["network"], d["data"],
+        t["correlation"], t["network"], t["data"],
+        modules, pool, config=cfg, **kw,
+    )
+
+
+def test_observed_matches_oracle(setup):
+    d, t, modules, pool = setup
+    eng = _engine(setup)
+    obs = eng.observed()
+    assert obs.shape == (len(modules), 7)
+
+    for k, mod in enumerate(modules):
+        disc = oracle.DiscoveryProps(
+            d["correlation"][np.ix_(mod.disc_idx, mod.disc_idx)],
+            d["network"][np.ix_(mod.disc_idx, mod.disc_idx)],
+            d["data"][:, mod.disc_idx],
+        )
+        sub = np.ix_(mod.test_idx, mod.test_idx)
+        expected = oracle.module_stats(
+            disc, t["correlation"][sub], t["network"][sub], t["data"][:, mod.test_idx]
+        )
+        np.testing.assert_allclose(obs[k], expected, atol=2e-4)
+
+
+def test_null_reproducible_and_chunk_invariant(setup):
+    eng = _engine(setup)
+    n1, c1 = eng.run_null(20, key=7)
+    assert c1 == 20 and n1.shape == (20, 4, 7)
+    assert np.isfinite(n1).all()
+
+    eng2 = _engine(setup, config=EngineConfig(chunk_size=7, summary_method="eigh"))
+    n2, _ = eng2.run_null(20, key=7)
+    np.testing.assert_allclose(n1, n2, atol=1e-5)
+
+    n3, _ = eng.run_null(20, key=8)
+    assert np.abs(n1 - n3).max() > 1e-3  # different key → different null
+
+
+def test_null_statistics_are_calibrated(setup):
+    """Null values computed by the engine match the oracle's permutation
+    procedure *distributionally* (SURVEY.md §7 'RNG semantics': statistical
+    equivalence, not bit parity with R)."""
+    d, t, modules, pool = setup
+    eng = _engine(setup)
+    nulls, _ = eng.run_null(200, key=3)
+
+    rng = np.random.default_rng(3)
+    disc_props = [
+        oracle.DiscoveryProps(
+            d["correlation"][np.ix_(m.disc_idx, m.disc_idx)],
+            d["network"][np.ix_(m.disc_idx, m.disc_idx)],
+            d["data"][:, m.disc_idx],
+        )
+        for m in modules
+    ]
+    onulls = oracle.permutation_null(
+        disc_props, [m.size for m in modules],
+        t["correlation"], t["network"], t["data"],
+        pool, 200, rng,
+    )
+    # Compare null means / sds per module×stat within Monte-Carlo tolerance.
+    for k in range(len(modules)):
+        for s in range(7):
+            a, b = nulls[:, k, s], onulls[:, k, s]
+            se = np.sqrt(a.var() / len(a) + b.var() / len(b)) + 1e-6
+            assert abs(a.mean() - b.mean()) < 5 * se, (k, s, a.mean(), b.mean())
+
+
+def test_resume(setup):
+    eng = _engine(setup)
+    full, _ = eng.run_null(30, key=11)
+    part, done = eng.run_null(12, key=11)
+    resumed = np.full((30, 4, 7), np.nan)
+    resumed[:12] = part[:12]
+    resumed, done2 = eng.run_null(30, key=11, nulls_init=resumed, start_perm=12)
+    assert done2 == 30
+    np.testing.assert_allclose(resumed, full, atol=1e-6)
+
+
+def test_pool_too_small_raises(setup):
+    d, t, modules, pool = setup
+    with pytest.raises(ValueError, match="exceed the null candidate pool"):
+        PermutationEngine(
+            d["correlation"], d["network"], d["data"],
+            t["correlation"], t["network"], t["data"],
+            modules, pool[:10],
+        )
+
+
+def test_tiny_module_raises(setup):
+    d, t, modules, pool = setup
+    bad = modules + [ModuleSpec("9", np.array([0]), np.array([0]))]
+    with pytest.raises(ValueError, match="fewer than 2 nodes"):
+        PermutationEngine(
+            d["correlation"], d["network"], d["data"],
+            t["correlation"], t["network"], t["data"],
+            bad, pool,
+        )
+
+
+def test_dataless_engine(setup):
+    d, t, modules, pool = setup
+    eng = PermutationEngine(
+        d["correlation"], d["network"], None,
+        t["correlation"], t["network"], None,
+        modules, pool, config=EngineConfig(chunk_size=8),
+    )
+    obs = eng.observed()
+    finite_cols = [oracle.STAT_NAMES.index(s) for s in oracle.TOPOLOGY_STATS]
+    assert np.isfinite(obs[:, finite_cols]).all()
+    nan_cols = [i for i in range(7) if i not in finite_cols]
+    assert np.isnan(obs[:, nan_cols]).all()
+    nulls, _ = eng.run_null(5, key=0)
+    assert np.isfinite(nulls[:, :, finite_cols]).all()
+
+
+def test_mesh_sharded_null_matches(setup):
+    """Sharding the permutation chunk across an 8-device CPU mesh gives the
+    same null as the single-device path (SURVEY.md §4 'multi-node without a
+    real cluster')."""
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8])
+    mesh = Mesh(devs, ("perm",))
+    eng = _engine(setup)
+    ref, _ = eng.run_null(16, key=5)
+    eng_sh = _engine(setup, mesh=mesh)
+    got, _ = eng_sh.run_null(16, key=5)
+    np.testing.assert_allclose(ref, got, atol=1e-5)

@@ -1,0 +1,158 @@
+"""End-to-end test of `module_preservation` on vignette-like toy data — the
+rebuild of the reference's de-facto integration test (SURVEY.md §2.1
+"Vignette", §4; Config A in BASELINE.md): planted preserved modules must come
+out significant, and the API surface (validation, result shaping,
+alternatives, data-less variant) behaves like the reference's.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+from netrep_tpu import module_preservation
+from netrep_tpu.models.results import PreservationResult
+from netrep_tpu.ops.oracle import STAT_NAMES, TOPOLOGY_STATS
+from netrep_tpu.utils.config import EngineConfig
+
+try:
+    import pandas as pd
+except Exception:
+    pd = None
+
+CFG = EngineConfig(chunk_size=64, summary_method="power", power_iters=50)
+
+
+def _frames(pair):
+    """Package the toy pair as pandas inputs (named nodes)."""
+    d, t = pair["discovery"], pair["test"]
+    mk = lambda ds: dict(
+        data=pd.DataFrame(ds["data"], columns=ds["names"]),
+        correlation=pd.DataFrame(ds["correlation"], index=ds["names"], columns=ds["names"]),
+        network=pd.DataFrame(ds["network"], index=ds["names"], columns=ds["names"]),
+    )
+    return mk(d), mk(t)
+
+
+@pytest.fixture(scope="module")
+def result(toy_pair_module):
+    d, t = _frames(toy_pair_module)
+    return module_preservation(
+        network={"disc": d["network"], "test": t["network"]},
+        data={"disc": d["data"], "test": t["data"]},
+        correlation={"disc": d["correlation"], "test": t["correlation"]},
+        module_assignments={nm: lab for nm, lab in toy_pair_module["labels"].items()},
+        discovery="disc",
+        test="test",
+        n_perm=250,
+        seed=123,
+        config=CFG,
+    )
+
+
+def test_simplified_single_pair(result):
+    assert isinstance(result, PreservationResult)
+    assert result.discovery == "disc" and result.test == "test"
+    assert result.completed == 250
+    assert result.observed.shape == (4, 7)
+    assert result.nulls.shape == (250, 4, 7)
+    assert result.p_values.shape == (4, 7)
+
+
+def test_planted_modules_are_preserved(result):
+    """All 4 planted modules are strongly preserved: every statistic
+    significant at the resolution of 250 permutations."""
+    assert (result.max_pvalue() < 0.05).all()
+    # p-values can never be zero (Phipson–Smyth)
+    assert (result.p_values > 0).all()
+
+
+def test_overlap_bookkeeping(result, toy_pair_module):
+    sizes = toy_pair_module["module_sizes"]
+    assert list(result.total_size) == [sizes[l] for l in result.module_labels]
+    assert (result.n_vars_present <= result.total_size).all()
+    assert (result.prop_vars_present <= 1.0).all()
+    assert (result.n_vars_present >= 2).all()
+
+
+def test_repr_and_frames(result):
+    text = repr(result)
+    assert "disc" in text and "p-values" in text
+    assert list(result.p_frame().columns) == list(STAT_NAMES)
+
+
+def test_no_simplify_nesting(toy_pair_module):
+    d, t = _frames(toy_pair_module)
+    res = module_preservation(
+        network={"disc": d["network"], "test": t["network"]},
+        data={"disc": d["data"], "test": t["data"]},
+        correlation={"disc": d["correlation"], "test": t["correlation"]},
+        module_assignments=toy_pair_module["labels"],
+        discovery="disc", test="test",
+        n_perm=10, seed=0, simplify=False, config=CFG,
+    )
+    assert set(res) == {"disc"} and set(res["disc"]) == {"test"}
+
+
+def test_dataless_end_to_end(toy_pair_module):
+    d, t = _frames(toy_pair_module)
+    res = module_preservation(
+        network={"disc": d["network"], "test": t["network"]},
+        correlation={"disc": d["correlation"], "test": t["correlation"]},
+        module_assignments=toy_pair_module["labels"],
+        discovery="disc", test="test",
+        n_perm=50, seed=1, config=CFG,
+    )
+    topo = [STAT_NAMES.index(s) for s in TOPOLOGY_STATS]
+    other = [i for i in range(7) if i not in topo]
+    assert np.isfinite(res.p_values[:, topo]).all()
+    assert np.isnan(res.p_values[:, other]).all()
+
+
+def test_alternative_less_flips(toy_pair_module):
+    d, t = _frames(toy_pair_module)
+    kw = dict(
+        network={"disc": d["network"], "test": t["network"]},
+        data={"disc": d["data"], "test": t["data"]},
+        correlation={"disc": d["correlation"], "test": t["correlation"]},
+        module_assignments=toy_pair_module["labels"],
+        discovery="disc", test="test", n_perm=100, seed=5, config=CFG,
+    )
+    hi = module_preservation(alternative="greater", **kw)
+    lo = module_preservation(alternative="less", **kw)
+    # strongly preserved modules: greater-p small, less-p near 1
+    assert hi.p_values[:, 0].max() < 0.1
+    assert lo.p_values[:, 0].min() > 0.9
+
+
+def test_validation_errors(toy_pair_module):
+    d, t = _frames(toy_pair_module)
+    bad_net = t["network"].copy()
+    bad_net.iloc[0, 1] = 2.0  # breaks symmetry
+    with pytest.raises(ValueError, match="not symmetric"):
+        module_preservation(
+            network={"disc": d["network"], "test": bad_net},
+            correlation={"disc": d["correlation"], "test": t["correlation"]},
+            module_assignments=toy_pair_module["labels"],
+            discovery="disc", test="test", n_perm=5,
+        )
+    with pytest.raises(ValueError, match="correlation must be provided"):
+        module_preservation(
+            network={"disc": d["network"], "test": t["network"]},
+            module_assignments=toy_pair_module["labels"],
+            discovery="disc", test="test", n_perm=5,
+        )
+    with pytest.raises(ValueError, match="not found"):
+        module_preservation(
+            network={"disc": d["network"], "test": t["network"]},
+            correlation={"disc": d["correlation"], "test": t["correlation"]},
+            module_assignments=toy_pair_module["labels"],
+            discovery="nope", test="test", n_perm=5,
+        )
+    with pytest.raises(ValueError, match="alternative"):
+        module_preservation(
+            network={"disc": d["network"], "test": t["network"]},
+            correlation={"disc": d["correlation"], "test": t["correlation"]},
+            module_assignments=toy_pair_module["labels"],
+            discovery="disc", test="test", n_perm=5, alternative="sideways",
+        )

@@ -1,0 +1,87 @@
+"""Tests for the Phipson–Smyth permp reimplementation and permutation-count
+planning (SURVEY.md §7 'Exact p-values' hard requirement)."""
+
+import numpy as np
+import pytest
+
+from netrep_tpu.ops import pvalues as pv
+
+
+def test_permp_infinite_space_is_biased_estimator():
+    p = pv.permp(np.array([0, 5, 100]), nperm=100, total_nperm=None)
+    np.testing.assert_allclose(p, [1 / 101, 6 / 101, 1.0])
+
+
+def test_permp_exact_small_space():
+    """Exact method: mean of Binomial CDFs over attainable true p-values."""
+    x, nperm, mt = 3, 50, 20
+    from scipy.stats import binom
+
+    expected = np.mean([binom.cdf(x, nperm, v / mt) for v in range(1, mt + 1)])
+    got = pv.permp(np.array([x]), nperm, total_nperm=mt, method="exact")[0]
+    assert abs(got - expected) < 1e-12
+
+
+def test_permp_approx_converges_to_exact():
+    """The integral approximation tracks the exact sum for moderate spaces."""
+    x, nperm, mt = 2, 200, 5000
+    ex = pv.permp(np.array([x]), nperm, total_nperm=mt, method="exact")[0]
+    ap = pv.permp(np.array([x]), nperm, total_nperm=mt, method="approximate")[0]
+    assert abs(ex - ap) < 1e-4
+
+
+def test_permp_never_zero():
+    p = pv.permp(np.array([0]), nperm=1000, total_nperm=1e300)
+    assert p[0] > 0
+
+
+def test_permp_auto_switch():
+    small = pv.permp(np.array([1]), 100, total_nperm=100, method="auto")
+    exact = pv.permp(np.array([1]), 100, total_nperm=100, method="exact")
+    np.testing.assert_allclose(small, exact)
+
+
+def test_exceedance_counts_alternatives():
+    obs = np.array([2.0])
+    nulls = np.array([[1.0], [2.0], [3.0], [np.nan]])
+    c, n = pv.exceedance_counts(obs, nulls, "greater")
+    assert c[0] == 2 and n[0] == 3
+    c, _ = pv.exceedance_counts(obs, nulls, "less")
+    assert c[0] == 2
+    c, _ = pv.exceedance_counts(obs, nulls, "two.sided")
+    assert c[0] == 2
+    with pytest.raises(ValueError):
+        pv.exceedance_counts(obs, nulls, "bogus")
+
+
+def test_permutation_pvalues_shapes_and_nan():
+    rng = np.random.default_rng(0)
+    obs = np.array([[3.0, np.nan], [0.0, 1.0]])
+    nulls = rng.standard_normal((500, 2, 2))
+    p = pv.permutation_pvalues(obs, nulls, "greater")
+    assert p.shape == (2, 2)
+    assert np.isnan(p[0, 1])
+    assert p[0, 0] < 0.05          # obs=3 is far in the right tail
+    assert 0.0 < p[1, 0] <= 1.0
+
+
+def test_two_sided_doubles_and_caps():
+    obs = np.array([0.0])
+    nulls = np.random.default_rng(1).standard_normal((999, 1))
+    p = pv.permutation_pvalues(obs, nulls, "two.sided")
+    assert 0.9 <= p[0] <= 1.0  # dead-centre observed → p ≈ 1
+
+
+def test_total_permutations():
+    # pool of 5, one module of 2: 5*4 = 20 ordered assignments
+    assert abs(pv.total_permutations(5, [2]) - 20) < 1e-9
+    assert pv.total_permutations(10, [11]) == float("inf")
+    assert np.isinf(pv.total_permutations(20000, [100] * 50))
+
+
+def test_required_perms():
+    assert pv.required_perms(0.05) == 19
+    assert pv.required_perms(0.05, n_tests=10) == 199
+    assert pv.required_perms(0.05, alternative="two.sided") == 39
+    with pytest.raises(ValueError):
+        pv.required_perms(0.0)

@@ -1,0 +1,155 @@
+"""Oracle-parity tests: JAX masked kernels vs the pure-NumPy oracle
+(SURVEY.md §4 — the reference cross-checks its C++ kernels against slow
+pure-R re-implementations; we do the same with NumPy vs JAX)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from netrep_tpu.ops import oracle
+from netrep_tpu.ops import stats as jstats
+
+
+def _random_module(rng, m=17, ns_d=30, ns_t=25, n_test=60):
+    """Random discovery module + test matrices with planted correlation so
+    the top singular value is well separated (fast power-iteration parity)."""
+    latent_d = rng.standard_normal(ns_d)
+    latent_t = rng.standard_normal(ns_t)
+    d_data = 0.8 * np.outer(latent_d, rng.choice([-1, 1], m)) + 0.6 * rng.standard_normal((ns_d, m))
+    d_corr = np.corrcoef(d_data, rowvar=False)
+    d_net = np.abs(d_corr) ** 2
+
+    t_data = 0.8 * np.outer(latent_t, rng.choice([-1, 1], n_test)) + 0.6 * rng.standard_normal((ns_t, n_test))
+    t_corr = np.corrcoef(t_data, rowvar=False)
+    t_net = np.abs(t_corr) ** 2
+    idx = rng.choice(n_test, size=m, replace=False)
+    return d_data, d_corr, d_net, t_data, t_corr, t_net, idx
+
+
+def _pad(a, cap, axis=-1):
+    pad = [(0, 0)] * a.ndim
+    pad[axis] = (0, cap - a.shape[axis])
+    return np.pad(a, pad)
+
+
+def _padded_disc(d_corr, d_net, d_data, m, cap, summary_method="eigh"):
+    mask = np.zeros(cap, dtype=np.float32)
+    mask[:m] = 1.0
+    corr_p = _pad(_pad(d_corr, cap, -1), cap, -2)
+    net_p = _pad(_pad(d_net, cap, -1), cap, -2)
+    data_p = _pad(d_data, cap, -1) if d_data is not None else None
+    disc = jstats.make_disc_props(corr_p, net_p, data_p, mask, summary_method=summary_method)
+    return disc, mask
+
+
+@pytest.mark.parametrize("cap_extra", [0, 7])
+def test_module_stats_match_oracle(rng, cap_extra):
+    """Seven statistics match the oracle, with and without padding."""
+    d_data, d_corr, d_net, t_data, t_corr, t_net, idx = _random_module(rng)
+    m = len(idx)
+    cap = m + cap_extra
+
+    sub = np.ix_(idx, idx)
+    disc_o = oracle.DiscoveryProps(d_corr, d_net, d_data)
+    expected = oracle.module_stats(disc_o, t_corr[sub], t_net[sub], t_data[:, idx])
+
+    disc, mask = _padded_disc(d_corr, d_net, d_data, m, cap)
+    idx_p = _pad(idx.astype(np.int32), cap)
+    got = jstats.gather_and_stats(
+        disc, jnp.asarray(idx_p), jnp.asarray(t_corr, jnp.float32),
+        jnp.asarray(t_net, jnp.float32), jnp.asarray(t_data, jnp.float32),
+        summary_method="eigh",
+    )
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=0, atol=5e-5)
+
+
+def test_dataless_variant(rng):
+    """Without data only avg.weight / cor.cor / cor.degree are finite
+    (SURVEY.md §2.2 data-less case)."""
+    d_data, d_corr, d_net, t_data, t_corr, t_net, idx = _random_module(rng)
+    m = len(idx)
+    sub = np.ix_(idx, idx)
+    disc_o = oracle.DiscoveryProps(d_corr, d_net, None)
+    expected = oracle.module_stats(disc_o, t_corr[sub], t_net[sub], None)
+
+    finite = ~np.isnan(expected)
+    assert [oracle.STAT_NAMES[i] for i in np.where(finite)[0]] == list(oracle.TOPOLOGY_STATS)
+
+    disc, mask = _padded_disc(d_corr, d_net, None, m, m + 3)
+    idx_p = _pad(idx.astype(np.int32), m + 3)
+    got = np.asarray(jstats.gather_and_stats(
+        disc, jnp.asarray(idx_p), jnp.asarray(t_corr, jnp.float32),
+        jnp.asarray(t_net, jnp.float32), None))
+    np.testing.assert_allclose(got[finite], expected[finite], atol=2e-5)
+    assert np.isnan(got[~finite]).all()
+
+
+def test_power_iteration_matches_eigh(rng):
+    """Masked power iteration converges to the exact summary profile on
+    planted-structure data (SURVEY.md §7 'Batched SVD on TPU' risk item)."""
+    d_data, *_ = _random_module(rng, m=24)
+    cap = 30
+    mask = np.zeros(cap, dtype=np.float32)
+    mask[:24] = 1.0
+    z = jstats.standardize_masked(jnp.asarray(_pad(d_data, cap), jnp.float32), jnp.asarray(mask))
+    p_power = np.asarray(jstats.summary_profile_masked(z, jnp.asarray(mask), n_iter=100, method="power"))
+    p_eigh = np.asarray(jstats.summary_profile_masked(z, jnp.asarray(mask), method="eigh"))
+    np.testing.assert_allclose(p_power, p_eigh, atol=1e-4)
+
+    p_oracle = oracle.summary_profile(d_data)
+    np.testing.assert_allclose(p_eigh, p_oracle, atol=1e-4)
+
+
+def test_building_blocks_match_oracle(rng):
+    d_data, d_corr, d_net, *_ = _random_module(rng, m=13)
+    cap = 16
+    mask = np.zeros(cap, dtype=np.float32)
+    mask[:13] = 1.0
+
+    deg = np.asarray(jstats.weighted_degree_masked(
+        jnp.asarray(_pad(_pad(d_net, cap, -1), cap, -2), jnp.float32), jnp.asarray(mask)))
+    np.testing.assert_allclose(deg[:13], oracle.weighted_degree(d_net), atol=1e-5)
+    assert (deg[13:] == 0).all()
+
+    z = jstats.standardize_masked(jnp.asarray(_pad(d_data, cap), jnp.float32), jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(z)[:, :13], oracle.standardize(d_data), atol=2e-5)
+
+    prof = jstats.summary_profile_masked(z, jnp.asarray(mask), method="eigh")
+    nc = np.asarray(jstats.node_contribution_masked(z, prof, jnp.asarray(mask)))
+    np.testing.assert_allclose(nc[:13], oracle.node_contribution(d_data), atol=1e-4)
+
+    coh = float(jstats.masked_mean(jnp.asarray(nc) ** 2, jnp.asarray(mask)))
+    assert abs(coh - oracle.module_coherence(d_data)) < 1e-4
+
+
+def test_masked_pearson_degenerate():
+    x = jnp.asarray([1.0, 1.0, 1.0, 0.0])
+    y = jnp.asarray([1.0, 2.0, 3.0, 0.0])
+    w = jnp.asarray([1.0, 1.0, 1.0, 0.0])
+    assert np.isnan(float(jstats.masked_pearson(x, y, w)))
+
+
+def test_vmap_over_permutations(rng):
+    """The kernel composes with vmap over many index sets — the reference's
+    OpenMP permutation loop axis (SURVEY.md §2.3) as a batched XLA op."""
+    d_data, d_corr, d_net, t_data, t_corr, t_net, _ = _random_module(rng)
+    m, cap, nperm = 17, 20, 8
+    disc, mask = _padded_disc(d_corr, d_net, d_data, m, cap)
+
+    idx_batch = np.zeros((nperm, cap), dtype=np.int32)
+    for p in range(nperm):
+        idx_batch[p, :m] = rng.choice(t_corr.shape[0], size=m, replace=False)
+
+    fn = jax.vmap(lambda ix: jstats.gather_and_stats(
+        disc, ix, jnp.asarray(t_corr, jnp.float32), jnp.asarray(t_net, jnp.float32),
+        jnp.asarray(t_data, jnp.float32), summary_method="eigh"))
+    got = np.asarray(fn(jnp.asarray(idx_batch)))
+
+    disc_o = oracle.DiscoveryProps(d_corr, d_net, d_data)
+    for p in range(nperm):
+        idx = idx_batch[p, :m]
+        sub = np.ix_(idx, idx)
+        expected = oracle.module_stats(disc_o, t_corr[sub], t_net[sub], t_data[:, idx])
+        np.testing.assert_allclose(got[p], expected, atol=1e-4)
