@@ -28,6 +28,61 @@ from .results import PreservationResult, shape_results
 logger = logging.getLogger("netrep_tpu")
 
 
+def _overlap_setup(disc_ds, test_ds, assignments, modules, background_label, null):
+    """Resolve kept modules, specs, pool, and overlap bookkeeping for one
+    (discovery, test) pair (SURVEY.md §3.1)."""
+    labels, specs, counts = ds.module_overlap(
+        disc_ds, test_ds, assignments, modules, background_label
+    )
+    dropped = [lab for lab, _di, ti in specs if len(ti) < 2]
+    if dropped:
+        logger.warning(
+            "discovery %r → test %r: dropping module(s) %s with <2 nodes "
+            "present in the test dataset", disc_ds.name, test_ds.name, dropped,
+        )
+    kept = [(lab, di, ti) for lab, di, ti in specs if len(ti) >= 2]
+    if not kept:
+        raise ValueError(
+            f"no module of discovery {disc_ds.name!r} has ≥2 nodes present "
+            f"in test {test_ds.name!r}; nothing to test"
+        )
+    labels = [lab for lab, _, _ in kept]
+    mod_specs = [ModuleSpec(lab, di, ti) for lab, di, ti in kept]
+
+    tpos = test_ds.index_of()
+    if null == "overlap":
+        pool = np.asarray(
+            [tpos[nm] for nm in disc_ds.node_names if nm in tpos],
+            dtype=np.int32,
+        )
+    else:
+        pool = np.arange(test_ds.n_nodes, dtype=np.int32)
+    return labels, mod_specs, counts, pool
+
+
+def _make_result(d_name, t_name, labels, counts, observed, nulls, completed,
+                 np_this, alternative, total_space):
+    p_values = pv.permutation_pvalues(
+        observed, nulls[:completed], alternative, total_nperm=total_space
+    )
+    n_present = np.array([counts[lab][0] for lab in labels])
+    tot = np.array([counts[lab][1] for lab in labels])
+    return PreservationResult(
+        discovery=d_name,
+        test=t_name,
+        module_labels=labels,
+        observed=observed,
+        nulls=nulls,
+        p_values=p_values,
+        n_vars_present=n_present,
+        prop_vars_present=n_present / tot,
+        total_size=tot,
+        alternative=alternative,
+        n_perm=np_this,
+        completed=completed,
+    )
+
+
 def module_preservation(
     network,
     data=None,
@@ -47,6 +102,7 @@ def module_preservation(
     seed: int = 0,
     config: EngineConfig | None = None,
     mesh=None,
+    vmap_tests: bool = False,
     progress: Callable[[int, int], None] | None = None,
 ):
     """Permutation test of network module preservation across datasets.
@@ -55,9 +111,16 @@ def module_preservation(
 
     - ``seed`` — PRNG seed; same seed ⇒ identical nulls regardless of chunk
       size or device mesh (SURVEY.md §7 "RNG semantics").
-    - ``config`` — :class:`~netrep_tpu.utils.config.EngineConfig` TPU knobs.
+    - ``config`` — :class:`~netrep_tpu.utils.config.EngineConfig` TPU knobs
+      (chunk size, summary method, dtype, matrix sharding).
     - ``mesh`` — optional :class:`jax.sharding.Mesh`; permutation chunks are
-      sharded across its ``config.mesh_axis`` axis (SURVEY.md §2.3).
+      sharded across ``config.mesh_axis``, and with
+      ``config.matrix_sharding='row'`` the n×n matrices are row-sharded with
+      collective module gathers (SURVEY.md §2.3, §5).
+    - ``vmap_tests`` — Config C fast path (BASELINE.json:9): when one
+      discovery is tested against several cohorts sharing an identical node
+      universe, run them as a single vmapped kernel instead of sequential
+      pairs.
     - ``progress`` — callback ``(done, total)`` per chunk.
 
     Returns
@@ -80,103 +143,113 @@ def module_preservation(
         module_assignments, datasets, disc_names
     )
 
-    if n_perm is None:
-        # reference default: enough permutations for Bonferroni-corrected
-        # significance at 0.05 across modules (SURVEY.md §3.1 requiredPerms-
-        # style default), with a floor of 1000.
-        n_perm_auto = True
-    else:
-        n_perm_auto = False
+    by_disc: dict[str, list[str]] = {}
+    for d_name, t_name in pairs:
+        by_disc.setdefault(d_name, []).append(t_name)
+
+    def auto_n_perm(labels, with_data):
+        # Bonferroni across all module×statistic tests (SURVEY.md §3.4):
+        # 7 statistics with data, 3 topology-only without; floor of 1000.
+        n_stats_eff = 7 if with_data else 3
+        return max(1000, pv.required_perms(0.05, n_tests=len(labels) * n_stats_eff))
 
     results: dict[str, dict[str, PreservationResult]] = {}
-    for d_name, t_name in pairs:
-        disc_ds, test_ds = datasets[d_name], datasets[t_name]
-        labels, specs, counts = ds.module_overlap(
-            disc_ds, test_ds, assign[d_name], modules, background_label
-        )
-        dropped = [lab for lab, di, ti in specs if len(ti) < 2]
-        if dropped:
-            logger.warning(
-                "discovery %r → test %r: dropping module(s) %s with <2 "
-                "nodes present in the test dataset", d_name, t_name, dropped,
-            )
-        kept = [(lab, di, ti) for lab, di, ti in specs if len(ti) >= 2]
-        if not kept:
-            raise ValueError(
-                f"no module of discovery {d_name!r} has ≥2 nodes present in "
-                f"test {t_name!r}; nothing to test"
-            )
-        labels = [lab for lab, _, _ in kept]
-        mod_specs = [ModuleSpec(lab, di, ti) for lab, di, ti in kept]
-
-        tpos = test_ds.index_of()
-        if null == "overlap":
-            pool = np.asarray(
-                [tpos[nm] for nm in disc_ds.node_names if nm in tpos],
-                dtype=np.int32,
-            )
-        else:
-            pool = np.arange(test_ds.n_nodes, dtype=np.int32)
-
-        # Bonferroni across all module×statistic tests (SURVEY.md §3.4):
-        # 7 statistics with data, 3 topology-only without.
-        n_stats_eff = 7 if (disc_ds.data is not None and test_ds.data is not None) else 3
-        np_this = (
-            max(1000, pv.required_perms(0.05, n_tests=len(labels) * n_stats_eff))
-            if n_perm_auto
-            else n_perm
-        )
-        if verbose:
-            logger.info(
-                "discovery %r → test %r: %d modules, %d permutations, "
-                "null=%r", d_name, t_name, len(labels), np_this, null,
-            )
-
-        engine = PermutationEngine(
-            disc_ds.correlation, disc_ds.network, disc_ds.data,
-            test_ds.correlation, test_ds.network, test_ds.data,
-            mod_specs, pool, config=config, mesh=mesh,
-        )
-        observed = engine.observed()
-        nulls, completed = engine.run_null(
-            np_this, key=seed, progress=progress
-        )
-        interrupted = completed < np_this
+    interrupted = False
+    for d_name, t_names in by_disc.items():
         if interrupted:
-            logger.warning(
-                "interrupted after %d/%d permutations; p-values use the "
-                "completed subset", completed, np_this,
-            )
-
-        total_space = pv.total_permutations(
-            pool.size, [m.size for m in mod_specs]
-        )
-        p_values = pv.permutation_pvalues(
-            observed, nulls[:completed], alternative, total_nperm=total_space
-        )
-
-        n_present = np.array([counts[lab][0] for lab in labels])
-        tot = np.array([counts[lab][1] for lab in labels])
-        res = PreservationResult(
-            discovery=d_name,
-            test=t_name,
-            module_labels=labels,
-            observed=observed,
-            nulls=nulls,
-            p_values=p_values,
-            n_vars_present=n_present,
-            prop_vars_present=n_present / tot,
-            total_size=tot,
-            alternative=alternative,
-            n_perm=np_this,
-            completed=completed,
-        )
-        results.setdefault(d_name, {})[t_name] = res
-        if interrupted:
-            # Ctrl-C aborts the whole multi-pair run, not just the current
-            # pair (the reference's clean user-interrupt, SURVEY.md §5);
-            # pairs finished so far are returned.
-            logger.warning("stopping remaining dataset pairs after interrupt")
             break
+        disc_ds = datasets[d_name]
+
+        can_vmap = (
+            vmap_tests
+            and len(t_names) > 1
+            and config.matrix_sharding != "row"
+            and all(
+                datasets[t].node_names == datasets[t_names[0]].node_names
+                for t in t_names
+            )
+            and len({datasets[t].data is not None for t in t_names}) == 1
+        )
+        if vmap_tests and not can_vmap and len(t_names) > 1:
+            logger.warning(
+                "vmap_tests requested but unavailable (test datasets %s must "
+                "share a node universe, agree on data presence, and "
+                "matrix_sharding must not be 'row'); falling back to "
+                "sequential pairs", t_names,
+            )
+
+        if can_vmap:
+            from ..parallel.multitest import MultiTestEngine
+
+            t0 = datasets[t_names[0]]
+            labels, mod_specs, counts, pool = _overlap_setup(
+                disc_ds, t0, assign[d_name], modules, background_label, null
+            )
+            with_data = disc_ds.data is not None and t0.data is not None
+            np_this = n_perm if n_perm is not None else auto_n_perm(labels, with_data)
+            if verbose:
+                logger.info(
+                    "discovery %r → tests %s (vmapped): %d modules, %d "
+                    "permutations", d_name, t_names, len(labels), np_this,
+                )
+            engine = MultiTestEngine(
+                disc_ds.correlation, disc_ds.network, disc_ds.data,
+                np.stack([datasets[t].correlation for t in t_names]),
+                np.stack([datasets[t].network for t in t_names]),
+                [datasets[t].data for t in t_names] if with_data else None,
+                mod_specs, pool, config=config, mesh=mesh,
+            )
+            observed = engine.observed()
+            nulls, completed = engine.run_null(np_this, key=seed, progress=progress)
+            interrupted = completed < np_this
+            if interrupted:
+                logger.warning(
+                    "interrupted after %d/%d permutations; p-values use the "
+                    "completed subset; stopping remaining pairs",
+                    completed, np_this,
+                )
+            total_space = pv.total_permutations(pool.size, [m.size for m in mod_specs])
+            for ti, t_name in enumerate(t_names):
+                results.setdefault(d_name, {})[t_name] = _make_result(
+                    d_name, t_name, labels, counts, observed[ti],
+                    nulls[ti], completed, np_this, alternative, total_space,
+                )
+            continue
+
+        for t_name in t_names:
+            test_ds = datasets[t_name]
+            labels, mod_specs, counts, pool = _overlap_setup(
+                disc_ds, test_ds, assign[d_name], modules, background_label, null
+            )
+            with_data = disc_ds.data is not None and test_ds.data is not None
+            np_this = n_perm if n_perm is not None else auto_n_perm(labels, with_data)
+            if verbose:
+                logger.info(
+                    "discovery %r → test %r: %d modules, %d permutations, "
+                    "null=%r", d_name, t_name, len(labels), np_this, null,
+                )
+            engine = PermutationEngine(
+                disc_ds.correlation, disc_ds.network, disc_ds.data,
+                test_ds.correlation, test_ds.network, test_ds.data,
+                mod_specs, pool, config=config, mesh=mesh,
+            )
+            observed = engine.observed()
+            nulls, completed = engine.run_null(np_this, key=seed, progress=progress)
+            total_space = pv.total_permutations(pool.size, [m.size for m in mod_specs])
+            results.setdefault(d_name, {})[t_name] = _make_result(
+                d_name, t_name, labels, counts, observed, nulls, completed,
+                np_this, alternative, total_space,
+            )
+            if completed < np_this:
+                # Ctrl-C aborts the whole multi-pair run, not just the
+                # current pair (the reference's clean user-interrupt,
+                # SURVEY.md §5); pairs finished so far are returned.
+                interrupted = True
+                logger.warning(
+                    "interrupted after %d/%d permutations; p-values use the "
+                    "completed subset; stopping remaining pairs",
+                    completed, np_this,
+                )
+                break
 
     return shape_results(results, simplify)

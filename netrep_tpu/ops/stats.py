@@ -132,7 +132,12 @@ def summary_profile_masked(
             v = v / jnp.maximum(jnp.linalg.norm(v, axis=-1, keepdims=True), _EPS)
             return v, None
 
-        v0 = w / jnp.maximum(jnp.linalg.norm(w, axis=-1, keepdims=True), _EPS)
+        # broadcast the start vector to the gram's full batch shape up front —
+        # the scan carry must have a fixed type even when the mask carries
+        # fewer batch dims than the data (broadcast-batched callers).
+        batch = jnp.broadcast_shapes(gram.shape[:-2], w.shape[:-1])
+        v0 = jnp.broadcast_to(w, batch + w.shape[-1:])
+        v0 = v0 / jnp.maximum(jnp.linalg.norm(v0, axis=-1, keepdims=True), _EPS)
         v, _ = jax.lax.scan(step, v0, None, length=n_iter)
     else:
         raise ValueError(f"unknown summary method: {method!r}")

@@ -106,18 +106,62 @@ class PermutationEngine:
         pool: np.ndarray,
         config: EngineConfig = EngineConfig(),
         mesh: Mesh | None = None,
+        discovery_only: bool = False,
     ):
+        """``discovery_only=True`` builds only the discovery-side buckets and
+        pool bookkeeping (test matrices may be None) — used by wrappers like
+        :class:`~netrep_tpu.parallel.multitest.MultiTestEngine` that supply
+        their own test side; ``observed``/``run_null`` must not be called."""
         self.config = config
         self.mesh = mesh
         self.modules = list(modules)
-        self.has_data = disc_data is not None and test_data is not None
+        self.discovery_only = discovery_only
+        self.has_data = disc_data is not None and (
+            discovery_only or test_data is not None
+        )
         self.n_modules = len(self.modules)
 
+        self.row_sharded = (
+            mesh is not None and config.matrix_sharding == "row"
+        )
+        if config.matrix_sharding not in ("replicated", "row"):
+            raise ValueError(
+                f"matrix_sharding must be 'replicated' or 'row', got "
+                f"{config.matrix_sharding!r}"
+            )
+        if config.matrix_sharding == "row" and mesh is None:
+            raise ValueError("matrix_sharding='row' requires a mesh")
+
         dtype = jnp.dtype(config.dtype)
-        self._test_corr = jnp.asarray(test_corr, dtype)
-        self._test_net = jnp.asarray(test_net, dtype)
+        if discovery_only:
+            self._test_corr = self._test_net = None
+            if self.row_sharded:
+                from .sharded import make_sharded_gatherer
+
+                self._gather_perm = make_sharded_gatherer(mesh, config.mesh_axis)
+                self._gather_rep = make_sharded_gatherer(mesh, None)
+        elif self.row_sharded:
+            from .mesh import ROW_AXIS
+            from .sharded import (
+                make_sharded_gatherer, pad_square_to_multiple, shard_rows,
+            )
+
+            d_row = mesh.shape[ROW_AXIS]
+            self._test_corr = shard_rows(
+                jnp.asarray(pad_square_to_multiple(test_corr, d_row), dtype), mesh
+            )
+            self._test_net = shard_rows(
+                jnp.asarray(pad_square_to_multiple(test_net, d_row), dtype), mesh
+            )
+            self._gather_perm = make_sharded_gatherer(mesh, config.mesh_axis)
+            self._gather_rep = make_sharded_gatherer(mesh, None)
+        else:
+            self._test_corr = jnp.asarray(test_corr, dtype)
+            self._test_net = jnp.asarray(test_net, dtype)
         self._test_data = (
-            jnp.asarray(test_data, dtype) if self.has_data else None
+            jnp.asarray(test_data, dtype)
+            if (self.has_data and test_data is not None)
+            else None
         )
 
         sizes = [m.size for m in self.modules]
@@ -146,24 +190,48 @@ class PermutationEngine:
         for k, m in enumerate(self.modules):
             by_cap.setdefault(config.rounded_cap(m.size), []).append(k)
 
-        d_corr = jnp.asarray(disc_corr, jnp.float32)
-        d_net = jnp.asarray(disc_net, jnp.float32)
         d_data = (
             jnp.asarray(disc_data, jnp.float32) if self.has_data else None
         )
+        if self.row_sharded:
+            from .mesh import ROW_AXIS
+            from .sharded import pad_square_to_multiple, shard_rows
 
-        @jax.jit
-        def _disc_bucket(idx, mask):
-            # idx: (K, cap) padded discovery indices; mask: (K, cap)
-            sub = lambda mat, ix: mat[ix[:, None], ix[None, :]]
-            corr_b = jax.vmap(partial(sub, d_corr))(idx)
-            net_b = jax.vmap(partial(sub, d_net))(idx)
-            data_b = (
-                jax.vmap(lambda ix: jnp.take(d_data, ix, axis=1))(idx)
-                if d_data is not None
-                else None
+            d_row = mesh.shape[ROW_AXIS]
+            d_corr = shard_rows(
+                jnp.asarray(pad_square_to_multiple(disc_corr, d_row), jnp.float32),
+                mesh,
             )
-            return jstats.make_disc_props(corr_b, net_b, data_b, mask)
+            d_net = shard_rows(
+                jnp.asarray(pad_square_to_multiple(disc_net, d_row), jnp.float32),
+                mesh,
+            )
+
+            @jax.jit
+            def _disc_bucket(idx, mask):
+                corr_b, net_b = self._gather_rep(d_corr, d_net, idx)
+                data_b = (
+                    jax.vmap(lambda ix: jnp.take(d_data, ix, axis=1))(idx)
+                    if d_data is not None
+                    else None
+                )
+                return jstats.make_disc_props(corr_b, net_b, data_b, mask)
+        else:
+            d_corr = jnp.asarray(disc_corr, jnp.float32)
+            d_net = jnp.asarray(disc_net, jnp.float32)
+
+            @jax.jit
+            def _disc_bucket(idx, mask):
+                # idx: (K, cap) padded discovery indices; mask: (K, cap)
+                sub = lambda mat, ix: mat[ix[:, None], ix[None, :]]
+                corr_b = jax.vmap(partial(sub, d_corr))(idx)
+                net_b = jax.vmap(partial(sub, d_net))(idx)
+                data_b = (
+                    jax.vmap(lambda ix: jnp.take(d_data, ix, axis=1))(idx)
+                    if d_data is not None
+                    else None
+                )
+                return jstats.make_disc_props(corr_b, net_b, data_b, mask)
 
         self.buckets: list[_Bucket] = []
         for cap in sorted(by_cap):
@@ -192,19 +260,59 @@ class PermutationEngine:
     # Observed pass (SURVEY.md §3.1 "observed pass")
     # ------------------------------------------------------------------
 
+    # -- shared chunk/key contract (single source of truth for the
+    #    reproducibility guarantee; also used by MultiTestEngine) ----------
+
+    def effective_chunk(self) -> int:
+        """Chunk size, rounded to a multiple of the mesh's permutation axis."""
+        C = self.config.chunk_size
+        if self.mesh is not None:
+            ax = self.mesh.shape[self.config.mesh_axis]
+            C = max(ax, (C // ax) * ax)
+        return C
+
+    @staticmethod
+    def perm_keys(key: jax.Array, start: int, count: int) -> jax.Array:
+        """Per-permutation keys ``fold_in(key, i)`` for i in [start, start+count)
+        — the chunk-size- and mesh-independent seeding contract
+        (SURVEY.md §7 "RNG semantics")."""
+        return jax.vmap(partial(jax.random.fold_in, key))(
+            jnp.arange(start, start + count, dtype=jnp.uint32)
+        )
+
     def observed(self) -> np.ndarray:
         """(n_modules, 7) observed statistics on the actual overlap sets."""
-        if self._observed_fn is None:
-            self._observed_fn = jax.jit(
-                jax.vmap(
-                    partial(
-                        jstats.gather_and_stats,
-                        n_iter=self.config.power_iters,
-                        summary_method="eigh",  # observed pass: exact, runs once
-                    ),
-                    in_axes=(0, 0, None, None, None),
-                )
+        if self.discovery_only:
+            raise RuntimeError(
+                "engine was built discovery_only; test-side passes live in "
+                "the wrapping engine"
             )
+        if self._observed_fn is None:
+            if self.row_sharded:
+                gather_rep = self._gather_rep
+
+                def _obs(disc, idx, tc, tn, td):
+                    sub_c, sub_n = gather_rep(tc, tn, idx)
+                    zd = None
+                    if td is not None:
+                        sub_d = jax.vmap(lambda ix: jnp.take(td, ix, axis=-1))(idx)
+                        zd = jstats.standardize_masked(sub_d, disc.mask)
+                    return jstats.module_stats_masked(
+                        disc, sub_c, sub_n, zd, summary_method="eigh"
+                    )
+
+                self._observed_fn = jax.jit(_obs)
+            else:
+                self._observed_fn = jax.jit(
+                    jax.vmap(
+                        partial(
+                            jstats.gather_and_stats,
+                            n_iter=self.config.power_iters,
+                            summary_method="eigh",  # observed: exact, runs once
+                        ),
+                        in_axes=(0, 0, None, None, None),
+                    )
+                )
         out = np.full((self.n_modules, N_STATS), np.nan)
         for b in self.buckets:
             res = self._observed_fn(
@@ -228,6 +336,8 @@ class PermutationEngine:
         buckets = self.buckets
         pool = self._pool_dev
         tc, tn, td = self._test_corr, self._test_net, self._test_data
+        row_sharded = self.row_sharded
+        gather_perm = self._gather_perm if row_sharded else None
 
         def chunk(keys: jax.Array) -> list[jax.Array]:
             # keys: (C,) typed PRNG keys, one per permutation
@@ -240,16 +350,35 @@ class PermutationEngine:
                     idx = jnp.pad(idx, ((0, 0), (0, b.cap - size)))
                     cols.append(idx)
                 idx_b = jnp.stack(cols, axis=1)  # (C, K, cap)
-                inner = jax.vmap(
-                    partial(
-                        jstats.gather_and_stats,
-                        n_iter=cfg.power_iters,
-                        summary_method=cfg.summary_method,
-                    ),
-                    in_axes=(0, 0, None, None, None),
-                )
-                over_perms = jax.vmap(inner, in_axes=(None, 0, None, None, None))
-                outs.append(over_perms(b.disc, idx_b, tc, tn, td))
+                if row_sharded:
+                    # collective-assembled gathers from the row-sharded
+                    # matrices; statistics batch over (C, K) by broadcasting
+                    # (disc props carry the K axis).
+                    sub_c, sub_n = gather_perm(tc, tn, idx_b)
+                    zd = None
+                    if td is not None:
+                        sub_d = jax.vmap(
+                            jax.vmap(lambda ix: jnp.take(td, ix, axis=-1))
+                        )(idx_b)  # (C, K, samples, cap)
+                        zd = jstats.standardize_masked(sub_d, b.disc.mask)
+                    outs.append(
+                        jstats.module_stats_masked(
+                            b.disc, sub_c, sub_n, zd,
+                            n_iter=cfg.power_iters,
+                            summary_method=cfg.summary_method,
+                        )
+                    )
+                else:
+                    inner = jax.vmap(
+                        partial(
+                            jstats.gather_and_stats,
+                            n_iter=cfg.power_iters,
+                            summary_method=cfg.summary_method,
+                        ),
+                        in_axes=(0, 0, None, None, None),
+                    )
+                    over_perms = jax.vmap(inner, in_axes=(None, 0, None, None, None))
+                    outs.append(over_perms(b.disc, idx_b, tc, tn, td))
             return outs
 
         return chunk
@@ -306,29 +435,25 @@ class PermutationEngine:
         partial result instead of raising (the reference's Ctrl-C path,
         SURVEY.md §5 "failure detection").
         """
+        if self.discovery_only:
+            raise RuntimeError(
+                "engine was built discovery_only; test-side passes live in "
+                "the wrapping engine"
+            )
         if isinstance(key, int):
             key = jax.random.key(key)
 
-        C = self.config.chunk_size
-        if self.mesh is not None:
-            # pad chunk size to a multiple of the mesh axis
-            ax = self.mesh.shape[self.config.mesh_axis]
-            C = max(ax, (C // ax) * ax)
-
+        C = self.effective_chunk()
         if nulls_init is not None:
             nulls = nulls_init
         else:
             nulls = np.full((n_perm, self.n_modules, N_STATS), np.nan)
-        # Per-permutation keys derived by fold_in(perm_index): chunk-size and
-        # mesh independent.
         fn = self._chunk_fn()
         done = start_perm
         try:
             while done < n_perm:
                 take = min(C, n_perm - done)
-                keys = jax.vmap(partial(jax.random.fold_in, key))(
-                    jnp.arange(done, done + C, dtype=jnp.uint32)
-                )
+                keys = self.perm_keys(key, done, C)
                 outs = fn(keys)
                 for b, out in zip(self.buckets, outs):
                     arr = np.asarray(out[:take], dtype=np.float64)

@@ -1,0 +1,197 @@
+"""Multi-test-dataset vmap path — Config C (BASELINE.json:9; SURVEY.md §2.3
+"multi-dataset parallelism"): the reference loops (discovery, test) pairs
+sequentially in R; on TPU, when several test cohorts share one node universe
+(the common consortium design: same genes measured in every cohort), the
+engine vmaps the whole permutation kernel over a stacked (T, n, n) test-matrix
+axis — one compiled program, T× the arithmetic intensity per gather of the
+shared permutation index batch.
+
+Statistical note: the same permutation node-sets are reused across the T test
+datasets within one run. Nulls remain valid per pair (each dataset's matrices
+are independent of the shared index draw); only the *joint* distribution
+across datasets is coupled, which the reference's sequential independent runs
+don't expose either way because p-values are computed per pair.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import stats as jstats
+from ..ops.oracle import N_STATS
+from ..utils.config import EngineConfig
+from .engine import ModuleSpec, PermutationEngine
+
+
+class MultiTestEngine:
+    """Permutation engine for one discovery dataset against T stacked test
+    datasets with identical node universes.
+
+    Wraps :class:`PermutationEngine` for bucket construction (discovery-side
+    properties, sizes, pool validation) and adds a dataset axis to the test
+    side of every kernel via vmap.
+    """
+
+    def __init__(
+        self,
+        disc_corr, disc_net, disc_data,
+        test_corrs,   # (T, n, n)
+        test_nets,    # (T, n, n)
+        test_datas,   # list of (samples_t, n) per dataset (ragged ok) or None
+        modules: Sequence[ModuleSpec],
+        pool: np.ndarray,
+        config: EngineConfig = EngineConfig(),
+        mesh=None,
+    ):
+        if config.matrix_sharding == "row":
+            raise NotImplementedError(
+                "matrix_sharding='row' is not supported on the multi-test "
+                "vmap path (the stacked (T, n, n) matrices would be "
+                "replicated); run the pairs sequentially "
+                "(vmap_tests=False) for row-sharded Config D scale"
+            )
+        test_corrs = np.asarray(test_corrs)
+        self.T = test_corrs.shape[0]
+        # Base engine: discovery-side buckets + pool validation only — no
+        # throwaway test-side device transfer (the test side lives here).
+        self._base = PermutationEngine(
+            disc_corr, disc_net,
+            disc_data if test_datas is not None else None,
+            None, None, None,
+            modules, pool, config=config, mesh=mesh, discovery_only=True,
+        )
+        dtype = jnp.dtype(config.dtype)
+        self._tc = jnp.asarray(test_corrs, dtype)
+        self._tn = jnp.asarray(test_nets, dtype)
+        # ragged sample counts across datasets are allowed → keep a list and
+        # vmap only when uniform, else python-loop the T axis for data.
+        if test_datas is None:
+            self._td = None
+            self._uniform_samples = True
+        else:
+            shapes = {np.asarray(d).shape for d in test_datas}
+            self._uniform_samples = len(shapes) == 1
+            if self._uniform_samples:
+                self._td = jnp.asarray(np.stack(test_datas), dtype)
+            else:
+                self._td = [jnp.asarray(d, dtype) for d in test_datas]
+        self.config = config
+        self.mesh = mesh
+        self.modules = self._base.modules
+        self.n_modules = self._base.n_modules
+        self._chunk_cached: Callable | None = None
+
+    # -- kernel composition ------------------------------------------------
+
+    def _stats_stack(self, summary_method: str):
+        """vmap composition: modules → (optionally) permutations → datasets."""
+        one = partial(
+            jstats.gather_and_stats,
+            n_iter=self.config.power_iters,
+            summary_method=summary_method,
+        )
+        over_mod = jax.vmap(one, in_axes=(0, 0, None, None, None))
+        return over_mod
+
+    def observed(self) -> np.ndarray:
+        """(T, n_modules, 7) observed statistics."""
+        over_mod = self._stats_stack("eigh")
+        out = np.full((self.T, self.n_modules, N_STATS), np.nan)
+        if self._td is None or self._uniform_samples:
+            over_test = jax.jit(jax.vmap(
+                over_mod, in_axes=(None, None, 0, 0, None if self._td is None else 0)
+            ))
+            for b in self._base.buckets:
+                res = over_test(b.disc, b.obs_idx, self._tc, self._tn, self._td)
+                out[:, b.module_pos] = np.asarray(res, dtype=np.float64)
+        else:
+            fn = jax.jit(over_mod)
+            for t in range(self.T):
+                for b in self._base.buckets:
+                    res = fn(b.disc, b.obs_idx, self._tc[t], self._tn[t], self._td[t])
+                    out[t, b.module_pos] = np.asarray(res, dtype=np.float64)
+        return out
+
+    def _chunk_fn(self) -> Callable:
+        if self._chunk_cached is not None:
+            return self._chunk_cached
+        cfg = self.config
+        base = self._base
+        pool = base._pool_dev
+        tc, tn, td = self._tc, self._tn, self._td
+        uniform = self._td is None or self._uniform_samples
+        over_mod = self._stats_stack(cfg.summary_method)
+        over_perm = jax.vmap(over_mod, in_axes=(None, 0, None, None, None))
+
+        def chunk(keys):
+            perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)
+            outs = []
+            for b in base.buckets:
+                cols = []
+                for off, size in b.slices:
+                    idx = perm[:, off: off + size]
+                    cols.append(jnp.pad(idx, ((0, 0), (0, b.cap - size))))
+                idx_b = jnp.stack(cols, axis=1)  # (C, K, cap)
+                if uniform:
+                    over_test = jax.vmap(
+                        over_perm,
+                        in_axes=(None, None, 0, 0, None if td is None else 0),
+                    )
+                    outs.append(over_test(b.disc, idx_b, tc, tn, td))  # (T,C,K,7)
+                else:
+                    outs.append(jnp.stack([
+                        over_perm(b.disc, idx_b, tc[t], tn[t], td[t])
+                        for t in range(self.T)
+                    ]))
+            return outs
+
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            ksh = NamedSharding(self.mesh, P(cfg.mesh_axis))
+            osh = [
+                NamedSharding(self.mesh, P(None, cfg.mesh_axis))
+                for _ in base.buckets
+            ]
+            self._chunk_cached = jax.jit(chunk, in_shardings=(ksh,), out_shardings=osh)
+        else:
+            self._chunk_cached = jax.jit(chunk)
+        return self._chunk_cached
+
+    def run_null(self, n_perm: int, key=0, progress=None,
+                 nulls_init=None, start_perm: int = 0):
+        """(T, n_perm, n_modules, 7) null array + completed count; same
+        chunked/interruptible/reproducible/resumable contract as the base
+        engine (key derivation and chunk rounding are shared helpers on
+        :class:`PermutationEngine` so the two paths cannot drift)."""
+        if isinstance(key, int):
+            key = jax.random.key(key)
+        C = self._base.effective_chunk()
+        fn = self._chunk_fn()
+        if nulls_init is not None:
+            nulls = nulls_init
+        else:
+            nulls = np.full((self.T, n_perm, self.n_modules, N_STATS), np.nan)
+        done = start_perm
+        try:
+            while done < n_perm:
+                take = min(C, n_perm - done)
+                keys = self._base.perm_keys(key, done, C)
+                outs = fn(keys)
+                for b, outarr in zip(self._base.buckets, outs):
+                    # (T, take, K, 7); a single advanced index (module_pos)
+                    # keeps its axis position in the assignment target.
+                    arr = np.asarray(outarr[:, :take], dtype=np.float64)
+                    nulls[:, done: done + take, b.module_pos] = arr
+                done += take
+                if progress is not None:
+                    progress(done, n_perm)
+        except KeyboardInterrupt:
+            pass
+        return nulls, done

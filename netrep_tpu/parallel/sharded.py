@@ -1,0 +1,115 @@
+"""Row-sharded n×n matrices with collective-assembled module gathers —
+the framework's "context parallelism" (SURVEY.md §5 "long-context": the role
+of the long axis is played by network size n; at 50k nodes the three n×n f32
+matrices are ~10 GB each and must be sharded across the mesh, with module
+submatrix gathers assembled by collectives; §7 step 5, Config D
+[BASELINE.json:10]).
+
+Design: a matrix is laid out ``P(ROW_AXIS, None)`` — each device owns a
+contiguous block of rows (full row width, so the column gather is local).
+A module gather ``M[idx][:, idx]`` becomes, inside ``shard_map``:
+
+1. local column gather ``block[:, idx]`` — (rows/D, m), pure local HBM reads;
+2. local row selection: positions of ``idx`` that fall inside this device's
+   row block, others zeroed;
+3. ``psum`` over the row axis — each shard contributes its disjoint rows, the
+   sum assembles the full (m, m) submatrix on every shard.
+
+The psum rides ICI and moves only O(m²) per gather — m ≪ n, so the collective
+is tiny compared to the HBM savings of never materializing n² on one device.
+
+Data matrices (samples × n, samples ≪ n) stay replicated and are gathered
+with a plain ``take`` outside the shard region.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import ROW_AXIS
+
+try:  # jax ≥ 0.6 exports shard_map at top level; older under experimental
+    _shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_rows(mat, mesh: Mesh, axis: str = ROW_AXIS):
+    """Place an (n, n) matrix with rows sharded over ``axis``. Rows must
+    divide evenly by the axis size (pad first: :func:`pad_rows_to_multiple`)."""
+    n = mat.shape[0]
+    d = mesh.shape[axis]
+    if n % d:
+        raise ValueError(
+            f"rows ({n}) not divisible by mesh axis {axis!r} size {d}; "
+            "pad the matrix first (pad_rows_to_multiple)"
+        )
+    return jax.device_put(mat, NamedSharding(mesh, P(axis, None)))
+
+
+def pad_square_to_multiple(mat, d: int):
+    """Zero-pad both axes of a square matrix to a multiple of ``d`` (padding
+    is inert: gather indices only ever point at real nodes)."""
+    import numpy as np
+
+    n = mat.shape[0]
+    pad = (-n) % d
+    if pad == 0:
+        return mat
+    return np.pad(np.asarray(mat), [(0, pad), (0, pad)])
+
+
+def gather_submatrix_local(block: jnp.ndarray, idx: jnp.ndarray, axis: str = ROW_AXIS):
+    """Inside ``shard_map``: assemble ``M[idx][:, idx]`` from this device's
+    row block via the local-gather + psum recipe (module docstring).
+
+    ``block`` is (rows_per_shard, n); ``idx`` is (m,) global row/col indices,
+    replicated across the row axis. Returns the full (m, m) submatrix
+    (identical on every row shard after the psum)."""
+    rows_per = block.shape[0]
+    start = jax.lax.axis_index(axis) * rows_per
+    rel = idx - start
+    in_block = (rel >= 0) & (rel < rows_per)
+    safe = jnp.where(in_block, rel, 0)
+    cols = block[:, idx]                       # (rows_per, m) local gather
+    part = jnp.where(in_block[:, None], cols[safe, :], 0.0)  # (m, m)
+    return jax.lax.psum(part, axis)
+
+
+def make_sharded_gatherer(mesh: Mesh, batch_axis: str | None = None):
+    """Build a ``shard_map``-wrapped batched gather over row-sharded
+    correlation/network matrices.
+
+    Returns ``gather(corr, net, idx)`` with ``idx`` (..., m) int32
+    (arbitrary leading batch dims) → ``(sub_corr, sub_net)`` each
+    (..., m, m). With ``batch_axis`` set (e.g. the permutation axis), the
+    leading batch dim of ``idx`` and of the outputs stays sharded over that
+    mesh axis — permutation data parallelism composes with row sharding on a
+    2-D mesh, and each psum assembles only the local permutation shard's
+    submatrices. The psums batch into one collective pair per call."""
+
+    def body(corr_blk, net_blk, idx_rep):
+        def one(ix):
+            return (
+                gather_submatrix_local(corr_blk, ix),
+                gather_submatrix_local(net_blk, ix),
+            )
+
+        fn = one
+        for _ in range(idx_rep.ndim - 1):
+            fn = jax.vmap(fn)
+        return fn(idx_rep)
+
+    idx_spec = P(batch_axis) if batch_axis else P()
+
+    def gather(corr, net, idx):
+        return _shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(ROW_AXIS, None), P(ROW_AXIS, None), idx_spec),
+            out_specs=(idx_spec, idx_spec),
+        )(corr, net, idx)
+
+    return gather

@@ -30,6 +30,10 @@ class EngineConfig:
     dtype : matrix element dtype on device ('float32' or 'bfloat16' for the
         gather-bound large-n path; statistics always accumulate in f32).
     mesh_axis : name of the permutation data-parallel mesh axis.
+    matrix_sharding : 'replicated' (matrices fit in one HBM; permutation
+        axis only) or 'row' (n×n matrices row-sharded over the mesh's row
+        axis with psum-assembled module gathers — SURVEY.md §5 long-context
+        analogue, Config D scale).
     """
 
     chunk_size: int = 128
@@ -38,6 +42,7 @@ class EngineConfig:
     bucket_rounding: int = 8
     dtype: str = "float32"
     mesh_axis: str = "perm"
+    matrix_sharding: str = "replicated"
 
     def rounded_cap(self, size: int) -> int:
         cap = self.bucket_rounding

@@ -1,0 +1,206 @@
+"""Distributed-layer tests on the virtual 8-device CPU mesh (SURVEY.md §4
+"multi-node without a real cluster"): mesh construction, psum-assembled
+module gathers from row-sharded matrices, the 2-D (perm × row) engine path,
+and the multi-test vmap path (Config C)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from netrep_tpu.parallel import mesh as meshmod
+from netrep_tpu.parallel import sharded
+from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+from netrep_tpu.parallel.multitest import MultiTestEngine
+from netrep_tpu.utils.config import EngineConfig
+
+from test_engine import _make_setup
+
+
+def test_make_mesh_shapes():
+    m = meshmod.make_mesh()
+    assert m.shape == {"perm": 8, "row": 1}
+    m2 = meshmod.make_mesh(n_row_shards=4)
+    assert m2.shape == {"perm": 2, "row": 4}
+    with pytest.raises(ValueError, match="not divisible"):
+        meshmod.make_mesh(n_row_shards=3)
+    with pytest.raises(ValueError, match="needs"):
+        meshmod.make_mesh(n_perm_shards=5, n_row_shards=4)
+
+
+def test_sharded_gather_matches_dense(rng):
+    n, m_sz = 64, 9
+    mesh = meshmod.make_mesh(n_perm_shards=2, n_row_shards=4)
+    mat = rng.standard_normal((n, n))
+    mat2 = rng.standard_normal((n, n))
+    corr = sharded.shard_rows(jnp.asarray(mat, jnp.float32), mesh)
+    net = sharded.shard_rows(jnp.asarray(mat2, jnp.float32), mesh)
+
+    idx = rng.choice(n, size=(3, 5, m_sz), replace=True).astype(np.int32)
+    gather = sharded.make_sharded_gatherer(mesh)
+    sub_c, sub_n = jax.jit(lambda i: gather(corr, net, i))(jnp.asarray(idx))
+    for a in range(3):
+        for b in range(5):
+            np.testing.assert_allclose(
+                np.asarray(sub_c)[a, b], mat[np.ix_(idx[a, b], idx[a, b])], atol=1e-6
+            )
+            np.testing.assert_allclose(
+                np.asarray(sub_n)[a, b], mat2[np.ix_(idx[a, b], idx[a, b])], atol=1e-6
+            )
+
+
+def test_pad_square_to_multiple():
+    m = np.ones((10, 10))
+    p = sharded.pad_square_to_multiple(m, 4)
+    assert p.shape == (12, 12)
+    assert p[10:].sum() == 0 and p[:, 10:].sum() == 0
+    assert sharded.pad_square_to_multiple(m, 5) is m
+
+
+def test_row_sharded_engine_matches_replicated(setup_pair):
+    """Full 2-D mesh (perm × row): row-sharded matrices + sharded permutation
+    chunks reproduce the single-device null exactly (same seed contract)."""
+    d, t, modules, pool = setup_pair
+    ref = PermutationEngine(
+        d["correlation"], d["network"], d["data"],
+        t["correlation"], t["network"], t["data"],
+        modules, pool, config=EngineConfig(chunk_size=8, summary_method="eigh"),
+    )
+    obs_ref = ref.observed()
+    nulls_ref, _ = ref.run_null(16, key=21)
+
+    mesh2d = meshmod.make_mesh(n_perm_shards=2, n_row_shards=4)
+    eng = PermutationEngine(
+        d["correlation"], d["network"], d["data"],
+        t["correlation"], t["network"], t["data"],
+        modules, pool,
+        config=EngineConfig(
+            chunk_size=8, summary_method="eigh", matrix_sharding="row"
+        ),
+        mesh=mesh2d,
+    )
+    np.testing.assert_allclose(eng.observed(), obs_ref, atol=2e-5)
+    nulls, done = eng.run_null(16, key=21)
+    assert done == 16
+    np.testing.assert_allclose(nulls, nulls_ref, atol=2e-5)
+
+
+def test_row_sharding_requires_mesh(setup_pair):
+    d, t, modules, pool = setup_pair
+    with pytest.raises(ValueError, match="requires a mesh"):
+        PermutationEngine(
+            d["correlation"], d["network"], d["data"],
+            t["correlation"], t["network"], t["data"],
+            modules, pool, config=EngineConfig(matrix_sharding="row"),
+        )
+
+
+def test_multitest_engine_matches_sequential(setup_pair, rng):
+    """Config C: vmapped multi-test nulls equal per-pair sequential runs with
+    the same key (shared permutation index draws)."""
+    d, t, modules, pool = setup_pair
+    # second test cohort: same node universe, fresh data
+    t2_data = t["data"] + rng.standard_normal(t["data"].shape) * 0.5
+    t2_corr = np.corrcoef(t2_data, rowvar=False)
+    t2_net = np.abs(t2_corr) ** 2
+
+    cfg = EngineConfig(chunk_size=8, summary_method="eigh")
+    multi = MultiTestEngine(
+        d["correlation"], d["network"], d["data"],
+        np.stack([t["correlation"], t2_corr]),
+        np.stack([t["network"], t2_net]),
+        [t["data"], t2_data],
+        modules, pool, config=cfg,
+    )
+    obs = multi.observed()
+    nulls, done = multi.run_null(12, key=9)
+    assert done == 12 and nulls.shape[0] == 2
+
+    for ti, (tc, tn, td) in enumerate(
+        [(t["correlation"], t["network"], t["data"]), (t2_corr, t2_net, t2_data)]
+    ):
+        seq = PermutationEngine(
+            d["correlation"], d["network"], d["data"], tc, tn, td,
+            modules, pool, config=cfg,
+        )
+        np.testing.assert_allclose(obs[ti], seq.observed(), atol=2e-5)
+        seq_nulls, _ = seq.run_null(12, key=9)
+        np.testing.assert_allclose(nulls[ti], seq_nulls, atol=2e-5)
+
+
+def test_multitest_ragged_samples(setup_pair, rng):
+    """Test cohorts with different sample counts fall back to the per-dataset
+    loop but still produce a stacked result."""
+    d, t, modules, pool = setup_pair
+    t2_data = rng.standard_normal((t["data"].shape[0] + 5, t["data"].shape[1]))
+    t2_corr = np.corrcoef(t2_data, rowvar=False)
+    t2_net = np.abs(t2_corr) ** 2
+    multi = MultiTestEngine(
+        d["correlation"], d["network"], d["data"],
+        np.stack([t["correlation"], t2_corr]),
+        np.stack([t["network"], t2_net]),
+        [t["data"], t2_data],
+        modules, pool, config=EngineConfig(chunk_size=8, summary_method="eigh"),
+    )
+    assert not multi._uniform_samples
+    obs = multi.observed()
+    assert np.isfinite(obs).all()
+    nulls, done = multi.run_null(8, key=1)
+    assert done == 8 and np.isfinite(nulls).all()
+
+
+def test_vmap_tests_via_api(setup_pair, rng):
+    """module_preservation(vmap_tests=True) returns per-test results equal to
+    the sequential path."""
+    from netrep_tpu import module_preservation
+
+    d, t, modules, pool = setup_pair
+    t2_data = t["data"] + rng.standard_normal(t["data"].shape) * 0.5
+    t2_corr = np.corrcoef(t2_data, rowvar=False)
+    t2_net = np.abs(t2_corr) ** 2
+
+    kw = dict(
+        network={"d": _df(d["network"], d["names"]),
+                 "t1": _df(t["network"], t["names"]),
+                 "t2": _df(t2_net, t["names"])},
+        correlation={"d": _df(d["correlation"], d["names"]),
+                     "t1": _df(t["correlation"], t["names"]),
+                     "t2": _df(t2_corr, t["names"])},
+        data={"d": _df(d["data"], d["names"], square=False),
+              "t1": _df(t["data"], t["names"], square=False),
+              "t2": _df(t2_data, t["names"], square=False)},
+        module_assignments=_labels_from_setup(setup_pair),
+        discovery="d", test=["t1", "t2"],
+        n_perm=10, seed=4,
+        config=EngineConfig(chunk_size=8, summary_method="eigh"),
+        simplify=False,
+    )
+    seq = module_preservation(vmap_tests=False, **kw)
+    fast = module_preservation(vmap_tests=True, **kw)
+    for tn in ("t1", "t2"):
+        np.testing.assert_allclose(
+            seq["d"][tn].observed, fast["d"][tn].observed, atol=2e-5
+        )
+
+
+def _df(arr, names, square=True):
+    import pandas as pd
+
+    if square:
+        return pd.DataFrame(arr, index=names, columns=names)
+    return pd.DataFrame(arr, columns=names)
+
+
+def _labels_from_setup(setup_pair):
+    d, t, modules, pool = setup_pair
+    lab = {nm: "0" for nm in d["names"]}
+    for m in modules:
+        for i in m.disc_idx:
+            lab[d["names"][i]] = m.label
+    return lab
+
+
+@pytest.fixture
+def setup_pair(toy_pair):
+    return _make_setup(toy_pair)
