@@ -20,6 +20,8 @@ __all__ = [
     "network_properties",
     "required_perms",
     "permp",
+    "load_example",
+    "make_example_pair",
 ]
 
 
@@ -37,4 +39,8 @@ def __getattr__(name):
         from .ops import pvalues
 
         return getattr(pvalues, name)
+    if name in ("load_example", "make_example_pair"):
+        from . import data
+
+        return getattr(data, name)
     raise AttributeError(name)

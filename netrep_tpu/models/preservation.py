@@ -104,6 +104,8 @@ def module_preservation(
     mesh=None,
     vmap_tests: bool = False,
     progress: Callable[[int, int], None] | None = None,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 8192,
 ):
     """Permutation test of network module preservation across datasets.
 
@@ -122,6 +124,11 @@ def module_preservation(
       universe, run them as a single vmapped kernel instead of sequential
       pairs.
     - ``progress`` — callback ``(done, total)`` per chunk.
+    - ``checkpoint_dir`` — when set, each pair's partial null is persisted to
+      ``<dir>/null_<discovery>__<test>.npz`` every ``checkpoint_every``
+      permutations and on interrupt; re-running the same call resumes
+      exactly (SURVEY.md §5 "checkpoint/resume" — an improvement over the
+      reference's all-or-nothing runs).
 
     Returns
     -------
@@ -135,6 +142,17 @@ def module_preservation(
             f"got {alternative!r}"
         )
     config = config or EngineConfig()
+
+    def ckpt_path(d_name, t_name):
+        if checkpoint_dir is None:
+            return None
+        import os
+        import re
+
+        safe = lambda s: re.sub(r"[^A-Za-z0-9_.-]", "_", str(s))
+        return os.path.join(
+            checkpoint_dir, f"null_{safe(d_name)}__{safe(t_name)}.npz"
+        )
 
     datasets = ds.build_datasets(network, data=data, correlation=correlation)
     pairs = ds.resolve_pairs(datasets, discovery, test, self_preservation)
@@ -200,7 +218,11 @@ def module_preservation(
                 mod_specs, pool, config=config, mesh=mesh,
             )
             observed = engine.observed()
-            nulls, completed = engine.run_null(np_this, key=seed, progress=progress)
+            nulls, completed = engine.run_null(
+                np_this, key=seed, progress=progress,
+                checkpoint_path=ckpt_path(d_name, "+".join(t_names)),
+                checkpoint_every=checkpoint_every,
+            )
             interrupted = completed < np_this
             if interrupted:
                 logger.warning(
@@ -234,7 +256,11 @@ def module_preservation(
                 mod_specs, pool, config=config, mesh=mesh,
             )
             observed = engine.observed()
-            nulls, completed = engine.run_null(np_this, key=seed, progress=progress)
+            nulls, completed = engine.run_null(
+                np_this, key=seed, progress=progress,
+                checkpoint_path=ckpt_path(d_name, t_name),
+                checkpoint_every=checkpoint_every,
+            )
             total_space = pv.total_permutations(pool.size, [m.size for m in mod_specs])
             results.setdefault(d_name, {})[t_name] = _make_result(
                 d_name, t_name, labels, counts, observed, nulls, completed,

@@ -41,6 +41,80 @@ from ..ops.oracle import N_STATS
 from ..utils.config import EngineConfig
 
 
+def run_checkpointed_chunks(
+    base: "PermutationEngine",
+    n_perm: int,
+    key,
+    fn: Callable,
+    alloc_shape: tuple[int, ...],
+    write: Callable[[np.ndarray, list, int, int], None],
+    progress: Callable[[int, int], None] | None = None,
+    nulls_init: np.ndarray | None = None,
+    start_perm: int = 0,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 8192,
+    perm_axis: int = 0,
+    fingerprint_extra: bytes = b"",
+) -> tuple[np.ndarray, int]:
+    """The single chunked/interruptible/checkpointable null loop shared by
+    :class:`PermutationEngine` and ``MultiTestEngine`` (one implementation so
+    the two paths cannot drift — SURVEY.md §5 "failure detection",
+    "checkpoint/resume").
+
+    ``fn(keys) -> outs`` evaluates one chunk; ``write(nulls, outs, done,
+    take)`` scatters the chunk into the preallocated ``nulls`` array;
+    ``alloc_shape`` allocates it when neither ``nulls_init`` nor a readable
+    checkpoint provides one; ``perm_axis`` locates the permutation axis in
+    the null array; ``fingerprint_extra`` extends the engine fingerprint for
+    wrappers whose problem has extra structure (e.g. the test-dataset count).
+    """
+    if isinstance(key, int):
+        key = jax.random.key(key)
+
+    save = None
+    if checkpoint_path is not None:
+        from ..utils import checkpoint as ckpt
+
+        fp = ckpt.engine_fingerprint(base)
+        if fingerprint_extra:
+            fp = np.concatenate(
+                [fp, np.frombuffer(fingerprint_extra, dtype=np.uint8)]
+            )
+        kd = np.asarray(jax.random.key_data(key))
+        loaded = ckpt.load_null_checkpoint(checkpoint_path)
+        if loaded is not None:
+            nulls_init, start_perm = ckpt.validate_resume(
+                loaded, n_perm, kd, fp, checkpoint_path, perm_axis=perm_axis
+            )
+
+        def save(nulls, done):
+            ckpt.save_null_checkpoint(checkpoint_path, nulls, done, kd, fp)
+
+    C = base.effective_chunk()
+    nulls = nulls_init if nulls_init is not None else np.full(alloc_shape, np.nan)
+    done = start_perm
+    last_saved = done
+    try:
+        while done < n_perm:
+            take = min(C, n_perm - done)
+            keys = base.perm_keys(key, done, C)
+            outs = fn(keys)
+            write(nulls, outs, done, take)
+            done += take
+            if progress is not None:
+                progress(done, n_perm)
+            if save is not None and done - last_saved >= checkpoint_every:
+                save(nulls, done)
+                last_saved = done
+    except KeyboardInterrupt:
+        # the reference's clean Ctrl-C path (SURVEY.md §5): return the
+        # partial null; callers read `done` and keep completed work
+        pass
+    if save is not None and done > last_saved:
+        save(nulls, done)
+    return nulls, done
+
+
 @dataclasses.dataclass(frozen=True)
 class ModuleSpec:
     """One discovery module's overlap bookkeeping (SURVEY.md §3.1).
@@ -413,6 +487,8 @@ class PermutationEngine:
         progress: Callable[[int, int], None] | None = None,
         nulls_init: np.ndarray | None = None,
         start_perm: int = 0,
+        checkpoint_path: str | None = None,
+        checkpoint_every: int = 8192,
     ) -> tuple[np.ndarray, int]:
         """Compute the permutation null distribution.
 
@@ -426,6 +502,14 @@ class PermutationEngine:
         nulls_init, start_perm : resume support — a partially-filled null
             array and the index to continue from (SURVEY.md §5
             "checkpoint/resume").
+        checkpoint_path : when set, the partial null is persisted there
+            (atomic ``.npz``) every ``checkpoint_every`` permutations, on
+            interrupt, and on completion; an existing compatible checkpoint
+            at the path is resumed from automatically (exact: per-permutation
+            keys depend only on (key, index)). Mismatched problem/seed
+            raises (SURVEY.md §5 "checkpoint/resume").
+        checkpoint_every : checkpoint cadence in permutations (rounded up to
+            whole chunks).
 
         Returns
         -------
@@ -440,27 +524,15 @@ class PermutationEngine:
                 "engine was built discovery_only; test-side passes live in "
                 "the wrapping engine"
             )
-        if isinstance(key, int):
-            key = jax.random.key(key)
 
-        C = self.effective_chunk()
-        if nulls_init is not None:
-            nulls = nulls_init
-        else:
-            nulls = np.full((n_perm, self.n_modules, N_STATS), np.nan)
-        fn = self._chunk_fn()
-        done = start_perm
-        try:
-            while done < n_perm:
-                take = min(C, n_perm - done)
-                keys = self.perm_keys(key, done, C)
-                outs = fn(keys)
-                for b, out in zip(self.buckets, outs):
-                    arr = np.asarray(out[:take], dtype=np.float64)
-                    nulls[done: done + take, b.module_pos] = arr
-                done += take
-                if progress is not None:
-                    progress(done, n_perm)
-        except KeyboardInterrupt:
-            pass
-        return nulls, done
+        def write(nulls, outs, done, take):
+            for b, out in zip(self.buckets, outs):
+                arr = np.asarray(out[:take], dtype=np.float64)
+                nulls[done: done + take, b.module_pos] = arr
+
+        return run_checkpointed_chunks(
+            self, n_perm, key, self._chunk_fn(),
+            (n_perm, self.n_modules, N_STATS), write,
+            progress=progress, nulls_init=nulls_init, start_perm=start_perm,
+            checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+        )

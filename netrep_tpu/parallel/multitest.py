@@ -165,33 +165,27 @@ class MultiTestEngine:
         return self._chunk_cached
 
     def run_null(self, n_perm: int, key=0, progress=None,
-                 nulls_init=None, start_perm: int = 0):
+                 nulls_init=None, start_perm: int = 0,
+                 checkpoint_path: str | None = None,
+                 checkpoint_every: int = 8192):
         """(T, n_perm, n_modules, 7) null array + completed count; same
-        chunked/interruptible/reproducible/resumable contract as the base
-        engine (key derivation and chunk rounding are shared helpers on
-        :class:`PermutationEngine` so the two paths cannot drift)."""
-        if isinstance(key, int):
-            key = jax.random.key(key)
-        C = self._base.effective_chunk()
-        fn = self._chunk_fn()
-        if nulls_init is not None:
-            nulls = nulls_init
-        else:
-            nulls = np.full((self.T, n_perm, self.n_modules, N_STATS), np.nan)
-        done = start_perm
-        try:
-            while done < n_perm:
-                take = min(C, n_perm - done)
-                keys = self._base.perm_keys(key, done, C)
-                outs = fn(keys)
-                for b, outarr in zip(self._base.buckets, outs):
-                    # (T, take, K, 7); a single advanced index (module_pos)
-                    # keeps its axis position in the assignment target.
-                    arr = np.asarray(outarr[:, :take], dtype=np.float64)
-                    nulls[:, done: done + take, b.module_pos] = arr
-                done += take
-                if progress is not None:
-                    progress(done, n_perm)
-        except KeyboardInterrupt:
-            pass
-        return nulls, done
+        chunked/interruptible/reproducible/resumable/checkpointable contract
+        as the base engine (key derivation and chunk rounding are shared
+        helpers on :class:`PermutationEngine` so the two paths cannot
+        drift)."""
+        def write(nulls, outs, done, take):
+            for b, outarr in zip(self._base.buckets, outs):
+                # (T, take, K, 7); a single advanced index (module_pos)
+                # keeps its axis position in the assignment target.
+                arr = np.asarray(outarr[:, :take], dtype=np.float64)
+                nulls[:, done: done + take, b.module_pos] = arr
+
+        from .engine import run_checkpointed_chunks
+
+        return run_checkpointed_chunks(
+            self._base, n_perm, key, self._chunk_fn(),
+            (self.T, n_perm, self.n_modules, N_STATS), write,
+            progress=progress, nulls_init=nulls_init, start_perm=start_perm,
+            checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+            perm_axis=1, fingerprint_extra=f"|T:{self.T}".encode(),
+        )

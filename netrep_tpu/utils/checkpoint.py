@@ -1,0 +1,125 @@
+"""Null-distribution checkpoint/resume (SURVEY.md §5 "Checkpoint / resume").
+
+The reference has no checkpointing — a 100k-permutation run is
+all-or-nothing. The rebuild's chunked dispatch makes save/resume trivial and
+exact: the null array plus the PRNG key data fully determine the remaining
+work (per-permutation keys are ``fold_in(key, i)``, independent of chunk size
+and mesh — :meth:`netrep_tpu.parallel.engine.PermutationEngine.perm_keys`),
+so resuming produces bit-identical results to an uninterrupted run.
+
+Format: a single ``.npz`` with the partial null array, completion counter,
+PRNG key data, and an engine fingerprint that guards against resuming onto a
+different problem (wrong dataset pair, module set, or pool).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+_FORMAT_VERSION = 1
+
+
+def engine_fingerprint(engine) -> np.ndarray:
+    """Cheap structural fingerprint of a :class:`PermutationEngine` problem:
+    module labels/sizes, pool, and data presence. Deliberately *not* a hash
+    of the full matrices (genome-scale inputs) — it catches configuration
+    mix-ups, not bit-flips."""
+    parts = [str(_FORMAT_VERSION), str(int(engine.has_data))]
+    for m in engine.modules:
+        parts.append(f"{m.label}:{m.size}")
+    parts.append(f"pool:{engine.pool.size}:{int(np.sum(engine.pool)) & 0xFFFFFFFF}")
+    return np.frombuffer("|".join(parts).encode(), dtype=np.uint8)
+
+
+def save_null_checkpoint(
+    path: str,
+    nulls: np.ndarray,
+    completed: int,
+    key_data: np.ndarray,
+    fingerprint: np.ndarray,
+) -> None:
+    """Atomically persist a (possibly partial) null array. The write goes to
+    a temp file in the same directory followed by ``os.replace`` so an
+    interrupt mid-save never corrupts an existing checkpoint."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(
+                f,
+                version=np.int64(_FORMAT_VERSION),
+                nulls=nulls,
+                completed=np.int64(completed),
+                key_data=np.asarray(key_data),
+                fingerprint=fingerprint,
+            )
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_null_checkpoint(path: str) -> dict | None:
+    """Load a checkpoint, or ``None`` when the file doesn't exist."""
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as z:
+        if int(z["version"]) != _FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint {path!r} has format version {int(z['version'])}, "
+                f"this build reads version {_FORMAT_VERSION}"
+            )
+        return {
+            "nulls": z["nulls"],
+            "completed": int(z["completed"]),
+            "key_data": z["key_data"],
+            "fingerprint": z["fingerprint"],
+        }
+
+
+def validate_resume(
+    ckpt: dict,
+    n_perm: int,
+    key_data: np.ndarray,
+    fingerprint: np.ndarray,
+    path: str,
+    perm_axis: int = 0,
+) -> tuple[np.ndarray, int]:
+    """Check a loaded checkpoint against the current run; returns
+    ``(nulls_init, start_perm)`` ready for
+    :meth:`PermutationEngine.run_null`. Raises with a specific message on any
+    mismatch (SURVEY.md §2.1: informative errors are part of the surface)."""
+    fp = ckpt["fingerprint"]
+    if fp.shape != fingerprint.shape or not np.array_equal(fp, fingerprint):
+        raise ValueError(
+            f"checkpoint {path!r} was written for a different problem "
+            "(module set, sizes, pool, or data presence differ); refusing to "
+            "resume — delete the file or point elsewhere"
+        )
+    kd = np.asarray(ckpt["key_data"])
+    if kd.shape != np.asarray(key_data).shape or not np.array_equal(kd, key_data):
+        raise ValueError(
+            f"checkpoint {path!r} was written with a different PRNG key/seed; "
+            "resuming would splice two different null distributions — use the "
+            "original seed or delete the checkpoint"
+        )
+    nulls = ckpt["nulls"]
+    if nulls.shape[perm_axis] < n_perm:
+        shape = list(nulls.shape)
+        shape[perm_axis] = n_perm
+        grown = np.full(shape, np.nan)
+        sel = [slice(None)] * nulls.ndim
+        sel[perm_axis] = slice(0, nulls.shape[perm_axis])
+        grown[tuple(sel)] = nulls
+        nulls = grown
+    elif nulls.shape[perm_axis] > n_perm:
+        # shrinking run: honor the caller's (n_perm, ...) shape contract
+        sel = [slice(None)] * nulls.ndim
+        sel[perm_axis] = slice(0, n_perm)
+        nulls = nulls[tuple(sel)].copy()
+    completed = min(int(ckpt["completed"]), n_perm)
+    return nulls, completed

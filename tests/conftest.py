@@ -33,70 +33,9 @@ def rng():
     return np.random.default_rng(42)
 
 
-def make_toy_pair(
-    rng,
-    n_disc=90,
-    n_test=80,
-    n_overlap=70,
-    n_samples_disc=40,
-    n_samples_test=35,
-    module_sizes=(15, 12, 10, 8),
-    noise=0.7,
-):
-    """Synthetic discovery/test co-expression pair in the spirit of the
-    reference's vignette toy data (SURVEY.md §2.1 "Example data",
-    BASELINE.json:7): planted correlated modules shared by both datasets,
-    with partial node overlap and shuffled test-node order.
-
-    Returns a dict with data/correlation/network matrices per dataset, node
-    name lists, and the discovery module-label vector (module labels "1".."K",
-    background "0").
-    """
-    names_disc = [f"g{i:04d}" for i in range(n_disc)]
-    # test shares the first n_overlap discovery nodes plus its own extras,
-    # in shuffled order so index alignment is exercised.
-    extra = [f"t{i:04d}" for i in range(n_test - n_overlap)]
-    names_test = list(rng.permutation(names_disc[:n_overlap] + extra))
-
-    labels = np.zeros(n_disc, dtype=object)
-    pos = 0
-    latents = {}
-    for k, sz in enumerate(module_sizes, start=1):
-        labels[pos: pos + sz] = str(k)
-        latents[str(k)] = (rng.standard_normal(n_samples_disc),
-                           rng.standard_normal(n_samples_test))
-        pos += sz
-    labels[pos:] = "0"
-
-    import zlib
-
-    def build(names, n_samples, which):
-        x = rng.standard_normal((n_samples, len(names)))
-        for j, nm in enumerate(names):
-            if nm in names_disc[: sum(module_sizes)]:
-                k = labels[names_disc.index(nm)]
-                if k != "0":
-                    # per-node sign and noise level are deterministic in the
-                    # node name, hence consistent across datasets — gives the
-                    # module a heterogeneous, *preserved* degree structure
-                    # (cor.degree has no signal in equal-SNR toy data).
-                    sgn = 1.0 if zlib.crc32(nm.encode()) % 3 else -1.0
-                    lvl = 0.35 + 1.3 * ((zlib.crc32(nm.encode()[::-1]) % 97) / 97)
-                    x[:, j] = sgn * latents[k][which] + lvl * noise * x[:, j]
-        corr = np.corrcoef(x, rowvar=False)
-        net = np.abs(corr) ** 2  # soft-threshold adjacency, beta=2
-        np.fill_diagonal(net, 1.0)
-        return x, corr, net
-
-    d_data, d_corr, d_net = build(names_disc, n_samples_disc, 0)
-    t_data, t_corr, t_net = build(names_test, n_samples_test, 1)
-
-    return dict(
-        discovery=dict(data=d_data, correlation=d_corr, network=d_net, names=names_disc),
-        test=dict(data=t_data, correlation=t_corr, network=t_net, names=names_test),
-        labels={nm: str(l) for nm, l in zip(names_disc, labels)},
-        module_sizes=dict(zip((str(k) for k in range(1, len(module_sizes) + 1)), module_sizes)),
-    )
+# The synthetic fixture generator is a public API now (the reference ships
+# bundled example data, SURVEY.md §2.1); tests use the same code path.
+from netrep_tpu.data import make_example_pair as make_toy_pair  # noqa: E402
 
 
 @pytest.fixture

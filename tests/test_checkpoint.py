@@ -1,0 +1,132 @@
+"""Checkpoint/resume tests (SURVEY.md §5 "Checkpoint / resume"): exact-resume
+guarantee (resumed null is bit-identical to an uninterrupted run), fingerprint
+and seed guards, atomic save, and the module_preservation wiring."""
+
+import numpy as np
+import pytest
+
+from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+from netrep_tpu.utils import checkpoint as ck
+from netrep_tpu.utils.config import EngineConfig
+
+
+def _engine(rng, chunk=8):
+    n = 50
+    x = rng.standard_normal((20, n))
+    corr = np.corrcoef(x, rowvar=False)
+    net = np.abs(corr) ** 2
+    specs = [
+        ModuleSpec("1", np.arange(0, 8, dtype=np.int32), np.arange(0, 8, dtype=np.int32)),
+        ModuleSpec("2", np.arange(8, 14, dtype=np.int32), np.arange(8, 14, dtype=np.int32)),
+    ]
+    pool = np.arange(n, dtype=np.int32)
+    return PermutationEngine(
+        corr, net, x, corr, net, x, specs, pool,
+        config=EngineConfig(chunk_size=chunk, summary_method="power"),
+    )
+
+
+def test_exact_resume(tmp_path, rng):
+    eng = _engine(rng)
+    path = str(tmp_path / "null.npz")
+
+    # full uninterrupted run (no checkpoint)
+    full, done = eng.run_null(40, key=3)
+    assert done == 40
+
+    # partial run: only 16 perms, checkpointed
+    part, done = eng.run_null(16, key=3, checkpoint_path=path, checkpoint_every=8)
+    assert done == 16
+    saved = ck.load_null_checkpoint(path)
+    assert saved["completed"] == 16
+
+    # resume to 40 from the checkpoint: must equal the uninterrupted run
+    resumed, done = eng.run_null(40, key=3, checkpoint_path=path)
+    assert done == 40
+    np.testing.assert_array_equal(resumed, full)
+
+
+def test_shrinking_resume_honors_shape(tmp_path, rng):
+    """Resuming with a smaller n_perm must return an (n_perm, ...) array."""
+    eng = _engine(rng)
+    path = str(tmp_path / "null.npz")
+    eng.run_null(40, key=3, checkpoint_path=path)
+    small, done = eng.run_null(12, key=3, checkpoint_path=path)
+    assert small.shape[0] == 12
+    assert done == 12
+    assert np.isfinite(small).all()
+
+
+def test_wrong_seed_refuses(tmp_path, rng):
+    eng = _engine(rng)
+    path = str(tmp_path / "null.npz")
+    eng.run_null(16, key=3, checkpoint_path=path)
+    with pytest.raises(ValueError, match="different PRNG key"):
+        eng.run_null(32, key=4, checkpoint_path=path)
+
+
+def test_wrong_problem_refuses(tmp_path, rng):
+    eng = _engine(rng)
+    path = str(tmp_path / "null.npz")
+    eng.run_null(16, key=3, checkpoint_path=path)
+    other = _engine(np.random.default_rng(9))  # same sizes → same fingerprint
+    # different module sizes → different fingerprint
+    n = 50
+    x = rng.standard_normal((20, n))
+    corr = np.corrcoef(x, rowvar=False)
+    net = np.abs(corr) ** 2
+    eng2 = PermutationEngine(
+        corr, net, x, corr, net, x,
+        [ModuleSpec("1", np.arange(5, dtype=np.int32), np.arange(5, dtype=np.int32))],
+        np.arange(n, dtype=np.int32),
+        config=EngineConfig(chunk_size=8),
+    )
+    with pytest.raises(ValueError, match="different problem"):
+        eng2.run_null(32, key=3, checkpoint_path=path)
+    del other
+
+
+def test_completed_checkpoint_short_circuits(tmp_path, rng):
+    eng = _engine(rng)
+    path = str(tmp_path / "null.npz")
+    a, _ = eng.run_null(24, key=0, checkpoint_path=path)
+    # a fresh engine resumes from the finished checkpoint without recompute
+    eng2 = _engine(np.random.default_rng(42))
+    b, done = eng2.run_null(24, key=0, checkpoint_path=path)
+    assert done == 24
+    np.testing.assert_array_equal(a, b)
+
+
+def test_module_preservation_checkpoint_dir(tmp_path, rng, toy_pair):
+    import netrep_tpu
+
+    tp = toy_pair
+
+    def inputs():
+        import pandas as pd
+
+        def df(m, names):
+            return pd.DataFrame(m, index=names, columns=names)
+
+        return dict(
+            network={"d": df(tp["discovery"]["network"], tp["discovery"]["names"]),
+                     "t": df(tp["test"]["network"], tp["test"]["names"])},
+            correlation={"d": df(tp["discovery"]["correlation"], tp["discovery"]["names"]),
+                         "t": df(tp["test"]["correlation"], tp["test"]["names"])},
+            module_assignments=tp["labels"],
+            discovery="d", test="t",
+        )
+
+    res1 = netrep_tpu.module_preservation(
+        **inputs(), n_perm=24, seed=5,
+        checkpoint_dir=str(tmp_path), checkpoint_every=8,
+    )
+    files = list(tmp_path.glob("null_d__t.npz"))
+    assert len(files) == 1
+    # rerun resumes from the completed checkpoint and reproduces the result
+    res2 = netrep_tpu.module_preservation(
+        **inputs(), n_perm=24, seed=5,
+        checkpoint_dir=str(tmp_path), checkpoint_every=8,
+    )
+    np.testing.assert_array_equal(res1.nulls, res2.nulls)
+    np.testing.assert_array_equal(res1.p_values, res2.p_values)

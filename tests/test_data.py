@@ -1,0 +1,77 @@
+"""Tests for the bundled example-data module (SURVEY.md §2.1 "Example data"):
+determinism, shape contract, and end-to-end usability as the vignette fixture
+(Config A, BASELINE.json:7)."""
+
+import numpy as np
+
+import netrep_tpu
+from netrep_tpu.data import load_example, make_example_pair
+
+
+def test_load_example_deterministic():
+    a = load_example()
+    b = load_example()
+    for k in ("discovery_data", "discovery_correlation", "discovery_network",
+              "test_data", "test_correlation", "test_network"):
+        np.testing.assert_array_equal(a[k], b[k])
+    assert a["module_labels"] == b["module_labels"]
+    assert load_example(seed=1)["discovery_data"][0, 0] != a["discovery_data"][0, 0]
+
+
+def test_load_example_shapes_and_labels():
+    ex = load_example()
+    n_d = len(ex["discovery_names"])
+    n_t = len(ex["test_names"])
+    assert ex["discovery_correlation"].shape == (n_d, n_d)
+    assert ex["discovery_network"].shape == (n_d, n_d)
+    assert ex["discovery_data"].shape[1] == n_d
+    assert ex["test_correlation"].shape == (n_t, n_t)
+    assert set(ex["module_labels"]) == set(ex["discovery_names"])
+    mods = {v for v in ex["module_labels"].values() if v != "0"}
+    assert mods == {"1", "2", "3", "4"}
+    # correlation matrices are valid
+    assert np.allclose(ex["test_correlation"], ex["test_correlation"].T)
+    assert np.abs(ex["test_correlation"]).max() <= 1 + 1e-9
+
+
+def test_example_runs_end_to_end():
+    """Config A smoke: the fixture drives module_preservation directly via
+    the dict-of-DataFrames input form."""
+    pd = __import__("pandas")
+    ex = load_example(seed=0)
+
+    def df(mat, names):
+        return pd.DataFrame(mat, index=names, columns=names)
+
+    res = netrep_tpu.module_preservation(
+        network={
+            "d": df(ex["discovery_network"], ex["discovery_names"]),
+            "t": df(ex["test_network"], ex["test_names"]),
+        },
+        correlation={
+            "d": df(ex["discovery_correlation"], ex["discovery_names"]),
+            "t": df(ex["test_correlation"], ex["test_names"]),
+        },
+        data={
+            "d": pd.DataFrame(ex["discovery_data"], columns=ex["discovery_names"]),
+            "t": pd.DataFrame(ex["test_data"], columns=ex["test_names"]),
+        },
+        module_assignments=ex["module_labels"],
+        discovery="d",
+        test="t",
+        n_perm=50,
+        seed=7,
+    )
+    assert res.observed.shape == (4, 7)
+    assert np.isfinite(res.p_values).all()
+    # planted modules replicate: every statistic's observed value should sit
+    # in the upper tail for at least the strongest module
+    assert res.max_pvalue().min() < 0.2
+
+
+def test_make_example_pair_custom_sizes():
+    pair = make_example_pair(np.random.default_rng(3), module_sizes=(6, 5),
+                             n_disc=40, n_test=35, n_overlap=30,
+                             n_samples_disc=20, n_samples_test=18)
+    assert pair["module_sizes"] == {"1": 6, "2": 5}
+    assert len(pair["discovery"]["names"]) == 40

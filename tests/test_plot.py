@@ -1,0 +1,122 @@
+"""Plot-suite tests (SURVEY.md §2.1 "Plot suite", §3.3): panel composition,
+node/sample ordering semantics, data-less variant, and per-panel functions.
+Rendering is validated structurally (axes, artists, saved bytes) — visual
+regression is out of scope, matching the reference's own test strategy
+(plots are exercised, not pixel-compared)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+import pytest
+
+from netrep_tpu import plot as nplot
+from netrep_tpu.data import load_example
+from netrep_tpu.ops import oracle
+
+
+@pytest.fixture(scope="module")
+def ex():
+    return load_example(seed=5)
+
+
+def _inputs(ex, with_data=True):
+    kw = dict(
+        network={"d": ex["discovery_network"], "t": ex["test_network"]},
+        correlation={"d": ex["discovery_correlation"], "t": ex["test_correlation"]},
+        module_assignments={"d": {nm: ex["module_labels"].get(nm, "0")
+                                  for nm in ex["discovery_names"]}},
+    )
+    # ndarray inputs carry no names → attach via pandas for alignment
+    import pandas as pd
+
+    def df(m, names):
+        return pd.DataFrame(m, index=names, columns=names)
+
+    kw["network"] = {"d": df(ex["discovery_network"], ex["discovery_names"]),
+                     "t": df(ex["test_network"], ex["test_names"])}
+    kw["correlation"] = {"d": df(ex["discovery_correlation"], ex["discovery_names"]),
+                         "t": df(ex["test_correlation"], ex["test_names"])}
+    if with_data:
+        kw["data"] = {"d": pd.DataFrame(ex["discovery_data"], columns=ex["discovery_names"]),
+                      "t": pd.DataFrame(ex["test_data"], columns=ex["test_names"])}
+    return kw
+
+
+def test_plot_module_composite(ex, tmp_path):
+    fig, axes = nplot.plot_module(
+        **_inputs(ex), discovery="d", test="t", modules=["1", "2"],
+    )
+    assert set(axes) == {"data", "summary", "correlation", "network",
+                        "contribution", "degree"}
+    out = tmp_path / "module.png"
+    fig.savefig(out, dpi=60)
+    assert out.stat().st_size > 10_000
+    plt.close(fig)
+
+
+def test_plot_module_dataless(ex):
+    kw = _inputs(ex, with_data=False)
+    fig, axes = nplot.plot_module(**kw, discovery="d", test="t", modules=["1"])
+    assert set(axes) == {"correlation", "network", "degree"}
+    assert "data" not in axes
+    plt.close(fig)
+
+
+def test_node_order_is_discovery_degree(ex):
+    """Default ordering: within each module, nodes sorted by *discovery*
+    weighted degree, descending (SURVEY.md §3.3)."""
+    layout = nplot._prepare(
+        **_inputs(ex), discovery="d", test="t", modules=["1"],
+    )
+    dn = ex["discovery_names"]
+    dmat = ex["discovery_network"]
+    mod_nodes = [nm for nm in dn if ex["module_labels"][nm] == "1"]
+    tset = set(ex["test_names"])
+    present = [nm for nm in mod_nodes if nm in tset]
+    didx = [dn.index(nm) for nm in present]
+    deg = oracle.weighted_degree(dmat[np.ix_(didx, didx)])
+    expect = [present[i] for i in np.argsort(-deg, kind="stable")]
+    assert layout.node_names == expect
+
+
+def test_input_order_when_none(ex):
+    layout = nplot._prepare(
+        **_inputs(ex), discovery="d", test="t", modules=["1"],
+        order_nodes_by=None,
+    )
+    # input (test-dataset) order preserved within the module
+    tpos = {nm: i for i, nm in enumerate(ex["test_names"])}
+    idx = [tpos[nm] for nm in layout.node_names]
+    # node_idx should follow discovery-module listing order, not sorted degree
+    assert list(layout.node_idx) == idx
+
+
+def test_per_panel_functions(ex):
+    kw = _inputs(ex)
+    for fn in (nplot.plot_correlation, nplot.plot_network, nplot.plot_degree):
+        ax = fn(kw["network"], kw.get("data"), kw["correlation"],
+                kw["module_assignments"], discovery="d", test="t",
+                modules=["1"])
+        assert ax.figure is not None
+        plt.close(ax.figure)
+    for fn in (nplot.plot_data, nplot.plot_contribution, nplot.plot_summary):
+        ax = fn(kw["network"], kw["data"], kw["correlation"],
+                kw["module_assignments"], discovery="d", test="t",
+                modules=["1"])
+        assert ax.figure is not None
+        plt.close(ax.figure)
+
+
+def test_dataless_data_panel_raises(ex):
+    kw = _inputs(ex, with_data=False)
+    with pytest.raises(ValueError, match="no data matrix"):
+        nplot.plot_data(kw["network"], None, kw["correlation"],
+                        kw["module_assignments"], discovery="d", test="t")
+
+
+def test_bad_order_dataset_raises(ex):
+    with pytest.raises(ValueError, match="order_nodes_by"):
+        nplot._prepare(**_inputs(ex), discovery="d", test="t",
+                       order_nodes_by="nope")
