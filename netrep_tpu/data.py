@@ -58,6 +58,16 @@ def make_example_pair(
     network, names}``), ``labels`` ({node_name: module_label}), and
     ``module_sizes`` ({label: size}).
     """
+    if sum(module_sizes) > n_disc:
+        raise ValueError(
+            f"sum(module_sizes)={sum(module_sizes)} exceeds n_disc={n_disc}; "
+            "planted modules must fit in the discovery dataset"
+        )
+    if not (0 <= n_overlap <= min(n_disc, n_test)):
+        raise ValueError(
+            f"n_overlap={n_overlap} must be between 0 and "
+            f"min(n_disc, n_test)={min(n_disc, n_test)}"
+        )
     names_disc = [f"g{i:04d}" for i in range(n_disc)]
     extra = [f"t{i:04d}" for i in range(n_test - n_overlap)]
     names_test = list(rng.permutation(names_disc[:n_overlap] + extra))

@@ -8,8 +8,9 @@ Argument names follow the reference's documented surface
 (``modulePreservation(network, data, correlation, moduleAssignments,
 modules, backgroundLabel, discovery, test, selfPreservation, nThreads,
 nPerm, null, alternative, simplify, verbose)`` — SURVEY.md §2.1) in
-snake_case; ``n_threads`` is accepted for familiarity but ignored (XLA owns
-device parallelism — SURVEY.md §2.3 intra-op row).
+snake_case. ``n_threads`` sizes the thread pool of ``backend='native'``
+(the C++ permutation procedure); on the default JAX backend it is ignored
+because XLA owns device parallelism (SURVEY.md §2.3 intra-op row).
 """
 
 from __future__ import annotations
@@ -93,7 +94,8 @@ def module_preservation(
     discovery=None,
     test=None,
     self_preservation: bool = False,
-    n_threads: int | None = None,  # accepted, unused (XLA owns parallelism)
+    n_threads: int | None = None,  # used by backend='native'; JAX/XLA owns
+                                   # device parallelism otherwise
     n_perm: int | None = None,
     null: str = "overlap",
     alternative: str = "greater",
@@ -106,6 +108,7 @@ def module_preservation(
     progress: Callable[[int, int], None] | None = None,
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 8192,
+    backend: str = "jax",
 ):
     """Permutation test of network module preservation across datasets.
 
@@ -141,6 +144,18 @@ def module_preservation(
             "alternative must be one of 'greater', 'less', 'two.sided', "
             f"got {alternative!r}"
         )
+    if backend not in ("jax", "native"):
+        raise ValueError(f"backend must be 'jax' or 'native', got {backend!r}")
+    if backend == "native":
+        # the threaded C++ permutation procedure (netrep_tpu/native) — the
+        # CPU tier mirroring the reference's OpenMP PermutationProcedure
+        # (SURVEY.md §2.2); n_threads is honored here, unlike the JAX path
+        from ..native import NativePermutationEngine
+        engine_cls = lambda *a, **kw: NativePermutationEngine(
+            *a, **kw, n_threads=n_threads or 0
+        )
+    else:
+        engine_cls = PermutationEngine
     config = config or EngineConfig()
 
     def ckpt_path(d_name, t_name):
@@ -180,6 +195,7 @@ def module_preservation(
 
         can_vmap = (
             vmap_tests
+            and backend == "jax"
             and len(t_names) > 1
             and config.matrix_sharding != "row"
             and all(
@@ -190,10 +206,10 @@ def module_preservation(
         )
         if vmap_tests and not can_vmap and len(t_names) > 1:
             logger.warning(
-                "vmap_tests requested but unavailable (test datasets %s must "
-                "share a node universe, agree on data presence, and "
-                "matrix_sharding must not be 'row'); falling back to "
-                "sequential pairs", t_names,
+                "vmap_tests requested but unavailable (requires the default "
+                "backend='jax'; test datasets %s must share a node universe "
+                "and agree on data presence; matrix_sharding must not be "
+                "'row'); falling back to sequential pairs", t_names,
             )
 
         if can_vmap:
@@ -250,7 +266,7 @@ def module_preservation(
                     "discovery %r → test %r: %d modules, %d permutations, "
                     "null=%r", d_name, t_name, len(labels), np_this, null,
                 )
-            engine = PermutationEngine(
+            engine = engine_cls(
                 disc_ds.correlation, disc_ds.network, disc_ds.data,
                 test_ds.correlation, test_ds.network, test_ds.data,
                 mod_specs, pool, config=config, mesh=mesh,

@@ -68,7 +68,14 @@ def run_checkpointed_chunks(
     the null array; ``fingerprint_extra`` extends the engine fingerprint for
     wrappers whose problem has extra structure (e.g. the test-dataset count).
     """
-    if isinstance(key, int):
+    # Key-handling hooks let non-JAX engines (the native C++ backend) reuse
+    # this loop with their own RNG-stream identity: `prepare_key` normalizes
+    # the user seed, `key_data` yields the array stored in checkpoints to
+    # refuse cross-stream resume.
+    prepare = getattr(base, "prepare_key", None)
+    if prepare is not None:
+        key = prepare(key)
+    elif isinstance(key, int):
         key = jax.random.key(key)
 
     save = None
@@ -80,7 +87,11 @@ def run_checkpointed_chunks(
             fp = np.concatenate(
                 [fp, np.frombuffer(fingerprint_extra, dtype=np.uint8)]
             )
-        kd = np.asarray(jax.random.key_data(key))
+        key_data = getattr(base, "key_data", None)
+        kd = (
+            np.asarray(key_data(key)) if key_data is not None
+            else np.asarray(jax.random.key_data(key))
+        )
         loaded = ckpt.load_null_checkpoint(checkpoint_path)
         if loaded is not None:
             nulls_init, start_perm = ckpt.validate_resume(
@@ -333,6 +344,18 @@ class PermutationEngine:
     # ------------------------------------------------------------------
     # Observed pass (SURVEY.md §3.1 "observed pass")
     # ------------------------------------------------------------------
+
+    def fingerprint_arrays(self):
+        """Problem matrices sampled into the checkpoint fingerprint
+        (:func:`netrep_tpu.utils.checkpoint.content_digest`): test-side
+        device matrices plus the bucketed discovery properties, so a
+        completed checkpoint is never silently reused against changed data."""
+        arrays = [self._test_corr, self._test_net, self._test_data]
+        for b in self.buckets:
+            arrays.extend(
+                f for f in b.disc if f is not None and hasattr(f, "reshape")
+            )
+        return arrays
 
     # -- shared chunk/key contract (single source of truth for the
     #    reproducibility guarantee; also used by MultiTestEngine) ----------

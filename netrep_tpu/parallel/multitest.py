@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..ops import stats as jstats
 from ..ops.oracle import N_STATS
+from ..utils.checkpoint import content_digest as ckpt_digest
 from ..utils.config import EngineConfig
 from .engine import ModuleSpec, PermutationEngine
 
@@ -187,5 +188,10 @@ class MultiTestEngine:
             (self.T, n_perm, self.n_modules, N_STATS), write,
             progress=progress, nulls_init=nulls_init, start_perm=start_perm,
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
-            perm_axis=1, fingerprint_extra=f"|T:{self.T}".encode(),
+            perm_axis=1,
+            # the test-side matrices live on this wrapper (the base engine is
+            # discovery-only), so their content digest rides fingerprint_extra
+            fingerprint_extra=(
+                f"|T:{self.T}|td:{ckpt_digest([self._tc, self._tn] + (list(self._td) if isinstance(self._td, list) else [self._td]))}"
+            ).encode(),
         )

@@ -26,9 +26,16 @@ import dataclasses
 
 import numpy as np
 
+import os
+import sys
+
 import matplotlib
 
-matplotlib.use("Agg", force=False)  # headless-safe default; no-op under GUIs
+# Headless-safe default — but only when pyplot hasn't been imported yet and
+# no display is available; switching an interactive session (Jupyter, TkAgg)
+# to Agg would silently break the user's plt.show().
+if "matplotlib.pyplot" not in sys.modules and not os.environ.get("DISPLAY"):
+    matplotlib.use("Agg")
 import matplotlib.pyplot as plt  # noqa: E402
 from matplotlib.gridspec import GridSpec  # noqa: E402
 

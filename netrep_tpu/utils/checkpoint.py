@@ -14,6 +14,7 @@ different problem (wrong dataset pair, module set, or pool).
 
 from __future__ import annotations
 
+import hashlib
 import os
 import tempfile
 
@@ -22,15 +23,39 @@ import numpy as np
 _FORMAT_VERSION = 1
 
 
+def content_digest(arrays) -> str:
+    """Cheap content digest of problem matrices: shapes plus a strided
+    sample of up to 4096 elements per array. Catches "same module layout,
+    different data" mix-ups without hashing genome-scale matrices in full
+    (a completed checkpoint would otherwise be silently reused against
+    changed inputs — stale nulls vs fresh observed statistics)."""
+    h = hashlib.blake2b(digest_size=8)
+    for a in arrays:
+        if a is None:
+            h.update(b"-")
+            continue
+        # keep device arrays on device until the small strided sample is
+        # taken — digesting a sharded 20k×20k matrix must not pull the full
+        # array to the host
+        h.update(str(a.shape).encode() + str(a.dtype).encode())
+        flat = a.reshape(-1)
+        step = max(1, flat.size // 4096)
+        h.update(np.asarray(flat[::step][:4096], dtype=np.float64).tobytes())
+    return h.hexdigest()
+
+
 def engine_fingerprint(engine) -> np.ndarray:
-    """Cheap structural fingerprint of a :class:`PermutationEngine` problem:
-    module labels/sizes, pool, and data presence. Deliberately *not* a hash
-    of the full matrices (genome-scale inputs) — it catches configuration
-    mix-ups, not bit-flips."""
+    """Structural + sampled-content fingerprint of a
+    :class:`PermutationEngine` problem: module labels/sizes, pool, data
+    presence, and (when the engine exposes ``fingerprint_arrays()``) a
+    strided-sample digest of the underlying matrices."""
     parts = [str(_FORMAT_VERSION), str(int(engine.has_data))]
     for m in engine.modules:
         parts.append(f"{m.label}:{m.size}")
     parts.append(f"pool:{engine.pool.size}:{int(np.sum(engine.pool)) & 0xFFFFFFFF}")
+    arrays = getattr(engine, "fingerprint_arrays", None)
+    if arrays is not None:
+        parts.append("digest:" + content_digest(arrays()))
     return np.frombuffer("|".join(parts).encode(), dtype=np.uint8)
 
 
