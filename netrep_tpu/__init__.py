@@ -22,6 +22,8 @@ __all__ = [
     "permp",
     "load_example",
     "make_example_pair",
+    "SparseAdjacency",
+    "sparse_module_preservation",
 ]
 
 
@@ -43,4 +45,12 @@ def __getattr__(name):
         from . import data
 
         return getattr(data, name)
+    if name == "SparseAdjacency":
+        from .ops.sparse import SparseAdjacency
+
+        return SparseAdjacency
+    if name == "sparse_module_preservation":
+        from .models.sparse_api import sparse_module_preservation
+
+        return sparse_module_preservation
     raise AttributeError(name)

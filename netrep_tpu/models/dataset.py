@@ -288,22 +288,25 @@ def resolve_pairs(
     return pairs
 
 
-def module_overlap(
-    disc_ds: Dataset,
-    test_ds: Dataset,
+def module_overlap_names(
+    disc_names: Sequence[str],
+    test_names: Sequence[str],
     assignments: dict[str, str],
     modules: Sequence[str] | None,
     background_label: str | None = "0",
+    disc_label: str = "discovery",
 ):
     """Per-module aligned (discovery, test) index vectors over the nodes
     present in both datasets, plus overlap bookkeeping (nVarsPresent /
-    propVarsPresent / totalSize, SURVEY.md §2.1 "Result shaping").
+    propVarsPresent / totalSize, SURVEY.md §2.1 "Result shaping") — the
+    name-list core shared by the dense (:func:`module_overlap`) and sparse
+    (:mod:`netrep_tpu.models.sparse_api`) surfaces.
 
     Returns (module_labels, specs, counts) where ``specs`` is a list of
     ``(label, disc_idx, test_idx)`` and ``counts`` maps label →
     (n_present, total_size).
     """
-    tpos = test_ds.index_of()
+    tpos = {nm: i for i, nm in enumerate(test_names)}
     all_labels = sorted(
         {v for v in assignments.values() if v != str(background_label)},
         key=lambda s: (len(s), s),
@@ -314,7 +317,7 @@ def module_overlap(
         if unknown:
             raise ValueError(
                 f"requested module(s) {unknown} do not exist in the "
-                f"module assignments for discovery dataset {disc_ds.name!r}"
+                f"module assignments for discovery dataset {disc_label}"
             )
         labels = modules
     else:
@@ -324,7 +327,7 @@ def module_overlap(
     for lab in labels:
         disc_idx, test_idx = [], []
         total = 0
-        for i, nm in enumerate(disc_ds.node_names):
+        for i, nm in enumerate(disc_names):
             if assignments[nm] != lab:
                 continue
             total += 1
@@ -335,3 +338,17 @@ def module_overlap(
         counts[lab] = (len(disc_idx), total)
         specs.append((lab, np.asarray(disc_idx, np.int32), np.asarray(test_idx, np.int32)))
     return labels, specs, counts
+
+
+def module_overlap(
+    disc_ds: Dataset,
+    test_ds: Dataset,
+    assignments: dict[str, str],
+    modules: Sequence[str] | None,
+    background_label: str | None = "0",
+):
+    """Dataset-object wrapper over :func:`module_overlap_names`."""
+    return module_overlap_names(
+        disc_ds.node_names, test_ds.node_names, assignments, modules,
+        background_label, disc_label=repr(disc_ds.name),
+    )

@@ -252,6 +252,10 @@ class NativePermutationEngine:
         refused rather than spliced."""
         return np.asarray([0x6E61746976, int(key)], dtype=np.uint64)
 
+    #: tells run_checkpointed_chunks to clamp the final chunk to the exact
+    #: remaining count — no static-shape constraint here, unlike XLA
+    dynamic_chunk = True
+
     def effective_chunk(self) -> int:
         return self.chunk
 

@@ -50,6 +50,19 @@ inline uint64_t splitmix64(uint64_t x) {
   return x ^ (x >> 31);
 }
 
+// Unbiased bounded draw in [0, bound) via rejection sampling on the raw
+// mt19937_64 stream. std::uniform_int_distribution is implementation-
+// defined (libstdc++ and libc++ map the same generator stream to different
+// values), which would break the advertised determinism contract across
+// platforms — this fixed algorithm is part of the RNG spec.
+inline uint64_t bounded_draw(std::mt19937_64& gen, uint64_t bound) {
+  const uint64_t threshold = (~uint64_t{0} - bound + 1) % bound;  // 2^64 mod bound
+  for (;;) {
+    const uint64_t r = gen();
+    if (r >= threshold) return r % bound;
+  }
+}
+
 // ---------------------------------------------------------------------------
 // statistic building blocks (oracle.py building blocks, SURVEY.md §2.2)
 // ---------------------------------------------------------------------------
@@ -401,8 +414,8 @@ long long nr_null(const double* tcorr, const double* tnet,
       std::memcpy(sc.perm.data(), pool, sizeof(int) * pool_size);
       // partial Fisher–Yates: only the first total_assigned draws are used
       for (long long i = 0; i < total_assigned; ++i) {
-        std::uniform_int_distribution<int> dist((int)i, pool_size - 1);
-        std::swap(sc.perm[i], sc.perm[dist(gen)]);
+        const uint64_t j = (uint64_t)i + bounded_draw(gen, (uint64_t)(pool_size - i));
+        std::swap(sc.perm[i], sc.perm[j]);
       }
       size_t off = 0;
       double* row = nulls + (size_t)p * n_mod * N_STATS;

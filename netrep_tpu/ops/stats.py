@@ -217,6 +217,53 @@ def make_disc_props(corr, net, data, mask, summary_method: str = "eigh") -> Disc
 # The seven statistics on gathered (padded) test submatrices
 # ---------------------------------------------------------------------------
 
+def stats_from_parts(
+    disc: DiscProps,
+    avg_weight: jnp.ndarray,          # (...,) precomputed mean off-diag weight
+    test_degree: jnp.ndarray,         # (..., m) precomputed weighted degree
+    test_corr: jnp.ndarray | None,    # (..., m, m) pair-masked, or None
+    test_zdata: jnp.ndarray | None,   # (..., n_samples, m) standardized+masked
+    n_iter: int = 60,
+    summary_method: str = "power",
+) -> jnp.ndarray:
+    """Assemble the seven statistics from precomputed topology parts — the
+    common core of the dense path (parts from the gathered ``test_net``
+    submatrix) and the sparse path (parts from padded neighbor lists,
+    :mod:`netrep_tpu.ops.sparse`). ``test_corr`` must already be multiplied
+    by the off-diagonal pair mask. Statistics whose inputs are absent
+    (``test_corr``/``test_zdata`` None) come back NaN (SURVEY.md §2.2)."""
+    w = disc.mask
+    pair = offdiag_mask(w)
+    npair = jnp.maximum(jnp.sum(pair, axis=(-1, -2)), _EPS)
+    nanlike = jnp.full_like(_f32(avg_weight), jnp.nan)
+
+    flat = lambda a: a.reshape(*a.shape[:-2], -1)
+    if test_corr is not None:
+        cor_cor = masked_pearson(flat(disc.corr), flat(test_corr), flat(pair))
+    else:
+        cor_cor = nanlike
+
+    cor_degree = masked_pearson(disc.degree, test_degree, w)
+
+    if test_zdata is not None:
+        prof = summary_profile_masked(test_zdata, w, n_iter=n_iter, method=summary_method)
+        nc = node_contribution_masked(test_zdata, prof, w)
+        coherence = masked_mean(nc * nc, w, axis=-1)
+        cor_contrib = masked_pearson(disc.contrib, nc, w)
+        avg_cor = (
+            jnp.sum(disc.sign_corr * test_corr, axis=(-1, -2)) / npair
+            if test_corr is not None else nanlike
+        )
+        avg_contrib = masked_mean(disc.sign_contrib * nc, w, axis=-1)
+    else:
+        coherence = cor_contrib = avg_cor = avg_contrib = nanlike
+
+    return jnp.stack(
+        [avg_weight, coherence, cor_cor, cor_degree, cor_contrib, avg_cor, avg_contrib],
+        axis=-1,
+    )
+
+
 def module_stats_masked(
     disc: DiscProps,
     test_corr: jnp.ndarray,   # (..., m, m)
@@ -237,27 +284,11 @@ def module_stats_masked(
     npair = jnp.maximum(jnp.sum(pair, axis=(-1, -2)), _EPS)
 
     avg_weight = jnp.sum(test_net, axis=(-1, -2)) / npair
-
-    flat = lambda a: a.reshape(*a.shape[:-2], -1)
-    cor_cor = masked_pearson(flat(disc.corr), flat(test_corr), flat(pair))
-
     test_degree = jnp.sum(test_net, axis=-1)
-    cor_degree = masked_pearson(disc.degree, test_degree, w)
 
-    if test_zdata is not None:
-        prof = summary_profile_masked(test_zdata, w, n_iter=n_iter, method=summary_method)
-        nc = node_contribution_masked(test_zdata, prof, w)
-        coherence = masked_mean(nc * nc, w, axis=-1)
-        cor_contrib = masked_pearson(disc.contrib, nc, w)
-        avg_cor = jnp.sum(disc.sign_corr * test_corr, axis=(-1, -2)) / npair
-        avg_contrib = masked_mean(disc.sign_contrib * nc, w, axis=-1)
-    else:
-        nanlike = jnp.full_like(avg_weight, jnp.nan)
-        coherence = cor_contrib = avg_cor = avg_contrib = nanlike
-
-    return jnp.stack(
-        [avg_weight, coherence, cor_cor, cor_degree, cor_contrib, avg_cor, avg_contrib],
-        axis=-1,
+    return stats_from_parts(
+        disc, avg_weight, test_degree, test_corr, test_zdata,
+        n_iter=n_iter, summary_method=summary_method,
     )
 
 

@@ -102,13 +102,17 @@ def run_checkpointed_chunks(
             ckpt.save_null_checkpoint(checkpoint_path, nulls, done, kd, fp)
 
     C = base.effective_chunk()
+    # JAX engines keep the full chunk shape on the tail (fixed shapes hit the
+    # compile cache); dynamic-shape engines (the native C++ backend) opt into
+    # clamping so the tail doesn't burn up to chunk-1 wasted permutations.
+    dynamic = getattr(base, "dynamic_chunk", False)
     nulls = nulls_init if nulls_init is not None else np.full(alloc_shape, np.nan)
     done = start_perm
     last_saved = done
     try:
         while done < n_perm:
             take = min(C, n_perm - done)
-            keys = base.perm_keys(key, done, C)
+            keys = base.perm_keys(key, done, take if dynamic else C)
             outs = fn(keys)
             write(nulls, outs, done, take)
             done += take

@@ -1,0 +1,254 @@
+"""Sparse permutation engine — Config E (BASELINE.json:11): permutation
+nulls over kNN-graph adjacencies without ever materializing an ``n × n``
+matrix. Same contract as :class:`~netrep_tpu.parallel.engine.
+PermutationEngine` (bucketed static shapes, chunked/interruptible/
+checkpointable null loop, chunk- and mesh-independent RNG), different data
+plane: padded neighbor lists + on-the-fly correlation
+(:mod:`netrep_tpu.ops.sparse`).
+
+The reference has no sparse mode (SURVEY.md §2.3: its only scale axis is
+dense ``n²`` matrices in shared memory); this engine is the rebuild's answer
+to the survey's "sharded gather + masked reduction is this domain's context
+parallelism" item for graphs whose adjacency is structurally sparse. The
+working set per chunk is ``O(C·K·cap·k)`` — at Config E scale (n=50k,
+k≈30) a 64-permutation chunk over 20 modules of ≤200 nodes is ~100 MB,
+versus 10 GB for one dense adjacency.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops import sparse as jsparse
+from ..ops.oracle import N_STATS
+from ..ops.sparse import SparseAdjacency
+from ..utils.config import EngineConfig
+from .engine import ModuleSpec, PermutationEngine, run_checkpointed_chunks
+
+
+class _SparseBucket:
+    def __init__(self, cap, module_pos, disc, obs_idx, slices):
+        self.cap = cap
+        self.module_pos = module_pos
+        self.disc = disc
+        self.obs_idx = obs_idx
+        self.slices = slices
+
+
+class SparsePermutationEngine:
+    """Permutation-null engine for one (discovery, test) pair of sparse
+    networks.
+
+    Parameters
+    ----------
+    disc_adj, test_adj : :class:`~netrep_tpu.ops.sparse.SparseAdjacency`.
+    disc_data, test_data : (n_samples, n) data matrices or None. Without
+        data, only ``avg.weight`` and ``cor.degree`` are defined (the
+        correlation-based statistics need the on-the-fly correlation —
+        see :mod:`netrep_tpu.ops.sparse` on why sparse data-less differs
+        from dense data-less).
+    modules : ordered :class:`ModuleSpec` list (discovery/test index pairs).
+    pool : candidate test-node ids the null samples from (SURVEY.md §3.1).
+    config, mesh : as for :class:`PermutationEngine`; ``mesh`` shards the
+        permutation axis (``config.mesh_axis``) — the adjacency itself is
+        replicated (n·k floats is small by construction).
+    """
+
+    def __init__(
+        self,
+        disc_adj: SparseAdjacency,
+        disc_data,
+        test_adj: SparseAdjacency,
+        test_data,
+        modules: Sequence[ModuleSpec],
+        pool: np.ndarray,
+        config: EngineConfig = EngineConfig(),
+        mesh=None,
+    ):
+        if config.matrix_sharding == "row":
+            raise NotImplementedError(
+                "matrix_sharding='row' does not apply to the sparse engine: "
+                "the padded neighbor lists are O(n·k) and are replicated"
+            )
+        self.config = config
+        self.mesh = mesh
+        self.modules = list(modules)
+        self.n_modules = len(self.modules)
+        self.has_data = disc_data is not None and test_data is not None
+
+        bad = [m.label for m in self.modules if m.size < 2]
+        if bad:
+            raise ValueError(
+                f"modules {bad} have fewer than 2 nodes present in the test "
+                "dataset; drop them before building the engine"
+            )
+
+        dtype = jnp.dtype(config.dtype)
+        self._nbr = jnp.asarray(test_adj.nbr)
+        self._wgt = jnp.asarray(test_adj.wgt, dtype)
+        self._test_data = (
+            jnp.asarray(test_data, dtype) if self.has_data else None
+        )
+        self.pool = np.asarray(pool, dtype=np.int32)
+        self.total_take = sum(m.size for m in self.modules)
+        if self.total_take > self.pool.size:
+            raise ValueError(
+                f"total module size ({self.total_take}) exceeds the "
+                f"candidate pool ({self.pool.size}); use null='all' or drop "
+                "modules"
+            )
+        self._pool_dev = jnp.asarray(self.pool)
+
+        # bucket modules by padded capacity so each bucket compiles once
+        # (SURVEY.md §7 "Variable module sizes vs. XLA static shapes")
+        disc_nbr = jnp.asarray(disc_adj.nbr)
+        disc_wgt = jnp.asarray(disc_adj.wgt, dtype)
+        disc_data_dev = (
+            jnp.asarray(disc_data, dtype) if self.has_data else None
+        )
+        by_cap: dict[int, list[int]] = {}
+        for k, m in enumerate(self.modules):
+            by_cap.setdefault(config.rounded_cap(m.size), []).append(k)
+
+        offsets = np.concatenate(
+            [[0], np.cumsum([m.size for m in self.modules])]
+        ).astype(int)
+
+        self.buckets: list[_SparseBucket] = []
+        for cap, pos in sorted(by_cap.items()):
+            K = len(pos)
+            disc_idx = np.zeros((K, cap), dtype=np.int32)
+            obs_idx = np.zeros((K, cap), dtype=np.int32)
+            mask = np.zeros((K, cap), dtype=np.float32)
+            slices = []
+            for row, k in enumerate(pos):
+                m = self.modules[k]
+                sz = m.size
+                disc_idx[row, :sz] = np.asarray(m.disc_idx, dtype=np.int32)
+                obs_idx[row, :sz] = np.asarray(m.test_idx, dtype=np.int32)
+                mask[row, :sz] = 1.0
+                slices.append((int(offsets[k]), sz))
+            disc = jsparse.make_disc_props_sparse(
+                disc_nbr, disc_wgt, disc_data_dev,
+                jnp.asarray(disc_idx), jnp.asarray(mask),
+            )
+            self.buckets.append(
+                _SparseBucket(cap, pos, disc, jnp.asarray(obs_idx), slices)
+            )
+
+        self._chunk_fn_cached: Callable | None = None
+        self._observed_fn = None
+
+    # shared chunk/key contract — single source of truth on the dense engine
+    effective_chunk = PermutationEngine.effective_chunk
+    perm_keys = staticmethod(PermutationEngine.perm_keys)
+
+    def fingerprint_arrays(self):
+        arrays = [self._nbr, self._wgt, self._test_data]
+        for b in self.buckets:
+            arrays.extend(
+                f for f in b.disc if f is not None and hasattr(f, "reshape")
+            )
+        return arrays
+
+    def observed(self) -> np.ndarray:
+        """(n_modules, 7) observed statistics on the actual overlap sets."""
+        if self._observed_fn is None:
+            self._observed_fn = jax.jit(
+                jax.vmap(
+                    partial(
+                        jsparse.sparse_gather_and_stats,
+                        n_iter=self.config.power_iters,
+                        summary_method="eigh",  # observed: exact, runs once
+                    ),
+                    in_axes=(0, 0, None, None, None),
+                )
+            )
+        out = np.full((self.n_modules, N_STATS), np.nan)
+        for b in self.buckets:
+            res = self._observed_fn(
+                b.disc, b.obs_idx, self._nbr, self._wgt, self._test_data
+            )
+            out[b.module_pos] = np.asarray(res, dtype=np.float64)
+        return out
+
+    def chunk_body(self) -> Callable:
+        """Unjitted chunk program; same permutation-draw semantics as the
+        dense engine (one pool shuffle per permutation, consecutive module
+        slices — disjoint node sets within a permutation)."""
+        cfg = self.config
+        buckets = self.buckets
+        pool = self._pool_dev
+        nbr, wgt, td = self._nbr, self._wgt, self._test_data
+
+        def chunk(keys: jax.Array) -> list[jax.Array]:
+            perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)
+            outs = []
+            for b in buckets:
+                cols = []
+                for off, size in b.slices:
+                    idx = perm[:, off: off + size]
+                    idx = jnp.pad(idx, ((0, 0), (0, b.cap - size)))
+                    cols.append(idx)
+                idx_b = jnp.stack(cols, axis=1)  # (C, K, cap)
+                inner = jax.vmap(
+                    partial(
+                        jsparse.sparse_gather_and_stats,
+                        n_iter=cfg.power_iters,
+                        summary_method=cfg.summary_method,
+                    ),
+                    in_axes=(0, 0, None, None, None),
+                )
+                over_perms = jax.vmap(inner, in_axes=(None, 0, None, None, None))
+                outs.append(over_perms(b.disc, idx_b, nbr, wgt, td))
+            return outs
+
+        return chunk
+
+    def _chunk_fn(self) -> Callable:
+        if self._chunk_fn_cached is None:
+            chunk = self.chunk_body()
+            if self.mesh is not None:
+                ksh = NamedSharding(self.mesh, P(self.config.mesh_axis))
+                osh = [
+                    NamedSharding(self.mesh, P(self.config.mesh_axis))
+                    for _ in self.buckets
+                ]
+                self._chunk_fn_cached = jax.jit(
+                    chunk, in_shardings=(ksh,), out_shardings=osh
+                )
+            else:
+                self._chunk_fn_cached = jax.jit(chunk)
+        return self._chunk_fn_cached
+
+    def run_null(
+        self,
+        n_perm: int,
+        key: jax.Array | int = 0,
+        progress: Callable[[int, int], None] | None = None,
+        nulls_init: np.ndarray | None = None,
+        start_perm: int = 0,
+        checkpoint_path: str | None = None,
+        checkpoint_every: int = 8192,
+    ) -> tuple[np.ndarray, int]:
+        """Same contract as :meth:`PermutationEngine.run_null` (chunked,
+        interruptible, resumable, checkpointable; same-seed ⇒ same null)."""
+
+        def write(nulls, outs, done, take):
+            for b, out in zip(self.buckets, outs):
+                arr = np.asarray(out[:take], dtype=np.float64)
+                nulls[done: done + take, b.module_pos] = arr
+
+        return run_checkpointed_chunks(
+            self, n_perm, key, self._chunk_fn(),
+            (n_perm, self.n_modules, N_STATS), write,
+            progress=progress, nulls_init=nulls_init, start_perm=start_perm,
+            checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+        )

@@ -31,10 +31,18 @@ import sys
 
 import matplotlib
 
-# Headless-safe default — but only when pyplot hasn't been imported yet and
-# no display is available; switching an interactive session (Jupyter, TkAgg)
-# to Agg would silently break the user's plt.show().
-if "matplotlib.pyplot" not in sys.modules and not os.environ.get("DISPLAY"):
+# Headless-safe default: force Agg only on a display-less Linux box, and only
+# when neither pyplot nor an explicit MPLBACKEND has had a say. macOS/Windows
+# always have a GUI toolkit; Wayland sessions may have WAYLAND_DISPLAY but no
+# DISPLAY; switching an interactive session to Agg would silently break
+# plt.show().
+if (
+    "matplotlib.pyplot" not in sys.modules
+    and not os.environ.get("MPLBACKEND")
+    and sys.platform.startswith("linux")
+    and not os.environ.get("DISPLAY")
+    and not os.environ.get("WAYLAND_DISPLAY")
+):
     matplotlib.use("Agg")
 import matplotlib.pyplot as plt  # noqa: E402
 from matplotlib.gridspec import GridSpec  # noqa: E402

@@ -20,7 +20,9 @@ import tempfile
 
 import numpy as np
 
-_FORMAT_VERSION = 1
+# v2: fingerprint gained the sampled content digest — v1 checkpoints get a
+# clear version error instead of a misleading "different problem" mismatch
+_FORMAT_VERSION = 2
 
 
 def content_digest(arrays) -> str:

@@ -1,0 +1,240 @@
+"""Sparse-path tests (Config E, BASELINE.json:11): representation
+round-trips, kernel parity against the dense engine on densified graphs,
+same-seed null equality (the two engines share the permutation-draw
+contract), and the sparse user surface."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from netrep_tpu.ops import oracle
+from netrep_tpu.ops.sparse import SparseAdjacency
+from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+from netrep_tpu.parallel.sparse import SparsePermutationEngine
+from netrep_tpu.utils.config import EngineConfig
+
+
+def _knn_problem(rng, n_disc=50, n_test=44, k=6, s_d=30, s_t=26,
+                 module_sizes=(9, 7, 5), with_data=True):
+    """Synthetic kNN-style sparse pair: planted module data, adjacency =
+    top-k |corr| edges per node, symmetrized."""
+    def build(n, s):
+        x = rng.standard_normal((s, n))
+        pos = 0
+        for sz in module_sizes:
+            latent = rng.standard_normal(s)
+            x[:, pos:pos + sz] = latent[:, None] + 0.7 * x[:, pos:pos + sz]
+            pos += sz
+        corr = np.corrcoef(x, rowvar=False)
+        aff = np.abs(corr)
+        np.fill_diagonal(aff, 0.0)
+        rows, cols, vals = [], [], []
+        for i in range(n):
+            top = np.argsort(aff[i])[-k:]
+            rows.extend([i] * k)
+            cols.extend(top.tolist())
+            vals.extend(aff[i, top].tolist())
+        adj = SparseAdjacency.from_coo(rows, cols, vals, n)
+        return x, adj
+
+    d_data, d_adj = build(n_disc, s_d)
+    t_data, t_adj = build(n_test, s_t)
+    specs, pos = [], 0
+    for kk, sz in enumerate(module_sizes):
+        idx = np.arange(pos, pos + sz, dtype=np.int32)
+        specs.append(ModuleSpec(str(kk + 1), idx, idx))
+        pos += sz
+    pool = np.arange(n_test, dtype=np.int32)
+    if not with_data:
+        d_data = t_data = None
+    return (d_adj, d_data), (t_adj, t_data), specs, pool
+
+
+def test_coo_roundtrip_and_symmetrize(rng):
+    n = 12
+    rows = [0, 1, 2, 5, 7]
+    cols = [1, 2, 3, 6, 8]
+    vals = [0.5, 0.25, 1.0, 0.75, 0.3]
+    adj = SparseAdjacency.from_coo(rows, cols, vals, n)
+    dense = adj.to_dense()
+    assert dense[0, 1] == 0.5 and dense[1, 0] == 0.5  # symmetrized
+    np.testing.assert_allclose(dense, dense.T)
+    # self-loops and explicit zeros dropped
+    adj2 = SparseAdjacency.from_coo([3, 4], [3, 5], [9.0, 0.0], n)
+    assert adj2.to_dense().sum() == 0.0
+    # round-trip through from_dense
+    adj3 = SparseAdjacency.from_dense(dense)
+    np.testing.assert_allclose(adj3.to_dense(), dense)
+    # out-of-range errors
+    with pytest.raises(ValueError, match="out of range"):
+        SparseAdjacency.from_coo([0], [99], [1.0], n)
+
+
+@pytest.mark.parametrize("with_data", [True, False])
+def test_sparse_observed_matches_dense_engine(rng, with_data):
+    """On a densified graph the sparse engine's observed statistics must
+    match the dense engine's — except the correlation statistics, which the
+    sparse path derives from data on the fly rather than from a user matrix
+    (with data they agree because the dense fixture's correlation IS the
+    data correlation; without data they are NaN on the sparse side)."""
+    (d_adj, d_data), (t_adj, t_data), specs, pool = _knn_problem(
+        rng, with_data=with_data
+    )
+    d_dense, t_dense = d_adj.to_dense(), t_adj.to_dense()
+    d_corr = (
+        np.corrcoef(d_data, rowvar=False) if with_data
+        else np.eye(d_adj.n)
+    )
+    t_corr = (
+        np.corrcoef(t_data, rowvar=False) if with_data
+        else np.eye(t_adj.n)
+    )
+
+    sparse_eng = SparsePermutationEngine(
+        d_adj, d_data, t_adj, t_data, specs, pool,
+        config=EngineConfig(chunk_size=8),
+    )
+    dense_eng = PermutationEngine(
+        d_corr, d_dense, d_data, t_corr, t_dense, t_data, specs, pool,
+        config=EngineConfig(chunk_size=8),
+    )
+    so = sparse_eng.observed()
+    do = dense_eng.observed()
+    if with_data:
+        np.testing.assert_allclose(so, do, rtol=2e-4, atol=2e-4)
+    else:
+        # avg.weight (0) and cor.degree (3) agree; the rest NaN on sparse
+        np.testing.assert_allclose(so[:, [0, 3]], do[:, [0, 3]],
+                                   rtol=2e-4, atol=2e-4)
+        assert np.isnan(so[:, [1, 2, 4, 5, 6]]).all()
+
+
+def test_sparse_null_equals_dense_null_same_seed(rng):
+    """The sparse and dense engines share the permutation-draw contract
+    (same fold_in keys → same pool shuffle → same node sets), so on a
+    densified graph the same seed must give the same null to float32
+    tolerance — kernel parity on thousands of random modules at once."""
+    (d_adj, d_data), (t_adj, t_data), specs, pool = _knn_problem(rng)
+    d_corr = np.corrcoef(d_data, rowvar=False)
+    t_corr = np.corrcoef(t_data, rowvar=False)
+
+    cfg = EngineConfig(chunk_size=16, summary_method="power", power_iters=60)
+    sparse_eng = SparsePermutationEngine(
+        d_adj, d_data, t_adj, t_data, specs, pool, config=cfg
+    )
+    dense_eng = PermutationEngine(
+        d_corr, d_adj.to_dense(), d_data, t_corr, t_adj.to_dense(), t_data,
+        specs, pool, config=cfg,
+    )
+    sn, sd = sparse_eng.run_null(48, key=3)
+    dn, dd = dense_eng.run_null(48, key=3)
+    assert sd == dd == 48
+    np.testing.assert_allclose(sn, dn, rtol=5e-3, atol=5e-3)
+
+
+def test_sparse_null_determinism_and_chunk_independence(rng):
+    (d_adj, d_data), (t_adj, t_data), specs, pool = _knn_problem(rng)
+    e1 = SparsePermutationEngine(
+        d_adj, d_data, t_adj, t_data, specs, pool,
+        config=EngineConfig(chunk_size=8),
+    )
+    e2 = SparsePermutationEngine(
+        d_adj, d_data, t_adj, t_data, specs, pool,
+        config=EngineConfig(chunk_size=16),
+    )
+    n1, _ = e1.run_null(32, key=11)
+    n2, _ = e2.run_null(32, key=11)
+    np.testing.assert_allclose(n1, n2, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_api_end_to_end(rng, tmp_path):
+    from netrep_tpu import sparse_module_preservation
+
+    (d_adj, d_data), (t_adj, t_data), specs, pool = _knn_problem(rng)
+    labels = np.full(d_adj.n, "0", dtype=object)
+    pos = 0
+    for kk, sz in enumerate((9, 7, 5)):
+        labels[pos:pos + sz] = str(kk + 1)
+        pos += sz
+    d_names = [f"c{i}" for i in range(d_adj.n)]
+    t_names = d_names[: t_adj.n]
+
+    ckpt = str(tmp_path / "sparse_null.npz")
+    res = sparse_module_preservation(
+        d_adj, t_adj, labels,
+        discovery_data=d_data, test_data=t_data,
+        discovery_names=d_names, test_names=t_names,
+        n_perm=200, seed=0, checkpoint_path=ckpt,
+    )
+    assert res.observed.shape == (3, 7)
+    assert res.completed == 200
+    assert np.isfinite(res.p_values).all()
+    assert (res.p_values[:, 0] < 0.25).all()  # planted modules preserved
+    assert res.n_vars_present.tolist() == [9, 7, 5]
+
+    # resume from the finished checkpoint is a no-op with identical results
+    res2 = sparse_module_preservation(
+        d_adj, t_adj, labels,
+        discovery_data=d_data, test_data=t_data,
+        discovery_names=d_names, test_names=t_names,
+        n_perm=200, seed=0, checkpoint_path=ckpt,
+    )
+    np.testing.assert_array_equal(res.nulls, res2.nulls)
+
+
+def test_sparse_api_validation(rng):
+    from netrep_tpu import sparse_module_preservation
+
+    (d_adj, d_data), (t_adj, t_data), specs, pool = _knn_problem(rng)
+    labels = np.full(d_adj.n, "1", dtype=object)
+
+    with pytest.raises(TypeError, match="SparseAdjacency"):
+        sparse_module_preservation(
+            d_adj.to_dense(), t_adj, labels,
+        )
+    with pytest.raises(ValueError, match="same node count"):
+        sparse_module_preservation(d_adj, t_adj, labels)
+    with pytest.raises(ValueError, match="discovery_names length"):
+        sparse_module_preservation(
+            d_adj, t_adj, labels,
+            discovery_names=["a"], test_names=["a"] * t_adj.n,
+        )
+    with pytest.raises(ValueError, match="missing"):
+        sparse_module_preservation(
+            d_adj, t_adj, {"c0": "1"},
+            discovery_names=[f"c{i}" for i in range(d_adj.n)],
+            test_names=[f"c{i}" for i in range(t_adj.n)],
+        )
+    with pytest.raises(ValueError, match="do not exist in the module"):
+        sparse_module_preservation(
+            d_adj, t_adj, labels,
+            discovery_names=[f"c{i}" for i in range(d_adj.n)],
+            test_names=[f"c{i}" for i in range(t_adj.n)],
+            modules=["zebra"],
+        )
+
+
+def test_sparse_vs_oracle_topology(rng):
+    """Direct oracle check for the sparse topology kernels on a densified
+    module slice (avg.weight, weighted degree feeding cor.degree)."""
+    (d_adj, d_data), (t_adj, t_data), specs, pool = _knn_problem(rng)
+    dense = t_adj.to_dense()
+    for m in specs:
+        idx = np.asarray(m.test_idx)
+        sub = dense[np.ix_(idx, idx)]
+        want_avg = oracle.avg_edge_weight(sub)
+        want_deg = oracle.weighted_degree(sub)
+
+        from netrep_tpu.ops.sparse import sparse_module_topology
+
+        nbr_rows = jnp.asarray(t_adj.nbr[idx])
+        wgt_rows = jnp.asarray(t_adj.wgt[idx])
+        got_avg, got_deg = sparse_module_topology(
+            nbr_rows, wgt_rows, jnp.asarray(idx),
+            jnp.ones(len(idx), dtype=np.float32),
+        )
+        np.testing.assert_allclose(float(got_avg), want_avg, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(got_deg), want_deg, rtol=1e-5, atol=1e-6
+        )
