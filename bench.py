@@ -26,6 +26,20 @@ import numpy as np
 TARGET_SECONDS = 60.0  # BASELINE.json:5 north-star
 
 
+def ensure_backend():
+    """Resolve a usable JAX backend. The driver environment pins
+    JAX_PLATFORMS=axon (the TPU tunnel), whose plugin registration is
+    flaky — when it fails, fall back to automatic backend selection (which
+    finds the same TPU via libtpu, else CPU)."""
+    import jax
+
+    try:
+        return jax.devices()
+    except RuntimeError:
+        jax.config.update("jax_platforms", "")
+        return jax.devices()
+
+
 def build_problem(n_genes, n_modules, n_samples, seed=0):
     """Synthetic genome-scale co-expression pair, generated on device:
     data → correlation (one big MXU matmul) → soft-threshold adjacency."""
@@ -50,7 +64,7 @@ def main():
     ap.add_argument("--genes", type=int, default=20_000)
     ap.add_argument("--modules", type=int, default=50)
     ap.add_argument("--perms", type=int, default=10_000)
-    ap.add_argument("--chunk", type=int, default=64)
+    ap.add_argument("--chunk", type=int, default=256)
     ap.add_argument("--samples", type=int, default=128)
     ap.add_argument("--dtype", default="float32")
     ap.add_argument("--smoke", action="store_true",
@@ -62,6 +76,7 @@ def main():
         )
 
     import jax
+    ensure_backend()
     from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
     from netrep_tpu.utils.config import EngineConfig
 

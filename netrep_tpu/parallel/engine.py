@@ -130,6 +130,13 @@ def run_checkpointed_chunks(
     return nulls, done
 
 
+@partial(jax.jit, static_argnums=(2,))
+def _perm_keys_jit(key: jax.Array, start: jax.Array, count: int) -> jax.Array:
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        start + jnp.arange(count, dtype=jnp.uint32)
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class ModuleSpec:
     """One discovery module's overlap bookkeeping (SURVEY.md §3.1).
@@ -252,6 +259,18 @@ class PermutationEngine:
             if (self.has_data and test_data is not None)
             else None
         )
+        # sorted-rows+MXU gather path (see ops.stats.gather_and_stats_mxu):
+        # resolved against the backend the matrices actually live on; the
+        # data matrix is transposed once so data slices are row gathers
+        self.gather_mode = (
+            "direct" if self.row_sharded
+            else config.resolved_gather_mode(jax.default_backend())
+        )
+        self._test_dataT = (
+            jnp.swapaxes(self._test_data, -1, -2)
+            if (self._test_data is not None and self.gather_mode == "mxu")
+            else None
+        )
 
         sizes = [m.size for m in self.modules]
         if min(sizes, default=1) < 2:
@@ -282,6 +301,9 @@ class PermutationEngine:
         d_data = (
             jnp.asarray(disc_data, jnp.float32) if self.has_data else None
         )
+        # The discovery matrices ride as jit ARGUMENTS (not closure
+        # captures — captured device arrays become compile-time constants:
+        # 3.2 GB baked into the bucket-build executable at Config D scale).
         if self.row_sharded:
             from .mesh import ROW_AXIS
             from .sharded import pad_square_to_multiple, shard_rows
@@ -295,13 +317,14 @@ class PermutationEngine:
                 jnp.asarray(pad_square_to_multiple(disc_net, d_row), jnp.float32),
                 mesh,
             )
+            gather_rep = self._gather_rep
 
             @jax.jit
-            def _disc_bucket(idx, mask):
-                corr_b, net_b = self._gather_rep(d_corr, d_net, idx)
+            def _disc_bucket(dc, dn, dd, idx, mask):
+                corr_b, net_b = gather_rep(dc, dn, idx)
                 data_b = (
-                    jax.vmap(lambda ix: jnp.take(d_data, ix, axis=1))(idx)
-                    if d_data is not None
+                    jax.vmap(lambda ix: jnp.take(dd, ix, axis=1))(idx)
+                    if dd is not None
                     else None
                 )
                 return jstats.make_disc_props(corr_b, net_b, data_b, mask)
@@ -310,14 +333,14 @@ class PermutationEngine:
             d_net = jnp.asarray(disc_net, jnp.float32)
 
             @jax.jit
-            def _disc_bucket(idx, mask):
+            def _disc_bucket(dc, dn, dd, idx, mask):
                 # idx: (K, cap) padded discovery indices; mask: (K, cap)
                 sub = lambda mat, ix: mat[ix[:, None], ix[None, :]]
-                corr_b = jax.vmap(partial(sub, d_corr))(idx)
-                net_b = jax.vmap(partial(sub, d_net))(idx)
+                corr_b = jax.vmap(partial(sub, dc))(idx)
+                net_b = jax.vmap(partial(sub, dn))(idx)
                 data_b = (
-                    jax.vmap(lambda ix: jnp.take(d_data, ix, axis=1))(idx)
-                    if d_data is not None
+                    jax.vmap(lambda ix: jnp.take(dd, ix, axis=1))(idx)
+                    if dd is not None
                     else None
                 )
                 return jstats.make_disc_props(corr_b, net_b, data_b, mask)
@@ -336,6 +359,7 @@ class PermutationEngine:
                 slices.append((int(offsets[k]), mod.size))
 
             disc = _disc_bucket(
+                d_corr, d_net, d_data,
                 jnp.asarray(np.stack(didx_b)), jnp.asarray(np.stack(mask_b))
             )
             self.buckets.append(
@@ -376,10 +400,10 @@ class PermutationEngine:
     def perm_keys(key: jax.Array, start: int, count: int) -> jax.Array:
         """Per-permutation keys ``fold_in(key, i)`` for i in [start, start+count)
         — the chunk-size- and mesh-independent seeding contract
-        (SURVEY.md §7 "RNG semantics")."""
-        return jax.vmap(partial(jax.random.fold_in, key))(
-            jnp.arange(start, start + count, dtype=jnp.uint32)
-        )
+        (SURVEY.md §7 "RNG semantics"). Jitted (static count, traced start):
+        eager dispatch costs ~1s per op on tunneled TPU backends, which
+        would dwarf the chunk compute in the hot loop."""
+        return _perm_keys_jit(key, jnp.uint32(start), int(count))
 
     def observed(self) -> np.ndarray:
         """(n_modules, 7) observed statistics on the actual overlap sets."""
@@ -407,17 +431,22 @@ class PermutationEngine:
                 self._observed_fn = jax.jit(
                     jax.vmap(
                         partial(
-                            jstats.gather_and_stats,
+                            jstats.gather_and_stats_mxu
+                            if self.gather_mode == "mxu"
+                            else jstats.gather_and_stats,
                             n_iter=self.config.power_iters,
                             summary_method="eigh",  # observed: exact, runs once
                         ),
                         in_axes=(0, 0, None, None, None),
                     )
                 )
+        td_obs = (
+            self._test_dataT if self.gather_mode == "mxu" else self._test_data
+        )
         out = np.full((self.n_modules, N_STATS), np.nan)
         for b in self.buckets:
             res = self._observed_fn(
-                b.disc, b.obs_idx, self._test_corr, self._test_net, self._test_data
+                b.disc, b.obs_idx, self._test_corr, self._test_net, td_obs
             )
             out[b.module_pos] = np.asarray(res, dtype=np.float64)
         return out
@@ -426,32 +455,53 @@ class PermutationEngine:
     # Null chunks
     # ------------------------------------------------------------------
 
+    def chunk_args(self) -> tuple:
+        """Device operands of the chunk program. Passed to the jitted chunk
+        as ARGUMENTS, never captured in its closure: closure-captured device
+        arrays become compile-time constants, and baking the n×n matrices
+        into the executable (3+ GB at Config D scale) multiplies compile
+        time and HBM footprint."""
+        return (
+            self._pool_dev,
+            self._test_corr,
+            self._test_net,
+            self._test_dataT if self.gather_mode == "mxu" else self._test_data,
+            [b.disc for b in self.buckets],
+        )
+
     def chunk_body(self) -> Callable:
         """The unjitted chunk program: draw a node permutation per chunk
         element, slice per-module index sets in the fixed module order
         (disjoint within a permutation — the reference's label-shuffle
         semantics, SURVEY.md §3.1), and run all bucket kernels. Signature:
-        ``chunk(keys: (C,) PRNG keys) -> [per-bucket (C, K_b, 7) arrays]``.
-        Jittable as-is (used by ``__graft_entry__.entry``)."""
+        ``chunk(keys, *chunk_args) -> [per-bucket (C, K_b, 7) arrays]``
+        with ``chunk_args`` as produced by :meth:`chunk_args` (used by
+        ``__graft_entry__.entry``)."""
         cfg = self.config
-        buckets = self.buckets
-        pool = self._pool_dev
-        tc, tn, td = self._test_corr, self._test_net, self._test_data
+        # only static structure may be closed over (see chunk_args)
+        caps_slices = [(b.cap, tuple(b.slices)) for b in self.buckets]
         row_sharded = self.row_sharded
         gather_perm = self._gather_perm if row_sharded else None
+        gather_mode = self.gather_mode
+        kernel = partial(
+            jstats.gather_and_stats_mxu if gather_mode == "mxu"
+            else jstats.gather_and_stats,
+            n_iter=cfg.power_iters,
+            summary_method=cfg.summary_method,
+        )
 
-        def chunk(keys: jax.Array) -> list[jax.Array]:
+        def chunk(keys: jax.Array, pool, tc, tn, td, discs) -> list[jax.Array]:
             # keys: (C,) typed PRNG keys, one per permutation
-            perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)
-            outs = []
-            for b in buckets:
-                cols = []
-                for off, size in b.slices:
-                    idx = perm[:, off: off + size]
-                    idx = jnp.pad(idx, ((0, 0), (0, b.cap - size)))
-                    cols.append(idx)
-                idx_b = jnp.stack(cols, axis=1)  # (C, K, cap)
-                if row_sharded:
+            if row_sharded:
+                perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)
+                outs = []
+                for (cap, slices), disc in zip(caps_slices, discs):
+                    cols = []
+                    for off, size in slices:
+                        idx = perm[:, off: off + size]
+                        idx = jnp.pad(idx, ((0, 0), (0, cap - size)))
+                        cols.append(idx)
+                    idx_b = jnp.stack(cols, axis=1)  # (C, K, cap)
                     # collective-assembled gathers from the row-sharded
                     # matrices; statistics batch over (C, K) by broadcasting
                     # (disc props carry the K axis).
@@ -461,46 +511,60 @@ class PermutationEngine:
                         sub_d = jax.vmap(
                             jax.vmap(lambda ix: jnp.take(td, ix, axis=-1))
                         )(idx_b)  # (C, K, samples, cap)
-                        zd = jstats.standardize_masked(sub_d, b.disc.mask)
+                        zd = jstats.standardize_masked(sub_d, disc.mask)
                     outs.append(
                         jstats.module_stats_masked(
-                            b.disc, sub_c, sub_n, zd,
+                            disc, sub_c, sub_n, zd,
                             n_iter=cfg.power_iters,
                             summary_method=cfg.summary_method,
                         )
                     )
-                else:
-                    inner = jax.vmap(
-                        partial(
-                            jstats.gather_and_stats,
-                            n_iter=cfg.power_iters,
-                            summary_method=cfg.summary_method,
-                        ),
-                        in_axes=(0, 0, None, None, None),
-                    )
-                    over_perms = jax.vmap(inner, in_axes=(None, 0, None, None, None))
-                    outs.append(over_perms(b.disc, idx_b, tc, tn, td))
-            return outs
+                return outs
+
+            # Replicated path: sequence permutations with lax.map (one device
+            # dispatch; batch_size bounds the mxu path's (batch, rows, n)
+            # gather working set in HBM), vmap over each bucket's modules.
+            def per_perm(key):
+                perm = jax.random.permutation(key, pool)
+                outs_p = []
+                for (cap, slices), disc in zip(caps_slices, discs):
+                    cols = []
+                    for off, size in slices:
+                        idx = perm[off: off + size]
+                        cols.append(jnp.pad(idx, (0, cap - size)))
+                    idx_b = jnp.stack(cols, axis=0)  # (K, cap)
+                    over_mods = jax.vmap(kernel, in_axes=(0, 0, None, None, None))
+                    outs_p.append(over_mods(disc, idx_b, tc, tn, td))
+                return outs_p
+
+            return jax.lax.map(per_perm, keys, batch_size=cfg.perm_batch)
 
         return chunk
 
     def _build_chunk_fn(self) -> Callable:
-        """Jit the chunk body, sharding the per-permutation key array (and
-        outputs) along the mesh's permutation axis when a mesh is present —
-        XLA then partitions the whole chunk across devices over ICI
-        (SURVEY.md §2.3)."""
+        """Jit the chunk body (operands as arguments, :meth:`chunk_args`),
+        sharding the per-permutation key array (and outputs) along the
+        mesh's permutation axis when a mesh is present — XLA then partitions
+        the whole chunk across devices over ICI (SURVEY.md §2.3)."""
         chunk = self.chunk_body()
         cfg = self.config
-        buckets = self.buckets
+        args = self.chunk_args()
         if self.mesh is not None:
             keys_sharding = NamedSharding(self.mesh, P(cfg.mesh_axis))
             out_shardings = [
-                NamedSharding(self.mesh, P(cfg.mesh_axis)) for _ in buckets
+                NamedSharding(self.mesh, P(cfg.mesh_axis))
+                for _ in self.buckets
             ]
-            return jax.jit(
-                chunk, in_shardings=(keys_sharding,), out_shardings=out_shardings
-            )
-        return jax.jit(chunk)
+            jitted = jax.jit(chunk, out_shardings=out_shardings)
+
+            def fn(keys):
+                # shard keys explicitly; the matrix operands keep their own
+                # (committed) shardings — replicated or row-sharded
+                return jitted(jax.device_put(keys, keys_sharding), *args)
+
+            return fn
+        jitted = jax.jit(chunk)
+        return lambda keys: jitted(keys, *args)
 
     def _chunk_fn(self) -> Callable:
         if self._chunk_fn_cached is None:
@@ -554,8 +618,11 @@ class PermutationEngine:
 
         def write(nulls, outs, done, take):
             for b, out in zip(self.buckets, outs):
-                arr = np.asarray(out[:take], dtype=np.float64)
-                nulls[done: done + take, b.module_pos] = arr
+                # transfer the whole chunk output and slice on the HOST: a
+                # device-side `out[:take]` is an eager op, and eager dispatch
+                # on tunneled backends costs ~1s per op (the arrays are tiny)
+                arr = np.asarray(out, dtype=np.float64)
+                nulls[done: done + take, b.module_pos] = arr[:take]
 
         return run_checkpointed_chunks(
             self, n_perm, key, self._chunk_fn(),

@@ -124,31 +124,40 @@ class MultiTestEngine:
             return self._chunk_cached
         cfg = self.config
         base = self._base
-        pool = base._pool_dev
-        tc, tn, td = self._tc, self._tn, self._td
         uniform = self._td is None or self._uniform_samples
+        td_absent = self._td is None
+        T = self.T
+        caps_slices = [(b.cap, tuple(b.slices)) for b in base.buckets]
         over_mod = self._stats_stack(cfg.summary_method)
         over_perm = jax.vmap(over_mod, in_axes=(None, 0, None, None, None))
 
-        def chunk(keys):
+        # device operands are jit ARGUMENTS, not closure captures — captured
+        # device arrays become compile-time constants (T·n² baked into the
+        # executable at multi-cohort scale)
+        chunk_args = (
+            base._pool_dev, self._tc, self._tn, self._td,
+            [b.disc for b in base.buckets],
+        )
+
+        def chunk(keys, pool, tc, tn, td, discs):
             perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)
             outs = []
-            for b in base.buckets:
+            for (cap, slices), disc in zip(caps_slices, discs):
                 cols = []
-                for off, size in b.slices:
+                for off, size in slices:
                     idx = perm[:, off: off + size]
-                    cols.append(jnp.pad(idx, ((0, 0), (0, b.cap - size))))
+                    cols.append(jnp.pad(idx, ((0, 0), (0, cap - size))))
                 idx_b = jnp.stack(cols, axis=1)  # (C, K, cap)
                 if uniform:
                     over_test = jax.vmap(
                         over_perm,
-                        in_axes=(None, None, 0, 0, None if td is None else 0),
+                        in_axes=(None, None, 0, 0, None if td_absent else 0),
                     )
-                    outs.append(over_test(b.disc, idx_b, tc, tn, td))  # (T,C,K,7)
+                    outs.append(over_test(disc, idx_b, tc, tn, td))  # (T,C,K,7)
                 else:
                     outs.append(jnp.stack([
-                        over_perm(b.disc, idx_b, tc[t], tn[t], td[t])
-                        for t in range(self.T)
+                        over_perm(disc, idx_b, tc[t], tn[t], td[t])
+                        for t in range(T)
                     ]))
             return outs
 
@@ -160,9 +169,13 @@ class MultiTestEngine:
                 NamedSharding(self.mesh, P(None, cfg.mesh_axis))
                 for _ in base.buckets
             ]
-            self._chunk_cached = jax.jit(chunk, in_shardings=(ksh,), out_shardings=osh)
+            jitted = jax.jit(chunk, out_shardings=osh)
+            self._chunk_cached = lambda keys: jitted(
+                jax.device_put(keys, ksh), *chunk_args
+            )
         else:
-            self._chunk_cached = jax.jit(chunk)
+            jitted = jax.jit(chunk)
+            self._chunk_cached = lambda keys: jitted(keys, *chunk_args)
         return self._chunk_cached
 
     def run_null(self, n_perm: int, key=0, progress=None,
@@ -176,10 +189,12 @@ class MultiTestEngine:
         drift)."""
         def write(nulls, outs, done, take):
             for b, outarr in zip(self._base.buckets, outs):
-                # (T, take, K, 7); a single advanced index (module_pos)
-                # keeps its axis position in the assignment target.
-                arr = np.asarray(outarr[:, :take], dtype=np.float64)
-                nulls[:, done: done + take, b.module_pos] = arr
+                # full-chunk transfer, host-side slice (device slicing is an
+                # eager op — ~1s dispatch on tunneled backends); a single
+                # advanced index (module_pos) keeps its axis position in the
+                # assignment target.
+                arr = np.asarray(outarr, dtype=np.float64)
+                nulls[:, done: done + take, b.module_pos] = arr[:, :take]
 
         from .engine import run_checkpointed_chunks
 

@@ -179,23 +179,31 @@ class SparsePermutationEngine:
             out[b.module_pos] = np.asarray(res, dtype=np.float64)
         return out
 
+    def chunk_args(self) -> tuple:
+        """Device operands, passed to the jitted chunk as arguments (not
+        closure captures — captured device arrays become compile-time
+        constants; see :meth:`PermutationEngine.chunk_args`)."""
+        return (
+            self._pool_dev, self._nbr, self._wgt, self._test_data,
+            [b.disc for b in self.buckets],
+        )
+
     def chunk_body(self) -> Callable:
         """Unjitted chunk program; same permutation-draw semantics as the
         dense engine (one pool shuffle per permutation, consecutive module
-        slices — disjoint node sets within a permutation)."""
+        slices — disjoint node sets within a permutation). Signature:
+        ``chunk(keys, *chunk_args)``."""
         cfg = self.config
-        buckets = self.buckets
-        pool = self._pool_dev
-        nbr, wgt, td = self._nbr, self._wgt, self._test_data
+        caps_slices = [(b.cap, tuple(b.slices)) for b in self.buckets]
 
-        def chunk(keys: jax.Array) -> list[jax.Array]:
+        def chunk(keys: jax.Array, pool, nbr, wgt, td, discs) -> list[jax.Array]:
             perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)
             outs = []
-            for b in buckets:
+            for (cap, slices), disc in zip(caps_slices, discs):
                 cols = []
-                for off, size in b.slices:
+                for off, size in slices:
                     idx = perm[:, off: off + size]
-                    idx = jnp.pad(idx, ((0, 0), (0, b.cap - size)))
+                    idx = jnp.pad(idx, ((0, 0), (0, cap - size)))
                     cols.append(idx)
                 idx_b = jnp.stack(cols, axis=1)  # (C, K, cap)
                 inner = jax.vmap(
@@ -207,7 +215,7 @@ class SparsePermutationEngine:
                     in_axes=(0, 0, None, None, None),
                 )
                 over_perms = jax.vmap(inner, in_axes=(None, 0, None, None, None))
-                outs.append(over_perms(b.disc, idx_b, nbr, wgt, td))
+                outs.append(over_perms(disc, idx_b, nbr, wgt, td))
             return outs
 
         return chunk
@@ -215,17 +223,20 @@ class SparsePermutationEngine:
     def _chunk_fn(self) -> Callable:
         if self._chunk_fn_cached is None:
             chunk = self.chunk_body()
+            args = self.chunk_args()
             if self.mesh is not None:
                 ksh = NamedSharding(self.mesh, P(self.config.mesh_axis))
                 osh = [
                     NamedSharding(self.mesh, P(self.config.mesh_axis))
                     for _ in self.buckets
                 ]
-                self._chunk_fn_cached = jax.jit(
-                    chunk, in_shardings=(ksh,), out_shardings=osh
+                jitted = jax.jit(chunk, out_shardings=osh)
+                self._chunk_fn_cached = lambda keys: jitted(
+                    jax.device_put(keys, ksh), *args
                 )
             else:
-                self._chunk_fn_cached = jax.jit(chunk)
+                jitted = jax.jit(chunk)
+                self._chunk_fn_cached = lambda keys: jitted(keys, *args)
         return self._chunk_fn_cached
 
     def run_null(
@@ -243,8 +254,10 @@ class SparsePermutationEngine:
 
         def write(nulls, outs, done, take):
             for b, out in zip(self.buckets, outs):
-                arr = np.asarray(out[:take], dtype=np.float64)
-                nulls[done: done + take, b.module_pos] = arr
+                # full-chunk transfer, host-side slice (device slicing is an
+                # eager op — ~1s dispatch on tunneled backends)
+                arr = np.asarray(out, dtype=np.float64)
+                nulls[done: done + take, b.module_pos] = arr[:take]
 
         return run_checkpointed_chunks(
             self, n_perm, key, self._chunk_fn(),

@@ -34,6 +34,15 @@ class EngineConfig:
         axis only) or 'row' (n×n matrices row-sharded over the mesh's row
         axis with psum-assembled module gathers — SURVEY.md §5 long-context
         analogue, Config D scale).
+    gather_mode : 'direct' (2D advanced-index gather — what XLA:CPU runs
+        fastest), 'mxu' (sorted row gather + one-hot column select + unsort
+        matmuls, :func:`netrep_tpu.ops.stats.gather_and_stats_mxu` — ~20×
+        faster on TPU where per-element gathers crawl), or 'auto' (mxu on
+        TPU, direct elsewhere). Both modes produce identical statistics.
+    perm_batch : permutations evaluated concurrently inside one chunk
+        dispatch on the mxu path (``lax.map`` batch size). Bounds the
+        (batch, Σ K_b·cap_b, n) row-gather working set in HBM; the chunk
+        itself stays one dispatch, so host round-trips are unaffected.
     """
 
     chunk_size: int = 128
@@ -43,6 +52,20 @@ class EngineConfig:
     dtype: str = "float32"
     mesh_axis: str = "perm"
     matrix_sharding: str = "replicated"
+    gather_mode: str = "auto"
+    perm_batch: int = 2
+
+    def resolved_gather_mode(self, platform: str) -> str:
+        if self.gather_mode == "auto":
+            # accelerators (tpu / the axon tunnel backend) get the
+            # sorted-rows+MXU path; XLA:CPU's native gather is already fast
+            return "direct" if platform == "cpu" else "mxu"
+        if self.gather_mode not in ("direct", "mxu"):
+            raise ValueError(
+                f"gather_mode must be 'auto', 'direct', or 'mxu', got "
+                f"{self.gather_mode!r}"
+            )
+        return self.gather_mode
 
     def rounded_cap(self, size: int) -> int:
         cap = self.bucket_rounding

@@ -170,3 +170,26 @@ def test_mesh_sharded_null_matches(setup):
     eng_sh = _engine(setup, mesh=mesh)
     got, _ = eng_sh.run_null(16, key=5)
     np.testing.assert_allclose(ref, got, atol=1e-5)
+
+
+@pytest.mark.parametrize("with_data", [True, False])
+def test_mxu_gather_mode_matches_direct(setup, with_data):
+    """The sorted-rows+MXU gather path (gather_mode='mxu',
+    ops.stats.gather_and_stats_mxu) must produce identical statistics to
+    the direct 2D gather — the one-hot/permutation matmuls are exact
+    selections in float32."""
+    d, t, modules, pool = setup
+
+    def run(mode):
+        eng = PermutationEngine(
+            d["correlation"], d["network"], d["data"] if with_data else None,
+            t["correlation"], t["network"], t["data"] if with_data else None,
+            modules, pool,
+            config=EngineConfig(chunk_size=16, gather_mode=mode, perm_batch=4),
+        )
+        return eng.observed(), eng.run_null(32, key=7)[0]
+
+    obs_d, null_d = run("direct")
+    obs_m, null_m = run("mxu")
+    np.testing.assert_allclose(obs_m, obs_d, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(null_m, null_d, rtol=1e-4, atol=1e-5)
