@@ -1,0 +1,264 @@
+"""Micro-benchmark of submatrix-gather strategies for the permutation hot
+loop (SURVEY.md §7 "Gather bandwidth"; VERDICT round-1 item 1).
+
+The per-permutation unit of work is: gather ``M[idx, idx]`` (m of n rows and
+columns) out of the three n×n / n×s test matrices, for each of ~50 modules,
+then run the statistic kernels. This script times candidate formulations of
+that gather on the real chip at north-star shapes (n=20k, 50 modules, sizes
+log-uniform [30, 200]) so the engine's default is chosen from evidence, not
+guesswork.
+
+Strategies:
+  primitives  raw row-gather / transpose / one-hot costs
+  direct      M[idx[:,None], idx[None,:]]               (per-element gather)
+  mxu         sorted row gather + one-hot column matmul (round-1 default)
+  transpose   sorted row gather -> transpose -> sorted row gather
+  twostage    shared per-perm prefix: S = M[sel,:][:,sel] (T,T) once, then
+              per-module gathers at T scale (direct / mxu / transpose)
+
+Usage: python benchmarks/microbench_gather.py [--genes N] [--chunk C] [--reps R]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def ensure_backend():
+    try:
+        return jax.devices()
+    except RuntimeError:
+        jax.config.update("jax_platforms", "")
+        return jax.devices()
+
+
+def bench(fn, *args, reps=3, warmup=1):
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def make_problem(n, n_modules, seed=1):
+    rng = np.random.default_rng(seed)
+    sizes = np.exp(rng.uniform(np.log(30), np.log(200), size=n_modules)).astype(int)
+    key = jax.random.key(0)
+    M = jax.random.normal(key, (n, n), dtype=jnp.float32)
+    M = (M + M.T) / 2
+    return M, sizes
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--genes", type=int, default=20_000)
+    ap.add_argument("--modules", type=int, default=50)
+    ap.add_argument("--chunk", type=int, default=32)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--only", default="", help="substring filter on section names")
+    args = ap.parse_args()
+    ensure_backend()
+    print(f"device={jax.devices()[0]}")
+
+    n, C = args.genes, args.chunk
+    M, sizes = make_problem(n, args.modules)
+    T = int(sizes.sum())
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    sum_m2 = int((sizes.astype(np.int64) ** 2).sum())
+    print(f"n={n} modules={len(sizes)} T={T} sum_m2={sum_m2} chunk={C}")
+
+    # bucket sizes to powers of two (same rule as EngineConfig.rounded_cap)
+    def cap_of(s):
+        c = 8
+        while c < s:
+            c *= 2
+        return c
+
+    caps = sorted({cap_of(s) for s in sizes})
+    by_cap = {c: [k for k, s in enumerate(sizes) if cap_of(s) == c] for c in caps}
+    print("buckets:", {c: len(v) for c, v in by_cap.items()})
+
+    pool = jnp.arange(n, dtype=jnp.int32)
+    keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.key(7), i))(
+        jnp.arange(C, dtype=jnp.uint32)
+    )
+
+    def run(name, thunk):
+        if args.only and args.only not in name:
+            return
+        try:
+            thunk()
+        except Exception as e:  # noqa: BLE001
+            print(f"{name}: FAILED {type(e).__name__}: {e}")
+
+    # ---------------- primitives -------------------------------------------
+    idx_T_sorted = jnp.sort(jax.random.choice(jax.random.key(1), n, (T,), replace=False))
+    idx_T_rand = jax.random.permutation(jax.random.key(2), idx_T_sorted)
+
+    def prims():
+        t = bench(jax.jit(lambda: jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)), reps=args.reps)
+        print(f"prim perm_draw x{C}:              {t*1e3:8.2f} ms  ({t/C*1e3:.3f} ms/perm)")
+
+        rowg = jax.jit(lambda Mx, idx: jnp.take(Mx, idx, axis=0))
+        t = bench(rowg, M, idx_T_sorted, reps=args.reps)
+        print(f"prim row_gather (T,n) sorted:     {t*1e3:8.2f} ms  ({T*n*4/t/1e9:.0f} GB/s)")
+        t = bench(rowg, M, idx_T_rand, reps=args.reps)
+        print(f"prim row_gather (T,n) random:     {t*1e3:8.2f} ms  ({T*n*4/t/1e9:.0f} GB/s)")
+
+        tr = jax.jit(lambda Mx, idx: jnp.take(Mx, idx, axis=0).T)
+        t = bench(tr, M, idx_T_sorted, reps=args.reps)
+        print(f"prim gather+transpose (n,T):      {t*1e3:8.2f} ms")
+
+        twog = jax.jit(lambda Mx, idx: jnp.take(jnp.take(Mx, idx, axis=0).T, idx, axis=0))
+        t = bench(twog, M, idx_T_sorted, reps=args.reps)
+        print(f"prim gather.T gather (T,T):       {t*1e3:8.2f} ms")
+
+        colsel = jax.jit(
+            lambda Mx, idx: jnp.matmul(
+                jnp.take(Mx, idx, axis=0),
+                (jax.lax.broadcasted_iota(jnp.int32, (n, T), 0) == idx[None, :]).astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+        )
+        t = bench(colsel, M, idx_T_sorted, reps=args.reps)
+        print(f"prim gather+onehot (T,T):         {t*1e3:8.2f} ms  ({2*T*T*n/t/1e12:.1f} TFLOP/s)")
+
+        direct2d = jax.jit(lambda Mx, idx: Mx[idx[:, None], idx[None, :]])
+        t = bench(direct2d, M, idx_T_sorted, reps=args.reps)
+        print(f"prim direct 2D gather (T,T):      {t*1e3:8.2f} ms  ({T*T/t/1e6:.0f} Melem/s)")
+
+    run("prim", prims)
+
+    # ---------------- full-chunk strategies --------------------------------
+    # Each strategy computes, for every perm in the chunk and every module,
+    # the (cap, cap) submatrix, and reduces it (sum) so XLA can't DCE the
+    # gather but the comparison isn't polluted by the stats kernels.
+
+    def draw(key):
+        return jax.random.permutation(key, pool)
+
+    def module_idx(perm, cap, ks):
+        """(K, cap) padded per-module indices + (K, cap) masks for bucket."""
+        cols, masks = [], []
+        for k in ks:
+            off, size = int(offsets[k]), int(sizes[k])
+            idx = perm[off : off + size]
+            cols.append(jnp.pad(idx, (0, cap - size), constant_values=n))
+            masks.append((jnp.arange(cap) < size).astype(jnp.float32))
+        return jnp.stack(cols), jnp.stack(masks)
+
+    def sub_direct(Mx, idx):           # (cap,) -> (cap, cap)
+        i = jnp.minimum(idx, n - 1)
+        return Mx[i[:, None], i[None, :]]
+
+    def sub_mxu(Mx, idx):
+        order = jnp.argsort(idx)
+        srt = jnp.take(idx, order)
+        rows = jnp.take(Mx, srt, axis=0, mode="clip")
+        cap = idx.shape[0]
+        onehot = (
+            jax.lax.broadcasted_iota(jnp.int32, (Mx.shape[0], cap), 0) == srt[None, :]
+        ).astype(Mx.dtype)
+        sub = jnp.matmul(rows, onehot, preferred_element_type=jnp.float32)
+        pos = jax.lax.broadcasted_iota(jnp.int32, (cap, cap), 1)
+        P = (pos == order[:, None]).astype(Mx.dtype)
+        return P.T @ sub @ P
+
+    def sub_transpose(Mx, idx):
+        order = jnp.argsort(idx)
+        srt = jnp.take(idx, order)
+        rows = jnp.take(Mx, srt, axis=0, mode="clip")          # (cap, n)
+        sub = jnp.take(rows.T, srt, axis=0, mode="clip")        # (cap, cap)
+        cap = idx.shape[0]
+        pos = jax.lax.broadcasted_iota(jnp.int32, (cap, cap), 1)
+        P = (pos == order[:, None]).astype(Mx.dtype)
+        return P.T @ sub.T @ P
+
+    def chunk_of(sub_fn, batch):
+        def chunk(ks, Mx):
+            def per_perm(key):
+                perm = draw(key)
+                acc = 0.0
+                for cap, ks_ in by_cap.items():
+                    idx_b, mask = module_idx(perm, cap, ks_)
+                    subs = jax.vmap(partial(sub_fn, Mx))(idx_b)
+                    pair = mask[:, :, None] * mask[:, None, :]
+                    acc += jnp.sum(subs * pair)
+                return acc
+
+            return jax.lax.map(per_perm, ks, batch_size=batch)
+
+        jitted = jax.jit(chunk)
+        return lambda ks: jitted(ks, M)
+
+    for name, fn in [("direct", sub_direct), ("mxu", sub_mxu), ("transpose", sub_transpose)]:
+        for batch in ([2, 8] if name != "direct" else [2]):
+            def go(name=name, fn=fn, batch=batch):
+                t = bench(chunk_of(fn, batch), keys, reps=args.reps)
+                print(f"chunk {name:9s} batch={batch}:         {t*1e3:8.2f} ms  ({t/C*1e3:6.3f} ms/perm)")
+            run(f"chunk-{name}-b{batch}", go)
+
+    # two-stage: shared (T,T) prefix submatrix, then per-module at T scale
+    def chunk_twostage(inner, batch):
+        def chunk(ks, Mx):
+            return jax.lax.map(partial(per_perm, Mx), ks, batch_size=batch)
+
+        def per_perm(Mx, key):
+            perm = draw(key)
+            sel = perm[:T]
+            srt = jnp.sort(sel)
+            rank = jnp.searchsorted(srt, sel).astype(jnp.int32)  # (T,)
+            R = jnp.take(Mx, srt, axis=0)                # (T, n) sorted rows
+            S = jnp.take(R.T, srt, axis=0)               # (T, T) sorted basis
+            acc = 0.0
+            for cap, ks in by_cap.items():
+                cols, masks = [], []
+                for k in ks:
+                    off, size = int(offsets[k]), int(sizes[k])
+                    cols.append(jnp.pad(rank[off : off + size], (0, cap - size), constant_values=T))
+                    masks.append((jnp.arange(cap) < size).astype(jnp.float32))
+                idx_b, mask = jnp.stack(cols), jnp.stack(masks)
+                subs = jax.vmap(partial(inner, S))(idx_b)
+                pair = mask[:, :, None] * mask[:, None, :]
+                acc += jnp.sum(subs * pair)
+            return acc
+
+        jitted = jax.jit(chunk)
+        return lambda ks: jitted(ks, M)
+
+    def sub_direct_T(S, idx):
+        i = jnp.minimum(idx, T - 1)
+        return S[i[:, None], i[None, :]]
+
+    def sub_mxu_T(S, idx):
+        order = jnp.argsort(idx)
+        srt = jnp.take(idx, order)
+        rows = jnp.take(S, srt, axis=0, mode="clip")
+        cap = idx.shape[0]
+        onehot = (
+            jax.lax.broadcasted_iota(jnp.int32, (T, cap), 0) == srt[None, :]
+        ).astype(S.dtype)
+        sub = jnp.matmul(rows, onehot, preferred_element_type=jnp.float32)
+        pos = jax.lax.broadcasted_iota(jnp.int32, (cap, cap), 1)
+        P = (pos == order[:, None]).astype(S.dtype)
+        return P.T @ sub @ P
+
+    for name, inner in [("2stage+direct", sub_direct_T), ("2stage+mxu", sub_mxu_T)]:
+        for batch in [2, 8]:
+            def go(name=name, inner=inner, batch=batch):
+                t = bench(chunk_twostage(inner, batch), keys, reps=args.reps)
+                print(f"chunk {name:13s} batch={batch}:     {t*1e3:8.2f} ms  ({t/C*1e3:6.3f} ms/perm)")
+            run(f"2stage-{name.split('+')[1]}-b{batch}", go)
+
+
+if __name__ == "__main__":
+    main()

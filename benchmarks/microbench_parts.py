@@ -1,0 +1,138 @@
+"""Decompose the mxu-gather chunk cost into parts, and measure precision/
+dtype variants of the one-hot selection matmul (VERDICT round-1 item 1).
+
+Parts per (perm, module): argsort -> row gather -> one-hot colsel matmul ->
+unsort matmuls. Plus: perm draw, data slice, standardize+power-iteration
+stats. Variants: f32 default precision, f32 HIGHEST, bf16, and a hi+lo
+two-pass bf16 "exact-ish" selection.
+
+Usage: python benchmarks/microbench_parts.py [--cap C] [--K K] [--batch B]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def ensure_backend():
+    try:
+        return jax.devices()
+    except RuntimeError:
+        jax.config.update("jax_platforms", "")
+        return jax.devices()
+
+
+def bench(fn, *args, reps=5, warmup=2):
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--genes", type=int, default=20_000)
+    ap.add_argument("--cap", type=int, default=128)
+    ap.add_argument("--K", type=int, default=21)
+    ap.add_argument("--batch", type=int, default=8, help="perm batch")
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+    ensure_backend()
+    print(f"device={jax.devices()[0]} matmul_default={jax.config.jax_default_matmul_precision}")
+
+    n, cap, K, B = args.genes, args.cap, args.K, args.batch
+    FL = 2 * B * K * cap * cap * n
+    print(f"n={n} cap={cap} K={K} batch={B}  colsel GFLOP={FL/1e9:.1f}")
+
+    key = jax.random.key(0)
+    M = jax.random.normal(key, (n, n), dtype=jnp.float32)
+    idx = jax.random.randint(jax.random.key(1), (B, K, cap), 0, n, dtype=jnp.int32)
+    idx = jnp.sort(idx, axis=-1)
+
+    # --- parts ---------------------------------------------------------------
+    rowg = jax.jit(lambda Mx, ix: jnp.take(Mx, ix, axis=0))
+    t = bench(rowg, M, idx, reps=args.reps)
+    nbytes = B * K * cap * n * 4
+    print(f"row gather (B,K,cap,n):      {t*1e3:8.2f} ms  ({nbytes/t/1e9:6.1f} GB/s)")
+
+    rows = rowg(M, idx)  # (B, K, cap, n)
+
+    def onehot_of(ix, dtype):
+        return (
+            jax.lax.broadcasted_iota(jnp.int32, (B, K, n, cap), 2) == ix[:, :, None, :]
+        ).astype(dtype)
+
+    oh_build = jax.jit(lambda ix: onehot_of(ix, jnp.float32))
+    t = bench(oh_build, idx, reps=args.reps)
+    print(f"onehot materialize:          {t*1e3:8.2f} ms  ({B*K*n*cap*4/t/1e9:6.1f} GB/s)")
+
+    def colsel(rws, ix, prec):
+        return jnp.matmul(rws, onehot_of(ix, rws.dtype),
+                          preferred_element_type=jnp.float32, precision=prec)
+
+    for prec in ["default", "highest"]:
+        f = jax.jit(lambda r, ix, p=prec: colsel(r, ix, p))
+        t = bench(f, rows, idx, reps=args.reps)
+        print(f"colsel matmul f32 {prec:8s}:  {t*1e3:8.2f} ms  ({FL/t/1e12:6.1f} TFLOP/s)")
+
+    rows16 = rows.astype(jnp.bfloat16)
+    f = jax.jit(lambda r, ix: colsel(r, ix, "default"))
+    t = bench(f, rows16, idx, reps=args.reps)
+    print(f"colsel matmul bf16:          {t*1e3:8.2f} ms  ({FL/t/1e12:6.1f} TFLOP/s)")
+
+    # hi/lo two-pass exact selection: x = hi + lo with hi = bf16(x)
+    def colsel_hilo(rws, ix):
+        hi = rws.astype(jnp.bfloat16)
+        lo = (rws - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        oh = onehot_of(ix, jnp.bfloat16)
+        s = jnp.matmul(hi, oh, preferred_element_type=jnp.float32)
+        s += jnp.matmul(lo, oh, preferred_element_type=jnp.float32)
+        return s
+
+    f = jax.jit(colsel_hilo)
+    t = bench(f, rows, idx, reps=args.reps)
+    print(f"colsel matmul hi/lo 2-pass:  {t*1e3:8.2f} ms  ({2*FL/t/1e12:6.1f} TFLOP/s eq)")
+
+    # fused gather+colsel (what the engine actually runs)
+    def fused(Mx, ix, prec):
+        rws = jnp.take(Mx, ix, axis=0)
+        return colsel(rws, ix, prec)
+
+    for prec in ["default", "highest"]:
+        f = jax.jit(lambda Mx, ix, p=prec: fused(Mx, ix, p))
+        t = bench(f, M, idx, reps=args.reps)
+        print(f"fused gather+colsel {prec:8s}: {t*1e3:6.2f} ms  ({FL/t/1e12:6.1f} TFLOP/s)")
+
+    M16 = M.astype(jnp.bfloat16)
+    f = jax.jit(lambda Mx, ix: fused(Mx, ix, "default"))
+    t = bench(f, M16, idx, reps=args.reps)
+    print(f"fused gather+colsel bf16:    {t*1e3:8.2f} ms  ({FL/t/1e12:6.1f} TFLOP/s)")
+
+    # correctness check of selection variants vs true gather
+    sub_true = np.asarray(M)[np.asarray(idx)[0, 0][:, None], np.asarray(idx)[0, 0][None, :]]
+
+    def unsorted_err(fn, Mx):
+        s = np.asarray(fn(Mx, idx))[0, 0]
+        # colsel output is rows[:, selected] in sorted order == true since idx sorted
+        return np.abs(s - sub_true).max() / np.abs(sub_true).max()
+
+    f_def = jax.jit(lambda Mx, ix: fused(Mx, ix, "default"))
+    f_hi = jax.jit(lambda Mx, ix: fused(Mx, ix, "highest"))
+    f_hl = jax.jit(lambda Mx, ix: colsel_hilo(jnp.take(Mx, ix, axis=0), ix))
+    print(f"rel err f32-default: {unsorted_err(lambda Mx, ix=idx: f_def(Mx, ix), M):.2e}")
+    print(f"rel err f32-highest: {unsorted_err(lambda Mx, ix=idx: f_hi(Mx, ix), M):.2e}")
+    print(f"rel err hi/lo:       {unsorted_err(lambda Mx, ix=idx: f_hl(Mx, ix), M):.2e}")
+    print(f"rel err bf16 mat:    {unsorted_err(lambda Mx, ix=idx: f_def(Mx, ix), M16):.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Capture a jax.profiler trace of the real bench-engine chunk and print an
+op-level time breakdown (VERDICT round-1 items 1-2: "profile first").
+
+Runs the north-star engine (bench.py shapes) for a few chunks under
+``jax.profiler.trace``, then parses the xplane with
+``jax.profiler.ProfileData`` and aggregates device-op durations by fusion
+name so the hot spots are visible without TensorBoard.
+
+Usage: python benchmarks/profile_chunk.py [--genes N] [--chunk C] [--top K]
+       [--dtype float32|bfloat16] [--precision default|highest]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import os
+import re
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--genes", type=int, default=20_000)
+    ap.add_argument("--modules", type=int, default=50)
+    ap.add_argument("--chunk", type=int, default=256)
+    ap.add_argument("--samples", type=int, default=128)
+    ap.add_argument("--nchunks", type=int, default=2)
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--perm-batch", type=int, default=2)
+    ap.add_argument("--outdir", default="")
+    args = ap.parse_args()
+
+    import jax
+
+    from bench import build_problem, ensure_backend
+
+    ensure_backend()
+    from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+    from netrep_tpu.utils.config import EngineConfig
+
+    (d_data, d_corr, d_net), (t_data, t_corr, t_net) = build_problem(
+        args.genes, args.modules, args.samples
+    )
+    rng = np.random.default_rng(1)
+    sizes = np.exp(rng.uniform(np.log(30), np.log(200), size=args.modules)).astype(int)
+    specs, pos = [], 0
+    for k, sz in enumerate(sizes):
+        idx = np.arange(pos, pos + sz, dtype=np.int32)
+        specs.append(ModuleSpec(str(k + 1), idx, idx))
+        pos += sz
+    pool = np.arange(args.genes, dtype=np.int32)
+
+    cfg = EngineConfig(chunk_size=args.chunk, summary_method="power",
+                       power_iters=40, dtype=args.dtype,
+                       perm_batch=args.perm_batch)
+    engine = PermutationEngine(
+        d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool, config=cfg
+    )
+
+    # warm up (compile) outside the trace
+    _ = engine.run_null(cfg.chunk_size, key=99)
+
+    outdir = args.outdir or tempfile.mkdtemp(prefix="netrep_trace_")
+    n_perm = args.nchunks * cfg.chunk_size
+    with jax.profiler.trace(outdir):
+        t0 = time.perf_counter()
+        _nulls, done = engine.run_null(n_perm, key=0)
+        elapsed = time.perf_counter() - t0
+    print(f"traced {done} perms in {elapsed:.3f}s -> {done/elapsed:.1f} perms/s "
+          f"({elapsed/done*1e3:.3f} ms/perm)  trace={outdir}")
+
+    paths = glob.glob(os.path.join(outdir, "**", "*.xplane.pb"), recursive=True)
+    if not paths:
+        print("no xplane captured", file=sys.stderr)
+        return 1
+    pd = jax.profiler.ProfileData.from_serialized_xspace(
+        open(sorted(paths)[-1], "rb").read()
+    )
+    per_op = collections.Counter()
+    total = 0.0
+    for plane in pd.planes:
+        if "TPU" not in plane.name and "tpu" not in plane.name.lower():
+            continue
+        for line in plane.lines:
+            for ev in line.events:
+                dur = ev.duration_ns
+                name = ev.name
+                # strip fusion suffix digits for aggregation
+                base = re.sub(r"[.\d]+$", "", name)
+                per_op[base] += dur
+                total += dur
+    print(f"\ntotal device-op time: {total/1e6:.1f} ms over {args.nchunks} chunks "
+          f"({total/1e6/n_perm:.3f} ms/perm)")
+    print(f"{'op (aggregated)':60s} {'ms':>10s} {'%':>6s}")
+    for name, dur in per_op.most_common(args.top):
+        print(f"{name[:60]:60s} {dur/1e6:10.2f} {dur/total*100:6.1f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -341,11 +341,17 @@ def gather_and_stats_mxu(
     summary_method: str = "power",
 ) -> jnp.ndarray:
     """MXU/DMA-friendly variant of :func:`gather_and_stats` (see
-    :func:`gather_submatrix_mxu`): numerically identical statistics (the
-    one-hot/permutation matmuls are exact in float32), ~20× faster on TPU
-    at genome scale. ``test_dataT`` is the data matrix transposed once at
-    engine init so the per-instance data slice is a contiguous row gather
-    instead of a strided column gather."""
+    :func:`gather_submatrix_mxu`), ~10-20x faster on TPU at genome scale,
+    where the per-element gather emitter crawls. Value fidelity: the one-hot
+    and permutation matmuls are exact selections in exact arithmetic, but
+    XLA's default-precision f32 matmul on TPU truncates operands to
+    bfloat16, so gathered VALUES carry up to ~4e-3 relative rounding there
+    (attenuated ~1/m in the statistics, which average over >= m^2 entries —
+    negligible against permutation-null Monte-Carlo noise; see BASELINE.md
+    §precision). On backends with true f32 matmuls (CPU) the selection is
+    exact. ``test_dataT`` is the data matrix transposed once at engine init
+    so the per-instance data slice is a contiguous row gather instead of a
+    strided column gather."""
     n = test_corr.shape[-1]
     m = idx.shape[-1]
     w = disc.mask
@@ -373,12 +379,25 @@ def gather_and_stats_mxu(
     )
 
 
+def gather_zdata(
+    test_dataT: jnp.ndarray,   # (n, n_samples) TRANSPOSED data
+    idx: jnp.ndarray,          # (..., m) int32 node indices (padded)
+    mask: jnp.ndarray,         # (..., m) validity mask
+) -> jnp.ndarray:
+    """Slice per-module data columns out of the TRANSPOSED data matrix and
+    standardize: the single place the (n, n_samples) layout contract lives
+    (row gather + swapaxes; see :func:`gather_and_stats` for why the
+    transposed layout). Supports leading batch axes on ``idx``."""
+    sub_d = jnp.take(test_dataT, idx, axis=0)          # (..., m, n_samples)
+    return standardize_masked(jnp.swapaxes(sub_d, -1, -2), mask)
+
+
 def gather_and_stats(
     disc: DiscProps,
     idx: jnp.ndarray,          # (..., m) int32 test-node indices (padded)
     test_corr: jnp.ndarray,    # (n, n)
     test_net: jnp.ndarray,     # (n, n)
-    test_data: jnp.ndarray | None,  # (n_samples, n)
+    test_dataT: jnp.ndarray | None,  # (n, n_samples) TRANSPOSED data
     n_iter: int = 60,
     summary_method: str = "power",
 ) -> jnp.ndarray:
@@ -388,14 +407,20 @@ def gather_and_stats(
     computation. ``idx`` is a single module's ``(m,)`` index vector — batching
     over permutations/modules is done by ``vmap`` of this function. ``idx``
     may carry arbitrary in-range values at padded positions (the mask zeroes
-    their influence)."""
+    their influence).
+
+    The 2D advanced-index gather is exact (no matmul in the value path) and,
+    measured on TPU v5e in the engine's batched ``(batch, K, m)`` index
+    layout, runs at 50-120 Gelem/s — the whole per-permutation submatrix
+    extraction (~1M useful elements at north-star shapes) costs ~20 µs.
+    ``test_dataT`` is the data matrix transposed once at engine init: the
+    per-module data slice is then a row gather; gathering columns of the
+    (n_samples, n) layout instead lowers to strided per-element loads on TPU
+    (measured ~10x whole-chunk slowdown — the round-1 ``direct`` mode's
+    mistake)."""
     sub_corr = test_corr[idx[:, None], idx[None, :]]
     sub_net = test_net[idx[:, None], idx[None, :]]
-    if test_data is not None:
-        sub_data = jnp.take(test_data, idx, axis=-1)
-        zdata = standardize_masked(sub_data, disc.mask)
-    else:
-        zdata = None
+    zdata = gather_zdata(test_dataT, idx, disc.mask) if test_dataT is not None else None
     return module_stats_masked(
         disc, sub_corr, sub_net, zdata, n_iter=n_iter, summary_method=summary_method
     )

@@ -107,27 +107,50 @@ def run_checkpointed_chunks(
     # clamping so the tail doesn't burn up to chunk-1 wasted permutations.
     dynamic = getattr(base, "dynamic_chunk", False)
     nulls = nulls_init if nulls_init is not None else np.full(alloc_shape, np.nan)
-    done = start_perm
-    last_saved = done
+    # Double-buffered loop: dispatch chunk k+1 (async on accelerators) BEFORE
+    # the synchronous host transfer of chunk k in `write`, so device compute
+    # overlaps the device→host copy. On the tunneled TPU backend the serial
+    # transfer gap was ~25% of wall-clock (round-2 profile); on synchronous
+    # backends (native C++) the order change is a no-op.
+    dispatched = start_perm
+    completed = start_perm
+    last_saved = completed
+    pending: tuple | None = None  # (outs, at, take)
     try:
-        while done < n_perm:
-            take = min(C, n_perm - done)
-            keys = base.perm_keys(key, done, take if dynamic else C)
-            outs = fn(keys)
-            write(nulls, outs, done, take)
-            done += take
-            if progress is not None:
-                progress(done, n_perm)
-            if save is not None and done - last_saved >= checkpoint_every:
-                save(nulls, done)
-                last_saved = done
+        while dispatched < n_perm or pending is not None:
+            nxt = None
+            if dispatched < n_perm:
+                take = min(C, n_perm - dispatched)
+                keys = base.perm_keys(key, dispatched, take if dynamic else C)
+                nxt = (fn(keys), dispatched, take)
+                dispatched += take
+            if pending is not None:
+                outs, at, take_p = pending
+                write(nulls, outs, at, take_p)
+                completed = at + take_p
+                if progress is not None:
+                    progress(completed, n_perm)
+                if save is not None and completed - last_saved >= checkpoint_every:
+                    save(nulls, completed)
+                    last_saved = completed
+            pending = nxt
     except KeyboardInterrupt:
-        # the reference's clean Ctrl-C path (SURVEY.md §5): return the
-        # partial null; callers read `done` and keep completed work
-        pass
-    if save is not None and done > last_saved:
-        save(nulls, done)
-    return nulls, done
+        # the reference's clean Ctrl-C path (SURVEY.md §5): flush the
+        # pending chunk (its compute is finished on synchronous backends and
+        # already dispatched on async ones — write blocks only until the
+        # device drains), then return the partial null; callers read the
+        # completed count and keep finished work. A second Ctrl-C during the
+        # flush abandons the pending chunk instead.
+        if pending is not None:
+            try:
+                outs, at, take_p = pending
+                write(nulls, outs, at, take_p)
+                completed = at + take_p
+            except KeyboardInterrupt:
+                pass
+    if save is not None and completed > last_saved:
+        save(nulls, completed)
+    return nulls, completed
 
 
 @partial(jax.jit, static_argnums=(2,))
@@ -254,21 +277,20 @@ class PermutationEngine:
         else:
             self._test_corr = jnp.asarray(test_corr, dtype)
             self._test_net = jnp.asarray(test_net, dtype)
-        self._test_data = (
-            jnp.asarray(test_data, dtype)
-            if (self.has_data and test_data is not None)
-            else None
-        )
-        # sorted-rows+MXU gather path (see ops.stats.gather_and_stats_mxu):
-        # resolved against the backend the matrices actually live on; the
-        # data matrix is transposed once so data slices are row gathers
         self.gather_mode = (
             "direct" if self.row_sharded
             else config.resolved_gather_mode(jax.default_backend())
         )
+        # The data matrix is transposed ONCE at init and ONLY the transposed
+        # copy is kept on device: every mode then slices per-module data as a
+        # row gather of (n, n_samples). Gathering columns of the
+        # (n_samples, n) layout lowers to strided per-element loads on TPU
+        # (measured ~10x whole-chunk slowdown in round 1's direct mode), and
+        # keeping the untransposed copy too would double the data matrix's
+        # HBM footprint at Config D scale.
         self._test_dataT = (
-            jnp.swapaxes(self._test_data, -1, -2)
-            if (self._test_data is not None and self.gather_mode == "mxu")
+            jnp.asarray(np.asarray(test_data).T, dtype)
+            if (self.has_data and test_data is not None)
             else None
         )
 
@@ -378,7 +400,7 @@ class PermutationEngine:
         (:func:`netrep_tpu.utils.checkpoint.content_digest`): test-side
         device matrices plus the bucketed discovery properties, so a
         completed checkpoint is never silently reused against changed data."""
-        arrays = [self._test_corr, self._test_net, self._test_data]
+        arrays = [self._test_corr, self._test_net, self._test_dataT]
         for b in self.buckets:
             arrays.extend(
                 f for f in b.disc if f is not None and hasattr(f, "reshape")
@@ -416,12 +438,12 @@ class PermutationEngine:
             if self.row_sharded:
                 gather_rep = self._gather_rep
 
-                def _obs(disc, idx, tc, tn, td):
+                def _obs(disc, idx, tc, tn, tdT):
                     sub_c, sub_n = gather_rep(tc, tn, idx)
-                    zd = None
-                    if td is not None:
-                        sub_d = jax.vmap(lambda ix: jnp.take(td, ix, axis=-1))(idx)
-                        zd = jstats.standardize_masked(sub_d, disc.mask)
+                    zd = (
+                        jstats.gather_zdata(tdT, idx, disc.mask)
+                        if tdT is not None else None
+                    )
                     return jstats.module_stats_masked(
                         disc, sub_c, sub_n, zd, summary_method="eigh"
                     )
@@ -440,13 +462,11 @@ class PermutationEngine:
                         in_axes=(0, 0, None, None, None),
                     )
                 )
-        td_obs = (
-            self._test_dataT if self.gather_mode == "mxu" else self._test_data
-        )
         out = np.full((self.n_modules, N_STATS), np.nan)
         for b in self.buckets:
             res = self._observed_fn(
-                b.disc, b.obs_idx, self._test_corr, self._test_net, td_obs
+                b.disc, b.obs_idx, self._test_corr, self._test_net,
+                self._test_dataT,
             )
             out[b.module_pos] = np.asarray(res, dtype=np.float64)
         return out
@@ -465,7 +485,7 @@ class PermutationEngine:
             self._pool_dev,
             self._test_corr,
             self._test_net,
-            self._test_dataT if self.gather_mode == "mxu" else self._test_data,
+            self._test_dataT,
             [b.disc for b in self.buckets],
         )
 
@@ -483,6 +503,9 @@ class PermutationEngine:
         row_sharded = self.row_sharded
         gather_perm = self._gather_perm if row_sharded else None
         gather_mode = self.gather_mode
+        perm_batch = cfg.resolved_perm_batch(
+            gather_mode, jax.default_backend(), self.effective_chunk()
+        )
         kernel = partial(
             jstats.gather_and_stats_mxu if gather_mode == "mxu"
             else jstats.gather_and_stats,
@@ -506,12 +529,10 @@ class PermutationEngine:
                     # matrices; statistics batch over (C, K) by broadcasting
                     # (disc props carry the K axis).
                     sub_c, sub_n = gather_perm(tc, tn, idx_b)
-                    zd = None
-                    if td is not None:
-                        sub_d = jax.vmap(
-                            jax.vmap(lambda ix: jnp.take(td, ix, axis=-1))
-                        )(idx_b)  # (C, K, samples, cap)
-                        zd = jstats.standardize_masked(sub_d, disc.mask)
+                    zd = (
+                        jstats.gather_zdata(td, idx_b, disc.mask)
+                        if td is not None else None
+                    )
                     outs.append(
                         jstats.module_stats_masked(
                             disc, sub_c, sub_n, zd,
@@ -537,7 +558,7 @@ class PermutationEngine:
                     outs_p.append(over_mods(disc, idx_b, tc, tn, td))
                 return outs_p
 
-            return jax.lax.map(per_perm, keys, batch_size=cfg.perm_batch)
+            return jax.lax.map(per_perm, keys, batch_size=perm_batch)
 
         return chunk
 

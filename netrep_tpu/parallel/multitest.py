@@ -72,6 +72,8 @@ class MultiTestEngine:
         self._tn = jnp.asarray(test_nets, dtype)
         # ragged sample counts across datasets are allowed → keep a list and
         # vmap only when uniform, else python-loop the T axis for data.
+        # Data is stored TRANSPOSED — (T, n, samples) — so per-module slices
+        # are row gathers (see ops.stats.gather_and_stats).
         if test_datas is None:
             self._td = None
             self._uniform_samples = True
@@ -79,9 +81,11 @@ class MultiTestEngine:
             shapes = {np.asarray(d).shape for d in test_datas}
             self._uniform_samples = len(shapes) == 1
             if self._uniform_samples:
-                self._td = jnp.asarray(np.stack(test_datas), dtype)
+                self._td = jnp.asarray(
+                    np.stack([np.asarray(d).T for d in test_datas]), dtype
+                )
             else:
-                self._td = [jnp.asarray(d, dtype) for d in test_datas]
+                self._td = [jnp.asarray(np.asarray(d).T, dtype) for d in test_datas]
         self.config = config
         self.mesh = mesh
         self.modules = self._base.modules

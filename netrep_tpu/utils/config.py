@@ -34,15 +34,24 @@ class EngineConfig:
         axis only) or 'row' (n×n matrices row-sharded over the mesh's row
         axis with psum-assembled module gathers — SURVEY.md §5 long-context
         analogue, Config D scale).
-    gather_mode : 'direct' (2D advanced-index gather — what XLA:CPU runs
-        fastest), 'mxu' (sorted row gather + one-hot column select + unsort
-        matmuls, :func:`netrep_tpu.ops.stats.gather_and_stats_mxu` — ~20×
-        faster on TPU where per-element gathers crawl), or 'auto' (mxu on
-        TPU, direct elsewhere). Both modes produce identical statistics.
+    gather_mode : 'direct' (batched 2D advanced-index gather — exact; what
+        XLA:CPU runs fastest; on TPU the per-element gather emitter crawls at
+        ~60 Melem/s, round-2 measured, so it loses by ~10x there), 'mxu'
+        (sorted row gather + one-hot column-select matmuls,
+        :func:`netrep_tpu.ops.stats.gather_and_stats_mxu` — the TPU winner:
+        XLA materializes the gathered row blocks at ~200-300 GB/s and the
+        selection rides the MXU), or 'auto' (mxu on TPU-like accelerators,
+        direct on CPU). Value fidelity on the mxu path: XLA's
+        default-precision f32 matmul truncates operands to bfloat16, so
+        gathered VALUES carry up to ~4e-3 relative rounding on TPU
+        (statistics attenuate this ~1/m; see ``BASELINE.md`` §precision).
     perm_batch : permutations evaluated concurrently inside one chunk
-        dispatch on the mxu path (``lax.map`` batch size). Bounds the
-        (batch, Σ K_b·cap_b, n) row-gather working set in HBM; the chunk
-        itself stays one dispatch, so host round-trips are unaffected.
+        dispatch (``lax.map`` batch size), bounding the per-dispatch working
+        set in HBM; the chunk itself stays one dispatch, so host round-trips
+        are unaffected. None (default) resolves per gather mode: the mxu
+        path's (batch, Σ K_b·cap_b, n) row blocks cap it at 2; the direct
+        path's working set is just the (batch, K, cap, cap) submatrices, so
+        it runs 64 at a time on accelerators and whole-chunk on CPU.
     """
 
     chunk_size: int = 128
@@ -53,7 +62,7 @@ class EngineConfig:
     mesh_axis: str = "perm"
     matrix_sharding: str = "replicated"
     gather_mode: str = "auto"
-    perm_batch: int = 2
+    perm_batch: int | None = None
 
     def resolved_gather_mode(self, platform: str) -> str:
         if self.gather_mode == "auto":
@@ -67,8 +76,24 @@ class EngineConfig:
             )
         return self.gather_mode
 
+    def resolved_perm_batch(self, gather_mode: str, platform: str, chunk: int) -> int:
+        if self.perm_batch is not None:
+            return max(1, min(self.perm_batch, chunk))
+        if gather_mode == "mxu":
+            return min(2, chunk)
+        return chunk if platform == "cpu" else min(64, chunk)
+
     def rounded_cap(self, size: int) -> int:
+        """Bucket capacity for a module of ``size`` nodes: powers of two up
+        to 32, then multiples of 32. The dominant hot-loop cost is the
+        (Σ K_b·cap_b, n) row-block traffic, linear in Σcap — multiple-of-32
+        rounding wastes ≤31 padded rows per module where power-of-two
+        rounding wasted up to 2x (measured ~20% less row traffic at
+        north-star module sizes), while staying sublane-aligned (8) for the
+        row blocks. Per-bucket programs still compile once per cap."""
         cap = self.bucket_rounding
-        while cap < size:
+        while cap < size and cap < 32:
             cap *= 2
-        return cap
+        if size <= cap:
+            return cap
+        return -(-size // 32) * 32

@@ -59,7 +59,7 @@ def test_module_stats_match_oracle(rng, cap_extra):
     idx_p = _pad(idx.astype(np.int32), cap)
     got = jstats.gather_and_stats(
         disc, jnp.asarray(idx_p), jnp.asarray(t_corr, jnp.float32),
-        jnp.asarray(t_net, jnp.float32), jnp.asarray(t_data, jnp.float32),
+        jnp.asarray(t_net, jnp.float32), jnp.asarray(t_data.T, jnp.float32),
         summary_method="eigh",
     )
     np.testing.assert_allclose(np.asarray(got), expected, rtol=0, atol=5e-5)
@@ -144,7 +144,7 @@ def test_vmap_over_permutations(rng):
 
     fn = jax.vmap(lambda ix: jstats.gather_and_stats(
         disc, ix, jnp.asarray(t_corr, jnp.float32), jnp.asarray(t_net, jnp.float32),
-        jnp.asarray(t_data, jnp.float32), summary_method="eigh"))
+        jnp.asarray(t_data.T, jnp.float32), summary_method="eigh"))
     got = np.asarray(fn(jnp.asarray(idx_batch)))
 
     disc_o = oracle.DiscoveryProps(d_corr, d_net, d_data)
