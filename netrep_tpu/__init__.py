@@ -24,6 +24,7 @@ __all__ = [
     "make_example_pair",
     "SparseAdjacency",
     "sparse_module_preservation",
+    "summarize_trace",
 ]
 
 
@@ -53,4 +54,8 @@ def __getattr__(name):
         from .models.sparse_api import sparse_module_preservation
 
         return sparse_module_preservation
+    if name == "summarize_trace":
+        from .utils.profiling import summarize_trace
+
+        return summarize_trace
     raise AttributeError(name)

@@ -23,6 +23,7 @@ import numpy as np
 from ..ops import pvalues as pv
 from ..parallel.engine import ModuleSpec, PermutationEngine
 from ..utils.config import EngineConfig
+from ..utils.profiling import PairTimer, device_trace, resolve_profile_dir
 from . import dataset as ds
 from .results import PreservationResult, shape_results
 
@@ -62,7 +63,7 @@ def _overlap_setup(disc_ds, test_ds, assignments, modules, background_label, nul
 
 
 def _make_result(d_name, t_name, labels, counts, observed, nulls, completed,
-                 np_this, alternative, total_space):
+                 np_this, alternative, total_space, profile=None):
     p_values = pv.permutation_pvalues(
         observed, nulls[:completed], alternative, total_nperm=total_space
     )
@@ -81,6 +82,7 @@ def _make_result(d_name, t_name, labels, counts, observed, nulls, completed,
         alternative=alternative,
         n_perm=np_this,
         completed=completed,
+        profile=profile,
     )
 
 
@@ -109,6 +111,7 @@ def module_preservation(
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 8192,
     backend: str = "jax",
+    profile=None,
 ):
     """Permutation test of network module preservation across datasets.
 
@@ -132,6 +135,14 @@ def module_preservation(
       permutations and on interrupt; re-running the same call resumes
       exactly (SURVEY.md §5 "checkpoint/resume" — an improvement over the
       reference's all-or-nothing runs).
+    - ``profile`` — tracing/profiling (SURVEY.md §5; the reference offers
+      only ``verbose=`` + ``system.time``): ``True`` captures a
+      ``jax.profiler`` trace under ``./netrep_profile``, a string names the
+      trace directory, and either also attaches per-pair timings (observed/
+      null wall-clock, per-chunk ms, first-chunk compile time, steady-state
+      median) to each result as ``result.profile``. Inspect the trace with
+      TensorBoard/Perfetto or
+      :func:`netrep_tpu.utils.profiling.summarize_trace`.
 
     Returns
     -------
@@ -186,7 +197,31 @@ def module_preservation(
         n_stats_eff = 7 if with_data else 3
         return max(1000, pv.required_perms(0.05, n_tests=len(labels) * n_stats_eff))
 
+    trace_dir = resolve_profile_dir(profile)
+    profiling = profile is not None and profile is not False
+
     results: dict[str, dict[str, PreservationResult]] = {}
+    interrupted = False
+    trace_cm = device_trace(trace_dir)
+    trace_cm.__enter__()  # covers every pair's device work; closed below
+    try:
+        return _run_pairs(
+            by_disc, datasets, assign, modules, background_label, null,
+            alternative, n_perm, auto_n_perm, engine_cls, config, mesh,
+            vmap_tests, backend, seed, progress, ckpt_path, checkpoint_every,
+            verbose, simplify, results, trace_dir, profiling,
+        )
+    finally:
+        trace_cm.__exit__(None, None, None)
+
+
+def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
+               alternative, n_perm, auto_n_perm, engine_cls, config, mesh,
+               vmap_tests, backend, seed, progress, ckpt_path,
+               checkpoint_every, verbose, simplify, results, trace_dir,
+               profiling):
+    """Pair-loop body of :func:`module_preservation` (split out so the
+    profiler trace context can bracket it without deep nesting)."""
     interrupted = False
     for d_name, t_names in by_disc.items():
         if interrupted:
@@ -233,12 +268,18 @@ def module_preservation(
                 [datasets[t].data for t in t_names] if with_data else None,
                 mod_specs, pool, config=config, mesh=mesh,
             )
-            observed = engine.observed()
+            timer = PairTimer(trace_dir) if profiling else None
+            observed = (
+                timer.time_observed(engine.observed) if timer
+                else engine.observed()
+            )
             nulls, completed = engine.run_null(
-                np_this, key=seed, progress=progress,
+                np_this, key=seed,
+                progress=timer.wrap_progress(progress) if timer else progress,
                 checkpoint_path=ckpt_path(d_name, "+".join(t_names)),
                 checkpoint_every=checkpoint_every,
             )
+            prof_dict = timer.finish_null(completed) if timer else None
             interrupted = completed < np_this
             if interrupted:
                 logger.warning(
@@ -251,6 +292,7 @@ def module_preservation(
                 results.setdefault(d_name, {})[t_name] = _make_result(
                     d_name, t_name, labels, counts, observed[ti],
                     nulls[ti], completed, np_this, alternative, total_space,
+                    profile=prof_dict,  # one vmapped run → shared timings
                 )
             continue
 
@@ -271,9 +313,14 @@ def module_preservation(
                 test_ds.correlation, test_ds.network, test_ds.data,
                 mod_specs, pool, config=config, mesh=mesh,
             )
-            observed = engine.observed()
+            timer = PairTimer(trace_dir) if profiling else None
+            observed = (
+                timer.time_observed(engine.observed) if timer
+                else engine.observed()
+            )
             nulls, completed = engine.run_null(
-                np_this, key=seed, progress=progress,
+                np_this, key=seed,
+                progress=timer.wrap_progress(progress) if timer else progress,
                 checkpoint_path=ckpt_path(d_name, t_name),
                 checkpoint_every=checkpoint_every,
             )
@@ -281,6 +328,7 @@ def module_preservation(
             results.setdefault(d_name, {})[t_name] = _make_result(
                 d_name, t_name, labels, counts, observed, nulls, completed,
                 np_this, alternative, total_space,
+                profile=timer.finish_null(completed) if timer else None,
             )
             if completed < np_this:
                 # Ctrl-C aborts the whole multi-pair run, not just the

@@ -36,6 +36,11 @@ class PreservationResult:
     alternative: str
     n_perm: int                   # permutations requested
     completed: int                # permutations actually completed
+    profile: dict | None = None   # per-pair timings when profile= was set
+                                  # (SURVEY.md §5 "Tracing / profiling"):
+                                  # trace_dir, observed_s, null_s,
+                                  # perms_per_sec, chunk_ms,
+                                  # compile_chunk_ms, steady_chunk_ms
 
     @property
     def stat_names(self) -> tuple[str, ...]:
