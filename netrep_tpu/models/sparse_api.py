@@ -83,6 +83,8 @@ def sparse_module_preservation(
     test_names: Sequence[str] | None = None,
     modules=None,
     background_label: str = "0",
+    discovery: str = "discovery",
+    test: str = "test",
     n_perm: int | None = None,
     null: str = "overlap",
     alternative: str = "greater",
@@ -109,6 +111,10 @@ def sparse_module_preservation(
       position ``i`` is the same node in both.
     - ``module_assignments`` maps discovery node name → label (dict) or is
       a per-position label array.
+    - ``discovery`` / ``test`` are dataset *names* recorded on the result
+      (plot labels, multi-result bookkeeping) — the matrices themselves ride
+      in the positional arguments, so unlike the dense surface these are
+      purely labels, defaulting to ``"discovery"`` / ``"test"``.
 
     Returns a single :class:`PreservationResult` (one dataset pair).
     """
@@ -200,8 +206,8 @@ def sparse_module_preservation(
     n_present = np.array([counts[lab][0] for lab in labels])
     tot = np.array([counts[lab][1] for lab in labels])
     return PreservationResult(
-        discovery="discovery",
-        test="test",
+        discovery=discovery,
+        test=test,
         module_labels=labels,
         observed=observed,
         nulls=nulls,

@@ -243,14 +243,24 @@ class NativePermutationEngine:
     # -- hooks consumed by engine.run_checkpointed_chunks ------------------
 
     def prepare_key(self, key) -> int:
-        return int(key)
+        if not isinstance(key, (int, np.integer)):
+            raise TypeError(
+                "backend='native' takes an integer seed, got "
+                f"{type(key).__name__}; jax PRNG keys only apply to the "
+                "default backend='jax'"
+            )
+        # mask to the counter-based generator's 64-bit seed space (matches
+        # core.null) so negative seeds round-trip through checkpoints
+        return int(key) & 0xFFFFFFFFFFFFFFFF
 
     def key_data(self, key) -> np.ndarray:
         """RNG-stream identity stored in checkpoints: (engine kind, seed).
         Distinct from the JAX engine's jax.random key data, so resuming a
         JAX checkpoint on the native backend (different null samples) is
         refused rather than spliced."""
-        return np.asarray([0x6E61746976, int(key)], dtype=np.uint64)
+        return np.asarray(
+            [0x6E61746976, int(key) & 0xFFFFFFFFFFFFFFFF], dtype=np.uint64
+        )
 
     #: tells run_checkpointed_chunks to clamp the final chunk to the exact
     #: remaining count — no static-shape constraint here, unlike XLA

@@ -72,8 +72,13 @@ class SparseAdjacency:
     ) -> "SparseAdjacency":
         """Build from COO triplets. ``symmetrize=True`` (default) unions the
         edge set with its transpose — pass each undirected edge once or in
-        both directions, identically either way. Duplicate (i, j) entries
-        must agree in value (last one wins silently otherwise)."""
+        both directions. Duplicate entries for the same undirected edge (in
+        either orientation) are resolved to the LAST one in input order, on
+        the canonical ``(min(i,j), max(i,j))`` edge *before* mirroring — so
+        both directions always agree and the adjacency stays symmetric even
+        when conflicting reciprocal entries are given. With
+        ``symmetrize=False`` the input must already contain both directions
+        of every edge; per-direction duplicates resolve last-wins."""
         rows = np.asarray(rows, dtype=np.int64)
         cols = np.asarray(cols, dtype=np.int64)
         vals = np.asarray(vals, dtype=np.float64)
@@ -85,7 +90,18 @@ class SparseAdjacency:
         keep = (rows != cols) & (vals != 0)
         rows, cols, vals = rows[keep], cols[keep], vals[keep]
         if symmetrize:
-            rows, cols = np.concatenate([rows, cols]), np.concatenate([cols, rows])
+            # canonicalize to (lo, hi) and dedupe BEFORE mirroring: a stable
+            # sort keeps input order within each edge group, so the last
+            # occurrence wins regardless of orientation — (i,j)=a alongside
+            # (j,i)=b can then never produce an asymmetric adjacency
+            lo, hi = np.minimum(rows, cols), np.maximum(rows, cols)
+            order = np.lexsort((hi, lo))
+            lo, hi, vals = lo[order], hi[order], vals[order]
+            last = np.ones(lo.size, dtype=bool)
+            if lo.size > 1:
+                last[:-1] = (lo[:-1] != lo[1:]) | (hi[:-1] != hi[1:])
+            lo, hi, vals = lo[last], hi[last], vals[last]
+            rows, cols = np.concatenate([lo, hi]), np.concatenate([hi, lo])
             vals = np.concatenate([vals, vals])
         # dedupe (i, j): later entries overwrite earlier
         order = np.lexsort((cols, rows))

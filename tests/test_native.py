@@ -187,3 +187,25 @@ def test_module_preservation_native_backend(rng):
     assert np.isfinite(res.p_values).all()
     # planted modules should look preserved: small p for avg.weight
     assert (res.p_values[:, 0] < 0.2).all()
+
+
+def test_native_seed_handling(rng):
+    """ADVICE r1: negative seeds must round-trip (masked to 64 bits, matching
+    core.null) and a jax typed key must raise a clear TypeError rather than
+    an opaque conversion error."""
+    disc, test, specs, pool = _problem(rng)
+    eng = native.NativePermutationEngine(*disc, *test, specs, pool)
+    # negative seed: runs, deterministic, and equals its masked twin
+    neg, done = eng.run_null(32, key=-7)
+    assert done == 32
+    masked, _ = eng.run_null(32, key=-7 & 0xFFFFFFFFFFFFFFFF)
+    np.testing.assert_array_equal(neg, masked)
+    # key_data masks too (checkpointed runs hit this path)
+    kd = eng.key_data(eng.prepare_key(-7))
+    assert kd.dtype == np.uint64
+    assert int(kd[1]) == (-7 & 0xFFFFFFFFFFFFFFFF)
+    # jax typed key → clear error naming the backend contract
+    import jax
+
+    with pytest.raises(TypeError, match="integer seed"):
+        eng.run_null(8, key=jax.random.key(0))

@@ -71,6 +71,24 @@ def test_coo_roundtrip_and_symmetrize(rng):
         SparseAdjacency.from_coo([0], [99], [1.0], n)
 
 
+def test_coo_conflicting_reciprocal_entries_stay_symmetric():
+    """(i,j)=a given alongside (j,i)=b must not yield an asymmetric
+    adjacency: conflicts resolve on the canonical undirected edge (last in
+    input order wins) BEFORE mirroring (ADVICE r1)."""
+    n = 6
+    adj = SparseAdjacency.from_coo(
+        [0, 1, 2, 3], [1, 0, 3, 2], [0.5, 0.9, 0.2, 0.4], n
+    )
+    dense = adj.to_dense()
+    np.testing.assert_allclose(dense, dense.T)
+    assert dense[0, 1] == dense[1, 0] == np.float32(0.9)  # later entry wins
+    assert dense[2, 3] == dense[3, 2] == np.float32(0.4)
+    # same-direction duplicates: still last-wins
+    adj2 = SparseAdjacency.from_coo([0, 0], [1, 1], [0.1, 0.7], n)
+    assert adj2.to_dense()[0, 1] == np.float32(0.7)
+    assert adj2.to_dense()[1, 0] == np.float32(0.7)
+
+
 @pytest.mark.parametrize("with_data", [True, False])
 def test_sparse_observed_matches_dense_engine(rng, with_data):
     """On a densified graph the sparse engine's observed statistics must
@@ -238,3 +256,25 @@ def test_sparse_vs_oracle_topology(rng):
         np.testing.assert_allclose(
             np.asarray(got_deg), want_deg, rtol=1e-5, atol=1e-6
         )
+
+
+def test_sparse_api_dataset_names(rng):
+    """ADVICE r1: the result records caller-supplied dataset names (plot
+    labels / multi-result bookkeeping), defaulting to the placeholders."""
+    from netrep_tpu import sparse_module_preservation
+
+    (d_adj, d_data), (t_adj, t_data), specs, pool = _knn_problem(rng)
+    labels = np.full(d_adj.n, "0", dtype=object)
+    labels[:9] = "1"
+    d_names = [f"c{i}" for i in range(d_adj.n)]
+    t_names = d_names[: t_adj.n]
+    kw = dict(
+        discovery_names=d_names, test_names=t_names, n_perm=32, seed=0,
+    )
+
+    res = sparse_module_preservation(
+        d_adj, t_adj, labels, discovery="cohortA", test="cohortB", **kw
+    )
+    assert res.discovery == "cohortA" and res.test == "cohortB"
+    res2 = sparse_module_preservation(d_adj, t_adj, labels, **kw)
+    assert res2.discovery == "discovery" and res2.test == "test"
