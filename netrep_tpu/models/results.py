@@ -22,7 +22,16 @@ from ..ops.oracle import STAT_NAMES
 
 @dataclasses.dataclass
 class PreservationResult:
-    """Result for one (discovery, test) dataset pair."""
+    """Result for one (discovery, test) dataset pair.
+
+    ``p_values`` are Phipson–Smyth exact permutation p-values
+    (:func:`netrep_tpu.ops.pvalues.permp`; never zero). Conventions, pinned
+    by tests and documented as re-verification debt against the unobservable
+    reference (SURVEY.md §7 "Exact p-values"): ``alternative='two.sided'``
+    uses min-tail × 2 capped at 1, and the exact finite-space method applies
+    automatically when the permutation space has ≤ 10,000 elements
+    (statmod's documented auto rule).
+    """
 
     discovery: str
     test: str

@@ -54,6 +54,23 @@ def permp(
     ``(x+1)/(nperm+1)`` because ``∫_0^1 F(x; n, u) du = (x+1)/(n+1)``; the
     approximate method subtracts the midpoint-rule boundary correction
     ``∫_0^{1/(2 mt)} F(x; n, u) du``.
+
+    Fidelity vs ``statmod::permp`` (re-verification debt, SURVEY.md §7
+    "Exact p-values"; the reference mount is empty and no R is installed, so
+    statmod itself cannot be executed here):
+
+    - The *exact* method is the estimator as published (Phipson & Smyth
+      2010, eq. 2) — ``tests/test_pvalues.py`` pins it against an
+      independent exact-rational-arithmetic oracle, so any disagreement
+      with statmod could only come from statmod deviating from its own
+      paper.
+    - The *approximate* method evaluates the same boundary-correction
+      integral statmod computes (statmod uses 128-point Gauss–Legendre;
+      here adaptive quadrature — agreement to quadrature tolerance,
+      ~1e-10, far below the estimator's own Monte-Carlo error).
+    - The ``'auto'`` rule (exact iff ``total_nperm <= 10_000``) mirrors
+      statmod's documented switch; flagged for re-verification against the
+      source if a reference mount ever appears.
     """
     x = np.atleast_1d(np.asarray(x, dtype=np.float64))
     x = np.clip(x, 0, nperm)
@@ -99,6 +116,15 @@ def exceedance_counts(
     (counts, effective_nperm) — for ``two.sided`` the counts are returned for
     both tails as the *minimum* tail count; callers double the resulting
     p-value (capped at 1), matching the standard two-sided permutation rule.
+
+    Convention note (documented deviation candidate, SURVEY.md §7): the
+    reference's R layer was not observable (empty mount), so its two-sided
+    rule could not be read. ``min-tail × 2, capped at 1`` is the standard
+    permutation convention and is what this layer implements; statmod's own
+    ``twosided=`` flag instead expects callers to count exceedances of
+    ``|statistic|``, which is only equivalent for symmetric nulls. If the
+    reference is ever re-verified to use the |statistic| convention, change
+    ONLY this function.
     """
     valid = ~np.isnan(nulls)
     eff = valid.sum(axis=0)

@@ -23,7 +23,18 @@ class EngineConfig:
         detection"), and is the save/resume granularity.
     summary_method : 'power' (masked power iteration — MXU-friendly, the
         default) or 'eigh' (exact; used by parity tests).
-    power_iters : fixed power-iteration count (static under jit).
+    power_iters : fixed power-iteration count (static under jit). The
+        default 60 is chosen from measured drift vs exact eigh at
+        north-star module shapes (m=200, s=128, f32 —
+        tests/test_power_vs_eigh.py): structured modules, including a
+        near-degenerate two-factor case at gap ratio 0.98, agree to ~1e-5
+        on every statistic by 60 iterations; null-like random modules never
+        converge in *direction* (Marchenko–Pastur bulk) but their statistic
+        distributions are rotation-invariant, leaving only a ≲5e-4
+        systematic coherence underestimate — far below the null sd. Raising
+        iterations past 60 buys nothing measurable; 40 doubles the
+        coherence bias; each step is one fused m×m matmul, so 60 costs ~2%
+        of the chunk on the mxu path.
     bucket_rounding : module bucket capacities are rounded up to the next
         power of two and at least this value — fewer distinct compiled
         programs (SURVEY.md §7: jit once per module-size bucket).

@@ -1,0 +1,116 @@
+"""Power-iteration vs exact-eigh drift at bench shapes (VERDICT r1 item 9).
+
+The engine computes OBSERVED statistics with ``summary_method='eigh'``
+(one-shot, exact) but NULL statistics with masked power iteration
+(``power_iters`` fixed for jit; SURVEY.md §7 "Batched SVD on TPU") — two
+numerics for the same statistic. These tests bound the drift at the
+north-star module scale (m≈200, s=128, f32) and are the evidence behind the
+``EngineConfig.power_iters`` default:
+
+- *Structured* modules (a planted factor, even two near-equal factors —
+  gram gap ratio ≈ 0.96): power-60 matches eigh to ~1e-5 on every
+  statistic, because convergence is geometric in the gram eigenvalue ratio.
+- *Null-like* modules (random node sets — the actual null draws): the gram
+  spectrum is a Marchenko–Pastur bulk with top-eigenvalue ratios ≈ 1, so
+  the power PROFILE never converges to the principal eigenvector. That is
+  harmless by symmetry: an unconverged profile is a random direction in the
+  top subspace exactly as the exact one is across draws, so the null
+  DISTRIBUTION of profile statistics is invariant (checked below); the one
+  systematic effect is coherence biased low by ≲5e-4 absolute (≈2% of the
+  null mean, far under the null sd), measured here and asserted.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from netrep_tpu.ops.stats import (
+    make_disc_props,
+    module_stats_masked,
+    standardize_masked,
+)
+
+S, M = 128, 200  # bench-shaped module: ~200 nodes, 128 samples
+COH = 1  # STAT_NAMES order: avg.weight, coherence, cor.cor, cor.degree, ...
+
+
+def _module_stats(data_d, data_t, method, n_iter):
+    """Seven statistics for one module where discovery=planted props (always
+    eigh, like the engine's one-shot bucket build) and the test side uses
+    ``method`` — mirroring the engine's observed/null numerics split."""
+    corr_d = np.corrcoef(data_d, rowvar=False).astype(np.float32)
+    net_d = (np.abs(corr_d) ** 2).astype(np.float32)
+    corr_t = np.corrcoef(data_t, rowvar=False).astype(np.float32)
+    net_t = (np.abs(corr_t) ** 2).astype(np.float32)
+    mask = jnp.ones(M, jnp.float32)
+    disc = make_disc_props(
+        jnp.asarray(corr_d), jnp.asarray(net_d),
+        jnp.asarray(data_d, jnp.float32), mask,
+    )
+    z = standardize_masked(jnp.asarray(data_t, jnp.float32), mask)
+    out = module_stats_masked(
+        disc, jnp.asarray(corr_t), jnp.asarray(net_t), z,
+        n_iter=n_iter, summary_method=method,
+    )
+    return np.asarray(out, np.float64)
+
+
+def test_structured_module_power_matches_eigh():
+    rng = np.random.default_rng(1)
+    lat = rng.standard_normal(S)
+    mk = lambda: rng.standard_normal((S, M)) * 0.8 + lat[:, None]
+    d, t = mk(), mk()
+    p = _module_stats(d, t, "power", 60)
+    e = _module_stats(d, t, "eigh", 60)
+    np.testing.assert_allclose(p, e, atol=3e-4, rtol=1e-3)
+
+
+def test_near_degenerate_module_power_matches_eigh():
+    """Two planted factors at strength ratio 0.98 — the adversarial case for
+    power iteration (gram gap ratio 0.98² ≈ 0.96 → error ~0.96^60 ≈ 0.09
+    of the initial off-axis component, further attenuated by the start
+    vector's alignment)."""
+    rng = np.random.default_rng(2)
+    l1, l2 = rng.standard_normal(S), rng.standard_normal(S)
+
+    def mk():
+        x = rng.standard_normal((S, M)) * 0.5
+        x[:, : M // 2] += 1.00 * l1[:, None]
+        x[:, M // 2:] += 0.98 * l2[:, None]
+        return x
+
+    d, t = mk(), mk()
+    p = _module_stats(d, t, "power", 60)
+    e = _module_stats(d, t, "eigh", 60)
+    np.testing.assert_allclose(p, e, atol=1e-3, rtol=2e-3)
+
+
+def test_null_like_modules_distribution_parity():
+    """Random modules (what permutation nulls actually evaluate): per-draw
+    profiles differ between the numerics, but every topology statistic is
+    exactly shared, and the profile statistics' null DISTRIBUTION moments
+    must agree — coherence within its measured ≲5e-4 systematic bias, the
+    contribution statistics to Monte-Carlo error."""
+    rng = np.random.default_rng(3)
+    draws = 30
+    P = np.empty((draws, 7))
+    E = np.empty((draws, 7))
+    for i in range(draws):
+        d = rng.standard_normal((S, M))
+        t = rng.standard_normal((S, M))
+        P[i] = _module_stats(d, t, "power", 60)
+        E[i] = _module_stats(d, t, "eigh", 60)
+    # topology statistics don't touch the profile: identical numerics
+    np.testing.assert_allclose(P[:, [0, 2, 3]], E[:, [0, 2, 3]], atol=1e-6)
+    # coherence: small systematic underestimate by unconverged power, bounded
+    dcoh = P[:, COH] - E[:, COH]
+    assert np.abs(dcoh).max() < 2e-3
+    assert abs(dcoh.mean()) < 7.5e-4   # the measured ≈4e-4 bias, with slack
+    # null-distribution parity of the profile statistics (cor.contrib=4,
+    # avg.cor=5 shares no profile → exact; avg.contrib=6): means agree to
+    # Monte-Carlo error of `draws` null draws
+    for j in (4, 6):
+        se = (P[:, j].std() + E[:, j].std()) / np.sqrt(draws) + 1e-9
+        assert abs(P[:, j].mean() - E[:, j].mean()) < 4 * se
+    np.testing.assert_allclose(P[:, 5], E[:, 5], atol=1e-6)  # avg.cor
