@@ -1,0 +1,157 @@
+# netrep-tpu R shim (reticulate stub) — the `backend="tpu"` story
+# (SURVEY.md §7 step 7; BASELINE.json:5): the reference package's exported
+# surface, argument names and defaults preserved verbatim, forwarding to the
+# netrep_tpu Python package. See docs/r-shim.md for the full mapping,
+# including the result-object shape.
+#
+# R is not installed in the build image, so this file is a *specification
+# stub*: it is exercised for name/default parity against the Python
+# signatures by tests/test_r_shim.py (which parses this file), and is
+# written to run unmodified in an R session that has reticulate + a Python
+# environment with netrep_tpu on sys.path:
+#
+#   source("r/netrep_tpu.R")
+#   res <- modulePreservation(network = list(d = dnet, t = tnet),
+#                             data = list(d = ddat, t = tdat),
+#                             correlation = list(d = dcor, t = tcor),
+#                             moduleAssignments = labels,
+#                             discovery = "d", test = "t", nPerm = 10000)
+
+.netrep <- local({
+  mod <- NULL
+  function() {
+    if (is.null(mod)) mod <<- reticulate::import("netrep_tpu")
+    mod
+  }
+})
+
+# Argument-name mapping, reference (camelCase) -> netrep_tpu (snake_case).
+# Machine-readable: tests/test_r_shim.py asserts every right-hand side is a
+# real parameter of the Python function and that defaults agree.
+.modulePreservation_args <- list(
+  network            = "network",
+  data               = "data",
+  correlation        = "correlation",
+  moduleAssignments  = "module_assignments",
+  modules            = "modules",
+  backgroundLabel    = "background_label",
+  discovery          = "discovery",
+  test               = "test",
+  selfPreservation   = "self_preservation",
+  nThreads           = "n_threads",
+  nPerm              = "n_perm",
+  null               = "null",
+  alternative        = "alternative",
+  simplify           = "simplify",
+  verbose            = "verbose"
+)
+
+#' Permutation test of network module preservation (reference signature).
+#'
+#' Arguments are the reference's, verbatim; TPU-only extras (seed, config,
+#' mesh, profile, checkpoint.dir, backend) ride through `...` using the
+#' Python names. NULL arguments are dropped so Python defaults apply.
+modulePreservation <- function(network,
+                               data = NULL,
+                               correlation = NULL,
+                               moduleAssignments = NULL,
+                               modules = NULL,
+                               backgroundLabel = "0",
+                               discovery = NULL,
+                               test = NULL,
+                               selfPreservation = FALSE,
+                               nThreads = NULL,
+                               nPerm = NULL,
+                               null = "overlap",
+                               alternative = "greater",
+                               simplify = TRUE,
+                               verbose = FALSE,
+                               ...) {
+  args <- list(network = network, data = data, correlation = correlation,
+               module_assignments = moduleAssignments, modules = modules,
+               background_label = backgroundLabel, discovery = discovery,
+               test = test, self_preservation = selfPreservation,
+               n_threads = nThreads, n_perm = nPerm, null = null,
+               alternative = alternative, simplify = simplify,
+               verbose = verbose, ...)
+  args <- args[!vapply(args, is.null, logical(1))]
+  do.call(.netrep()$module_preservation, args)
+}
+
+.networkProperties_args <- list(
+  network            = "network",
+  data               = "data",
+  correlation        = "correlation",
+  moduleAssignments  = "module_assignments",
+  modules            = "modules",
+  backgroundLabel    = "background_label",
+  discovery          = "discovery",
+  test               = "test",
+  selfPreservation   = "self_preservation",
+  simplify           = "simplify"
+)
+
+networkProperties <- function(network,
+                              data = NULL,
+                              correlation = NULL,
+                              moduleAssignments = NULL,
+                              modules = NULL,
+                              backgroundLabel = "0",
+                              discovery = NULL,
+                              test = NULL,
+                              selfPreservation = TRUE,
+                              simplify = TRUE) {
+  args <- list(network = network, data = data, correlation = correlation,
+               module_assignments = moduleAssignments, modules = modules,
+               background_label = backgroundLabel, discovery = discovery,
+               test = test, self_preservation = selfPreservation,
+               simplify = simplify)
+  args <- args[!vapply(args, is.null, logical(1))]
+  do.call(.netrep()$network_properties, args)
+}
+
+.requiredPerms_args <- list(
+  alpha       = "alpha",
+  nTests      = "n_tests",
+  alternative = "alternative"
+)
+
+requiredPerms <- function(alpha = 0.05, nTests = 1L,
+                          alternative = "greater") {
+  .netrep()$required_perms(alpha = alpha, n_tests = as.integer(nTests),
+                           alternative = alternative)
+}
+
+.plotModule_args <- list(
+  network           = "network",
+  data              = "data",
+  correlation       = "correlation",
+  moduleAssignments = "module_assignments",
+  modules           = "modules",
+  backgroundLabel   = "background_label",
+  discovery         = "discovery",
+  test              = "test",
+  orderNodesBy      = "order_nodes_by",
+  orderSamplesBy    = "order_samples_by"
+)
+
+plotModule <- function(network,
+                       data = NULL,
+                       correlation = NULL,
+                       moduleAssignments = NULL,
+                       modules = NULL,
+                       backgroundLabel = "0",
+                       discovery = NULL,
+                       test = NULL,
+                       orderNodesBy = "discovery",
+                       orderSamplesBy = "test",
+                       ...) {
+  plt <- reticulate::import("netrep_tpu.plot")
+  args <- list(network = network, data = data, correlation = correlation,
+               module_assignments = moduleAssignments, modules = modules,
+               background_label = backgroundLabel, discovery = discovery,
+               test = test, order_nodes_by = orderNodesBy,
+               order_samples_by = orderSamplesBy, ...)
+  args <- args[!vapply(args, is.null, logical(1))]
+  do.call(plt$plot_module, args)
+}

@@ -1,0 +1,54 @@
+"""Execute docs/vignette.md (VERDICT r1 item 5): every ```python block runs
+verbatim, in order, in one shared namespace — the reference's vignette is
+its de-facto integration test (SURVEY.md §2.1), and this keeps ours honest
+the same way. A drifting document fails the suite."""
+
+import os
+import re
+
+import pytest
+
+VIGNETTE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs", "vignette.md",
+)
+
+
+def _blocks():
+    text = open(VIGNETTE).read()
+    return re.findall(r"```python\n(.*?)```", text, flags=re.S)
+
+
+def test_vignette_exists_and_has_blocks():
+    assert os.path.exists(VIGNETTE)
+    blocks = _blocks()
+    assert len(blocks) >= 8, "vignette lost its executable walkthrough"
+
+
+def test_vignette_blocks_execute(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # artifacts (png, checkpoints) land in tmp
+    import matplotlib
+
+    matplotlib.use("Agg")
+    ns: dict = {}
+    for i, block in enumerate(_blocks()):
+        try:
+            exec(compile(block, f"vignette block {i + 1}", "exec"), ns)
+        except Exception as e:  # pragma: no cover - failure reporting
+            pytest.fail(
+                f"vignette block {i + 1} failed ({type(e).__name__}: {e}):\n"
+                f"{block}"
+            )
+    # the walkthrough's own artifacts exist
+    assert (tmp_path / "module_preservation.png").exists()
+    assert ns["result"].completed == 250
+    assert ns["r2"].completed == 256
+
+
+def test_data_docstring_points_at_real_file():
+    """The round-1 verdict flagged a dangling docs/vignette.md reference in
+    the public API docs; the file now exists — keep it that way."""
+    import netrep_tpu.data as data_mod
+
+    assert "docs/vignette.md" in (data_mod.load_example.__doc__ or "")
+    assert os.path.exists(VIGNETTE)
