@@ -232,7 +232,6 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
             vmap_tests
             and backend == "jax"
             and len(t_names) > 1
-            and config.matrix_sharding != "row"
             and all(
                 datasets[t].node_names == datasets[t_names[0]].node_names
                 for t in t_names
@@ -243,8 +242,8 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
             logger.warning(
                 "vmap_tests requested but unavailable (requires the default "
                 "backend='jax'; test datasets %s must share a node universe "
-                "and agree on data presence; matrix_sharding must not be "
-                "'row'); falling back to sequential pairs", t_names,
+                "and agree on data presence); falling back to sequential "
+                "pairs (any matrix sharding is retained per pair)", t_names,
             )
 
         if can_vmap:

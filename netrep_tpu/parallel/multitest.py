@@ -11,6 +11,12 @@ datasets within one run. Nulls remain valid per pair (each dataset's matrices
 are independent of the shared index draw); only the *joint* distribution
 across datasets is coupled, which the reference's sequential independent runs
 don't expose either way because p-values are computed per pair.
+
+Config C composes with Config D (``matrix_sharding='row'``): each cohort's
+n×n matrices are row-sharded individually across the mesh's row axis and the
+chunk program loops the small T axis over the shared permutation index batch
+— a multi-cohort genome-scale consortium run holds T×n²/D_row per device
+instead of T×n² (VERDICT r1 item 7).
 """
 
 from __future__ import annotations
@@ -50,26 +56,46 @@ class MultiTestEngine:
         config: EngineConfig = EngineConfig(),
         mesh=None,
     ):
-        if config.matrix_sharding == "row":
-            raise NotImplementedError(
-                "matrix_sharding='row' is not supported on the multi-test "
-                "vmap path (the stacked (T, n, n) matrices would be "
-                "replicated); run the pairs sequentially "
-                "(vmap_tests=False) for row-sharded Config D scale"
-            )
         test_corrs = np.asarray(test_corrs)
         self.T = test_corrs.shape[0]
         # Base engine: discovery-side buckets + pool validation only — no
         # throwaway test-side device transfer (the test side lives here).
+        # With matrix_sharding='row' it also builds the sharded gatherers
+        # (discovery_only + row path in PermutationEngine.__init__).
         self._base = PermutationEngine(
             disc_corr, disc_net,
             disc_data if test_datas is not None else None,
             None, None, None,
             modules, pool, config=config, mesh=mesh, discovery_only=True,
         )
+        self.row_sharded = self._base.row_sharded
         dtype = jnp.dtype(config.dtype)
-        self._tc = jnp.asarray(test_corrs, dtype)
-        self._tn = jnp.asarray(test_nets, dtype)
+        if self.row_sharded:
+            # Config C × Config D composition (VERDICT r1 item 7): each test
+            # dataset's n×n matrices are row-sharded individually and the
+            # chunk program loops the (small) T axis over the shared
+            # permutation index batch — the stacked (T, n, n) tensor never
+            # materializes on one device, and permutation draws stay shared
+            # across cohorts exactly as on the replicated vmap path.
+            from .mesh import ROW_AXIS
+            from .sharded import pad_square_to_multiple, shard_rows
+
+            d_row = mesh.shape[ROW_AXIS]
+            self._tc = [
+                shard_rows(
+                    jnp.asarray(pad_square_to_multiple(c, d_row), dtype), mesh
+                )
+                for c in test_corrs
+            ]
+            self._tn = [
+                shard_rows(
+                    jnp.asarray(pad_square_to_multiple(m, d_row), dtype), mesh
+                )
+                for m in np.asarray(test_nets)
+            ]
+        else:
+            self._tc = jnp.asarray(test_corrs, dtype)
+            self._tn = jnp.asarray(test_nets, dtype)
         # ragged sample counts across datasets are allowed → keep a list and
         # vmap only when uniform, else python-loop the T axis for data.
         # Data is stored TRANSPOSED — (T, n, samples) — so per-module slices
@@ -80,17 +106,21 @@ class MultiTestEngine:
         else:
             shapes = {np.asarray(d).shape for d in test_datas}
             self._uniform_samples = len(shapes) == 1
-            if self._uniform_samples:
+            if self._uniform_samples and not self.row_sharded:
                 self._td = jnp.asarray(
                     np.stack([np.asarray(d).T for d in test_datas]), dtype
                 )
             else:
+                # per-dataset list (ragged samples, or row-sharded — where
+                # the T axis is a host-side loop and `td[t]` must be free
+                # Python list indexing, not an eager device slice)
                 self._td = [jnp.asarray(np.asarray(d).T, dtype) for d in test_datas]
         self.config = config
         self.mesh = mesh
         self.modules = self._base.modules
         self.n_modules = self._base.n_modules
         self._chunk_cached: Callable | None = None
+        self._obs_fn_cached: Callable | None = None
 
     # -- kernel composition ------------------------------------------------
 
@@ -106,8 +136,22 @@ class MultiTestEngine:
 
     def observed(self) -> np.ndarray:
         """(T, n_modules, 7) observed statistics."""
-        over_mod = self._stats_stack("eigh")
         out = np.full((self.T, self.n_modules, N_STATS), np.nan)
+        if self.row_sharded:
+            if self._obs_fn_cached is None:
+                from .engine import make_row_sharded_observed
+
+                self._obs_fn_cached = make_row_sharded_observed(
+                    self._base._gather_rep
+                )
+            _obs = self._obs_fn_cached
+            for t in range(self.T):
+                td_t = None if self._td is None else self._td[t]
+                for b in self._base.buckets:
+                    res = _obs(b.disc, b.obs_idx, self._tc[t], self._tn[t], td_t)
+                    out[t, b.module_pos] = np.asarray(res, dtype=np.float64)
+            return out
+        over_mod = self._stats_stack("eigh")
         if self._td is None or self._uniform_samples:
             over_test = jax.jit(jax.vmap(
                 over_mod, in_axes=(None, None, 0, 0, None if self._td is None else 0)
@@ -143,6 +187,9 @@ class MultiTestEngine:
             [b.disc for b in base.buckets],
         )
 
+        row_sharded = self.row_sharded
+        gather_perm = base._gather_perm if row_sharded else None
+
         def chunk(keys, pool, tc, tn, td, discs):
             perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)
             outs = []
@@ -152,7 +199,25 @@ class MultiTestEngine:
                     idx = perm[:, off: off + size]
                     cols.append(jnp.pad(idx, ((0, 0), (0, cap - size))))
                 idx_b = jnp.stack(cols, axis=1)  # (C, K, cap)
-                if uniform:
+                if row_sharded:
+                    # Config C × row sharding: T is small — loop datasets
+                    # over the SHARED index batch; each cohort's submatrices
+                    # assemble from its own row-sharded matrices (psum over
+                    # the row axis), never materializing (T, n, n) anywhere.
+                    per_t = []
+                    for t in range(T):
+                        sub_c, sub_n = gather_perm(tc[t], tn[t], idx_b)
+                        zd = (
+                            jstats.gather_zdata(td[t], idx_b, disc.mask)
+                            if not td_absent else None
+                        )
+                        per_t.append(jstats.module_stats_masked(
+                            disc, sub_c, sub_n, zd,
+                            n_iter=cfg.power_iters,
+                            summary_method=cfg.summary_method,
+                        ))
+                    outs.append(jnp.stack(per_t))        # (T, C, K, 7)
+                elif uniform:
                     over_test = jax.vmap(
                         over_perm,
                         in_axes=(None, None, 0, 0, None if td_absent else 0),
@@ -182,6 +247,17 @@ class MultiTestEngine:
             self._chunk_cached = lambda keys: jitted(keys, *chunk_args)
         return self._chunk_cached
 
+    def _fingerprint_extra(self) -> bytes:
+        """Checkpoint identity of the test side (_tc/_tn/_td are per-dataset
+        lists when row-sharded or ragged, single stacked arrays otherwise)."""
+        as_list = lambda x: (
+            list(x) if isinstance(x, list) else [x]
+        )
+        digest = ckpt_digest(
+            as_list(self._tc) + as_list(self._tn) + as_list(self._td)
+        )
+        return f"|T:{self.T}|td:{digest}".encode()
+
     def run_null(self, n_perm: int, key=0, progress=None,
                  nulls_init=None, start_perm: int = 0,
                  checkpoint_path: str | None = None,
@@ -210,7 +286,5 @@ class MultiTestEngine:
             perm_axis=1,
             # the test-side matrices live on this wrapper (the base engine is
             # discovery-only), so their content digest rides fingerprint_extra
-            fingerprint_extra=(
-                f"|T:{self.T}|td:{ckpt_digest([self._tc, self._tn] + (list(self._td) if isinstance(self._td, list) else [self._td]))}"
-            ).encode(),
+            fingerprint_extra=self._fingerprint_extra(),
         )
