@@ -79,6 +79,8 @@ def sparse_module_preservation(
     module_assignments,
     discovery_data=None,
     test_data=None,
+    discovery_correlation: SparseAdjacency | None = None,
+    test_correlation: SparseAdjacency | None = None,
     discovery_names: Sequence[str] | None = None,
     test_names: Sequence[str] | None = None,
     modules=None,
@@ -102,10 +104,17 @@ def sparse_module_preservation(
 
     - ``discovery_network`` / ``test_network`` are
       :class:`SparseAdjacency` objects (build with ``from_coo`` /
-      ``from_dense``); no dense ``correlation`` argument exists — the
-      correlation statistics are computed from ``*_data`` on the fly
-      (``zᵀz/(s-1)`` per module slice). Without data, only ``avg.weight``
-      and ``cor.degree`` are defined (:mod:`netrep_tpu.ops.sparse`).
+      ``from_dense``); no *dense* ``correlation`` argument exists. The
+      correlation statistics come from ``discovery_correlation`` /
+      ``test_correlation`` — optional PRECOMPUTED sparse correlations in
+      the same neighbor-list format, authoritative when given (as the
+      dense surface's ``correlation`` argument is) — or else are computed
+      from ``*_data`` on the fly (``zᵀz/(s-1)`` per module slice).
+      Without data, a precomputed correlation restores four finite
+      statistics (``avg.weight``, ``cor.cor``, ``cor.degree``,
+      ``avg.cor``); with neither, only ``avg.weight`` and ``cor.degree``
+      are defined (:mod:`netrep_tpu.ops.sparse`). Absent correlation
+      pairs count as 0, the same convention as absent edges.
     - ``discovery_names`` / ``test_names`` align nodes across datasets by
       name; omitted, both graphs must have the same node count and
       position ``i`` is the same node in both.
@@ -180,14 +189,20 @@ def sparse_module_preservation(
         pool = np.arange(test_network.n, dtype=np.int32)
 
     with_data = discovery_data is not None and test_data is not None
+    with_corr = (
+        discovery_correlation is not None and test_correlation is not None
+    )
     if n_perm is None:
-        n_stats_eff = 7 if with_data else 2  # sparse data-less: avg.weight, cor.degree
+        # finite statistics: 7 with data; 4 with a precomputed correlation
+        # only (avg.weight, cor.cor, cor.degree, avg.cor); 2 with neither
+        n_stats_eff = 7 if with_data else (4 if with_corr else 2)
         n_perm = max(1000, pv.required_perms(0.05, n_tests=len(labels) * n_stats_eff))
 
     engine = SparsePermutationEngine(
         discovery_network, discovery_data if with_data else None,
         test_network, test_data if with_data else None,
         specs, pool, config=config or EngineConfig(), mesh=mesh,
+        disc_corr=discovery_correlation, test_corr=test_correlation,
     )
     observed = engine.observed()
     nulls, completed = engine.run_null(

@@ -18,14 +18,18 @@ limited — plan a gather-on-edge-list formulation):
   and each gathered neighbor id binary-searched (``m·k·log m``), keeping
   the working set at ``O(m·k)`` instead of ``O(n)`` per instance — the
   difference between fitting a 64-permutation chunk in HBM or not at n=50k.
-- **Correlation on the fly.** No ``n × n`` correlation matrix ever exists:
-  the per-module correlation submatrix is one MXU matmul of the gathered,
-  standardized data slice (``zᵀz/(s-1)`` = exact Pearson), which is also how
-  the statistics stay defined on sparse topology. Without ``data`` the
-  correlation-based statistics are NaN (documented deviation: the dense
-  data-less variant still has cor.cor because the user supplies a dense
-  correlation matrix; at sparse scale that matrix is exactly what we refuse
-  to materialize).
+- **Correlation on the fly — or precomputed-sparse.** No ``n × n``
+  correlation matrix ever exists: the per-module correlation submatrix is
+  one MXU matmul of the gathered, standardized data slice (``zᵀz/(s-1)`` =
+  exact Pearson) — or, when the user supplies a PRECOMPUTED sparse
+  correlation in the same neighbor-list format, a membership scatter out of
+  it (:func:`scatter_corr_submatrix`; the user's correlation is
+  authoritative, matching the dense surface). Without data, a precomputed
+  correlation keeps four statistics finite (avg.weight, cor.cor,
+  cor.degree, avg.cor); with neither input only avg.weight/cor.degree are
+  defined (documented deviation: the dense data-less variant has cor.cor
+  because the user supplies a dense correlation matrix — at sparse scale
+  that dense matrix is exactly what we refuse to materialize).
 """
 
 from __future__ import annotations
@@ -175,6 +179,43 @@ def sparse_module_topology(
     return avg_weight, degree
 
 
+def scatter_corr_submatrix(
+    nbr_rows: jnp.ndarray,   # (m, k) gathered correlation-graph neighbor ids
+    wgt_rows: jnp.ndarray,   # (m, k) gathered correlation values
+    idx: jnp.ndarray,        # (m,) padded module node ids
+    w: jnp.ndarray,          # (m,) 0/1 validity mask
+) -> jnp.ndarray:
+    """Module-order (m, m) correlation submatrix from a PRECOMPUTED sparse
+    correlation in neighbor-list format (VERDICT r1 item 8: restores
+    cor.cor/avg.cor for topology-only users whose correlation was sparsified
+    upstream, e.g. alongside the kNN graph). Reuses the sort + searchsorted
+    membership machinery (module docstring); member hits scatter-add into
+    the submatrix at their *module-order* positions (rank → original
+    position via the argsort permutation), absent pairs stay 0 — the same
+    convention the adjacency kernels use for absent edges. Output is
+    multiplied by the off-diagonal pair mask (the
+    :func:`netrep_tpu.ops.stats.stats_from_parts` input form)."""
+    import jax
+
+    m = idx.shape[-1]
+    big = jnp.int32(np.iinfo(np.int32).max)
+    keyed = jnp.where(w > 0, idx, big)
+    order = jnp.argsort(keyed)                    # rank r ← original order[r]
+    sidx = jnp.take(keyed, order)
+    pos = jnp.clip(jnp.searchsorted(sidx, nbr_rows), 0, m - 1)
+    member = (
+        (jnp.take(sidx, pos) == nbr_rows)
+        & (nbr_rows != idx[:, None])
+        & (w[:, None] > 0)
+    )
+    cols = jnp.take(order, pos)                   # module-order column
+    rows_i = jax.lax.broadcasted_iota(jnp.int32, nbr_rows.shape, 0)
+    sub = jnp.zeros((m, m), jnp.float32).at[
+        rows_i, jnp.where(member, cols, m)        # m = out-of-bounds: dropped
+    ].add(jnp.where(member, _f32(wgt_rows), 0.0), mode="drop")
+    return sub * jstats.offdiag_mask(w)
+
+
 def corr_from_zdata(zdata: jnp.ndarray, n_samples: int, w: jnp.ndarray) -> jnp.ndarray:
     """Exact Pearson correlation submatrix from a standardized (ddof=1)
     masked data slice: ``zᵀz/(s-1)``, multiplied by the off-diagonal pair
@@ -193,6 +234,8 @@ def sparse_gather_and_stats(
     nbr: jnp.ndarray,              # (n, k) neighbor ids
     wgt: jnp.ndarray,              # (n, k) weights
     test_data: jnp.ndarray | None,  # (n_samples, n)
+    corr_nbr: jnp.ndarray | None = None,  # (n, k_c) sparse-corr neighbor ids
+    corr_wgt: jnp.ndarray | None = None,  # (n, k_c) sparse-corr values
     n_iter: int = 60,
     summary_method: str = "power",
 ) -> jnp.ndarray:
@@ -201,7 +244,15 @@ def sparse_gather_and_stats(
     adjacency rows plus (optionally) an ``(s, m)`` data slice, never touching
     anything ``O(n²)``. ``idx`` padded slots must hold in-range row ids (the
     mask removes their influence); batching over permutations/modules is
-    ``vmap`` of this function."""
+    ``vmap`` of this function.
+
+    Correlation precedence (mirrors the dense surface where the user's
+    ``correlation`` argument is authoritative): a PRECOMPUTED sparse
+    correlation (``corr_nbr``/``corr_wgt``) feeds the correlation statistics
+    when given; otherwise they derive from ``test_data`` on the fly; with
+    neither they are NaN. With a precomputed correlation and no data,
+    ``avg.cor`` is also computed (its inputs are purely correlations) —
+    four finite statistics for topology-only users (VERDICT r1 item 8)."""
     w = disc.mask
     safe_idx = jnp.where(w > 0, idx, 0)  # pad rows gather row 0, masked out
     nbr_rows = jnp.take(nbr, safe_idx, axis=0)
@@ -211,14 +262,33 @@ def sparse_gather_and_stats(
     if test_data is not None:
         sub = jnp.take(test_data, safe_idx, axis=-1)
         zdata = jstats.standardize_masked(sub, w)
+    else:
+        zdata = None
+    if corr_nbr is not None:
+        corr = scatter_corr_submatrix(
+            jnp.take(corr_nbr, safe_idx, axis=0),
+            jnp.take(corr_wgt, safe_idx, axis=0),
+            idx, w,
+        )
+    elif zdata is not None:
         corr = corr_from_zdata(zdata, test_data.shape[-2], w)
     else:
-        zdata = corr = None
+        corr = None
 
-    return jstats.stats_from_parts(
+    out = jstats.stats_from_parts(
         disc, avg_weight, degree, corr, zdata,
         n_iter=n_iter, summary_method=summary_method,
     )
+    if corr is not None and zdata is None:
+        # avg.cor (STAT_NAMES index 5) needs only correlations; the shared
+        # stats_from_parts keeps the dense data-less convention (NaN, as the
+        # reference's data-less variant documents) so the sparse
+        # precomputed-correlation case patches it in here.
+        pair = jstats.offdiag_mask(w)
+        npair = jnp.maximum(jnp.sum(pair, axis=(-1, -2)), 1e-30)
+        avg_cor = jnp.sum(disc.sign_corr * corr, axis=(-1, -2)) / npair
+        out = out.at[..., 5].set(avg_cor)
+    return out
 
 
 def make_disc_props_sparse(
@@ -227,11 +297,15 @@ def make_disc_props_sparse(
     data: jnp.ndarray | None,      # (n_samples, n) or None
     idx_pad: jnp.ndarray,          # (K, cap) padded discovery ids
     mask: jnp.ndarray,             # (K, cap)
+    corr_nbr: jnp.ndarray | None = None,  # (n, k_c) sparse-corr neighbors
+    corr_wgt: jnp.ndarray | None = None,  # (n, k_c) sparse-corr values
     summary_method: str = "eigh",
 ) -> DiscProps:
     """Discovery-side fixed properties for a bucket of modules on a sparse
     discovery network: degree from neighbor lists, correlation submatrix
-    (and node contributions) from the data slice on the fly. Runs once per
+    from the PRECOMPUTED sparse correlation when given (the user's
+    correlation is authoritative, as on the dense surface) else from the
+    data slice on the fly; node contributions from data. Runs once per
     pair, outside the hot loop (SURVEY.md §3.1)."""
     import jax
 
@@ -246,12 +320,21 @@ def make_disc_props_sparse(
         # (s, K, cap) → (K, s, cap)
         sub = jnp.moveaxis(jnp.take(data, safe_idx, axis=-1), 1, 0)
         zdata = jstats.standardize_masked(sub, w)
-        corr = corr_from_zdata(zdata, data.shape[-2], w)
         prof = jstats.summary_profile_masked(zdata, w, method=summary_method)
         contrib = jstats.node_contribution_masked(zdata, prof, w)
     else:
-        corr = jnp.zeros(idx_pad.shape + idx_pad.shape[-1:], dtype=jnp.float32)
+        zdata = None
         contrib = jnp.zeros_like(degree)
+    if corr_nbr is not None:
+        corr = jax.vmap(scatter_corr_submatrix)(
+            jnp.take(corr_nbr, safe_idx, axis=0),
+            jnp.take(corr_wgt, safe_idx, axis=0),
+            idx_pad, mask,
+        )
+    elif zdata is not None:
+        corr = corr_from_zdata(zdata, data.shape[-2], w)
+    else:
+        corr = jnp.zeros(idx_pad.shape + idx_pad.shape[-1:], dtype=jnp.float32)
     return DiscProps(
         corr=corr,
         sign_corr=jnp.sign(corr),

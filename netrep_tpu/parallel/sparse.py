@@ -71,7 +71,14 @@ class SparsePermutationEngine:
         pool: np.ndarray,
         config: EngineConfig = EngineConfig(),
         mesh=None,
+        disc_corr: SparseAdjacency | None = None,
+        test_corr: SparseAdjacency | None = None,
     ):
+        """``disc_corr``/``test_corr`` are optional PRECOMPUTED sparse
+        correlations (same neighbor-list format as the adjacency): they feed
+        the correlation statistics instead of the on-the-fly ``zᵀz`` — and
+        in the data-less case restore cor.cor/avg.cor for topology-only
+        users (VERDICT r1 item 8)."""
         if config.matrix_sharding == "row":
             raise NotImplementedError(
                 "matrix_sharding='row' does not apply to the sparse engine: "
@@ -96,6 +103,24 @@ class SparsePermutationEngine:
         self._test_data = (
             jnp.asarray(test_data, dtype) if self.has_data else None
         )
+        self.has_corr = disc_corr is not None and test_corr is not None
+        if (disc_corr is None) != (test_corr is None):
+            raise ValueError(
+                "provide both disc_corr and test_corr sparse correlations, "
+                "or neither"
+            )
+        if self.has_corr:
+            for what, c, adj in (("disc", disc_corr, disc_adj),
+                                 ("test", test_corr, test_adj)):
+                if not isinstance(c, SparseAdjacency) or c.n != adj.n:
+                    raise ValueError(
+                        f"{what}_corr must be a SparseAdjacency over the "
+                        f"same {adj.n} nodes as the {what} network"
+                    )
+            self._cnbr = jnp.asarray(test_corr.nbr)
+            self._cwgt = jnp.asarray(test_corr.wgt, dtype)
+        else:
+            self._cnbr = self._cwgt = None
         self.pool = np.asarray(pool, dtype=np.int32)
         self.total_take = sum(m.size for m in self.modules)
         if self.total_take > self.pool.size:
@@ -110,6 +135,10 @@ class SparsePermutationEngine:
         # (SURVEY.md §7 "Variable module sizes vs. XLA static shapes")
         disc_nbr = jnp.asarray(disc_adj.nbr)
         disc_wgt = jnp.asarray(disc_adj.wgt, dtype)
+        disc_cnbr = jnp.asarray(disc_corr.nbr) if self.has_corr else None
+        disc_cwgt = (
+            jnp.asarray(disc_corr.wgt, dtype) if self.has_corr else None
+        )
         disc_data_dev = (
             jnp.asarray(disc_data, dtype) if self.has_data else None
         )
@@ -138,6 +167,8 @@ class SparsePermutationEngine:
             disc = jsparse.make_disc_props_sparse(
                 disc_nbr, disc_wgt, disc_data_dev,
                 jnp.asarray(disc_idx), jnp.asarray(mask),
+                corr_nbr=disc_cnbr,
+                corr_wgt=disc_cwgt,
             )
             self.buckets.append(
                 _SparseBucket(cap, pos, disc, jnp.asarray(obs_idx), slices)
@@ -151,7 +182,8 @@ class SparsePermutationEngine:
     perm_keys = staticmethod(PermutationEngine.perm_keys)
 
     def fingerprint_arrays(self):
-        arrays = [self._nbr, self._wgt, self._test_data]
+        arrays = [self._nbr, self._wgt, self._test_data,
+                  self._cnbr, self._cwgt]
         for b in self.buckets:
             arrays.extend(
                 f for f in b.disc if f is not None and hasattr(f, "reshape")
@@ -168,13 +200,14 @@ class SparsePermutationEngine:
                         n_iter=self.config.power_iters,
                         summary_method="eigh",  # observed: exact, runs once
                     ),
-                    in_axes=(0, 0, None, None, None),
+                    in_axes=(0, 0, None, None, None, None, None),
                 )
             )
         out = np.full((self.n_modules, N_STATS), np.nan)
         for b in self.buckets:
             res = self._observed_fn(
-                b.disc, b.obs_idx, self._nbr, self._wgt, self._test_data
+                b.disc, b.obs_idx, self._nbr, self._wgt, self._test_data,
+                self._cnbr, self._cwgt,
             )
             out[b.module_pos] = np.asarray(res, dtype=np.float64)
         return out
@@ -185,6 +218,7 @@ class SparsePermutationEngine:
         constants; see :meth:`PermutationEngine.chunk_args`)."""
         return (
             self._pool_dev, self._nbr, self._wgt, self._test_data,
+            self._cnbr, self._cwgt,
             [b.disc for b in self.buckets],
         )
 
@@ -196,7 +230,7 @@ class SparsePermutationEngine:
         cfg = self.config
         caps_slices = [(b.cap, tuple(b.slices)) for b in self.buckets]
 
-        def chunk(keys: jax.Array, pool, nbr, wgt, td, discs) -> list[jax.Array]:
+        def chunk(keys: jax.Array, pool, nbr, wgt, td, cnbr, cwgt, discs) -> list[jax.Array]:
             perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)
             outs = []
             for (cap, slices), disc in zip(caps_slices, discs):
@@ -212,10 +246,12 @@ class SparsePermutationEngine:
                         n_iter=cfg.power_iters,
                         summary_method=cfg.summary_method,
                     ),
-                    in_axes=(0, 0, None, None, None),
+                    in_axes=(0, 0, None, None, None, None, None),
                 )
-                over_perms = jax.vmap(inner, in_axes=(None, 0, None, None, None))
-                outs.append(over_perms(disc, idx_b, nbr, wgt, td))
+                over_perms = jax.vmap(
+                    inner, in_axes=(None, 0, None, None, None, None, None)
+                )
+                outs.append(over_perms(disc, idx_b, nbr, wgt, td, cnbr, cwgt))
             return outs
 
         return chunk
