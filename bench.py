@@ -1,17 +1,30 @@
-"""Benchmark: the north-star config (BASELINE.json:5) — a 10,000-permutation
-null on a 20,000-gene / 50-module network — on whatever accelerator JAX
-finds (the driver runs this on one real TPU chip).
+"""Benchmarks for the framework's configurations (SURVEY.md §6, §7 step 8;
+BASELINE.md).
 
-Prints ONE JSON line:
+Default (no ``--config``): the north-star — a 10,000-permutation null on a
+20,000-gene / 50-module network (BASELINE.json:5) — on whatever accelerator
+JAX finds (the driver runs this on one real TPU chip). Prints ONE JSON line:
     {"metric": ..., "value": <wall-clock seconds>, "unit": "s",
      "vs_baseline": <target_seconds / value>}
+``vs_baseline`` > 1 means faster than the 60 s north-star target.
 
-``vs_baseline`` > 1 means faster than the 60 s north-star target (which was
-set for a v4-8 slice; this script reports the single-chip number and the
-per-chip permutation throughput in auxiliary fields).
+Other configs (each also prints one JSON line; numbers recorded in
+BASELINE.md):
 
-Usage: python bench.py [--genes N] [--modules K] [--perms P] [--chunk C]
-                       [--samples S] [--dtype float32|bfloat16] [--smoke]
+    --config A       ~100-node toy, 4 modules, 1000 perms: pure-NumPy oracle
+                     (the measurable CPU baseline, SURVEY.md §6) AND the JAX
+                     engine on the same problem/backend
+    --config B       5,000 genes / 20 modules / 10,000 perms
+    --config C       1 discovery x 4 test cohorts: vmapped multi-test path
+                     vs sequential pairs (same problem, same seed)
+    --config D       20,000 genes / 50 modules / 100,000 perms with
+                     checkpointing every 8192
+    --config E       sparse 50k-node kNN graph (k=30, ~1.5M edges),
+                     30 modules, 10,000 perms
+    --config sharded delegates to benchmarks/microbench_sharded_gather.py
+
+Usage: python bench.py [--config X] [--genes N] [--modules K] [--perms P]
+                       [--chunk C] [--samples S] [--dtype D] [--smoke]
 """
 
 from __future__ import annotations
@@ -59,11 +72,289 @@ def build_problem(n_genes, n_modules, n_samples, seed=0):
     return one(k1), one(k2)
 
 
+def make_specs(n_genes, n_modules, lo=30, hi=200, seed=1):
+    from netrep_tpu.parallel.engine import ModuleSpec
+
+    rng = np.random.default_rng(seed)
+    sizes = np.exp(rng.uniform(np.log(lo), np.log(hi), size=n_modules)).astype(int)
+    specs, pos = [], 0
+    for k, sz in enumerate(sizes):
+        idx = np.arange(pos, pos + sz, dtype=np.int32)
+        specs.append(ModuleSpec(str(k + 1), idx, idx))
+        pos += sz
+    assert pos <= n_genes, "module sizes exceed gene count"
+    return specs
+
+
+def timed_null(engine, n_perm, chunk, **kw):
+    """Warm up one chunk (compile, excluded — once-per-shape), then time."""
+    import jax
+
+    _ = engine.run_null(chunk, key=99)
+    if hasattr(engine, "_test_corr") and engine._test_corr is not None:
+        jax.block_until_ready(engine._test_corr)
+    t0 = time.perf_counter()
+    nulls, done = engine.run_null(n_perm, key=0, **kw)
+    elapsed = time.perf_counter() - t0
+    assert done == n_perm
+    assert np.isfinite(np.asarray(nulls)).all()
+    return elapsed
+
+
+def emit(payload):
+    print(json.dumps(payload))
+    return 0
+
+
+def resolve(args, genes, modules, perms):
+    """Fill per-config defaults for flags the user did not pass (None
+    default — explicitly passing any value, including a config's own
+    default, is honored as given)."""
+    args.genes = genes if args.genes is None else args.genes
+    args.modules = modules if args.modules is None else args.modules
+    args.perms = perms if args.perms is None else args.perms
+    return args
+
+
+def bench_north(args, label=None):
+    import jax
+
+    resolve(args, 20_000, 50, 10_000)
+
+    from netrep_tpu.parallel.engine import PermutationEngine
+    from netrep_tpu.utils.config import EngineConfig
+
+    (d_data, d_corr, d_net), (t_data, t_corr, t_net) = build_problem(
+        args.genes, args.modules, args.samples
+    )
+    lo, hi = (30, 200) if not args.smoke else (8, 24)
+    specs = make_specs(args.genes, args.modules, lo, hi)
+    pool = np.arange(args.genes, dtype=np.int32)
+    cfg = EngineConfig(chunk_size=args.chunk, summary_method="power",
+                       power_iters=40, dtype=args.dtype)
+    engine = PermutationEngine(
+        d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool, config=cfg
+    )
+    elapsed = timed_null(engine, args.perms, cfg.chunk_size)
+    if label is None:
+        label = "north-star config, BASELINE.json:5"
+    return emit({
+        "metric": (
+            f"wall-clock for {args.perms}-perm null, {args.genes} genes / "
+            f"{args.modules} modules ({label})"
+        ),
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(TARGET_SECONDS / elapsed, 4),
+        "perms_per_sec": round(args.perms / elapsed, 2),
+        "device": str(jax.devices()[0]),
+        "dtype": args.dtype,
+        "chunk": args.chunk,
+    })
+
+
+def bench_a(args):
+    """Config A (BASELINE.json:7): toy fixture; oracle-NumPy vs JAX engine."""
+    import jax
+
+    from netrep_tpu.data import make_example_pair
+    from netrep_tpu.ops import oracle
+    from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+    from netrep_tpu.utils.config import EngineConfig
+
+    resolve(args, 0, 0, 1000)
+    n_perm = args.perms
+    pair = make_example_pair(np.random.default_rng(42))
+    d, t = pair["discovery"], pair["test"]
+    tpos = {nm: i for i, nm in enumerate(t["names"])}
+    specs, disc_props, sizes = [], [], []
+    for lab in sorted(pair["module_sizes"]):
+        nodes = [nm for nm, l in pair["labels"].items() if l == lab]
+        di = np.array([d["names"].index(nm) for nm in nodes if nm in tpos],
+                      dtype=np.int32)
+        ti = np.array([tpos[nm] for nm in nodes if nm in tpos], dtype=np.int32)
+        specs.append(ModuleSpec(lab, di, ti))
+        sizes.append(len(ti))
+        disc_props.append(oracle.DiscoveryProps(
+            d["correlation"][np.ix_(di, di)], d["network"][np.ix_(di, di)],
+            d["data"][:, di],
+        ))
+    pool = np.array([tpos[nm] for nm in d["names"] if nm in tpos],
+                    dtype=np.int32)
+
+    t0 = time.perf_counter()
+    nulls_o = oracle.permutation_null(
+        disc_props, sizes, t["correlation"], t["network"], t["data"],
+        pool, n_perm, np.random.default_rng(0),
+    )
+    oracle_s = time.perf_counter() - t0
+    assert np.isfinite(nulls_o).all()
+
+    cfg = EngineConfig(chunk_size=256)
+    engine = PermutationEngine(
+        d["correlation"], d["network"], d["data"],
+        t["correlation"], t["network"], t["data"], specs, pool, config=cfg,
+    )
+    jax_s = timed_null(engine, n_perm, cfg.chunk_size)
+    return emit({
+        "metric": f"Config A toy ({len(specs)} modules, {n_perm} perms): "
+                  "oracle-NumPy CPU vs JAX engine",
+        "value": round(jax_s, 3),
+        "unit": "s",
+        "vs_baseline": round(oracle_s / jax_s, 2),  # speedup over oracle
+        "oracle_cpu_s": round(oracle_s, 3),
+        "oracle_perms_per_sec": round(n_perm / oracle_s, 1),
+        "jax_perms_per_sec": round(n_perm / jax_s, 1),
+        "device": str(jax.devices()[0]),
+    })
+
+
+def bench_b(args):
+    resolve(args, 5000, 20, 10_000)
+    # vs_baseline stays 60s/elapsed — the only defined budget; the metric
+    # names the actual config so the row cannot be mistaken for north-star
+    return bench_north(args, label="Config B, BASELINE.json:8")
+
+
+def bench_c(args):
+    """Config C (BASELINE.json:9): vmapped multi-test vs sequential pairs."""
+    import jax
+
+    from netrep_tpu.parallel.engine import PermutationEngine
+    from netrep_tpu.parallel.multitest import MultiTestEngine
+    from netrep_tpu.utils.config import EngineConfig
+
+    resolve(args, 5000, 20 if not args.smoke else 5, 2000)
+    genes, n_perm = args.genes, args.perms
+    T = 4
+    (d_data, d_corr, d_net), _ = build_problem(genes, args.modules, args.samples)
+    tests = [build_problem(genes, args.modules, args.samples, seed=s + 1)[1]
+             for s in range(T)]
+    lo, hi = (30, 200) if not args.smoke else (8, 24)
+    specs = make_specs(genes, args.modules, lo, hi)
+    pool = np.arange(genes, dtype=np.int32)
+    cfg = EngineConfig(chunk_size=args.chunk, power_iters=40)
+
+    multi = MultiTestEngine(
+        d_corr, d_net, d_data,
+        np.stack([np.asarray(tc) for _, tc, _ in tests]),
+        np.stack([np.asarray(tn) for _, _, tn in tests]),
+        [np.asarray(td) for td, _, _ in tests],
+        specs, pool, config=cfg,
+    )
+    vmap_s = timed_null(multi, n_perm, cfg.chunk_size)
+
+    # compile-fair comparison: each sequential engine is warmed (one chunk)
+    # before its timed run, matching the vmapped path's excluded warm-up —
+    # both numbers are steady-state throughput
+    seq_s = 0.0
+    for td, tc, tn in tests:
+        eng = PermutationEngine(
+            d_corr, d_net, d_data, tc, tn, td, specs, pool, config=cfg
+        )
+        seq_s += timed_null(eng, n_perm, cfg.chunk_size)
+    return emit({
+        "metric": f"Config C ({T} cohorts x {genes} genes, "
+                  f"{args.modules} modules, {n_perm} perms): vmapped "
+                  "multi-test vs sequential pairs (both compile-excluded)",
+        "value": round(vmap_s, 3),
+        "unit": "s",
+        "vs_baseline": round(seq_s / vmap_s, 2),  # speedup over sequential
+        "sequential_s": round(seq_s, 3),
+        "vmap_perms_per_sec": round(n_perm / vmap_s, 2),
+        "device": str(jax.devices()[0]),
+    })
+
+
+def bench_d(args):
+    """Config D (BASELINE.json:10): 100k perms, checkpointing on."""
+    import os
+    import tempfile
+
+    import jax
+
+    from netrep_tpu.parallel.engine import PermutationEngine
+    from netrep_tpu.utils.config import EngineConfig
+
+    resolve(args, 20_000, 50, 100_000)
+    n_perm = args.perms
+    (d_data, d_corr, d_net), (t_data, t_corr, t_net) = build_problem(
+        args.genes, args.modules, args.samples
+    )
+    lo, hi = (30, 200) if not args.smoke else (8, 24)
+    specs = make_specs(args.genes, args.modules, lo, hi)
+    pool = np.arange(args.genes, dtype=np.int32)
+    cfg = EngineConfig(chunk_size=args.chunk, power_iters=40)
+    engine = PermutationEngine(
+        d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool, config=cfg
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        ck = os.path.join(tmp, "null.npz")
+        elapsed = timed_null(engine, n_perm, cfg.chunk_size,
+                             checkpoint_path=ck, checkpoint_every=8192)
+        assert os.path.exists(ck)
+    return emit({
+        "metric": f"Config D ({args.genes} genes / {args.modules} modules, "
+                  f"{n_perm} perms, checkpoint every 8192)",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round((TARGET_SECONDS * n_perm / 10_000) / elapsed, 4),
+        "perms_per_sec": round(n_perm / elapsed, 2),
+        "device": str(jax.devices()[0]),
+    })
+
+
+def bench_e(args):
+    """Config E (BASELINE.json:11): sparse 50k-node kNN graph."""
+    import jax
+
+    from netrep_tpu.ops.sparse import SparseAdjacency
+    from netrep_tpu.parallel.engine import ModuleSpec
+    from netrep_tpu.parallel.sparse import SparsePermutationEngine
+    from netrep_tpu.utils.config import EngineConfig
+
+    resolve(args, 50_000, 30, 10_000)
+    n = args.genes
+    k = 30
+    n_mod = args.modules
+    rng = np.random.default_rng(0)
+    # synthetic kNN-style graph: k random neighbors per node, symmetrized
+    rows = np.repeat(np.arange(n, dtype=np.int64), k)
+    cols = rng.integers(0, n, size=n * k)
+    vals = rng.uniform(0.05, 1.0, size=n * k).astype(np.float32)
+    adj = SparseAdjacency.from_coo(rows, cols, vals, n)
+    data = rng.standard_normal((args.samples, n)).astype(np.float32)
+    lo, hi = (50, 500) if not args.smoke else (8, 24)
+    sizes = np.exp(rng.uniform(np.log(lo), np.log(hi), size=n_mod)).astype(int)
+    specs, pos = [], 0
+    for i, sz in enumerate(sizes):
+        idx = np.arange(pos, pos + sz, dtype=np.int32)
+        specs.append(ModuleSpec(str(i + 1), idx, idx))
+        pos += sz
+    pool = np.arange(n, dtype=np.int32)
+    cfg = EngineConfig(chunk_size=args.chunk, power_iters=40)
+    engine = SparsePermutationEngine(
+        adj, data, adj, data, specs, pool, config=cfg
+    )
+    elapsed = timed_null(engine, args.perms, cfg.chunk_size)
+    return emit({
+        "metric": f"Config E sparse ({n} nodes, k={k}, {adj.nnz} edges, "
+                  f"{n_mod} modules, {args.perms} perms)",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(TARGET_SECONDS / elapsed, 4),
+        "perms_per_sec": round(args.perms / elapsed, 2),
+        "device": str(jax.devices()[0]),
+    })
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--genes", type=int, default=20_000)
-    ap.add_argument("--modules", type=int, default=50)
-    ap.add_argument("--perms", type=int, default=10_000)
+    ap.add_argument("--config", default="north",
+                    choices=["north", "A", "B", "C", "D", "E", "sharded"])
+    ap.add_argument("--genes", type=int, default=None)
+    ap.add_argument("--modules", type=int, default=None)
+    ap.add_argument("--perms", type=int, default=None)
     ap.add_argument("--chunk", type=int, default=256)
     ap.add_argument("--samples", type=int, default=128)
     ap.add_argument("--dtype", default="float32")
@@ -75,60 +366,22 @@ def main():
             500, 5, 64, 32, 32
         )
 
-    import jax
+    if args.config == "sharded":
+        # dispatch BEFORE ensure_backend(): libtpu is exclusive per process,
+        # so the parent must not acquire the chip the child needs
+        import os
+        import subprocess
+
+        return subprocess.call([
+            sys.executable,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "benchmarks", "microbench_sharded_gather.py"),
+        ])
     ensure_backend()
-    from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
-    from netrep_tpu.utils.config import EngineConfig
-
-    (d_data, d_corr, d_net), (t_data, t_corr, t_net) = build_problem(
-        args.genes, args.modules, args.samples
-    )
-
-    # 50 modules with sizes drawn log-uniform in [30, 200] (smoke: scaled)
-    rng = np.random.default_rng(1)
-    lo, hi = (30, 200) if not args.smoke else (8, 24)
-    sizes = np.exp(
-        rng.uniform(np.log(lo), np.log(hi), size=args.modules)
-    ).astype(int)
-    specs, pos = [], 0
-    for k, sz in enumerate(sizes):
-        idx = np.arange(pos, pos + sz, dtype=np.int32)
-        specs.append(ModuleSpec(str(k + 1), idx, idx))
-        pos += sz
-    pool = np.arange(args.genes, dtype=np.int32)
-
-    cfg = EngineConfig(chunk_size=args.chunk, summary_method="power",
-                       power_iters=40, dtype=args.dtype)
-    engine = PermutationEngine(
-        d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool, config=cfg
-    )
-
-    # compile warm-up (one chunk) — excluded from the timed run, matching
-    # "wall-clock for the null" (compile is once-per-shape, BASELINE.json:2)
-    _ = engine.run_null(cfg.chunk_size, key=99)
-    jax.block_until_ready(engine._test_corr)
-
-    t0 = time.perf_counter()
-    nulls, done = engine.run_null(args.perms, key=0)
-    elapsed = time.perf_counter() - t0
-    assert done == args.perms
-    assert np.isfinite(nulls).all()
-
-    perms_per_sec = args.perms / elapsed
-    print(json.dumps({
-        "metric": (
-            f"wall-clock for {args.perms}-perm null, {args.genes} genes / "
-            f"{args.modules} modules (north-star config, BASELINE.json:5)"
-        ),
-        "value": round(elapsed, 3),
-        "unit": "s",
-        "vs_baseline": round(TARGET_SECONDS / elapsed, 4),
-        "perms_per_sec": round(perms_per_sec, 2),
-        "device": str(jax.devices()[0]),
-        "dtype": args.dtype,
-        "chunk": args.chunk,
-    }))
-    return 0
+    return {
+        "north": bench_north, "A": bench_a, "B": bench_b,
+        "C": bench_c, "D": bench_d, "E": bench_e,
+    }[args.config](args)
 
 
 if __name__ == "__main__":
