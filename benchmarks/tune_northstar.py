@@ -1,0 +1,88 @@
+"""North-star tuning sweep: chunk size × perm_batch × dtype × power_iters
+on the real chip, at a reduced permutation count per point so the whole
+sweep stays under ~10 min. Prints one JSON line per point plus a final
+"best" line — feed the winner back into bench.py defaults if it beats them.
+
+Usage: python benchmarks/tune_northstar.py [--perms 2048]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from bench import build_problem, ensure_backend, make_specs  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--perms", type=int, default=2048)
+    ap.add_argument("--genes", type=int, default=20_000)
+    ap.add_argument("--modules", type=int, default=50)
+    ap.add_argument("--samples", type=int, default=128)
+    args = ap.parse_args()
+
+    import jax
+
+    ensure_backend()
+    from netrep_tpu.parallel.engine import PermutationEngine
+    from netrep_tpu.utils.config import EngineConfig
+
+    (d_data, d_corr, d_net), (t_data, t_corr, t_net) = build_problem(
+        args.genes, args.modules, args.samples
+    )
+    lo, hi = (30, 200) if args.genes >= 10_000 else (8, 24)
+    specs = make_specs(args.genes, args.modules, lo, hi)
+    pool = np.arange(args.genes, dtype=np.int32)
+
+    # each point pays a fresh jit compile (~20-40s on TPU) — keep the grid
+    # small: chunk × perm_batch around the current defaults, plus the bf16
+    # matrix variant the config supports but no bench has measured
+    grid = {
+        "chunk_size": [256, 512],
+        "perm_batch": [None, 4],
+        "dtype": ["float32", "bfloat16"],
+        "power_iters": [40],
+    }
+    best = None
+    for chunk, pb, dt, pi in itertools.product(
+        grid["chunk_size"], grid["perm_batch"], grid["dtype"],
+        grid["power_iters"],
+    ):
+        cfg = EngineConfig(chunk_size=chunk, perm_batch=pb, dtype=dt,
+                           power_iters=pi, summary_method="power")
+        try:
+            eng = PermutationEngine(
+                d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool,
+                config=cfg,
+            )
+            _ = eng.run_null(chunk, key=99)  # compile
+            t0 = time.perf_counter()
+            nulls, done = eng.run_null(args.perms, key=0)
+            dt_s = time.perf_counter() - t0
+            ok = done == args.perms and np.isfinite(nulls).all()
+        except Exception as e:  # OOM etc: record and move on
+            print(json.dumps({"chunk": chunk, "perm_batch": pb, "dtype": dt,
+                              "power_iters": pi,
+                              "error": f"{type(e).__name__}"}))
+            continue
+        pps = args.perms / dt_s
+        row = {"chunk": chunk, "perm_batch": pb, "dtype": dt,
+               "power_iters": pi, "s": round(dt_s, 2),
+               "perms_per_sec": round(pps, 1), "ok": bool(ok)}
+        print(json.dumps(row), flush=True)
+        if ok and (best is None or pps > best["perms_per_sec"]):
+            best = row
+    print(json.dumps({"best": best, "device": str(jax.devices()[0])}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
