@@ -99,6 +99,13 @@ def load_library():
 
 
 def _c(a: np.ndarray, dtype) -> np.ndarray:
+    """Adopt ``a`` for the C ABI. Zero-copy when already C-contiguous with
+    the right dtype (``ascontiguousarray`` returns the SAME object then) —
+    the native analogue of the reference's no-copy Armadillo adoption of R
+    matrices (SURVEY.md §2.2 "Zero-copy matrix adoption"); genome-scale
+    float64 matrices are never duplicated. Other dtypes/layouts pay one
+    conversion copy, which the C kernels require. Pinned by
+    tests/test_native.py::test_zero_copy_adoption."""
     return np.ascontiguousarray(a, dtype=dtype)
 
 

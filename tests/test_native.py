@@ -209,3 +209,22 @@ def test_native_seed_handling(rng):
 
     with pytest.raises(TypeError, match="integer seed"):
         eng.run_null(8, key=jax.random.key(0))
+
+
+def test_zero_copy_adoption(rng):
+    """SURVEY.md §2.2 "Zero-copy matrix adoption": C-contiguous float64
+    inputs are adopted without copying (the reference's Armadillo-advanced-
+    constructor behavior); the engine reads the caller's memory directly."""
+    disc, test, specs, pool = _problem(rng)
+    t_corr = np.ascontiguousarray(test[0], dtype=np.float64)
+    eng = native.NativePermutationEngine(
+        disc[0], disc[1], disc[2], t_corr, test[1], test[2], specs, pool
+    )
+    assert eng.core.test_corr is t_corr  # same object, no copy
+    # non-contiguous / wrong-dtype inputs are converted (a required copy)
+    f32 = np.asarray(test[0], dtype=np.float32)
+    eng2 = native.NativePermutationEngine(
+        disc[0], disc[1], disc[2], f32, test[1], test[2], specs, pool
+    )
+    assert eng2.core.test_corr is not f32
+    assert eng2.core.test_corr.dtype == np.float64
