@@ -24,6 +24,7 @@ __all__ = [
     "make_example_pair",
     "SparseAdjacency",
     "sparse_module_preservation",
+    "sparse_network_properties",
     "summarize_trace",
 ]
 
@@ -50,10 +51,10 @@ def __getattr__(name):
         from .ops.sparse import SparseAdjacency
 
         return SparseAdjacency
-    if name == "sparse_module_preservation":
-        from .models.sparse_api import sparse_module_preservation
+    if name in ("sparse_module_preservation", "sparse_network_properties"):
+        from .models import sparse_api
 
-        return sparse_module_preservation
+        return getattr(sparse_api, name)
     if name == "summarize_trace":
         from .utils.profiling import summarize_trace
 

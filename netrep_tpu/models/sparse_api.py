@@ -234,3 +234,112 @@ def sparse_module_preservation(
         n_perm=n_perm,
         completed=completed,
     )
+
+
+def sparse_network_properties(
+    network: SparseAdjacency,
+    data=None,
+    module_assignments=None,
+    names: Sequence[str] | None = None,
+    modules=None,
+    background_label: str = "0",
+) -> dict:
+    """Observed per-module network properties on a sparse network — the
+    Config E twin of :func:`~netrep_tpu.models.properties.network_properties`
+    (the reference's ``networkProperties()``, SURVEY.md §3.2), for one
+    dataset whose modules are defined over its own nodes.
+
+    Returns ``{module: props}`` with the dense surface's keys
+    (``node_names``, ``degree`` normalized to the module max,
+    ``avg_weight``, and — when ``data`` is given — ``summary``,
+    ``contribution``, ``coherence``; None/NaN otherwise). Degree and average
+    edge weight come from the padded neighbor lists, never a dense matrix;
+    the denominator counts all ordered pairs ``m·(m-1)``, matching the
+    dense kernels (absent edges are zeros).
+    """
+    from ..ops import oracle
+
+    if not isinstance(network, SparseAdjacency):
+        raise TypeError("network must be a SparseAdjacency")
+    if data is not None:
+        data = np.asarray(data)
+        if data.ndim != 2 or data.shape[1] != network.n:
+            raise ValueError(
+                f"data must be (n_samples, {network.n}), got "
+                f"{getattr(data, 'shape', None)}"
+            )
+    if names is None:
+        names = [f"node_{i}" for i in range(network.n)]
+    names = [str(n) for n in names]
+    if len(names) != network.n:
+        raise ValueError("names length != network size")
+    if module_assignments is None:
+        raise ValueError(
+            "module_assignments must be provided (node name → label dict or "
+            "per-position label array)"
+        )
+
+    # Observation surface: unlike the preservation path (_resolve_modules),
+    # singleton modules are KEPT — there is no test-overlap requirement; the
+    # dense network_properties twin reports them too (avg_weight NaN).
+    if isinstance(module_assignments, dict):
+        missing = [nm for nm in names if nm not in module_assignments]
+        if missing:
+            raise ValueError(
+                f"module_assignments is missing {len(missing)} node(s), "
+                f"e.g. {missing[:3]}"
+            )
+        per_node = [str(module_assignments[nm]) for nm in names]
+    else:
+        arr = np.asarray(module_assignments)
+        if arr.shape[0] != network.n:
+            raise ValueError(
+                f"module_assignments has {arr.shape[0]} entries but the "
+                f"network has {network.n} nodes"
+            )
+        per_node = [str(l) for l in arr]
+    by_label: dict[str, list[int]] = {}
+    for i, lab in enumerate(per_node):
+        if lab != str(background_label):
+            by_label.setdefault(lab, []).append(i)
+    if modules is not None:
+        wanted = [str(m) for m in modules]
+        unknown = [m for m in wanted if m not in by_label]
+        if unknown:
+            raise ValueError(
+                f"modules {unknown} do not exist in the module assignments"
+            )
+        by_label = {m: by_label[m] for m in wanted}
+    if not by_label:
+        raise ValueError("all nodes carry the background label; no modules")
+
+    out = {}
+    for lab, node_pos in by_label.items():
+        idx = np.asarray(node_pos, dtype=np.int64)
+        m = idx.size
+        nbr_rows = network.nbr[idx]                   # (m, k)
+        wgt_rows = network.wgt[idx].astype(np.float64)
+        member = np.isin(nbr_rows, idx) & (nbr_rows != idx[:, None])
+        deg = (wgt_rows * member).sum(axis=1)
+        dmax = np.max(np.abs(deg))
+        props = {
+            "node_names": [names[i] for i in idx],
+            "degree": deg / dmax if dmax > 0 else deg,
+            # m<2: no pairs — NaN, matching oracle.avg_edge_weight
+            "avg_weight": (
+                float(deg.sum() / (m * (m - 1))) if m > 1 else float("nan")
+            ),
+            "summary": None,
+            "contribution": None,
+            "coherence": float("nan"),
+        }
+        if data is not None:
+            dat = data[:, idx]
+            prof = oracle.summary_profile(dat)
+            nc = oracle.node_contribution(dat, prof)
+            props.update(
+                summary=prof, contribution=nc,
+                coherence=float(np.mean(nc**2)),
+            )
+        out[lab] = props
+    return out

@@ -385,3 +385,92 @@ def test_sparse_api_precomputed_correlation_dataless(rng):
             discovery_correlation=d_cg, test_correlation=bad,
             discovery_names=d_names, test_names=t_names, n_perm=8,
         )
+
+
+def test_sparse_network_properties_matches_dense(rng):
+    """sparse_network_properties equals the dense network_properties on a
+    densified graph (same oracle math; degree/avg_weight from neighbor
+    lists), with and without data."""
+    from netrep_tpu import sparse_network_properties
+    from netrep_tpu.models.properties import network_properties
+
+    (d_adj, d_data), _, specs, pool = _knn_problem(rng)
+    names = [f"c{i}" for i in range(d_adj.n)]
+    labels = {nm: "0" for nm in names}
+    for m in specs:
+        for i in m.disc_idx:
+            labels[names[i]] = m.label
+
+    try:
+        import pandas as pd
+    except Exception:
+        pytest.skip("pandas required")
+    dense_net = pd.DataFrame(d_adj.to_dense(), index=names, columns=names)
+    # network_properties requires a correlation argument (dense surface
+    # contract); the properties themselves don't read it
+    dense_corr = pd.DataFrame(
+        np.corrcoef(d_data, rowvar=False), index=names, columns=names
+    )
+
+    for with_data in (True, False):
+        dat = d_data if with_data else None
+        sp = sparse_network_properties(
+            d_adj, data=dat, module_assignments=labels, names=names
+        )
+        dn = network_properties(
+            network={"d": dense_net},
+            correlation={"d": dense_corr},
+            data={"d": pd.DataFrame(dat, columns=names)} if with_data else None,
+            module_assignments=labels,
+            discovery="d", test="d",
+        )
+        assert set(sp) == set(dn)
+        for lab in sp:
+            assert sp[lab]["node_names"] == dn[lab]["node_names"]
+            np.testing.assert_allclose(sp[lab]["degree"], dn[lab]["degree"],
+                                       atol=1e-6)
+            np.testing.assert_allclose(sp[lab]["avg_weight"],
+                                       dn[lab]["avg_weight"], atol=1e-6)
+            if with_data:
+                np.testing.assert_allclose(sp[lab]["coherence"],
+                                           dn[lab]["coherence"], atol=1e-6)
+                np.testing.assert_allclose(sp[lab]["summary"],
+                                           dn[lab]["summary"], atol=1e-6)
+                np.testing.assert_allclose(sp[lab]["contribution"],
+                                           dn[lab]["contribution"], atol=1e-6)
+            else:
+                assert sp[lab]["summary"] is None
+                assert np.isnan(sp[lab]["coherence"])
+
+    with pytest.raises(TypeError, match="SparseAdjacency"):
+        sparse_network_properties(d_adj.to_dense(), module_assignments=labels)
+    with pytest.raises(ValueError, match="names length"):
+        sparse_network_properties(d_adj, module_assignments=labels,
+                                  names=["a"])
+
+
+def test_sparse_network_properties_singletons_and_validation(rng):
+    """Observation surface semantics (unlike the preservation path):
+    singleton modules are reported (avg_weight NaN, degree [0]), and the
+    documented errors fire."""
+    from netrep_tpu import sparse_network_properties
+
+    (d_adj, _d), _, _specs, _pool = _knn_problem(rng)
+    labels = np.full(d_adj.n, "0", dtype=object)
+    labels[0] = "solo"
+    labels[1:4] = "trio"
+    props = sparse_network_properties(d_adj, module_assignments=labels)
+    assert set(props) == {"solo", "trio"}
+    assert np.isnan(props["solo"]["avg_weight"])
+    assert props["solo"]["degree"].tolist() == [0.0]
+    assert np.isfinite(props["trio"]["avg_weight"])
+
+    with pytest.raises(ValueError, match="module_assignments must be provided"):
+        sparse_network_properties(d_adj)
+    with pytest.raises(ValueError, match="do not exist"):
+        sparse_network_properties(d_adj, module_assignments=labels,
+                                  modules=["zebra"])
+    with pytest.raises(ValueError, match="background label"):
+        sparse_network_properties(
+            d_adj, module_assignments=np.full(d_adj.n, "0", dtype=object)
+        )
