@@ -39,13 +39,37 @@ import numpy as np
 TARGET_SECONDS = 60.0  # BASELINE.json:5 north-star
 
 
-def ensure_backend():
+def ensure_backend(probe_timeout: float = 120.0):
     """Resolve a usable JAX backend. The driver environment pins
     JAX_PLATFORMS=axon (the TPU tunnel), whose plugin registration is
-    flaky — when it fails, fall back to automatic backend selection (which
-    finds the same TPU via libtpu, else CPU)."""
+    flaky — and whose ``jax.devices()`` HANGS indefinitely (not errors)
+    when the tunnel is down. Probe in a killable subprocess first so a dead
+    tunnel produces a fast, explicit error line instead of an opaque hang;
+    registration errors still fall back to automatic backend selection."""
+    import os
+    import subprocess
+
     import jax
 
+    if os.environ.get("JAX_PLATFORMS", "") == "axon":
+        try:
+            # only a TIMEOUT means the tunnel is hung-dead; a fast nonzero
+            # exit (e.g. plugin registration RuntimeError) falls through to
+            # the auto-backend fallback below, as before
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=probe_timeout, capture_output=True,
+            )
+        except subprocess.TimeoutExpired:
+            print(json.dumps({
+                "metric": "backend probe",
+                "error": (
+                    "TPU tunnel (axon) unreachable: jax.devices() probe "
+                    f"did not complete in {probe_timeout:.0f}s; aborting "
+                    "instead of hanging. Re-run when the tunnel is up."
+                ),
+            }))
+            raise SystemExit(1)
     try:
         return jax.devices()
     except RuntimeError:

@@ -23,6 +23,35 @@ from .results import PreservationResult
 logger = logging.getLogger("netrep_tpu")
 
 
+def _normalize_assignments(
+    labels: dict[str, str] | Sequence,
+    names: list[str],
+    what: str = "network",
+) -> dict[str, str]:
+    """Dict/positional-array module-assignment normalization shared by the
+    sparse surfaces: node name → str label, every node covered."""
+    if labels is None:
+        raise ValueError(
+            "module_assignments must be provided (node name → label dict or "
+            "per-position label array)"
+        )
+    if isinstance(labels, dict):
+        missing = [nm for nm in names if nm not in labels]
+        if missing:
+            raise ValueError(
+                f"module_assignments is missing {len(missing)} {what} "
+                f"node(s), e.g. {missing[:3]}"
+            )
+        return {nm: str(labels[nm]) for nm in names}
+    labels = np.asarray(labels)
+    if labels.shape[0] != len(names):
+        raise ValueError(
+            f"module_assignments has {labels.shape[0]} entries but the "
+            f"{what} network has {len(names)} nodes"
+        )
+    return {nm: str(l) for nm, l in zip(names, labels)}
+
+
 def _resolve_modules(
     labels: dict[str, str] | Sequence,
     disc_names: list[str],
@@ -36,22 +65,7 @@ def _resolve_modules(
     dict/positional-array normalization the sparse surface accepts."""
     from .dataset import module_overlap_names
 
-    if not isinstance(labels, dict):
-        labels = np.asarray(labels)
-        if labels.shape[0] != len(disc_names):
-            raise ValueError(
-                f"module_assignments has {labels.shape[0]} entries but the "
-                f"discovery network has {len(disc_names)} nodes"
-            )
-        assignments = {nm: str(l) for nm, l in zip(disc_names, labels)}
-    else:
-        missing = [nm for nm in disc_names if nm not in labels]
-        if missing:
-            raise ValueError(
-                f"module_assignments is missing {len(missing)} discovery "
-                f"node(s), e.g. {missing[:3]}"
-            )
-        assignments = {nm: str(labels[nm]) for nm in disc_names}
+    assignments = _normalize_assignments(labels, disc_names, "discovery")
 
     all_labels, raw_specs, counts = module_overlap_names(
         disc_names, test_names, assignments, modules, background_label,
@@ -273,31 +287,11 @@ def sparse_network_properties(
     names = [str(n) for n in names]
     if len(names) != network.n:
         raise ValueError("names length != network size")
-    if module_assignments is None:
-        raise ValueError(
-            "module_assignments must be provided (node name → label dict or "
-            "per-position label array)"
-        )
-
     # Observation surface: unlike the preservation path (_resolve_modules),
     # singleton modules are KEPT — there is no test-overlap requirement; the
     # dense network_properties twin reports them too (avg_weight NaN).
-    if isinstance(module_assignments, dict):
-        missing = [nm for nm in names if nm not in module_assignments]
-        if missing:
-            raise ValueError(
-                f"module_assignments is missing {len(missing)} node(s), "
-                f"e.g. {missing[:3]}"
-            )
-        per_node = [str(module_assignments[nm]) for nm in names]
-    else:
-        arr = np.asarray(module_assignments)
-        if arr.shape[0] != network.n:
-            raise ValueError(
-                f"module_assignments has {arr.shape[0]} entries but the "
-                f"network has {network.n} nodes"
-            )
-        per_node = [str(l) for l in arr]
+    assignments = _normalize_assignments(module_assignments, names)
+    per_node = [assignments[nm] for nm in names]
     by_label: dict[str, list[int]] = {}
     for i, lab in enumerate(per_node):
         if lab != str(background_label):
