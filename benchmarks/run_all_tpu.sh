@@ -1,0 +1,23 @@
+#!/bin/bash
+# One-shot: every pending TPU measurement for BASELINE.md (VERDICT r1 items
+# 1/3/4). Run when the axon tunnel is up; each line is appended to the log
+# as it lands so a mid-run tunnel death loses nothing.
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/tpu_bench_results.jsonl}
+echo "== $(date -u +%FT%TZ) TPU bench sweep ==" | tee -a "$LOG"
+
+run() {
+  echo "--- $* ---" | tee -a "$LOG"
+  timeout "${T:-900}" "$@" 2>&1 | grep -v WARNING | tee -a "$LOG"
+}
+
+T=300  run python bench.py --smoke                     # tunnel sanity
+T=600  run python bench.py --config B
+T=900  run python bench.py --config C
+T=600  run python bench.py --config E
+T=900  run python benchmarks/microbench_sharded_gather.py
+T=2400 run python benchmarks/tune_northstar.py
+T=600  run python bench.py                             # north-star, current
+T=2400 run python bench.py --config D                  # 100k perms, longest
+echo "== done $(date -u +%FT%TZ) ==" | tee -a "$LOG"
