@@ -23,6 +23,17 @@ from .results import PreservationResult
 logger = logging.getLogger("netrep_tpu")
 
 
+def _normalize_names(names, n: int) -> list[str]:
+    """Node-name normalization shared by the sparse surfaces: positional
+    ``node_{i}`` defaults, stringify, length check."""
+    if names is None:
+        return [f"node_{i}" for i in range(n)]
+    names = [str(nm) for nm in names]
+    if len(names) != n:
+        raise ValueError("names length != network size")
+    return names
+
+
 def _normalize_assignments(
     labels: dict[str, str] | Sequence,
     names: list[str],
@@ -282,11 +293,7 @@ def sparse_network_properties(
                 f"data must be (n_samples, {network.n}), got "
                 f"{getattr(data, 'shape', None)}"
             )
-    if names is None:
-        names = [f"node_{i}" for i in range(network.n)]
-    names = [str(n) for n in names]
-    if len(names) != network.n:
-        raise ValueError("names length != network size")
+    names = _normalize_names(names, network.n)
     # Observation surface: unlike the preservation path (_resolve_modules),
     # singleton modules are KEPT — there is no test-overlap requirement; the
     # dense network_properties twin reports them too (avg_weight NaN).

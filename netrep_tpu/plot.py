@@ -52,6 +52,7 @@ from .ops import oracle  # noqa: E402
 
 __all__ = [
     "plot_module",
+    "plot_module_sparse",
     "plot_data",
     "plot_correlation",
     "plot_network",
@@ -512,3 +513,113 @@ def plot_module(
         fontsize=11, y=0.995,
     )
     return fig, axes
+
+
+def plot_module_sparse(
+    network,
+    data=None,
+    correlation=None,
+    module_assignments=None,
+    names=None,
+    modules=None,
+    background_label: str = "0",
+    max_nodes: int = 4000,
+    **kw,
+):
+    """Composite module plot for SPARSE networks (Config E): densify ONLY
+    the requested modules' subgraph — m ≪ n nodes, so the m×m panels are
+    cheap even when the full n×n matrix could never exist — and reuse
+    :func:`plot_module`'s panel stack.
+
+    Parameters mirror :func:`~netrep_tpu.models.sparse_api.sparse_module_preservation`
+    where they apply: ``network`` is a
+    :class:`~netrep_tpu.ops.sparse.SparseAdjacency`; ``correlation`` an
+    optional sparse correlation in the same format (used for the
+    correlation heatmap when given; otherwise it derives from ``data``; one
+    of the two is required). ``max_nodes`` guards against accidentally
+    densifying a huge node set — pass an explicit ``modules=`` selection
+    for large graphs. Remaining keyword arguments forward to
+    :func:`plot_module`.
+    """
+    import pandas as pd
+
+    from .models.sparse_api import _normalize_assignments, _normalize_names
+    from .ops.sparse import SparseAdjacency
+
+    if not isinstance(network, SparseAdjacency):
+        raise TypeError("network must be a SparseAdjacency")
+    if data is None and correlation is None:
+        raise ValueError(
+            "provide data= and/or correlation= (sparse): the correlation "
+            "heatmap panel needs one of them"
+        )
+    if data is not None:
+        data = np.asarray(data)
+        if data.ndim != 2 or data.shape[1] != network.n:
+            raise ValueError(
+                f"data must be (n_samples, {network.n}), got "
+                f"{getattr(data, 'shape', None)}"
+            )
+    if correlation is not None and (
+        not isinstance(correlation, SparseAdjacency)
+        or correlation.n != network.n
+    ):
+        raise ValueError(
+            "correlation must be a SparseAdjacency over the same "
+            f"{network.n} nodes"
+        )
+    names = _normalize_names(names, network.n)
+    assignments = _normalize_assignments(module_assignments, names)
+
+    wanted = (
+        [str(m) for m in modules] if modules is not None
+        else sorted({l for l in assignments.values()
+                     if l != str(background_label)})
+    )
+    keep = [i for i, nm in enumerate(names) if assignments[nm] in wanted]
+    if not keep:
+        raise ValueError(f"no nodes carry module label(s) {wanted}")
+    if len(keep) > max_nodes:
+        raise ValueError(
+            f"selected modules cover {len(keep)} nodes (> max_nodes="
+            f"{max_nodes}); pass a smaller modules= selection"
+        )
+    idx = np.asarray(keep, dtype=np.int64)
+    sub_names = [names[i] for i in idx]
+
+    # global node id → local position (or -1), shared by both densify calls;
+    # width n+1 so sentinel-padded neighbor ids (== n) land on the -1 slot
+    local_of = np.full(network.n + 1, -1, dtype=np.int64)
+    local_of[idx] = np.arange(idx.size)
+
+    def densify(adj, diag):
+        nbr = adj.nbr[idx]                       # (m, k) global neighbor ids
+        wgt = adj.wgt[idx].astype(np.float64)
+        cols = local_of[nbr]                     # (m, k) local cols or -1
+        rows = np.broadcast_to(
+            np.arange(idx.size)[:, None], nbr.shape
+        )
+        keep = cols >= 0
+        out = np.zeros((idx.size, idx.size))
+        out[rows[keep], cols[keep]] = wgt[keep]
+        np.fill_diagonal(out, diag)
+        return pd.DataFrame(out, index=sub_names, columns=sub_names)
+
+    net_df = densify(network, 1.0)
+    if correlation is not None:
+        corr_df = densify(correlation, 1.0)
+    else:
+        sub = np.asarray(data)[:, idx]
+        corr_df = pd.DataFrame(
+            np.corrcoef(sub, rowvar=False), index=sub_names, columns=sub_names
+        )
+    data_df = (
+        pd.DataFrame(np.asarray(data)[:, idx], columns=sub_names)
+        if data is not None else None
+    )
+    sub_assign = {nm: assignments[nm] for nm in sub_names}
+    return plot_module(
+        network=net_df, data=data_df, correlation=corr_df,
+        module_assignments=sub_assign, modules=wanted,
+        background_label=background_label, **kw,
+    )

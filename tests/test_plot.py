@@ -120,3 +120,45 @@ def test_bad_order_dataset_raises(ex):
     with pytest.raises(ValueError, match="order_nodes_by"):
         nplot._prepare(**_inputs(ex), discovery="d", test="t",
                        order_nodes_by="nope")
+
+
+def test_plot_module_sparse():
+    """Sparse composite plot: densifies only the module subgraph and reuses
+    the dense panel stack (Config E visualization)."""
+    from netrep_tpu.ops.sparse import SparseAdjacency
+    from netrep_tpu.plot import plot_module_sparse
+
+    r = np.random.default_rng(3)
+    n, k = 60, 5
+    x = r.standard_normal((20, n))
+    x[:, :12] += 1.1 * r.standard_normal(20)[:, None]
+    aff = np.abs(np.corrcoef(x, rowvar=False))
+    np.fill_diagonal(aff, 0.0)
+    rows = np.repeat(np.arange(n), k)
+    cols = np.argsort(aff, axis=1)[:, -k:].ravel()
+    adj = SparseAdjacency.from_coo(rows, cols, aff[rows, cols], n)
+    labels = ["M1"] * 12 + ["M2"] * 8 + ["0"] * (n - 20)
+
+    fig, axes = plot_module_sparse(
+        adj, data=x, module_assignments=labels, modules=["M1"],
+    )
+    assert set(axes) >= {"data", "correlation", "network", "degree"}
+    plt.close(fig)
+
+    # data-less with a precomputed sparse correlation
+    c = np.corrcoef(x, rowvar=False)
+    cg = SparseAdjacency.from_coo(rows, cols, c[rows, cols], n)
+    fig2, axes2 = plot_module_sparse(
+        adj, correlation=cg, module_assignments=labels,
+    )
+    assert "data" not in axes2 and "correlation" in axes2
+    plt.close(fig2)
+
+    with pytest.raises(ValueError, match="data= and/or correlation="):
+        plot_module_sparse(adj, module_assignments=labels)
+    with pytest.raises(ValueError, match="max_nodes"):
+        plot_module_sparse(adj, data=x, module_assignments=labels,
+                           max_nodes=5)
+    with pytest.raises(TypeError, match="SparseAdjacency"):
+        plot_module_sparse(adj.to_dense(), data=x,
+                           module_assignments=labels)
