@@ -50,10 +50,11 @@ class SparsePermutationEngine:
     ----------
     disc_adj, test_adj : :class:`~netrep_tpu.ops.sparse.SparseAdjacency`.
     disc_data, test_data : (n_samples, n) data matrices or None. Without
-        data, only ``avg.weight`` and ``cor.degree`` are defined (the
-        correlation-based statistics need the on-the-fly correlation —
-        see :mod:`netrep_tpu.ops.sparse` on why sparse data-less differs
-        from dense data-less).
+        data, a precomputed sparse correlation (``disc_corr``/``test_corr``
+        below) keeps four statistics finite; with neither, only
+        ``avg.weight`` and ``cor.degree`` are defined (see
+        :mod:`netrep_tpu.ops.sparse` on why sparse data-less differs from
+        dense data-less).
     modules : ordered :class:`ModuleSpec` list (discovery/test index pairs).
     pool : candidate test-node ids the null samples from (SURVEY.md §3.1).
     config, mesh : as for :class:`PermutationEngine`; ``mesh`` shards the
