@@ -21,6 +21,10 @@ BASELINE.md):
                      checkpointing every 8192
     --config E       sparse 50k-node kNN graph (k=30, ~1.5M edges),
                      30 modules, 10,000 perms
+    --config oracle  pure-NumPy oracle (the reference-style CPU loop) on the
+                     north-star problem shape at a reduced permutation count
+                     (default 50) — the per-config "oracle-CPU" baseline row;
+                     combine with --genes/--modules for other shapes
     --config sharded delegates to benchmarks/microbench_sharded_gather.py
 
 Usage: python bench.py [--config X] [--genes N] [--modules K] [--perms P]
@@ -233,6 +237,60 @@ def bench_a(args):
     })
 
 
+def bench_oracle(args):
+    """Oracle-CPU row for arbitrary problem shapes (BASELINE.md "oracle-CPU
+    row per config"): the pure-NumPy reference loop on the same synthetic
+    problem the JAX configs use, at a reduced permutation count (wall-clock
+    per permutation is what matters; the loop is embarrassingly linear in
+    n_perm)."""
+    from netrep_tpu.ops import oracle
+
+    resolve(args, 20_000, 50, 50)
+    (d_data, d_corr, d_net), (t_data, t_corr, t_net) = [
+        tuple(np.asarray(a) for a in side)
+        for side in build_problem(args.genes, args.modules, args.samples)
+    ]
+    # SAME module-size rule as the JAX configs — the oracle row must measure
+    # the same problem the accelerated row runs, not an easier one
+    lo, hi = (30, 200) if not args.smoke else (8, 24)
+    specs = make_specs(args.genes, args.modules, lo, hi)
+    pool = np.arange(args.genes, dtype=np.int32)
+
+    disc_props = [
+        oracle.DiscoveryProps(
+            d_corr[np.ix_(m.disc_idx, m.disc_idx)],
+            d_net[np.ix_(m.disc_idx, m.disc_idx)],
+            d_data[:, m.disc_idx],
+        )
+        for m in specs
+    ]
+    sizes = [m.size for m in specs]
+    from threadpoolctl import threadpool_limits
+
+    t0 = time.perf_counter()
+    with threadpool_limits(limits=1):  # honest single-thread baseline
+        nulls = oracle.permutation_null(
+            disc_props, sizes, t_corr, t_net, t_data, pool, args.perms,
+            np.random.default_rng(0),
+        )
+    elapsed = time.perf_counter() - t0
+    assert np.isfinite(nulls).all()
+    pps = args.perms / elapsed
+    return emit({
+        "metric": (
+            f"oracle-NumPy CPU loop, {args.genes} genes / {args.modules} "
+            f"modules ({args.perms} perms measured; reference-style "
+            "baseline, BLAS pinned to 1 thread)"
+        ),
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(pps * TARGET_SECONDS / 10_000, 4),
+        "perms_per_sec": round(pps, 3),
+        "projected_10k_perm_s": round(10_000 / pps, 1),
+        "device": "CPU (oracle)",
+    })
+
+
 def bench_b(args):
     resolve(args, 5000, 20, 10_000)
     # vs_baseline stays 60s/elapsed — the only defined budget; the metric
@@ -375,7 +433,8 @@ def bench_e(args):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="north",
-                    choices=["north", "A", "B", "C", "D", "E", "sharded"])
+                    choices=["north", "A", "B", "C", "D", "E", "oracle",
+                             "sharded"])
     ap.add_argument("--genes", type=int, default=None)
     ap.add_argument("--modules", type=int, default=None)
     ap.add_argument("--perms", type=int, default=None)
@@ -401,10 +460,21 @@ def main():
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "benchmarks", "microbench_sharded_gather.py"),
         ])
+    if args.config == "oracle":
+        # pure-CPU config: must run even when the TPU tunnel is hung (the
+        # exact situation where the CPU baseline is the only runnable bench).
+        # Both the live config AND the env var flip: ensure_backend's hang
+        # probe triggers off the env var.
+        import os
+
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        os.environ["JAX_PLATFORMS"] = "cpu"
     ensure_backend()
     return {
         "north": bench_north, "A": bench_a, "B": bench_b,
-        "C": bench_c, "D": bench_d, "E": bench_e,
+        "C": bench_c, "D": bench_d, "E": bench_e, "oracle": bench_oracle,
     }[args.config](args)
 
 
