@@ -335,10 +335,11 @@ def gather_and_stats_mxu(
     disc: DiscProps,
     idx: jnp.ndarray,          # (m,) int32 test-node indices (padded)
     test_corr: jnp.ndarray,    # (n, n)
-    test_net: jnp.ndarray,     # (n, n)
+    test_net: jnp.ndarray | None,    # (n, n); None with net_beta set
     test_dataT: jnp.ndarray | None,  # (n, n_samples) TRANSPOSED data
     n_iter: int = 60,
     summary_method: str = "power",
+    net_beta: float | None = None,
 ) -> jnp.ndarray:
     """MXU/DMA-friendly variant of :func:`gather_and_stats` (see
     :func:`gather_submatrix_mxu`), ~10-20x faster on TPU at genome scale,
@@ -363,7 +364,14 @@ def gather_and_stats_mxu(
     unsort = (pos == order[:, None]).astype(test_corr.dtype)      # P (m, m)
 
     sub_corr = gather_submatrix_mxu(test_corr, idx_sorted, unsort)
-    sub_net = gather_submatrix_mxu(test_net, idx_sorted, unsort)
+    # derived network (net_beta): |corr|**β of the GATHERED submatrix —
+    # halves the row traffic of the bandwidth-bound hot loop and avoids the
+    # second gather's own bf16 selection rounding (the derived values carry
+    # only the corr gather's rounding, amplified ~β× by the power)
+    sub_net = (
+        derived_net(sub_corr, net_beta) if test_net is None
+        else gather_submatrix_mxu(test_net, idx_sorted, unsort)
+    )
 
     if test_dataT is not None:
         rows_d = jnp.take(test_dataT, idx_sorted, axis=0, mode="clip")  # (m, s)
@@ -392,14 +400,26 @@ def gather_zdata(
     return standardize_masked(jnp.swapaxes(sub_d, -1, -2), mask)
 
 
+def derived_net(sub_corr: jnp.ndarray, net_beta: float) -> jnp.ndarray:
+    """Soft-threshold network submatrix derived on device from the gathered
+    correlation: ``|corr|**β`` (the WGCNA construction). Deriving instead of
+    gathering a stored n×n network halves the hot loop's HBM row traffic and
+    the engine's matrix footprint (BASELINE.md roofline: the gather is
+    bandwidth-bound) — elementwise functions commute with gathers, so the
+    result equals gathering a precomputed ``|corr|**β`` matrix up to
+    float rounding."""
+    return jnp.abs(sub_corr) ** net_beta
+
+
 def gather_and_stats(
     disc: DiscProps,
     idx: jnp.ndarray,          # (..., m) int32 test-node indices (padded)
     test_corr: jnp.ndarray,    # (n, n)
-    test_net: jnp.ndarray,     # (n, n)
+    test_net: jnp.ndarray | None,    # (n, n); None with net_beta set
     test_dataT: jnp.ndarray | None,  # (n, n_samples) TRANSPOSED data
     n_iter: int = 60,
     summary_method: str = "power",
+    net_beta: float | None = None,
 ) -> jnp.ndarray:
     """Gather a module's test submatrices by index and compute the seven
     statistics — the per-permutation unit of work in the reference's hot loop
@@ -419,7 +439,10 @@ def gather_and_stats(
     (measured ~10x whole-chunk slowdown — the round-1 ``direct`` mode's
     mistake)."""
     sub_corr = test_corr[idx[:, None], idx[None, :]]
-    sub_net = test_net[idx[:, None], idx[None, :]]
+    sub_net = (
+        derived_net(sub_corr, net_beta) if test_net is None
+        else test_net[idx[:, None], idx[None, :]]
+    )
     zdata = gather_zdata(test_dataT, idx, disc.mask) if test_dataT is not None else None
     return module_stats_masked(
         disc, sub_corr, sub_net, zdata, n_iter=n_iter, summary_method=summary_method

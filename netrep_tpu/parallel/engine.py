@@ -160,14 +160,40 @@ def _perm_keys_jit(key: jax.Array, start: jax.Array, count: int) -> jax.Array:
     )
 
 
-def make_row_sharded_observed(gather_rep) -> Callable:
+def check_derived_network(corr, net, beta: float, what: str) -> None:
+    """Sample-check that ``net == |corr|**beta`` before the engine commits to
+    deriving network submatrices on device
+    (``EngineConfig.network_from_correlation``): a strided sample of up to
+    64k entries per matrix; a mismatch means the knob contradicts the data
+    the user actually supplied."""
+    c = np.asarray(corr).reshape(-1)
+    m = np.asarray(net).reshape(-1)
+    # ceil-stride so the sample SPANS the whole matrix (a floor stride
+    # truncates the tail and can alias onto one column when size % 65536==0)
+    step = -(-c.size // 65536)
+    want = np.abs(c[::step]) ** beta
+    got = m[::step]
+    if not np.allclose(got, want, rtol=1e-3, atol=1e-4):
+        worst = float(np.max(np.abs(got - want)))
+        raise ValueError(
+            f"network_from_correlation={beta} but the supplied {what} "
+            f"network is not |correlation|**{beta} (max sampled deviation "
+            f"{worst:.3g}); drop the config knob or fix the inputs"
+        )
+
+
+def make_row_sharded_observed(gather_rep, net_beta: float | None = None) -> Callable:
     """Jitted observed-pass kernel over row-sharded matrices: collective
     gather + exact-eigh statistics. Shared by :class:`PermutationEngine` and
-    ``MultiTestEngine`` so the two observed paths cannot drift."""
+    ``MultiTestEngine`` so the two observed paths cannot drift. With
+    ``net_beta`` the network submatrix derives from the gathered correlation
+    (``tn`` is None then)."""
+
+    from .sharded import gather_corr_net
 
     @jax.jit
     def _obs(disc, idx, tc, tn, tdT):
-        sub_c, sub_n = gather_rep(tc, tn, idx)
+        sub_c, sub_n = gather_corr_net(gather_rep, tc, tn, idx, net_beta)
         zd = (
             jstats.gather_zdata(tdT, idx, disc.mask)
             if tdT is not None else None
@@ -275,6 +301,19 @@ class PermutationEngine:
         # r1 item 3 lifted the old row_sharded → 'direct' force): 'mxu' on
         # accelerators, 'direct' on CPU, per EngineConfig.gather_mode.
         self.gather_mode = config.resolved_gather_mode(jax.default_backend())
+        # Derived-network mode: never store/gather the n×n network on device
+        # (EngineConfig.network_from_correlation) — submatrices come from
+        # |gathered corr|**β. Sample-check the claim against the supplied
+        # matrices first.
+        self.net_beta = config.network_from_correlation
+        if self.net_beta is not None:
+            check_derived_network(
+                disc_corr, disc_net, self.net_beta, "discovery"
+            )
+            if not discovery_only:
+                check_derived_network(
+                    test_corr, test_net, self.net_beta, "test"
+                )
         if self.row_sharded:
             # bound for the sharded gatherer's per-dispatch working set on
             # the LOCAL permutation axis (mirrors the replicated path's
@@ -305,8 +344,12 @@ class PermutationEngine:
             self._test_corr = shard_rows(
                 jnp.asarray(pad_square_to_multiple(test_corr, d_row), dtype), mesh
             )
-            self._test_net = shard_rows(
-                jnp.asarray(pad_square_to_multiple(test_net, d_row), dtype), mesh
+            self._test_net = (
+                None if self.net_beta is not None
+                else shard_rows(
+                    jnp.asarray(pad_square_to_multiple(test_net, d_row), dtype),
+                    mesh,
+                )
             )
             self._gather_perm = make_sharded_gatherer(
                 mesh, config.mesh_axis, mode=self.gather_mode,
@@ -317,7 +360,10 @@ class PermutationEngine:
             )
         else:
             self._test_corr = jnp.asarray(test_corr, dtype)
-            self._test_net = jnp.asarray(test_net, dtype)
+            self._test_net = (
+                None if self.net_beta is not None
+                else jnp.asarray(test_net, dtype)
+            )
         # The data matrix is transposed ONCE at init and ONLY the transposed
         # copy is kept on device: every mode then slices per-module data as a
         # row gather of (n, n_samples). Gathering columns of the
@@ -363,6 +409,7 @@ class PermutationEngine:
         # The discovery matrices ride as jit ARGUMENTS (not closure
         # captures — captured device arrays become compile-time constants:
         # 3.2 GB baked into the bucket-build executable at Config D scale).
+        net_beta = self.net_beta
         if self.row_sharded:
             from .mesh import ROW_AXIS
             from .sharded import pad_square_to_multiple, shard_rows
@@ -372,15 +419,24 @@ class PermutationEngine:
                 jnp.asarray(pad_square_to_multiple(disc_corr, d_row), jnp.float32),
                 mesh,
             )
-            d_net = shard_rows(
-                jnp.asarray(pad_square_to_multiple(disc_net, d_row), jnp.float32),
-                mesh,
+            d_net = (
+                None if net_beta is not None
+                else shard_rows(
+                    jnp.asarray(
+                        pad_square_to_multiple(disc_net, d_row), jnp.float32
+                    ),
+                    mesh,
+                )
             )
             gather_rep = self._gather_rep
 
+            from .sharded import gather_corr_net
+
             @jax.jit
             def _disc_bucket(dc, dn, dd, idx, mask):
-                corr_b, net_b = gather_rep(dc, dn, idx)
+                corr_b, net_b = gather_corr_net(
+                    gather_rep, dc, dn, idx, net_beta
+                )
                 data_b = (
                     jax.vmap(lambda ix: jnp.take(dd, ix, axis=1))(idx)
                     if dd is not None
@@ -389,14 +445,20 @@ class PermutationEngine:
                 return jstats.make_disc_props(corr_b, net_b, data_b, mask)
         else:
             d_corr = jnp.asarray(disc_corr, jnp.float32)
-            d_net = jnp.asarray(disc_net, jnp.float32)
+            d_net = (
+                None if net_beta is not None
+                else jnp.asarray(disc_net, jnp.float32)
+            )
 
             @jax.jit
             def _disc_bucket(dc, dn, dd, idx, mask):
                 # idx: (K, cap) padded discovery indices; mask: (K, cap)
                 sub = lambda mat, ix: mat[ix[:, None], ix[None, :]]
                 corr_b = jax.vmap(partial(sub, dc))(idx)
-                net_b = jax.vmap(partial(sub, dn))(idx)
+                net_b = (
+                    jstats.derived_net(corr_b, net_beta) if dn is None
+                    else jax.vmap(partial(sub, dn))(idx)
+                )
                 data_b = (
                     jax.vmap(lambda ix: jnp.take(dd, ix, axis=1))(idx)
                     if dd is not None
@@ -473,7 +535,9 @@ class PermutationEngine:
             )
         if self._observed_fn is None:
             if self.row_sharded:
-                self._observed_fn = make_row_sharded_observed(self._gather_rep)
+                self._observed_fn = make_row_sharded_observed(
+                    self._gather_rep, self.net_beta
+                )
             else:
                 self._observed_fn = jax.jit(
                     jax.vmap(
@@ -483,6 +547,7 @@ class PermutationEngine:
                             else jstats.gather_and_stats,
                             n_iter=self.config.power_iters,
                             summary_method="eigh",  # observed: exact, runs once
+                            net_beta=self.net_beta,
                         ),
                         in_axes=(0, 0, None, None, None),
                     )
@@ -527,15 +592,19 @@ class PermutationEngine:
         caps_slices = [(b.cap, tuple(b.slices)) for b in self.buckets]
         row_sharded = self.row_sharded
         gather_perm = self._gather_perm if row_sharded else None
+        if row_sharded:
+            from .sharded import gather_corr_net as _gcn
         gather_mode = self.gather_mode
         perm_batch = cfg.resolved_perm_batch(
             gather_mode, jax.default_backend(), self.effective_chunk()
         )
+        net_beta = self.net_beta
         kernel = partial(
             jstats.gather_and_stats_mxu if gather_mode == "mxu"
             else jstats.gather_and_stats,
             n_iter=cfg.power_iters,
             summary_method=cfg.summary_method,
+            net_beta=net_beta,
         )
 
         def chunk(keys: jax.Array, pool, tc, tn, td, discs) -> list[jax.Array]:
@@ -553,7 +622,7 @@ class PermutationEngine:
                     # collective-assembled gathers from the row-sharded
                     # matrices; statistics batch over (C, K) by broadcasting
                     # (disc props carry the K axis).
-                    sub_c, sub_n = gather_perm(tc, tn, idx_b)
+                    sub_c, sub_n = _gcn(gather_perm, tc, tn, idx_b, net_beta)
                     zd = (
                         jstats.gather_zdata(td, idx_b, disc.mask)
                         if td is not None else None

@@ -69,7 +69,15 @@ class MultiTestEngine:
             modules, pool, config=config, mesh=mesh, discovery_only=True,
         )
         self.row_sharded = self._base.row_sharded
+        self.net_beta = self._base.net_beta  # sample-checked per dataset below
         dtype = jnp.dtype(config.dtype)
+        if self.net_beta is not None:
+            from .engine import check_derived_network
+
+            for t in range(self.T):
+                check_derived_network(
+                    test_corrs[t], test_nets[t], self.net_beta, f"test[{t}]",
+                )
         if self.row_sharded:
             # Config C × Config D composition (VERDICT r1 item 7): each test
             # dataset's n×n matrices are row-sharded individually and the
@@ -87,15 +95,22 @@ class MultiTestEngine:
                 )
                 for c in test_corrs
             ]
-            self._tn = [
-                shard_rows(
-                    jnp.asarray(pad_square_to_multiple(m, d_row), dtype), mesh
-                )
-                for m in np.asarray(test_nets)
-            ]
+            self._tn = (
+                None if self.net_beta is not None
+                else [
+                    shard_rows(
+                        jnp.asarray(pad_square_to_multiple(m, d_row), dtype),
+                        mesh,
+                    )
+                    for m in np.asarray(test_nets)
+                ]
+            )
         else:
             self._tc = jnp.asarray(test_corrs, dtype)
-            self._tn = jnp.asarray(test_nets, dtype)
+            self._tn = (
+                None if self.net_beta is not None
+                else jnp.asarray(test_nets, dtype)
+            )
         # ragged sample counts across datasets are allowed → keep a list and
         # vmap only when uniform, else python-loop the T axis for data.
         # Data is stored TRANSPOSED — (T, n, samples) — so per-module slices
@@ -130,9 +145,14 @@ class MultiTestEngine:
             jstats.gather_and_stats,
             n_iter=self.config.power_iters,
             summary_method=summary_method,
+            net_beta=self.net_beta,
         )
         over_mod = jax.vmap(one, in_axes=(0, 0, None, None, None))
         return over_mod
+
+    def _tn_at(self, t):
+        """Per-dataset network operand: None in derived-network mode."""
+        return None if self._tn is None else self._tn[t]
 
     def observed(self) -> np.ndarray:
         """(T, n_modules, 7) observed statistics."""
@@ -142,19 +162,23 @@ class MultiTestEngine:
                 from .engine import make_row_sharded_observed
 
                 self._obs_fn_cached = make_row_sharded_observed(
-                    self._base._gather_rep
+                    self._base._gather_rep, self.net_beta
                 )
             _obs = self._obs_fn_cached
             for t in range(self.T):
                 td_t = None if self._td is None else self._td[t]
                 for b in self._base.buckets:
-                    res = _obs(b.disc, b.obs_idx, self._tc[t], self._tn[t], td_t)
+                    res = _obs(
+                        b.disc, b.obs_idx, self._tc[t], self._tn_at(t), td_t
+                    )
                     out[t, b.module_pos] = np.asarray(res, dtype=np.float64)
             return out
         over_mod = self._stats_stack("eigh")
         if self._td is None or self._uniform_samples:
             over_test = jax.jit(jax.vmap(
-                over_mod, in_axes=(None, None, 0, 0, None if self._td is None else 0)
+                over_mod,
+                in_axes=(None, None, 0, None if self._tn is None else 0,
+                         None if self._td is None else 0),
             ))
             for b in self._base.buckets:
                 res = over_test(b.disc, b.obs_idx, self._tc, self._tn, self._td)
@@ -163,7 +187,8 @@ class MultiTestEngine:
             fn = jax.jit(over_mod)
             for t in range(self.T):
                 for b in self._base.buckets:
-                    res = fn(b.disc, b.obs_idx, self._tc[t], self._tn[t], self._td[t])
+                    res = fn(b.disc, b.obs_idx, self._tc[t], self._tn_at(t),
+                             self._td[t])
                     out[t, b.module_pos] = np.asarray(res, dtype=np.float64)
         return out
 
@@ -189,6 +214,10 @@ class MultiTestEngine:
 
         row_sharded = self.row_sharded
         gather_perm = base._gather_perm if row_sharded else None
+        net_beta = self.net_beta
+        tn_absent = self._tn is None
+        if row_sharded:
+            from .sharded import gather_corr_net
 
         def chunk(keys, pool, tc, tn, td, discs):
             perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)
@@ -206,7 +235,10 @@ class MultiTestEngine:
                     # the row axis), never materializing (T, n, n) anywhere.
                     per_t = []
                     for t in range(T):
-                        sub_c, sub_n = gather_perm(tc[t], tn[t], idx_b)
+                        sub_c, sub_n = gather_corr_net(
+                            gather_perm, tc[t],
+                            None if tn_absent else tn[t], idx_b, net_beta,
+                        )
                         zd = (
                             jstats.gather_zdata(td[t], idx_b, disc.mask)
                             if not td_absent else None
@@ -220,12 +252,14 @@ class MultiTestEngine:
                 elif uniform:
                     over_test = jax.vmap(
                         over_perm,
-                        in_axes=(None, None, 0, 0, None if td_absent else 0),
+                        in_axes=(None, None, 0, None if tn_absent else 0,
+                                 None if td_absent else 0),
                     )
                     outs.append(over_test(disc, idx_b, tc, tn, td))  # (T,C,K,7)
                 else:
                     outs.append(jnp.stack([
-                        over_perm(disc, idx_b, tc[t], tn[t], td[t])
+                        over_perm(disc, idx_b, tc[t],
+                                  None if tn_absent else tn[t], td[t])
                         for t in range(T)
                     ]))
             return outs

@@ -130,6 +130,21 @@ def gather_submatrix_local_mxu(
     )
 
 
+def gather_corr_net(gather, tc, tn, idx, net_beta):
+    """Single dispatch point for derived-network mode over a sharded
+    gatherer: with ``tn`` present, gather the (corr, net) submatrix pair;
+    with ``tn`` None, gather only the correlation and derive the network as
+    ``|corr|**net_beta`` on device (EngineConfig.network_from_correlation).
+    One helper so the observed, discovery-bucket, null-chunk, and multi-test
+    paths cannot drift."""
+    from ..ops import stats as jstats
+
+    if tn is None:
+        sub_c = gather(tc, None, idx)
+        return sub_c, jstats.derived_net(sub_c, net_beta)
+    return gather(tc, tn, idx)
+
+
 def make_sharded_gatherer(
     mesh: Mesh,
     batch_axis: str | None = None,
@@ -162,10 +177,7 @@ def make_sharded_gatherer(
         else gather_submatrix_local_mxu
     )
 
-    def body(corr_blk, net_blk, idx_rep):
-        def one(ix):
-            return (local(corr_blk, ix), local(net_blk, ix))
-
+    def batched(one, idx_rep):
         if idx_rep.ndim == 1:
             return one(idx_rep)
         over_mods = jax.vmap(one)
@@ -179,9 +191,27 @@ def make_sharded_gatherer(
             fn = jax.vmap(fn)
         return fn(idx_rep)
 
+    def body(corr_blk, net_blk, idx_rep):
+        return batched(
+            lambda ix: (local(corr_blk, ix), local(net_blk, ix)), idx_rep
+        )
+
+    def body_single(blk, idx_rep):
+        return batched(lambda ix: local(blk, ix), idx_rep)
+
     idx_spec = P(batch_axis) if batch_axis else P()
 
     def gather(corr, net, idx):
+        """``net=None`` gathers only the correlation submatrices (derived-
+        network mode, EngineConfig.network_from_correlation) and returns a
+        single array instead of a pair."""
+        if net is None:
+            return _shard_map(
+                body_single,
+                mesh=mesh,
+                in_specs=(P(ROW_AXIS, None), idx_spec),
+                out_specs=idx_spec,
+            )(corr, idx)
         return _shard_map(
             body,
             mesh=mesh,

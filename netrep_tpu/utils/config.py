@@ -56,6 +56,16 @@ class EngineConfig:
         default-precision f32 matmul truncates operands to bfloat16, so
         gathered VALUES carry up to ~4e-3 relative rounding on TPU
         (statistics attenuate this ~1/m; see ``BASELINE.md`` §precision).
+    network_from_correlation : soft-threshold power β when the network is
+        the WGCNA construction ``|correlation|**β``. When set, the engine
+        never stores or gathers the n×n network on device: network
+        submatrices derive elementwise from the gathered correlation —
+        halving both HBM matrix footprint and the bandwidth-bound hot
+        loop's row traffic (BASELINE.md roofline). The supplied network is
+        sample-checked against ``|corr|**β`` at engine build (mismatch
+        raises). Ignored by ``backend='native'`` (host matrices, no HBM
+        constraint) and the sparse engine (its network IS the sparse
+        structure).
     perm_batch : permutations evaluated concurrently inside one chunk
         dispatch (``lax.map`` batch size), bounding the per-dispatch working
         set in HBM; the chunk itself stays one dispatch, so host round-trips
@@ -74,6 +84,7 @@ class EngineConfig:
     matrix_sharding: str = "replicated"
     gather_mode: str = "auto"
     perm_batch: int | None = None
+    network_from_correlation: float | None = None
 
     def resolved_gather_mode(self, platform: str) -> str:
         if self.gather_mode == "auto":

@@ -193,3 +193,42 @@ def test_mxu_gather_mode_matches_direct(setup, with_data):
     obs_m, null_m = run("mxu")
     np.testing.assert_allclose(obs_m, obs_d, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(null_m, null_d, rtol=1e-4, atol=1e-5)
+
+
+def test_derived_network_matches_explicit(setup):
+    """EngineConfig.network_from_correlation: deriving network submatrices
+    from the gathered correlation (|corr|**beta on device, network never
+    transferred) equals the explicit-network run — elementwise functions
+    commute with gathers. The toy fixture's network IS |corr|**2."""
+    d, t, modules, pool = setup
+    for mode in ("direct", "mxu"):
+        ref = PermutationEngine(
+            d["correlation"], d["network"], d["data"],
+            t["correlation"], t["network"], t["data"], modules, pool,
+            config=EngineConfig(chunk_size=8, summary_method="eigh",
+                                gather_mode=mode),
+        )
+        der = PermutationEngine(
+            d["correlation"], d["network"], d["data"],
+            t["correlation"], t["network"], t["data"], modules, pool,
+            config=EngineConfig(chunk_size=8, summary_method="eigh",
+                                gather_mode=mode,
+                                network_from_correlation=2.0),
+        )
+        assert der._test_net is None  # the n x n network never hit the device
+        np.testing.assert_allclose(der.observed(), ref.observed(),
+                                   rtol=2e-5, atol=2e-5)
+        dn, done = der.run_null(16, key=4)
+        rn, _ = ref.run_null(16, key=4)
+        assert done == 16
+        np.testing.assert_allclose(dn, rn, rtol=2e-5, atol=2e-5)
+
+
+def test_derived_network_mismatch_raises(setup):
+    d, t, modules, pool = setup
+    with pytest.raises(ValueError, match="not \\|correlation\\|"):
+        PermutationEngine(
+            d["correlation"], d["network"], d["data"],
+            t["correlation"], t["network"], t["data"], modules, pool,
+            config=EngineConfig(network_from_correlation=3.0),  # wrong beta
+        )

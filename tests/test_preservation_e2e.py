@@ -156,3 +156,29 @@ def test_validation_errors(toy_pair_module):
             module_assignments=toy_pair_module["labels"],
             discovery="disc", test="test", n_perm=5, alternative="sideways",
         )
+
+
+def test_network_from_correlation_user_surface(toy_pair_module):
+    """module_preservation with EngineConfig(network_from_correlation=2.0):
+    the toy fixture's networks are |corr|**2, so results equal the default
+    run while the engine never puts the n x n networks on device."""
+    d, t = _frames(toy_pair_module)
+    kwargs = dict(
+        network={"disc": d["network"], "test": t["network"]},
+        data={"disc": d["data"], "test": t["data"]},
+        correlation={"disc": d["correlation"], "test": t["correlation"]},
+        module_assignments=dict(toy_pair_module["labels"]),
+        discovery="disc", test="test", n_perm=40, seed=11,
+    )
+    base = module_preservation(
+        **kwargs, config=EngineConfig(chunk_size=16, summary_method="eigh")
+    )
+    derived = module_preservation(
+        **kwargs,
+        config=EngineConfig(chunk_size=16, summary_method="eigh",
+                            network_from_correlation=2.0),
+    )
+    np.testing.assert_allclose(derived.observed, base.observed,
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(derived.nulls, base.nulls, rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(derived.p_values, base.p_values)

@@ -397,3 +397,104 @@ def test_multitest_row_sharded_ragged_samples(setup_pair, rng):
     nulls, done = eng.run_null(8, key=2)
     assert done == 8
     np.testing.assert_allclose(nulls, nulls_ref, atol=2e-5)
+
+
+def test_derived_network_row_sharded_and_multitest(setup_pair, rng):
+    """network_from_correlation composes with row sharding (single-matrix
+    collective gather + on-device derivation) and with the multi-test vmap
+    path (per-cohort check, shared permutation draws)."""
+    d, t, modules, pool = setup_pair
+    mesh2d = meshmod.make_mesh(n_perm_shards=2, n_row_shards=4)
+
+    ref = PermutationEngine(
+        d["correlation"], d["network"], d["data"],
+        t["correlation"], t["network"], t["data"], modules, pool,
+        config=EngineConfig(chunk_size=8, summary_method="eigh"),
+    )
+    nulls_ref, _ = ref.run_null(16, key=6)
+    obs_ref = ref.observed()
+
+    eng = PermutationEngine(
+        d["correlation"], d["network"], d["data"],
+        t["correlation"], t["network"], t["data"], modules, pool,
+        config=EngineConfig(chunk_size=8, summary_method="eigh",
+                            matrix_sharding="row", gather_mode="mxu",
+                            network_from_correlation=2.0),
+        mesh=mesh2d,
+    )
+    assert eng._test_net is None
+    np.testing.assert_allclose(eng.observed(), obs_ref, atol=2e-5)
+    nulls, done = eng.run_null(16, key=6)
+    assert done == 16
+    np.testing.assert_allclose(nulls, nulls_ref, atol=2e-5)
+
+    # multi-test: second cohort with net == |corr|**2 by construction
+    t2_data = t["data"] + rng.standard_normal(t["data"].shape) * 0.5
+    t2_corr = np.corrcoef(t2_data, rowvar=False)
+    t2_net = np.abs(t2_corr) ** 2
+    np.fill_diagonal(t2_net, 1.0)
+    stack = (
+        d["correlation"], d["network"], d["data"],
+        np.stack([t["correlation"], t2_corr]),
+        np.stack([t["network"], t2_net]),
+        [t["data"], t2_data],
+        modules, pool,
+    )
+    cfg = EngineConfig(chunk_size=8, summary_method="eigh")
+    m_ref = MultiTestEngine(*stack, config=cfg)
+    m_der = MultiTestEngine(
+        *stack,
+        config=EngineConfig(chunk_size=8, summary_method="eigh",
+                            network_from_correlation=2.0),
+    )
+    assert m_der._tn is None
+    np.testing.assert_allclose(m_der.observed(), m_ref.observed(), atol=2e-5)
+    a, _ = m_der.run_null(12, key=8)
+    b, _ = m_ref.run_null(12, key=8)
+    np.testing.assert_allclose(a, b, atol=2e-5)
+
+    # wrong cohort: multitest checks EVERY dataset
+    bad_net = np.abs(t2_corr) ** 4
+    with pytest.raises(ValueError, match="test\\[1\\]"):
+        MultiTestEngine(
+            d["correlation"], d["network"], d["data"],
+            np.stack([t["correlation"], t2_corr]),
+            np.stack([t["network"], bad_net]),
+            [t["data"], t2_data],
+            modules, pool,
+            config=EngineConfig(network_from_correlation=2.0),
+        )
+
+
+def test_derived_network_multitest_row_sharded(setup_pair, rng):
+    """The triple composition: derived network x row sharding x multi-test."""
+    d, t, modules, pool = setup_pair
+    t2_data = t["data"] + rng.standard_normal(t["data"].shape) * 0.5
+    t2_corr = np.corrcoef(t2_data, rowvar=False)
+    t2_net = np.abs(t2_corr) ** 2
+    np.fill_diagonal(t2_net, 1.0)
+    stack = (
+        d["correlation"], d["network"], d["data"],
+        np.stack([t["correlation"], t2_corr]),
+        np.stack([t["network"], t2_net]),
+        [t["data"], t2_data],
+        modules, pool,
+    )
+    ref = MultiTestEngine(
+        *stack, config=EngineConfig(chunk_size=8, summary_method="eigh")
+    )
+    nulls_ref, _ = ref.run_null(8, key=13)
+
+    mesh2d = meshmod.make_mesh(n_perm_shards=2, n_row_shards=4)
+    eng = MultiTestEngine(
+        *stack,
+        config=EngineConfig(chunk_size=8, summary_method="eigh",
+                            matrix_sharding="row", gather_mode="mxu",
+                            network_from_correlation=2.0),
+        mesh=mesh2d,
+    )
+    assert eng._tn is None
+    np.testing.assert_allclose(eng.observed(), ref.observed(), atol=2e-5)
+    nulls, done = eng.run_null(8, key=13)
+    assert done == 8
+    np.testing.assert_allclose(nulls, nulls_ref, atol=2e-5)
