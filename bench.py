@@ -158,14 +158,22 @@ def bench_north(args, label=None):
     lo, hi = (30, 200) if not args.smoke else (8, 24)
     specs = make_specs(args.genes, args.modules, lo, hi)
     pool = np.arange(args.genes, dtype=np.int32)
-    cfg = EngineConfig(chunk_size=args.chunk, summary_method="power",
-                       power_iters=40, dtype=args.dtype)
+    cfg = EngineConfig(
+        chunk_size=args.chunk, summary_method="power", power_iters=40,
+        dtype=args.dtype,
+        # the bench problem's network IS |corr|**2 by construction, so
+        # derived mode computes the identical statistics while halving the
+        # gather traffic (the roofline bottleneck, BASELINE.md)
+        network_from_correlation=2.0 if args.derived_net else None,
+    )
     engine = PermutationEngine(
         d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool, config=cfg
     )
     elapsed = timed_null(engine, args.perms, cfg.chunk_size)
     if label is None:
         label = "north-star config, BASELINE.json:5"
+    if args.derived_net:
+        label += "; derived network |corr|^2"
     return emit({
         "metric": (
             f"wall-clock for {args.perms}-perm null, {args.genes} genes / "
@@ -366,7 +374,10 @@ def bench_d(args):
     lo, hi = (30, 200) if not args.smoke else (8, 24)
     specs = make_specs(args.genes, args.modules, lo, hi)
     pool = np.arange(args.genes, dtype=np.int32)
-    cfg = EngineConfig(chunk_size=args.chunk, power_iters=40)
+    cfg = EngineConfig(
+        chunk_size=args.chunk, power_iters=40,
+        network_from_correlation=2.0 if args.derived_net else None,
+    )
     engine = PermutationEngine(
         d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool, config=cfg
     )
@@ -377,7 +388,9 @@ def bench_d(args):
         assert os.path.exists(ck)
     return emit({
         "metric": f"Config D ({args.genes} genes / {args.modules} modules, "
-                  f"{n_perm} perms, checkpoint every 8192)",
+                  f"{n_perm} perms, checkpoint every 8192"
+                  + ("; derived network |corr|^2" if args.derived_net else "")
+                  + ")",
         "value": round(elapsed, 3),
         "unit": "s",
         "vs_baseline": round((TARGET_SECONDS * n_perm / 10_000) / elapsed, 4),
@@ -443,6 +456,10 @@ def main():
     ap.add_argument("--dtype", default="float32")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config for a fast correctness pass")
+    ap.add_argument("--derived-net", action="store_true",
+                    help="EngineConfig(network_from_correlation=2.0): derive "
+                         "network submatrices on device instead of storing "
+                         "the n x n network (north/B/D configs)")
     args = ap.parse_args()
     if args.smoke:
         args.genes, args.modules, args.perms, args.chunk, args.samples = (

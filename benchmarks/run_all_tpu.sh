@@ -19,5 +19,7 @@ T=600  run python bench.py --config E
 T=900  run python benchmarks/microbench_sharded_gather.py
 T=2400 run python benchmarks/tune_northstar.py
 T=600  run python bench.py                             # north-star, current
-T=2400 run python bench.py --config D                  # 100k perms, longest
+T=600  run python bench.py --derived-net               # |corr|^2 derived mode
+T=2400 run python bench.py --config D                  # 100k perms, stored net
+T=2400 run python bench.py --config D --derived-net    # 100k perms, derived
 echo "== done $(date -u +%FT%TZ) ==" | tee -a "$LOG"
