@@ -141,6 +141,32 @@ class SparseAdjacency:
             rows, cols, mat[rows, cols], mat.shape[0], symmetrize=False
         )
 
+    @classmethod
+    def from_scipy(cls, mat, symmetrize: bool = True) -> "SparseAdjacency":
+        """Build from any ``scipy.sparse`` matrix (the lingua franca of
+        single-cell kNN graphs, e.g. ``adata.obsp['connectivities']``).
+        Directed kNN graphs are symmetrized by default (union with the
+        transpose, conflicting reciprocal weights resolved per
+        :meth:`from_coo`)."""
+        try:
+            from scipy import sparse as sp
+        except Exception as e:  # pragma: no cover - scipy is baked in
+            raise ImportError("from_scipy requires scipy") from e
+        if not sp.issparse(mat):
+            raise TypeError(
+                f"from_scipy takes a scipy.sparse matrix, got {type(mat).__name__}"
+            )
+        if mat.shape[0] != mat.shape[1]:
+            raise ValueError(f"adjacency must be square, got {mat.shape}")
+        coo = mat.tocoo()
+        # scipy semantics SUM duplicate COO entries; from_coo resolves
+        # last-wins — collapse first so the weights match what the user's
+        # matrix means
+        coo.sum_duplicates()
+        return cls.from_coo(
+            coo.row, coo.col, coo.data, mat.shape[0], symmetrize=symmetrize
+        )
+
     def to_dense(self) -> np.ndarray:
         out = np.zeros((self.n, self.n), dtype=np.float64)
         rows = np.repeat(np.arange(self.n), self.k)

@@ -161,18 +161,22 @@ def _perm_keys_jit(key: jax.Array, start: jax.Array, count: int) -> jax.Array:
 
 
 def check_derived_network(corr, net, beta: float, what: str) -> None:
-    """Sample-check that ``net == |corr|**beta`` before the engine commits to
+    """Check that ``net == |corr|**beta`` before the engine commits to
     deriving network submatrices on device
-    (``EngineConfig.network_from_correlation``): a strided sample of up to
-    64k entries per matrix; a mismatch means the knob contradicts the data
-    the user actually supplied."""
+    (``EngineConfig.network_from_correlation``): exhaustive for matrices up
+    to 64k entries, a fixed-seed random flat sample of 64k entries beyond
+    (any *strided* sample would alias onto the columns divisible by
+    gcd(stride, n), leaving most of the matrix unchecked). A mismatch means
+    the knob contradicts the data the user actually supplied."""
     c = np.asarray(corr).reshape(-1)
     m = np.asarray(net).reshape(-1)
-    # random (fixed-seed) flat sample: any stride aliases onto the columns
-    # divisible by gcd(stride, n), leaving most of the matrix unchecked
-    ii = np.random.default_rng(0).integers(0, c.size, size=min(c.size, 65536))
-    want = np.abs(c[ii]) ** beta
-    got = m[ii]
+    if c.size <= 65536:
+        want = np.abs(c) ** beta
+        got = m
+    else:
+        ii = np.random.default_rng(0).integers(0, c.size, size=65536)
+        want = np.abs(c[ii]) ** beta
+        got = m[ii]
     if not np.allclose(got, want, rtol=1e-3, atol=1e-4):
         worst = float(np.max(np.abs(got - want)))
         raise ValueError(

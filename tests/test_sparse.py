@@ -474,3 +474,40 @@ def test_sparse_network_properties_singletons_and_validation(rng):
         sparse_network_properties(
             d_adj, module_assignments=np.full(d_adj.n, "0", dtype=object)
         )
+
+
+def test_from_scipy_roundtrip(rng):
+    """scipy.sparse interop: the single-cell kNN lingua franca builds the
+    same adjacency as the COO constructor, including symmetrization of a
+    directed kNN graph."""
+    from scipy import sparse as sp
+
+    n = 30
+    dense = np.zeros((n, n))
+    r = np.random.default_rng(2)
+    for i in range(n):
+        nbrs = r.choice([j for j in range(n) if j != i], size=4, replace=False)
+        dense[i, nbrs] = r.uniform(0.1, 1.0, size=4)   # directed kNN
+    for fmt in ("csr", "csc", "coo"):
+        adj = SparseAdjacency.from_scipy(getattr(sp, f"{fmt}_matrix")(dense))
+        got = adj.to_dense()
+        np.testing.assert_allclose(got, got.T)
+        # union-with-transpose semantics: every directed edge appears in
+        # both orientations
+        assert ((got != 0) == ((dense != 0) | (dense.T != 0))).all()
+    with pytest.raises(TypeError, match="scipy.sparse"):
+        SparseAdjacency.from_scipy(dense)
+    with pytest.raises(ValueError, match="square"):
+        SparseAdjacency.from_scipy(sp.csr_matrix(np.ones((3, 5))))
+
+
+def test_from_scipy_duplicate_coo_entries_sum():
+    """scipy sums duplicate COO entries; from_scipy must match that."""
+    from scipy import sparse as sp
+
+    m = sp.coo_matrix(
+        (np.array([1.0, 2.0]), (np.array([0, 0]), np.array([1, 1]))),
+        shape=(3, 3),
+    )
+    adj = SparseAdjacency.from_scipy(m)
+    assert adj.to_dense()[0, 1] == 3.0
