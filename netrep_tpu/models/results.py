@@ -79,6 +79,73 @@ class PreservationResult:
         with np.errstate(invalid="ignore"):
             return np.nanmax(self.p_values, axis=1)
 
+    _SAVE_VERSION = 1
+
+    def save(self, path: str) -> None:
+        """Persist the result as a single ``.npz`` (atomic write) — the
+        analogue of saving the reference's result object as .rds. ``profile``
+        timings are not persisted (session-local diagnostics)."""
+        import json
+
+        from ..utils.checkpoint import atomic_savez
+
+        meta = {
+            "discovery": self.discovery,
+            "test": self.test,
+            "module_labels": list(self.module_labels),
+            "alternative": self.alternative,
+            "n_perm": int(self.n_perm),
+            "completed": int(self.completed),
+        }
+        atomic_savez(
+            path,
+            # top-level format marker checked FIRST on load, so a foreign
+            # .npz (e.g. a null checkpoint) gets an informative error even
+            # if a future format changes the meta encoding
+            result_version=np.int64(self._SAVE_VERSION),
+            meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+            observed=self.observed,
+            nulls=self.nulls,
+            p_values=self.p_values,
+            n_vars_present=self.n_vars_present,
+            prop_vars_present=self.prop_vars_present,
+            total_size=self.total_size,
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "PreservationResult":
+        """Load a result saved by :meth:`save`."""
+        import json
+
+        with np.load(path) as z:
+            if "result_version" not in z.files:
+                raise ValueError(
+                    f"{path} is not a PreservationResult file (no "
+                    "result_version marker — null checkpoints and other "
+                    ".npz files are not loadable here)"
+                )
+            version = int(z["result_version"])
+            if version != cls._SAVE_VERSION:
+                raise ValueError(
+                    f"unsupported result-file version {version!r} "
+                    f"in {path} (this build reads version {cls._SAVE_VERSION})"
+                )
+            meta = json.loads(bytes(z["meta"]).decode())
+            return cls(
+                discovery=meta["discovery"],
+                test=meta["test"],
+                module_labels=[str(l) for l in meta["module_labels"]],
+                observed=z["observed"],
+                nulls=z["nulls"],
+                p_values=z["p_values"],
+                n_vars_present=z["n_vars_present"],
+                prop_vars_present=z["prop_vars_present"],
+                total_size=z["total_size"],
+                alternative=meta["alternative"],
+                n_perm=meta["n_perm"],
+                completed=meta["completed"],
+            )
+
 
 def shape_results(
     results: dict[str, dict[str, PreservationResult]], simplify: bool

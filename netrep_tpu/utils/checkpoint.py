@@ -64,6 +64,23 @@ def engine_fingerprint(engine) -> np.ndarray:
     return np.frombuffer("|".join(parts).encode(), dtype=np.uint8)
 
 
+def atomic_savez(path: str, **arrays) -> None:
+    """Atomically write a compressed ``.npz``: ``mkstemp`` in the target
+    directory (unique across threads/processes) + ``os.replace``, so an
+    interrupt or a concurrent writer never corrupts an existing file.
+    Shared by checkpoints and result-object saves."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def save_null_checkpoint(
     path: str,
     nulls: np.ndarray,
@@ -71,26 +88,16 @@ def save_null_checkpoint(
     key_data: np.ndarray,
     fingerprint: np.ndarray,
 ) -> None:
-    """Atomically persist a (possibly partial) null array. The write goes to
-    a temp file in the same directory followed by ``os.replace`` so an
-    interrupt mid-save never corrupts an existing checkpoint."""
-    d = os.path.dirname(os.path.abspath(path)) or "."
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez_compressed(
-                f,
-                version=np.int64(_FORMAT_VERSION),
-                nulls=nulls,
-                completed=np.int64(completed),
-                key_data=np.asarray(key_data),
-                fingerprint=fingerprint,
-            )
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+    """Atomically persist a (possibly partial) null array (see
+    :func:`atomic_savez`)."""
+    atomic_savez(
+        path,
+        version=np.int64(_FORMAT_VERSION),
+        nulls=nulls,
+        completed=np.int64(completed),
+        key_data=np.asarray(key_data),
+        fingerprint=fingerprint,
+    )
 
 
 def load_null_checkpoint(path: str) -> dict | None:

@@ -182,3 +182,35 @@ def test_network_from_correlation_user_surface(toy_pair_module):
                                rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(derived.nulls, base.nulls, rtol=2e-5, atol=2e-5)
     np.testing.assert_array_equal(derived.p_values, base.p_values)
+
+
+def test_result_save_load_roundtrip(result, tmp_path):
+    """PreservationResult.save/load: the .rds-saving workflow equivalent."""
+    path = str(tmp_path / "res.npz")
+    result.save(path)
+    back = PreservationResult.load(path)
+    assert back.discovery == result.discovery and back.test == result.test
+    assert back.module_labels == result.module_labels
+    assert back.alternative == result.alternative
+    assert back.n_perm == result.n_perm and back.completed == result.completed
+    np.testing.assert_array_equal(back.observed, result.observed)
+    np.testing.assert_array_equal(back.nulls, result.nulls)
+    np.testing.assert_array_equal(back.p_values, result.p_values)
+    np.testing.assert_array_equal(back.total_size, result.total_size)
+    # derived views still work on the loaded object
+    np.testing.assert_array_equal(back.max_pvalue(), result.max_pvalue())
+    assert repr(back) == repr(result)
+    # foreign .npz (e.g. a null checkpoint) → informative error, not KeyError
+    import numpy as _np
+
+    foreign = str(tmp_path / "foreign.npz")
+    with open(foreign, "wb") as fh:
+        _np.savez(fh, nulls=_np.zeros(3))
+    with pytest.raises(ValueError, match="not a PreservationResult"):
+        PreservationResult.load(foreign)
+    # future version → version error
+    bad = str(tmp_path / "bad.npz")
+    with open(bad, "wb") as fh:
+        _np.savez(fh, result_version=_np.int64(99))
+    with pytest.raises(ValueError, match="version"):
+        PreservationResult.load(bad)
