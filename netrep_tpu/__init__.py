@@ -22,6 +22,7 @@ __all__ = [
     "permp",
     "load_example",
     "make_example_pair",
+    "PreservationResult",
     "SparseAdjacency",
     "sparse_module_preservation",
     "sparse_network_properties",
@@ -59,4 +60,8 @@ def __getattr__(name):
         from .utils.profiling import summarize_trace
 
         return summarize_trace
+    if name == "PreservationResult":
+        from .models.results import PreservationResult
+
+        return PreservationResult
     raise AttributeError(name)

@@ -105,6 +105,12 @@ def load_null_checkpoint(path: str) -> dict | None:
     if not os.path.exists(path):
         return None
     with np.load(path) as z:
+        if "version" not in z.files:
+            raise ValueError(
+                f"{path!r} is not a null checkpoint (no version marker — "
+                "saved PreservationResult files and other .npz files cannot "
+                "be resumed from)"
+            )
         if int(z["version"]) != _FORMAT_VERSION:
             raise ValueError(
                 f"checkpoint {path!r} has format version {int(z['version'])}, "

@@ -130,3 +130,15 @@ def test_module_preservation_checkpoint_dir(tmp_path, rng, toy_pair):
     )
     np.testing.assert_array_equal(res1.nulls, res2.nulls)
     np.testing.assert_array_equal(res1.p_values, res2.p_values)
+
+
+def test_foreign_npz_is_not_a_checkpoint(tmp_path):
+    """A saved PreservationResult (or any foreign .npz) fed to the resume
+    path raises an informative error, not a KeyError."""
+    from netrep_tpu.utils import checkpoint as ckpt
+
+    foreign = str(tmp_path / "foreign.npz")
+    with open(foreign, "wb") as fh:
+        np.savez(fh, result_version=np.int64(1))
+    with pytest.raises(ValueError, match="not a null checkpoint"):
+        ckpt.load_null_checkpoint(foreign)
