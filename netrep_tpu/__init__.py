@@ -23,6 +23,7 @@ __all__ = [
     "load_example",
     "make_example_pair",
     "PreservationResult",
+    "combine_analyses",
     "SparseAdjacency",
     "sparse_module_preservation",
     "sparse_network_properties",
@@ -60,8 +61,8 @@ def __getattr__(name):
         from .utils.profiling import summarize_trace
 
         return summarize_trace
-    if name == "PreservationResult":
-        from .models.results import PreservationResult
+    if name in ("PreservationResult", "combine_analyses"):
+        from .models import results
 
-        return PreservationResult
+        return getattr(results, name)
     raise AttributeError(name)

@@ -83,6 +83,7 @@ def _make_result(d_name, t_name, labels, counts, observed, nulls, completed,
         n_perm=np_this,
         completed=completed,
         profile=profile,
+        total_space=total_space,
     )
 
 

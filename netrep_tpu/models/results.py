@@ -50,6 +50,10 @@ class PreservationResult:
                                   # trace_dir, observed_s, null_s,
                                   # perms_per_sec, chunk_ms,
                                   # compile_chunk_ms, steady_chunk_ms
+    total_space: float | None = None  # size of the full permutation space
+                                  # (may be inf); kept so p-values can be
+                                  # recomputed exactly when results are
+                                  # merged by combine_analyses()
 
     @property
     def stat_names(self) -> tuple[str, ...]:
@@ -96,6 +100,9 @@ class PreservationResult:
             "alternative": self.alternative,
             "n_perm": int(self.n_perm),
             "completed": int(self.completed),
+            # json.dumps emits Infinity for inf and json.loads reads it back
+            # (Python's non-strict default), so inf-sized spaces round-trip
+            "total_space": None if self.total_space is None else float(self.total_space),
         }
         atomic_savez(
             path,
@@ -144,7 +151,165 @@ class PreservationResult:
                 alternative=meta["alternative"],
                 n_perm=meta["n_perm"],
                 completed=meta["completed"],
+                total_space=meta.get("total_space"),  # absent in older files
             )
+
+
+def combine_analyses(*analyses, allow_duplicate_nulls: bool = False):
+    """Merge ``module_preservation`` results whose permutations were computed
+    separately — the rebuild of the reference's ``combineAnalyses()``
+    (upstream ``R/combineAnalyses.R``, SURVEY.md §2.1 user API): split a large
+    ``n_perm`` across machines/sessions (different seeds), then pool the null
+    distributions and recompute the exact Phipson–Smyth p-values over the
+    combined permutation count.
+
+    Accepts two or more :class:`PreservationResult` objects for the same
+    (discovery, test) pair, or two or more nested ``{discovery: {test:
+    result}}`` dicts (as returned by ``simplify=False``), which are merged
+    key-by-key.
+
+    Each input contributes its *completed* permutations only. The runs must
+    agree on everything except the nulls: module labels, alternative,
+    dataset names, observed statistics, and node counts — disagreement means
+    the inputs came from different analyses and is an error.
+
+    Identical null blocks across inputs (the same seed run twice) would
+    silently double-count correlated permutations, biasing p-values; this is
+    detected via a content hash and raises unless ``allow_duplicate_nulls``.
+    """
+    if len(analyses) < 2:
+        raise ValueError("combine_analyses needs at least two results")
+    if all(isinstance(a, dict) for a in analyses):
+        keysets = [set(a) for a in analyses]
+        if any(ks != keysets[0] for ks in keysets[1:]):
+            level = "discovery" if isinstance(
+                next(iter(analyses[0].values()), None), dict
+            ) else "test"
+            raise ValueError(
+                f"nested results disagree on {level} datasets: "
+                f"{sorted(map(sorted, keysets))}"
+            )
+        return {
+            d: combine_analyses(
+                *(a[d] for a in analyses),
+                allow_duplicate_nulls=allow_duplicate_nulls,
+            )
+            for d in analyses[0]
+        }
+    if all(isinstance(a, PreservationResult) for a in analyses):
+        return _combine_pair_results(analyses, allow_duplicate_nulls)
+    raise TypeError(
+        "combine_analyses takes all PreservationResult objects or all "
+        f"nested dicts, got {[type(a).__name__ for a in analyses]}"
+    )
+
+
+def _combine_pair_results(results, allow_duplicate_nulls):
+    import hashlib
+
+    from ..ops import pvalues as pv
+
+    first = results[0]
+    for r in results[1:]:
+        if (r.discovery, r.test) != (first.discovery, first.test):
+            raise ValueError(
+                f"results are for different dataset pairs: "
+                f"({first.discovery!r}, {first.test!r}) vs "
+                f"({r.discovery!r}, {r.test!r})"
+            )
+        if list(r.module_labels) != list(first.module_labels):
+            raise ValueError("results have different module labels")
+        if r.alternative != first.alternative:
+            raise ValueError(
+                f"results use different alternatives: "
+                f"{first.alternative!r} vs {r.alternative!r}"
+            )
+        if not np.array_equal(r.n_vars_present, first.n_vars_present) or \
+           not np.array_equal(r.total_size, first.total_size):
+            raise ValueError("results have different node-overlap counts")
+        # observed is deterministic given the inputs, so any drift beyond
+        # numeric noise means the analyses ran on different data
+        if not np.allclose(
+            r.observed, first.observed, rtol=1e-4, atol=1e-5, equal_nan=True
+        ):
+            raise ValueError(
+                "observed statistics differ between results — these are not "
+                "runs of the same analysis"
+            )
+
+    spaces = [r.total_space for r in results if r.total_space is not None]
+    total_space = spaces[0] if spaces else None
+    for s in spaces[1:]:
+        same = (s == total_space) or (
+            np.isfinite(s) and np.isfinite(total_space)
+            and np.isclose(s, total_space, rtol=1e-9)
+        )
+        if not same:
+            raise ValueError(
+                f"results record different permutation-space sizes "
+                f"({total_space!r} vs {s!r})"
+            )
+
+    blocks = [np.asarray(r.nulls[: r.completed]) for r in results]
+    if not allow_duplicate_nulls:
+        # Detect the same seed run twice at per-permutation granularity:
+        # a byte-identical null row in two inputs means they drew the same
+        # node assignment (even when one run was interrupted and is only a
+        # prefix of the other's stream). In a SMALL finite space, though,
+        # independent with-replacement runs legitimately collide — so only
+        # raise when the cross-input duplicate count exceeds what
+        # independent uniform sampling from `total_space` predicts.
+        seen: dict[bytes, int] = {}
+        cross_dups = 0
+        for bi, block in enumerate(blocks):
+            for row in block:
+                h = hashlib.sha256(np.ascontiguousarray(row)).digest()
+                if seen.setdefault(h, bi) != bi:
+                    cross_dups += 1
+        if cross_dups:
+            sizes = [b.shape[0] for b in blocks]
+            n_pairs = (sum(sizes) ** 2 - sum(s * s for s in sizes)) / 2
+            if (total_space is not None and np.isfinite(total_space)
+                    and total_space > 0):
+                expected = n_pairs / total_space
+                threshold = expected + 4.0 * np.sqrt(expected) + 0.5
+            else:
+                # Space size unknown (results saved by an older release) or
+                # infinite. A duplicated seed replicates ~100% of the smaller
+                # block, so tolerate up to 5% of it as possible small-space
+                # chance collisions rather than rejecting on the first match.
+                expected = 0.0
+                threshold = 0.05 * min(s for s in sizes if s) + 0.5
+            if cross_dups > threshold:
+                raise ValueError(
+                    f"{cross_dups} byte-identical null row(s) shared "
+                    f"between inputs (~{expected:.2f} expected by chance "
+                    "for this permutation space) — the same seed run "
+                    "twice?; pooling correlated permutations biases "
+                    "p-values. Pass allow_duplicate_nulls=True to "
+                    "override."
+                )
+
+    nulls = np.concatenate(blocks, axis=0)
+    completed = int(nulls.shape[0])
+    p_values = pv.permutation_pvalues(
+        first.observed, nulls, first.alternative, total_nperm=total_space
+    )
+    return PreservationResult(
+        discovery=first.discovery,
+        test=first.test,
+        module_labels=list(first.module_labels),
+        observed=first.observed,
+        nulls=nulls,
+        p_values=p_values,
+        n_vars_present=first.n_vars_present,
+        prop_vars_present=first.prop_vars_present,
+        total_size=first.total_size,
+        alternative=first.alternative,
+        n_perm=int(sum(r.n_perm for r in results)),
+        completed=completed,
+        total_space=total_space,
+    )
 
 
 def shape_results(

@@ -258,6 +258,7 @@ def sparse_module_preservation(
         alternative=alternative,
         n_perm=n_perm,
         completed=completed,
+        total_space=total_space,
     )
 
 

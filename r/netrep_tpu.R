@@ -122,6 +122,22 @@ requiredPerms <- function(alpha = 0.05, nTests = 1L,
                            alternative = alternative)
 }
 
+.combineAnalyses_args <- list(
+  allowDuplicateNulls = "allow_duplicate_nulls"
+)
+
+#' Combine two module-preservation analyses run with separate permutations
+#' (reference: combineAnalyses, R/combineAnalyses.R) — null distributions are
+#' pooled and exact p-values recomputed over the combined count. The inputs
+#' must be results of the same analysis (same datasets, modules, alternative)
+#' produced with different seeds; duplicated permutation streams are rejected
+#' unless allowDuplicateNulls = TRUE.
+combineAnalyses <- function(analysis1, analysis2,
+                            allowDuplicateNulls = FALSE, ...) {
+  .netrep()$combine_analyses(analysis1, analysis2,
+                             allow_duplicate_nulls = allowDuplicateNulls, ...)
+}
+
 .plotModule_args <- list(
   network           = "network",
   data              = "data",

@@ -1,0 +1,196 @@
+"""Tests for `combine_analyses` — the rebuild of the reference's
+``combineAnalyses()`` (upstream ``R/combineAnalyses.R``): pooling null
+distributions from permutation runs split across machines/sessions and
+recomputing exact p-values over the combined count.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from netrep_tpu import combine_analyses, module_preservation
+from netrep_tpu.models.results import PreservationResult
+from netrep_tpu.ops import pvalues as pv
+from netrep_tpu.utils.config import EngineConfig
+
+CFG = EngineConfig(chunk_size=64, summary_method="power", power_iters=50)
+
+
+def _run(toy, seed, n_perm=120, simplify=True):
+    d, t = toy["discovery"], toy["test"]
+    return module_preservation(
+        network={"disc": d["network"], "test": t["network"]},
+        data={"disc": d["data"], "test": t["data"]},
+        correlation={"disc": d["correlation"], "test": t["correlation"]},
+        module_assignments=[toy["labels"][n] for n in d["names"]],
+        discovery="disc",
+        test="test",
+        n_perm=n_perm,
+        seed=seed,
+        simplify=simplify,
+        config=CFG,
+    )
+
+
+@pytest.fixture(scope="module")
+def two_runs(toy_pair_module):
+    return _run(toy_pair_module, seed=1), _run(toy_pair_module, seed=2)
+
+
+def test_combine_concatenates_and_recomputes(two_runs):
+    a, b = two_runs
+    c = combine_analyses(a, b)
+    assert isinstance(c, PreservationResult)
+    assert c.completed == a.completed + b.completed
+    assert c.n_perm == a.n_perm + b.n_perm
+    assert c.nulls.shape == (c.completed, *a.nulls.shape[1:])
+    np.testing.assert_array_equal(c.nulls[: a.completed], a.nulls[: a.completed])
+    np.testing.assert_array_equal(c.nulls[a.completed :], b.nulls[: b.completed])
+    np.testing.assert_array_equal(c.observed, a.observed)
+    # p-values equal a direct computation over the pooled nulls
+    expect = pv.permutation_pvalues(
+        a.observed, c.nulls, a.alternative, total_nperm=a.total_space
+    )
+    np.testing.assert_allclose(c.p_values, expect, rtol=0, atol=0)
+
+
+def test_combine_three_way(two_runs, toy_pair_module):
+    a, b = two_runs
+    c3 = _run(toy_pair_module, seed=3, n_perm=60)
+    c = combine_analyses(a, b, c3)
+    assert c.completed == a.completed + b.completed + c3.completed
+
+
+def test_same_seed_rejected(toy_pair_module):
+    a = _run(toy_pair_module, seed=7)
+    b = _run(toy_pair_module, seed=7)
+    with pytest.raises(ValueError, match="identical null"):
+        combine_analyses(a, b)
+    c = combine_analyses(a, b, allow_duplicate_nulls=True)
+    assert c.completed == a.completed + b.completed
+    # a same-seed run that was interrupted (prefix of the other's stream)
+    # must be caught too, not just byte-identical whole blocks
+    prefix = dataclasses.replace(b, completed=50)
+    with pytest.raises(ValueError, match="identical null"):
+        combine_analyses(a, prefix)
+
+
+def _fake_result(nulls, total_space, seed_obs=0):
+    rng = np.random.default_rng(seed_obs)
+    n = nulls.shape[0]
+    return PreservationResult(
+        discovery="d", test="t", module_labels=["1"],
+        observed=rng.standard_normal((1, 7)),
+        nulls=nulls, p_values=np.zeros((1, 7)),
+        n_vars_present=np.array([5]), prop_vars_present=np.array([1.0]),
+        total_size=np.array([5]), alternative="greater",
+        n_perm=n, completed=n, total_space=total_space,
+    )
+
+
+def test_small_space_chance_collisions_tolerated():
+    # In a small finite permutation space, independent different-seed runs
+    # legitimately draw the same assignment sometimes; a few shared rows must
+    # not be mistaken for a duplicated seed. Space of 2520 with 120+120 draws
+    # expects ~5.7 collisions.
+    rng1, rng2 = np.random.default_rng(1), np.random.default_rng(2)
+    a_rows = rng1.standard_normal((120, 1, 7))
+    b_rows = rng2.standard_normal((120, 1, 7))
+    b_rows[[3, 40, 77]] = a_rows[[10, 20, 30]]  # 3 chance collisions
+    a = _fake_result(a_rows, total_space=2520.0)
+    b = _fake_result(b_rows, total_space=2520.0)
+    b.observed = a.observed  # same analysis
+    c = combine_analyses(a, b)
+    assert c.completed == 240
+    # but a fully-duplicated stream still trips the detector in that space
+    dup = _fake_result(a_rows.copy(), total_space=2520.0)
+    dup.observed = a.observed
+    with pytest.raises(ValueError, match="identical null"):
+        combine_analyses(a, dup)
+
+
+def test_unknown_space_tolerates_few_collisions():
+    # results saved by an older release carry total_space=None; a couple of
+    # shared rows (possible small-space chance collisions) must not reject
+    # the combine, but a duplicated stream must
+    rng1, rng2 = np.random.default_rng(3), np.random.default_rng(4)
+    a_rows = rng1.standard_normal((100, 1, 7))
+    b_rows = rng2.standard_normal((100, 1, 7))
+    b_rows[[5, 60]] = a_rows[[1, 2]]
+    a = _fake_result(a_rows, total_space=None)
+    b = _fake_result(b_rows, total_space=None)
+    b.observed = a.observed
+    c = combine_analyses(a, b)
+    assert c.completed == 200 and c.total_space is None
+    dup = _fake_result(a_rows.copy(), total_space=None)
+    dup.observed = a.observed
+    with pytest.raises(ValueError, match="identical null"):
+        combine_analyses(a, dup)
+
+
+def test_empty_blocks_do_not_collide(two_runs):
+    # two fully-interrupted runs (completed=0) share no permutations; their
+    # empty null blocks must not trip the duplicate detector
+    a, b = two_runs
+    e1 = dataclasses.replace(a, completed=0)
+    e2 = dataclasses.replace(b, completed=0)
+    c = combine_analyses(e1, e2, a)
+    assert c.completed == a.completed
+
+
+def test_mismatched_analyses_rejected(two_runs):
+    a, b = two_runs
+    with pytest.raises(ValueError, match="at least two"):
+        combine_analyses(a)
+    wrong_pair = dataclasses.replace(b, test="other")
+    with pytest.raises(ValueError, match="different dataset pairs"):
+        combine_analyses(a, wrong_pair)
+    wrong_alt = dataclasses.replace(b, alternative="less")
+    with pytest.raises(ValueError, match="different alternatives"):
+        combine_analyses(a, wrong_alt)
+    wrong_obs = dataclasses.replace(b, observed=b.observed + 0.5)
+    with pytest.raises(ValueError, match="observed statistics differ"):
+        combine_analyses(a, wrong_obs)
+    wrong_labels = dataclasses.replace(b, module_labels=list(b.module_labels)[::-1])
+    with pytest.raises(ValueError, match="different module labels"):
+        combine_analyses(a, wrong_labels)
+    with pytest.raises(TypeError):
+        combine_analyses(a, {"disc": {"test": b}})
+
+
+def test_combine_nested_dicts(toy_pair_module):
+    a = _run(toy_pair_module, seed=1, simplify=False)
+    b = _run(toy_pair_module, seed=2, simplify=False)
+    c = combine_analyses(a, b)
+    assert set(c) == {"disc"} and set(c["disc"]) == {"test"}
+    inner = c["disc"]["test"]
+    assert inner.completed == a["disc"]["test"].completed + b["disc"]["test"].completed
+    # mismatched keys
+    with pytest.raises(ValueError, match="disagree on discovery"):
+        combine_analyses(a, {"other": b["disc"]})
+
+
+def test_interrupted_runs_pool_completed_only(two_runs):
+    a, b = two_runs
+    # simulate an interrupted second run: only 50 of 120 completed
+    short = dataclasses.replace(b, completed=50)
+    c = combine_analyses(a, short)
+    assert c.completed == a.completed + 50
+    np.testing.assert_array_equal(c.nulls[a.completed :], b.nulls[:50])
+
+
+def test_total_space_roundtrip_and_conflict(two_runs, tmp_path):
+    a, b = two_runs
+    assert a.total_space is not None
+    p = str(tmp_path / "a.npz")
+    a.save(p)
+    loaded = PreservationResult.load(p)
+    assert loaded.total_space == a.total_space
+    conflicting = dataclasses.replace(b, total_space=123.0)
+    with pytest.raises(ValueError, match="permutation-space sizes"):
+        combine_analyses(a, conflicting)
+    # a None-space input defers to the recorded one
+    none_space = dataclasses.replace(b, total_space=None)
+    c = combine_analyses(a, none_space)
+    assert c.total_space == a.total_space

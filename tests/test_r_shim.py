@@ -112,9 +112,25 @@ def test_reference_surface_is_complete():
     src = _r_source()
     doc = open(os.path.join(ROOT, "docs", "r-shim.md")).read()
     for fn in ("modulePreservation", "networkProperties", "requiredPerms",
-               "plotModule"):
+               "plotModule", "combineAnalyses"):
         assert re.search(rf"^{fn}\s*<-\s*function", src, flags=re.M), fn
         assert fn in doc, f"{fn} undocumented in docs/r-shim.md"
+
+
+def test_combine_analyses_shim_override():
+    """combineAnalyses takes two positional results (the Python side is
+    variadic, so no positional mapping exists) plus the camelCase override,
+    which must map onto a real keyword with a matching default."""
+    from netrep_tpu.models.results import combine_analyses
+
+    assert _mapping("combineAnalyses") == {
+        "allowDuplicateNulls": "allow_duplicate_nulls"
+    }
+    r_defaults = _r_defaults("combineAnalyses")
+    assert list(r_defaults) == ["analysis1", "analysis2", "allowDuplicateNulls"]
+    assert r_defaults["allowDuplicateNulls"] == "FALSE"
+    p = inspect.signature(combine_analyses).parameters["allow_duplicate_nulls"]
+    assert p.kind is inspect.Parameter.KEYWORD_ONLY and p.default is False
 
 
 def test_reference_argument_names_preserved():
