@@ -59,6 +59,8 @@ __all__ = [
     "plot_summary",
     "plot_contribution",
     "plot_degree",
+    "node_order",
+    "sample_order",
 ]
 
 #: Diverging map (two hues + neutral midpoint) for signed quantities
@@ -103,9 +105,16 @@ def _prepare(
     test=None,
     order_nodes_by="discovery",
     order_samples_by="test",
+    stats: str = "full",
 ) -> ModuleLayout:
     """Shared input processing for all plot functions (SURVEY.md §3.3: same
-    L4 input layer, then networkProperties-style observed properties)."""
+    L4 input layer, then networkProperties-style observed properties).
+
+    ``stats`` bounds the data statistics computed: ``'full'`` (contribution +
+    summary + sample order — the composite plot), ``'summary'`` (summary and
+    sample order only), ``'none'`` (pure ordering; the per-module SVDs are
+    skipped).
+    """
     datasets = dsmod.build_datasets(network, data=data, correlation=correlation)
     names = list(datasets)
     d_name = str(discovery) if discovery is not None else names[0]
@@ -181,15 +190,16 @@ def _prepare(
     boundaries = np.concatenate([[0], np.cumsum(sizes)])
 
     contribution = summary = sample_order = None
-    if tgt.data is not None:
-        # per-module contribution/summary in the target dataset
-        contribution = np.empty(node_idx.size)
-        pos = 0
-        for _lab, _di, ti in specs:
-            block = node_idx[pos: pos + len(ti)]
-            sub = tgt.data[:, block]
-            contribution[pos: pos + len(ti)] = oracle.node_contribution(sub)
-            pos += len(ti)
+    if tgt.data is not None and stats != "none":
+        if stats == "full":
+            # per-module contribution/summary in the target dataset
+            contribution = np.empty(node_idx.size)
+            pos = 0
+            for _lab, _di, ti in specs:
+                block = node_idx[pos: pos + len(ti)]
+                sub = tgt.data[:, block]
+                contribution[pos: pos + len(ti)] = oracle.node_contribution(sub)
+                pos += len(ti)
         # summary profile of the *first* plotted module orders the samples
         # (the reference's orderSamplesBy semantics: one profile, one order)
         # Sample ordering: samples belong to the plotted dataset, so only its
@@ -220,6 +230,69 @@ def _prepare(
         summary=summary,
         sample_order=sample_order,
     )
+
+
+def node_order(
+    network,
+    data=None,
+    correlation=None,
+    module_assignments=None,
+    modules=None,
+    background_label: str = "0",
+    discovery=None,
+    test=None,
+    order_nodes_by="discovery",
+) -> list[str]:
+    """Node names in module-preservation plotting order — the reference's
+    exported ``nodeOrder()`` (upstream ``R/plotFunctions.R`` surface,
+    SURVEY.md §3.3): per-module blocks, each ordered by weighted degree
+    (descending) in the ``order_nodes_by`` dataset ('discovery' — the
+    default and the reference's convention — 'test', a dataset name, or
+    None for input order). Use it to build custom figures with the same
+    layout as :func:`plot_module`."""
+    layout = _prepare(
+        network, data=data, correlation=correlation,
+        module_assignments=module_assignments, modules=modules,
+        background_label=background_label, discovery=discovery, test=test,
+        order_nodes_by=order_nodes_by, order_samples_by=None,
+        stats="none",
+    )
+    return list(layout.node_names)
+
+
+def sample_order(
+    network,
+    data,
+    correlation=None,
+    module_assignments=None,
+    modules=None,
+    background_label: str = "0",
+    discovery=None,
+    test=None,
+    order_samples_by="test",
+):
+    """Sample labels (or indices, for unnamed data) ordered by the plotted
+    module's summary profile — the reference's exported ``sampleOrder()``:
+    the row order :func:`plot_module`'s data heatmap uses. ``data`` is
+    required (the summary profile is a data statistic); when more than one
+    module is selected, the first module's profile defines the order, as in
+    :func:`plot_module`."""
+    layout = _prepare(
+        network, data=data, correlation=correlation,
+        module_assignments=module_assignments, modules=modules,
+        background_label=background_label, discovery=discovery, test=test,
+        order_nodes_by="discovery", order_samples_by=order_samples_by,
+        stats="summary",
+    )
+    if layout.sample_order is None:
+        raise ValueError(
+            "sample_order requires `data` for the plotted (test) dataset — "
+            "the summary profile that orders samples is a data statistic"
+        )
+    names = layout.target.sample_names
+    if names is not None:
+        return [names[i] for i in layout.sample_order]
+    return np.asarray(layout.sample_order)
 
 
 # ---------------------------------------------------------------------------

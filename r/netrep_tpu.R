@@ -122,6 +122,76 @@ requiredPerms <- function(alpha = 0.05, nTests = 1L,
                            alternative = alternative)
 }
 
+.nodeOrder_args <- list(
+  network           = "network",
+  data              = "data",
+  correlation       = "correlation",
+  moduleAssignments = "module_assignments",
+  modules           = "modules",
+  backgroundLabel   = "background_label",
+  discovery         = "discovery",
+  test              = "test",
+  orderNodesBy      = "order_nodes_by"
+)
+
+#' Node plotting order by weighted degree (reference: nodeOrder).
+#' orderNodesBy = NULL is a real mode (input order), so it is forwarded as
+#' Python None rather than dropped.
+nodeOrder <- function(network,
+                      data = NULL,
+                      correlation = NULL,
+                      moduleAssignments = NULL,
+                      modules = NULL,
+                      backgroundLabel = "0",
+                      discovery = NULL,
+                      test = NULL,
+                      orderNodesBy = "discovery") {
+  plt <- reticulate::import("netrep_tpu.plot")
+  args <- list(network = network, data = data, correlation = correlation,
+               module_assignments = moduleAssignments, modules = modules,
+               background_label = backgroundLabel, discovery = discovery,
+               test = test)
+  args <- args[!vapply(args, is.null, logical(1))]
+  # ([<- with list() stores NULL; $<- NULL would delete the element)
+  args["order_nodes_by"] <- list(orderNodesBy)
+  do.call(plt$node_order, args)
+}
+
+.sampleOrder_args <- list(
+  network           = "network",
+  data              = "data",
+  correlation       = "correlation",
+  moduleAssignments = "module_assignments",
+  modules           = "modules",
+  backgroundLabel   = "background_label",
+  discovery         = "discovery",
+  test              = "test",
+  orderSamplesBy    = "order_samples_by"
+)
+
+#' Sample plotting order by summary profile (reference: sampleOrder).
+#' orderSamplesBy = NULL is a real mode (input order), so it is forwarded as
+#' Python None rather than dropped.
+sampleOrder <- function(network,
+                        data,
+                        correlation = NULL,
+                        moduleAssignments = NULL,
+                        modules = NULL,
+                        backgroundLabel = "0",
+                        discovery = NULL,
+                        test = NULL,
+                        orderSamplesBy = "test") {
+  plt <- reticulate::import("netrep_tpu.plot")
+  args <- list(network = network, data = data, correlation = correlation,
+               module_assignments = moduleAssignments, modules = modules,
+               background_label = backgroundLabel, discovery = discovery,
+               test = test)
+  args <- args[!vapply(args, is.null, logical(1))]
+  # ([<- with list() stores NULL; $<- NULL would delete the element)
+  args["order_samples_by"] <- list(orderSamplesBy)
+  do.call(plt$sample_order, args)
+}
+
 .combineAnalyses_args <- list(
   allowDuplicateNulls = "allow_duplicate_nulls"
 )
@@ -166,8 +236,12 @@ plotModule <- function(network,
   args <- list(network = network, data = data, correlation = correlation,
                module_assignments = moduleAssignments, modules = modules,
                background_label = backgroundLabel, discovery = discovery,
-               test = test, order_nodes_by = orderNodesBy,
-               order_samples_by = orderSamplesBy, ...)
+               test = test, ...)
   args <- args[!vapply(args, is.null, logical(1))]
+  # NULL is a real mode for the order arguments (input order) — forward as
+  # Python None instead of dropping to the Python defaults
+  # ([<- with list() stores NULL; $<- NULL would delete the element)
+  args["order_nodes_by"] <- list(orderNodesBy)
+  args["order_samples_by"] <- list(orderSamplesBy)
   do.call(plt$plot_module, args)
 }

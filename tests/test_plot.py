@@ -162,3 +162,60 @@ def test_plot_module_sparse():
     with pytest.raises(TypeError, match="SparseAdjacency"):
         plot_module_sparse(adj.to_dense(), data=x,
                            module_assignments=labels)
+
+
+def test_node_order_public(ex):
+    """node_order() (reference: exported nodeOrder) returns the same order
+    the composite plot lays out."""
+    names = nplot.node_order(
+        **_inputs(ex), discovery="d", test="t", modules=["1", "2"],
+    )
+    layout = nplot._prepare(
+        **_inputs(ex), discovery="d", test="t", modules=["1", "2"],
+    )
+    assert names == layout.node_names
+    assert len(names) == len(set(names)) > 0
+    # data-less call works (degree is a topology statistic)
+    dataless = nplot.node_order(
+        **_inputs(ex, with_data=False), discovery="d", test="t",
+        modules=["1", "2"],
+    )
+    assert dataless == names
+
+
+def test_sample_order_public(ex):
+    """sample_order() (reference: exported sampleOrder) matches the data
+    heatmap's row order: argsort of the first module's summary profile."""
+    order = nplot.sample_order(
+        **_inputs(ex), discovery="d", test="t", modules=["1"],
+    )
+    layout = nplot._prepare(
+        **_inputs(ex), discovery="d", test="t", modules=["1"],
+    )
+    assert len(order) == ex["test_data"].shape[0]
+    expect = np.argsort(
+        oracle.summary_profile(
+            np.asarray(ex["test_data"])[:, layout.node_idx[: int(layout.boundaries[1])]]
+        ),
+        kind="stable",
+    )
+    got_idx = order if not isinstance(order, list) else [
+        list(layout.target.sample_names).index(s) for s in order
+    ]
+    np.testing.assert_array_equal(np.asarray(got_idx), expect)
+
+    with pytest.raises(TypeError):
+        nplot.sample_order(**_inputs(ex, with_data=False), discovery="d",
+                           test="t")
+
+
+def test_sample_order_missing_test_data_raises(ex):
+    """data provided but not for the plotted dataset → layout has no summary
+    → the informative ValueError (not a silent None)."""
+    import pandas as pd
+
+    kw = _inputs(ex, with_data=False)
+    kw["data"] = {"d": pd.DataFrame(ex["discovery_data"],
+                                    columns=ex["discovery_names"])}
+    with pytest.raises(ValueError, match="requires `data`"):
+        nplot.sample_order(**kw, discovery="d", test="t")

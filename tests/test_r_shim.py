@@ -74,6 +74,8 @@ CASES = [
      "network_properties"),
     ("requiredPerms", "netrep_tpu.ops.pvalues", "required_perms"),
     ("plotModule", "netrep_tpu.plot", "plot_module"),
+    ("nodeOrder", "netrep_tpu.plot", "node_order"),
+    ("sampleOrder", "netrep_tpu.plot", "sample_order"),
 ]
 
 
@@ -112,7 +114,7 @@ def test_reference_surface_is_complete():
     src = _r_source()
     doc = open(os.path.join(ROOT, "docs", "r-shim.md")).read()
     for fn in ("modulePreservation", "networkProperties", "requiredPerms",
-               "plotModule", "combineAnalyses"):
+               "plotModule", "combineAnalyses", "nodeOrder", "sampleOrder"):
         assert re.search(rf"^{fn}\s*<-\s*function", src, flags=re.M), fn
         assert fn in doc, f"{fn} undocumented in docs/r-shim.md"
 
