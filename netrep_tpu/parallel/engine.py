@@ -736,11 +736,16 @@ class PermutationEngine:
             )
 
         def write(nulls, outs, done, take):
+            from .distributed import gather_to_host
+
             for b, out in zip(self.buckets, outs):
                 # transfer the whole chunk output and slice on the HOST: a
                 # device-side `out[:take]` is an eager op, and eager dispatch
-                # on tunneled backends costs ~1s per op (the arrays are tiny)
-                arr = np.asarray(out, dtype=np.float64)
+                # on tunneled backends costs ~1s per op (the arrays are tiny).
+                # gather_to_host additionally allgathers across processes on
+                # multi-host meshes, where the perm-axis shards live on other
+                # hosts' devices and np.asarray alone would fail.
+                arr = gather_to_host(out).astype(np.float64)
                 nulls[done: done + take, b.module_pos] = arr[:take]
 
         return run_checkpointed_chunks(

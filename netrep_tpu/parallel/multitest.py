@@ -302,12 +302,15 @@ class MultiTestEngine:
         helpers on :class:`PermutationEngine` so the two paths cannot
         drift)."""
         def write(nulls, outs, done, take):
+            from .distributed import gather_to_host
+
             for b, outarr in zip(self._base.buckets, outs):
                 # full-chunk transfer, host-side slice (device slicing is an
                 # eager op — ~1s dispatch on tunneled backends); a single
                 # advanced index (module_pos) keeps its axis position in the
-                # assignment target.
-                arr = np.asarray(outarr, dtype=np.float64)
+                # assignment target. Cross-host allgather on multi-host
+                # meshes.
+                arr = gather_to_host(outarr).astype(np.float64)
                 nulls[:, done: done + take, b.module_pos] = arr[:, :take]
 
         from .engine import run_checkpointed_chunks

@@ -290,10 +290,13 @@ class SparsePermutationEngine:
         interruptible, resumable, checkpointable; same-seed ⇒ same null)."""
 
         def write(nulls, outs, done, take):
+            from .distributed import gather_to_host
+
             for b, out in zip(self.buckets, outs):
                 # full-chunk transfer, host-side slice (device slicing is an
-                # eager op — ~1s dispatch on tunneled backends)
-                arr = np.asarray(out, dtype=np.float64)
+                # eager op — ~1s dispatch on tunneled backends); cross-host
+                # allgather on multi-host meshes
+                arr = gather_to_host(out).astype(np.float64)
                 nulls[done: done + take, b.module_pos] = arr[:take]
 
         return run_checkpointed_chunks(

@@ -281,7 +281,9 @@ def sample_order(
         network, data=data, correlation=correlation,
         module_assignments=module_assignments, modules=modules,
         background_label=background_label, discovery=discovery, test=test,
-        order_nodes_by="discovery", order_samples_by=order_samples_by,
+        # node order cannot affect the sample order (the summary profile is
+        # column-permutation-invariant), so skip the degree sorts entirely
+        order_nodes_by=None, order_samples_by=order_samples_by,
         stats="summary",
     )
     if layout.sample_order is None:

@@ -122,6 +122,17 @@ requiredPerms <- function(alpha = 0.05, nTests = 1L,
                            alternative = alternative)
 }
 
+#' Shared plot-call glue: drop NULL args (Python defaults apply), then
+#' force-set the order-mode arguments — NULL is a real mode there (input
+#' order), so it must reach Python as None, not be dropped. Single-bracket
+#' list assignment stores NULL; $<- NULL would delete the element.
+.callPlot <- function(py_name, args, orderArgs) {
+  plt <- reticulate::import("netrep_tpu.plot")
+  args <- args[!vapply(args, is.null, logical(1))]
+  for (nm in names(orderArgs)) args[nm] <- orderArgs[nm]
+  do.call(plt[[py_name]], args)
+}
+
 .nodeOrder_args <- list(
   network           = "network",
   data              = "data",
@@ -146,15 +157,12 @@ nodeOrder <- function(network,
                       discovery = NULL,
                       test = NULL,
                       orderNodesBy = "discovery") {
-  plt <- reticulate::import("netrep_tpu.plot")
-  args <- list(network = network, data = data, correlation = correlation,
-               module_assignments = moduleAssignments, modules = modules,
-               background_label = backgroundLabel, discovery = discovery,
-               test = test)
-  args <- args[!vapply(args, is.null, logical(1))]
-  # ([<- with list() stores NULL; $<- NULL would delete the element)
-  args["order_nodes_by"] <- list(orderNodesBy)
-  do.call(plt$node_order, args)
+  .callPlot("node_order",
+            list(network = network, data = data, correlation = correlation,
+                 module_assignments = moduleAssignments, modules = modules,
+                 background_label = backgroundLabel, discovery = discovery,
+                 test = test),
+            list(order_nodes_by = orderNodesBy))
 }
 
 .sampleOrder_args <- list(
@@ -181,15 +189,12 @@ sampleOrder <- function(network,
                         discovery = NULL,
                         test = NULL,
                         orderSamplesBy = "test") {
-  plt <- reticulate::import("netrep_tpu.plot")
-  args <- list(network = network, data = data, correlation = correlation,
-               module_assignments = moduleAssignments, modules = modules,
-               background_label = backgroundLabel, discovery = discovery,
-               test = test)
-  args <- args[!vapply(args, is.null, logical(1))]
-  # ([<- with list() stores NULL; $<- NULL would delete the element)
-  args["order_samples_by"] <- list(orderSamplesBy)
-  do.call(plt$sample_order, args)
+  .callPlot("sample_order",
+            list(network = network, data = data, correlation = correlation,
+                 module_assignments = moduleAssignments, modules = modules,
+                 background_label = backgroundLabel, discovery = discovery,
+                 test = test),
+            list(order_samples_by = orderSamplesBy))
 }
 
 .combineAnalyses_args <- list(
@@ -232,16 +237,11 @@ plotModule <- function(network,
                        orderNodesBy = "discovery",
                        orderSamplesBy = "test",
                        ...) {
-  plt <- reticulate::import("netrep_tpu.plot")
-  args <- list(network = network, data = data, correlation = correlation,
-               module_assignments = moduleAssignments, modules = modules,
-               background_label = backgroundLabel, discovery = discovery,
-               test = test, ...)
-  args <- args[!vapply(args, is.null, logical(1))]
-  # NULL is a real mode for the order arguments (input order) — forward as
-  # Python None instead of dropping to the Python defaults
-  # ([<- with list() stores NULL; $<- NULL would delete the element)
-  args["order_nodes_by"] <- list(orderNodesBy)
-  args["order_samples_by"] <- list(orderSamplesBy)
-  do.call(plt$plot_module, args)
+  .callPlot("plot_module",
+            list(network = network, data = data, correlation = correlation,
+                 module_assignments = moduleAssignments, modules = modules,
+                 background_label = backgroundLabel, discovery = discovery,
+                 test = test, ...),
+            list(order_nodes_by = orderNodesBy,
+                 order_samples_by = orderSamplesBy))
 }
