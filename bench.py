@@ -55,7 +55,22 @@ def ensure_backend(probe_timeout: float = 120.0):
 
     import jax
 
-    if os.environ.get("JAX_PLATFORMS", "") == "axon":
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want and "axon" not in want:
+        # An explicit non-TPU platform (e.g. JAX_PLATFORMS=cpu): the axon
+        # plugin's get_backend hook still dials the tunnel first — the env
+        # var alone does NOT stop it — so force the platform via jax.config
+        # before any device call.
+        jax.config.update("jax_platforms", want)
+        try:
+            return jax.devices()
+        except RuntimeError:
+            # requested platform unavailable → CPU (NOT automatic selection,
+            # which would dial the axon plugin and hang when the tunnel is
+            # down — the very hang this function exists to prevent)
+            jax.config.update("jax_platforms", "cpu")
+            return jax.devices()
+    if "axon" in want or os.path.exists("/root/.axon_site"):
         try:
             # only a TIMEOUT means the tunnel is hung-dead; a fast nonzero
             # exit (e.g. plugin registration RuntimeError) falls through to

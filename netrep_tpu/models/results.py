@@ -80,8 +80,36 @@ class PreservationResult:
         """Per-module worst-case p-value across the seven statistics — the
         reference's conventional module-level preservation call (a module is
         preserved when *all* statistics are significant)."""
-        with np.errstate(invalid="ignore"):
+        import warnings
+
+        with warnings.catch_warnings():
+            # an all-NaN row (data-less run: no computable statistics) is a
+            # legitimate input; nanmax's RuntimeWarning for it is noise here
+            warnings.simplefilter("ignore", category=RuntimeWarning)
             return np.nanmax(self.p_values, axis=1)
+
+    def preserved_modules(
+        self, alpha: float = 0.05, adjust: str = "bonferroni"
+    ) -> list[str]:
+        """Module labels meeting the conventional preservation call (the
+        reference vignette's interpretation rule, done by hand there): every
+        computed statistic significant at ``alpha``, Bonferroni-adjusted for
+        the number of modules tested (``adjust='none'`` skips adjustment).
+        Modules with no computable statistics (all-NaN row) never qualify."""
+        if adjust == "bonferroni":
+            thresh = alpha / max(len(self.module_labels), 1)
+        elif adjust == "none":
+            thresh = alpha
+        else:
+            raise ValueError(
+                f"adjust must be 'bonferroni' or 'none', got {adjust!r}"
+            )
+        mx = self.max_pvalue()
+        return [
+            lab
+            for lab, p in zip(self.module_labels, mx)
+            if np.isfinite(p) and p < thresh
+        ]
 
     _SAVE_VERSION = 1
 

@@ -194,3 +194,28 @@ def test_total_space_roundtrip_and_conflict(two_runs, tmp_path):
     none_space = dataclasses.replace(b, total_space=None)
     c = combine_analyses(a, none_space)
     assert c.total_space == a.total_space
+
+
+def test_preserved_modules_call():
+    import warnings
+
+    nulls = np.zeros((10, 4, 7))
+    r = PreservationResult(
+        discovery="d", test="t", module_labels=["a", "b", "c", "d"],
+        observed=np.ones((4, 7)), nulls=nulls,
+        p_values=np.array([[0.001] * 7,            # clearly preserved
+                           [0.001] * 6 + [0.2],    # one statistic fails
+                           [np.nan] * 7,           # nothing computable
+                           [0.001] * 6 + [0.02]]), # alpha/4 < 0.02 < alpha
+        n_vars_present=np.array([5] * 4),
+        prop_vars_present=np.ones(4), total_size=np.array([5] * 4),
+        alternative="greater", n_perm=10, completed=10,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # the all-NaN row must stay silent
+        # module 'd' distinguishes Bonferroni (0.05/4) from unadjusted
+        assert r.preserved_modules() == ["a"]
+        assert r.preserved_modules(adjust="none") == ["a", "d"]
+        assert r.preserved_modules(alpha=0.7, adjust="none") == ["a", "b", "d"]
+    with pytest.raises(ValueError, match="adjust"):
+        r.preserved_modules(adjust="fdr")
