@@ -232,3 +232,21 @@ def test_derived_network_mismatch_raises(setup):
             t["correlation"], t["network"], t["data"], modules, pool,
             config=EngineConfig(network_from_correlation=3.0),  # wrong beta
         )
+
+
+def test_bfloat16_storage_tracks_float32(setup):
+    """dtype='bfloat16' halves the HBM traffic of the bandwidth-bound gather
+    (the TPU perf lever, BASELINE.md roofline/precision notes); statistics
+    must track the f32 run within bf16 rounding attenuated by the per-module
+    averaging (~1e-2 at toy module sizes, far below Monte-Carlo null noise)."""
+    f32 = _engine(setup, config=EngineConfig(chunk_size=16, summary_method="eigh",
+                                             dtype="float32"))
+    bf16 = _engine(setup, config=EngineConfig(chunk_size=16, summary_method="eigh",
+                                              dtype="bfloat16"))
+    np.testing.assert_allclose(bf16.observed(), f32.observed(), atol=2e-2)
+    nf, cf = f32.run_null(12, key=3)
+    nb, cb = bf16.run_null(12, key=3)
+    assert cf == cb == 12
+    # same permutation draws (keys are dtype-independent), bf16-rounded stats
+    np.testing.assert_allclose(nb, nf, atol=5e-2)
+    assert np.isfinite(nb).all()

@@ -1,0 +1,121 @@
+"""Adversarial input-validation sweep (SURVEY.md §4: "input-validation tests
+assert informative errors on malformed matrices"): every malformed variant of
+a valid call must fail with ValueError/TypeError carrying a non-empty message
+— never an IndexError/KeyError/opaque crash from deeper in the stack, and
+never a silent success."""
+
+import numpy as np
+import pytest
+
+from netrep_tpu import module_preservation
+
+
+def _valid_kwargs(rng, n=24, s=10):
+    z = rng.standard_normal((s, n))
+    corr = np.corrcoef(z, rowvar=False)
+    net = np.abs(corr) ** 2
+    names = [f"g{i}" for i in range(n)]
+    import pandas as pd
+
+    df = lambda m: pd.DataFrame(m, index=names, columns=names)
+    labels = {nm: str(1 + (i % 2)) for i, nm in enumerate(names)}
+    return dict(
+        network={"d": df(net), "t": df(net + 0.0)},
+        data={"d": pd.DataFrame(z, columns=names),
+              "t": pd.DataFrame(z, columns=names)},
+        correlation={"d": df(corr), "t": df(corr)},
+        module_assignments=labels,
+        discovery="d", test="t", n_perm=8,
+    )
+
+
+def _mutations(rng, kw):
+    """Yield (description, mutated-kwargs) pairs, each invalid in one way."""
+    import copy
+
+    import pandas as pd
+
+    def clone():
+        return copy.deepcopy(kw)
+
+    m = clone()
+    m["network"]["t"].iloc[0, 1] += 0.5  # breaks symmetry
+    yield "asymmetric network", m
+
+    m = clone()
+    m["correlation"]["d"].iloc[2, 3] = np.nan
+    m["correlation"]["d"].iloc[3, 2] = np.nan
+    yield "NaN in correlation", m
+
+    m = clone()
+    m["data"]["t"] = m["data"]["t"].iloc[:, :-1]  # drops a column
+    yield "data/network column mismatch", m
+
+    m = clone()
+    bad = m["network"]["d"].copy()
+    bad.columns = [f"x{i}" for i in range(bad.shape[1])]
+    bad.index = bad.columns
+    m["network"]["d"] = bad
+    yield "node names disagree across matrices", m
+
+    m = clone()
+    m["discovery"] = "nope"
+    yield "unknown discovery name", m
+
+    m = clone()
+    m["modules"] = ["99"]
+    yield "unknown module label", m
+
+    m = clone()
+    m["module_assignments"] = {k: v for k, v in list(kw["module_assignments"].items())[:-3]}
+    yield "assignments missing nodes", m
+
+    m = clone()
+    m["module_assignments"] = "0"  # all-background scalar nonsense
+    yield "assignments wrong type", m
+
+    m = clone()
+    m["network"]["t"] = pd.DataFrame(
+        np.ones((3, 4)), index=list("abc"), columns=list("wxyz")
+    )
+    yield "non-square network", m
+
+    m = clone()
+    m["alternative"] = "both"
+    yield "bad alternative", m
+
+    m = clone()
+    m["null"] = "everything"
+    yield "bad null mode", m
+
+    m = clone()
+    m["network"] = None
+    yield "missing network", m
+
+    m = clone()
+    dup = m["network"]["d"].copy()
+    dup.columns = ["g0"] * dup.shape[1]
+    dup.index = dup.columns
+    m["network"]["d"] = dup
+    yield "duplicate node names", m
+
+
+def test_malformed_inputs_fail_informatively():
+    rng = np.random.default_rng(0)
+    kw = _valid_kwargs(rng)
+    # sanity: the unmutated call succeeds
+    res = module_preservation(**kw, seed=1)
+    assert res.completed == 8
+
+    failures = []
+    for desc, mkw in _mutations(rng, kw):
+        try:
+            module_preservation(**mkw, seed=1)
+        except (ValueError, TypeError) as e:
+            if not str(e).strip():
+                failures.append(f"{desc}: empty error message")
+        except Exception as e:  # wrong exception class = leaked internal error
+            failures.append(f"{desc}: {type(e).__name__}: {e}")
+        else:
+            failures.append(f"{desc}: silently succeeded")
+    assert not failures, "\n".join(failures)
