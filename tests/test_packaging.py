@@ -1,0 +1,50 @@
+"""Packaging metadata stays truthful: version parity with the package,
+package discovery finds every subpackage, and the native source ships as
+package data (the lazy first-use build depends on it being installed)."""
+
+import os
+import tomllib
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _project():
+    with open(os.path.join(ROOT, "pyproject.toml"), "rb") as f:
+        return tomllib.load(f)
+
+
+def test_version_parity():
+    import netrep_tpu
+
+    assert _project()["project"]["version"] == netrep_tpu.__version__
+
+
+def test_all_subpackages_discovered():
+    from setuptools import find_packages
+
+    found = set(find_packages(where=ROOT, include=["netrep_tpu*"]))
+    on_disk = {"netrep_tpu"} | {
+        f"netrep_tpu.{d}"
+        for d in os.listdir(os.path.join(ROOT, "netrep_tpu"))
+        if os.path.isdir(os.path.join(ROOT, "netrep_tpu", d))
+        and os.path.exists(os.path.join(ROOT, "netrep_tpu", d, "__init__.py"))
+    }
+    assert found == on_disk, (found, on_disk)
+
+
+def test_native_source_is_package_data():
+    data = _project()["tool"]["setuptools"]["package-data"]
+    assert "*.cpp" in data["netrep_tpu.native"]
+    assert os.path.exists(
+        os.path.join(ROOT, "netrep_tpu", "native", "netstats.cpp")
+    )
+
+
+def test_declared_dependencies_cover_package_imports():
+    """Hard dependencies must cover everything the core package imports at
+    module scope (plot/pandas extras excluded by design)."""
+    deps = {
+        d.split(">=")[0].split("==")[0].strip()
+        for d in _project()["project"]["dependencies"]
+    }
+    assert {"numpy", "scipy", "jax"} <= deps
