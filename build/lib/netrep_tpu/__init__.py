@@ -1,0 +1,68 @@
+"""netrep-tpu — a TPU-native (JAX/XLA) framework with the capabilities of the
+NetRep R package: permutation testing of network module preservation across
+datasets (SURVEY.md; BASELINE.json:5).
+
+Public API (mirrors the reference's exported surface, SURVEY.md §2.1):
+
+- :func:`module_preservation`   — the main entry point (permutation test).
+- :func:`network_properties`    — observed per-module topological properties.
+- :func:`required_perms`        — permutations needed for a significance level.
+"""
+
+from .ops.oracle import STAT_NAMES, TOPOLOGY_STATS
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "STAT_NAMES",
+    "TOPOLOGY_STATS",
+    "module_preservation",
+    "network_properties",
+    "required_perms",
+    "permp",
+    "load_example",
+    "make_example_pair",
+    "PreservationResult",
+    "combine_analyses",
+    "SparseAdjacency",
+    "sparse_module_preservation",
+    "sparse_network_properties",
+    "summarize_trace",
+]
+
+
+def __getattr__(name):
+    # Lazy imports keep `import netrep_tpu` light (no jax trace-time cost)
+    # until an API that needs it is touched.
+    if name in ("module_preservation", "network_properties"):
+        from .models import preservation, properties
+
+        return {
+            "module_preservation": preservation.module_preservation,
+            "network_properties": properties.network_properties,
+        }[name]
+    if name in ("required_perms", "permp"):
+        from .ops import pvalues
+
+        return getattr(pvalues, name)
+    if name in ("load_example", "make_example_pair"):
+        from . import data
+
+        return getattr(data, name)
+    if name == "SparseAdjacency":
+        from .ops.sparse import SparseAdjacency
+
+        return SparseAdjacency
+    if name in ("sparse_module_preservation", "sparse_network_properties"):
+        from .models import sparse_api
+
+        return getattr(sparse_api, name)
+    if name == "summarize_trace":
+        from .utils.profiling import summarize_trace
+
+        return summarize_trace
+    if name in ("PreservationResult", "combine_analyses"):
+        from .models import results
+
+        return getattr(results, name)
+    raise AttributeError(name)

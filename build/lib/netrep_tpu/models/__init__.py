@@ -1,0 +1,2 @@
+"""User-facing model layer: dataset containers, the `module_preservation`
+orchestrator, `network_properties`, and result shaping (SURVEY.md §2.1)."""

@@ -1,0 +1,354 @@
+"""Dataset containers and input normalization — the rebuild of the
+reference's input-processing layer (SURVEY.md §2.1 "Input processing",
+§3.1 L4): normalizes the ``network`` / ``data`` / ``correlation`` arguments
+(single matrix, list, or dict over datasets) into aligned internal
+structures, and validates symmetry, finiteness, and cross-dataset name
+matching with informative errors (error-message parity is an explicit goal,
+SURVEY.md §7 step 6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Sequence
+
+import numpy as np
+
+try:  # pandas is optional at runtime but used when given
+    import pandas as pd
+except Exception:  # pragma: no cover
+    pd = None
+
+_SYM_TOL = 1e-8
+
+
+@dataclasses.dataclass
+class Dataset:
+    """One dataset's aligned matrices.
+
+    Attributes
+    ----------
+    name : dataset label.
+    correlation : (n, n) correlation matrix.
+    network : (n, n) network (edge weight / adjacency) matrix.
+    data : (n_samples, n) data matrix or None (data-less variant).
+    node_names : length-n node labels (column names).
+    sample_names : sample labels for ``data`` (or None).
+    """
+
+    name: str
+    correlation: np.ndarray
+    network: np.ndarray
+    data: np.ndarray | None
+    node_names: list[str]
+    sample_names: list[str] | None = None
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.node_names)
+
+    def index_of(self) -> dict[str, int]:
+        return {nm: i for i, nm in enumerate(self.node_names)}
+
+
+def _as_matrix(x, what: str, dataset: str):
+    """Extract (array, row_names, col_names) from ndarray / DataFrame."""
+    if pd is not None and isinstance(x, pd.DataFrame):
+        return (
+            x.to_numpy(dtype=np.float64),
+            [str(r) for r in x.index],
+            [str(c) for c in x.columns],
+        )
+    arr = np.asarray(x, dtype=np.float64)
+    if arr.ndim != 2:
+        raise ValueError(
+            f"{what} for dataset {dataset!r} must be a 2-dimensional matrix, "
+            f"got {arr.ndim} dimension(s)"
+        )
+    return arr, None, None
+
+
+def _check_square_symmetric(arr: np.ndarray, what: str, dataset: str):
+    if arr.shape[0] != arr.shape[1]:
+        raise ValueError(
+            f"{what} for dataset {dataset!r} must be square, got shape {arr.shape}"
+        )
+    if not np.isfinite(arr).all():
+        raise ValueError(
+            f"{what} for dataset {dataset!r} contains non-finite values "
+            "(NA/NaN/Inf are not allowed)"
+        )
+    if not np.allclose(arr, arr.T, atol=_SYM_TOL):
+        raise ValueError(f"{what} for dataset {dataset!r} is not symmetric")
+
+
+def _normalize_collection(x, what: str) -> dict[str, object]:
+    """Turn a single matrix / sequence / mapping into {dataset_name: matrix}."""
+    if x is None:
+        return {}
+    if isinstance(x, Mapping):
+        return {str(k): v for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return {str(i + 1): v for i, v in enumerate(x)}
+    return {"1": x}
+
+
+def build_datasets(
+    network,
+    data=None,
+    correlation=None,
+) -> dict[str, Dataset]:
+    """Normalize user inputs into named, validated :class:`Dataset` objects.
+
+    Mirrors the reference's input-processing semantics (SURVEY.md §2.1):
+    ``network`` is required; ``correlation`` is required for the correlation
+    statistics; ``data`` is optional (data-less variant drops the
+    data-dependent statistics, SURVEY.md §2.2). Checks performed per dataset:
+    square + symmetric + finite correlation/network, correlation entries in
+    [-1, 1], data/correlation/network node-name agreement and equal node
+    counts.
+    """
+    nets = _normalize_collection(network, "network")
+    if not nets:
+        raise ValueError("network must be provided (matrix, list, or dict)")
+    datas = _normalize_collection(data, "data")
+    corrs = _normalize_collection(correlation, "correlation")
+    if not corrs:
+        raise ValueError(
+            "correlation must be provided: the preservation statistics "
+            "cor.cor and avg.cor are defined on the correlation structure"
+        )
+    if set(corrs) != set(nets):
+        raise ValueError(
+            f"correlation datasets {sorted(corrs)} do not match network "
+            f"datasets {sorted(nets)}"
+        )
+    if datas and not set(datas) <= set(nets):
+        raise ValueError(
+            f"data datasets {sorted(datas)} are not a subset of network "
+            f"datasets {sorted(nets)}"
+        )
+
+    out: dict[str, Dataset] = {}
+    for name, net_raw in nets.items():
+        net, _nr, net_names = _as_matrix(net_raw, "network", name)
+        _check_square_symmetric(net, "network", name)
+        corr, _cr, corr_names = _as_matrix(corrs[name], "correlation", name)
+        _check_square_symmetric(corr, "correlation", name)
+        if np.nanmax(np.abs(corr)) > 1 + 1e-6:
+            raise ValueError(
+                f"correlation for dataset {name!r} has entries outside [-1, 1]"
+            )
+        if corr.shape != net.shape:
+            raise ValueError(
+                f"correlation and network for dataset {name!r} disagree in "
+                f"size: {corr.shape} vs {net.shape}"
+            )
+
+        dat = samp_names = dat_names = None
+        if name in datas:
+            dat, samp_names, dat_names = _as_matrix(datas[name], "data", name)
+            if not np.isfinite(dat).all():
+                raise ValueError(
+                    f"data for dataset {name!r} contains non-finite values"
+                )
+            if dat.shape[1] != net.shape[0]:
+                raise ValueError(
+                    f"data for dataset {name!r} has {dat.shape[1]} nodes "
+                    f"(columns) but the network has {net.shape[0]}"
+                )
+
+        names = net_names or corr_names or dat_names
+        if names is None:
+            names = [f"node_{i}" for i in range(net.shape[0])]
+        for label, other in (("correlation", corr_names), ("data", dat_names)):
+            if other is not None and other != names:
+                raise ValueError(
+                    f"node names of {label} and network disagree for dataset "
+                    f"{name!r}"
+                )
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate node names in dataset {name!r}")
+
+        out[name] = Dataset(
+            name=name,
+            correlation=corr,
+            network=net,
+            data=dat,
+            node_names=list(names),
+            sample_names=samp_names,
+        )
+    return out
+
+
+def normalize_module_assignments(
+    module_assignments,
+    datasets: dict[str, Dataset],
+    discovery: Sequence[str],
+) -> dict[str, dict[str, str]]:
+    """Normalize ``module_assignments`` into {discovery_dataset: {node: label}}.
+
+    Accepts a mapping node→label, a sequence aligned with the discovery
+    dataset's node order, a pandas Series, or a mapping
+    discovery_dataset→(any of the above) for multiple discovery datasets
+    (SURVEY.md §2.1).
+    """
+    if module_assignments is None:
+        raise ValueError("module_assignments must be provided")
+
+    def one(x, dname: str) -> dict[str, str]:
+        ds = datasets[dname]
+        if pd is not None and isinstance(x, pd.Series):
+            x = {str(k): v for k, v in x.items()}
+        if isinstance(x, Mapping):
+            by_name = {str(k): v for k, v in x.items()}  # tolerate int keys
+            miss = set(ds.node_names) - set(by_name)
+            if miss:
+                raise ValueError(
+                    f"module_assignments is missing {len(miss)} node(s) of "
+                    f"discovery dataset {dname!r} (e.g. {sorted(miss)[:3]})"
+                )
+            return {nm: str(by_name[nm]) for nm in ds.node_names}
+        seq = list(x)
+        if len(seq) != ds.n_nodes:
+            raise ValueError(
+                f"module_assignments has length {len(seq)} but discovery "
+                f"dataset {dname!r} has {ds.n_nodes} nodes"
+            )
+        return {nm: str(l) for nm, l in zip(ds.node_names, seq)}
+
+    if isinstance(module_assignments, Mapping):
+        # A mapping keyed entirely by dataset names is a per-discovery dict;
+        # anything else is a node→label mapping for the single discovery.
+        keys = {str(k) for k in module_assignments}
+        if keys and keys <= set(datasets):
+            missing = set(discovery) - keys
+            if missing:
+                raise ValueError(
+                    f"module_assignments has no entry for discovery "
+                    f"dataset(s) {sorted(missing)}"
+                )
+            return {
+                str(k): one(v, str(k))
+                for k, v in module_assignments.items()
+                if str(k) in set(discovery)
+            }
+    if len(discovery) > 1:
+        raise ValueError(
+            "with multiple discovery datasets, module_assignments must be a "
+            "dict {discovery_dataset: assignments}"
+        )
+    return {discovery[0]: one(module_assignments, discovery[0])}
+
+
+def resolve_pairs(
+    datasets: dict[str, Dataset],
+    discovery,
+    test,
+    self_preservation: bool,
+) -> list[tuple[str, str]]:
+    """Resolve the (discovery, test) dataset pairs to analyse (SURVEY.md
+    §3.1: loop over pairs; self-pairs skipped unless ``self_preservation``)."""
+    names = list(datasets)
+
+    def pick(x, what):
+        if x is None:
+            return None
+        if isinstance(x, (str, int)):
+            x = [x]
+        out = []
+        for item in x:
+            key = str(item)
+            if key not in datasets:
+                raise ValueError(
+                    f"{what} dataset {item!r} not found; available datasets: "
+                    f"{names}"
+                )
+            out.append(key)
+        return out
+
+    disc = pick(discovery, "discovery")
+    tst = pick(test, "test")
+    if disc is None:
+        disc = [names[0]]
+    if tst is None:
+        tst = [n for n in names if n not in disc] or list(disc)
+
+    pairs = [
+        (d, t)
+        for d in disc
+        for t in tst
+        if self_preservation or d != t
+    ]
+    if not pairs:
+        raise ValueError(
+            "no (discovery, test) pairs to analyse: discovery == test and "
+            "self_preservation=False"
+        )
+    return pairs
+
+
+def module_overlap_names(
+    disc_names: Sequence[str],
+    test_names: Sequence[str],
+    assignments: dict[str, str],
+    modules: Sequence[str] | None,
+    background_label: str | None = "0",
+    disc_label: str = "discovery",
+):
+    """Per-module aligned (discovery, test) index vectors over the nodes
+    present in both datasets, plus overlap bookkeeping (nVarsPresent /
+    propVarsPresent / totalSize, SURVEY.md §2.1 "Result shaping") — the
+    name-list core shared by the dense (:func:`module_overlap`) and sparse
+    (:mod:`netrep_tpu.models.sparse_api`) surfaces.
+
+    Returns (module_labels, specs, counts) where ``specs`` is a list of
+    ``(label, disc_idx, test_idx)`` and ``counts`` maps label →
+    (n_present, total_size).
+    """
+    tpos = {nm: i for i, nm in enumerate(test_names)}
+    all_labels = sorted(
+        {v for v in assignments.values() if v != str(background_label)},
+        key=lambda s: (len(s), s),
+    )
+    if modules is not None:
+        modules = [str(m) for m in modules]
+        unknown = [m for m in modules if m not in set(assignments.values())]
+        if unknown:
+            raise ValueError(
+                f"requested module(s) {unknown} do not exist in the "
+                f"module assignments for discovery dataset {disc_label}"
+            )
+        labels = modules
+    else:
+        labels = all_labels
+
+    specs, counts = [], {}
+    for lab in labels:
+        disc_idx, test_idx = [], []
+        total = 0
+        for i, nm in enumerate(disc_names):
+            if assignments[nm] != lab:
+                continue
+            total += 1
+            j = tpos.get(nm)
+            if j is not None:
+                disc_idx.append(i)
+                test_idx.append(j)
+        counts[lab] = (len(disc_idx), total)
+        specs.append((lab, np.asarray(disc_idx, np.int32), np.asarray(test_idx, np.int32)))
+    return labels, specs, counts
+
+
+def module_overlap(
+    disc_ds: Dataset,
+    test_ds: Dataset,
+    assignments: dict[str, str],
+    modules: Sequence[str] | None,
+    background_label: str | None = "0",
+):
+    """Dataset-object wrapper over :func:`module_overlap_names`."""
+    return module_overlap_names(
+        disc_ds.node_names, test_ds.node_names, assignments, modules,
+        background_label, disc_label=repr(disc_ds.name),
+    )

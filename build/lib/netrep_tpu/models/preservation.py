@@ -1,0 +1,345 @@
+"""`module_preservation` — the framework's main entry point, the rebuild of
+the reference's top-level orchestrator (SURVEY.md §2.1, call stack §3.1):
+validate inputs, loop over (discovery, test) dataset pairs, run the
+permutation engine (the TPU-native ``PermutationProcedure``), aggregate exact
+permutation p-values, and shape results.
+
+Argument names follow the reference's documented surface
+(``modulePreservation(network, data, correlation, moduleAssignments,
+modules, backgroundLabel, discovery, test, selfPreservation, nThreads,
+nPerm, null, alternative, simplify, verbose)`` — SURVEY.md §2.1) in
+snake_case. ``n_threads`` sizes the thread pool of ``backend='native'``
+(the C++ permutation procedure); on the default JAX backend it is ignored
+because XLA owns device parallelism (SURVEY.md §2.3 intra-op row).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+import numpy as np
+
+from ..ops import pvalues as pv
+from ..parallel.engine import ModuleSpec, PermutationEngine
+from ..utils.config import EngineConfig
+from ..utils.profiling import PairTimer, device_trace, resolve_profile_dir
+from . import dataset as ds
+from .results import PreservationResult, shape_results
+
+logger = logging.getLogger("netrep_tpu")
+
+
+def _overlap_setup(disc_ds, test_ds, assignments, modules, background_label, null):
+    """Resolve kept modules, specs, pool, and overlap bookkeeping for one
+    (discovery, test) pair (SURVEY.md §3.1)."""
+    labels, specs, counts = ds.module_overlap(
+        disc_ds, test_ds, assignments, modules, background_label
+    )
+    dropped = [lab for lab, _di, ti in specs if len(ti) < 2]
+    if dropped:
+        logger.warning(
+            "discovery %r → test %r: dropping module(s) %s with <2 nodes "
+            "present in the test dataset", disc_ds.name, test_ds.name, dropped,
+        )
+    kept = [(lab, di, ti) for lab, di, ti in specs if len(ti) >= 2]
+    if not kept:
+        raise ValueError(
+            f"no module of discovery {disc_ds.name!r} has ≥2 nodes present "
+            f"in test {test_ds.name!r}; nothing to test"
+        )
+    labels = [lab for lab, _, _ in kept]
+    mod_specs = [ModuleSpec(lab, di, ti) for lab, di, ti in kept]
+
+    tpos = test_ds.index_of()
+    if null == "overlap":
+        pool = np.asarray(
+            [tpos[nm] for nm in disc_ds.node_names if nm in tpos],
+            dtype=np.int32,
+        )
+    else:
+        pool = np.arange(test_ds.n_nodes, dtype=np.int32)
+    return labels, mod_specs, counts, pool
+
+
+def _make_result(d_name, t_name, labels, counts, observed, nulls, completed,
+                 np_this, alternative, total_space, profile=None):
+    p_values = pv.permutation_pvalues(
+        observed, nulls[:completed], alternative, total_nperm=total_space
+    )
+    n_present = np.array([counts[lab][0] for lab in labels])
+    tot = np.array([counts[lab][1] for lab in labels])
+    return PreservationResult(
+        discovery=d_name,
+        test=t_name,
+        module_labels=labels,
+        observed=observed,
+        nulls=nulls,
+        p_values=p_values,
+        n_vars_present=n_present,
+        prop_vars_present=n_present / tot,
+        total_size=tot,
+        alternative=alternative,
+        n_perm=np_this,
+        completed=completed,
+        profile=profile,
+        total_space=total_space,
+    )
+
+
+def module_preservation(
+    network,
+    data=None,
+    correlation=None,
+    module_assignments=None,
+    modules=None,
+    background_label: str = "0",
+    discovery=None,
+    test=None,
+    self_preservation: bool = False,
+    n_threads: int | None = None,  # used by backend='native'; JAX/XLA owns
+                                   # device parallelism otherwise
+    n_perm: int | None = None,
+    null: str = "overlap",
+    alternative: str = "greater",
+    simplify: bool = True,
+    verbose: bool = False,
+    seed: int = 0,
+    config: EngineConfig | None = None,
+    mesh=None,
+    vmap_tests: bool = False,
+    progress: Callable[[int, int], None] | None = None,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 8192,
+    backend: str = "jax",
+    profile=None,
+):
+    """Permutation test of network module preservation across datasets.
+
+    Parameters mirror the reference (SURVEY.md §2.1); TPU-specific additions:
+
+    - ``seed`` — PRNG seed; same seed ⇒ identical nulls regardless of chunk
+      size or device mesh (SURVEY.md §7 "RNG semantics").
+    - ``config`` — :class:`~netrep_tpu.utils.config.EngineConfig` TPU knobs
+      (chunk size, summary method, dtype, matrix sharding).
+    - ``mesh`` — optional :class:`jax.sharding.Mesh`; permutation chunks are
+      sharded across ``config.mesh_axis``, and with
+      ``config.matrix_sharding='row'`` the n×n matrices are row-sharded with
+      collective module gathers (SURVEY.md §2.3, §5).
+    - ``vmap_tests`` — Config C fast path (BASELINE.json:9): when one
+      discovery is tested against several cohorts sharing an identical node
+      universe, run them as a single vmapped kernel instead of sequential
+      pairs.
+    - ``progress`` — callback ``(done, total)`` per chunk.
+    - ``checkpoint_dir`` — when set, each pair's partial null is persisted to
+      ``<dir>/null_<discovery>__<test>.npz`` every ``checkpoint_every``
+      permutations and on interrupt; re-running the same call resumes
+      exactly (SURVEY.md §5 "checkpoint/resume" — an improvement over the
+      reference's all-or-nothing runs).
+    - ``profile`` — tracing/profiling (SURVEY.md §5; the reference offers
+      only ``verbose=`` + ``system.time``): ``True`` captures a
+      ``jax.profiler`` trace under ``./netrep_profile``, a string names the
+      trace directory, and either also attaches per-pair timings (observed/
+      null wall-clock, per-chunk ms, first-chunk compile time, steady-state
+      median) to each result as ``result.profile``. Inspect the trace with
+      TensorBoard/Perfetto or
+      :func:`netrep_tpu.utils.profiling.summarize_trace`.
+
+    Returns
+    -------
+    ``{discovery: {test: PreservationResult}}``, collapsed by ``simplify``.
+    """
+    if null not in ("overlap", "all"):
+        raise ValueError(f"null must be 'overlap' or 'all', got {null!r}")
+    if alternative not in ("greater", "less", "two.sided"):
+        raise ValueError(
+            "alternative must be one of 'greater', 'less', 'two.sided', "
+            f"got {alternative!r}"
+        )
+    if backend not in ("jax", "native"):
+        raise ValueError(f"backend must be 'jax' or 'native', got {backend!r}")
+    if backend == "native":
+        # the threaded C++ permutation procedure (netrep_tpu/native) — the
+        # CPU tier mirroring the reference's OpenMP PermutationProcedure
+        # (SURVEY.md §2.2); n_threads is honored here, unlike the JAX path
+        from ..native import NativePermutationEngine
+        engine_cls = lambda *a, **kw: NativePermutationEngine(
+            *a, **kw, n_threads=n_threads or 0
+        )
+    else:
+        engine_cls = PermutationEngine
+    config = config or EngineConfig()
+
+    def ckpt_path(d_name, t_name):
+        if checkpoint_dir is None:
+            return None
+        import os
+        import re
+
+        safe = lambda s: re.sub(r"[^A-Za-z0-9_.-]", "_", str(s))
+        return os.path.join(
+            checkpoint_dir, f"null_{safe(d_name)}__{safe(t_name)}.npz"
+        )
+
+    datasets = ds.build_datasets(network, data=data, correlation=correlation)
+    pairs = ds.resolve_pairs(datasets, discovery, test, self_preservation)
+    disc_names = sorted({d for d, _ in pairs}, key=list(datasets).index)
+    assign = ds.normalize_module_assignments(
+        module_assignments, datasets, disc_names
+    )
+
+    by_disc: dict[str, list[str]] = {}
+    for d_name, t_name in pairs:
+        by_disc.setdefault(d_name, []).append(t_name)
+
+    def auto_n_perm(labels, with_data):
+        # Bonferroni across all module×statistic tests (SURVEY.md §3.4):
+        # 7 statistics with data, 3 topology-only without; floor of 1000.
+        n_stats_eff = 7 if with_data else 3
+        return max(1000, pv.required_perms(0.05, n_tests=len(labels) * n_stats_eff))
+
+    trace_dir = resolve_profile_dir(profile)
+    profiling = profile is not None and profile is not False
+
+    results: dict[str, dict[str, PreservationResult]] = {}
+    interrupted = False
+    trace_cm = device_trace(trace_dir)
+    trace_cm.__enter__()  # covers every pair's device work; closed below
+    try:
+        return _run_pairs(
+            by_disc, datasets, assign, modules, background_label, null,
+            alternative, n_perm, auto_n_perm, engine_cls, config, mesh,
+            vmap_tests, backend, seed, progress, ckpt_path, checkpoint_every,
+            verbose, simplify, results, trace_dir, profiling,
+        )
+    finally:
+        trace_cm.__exit__(None, None, None)
+
+
+def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
+               alternative, n_perm, auto_n_perm, engine_cls, config, mesh,
+               vmap_tests, backend, seed, progress, ckpt_path,
+               checkpoint_every, verbose, simplify, results, trace_dir,
+               profiling):
+    """Pair-loop body of :func:`module_preservation` (split out so the
+    profiler trace context can bracket it without deep nesting)."""
+    interrupted = False
+    for d_name, t_names in by_disc.items():
+        if interrupted:
+            break
+        disc_ds = datasets[d_name]
+
+        can_vmap = (
+            vmap_tests
+            and backend == "jax"
+            and len(t_names) > 1
+            and all(
+                datasets[t].node_names == datasets[t_names[0]].node_names
+                for t in t_names
+            )
+            and len({datasets[t].data is not None for t in t_names}) == 1
+        )
+        if vmap_tests and not can_vmap and len(t_names) > 1:
+            logger.warning(
+                "vmap_tests requested but unavailable (requires the default "
+                "backend='jax'; test datasets %s must share a node universe "
+                "and agree on data presence); falling back to sequential "
+                "pairs (any matrix sharding is retained per pair)", t_names,
+            )
+
+        if can_vmap:
+            from ..parallel.multitest import MultiTestEngine
+
+            t0 = datasets[t_names[0]]
+            labels, mod_specs, counts, pool = _overlap_setup(
+                disc_ds, t0, assign[d_name], modules, background_label, null
+            )
+            with_data = disc_ds.data is not None and t0.data is not None
+            np_this = n_perm if n_perm is not None else auto_n_perm(labels, with_data)
+            if verbose:
+                logger.info(
+                    "discovery %r → tests %s (vmapped): %d modules, %d "
+                    "permutations", d_name, t_names, len(labels), np_this,
+                )
+            engine = MultiTestEngine(
+                disc_ds.correlation, disc_ds.network, disc_ds.data,
+                np.stack([datasets[t].correlation for t in t_names]),
+                np.stack([datasets[t].network for t in t_names]),
+                [datasets[t].data for t in t_names] if with_data else None,
+                mod_specs, pool, config=config, mesh=mesh,
+            )
+            timer = PairTimer(trace_dir) if profiling else None
+            observed = (
+                timer.time_observed(engine.observed) if timer
+                else engine.observed()
+            )
+            nulls, completed = engine.run_null(
+                np_this, key=seed,
+                progress=timer.wrap_progress(progress) if timer else progress,
+                checkpoint_path=ckpt_path(d_name, "+".join(t_names)),
+                checkpoint_every=checkpoint_every,
+            )
+            prof_dict = timer.finish_null(completed) if timer else None
+            interrupted = completed < np_this
+            if interrupted:
+                logger.warning(
+                    "interrupted after %d/%d permutations; p-values use the "
+                    "completed subset; stopping remaining pairs",
+                    completed, np_this,
+                )
+            total_space = pv.total_permutations(pool.size, [m.size for m in mod_specs])
+            for ti, t_name in enumerate(t_names):
+                results.setdefault(d_name, {})[t_name] = _make_result(
+                    d_name, t_name, labels, counts, observed[ti],
+                    nulls[ti], completed, np_this, alternative, total_space,
+                    profile=prof_dict,  # one vmapped run → shared timings
+                )
+            continue
+
+        for t_name in t_names:
+            test_ds = datasets[t_name]
+            labels, mod_specs, counts, pool = _overlap_setup(
+                disc_ds, test_ds, assign[d_name], modules, background_label, null
+            )
+            with_data = disc_ds.data is not None and test_ds.data is not None
+            np_this = n_perm if n_perm is not None else auto_n_perm(labels, with_data)
+            if verbose:
+                logger.info(
+                    "discovery %r → test %r: %d modules, %d permutations, "
+                    "null=%r", d_name, t_name, len(labels), np_this, null,
+                )
+            engine = engine_cls(
+                disc_ds.correlation, disc_ds.network, disc_ds.data,
+                test_ds.correlation, test_ds.network, test_ds.data,
+                mod_specs, pool, config=config, mesh=mesh,
+            )
+            timer = PairTimer(trace_dir) if profiling else None
+            observed = (
+                timer.time_observed(engine.observed) if timer
+                else engine.observed()
+            )
+            nulls, completed = engine.run_null(
+                np_this, key=seed,
+                progress=timer.wrap_progress(progress) if timer else progress,
+                checkpoint_path=ckpt_path(d_name, t_name),
+                checkpoint_every=checkpoint_every,
+            )
+            total_space = pv.total_permutations(pool.size, [m.size for m in mod_specs])
+            results.setdefault(d_name, {})[t_name] = _make_result(
+                d_name, t_name, labels, counts, observed, nulls, completed,
+                np_this, alternative, total_space,
+                profile=timer.finish_null(completed) if timer else None,
+            )
+            if completed < np_this:
+                # Ctrl-C aborts the whole multi-pair run, not just the
+                # current pair (the reference's clean user-interrupt,
+                # SURVEY.md §5); pairs finished so far are returned.
+                interrupted = True
+                logger.warning(
+                    "interrupted after %d/%d permutations; p-values use the "
+                    "completed subset; stopping remaining pairs",
+                    completed, np_this,
+                )
+                break
+
+    return shape_results(results, simplify)

@@ -1,0 +1,97 @@
+"""`network_properties` — observed per-module topological properties, the
+rebuild of the reference's ``networkProperties()`` / NetProps C++ entry
+(SURVEY.md §2.1, §3.2): per dataset and module, the summary profile
+(eigengene), weighted degree, node contribution, coherence, and average edge
+weight; the data-less variant skips the data-dependent properties.
+
+These are one-shot observed computations (once per module, not the hot
+loop), so they run through the NumPy oracle kernels — the framework's
+semantic source of truth (netrep_tpu/ops/oracle.py), against which the JAX
+hot-path kernels are parity-tested. Device dispatch would add latency, not
+throughput, here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import oracle
+from . import dataset as ds
+
+
+def network_properties(
+    network,
+    data=None,
+    correlation=None,
+    module_assignments=None,
+    modules=None,
+    background_label: str = "0",
+    discovery=None,
+    test=None,
+    self_preservation: bool = True,
+    simplify: bool = True,
+):
+    """Observed per-module network properties (SURVEY.md §3.2).
+
+    Returns ``{discovery: {test: {module: props}}}`` where ``props`` has:
+
+    - ``summary`` : (n_samples,) summary profile (None when data-less)
+    - ``degree`` : (m,) within-module weighted degree, normalized to the
+      module maximum
+    - ``contribution`` : (m,) node contributions (None when data-less)
+    - ``coherence`` : float (NaN when data-less)
+    - ``avg_weight`` : float
+    - ``node_names`` : module node labels present in the dataset
+
+    ``simplify=True`` collapses single-level nesting (reference semantics,
+    SURVEY.md §2.1).
+    """
+    datasets = ds.build_datasets(network, data=data, correlation=correlation)
+    # networkProperties defaults to computing properties in every dataset,
+    # including the discovery itself (self pairs allowed).
+    pairs = ds.resolve_pairs(datasets, discovery, test, self_preservation)
+    disc_names = sorted({d for d, _ in pairs}, key=list(datasets).index)
+    assign = ds.normalize_module_assignments(
+        module_assignments, datasets, disc_names
+    )
+
+    out: dict[str, dict[str, dict[str, dict]]] = {}
+    for d_name, t_name in pairs:
+        disc_ds, tgt = datasets[d_name], datasets[t_name]
+        labels, specs, _counts = ds.module_overlap(
+            disc_ds, tgt, assign[d_name], modules, background_label
+        )
+        per_mod = {}
+        for lab, _di, ti in specs:
+            if len(ti) == 0:
+                per_mod[lab] = None
+                continue
+            sub = np.ix_(ti, ti)
+            net_sub = tgt.network[sub]
+            deg = oracle.weighted_degree(net_sub)
+            dmax = np.max(np.abs(deg))
+            props = {
+                "node_names": [tgt.node_names[i] for i in ti],
+                "degree": deg / dmax if dmax > 0 else deg,
+                "avg_weight": oracle.avg_edge_weight(net_sub),
+                "summary": None,
+                "contribution": None,
+                "coherence": float("nan"),
+            }
+            if tgt.data is not None:
+                dat = tgt.data[:, ti]
+                prof = oracle.summary_profile(dat)
+                nc = oracle.node_contribution(dat, prof)
+                props.update(
+                    summary=prof,
+                    contribution=nc,
+                    coherence=float(np.mean(nc**2)),
+                )
+            per_mod[lab] = props
+        out.setdefault(d_name, {})[t_name] = per_mod
+
+    if simplify:
+        if len(out) == 1:
+            inner = next(iter(out.values()))
+            return next(iter(inner.values())) if len(inner) == 1 else inner
+    return out

@@ -1,0 +1,355 @@
+"""Result objects for `module_preservation` — the rebuild of the reference's
+nested-list result shaping (SURVEY.md §2.1 "Result shaping"):
+``result[discovery][test]`` with elements ``observed`` (modules × 7),
+``nulls`` (nPerm × modules × 7), ``p_values``, ``nVarsPresent``,
+``propVarsPresent``, ``totalSize``; ``simplify=True`` collapses a
+single-pair result to the inner object.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+try:
+    import pandas as pd
+except Exception:  # pragma: no cover
+    pd = None
+
+from ..ops.oracle import STAT_NAMES
+
+
+@dataclasses.dataclass
+class PreservationResult:
+    """Result for one (discovery, test) dataset pair.
+
+    ``p_values`` are Phipson–Smyth exact permutation p-values
+    (:func:`netrep_tpu.ops.pvalues.permp`; never zero). Conventions, pinned
+    by tests and documented as re-verification debt against the unobservable
+    reference (SURVEY.md §7 "Exact p-values"): ``alternative='two.sided'``
+    uses min-tail × 2 capped at 1, and the exact finite-space method applies
+    automatically when the permutation space has ≤ 10,000 elements
+    (statmod's documented auto rule).
+    """
+
+    discovery: str
+    test: str
+    module_labels: list[str]
+    observed: np.ndarray          # (n_modules, 7)
+    nulls: np.ndarray             # (n_perm, n_modules, 7)
+    p_values: np.ndarray          # (n_modules, 7)
+    n_vars_present: np.ndarray    # (n_modules,)
+    prop_vars_present: np.ndarray
+    total_size: np.ndarray
+    alternative: str
+    n_perm: int                   # permutations requested
+    completed: int                # permutations actually completed
+    profile: dict | None = None   # per-pair timings when profile= was set
+                                  # (SURVEY.md §5 "Tracing / profiling"):
+                                  # trace_dir, observed_s, null_s,
+                                  # perms_per_sec, chunk_ms,
+                                  # compile_chunk_ms, steady_chunk_ms
+    total_space: float | None = None  # size of the full permutation space
+                                  # (may be inf); kept so p-values can be
+                                  # recomputed exactly when results are
+                                  # merged by combine_analyses()
+
+    @property
+    def stat_names(self) -> tuple[str, ...]:
+        return STAT_NAMES
+
+    def observed_frame(self):
+        return pd.DataFrame(self.observed, index=self.module_labels, columns=STAT_NAMES)
+
+    def p_frame(self):
+        return pd.DataFrame(self.p_values, index=self.module_labels, columns=STAT_NAMES)
+
+    def __repr__(self) -> str:  # S3 print-method analogue (SURVEY.md §1 L5)
+        lines = [
+            f"Module preservation: discovery={self.discovery!r} "
+            f"test={self.test!r} ({self.completed}/{self.n_perm} permutations,"
+            f" alternative={self.alternative!r})"
+        ]
+        if pd is not None:
+            lines.append("p-values:")
+            lines.append(self.p_frame().to_string(float_format=lambda v: f"{v:.4g}"))
+        return "\n".join(lines)
+
+    def max_pvalue(self) -> np.ndarray:
+        """Per-module worst-case p-value across the seven statistics — the
+        reference's conventional module-level preservation call (a module is
+        preserved when *all* statistics are significant)."""
+        import warnings
+
+        with warnings.catch_warnings():
+            # an all-NaN row (data-less run: no computable statistics) is a
+            # legitimate input; nanmax's RuntimeWarning for it is noise here
+            warnings.simplefilter("ignore", category=RuntimeWarning)
+            return np.nanmax(self.p_values, axis=1)
+
+    def preserved_modules(
+        self, alpha: float = 0.05, adjust: str = "bonferroni"
+    ) -> list[str]:
+        """Module labels meeting the conventional preservation call (the
+        reference vignette's interpretation rule, done by hand there): every
+        computed statistic significant at ``alpha``, Bonferroni-adjusted for
+        the number of modules tested (``adjust='none'`` skips adjustment).
+        Modules with no computable statistics (all-NaN row) never qualify."""
+        if adjust == "bonferroni":
+            thresh = alpha / max(len(self.module_labels), 1)
+        elif adjust == "none":
+            thresh = alpha
+        else:
+            raise ValueError(
+                f"adjust must be 'bonferroni' or 'none', got {adjust!r}"
+            )
+        mx = self.max_pvalue()
+        return [
+            lab
+            for lab, p in zip(self.module_labels, mx)
+            if np.isfinite(p) and p < thresh
+        ]
+
+    _SAVE_VERSION = 1
+
+    def save(self, path: str) -> None:
+        """Persist the result as a single ``.npz`` (atomic write) — the
+        analogue of saving the reference's result object as .rds. ``profile``
+        timings are not persisted (session-local diagnostics)."""
+        import json
+
+        from ..utils.checkpoint import atomic_savez
+
+        meta = {
+            "discovery": self.discovery,
+            "test": self.test,
+            "module_labels": list(self.module_labels),
+            "alternative": self.alternative,
+            "n_perm": int(self.n_perm),
+            "completed": int(self.completed),
+            # json.dumps emits Infinity for inf and json.loads reads it back
+            # (Python's non-strict default), so inf-sized spaces round-trip
+            "total_space": None if self.total_space is None else float(self.total_space),
+        }
+        atomic_savez(
+            path,
+            # top-level format marker checked FIRST on load, so a foreign
+            # .npz (e.g. a null checkpoint) gets an informative error even
+            # if a future format changes the meta encoding
+            result_version=np.int64(self._SAVE_VERSION),
+            meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+            observed=self.observed,
+            nulls=self.nulls,
+            p_values=self.p_values,
+            n_vars_present=self.n_vars_present,
+            prop_vars_present=self.prop_vars_present,
+            total_size=self.total_size,
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "PreservationResult":
+        """Load a result saved by :meth:`save`."""
+        import json
+
+        with np.load(path) as z:
+            if "result_version" not in z.files:
+                raise ValueError(
+                    f"{path} is not a PreservationResult file (no "
+                    "result_version marker — null checkpoints and other "
+                    ".npz files are not loadable here)"
+                )
+            version = int(z["result_version"])
+            if version != cls._SAVE_VERSION:
+                raise ValueError(
+                    f"unsupported result-file version {version!r} "
+                    f"in {path} (this build reads version {cls._SAVE_VERSION})"
+                )
+            meta = json.loads(bytes(z["meta"]).decode())
+            return cls(
+                discovery=meta["discovery"],
+                test=meta["test"],
+                module_labels=[str(l) for l in meta["module_labels"]],
+                observed=z["observed"],
+                nulls=z["nulls"],
+                p_values=z["p_values"],
+                n_vars_present=z["n_vars_present"],
+                prop_vars_present=z["prop_vars_present"],
+                total_size=z["total_size"],
+                alternative=meta["alternative"],
+                n_perm=meta["n_perm"],
+                completed=meta["completed"],
+                total_space=meta.get("total_space"),  # absent in older files
+            )
+
+
+def combine_analyses(*analyses, allow_duplicate_nulls: bool = False):
+    """Merge ``module_preservation`` results whose permutations were computed
+    separately — the rebuild of the reference's ``combineAnalyses()``
+    (upstream ``R/combineAnalyses.R``, SURVEY.md §2.1 user API): split a large
+    ``n_perm`` across machines/sessions (different seeds), then pool the null
+    distributions and recompute the exact Phipson–Smyth p-values over the
+    combined permutation count.
+
+    Accepts two or more :class:`PreservationResult` objects for the same
+    (discovery, test) pair, or two or more nested ``{discovery: {test:
+    result}}`` dicts (as returned by ``simplify=False``), which are merged
+    key-by-key.
+
+    Each input contributes its *completed* permutations only. The runs must
+    agree on everything except the nulls: module labels, alternative,
+    dataset names, observed statistics, and node counts — disagreement means
+    the inputs came from different analyses and is an error.
+
+    Identical null blocks across inputs (the same seed run twice) would
+    silently double-count correlated permutations, biasing p-values; this is
+    detected via a content hash and raises unless ``allow_duplicate_nulls``.
+    """
+    if len(analyses) < 2:
+        raise ValueError("combine_analyses needs at least two results")
+    if all(isinstance(a, dict) for a in analyses):
+        keysets = [set(a) for a in analyses]
+        if any(ks != keysets[0] for ks in keysets[1:]):
+            level = "discovery" if isinstance(
+                next(iter(analyses[0].values()), None), dict
+            ) else "test"
+            raise ValueError(
+                f"nested results disagree on {level} datasets: "
+                f"{sorted(map(sorted, keysets))}"
+            )
+        return {
+            d: combine_analyses(
+                *(a[d] for a in analyses),
+                allow_duplicate_nulls=allow_duplicate_nulls,
+            )
+            for d in analyses[0]
+        }
+    if all(isinstance(a, PreservationResult) for a in analyses):
+        return _combine_pair_results(analyses, allow_duplicate_nulls)
+    raise TypeError(
+        "combine_analyses takes all PreservationResult objects or all "
+        f"nested dicts, got {[type(a).__name__ for a in analyses]}"
+    )
+
+
+def _combine_pair_results(results, allow_duplicate_nulls):
+    import hashlib
+
+    from ..ops import pvalues as pv
+
+    first = results[0]
+    for r in results[1:]:
+        if (r.discovery, r.test) != (first.discovery, first.test):
+            raise ValueError(
+                f"results are for different dataset pairs: "
+                f"({first.discovery!r}, {first.test!r}) vs "
+                f"({r.discovery!r}, {r.test!r})"
+            )
+        if list(r.module_labels) != list(first.module_labels):
+            raise ValueError("results have different module labels")
+        if r.alternative != first.alternative:
+            raise ValueError(
+                f"results use different alternatives: "
+                f"{first.alternative!r} vs {r.alternative!r}"
+            )
+        if not np.array_equal(r.n_vars_present, first.n_vars_present) or \
+           not np.array_equal(r.total_size, first.total_size):
+            raise ValueError("results have different node-overlap counts")
+        # observed is deterministic given the inputs, so any drift beyond
+        # numeric noise means the analyses ran on different data
+        if not np.allclose(
+            r.observed, first.observed, rtol=1e-4, atol=1e-5, equal_nan=True
+        ):
+            raise ValueError(
+                "observed statistics differ between results — these are not "
+                "runs of the same analysis"
+            )
+
+    spaces = [r.total_space for r in results if r.total_space is not None]
+    total_space = spaces[0] if spaces else None
+    for s in spaces[1:]:
+        same = (s == total_space) or (
+            np.isfinite(s) and np.isfinite(total_space)
+            and np.isclose(s, total_space, rtol=1e-9)
+        )
+        if not same:
+            raise ValueError(
+                f"results record different permutation-space sizes "
+                f"({total_space!r} vs {s!r})"
+            )
+
+    blocks = [np.asarray(r.nulls[: r.completed]) for r in results]
+    if not allow_duplicate_nulls:
+        # Detect the same seed run twice at per-permutation granularity:
+        # a byte-identical null row in two inputs means they drew the same
+        # node assignment (even when one run was interrupted and is only a
+        # prefix of the other's stream). In a SMALL finite space, though,
+        # independent with-replacement runs legitimately collide — so only
+        # raise when the cross-input duplicate count exceeds what
+        # independent uniform sampling from `total_space` predicts.
+        seen: dict[bytes, int] = {}
+        cross_dups = 0
+        for bi, block in enumerate(blocks):
+            for row in block:
+                h = hashlib.sha256(np.ascontiguousarray(row)).digest()
+                if seen.setdefault(h, bi) != bi:
+                    cross_dups += 1
+        if cross_dups:
+            sizes = [b.shape[0] for b in blocks]
+            n_pairs = (sum(sizes) ** 2 - sum(s * s for s in sizes)) / 2
+            if (total_space is not None and np.isfinite(total_space)
+                    and total_space > 0):
+                expected = n_pairs / total_space
+                threshold = expected + 4.0 * np.sqrt(expected) + 0.5
+            else:
+                # Space size unknown (results saved by an older release) or
+                # infinite. A duplicated seed replicates ~100% of the smaller
+                # block, so tolerate up to 5% of it as possible small-space
+                # chance collisions rather than rejecting on the first match.
+                expected = 0.0
+                threshold = 0.05 * min(s for s in sizes if s) + 0.5
+            if cross_dups > threshold:
+                raise ValueError(
+                    f"{cross_dups} byte-identical null row(s) shared "
+                    f"between inputs (~{expected:.2f} expected by chance "
+                    "for this permutation space) — the same seed run "
+                    "twice?; pooling correlated permutations biases "
+                    "p-values. Pass allow_duplicate_nulls=True to "
+                    "override."
+                )
+
+    nulls = np.concatenate(blocks, axis=0)
+    completed = int(nulls.shape[0])
+    p_values = pv.permutation_pvalues(
+        first.observed, nulls, first.alternative, total_nperm=total_space
+    )
+    return PreservationResult(
+        discovery=first.discovery,
+        test=first.test,
+        module_labels=list(first.module_labels),
+        observed=first.observed,
+        nulls=nulls,
+        p_values=p_values,
+        n_vars_present=first.n_vars_present,
+        prop_vars_present=first.prop_vars_present,
+        total_size=first.total_size,
+        alternative=first.alternative,
+        n_perm=int(sum(r.n_perm for r in results)),
+        completed=completed,
+        total_space=total_space,
+    )
+
+
+def shape_results(
+    results: dict[str, dict[str, PreservationResult]], simplify: bool
+):
+    """``simplify=True`` collapses single-discovery/single-test nesting,
+    mirroring the reference (SURVEY.md §2.1)."""
+    if not simplify:
+        return results
+    if len(results) == 1:
+        inner = next(iter(results.values()))
+        if len(inner) == 1:
+            return next(iter(inner.values()))
+        return inner
+    return results

@@ -1,0 +1,347 @@
+"""``sparse_module_preservation`` — the Config E user surface
+(BASELINE.json:11: 50k-cell kNN graph, sparse adjacency, Leiden-cluster
+modules). Mirrors :func:`~netrep_tpu.models.preservation.module_preservation`
+semantics (overlap resolution, permutation null, exact p-values, result
+shaping) on :class:`~netrep_tpu.ops.sparse.SparseAdjacency` networks, where
+the dense ``n × n`` network/correlation matrices the reference's surface
+requires (SURVEY.md §2.1) are exactly what cannot exist at this scale."""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..ops import pvalues as pv
+from ..ops.sparse import SparseAdjacency
+from ..parallel.engine import ModuleSpec
+from ..parallel.sparse import SparsePermutationEngine
+from ..utils.config import EngineConfig
+from .results import PreservationResult
+
+logger = logging.getLogger("netrep_tpu")
+
+
+def _normalize_names(names, n: int) -> list[str]:
+    """Node-name normalization shared by the sparse surfaces: positional
+    ``node_{i}`` defaults, stringify, length check."""
+    if names is None:
+        return [f"node_{i}" for i in range(n)]
+    names = [str(nm) for nm in names]
+    if len(names) != n:
+        raise ValueError("names length != network size")
+    return names
+
+
+def _normalize_assignments(
+    labels: dict[str, str] | Sequence,
+    names: list[str],
+    what: str = "network",
+) -> dict[str, str]:
+    """Dict/positional-array module-assignment normalization shared by the
+    sparse surfaces: node name → str label, every node covered."""
+    if labels is None:
+        raise ValueError(
+            "module_assignments must be provided (node name → label dict or "
+            "per-position label array)"
+        )
+    if isinstance(labels, dict):
+        missing = [nm for nm in names if nm not in labels]
+        if missing:
+            raise ValueError(
+                f"module_assignments is missing {len(missing)} {what} "
+                f"node(s), e.g. {missing[:3]}"
+            )
+        return {nm: str(labels[nm]) for nm in names}
+    labels = np.asarray(labels)
+    if labels.shape[0] != len(names):
+        raise ValueError(
+            f"module_assignments has {labels.shape[0]} entries but the "
+            f"{what} network has {len(names)} nodes"
+        )
+    return {nm: str(l) for nm, l in zip(names, labels)}
+
+
+def _resolve_modules(
+    labels: dict[str, str] | Sequence,
+    disc_names: list[str],
+    test_names: list[str],
+    modules,
+    background_label: str,
+):
+    """Name-aligned overlap resolution via the shared
+    :func:`~netrep_tpu.models.dataset.module_overlap_names` core (same
+    semantics as the dense path, SURVEY.md §3.1), preceded by the
+    dict/positional-array normalization the sparse surface accepts."""
+    from .dataset import module_overlap_names
+
+    assignments = _normalize_assignments(labels, disc_names, "discovery")
+
+    all_labels, raw_specs, counts = module_overlap_names(
+        disc_names, test_names, assignments, modules, background_label,
+    )
+    kept, specs = [], []
+    for lab, disc_idx, test_idx in raw_specs:
+        if len(test_idx) < 2:
+            logger.warning(
+                "dropping module %r: %d node(s) present in the test dataset",
+                lab, len(test_idx),
+            )
+            continue
+        kept.append(lab)
+        specs.append(ModuleSpec(lab, disc_idx, test_idx))
+    if not kept:
+        raise ValueError(
+            "no module has ≥2 nodes present in the test dataset; nothing to test"
+        )
+    return kept, specs, counts
+
+
+def sparse_module_preservation(
+    discovery_network: SparseAdjacency,
+    test_network: SparseAdjacency,
+    module_assignments,
+    discovery_data=None,
+    test_data=None,
+    discovery_correlation: SparseAdjacency | None = None,
+    test_correlation: SparseAdjacency | None = None,
+    discovery_names: Sequence[str] | None = None,
+    test_names: Sequence[str] | None = None,
+    modules=None,
+    background_label: str = "0",
+    discovery: str = "discovery",
+    test: str = "test",
+    n_perm: int | None = None,
+    null: str = "overlap",
+    alternative: str = "greater",
+    seed: int = 0,
+    config: EngineConfig | None = None,
+    mesh=None,
+    progress: Callable[[int, int], None] | None = None,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 8192,
+) -> PreservationResult:
+    """Permutation test of module preservation on sparse networks.
+
+    Parameters follow :func:`module_preservation` where they apply;
+    differences forced by the sparse representation:
+
+    - ``discovery_network`` / ``test_network`` are
+      :class:`SparseAdjacency` objects (build with ``from_coo`` /
+      ``from_dense``); no *dense* ``correlation`` argument exists. The
+      correlation statistics come from ``discovery_correlation`` /
+      ``test_correlation`` — optional PRECOMPUTED sparse correlations in
+      the same neighbor-list format, authoritative when given (as the
+      dense surface's ``correlation`` argument is) — or else are computed
+      from ``*_data`` on the fly (``zᵀz/(s-1)`` per module slice).
+      Without data, a precomputed correlation restores four finite
+      statistics (``avg.weight``, ``cor.cor``, ``cor.degree``,
+      ``avg.cor``); with neither, only ``avg.weight`` and ``cor.degree``
+      are defined (:mod:`netrep_tpu.ops.sparse`). Absent correlation
+      pairs count as 0, the same convention as absent edges.
+    - ``discovery_names`` / ``test_names`` align nodes across datasets by
+      name; omitted, both graphs must have the same node count and
+      position ``i`` is the same node in both.
+    - ``module_assignments`` maps discovery node name → label (dict) or is
+      a per-position label array.
+    - ``discovery`` / ``test`` are dataset *names* recorded on the result
+      (plot labels, multi-result bookkeeping) — the matrices themselves ride
+      in the positional arguments, so unlike the dense surface these are
+      purely labels, defaulting to ``"discovery"`` / ``"test"``.
+
+    Returns a single :class:`PreservationResult` (one dataset pair).
+    """
+    if null not in ("overlap", "all"):
+        raise ValueError(f"null must be 'overlap' or 'all', got {null!r}")
+    if alternative not in ("greater", "less", "two.sided"):
+        raise ValueError(
+            "alternative must be one of 'greater', 'less', 'two.sided', "
+            f"got {alternative!r}"
+        )
+    if not isinstance(discovery_network, SparseAdjacency) or not isinstance(
+        test_network, SparseAdjacency
+    ):
+        raise TypeError(
+            "discovery_network/test_network must be SparseAdjacency (use "
+            "SparseAdjacency.from_coo / from_dense; for dense matrices use "
+            "module_preservation)"
+        )
+    for what, d, adj in (
+        ("discovery", discovery_data, discovery_network),
+        ("test", test_data, test_network),
+    ):
+        if d is not None:
+            d = np.asarray(d)
+            if d.ndim != 2 or d.shape[1] != adj.n:
+                raise ValueError(
+                    f"{what}_data must be (n_samples, {adj.n}), got "
+                    f"{d.shape}"
+                )
+
+    if discovery_names is None or test_names is None:
+        if discovery_names is not None or test_names is not None:
+            raise ValueError(
+                "provide both discovery_names and test_names, or neither"
+            )
+        if discovery_network.n != test_network.n:
+            raise ValueError(
+                "without node names the two networks must have the same "
+                f"node count (got {discovery_network.n} vs "
+                f"{test_network.n}); pass discovery_names/test_names"
+            )
+        discovery_names = [f"node_{i}" for i in range(discovery_network.n)]
+        test_names = list(discovery_names)
+    discovery_names = [str(n) for n in discovery_names]
+    test_names = [str(n) for n in test_names]
+    if len(discovery_names) != discovery_network.n:
+        raise ValueError("discovery_names length != discovery network size")
+    if len(test_names) != test_network.n:
+        raise ValueError("test_names length != test network size")
+
+    labels, specs, counts = _resolve_modules(
+        module_assignments, discovery_names, test_names, modules,
+        background_label,
+    )
+
+    tpos = {nm: i for i, nm in enumerate(test_names)}
+    if null == "overlap":
+        pool = np.asarray(
+            [tpos[nm] for nm in discovery_names if nm in tpos],
+            dtype=np.int32,
+        )
+    else:
+        pool = np.arange(test_network.n, dtype=np.int32)
+
+    with_data = discovery_data is not None and test_data is not None
+    with_corr = (
+        discovery_correlation is not None and test_correlation is not None
+    )
+    if n_perm is None:
+        # finite statistics: 7 with data; 4 with a precomputed correlation
+        # only (avg.weight, cor.cor, cor.degree, avg.cor); 2 with neither
+        n_stats_eff = 7 if with_data else (4 if with_corr else 2)
+        n_perm = max(1000, pv.required_perms(0.05, n_tests=len(labels) * n_stats_eff))
+
+    engine = SparsePermutationEngine(
+        discovery_network, discovery_data if with_data else None,
+        test_network, test_data if with_data else None,
+        specs, pool, config=config or EngineConfig(), mesh=mesh,
+        disc_corr=discovery_correlation, test_corr=test_correlation,
+    )
+    observed = engine.observed()
+    nulls, completed = engine.run_null(
+        n_perm, key=seed, progress=progress,
+        checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+    )
+    if completed < n_perm:
+        logger.warning(
+            "interrupted after %d/%d permutations; p-values use the "
+            "completed subset", completed, n_perm,
+        )
+    total_space = pv.total_permutations(pool.size, [m.size for m in specs])
+    p_values = pv.permutation_pvalues(
+        observed, nulls[:completed], alternative, total_nperm=total_space
+    )
+    n_present = np.array([counts[lab][0] for lab in labels])
+    tot = np.array([counts[lab][1] for lab in labels])
+    return PreservationResult(
+        discovery=discovery,
+        test=test,
+        module_labels=labels,
+        observed=observed,
+        nulls=nulls,
+        p_values=p_values,
+        n_vars_present=n_present,
+        prop_vars_present=n_present / tot,
+        total_size=tot,
+        alternative=alternative,
+        n_perm=n_perm,
+        completed=completed,
+        total_space=total_space,
+    )
+
+
+def sparse_network_properties(
+    network: SparseAdjacency,
+    data=None,
+    module_assignments=None,
+    names: Sequence[str] | None = None,
+    modules=None,
+    background_label: str = "0",
+) -> dict:
+    """Observed per-module network properties on a sparse network — the
+    Config E twin of :func:`~netrep_tpu.models.properties.network_properties`
+    (the reference's ``networkProperties()``, SURVEY.md §3.2), for one
+    dataset whose modules are defined over its own nodes.
+
+    Returns ``{module: props}`` with the dense surface's keys
+    (``node_names``, ``degree`` normalized to the module max,
+    ``avg_weight``, and — when ``data`` is given — ``summary``,
+    ``contribution``, ``coherence``; None/NaN otherwise). Degree and average
+    edge weight come from the padded neighbor lists, never a dense matrix;
+    the denominator counts all ordered pairs ``m·(m-1)``, matching the
+    dense kernels (absent edges are zeros).
+    """
+    from ..ops import oracle
+
+    if not isinstance(network, SparseAdjacency):
+        raise TypeError("network must be a SparseAdjacency")
+    if data is not None:
+        data = np.asarray(data)
+        if data.ndim != 2 or data.shape[1] != network.n:
+            raise ValueError(
+                f"data must be (n_samples, {network.n}), got "
+                f"{getattr(data, 'shape', None)}"
+            )
+    names = _normalize_names(names, network.n)
+    # Observation surface: unlike the preservation path (_resolve_modules),
+    # singleton modules are KEPT — there is no test-overlap requirement; the
+    # dense network_properties twin reports them too (avg_weight NaN).
+    assignments = _normalize_assignments(module_assignments, names)
+    per_node = [assignments[nm] for nm in names]
+    by_label: dict[str, list[int]] = {}
+    for i, lab in enumerate(per_node):
+        if lab != str(background_label):
+            by_label.setdefault(lab, []).append(i)
+    if modules is not None:
+        wanted = [str(m) for m in modules]
+        unknown = [m for m in wanted if m not in by_label]
+        if unknown:
+            raise ValueError(
+                f"modules {unknown} do not exist in the module assignments"
+            )
+        by_label = {m: by_label[m] for m in wanted}
+    if not by_label:
+        raise ValueError("all nodes carry the background label; no modules")
+
+    out = {}
+    for lab, node_pos in by_label.items():
+        idx = np.asarray(node_pos, dtype=np.int64)
+        m = idx.size
+        nbr_rows = network.nbr[idx]                   # (m, k)
+        wgt_rows = network.wgt[idx].astype(np.float64)
+        member = np.isin(nbr_rows, idx) & (nbr_rows != idx[:, None])
+        deg = (wgt_rows * member).sum(axis=1)
+        dmax = np.max(np.abs(deg))
+        props = {
+            "node_names": [names[i] for i in idx],
+            "degree": deg / dmax if dmax > 0 else deg,
+            # m<2: no pairs — NaN, matching oracle.avg_edge_weight
+            "avg_weight": (
+                float(deg.sum() / (m * (m - 1))) if m > 1 else float("nan")
+            ),
+            "summary": None,
+            "contribution": None,
+            "coherence": float("nan"),
+        }
+        if data is not None:
+            dat = data[:, idx]
+            prof = oracle.summary_profile(dat)
+            nc = oracle.node_contribution(dat, prof)
+            props.update(
+                summary=prof, contribution=nc,
+                coherence=float(np.mean(nc**2)),
+            )
+        out[lab] = props
+    return out

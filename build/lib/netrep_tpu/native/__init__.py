@@ -1,0 +1,319 @@
+"""Native C++ backend — ctypes bindings over the compute core in
+``netstats.cpp`` (the rebuild's equivalent of the reference's
+``src/netStats.cpp`` statistic kernels + ``src/permutations.cpp``
+``PermutationProcedure`` over a thread pool, SURVEY.md §2.2,
+BASELINE.json:5).
+
+The JAX/XLA engine (:mod:`netrep_tpu.parallel.engine`) is the TPU compute
+path; this backend is the native CPU tier: a threaded C++ permutation
+procedure selectable via ``module_preservation(..., backend="native")``,
+also serving as an independent (non-NumPy, non-JAX) parity oracle.
+
+Determinism contract: permutation ``p`` (global index) derives its RNG from
+``splitmix64(seed ^ f(p))`` inside the library, so results are invariant to
+``n_threads`` and to how the permutation range is chunked across calls —
+the property SURVEY.md §4 says tests must enforce.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..ops import oracle
+from .build import ensure_built, toolchain_available
+
+__all__ = [
+    "available",
+    "load_library",
+    "NativeCore",
+    "NativePermutationEngine",
+]
+
+_lib = None
+_lib_lock = threading.Lock()
+
+_F64 = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_I32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+
+
+def available() -> bool:
+    """True when the native backend can be used (compiler present or a
+    cached build exists)."""
+    import os
+
+    from .build import lib_path
+
+    return os.path.exists(lib_path()) or toolchain_available()
+
+
+def load_library():
+    """Build (if needed) and load the shared library; idempotent."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = ensure_built()
+        lib = ctypes.CDLL(path)
+
+        lib.nr_abi_version.restype = ctypes.c_int
+        if lib.nr_abi_version() != 1:
+            raise RuntimeError("native library ABI mismatch; delete "
+                               f"{path} and rebuild")
+
+        lib.nr_observed.restype = None
+        lib.nr_observed.argtypes = [
+            _F64, _F64, ctypes.c_void_p,            # tcorr, tnet, tdata(|0)
+            ctypes.c_int, ctypes.c_int,             # n, s
+            _I32, _I32, ctypes.c_int,               # idx_cat, sizes, n_mod
+            _F64, _F64, ctypes.c_void_p,            # disc corr/deg/contrib(|0)
+            _F64,                                   # out
+        ]
+        lib.nr_null.restype = ctypes.c_longlong
+        lib.nr_null.argtypes = [
+            _F64, _F64, ctypes.c_void_p,            # tcorr, tnet, tdata(|0)
+            ctypes.c_int, ctypes.c_int,             # n, s
+            _I32, ctypes.c_int,                     # pool, pool_size
+            _I32, ctypes.c_int,                     # sizes, n_mod
+            _F64, _F64, ctypes.c_void_p,            # disc corr/deg/contrib(|0)
+            ctypes.c_longlong, ctypes.c_longlong,   # n_perm, perm_offset
+            ctypes.c_ulonglong, ctypes.c_int,       # seed, n_threads
+            _F64,                                   # nulls out
+            ctypes.c_void_p,                        # progress (long long*)|0
+            ctypes.c_void_p,                        # cancel (int*)|0
+        ]
+        lib.nr_props.restype = None
+        lib.nr_props.argtypes = [
+            _F64, _F64, ctypes.c_void_p,            # corr, net, data(|0)
+            ctypes.c_int, ctypes.c_int,             # n, s
+            _I32, ctypes.c_int,                     # idx, m
+            _F64, _F64, _F64,                       # degree, contrib, profile
+            ctypes.POINTER(ctypes.c_double),        # coherence
+            ctypes.POINTER(ctypes.c_double),        # avg_weight
+        ]
+        _lib = lib
+        return _lib
+
+
+def _c(a: np.ndarray, dtype) -> np.ndarray:
+    """Adopt ``a`` for the C ABI. Zero-copy when already C-contiguous with
+    the right dtype (``ascontiguousarray`` returns the SAME object then) —
+    the native analogue of the reference's no-copy Armadillo adoption of R
+    matrices (SURVEY.md §2.2 "Zero-copy matrix adoption"); genome-scale
+    float64 matrices are never duplicated. Other dtypes/layouts pay one
+    conversion copy, which the C kernels require. Pinned by
+    tests/test_native.py::test_zero_copy_adoption."""
+    return np.ascontiguousarray(a, dtype=dtype)
+
+
+def _opt_ptr(a: np.ndarray | None):
+    """void* for an optional float64 array (NULL when absent)."""
+    if a is None:
+        return None
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+class NativeCore:
+    """Thin stateful wrapper holding one (discovery, test) problem in native
+    layout: discovery per-module properties are precomputed once (the fixed
+    side of every statistic, SURVEY.md §3.1) and concatenated for the C ABI."""
+
+    def __init__(
+        self,
+        disc_corr: np.ndarray,
+        disc_net: np.ndarray,
+        disc_data: np.ndarray | None,
+        test_corr: np.ndarray,
+        test_net: np.ndarray,
+        test_data: np.ndarray | None,
+        modules: Sequence,          # ModuleSpec-likes: .disc_idx/.test_idx
+        pool: np.ndarray,
+    ):
+        self.lib = load_library()
+        self.test_corr = _c(test_corr, np.float64)
+        self.test_net = _c(test_net, np.float64)
+        self.with_data = disc_data is not None and test_data is not None
+        self.test_data = (
+            _c(test_data, np.float64) if self.with_data else None
+        )
+        self.n = self.test_corr.shape[0]
+        self.s = self.test_data.shape[0] if self.with_data else 0
+        self.pool = _c(pool, np.int32)
+        self.sizes = np.asarray([len(m.test_idx) for m in modules], np.int32)
+        self.n_mod = len(modules)
+        self.obs_idx = _c(
+            np.concatenate([np.asarray(m.test_idx) for m in modules]),
+            np.int32,
+        )
+
+        # Discovery-side fixed properties via the NumPy oracle definitions
+        # (identical math; computed once per pair, not in the hot loop)
+        corr_cat, deg_cat, contrib_cat = [], [], []
+        for m in modules:
+            di = np.asarray(m.disc_idx)
+            sub_corr = disc_corr[np.ix_(di, di)]
+            sub_net = disc_net[np.ix_(di, di)]
+            corr_cat.append(np.asarray(sub_corr, np.float64).ravel())
+            deg_cat.append(oracle.weighted_degree(sub_net))
+            if self.with_data:
+                contrib_cat.append(
+                    oracle.node_contribution(disc_data[:, di])
+                )
+        self.disc_corr_cat = _c(np.concatenate(corr_cat), np.float64)
+        self.disc_deg_cat = _c(np.concatenate(deg_cat), np.float64)
+        self.disc_contrib_cat = (
+            _c(np.concatenate(contrib_cat), np.float64)
+            if self.with_data else None
+        )
+
+    def observed(self) -> np.ndarray:
+        out = np.empty((self.n_mod, oracle.N_STATS), np.float64)
+        self.lib.nr_observed(
+            self.test_corr, self.test_net, _opt_ptr(self.test_data),
+            self.n, self.s, self.obs_idx, self.sizes, self.n_mod,
+            self.disc_corr_cat, self.disc_deg_cat,
+            _opt_ptr(self.disc_contrib_cat), out,
+        )
+        return out
+
+    def null(
+        self,
+        n_perm: int,
+        seed: int = 0,
+        perm_offset: int = 0,
+        n_threads: int = 0,
+        out: np.ndarray | None = None,
+        progress_buf: np.ndarray | None = None,
+        cancel_buf: np.ndarray | None = None,
+    ) -> tuple[np.ndarray, int]:
+        """Run permutations [perm_offset, perm_offset + n_perm) of stream
+        ``seed``. Returns ``(nulls, completed)``."""
+        if out is None:
+            out = np.empty((n_perm, self.n_mod, oracle.N_STATS), np.float64)
+        done = self.lib.nr_null(
+            self.test_corr, self.test_net, _opt_ptr(self.test_data),
+            self.n, self.s, self.pool, self.pool.size,
+            self.sizes, self.n_mod,
+            self.disc_corr_cat, self.disc_deg_cat,
+            _opt_ptr(self.disc_contrib_cat),
+            n_perm, perm_offset,
+            np.uint64(int(seed) & 0xFFFFFFFFFFFFFFFF), n_threads, out,
+            _opt_ptr(progress_buf), _opt_ptr(cancel_buf),
+        )
+        if done < 0:
+            raise ValueError("module sizes exceed the candidate pool")
+        return out, int(done)
+
+
+class NativePermutationEngine:
+    """Interface-compatible counterpart of
+    :class:`netrep_tpu.parallel.engine.PermutationEngine` backed by the C++
+    core, so ``module_preservation(backend='native')`` can swap it in.
+
+    The permutation range is dispatched to the library in chunks so Python
+    regains control between calls — KeyboardInterrupt lands between chunks
+    (the reference's cooperative Ctrl-C path, SURVEY.md §5) and partial
+    nulls are kept / checkpointable exactly like the JAX engine's.
+    """
+
+    def __init__(
+        self,
+        disc_corr, disc_net, disc_data,
+        test_corr, test_net, test_data,
+        modules, pool,
+        config=None,
+        mesh=None,  # accepted for signature parity; meaningless on CPU
+        n_threads: int = 0,
+    ):
+        del mesh
+        self.core = NativeCore(
+            np.asarray(disc_corr), np.asarray(disc_net),
+            None if disc_data is None else np.asarray(disc_data),
+            np.asarray(test_corr), np.asarray(test_net),
+            None if test_data is None else np.asarray(test_data),
+            modules, np.asarray(pool),
+        )
+        self.modules = list(modules)
+        self.pool = self.core.pool          # checkpoint fingerprint fields
+        self.has_data = self.core.with_data
+        self.chunk = max(
+            64, int(getattr(config, "chunk_size", 1024) or 1024)
+        )
+        self.n_threads = n_threads
+
+    def observed(self) -> np.ndarray:
+        return self.core.observed()
+
+    # -- hooks consumed by engine.run_checkpointed_chunks ------------------
+
+    def prepare_key(self, key) -> int:
+        if not isinstance(key, (int, np.integer)):
+            raise TypeError(
+                "backend='native' takes an integer seed, got "
+                f"{type(key).__name__}; jax PRNG keys only apply to the "
+                "default backend='jax'"
+            )
+        # mask to the counter-based generator's 64-bit seed space (matches
+        # core.null) so negative seeds round-trip through checkpoints
+        return int(key) & 0xFFFFFFFFFFFFFFFF
+
+    def key_data(self, key) -> np.ndarray:
+        """RNG-stream identity stored in checkpoints: (engine kind, seed).
+        Distinct from the JAX engine's jax.random key data, so resuming a
+        JAX checkpoint on the native backend (different null samples) is
+        refused rather than spliced."""
+        return np.asarray(
+            [0x6E61746976, int(key) & 0xFFFFFFFFFFFFFFFF], dtype=np.uint64
+        )
+
+    #: tells run_checkpointed_chunks to clamp the final chunk to the exact
+    #: remaining count — no static-shape constraint here, unlike XLA
+    dynamic_chunk = True
+
+    def effective_chunk(self) -> int:
+        return self.chunk
+
+    def perm_keys(self, key: int, start: int, count: int):
+        # the native RNG is counter-based on the global permutation index;
+        # the "keys" for a chunk are just its (seed, offset, count) triple
+        return (int(key), int(start), int(count))
+
+    def fingerprint_arrays(self):
+        c = self.core
+        return [c.test_corr, c.test_net, c.test_data,
+                c.disc_corr_cat, c.disc_deg_cat, c.disc_contrib_cat]
+
+    def run_null(
+        self,
+        n_perm: int,
+        key: int = 0,
+        progress: Callable[[int, int], None] | None = None,
+        checkpoint_path: str | None = None,
+        checkpoint_every: int = 8192,
+    ) -> tuple[np.ndarray, int]:
+        # reuse the single chunked/interruptible/checkpointable loop shared
+        # with the JAX engines (engine.run_checkpointed_chunks) so the
+        # interrupt/resume semantics cannot drift across backends
+        from ..parallel.engine import run_checkpointed_chunks
+
+        def fn(spec):
+            seed, start, count = spec
+            out, completed = self.core.null(
+                count, seed=seed, perm_offset=start, n_threads=self.n_threads
+            )
+            if completed < count:  # cancelled mid-chunk (cooperative flag)
+                out[completed:] = np.nan
+            return out
+
+        def write(nulls, out, done, take):
+            nulls[done:done + take] = out[:take]
+
+        return run_checkpointed_chunks(
+            self, n_perm, key, fn,
+            (n_perm, self.core.n_mod, oracle.N_STATS), write,
+            progress=progress, checkpoint_path=checkpoint_path,
+            checkpoint_every=checkpoint_every,
+        )

@@ -1,0 +1,77 @@
+"""Build machinery for the native C++ compute core.
+
+Compiles ``netstats.cpp`` with the system ``g++`` into a shared object the
+first time it is needed, keyed by a hash of the source so edits invalidate
+the cache automatically. Mirrors the role of the reference's ``src/Makevars``
+build config (SURVEY.md §2.2 "Build config") without requiring users to run
+a build step: the library is built lazily on first use and cached under the
+package directory.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import tempfile
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+SOURCE = os.path.join(_HERE, "netstats.cpp")
+
+CXX = os.environ.get("NETREP_CXX", "g++")
+CXXFLAGS = [
+    "-O3",
+    "-std=c++17",
+    "-shared",
+    "-fPIC",
+    "-pthread",
+    "-fno-math-errno",
+]
+
+
+def _source_tag() -> str:
+    with open(SOURCE, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:12]
+
+
+def lib_path() -> str:
+    return os.path.join(_HERE, f"_netstats_{_source_tag()}.so")
+
+
+def toolchain_available() -> bool:
+    try:
+        subprocess.run(
+            [CXX, "--version"], capture_output=True, check=True, timeout=30
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def ensure_built() -> str:
+    """Compile the shared object if the cached build is missing; return its
+    path. Raises ``RuntimeError`` with the compiler output on failure."""
+    path = lib_path()
+    if os.path.exists(path):
+        return path
+    # build into a temp file then atomically rename, so concurrent importers
+    # (e.g. pytest-xdist workers) never load a half-written .so
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+    os.close(fd)
+    try:
+        proc = subprocess.run(
+            [CXX, *CXXFLAGS, SOURCE, "-o", tmp],
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native build failed ({CXX} exit {proc.returncode}):\n"
+                f"{proc.stderr}"
+            )
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path

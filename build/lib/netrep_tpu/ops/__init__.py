@@ -1,0 +1,2 @@
+"""Compute kernels: NumPy oracle semantics (`oracle`), JAX masked statistic
+kernels (`stats`), and exact permutation p-values (`pvalues`)."""

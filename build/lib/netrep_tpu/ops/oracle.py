@@ -1,0 +1,268 @@
+"""Pure-NumPy oracle implementation of the NetRep statistics.
+
+This module is the *reference semantics* for the whole framework: every JAX
+kernel in :mod:`netrep_tpu.ops.stats` is tested for parity against these
+functions (SURVEY.md §4 "oracle-parity strategy"), and the slow permutation
+loop here doubles as the measurable CPU baseline (SURVEY.md §6, BASELINE.md).
+
+Statistic definitions follow the reference's seven module-preservation
+statistics (SURVEY.md §2.2 "Statistic kernels", BASELINE.json:5):
+
+- ``avg.weight``  — mean off-diagonal edge weight of the module's test-network
+  submatrix.
+- ``coherence``   — proportion of the module's (standardized) data variance
+  explained by the summary profile; equals the mean squared node contribution.
+- ``cor.cor``     — Pearson correlation between the off-diagonal entries of
+  the discovery and test correlation submatrices (concordance of correlation
+  structure, SURVEY.md §2.2).
+- ``cor.degree``  — Pearson correlation between discovery and test
+  within-module weighted degree vectors.
+- ``cor.contrib`` — Pearson correlation between discovery and test node
+  contribution vectors.
+- ``avg.cor``     — sign-aware mean correlation density: mean over
+  off-diagonal pairs of ``sign(disc_corr) * test_corr`` (discovery signs,
+  SURVEY.md §2.2 "sign-aware means using discovery-network signs").
+- ``avg.contrib`` — sign-aware mean node contribution: mean over nodes of
+  ``sign(disc_contrib) * test_contrib``.
+
+Building blocks (SURVEY.md §2.2):
+
+- summary profile — first left singular vector of the column-standardized
+  module data, sign-anchored to correlate positively with the module's mean
+  node profile.
+- node contribution — Pearson correlation of each node's data with the
+  summary profile.
+- weighted degree — row sums of the module adjacency submatrix, diagonal
+  excluded.
+
+NOTE on provenance: the reference mount ``/root/reference`` is empty
+(SURVEY.md §0), so no file:line citations into reference sources are
+possible; definitions are built from SURVEY.md §2.2/§3.1 and BASELINE.json:5
+and kept self-consistent across oracle, JAX kernels, and the native backend.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: Canonical statistic order used throughout the framework (observed arrays,
+#: null arrays, p-value tables). Matches the reference's seven statistics
+#: named in BASELINE.json:5.
+STAT_NAMES = (
+    "avg.weight",
+    "coherence",
+    "cor.cor",
+    "cor.degree",
+    "cor.contrib",
+    "avg.cor",
+    "avg.contrib",
+)
+
+#: Statistics computable without a ``data`` matrix (SURVEY.md §2.2
+#: "data-less case": avg.weight, cor.cor, cor.degree; data-dependent
+#: statistics are NA).
+TOPOLOGY_STATS = ("avg.weight", "cor.cor", "cor.degree")
+
+N_STATS = len(STAT_NAMES)
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+def standardize(data: np.ndarray) -> np.ndarray:
+    """Column-standardize ``data`` (samples x nodes): mean 0, sd 1 (ddof=1).
+
+    Columns with zero variance become all-zero rather than NaN so degenerate
+    nodes drop out of downstream statistics.
+    """
+    data = np.asarray(data, dtype=np.float64)
+    mu = data.mean(axis=0, keepdims=True)
+    sd = data.std(axis=0, ddof=1, keepdims=True)
+    sd = np.where(sd > 0, sd, np.inf)
+    return (data - mu) / sd
+
+
+def summary_profile(data: np.ndarray) -> np.ndarray:
+    """Summary profile of a module: first left singular vector of the
+    column-standardized data, sign-anchored so it correlates positively with
+    the module's mean node profile (SURVEY.md §2.2).
+
+    Parameters
+    ----------
+    data : (n_samples, n_nodes) module data slice.
+
+    Returns
+    -------
+    (n_samples,) unit-norm summary profile.
+    """
+    x = standardize(data)
+    u, s, _vt = np.linalg.svd(x, full_matrices=False)
+    prof = u[:, 0]
+    anchor = x.mean(axis=1)
+    if np.dot(prof, anchor) < 0:
+        prof = -prof
+    return prof
+
+
+def node_contribution(data: np.ndarray, profile: np.ndarray | None = None) -> np.ndarray:
+    """Node contribution: Pearson correlation of each node's data with the
+    module summary profile (SURVEY.md §2.2)."""
+    x = standardize(data)
+    if profile is None:
+        profile = summary_profile(data)
+    p = profile - profile.mean()
+    pn = np.linalg.norm(p)
+    xn = np.linalg.norm(x, axis=0)
+    denom = pn * xn
+    with np.errstate(invalid="ignore", divide="ignore"):
+        out = (x.T @ p) / denom
+    out[denom == 0] = 0.0
+    return out
+
+
+def module_coherence(data: np.ndarray) -> float:
+    """Proportion of the standardized module data's variance explained by the
+    summary profile. Equals the mean squared node contribution for
+    column-standardized data (SURVEY.md §2.2)."""
+    nc = node_contribution(data)
+    return float(np.mean(nc**2))
+
+
+def weighted_degree(net: np.ndarray) -> np.ndarray:
+    """Within-module weighted degree: row sums of the module adjacency
+    submatrix, diagonal excluded (SURVEY.md §2.2)."""
+    net = np.asarray(net, dtype=np.float64)
+    return net.sum(axis=1) - np.diag(net)
+
+
+def avg_edge_weight(net: np.ndarray) -> float:
+    """Mean off-diagonal edge weight of the module adjacency submatrix."""
+    net = np.asarray(net, dtype=np.float64)
+    m = net.shape[0]
+    if m < 2:
+        return float("nan")
+    off = net.sum() - np.trace(net)
+    return float(off / (m * (m - 1)))
+
+
+def pearson(x: np.ndarray, y: np.ndarray) -> float:
+    """Plain Pearson correlation with NaN for degenerate inputs."""
+    x = np.asarray(x, dtype=np.float64).ravel()
+    y = np.asarray(y, dtype=np.float64).ravel()
+    xc = x - x.mean()
+    yc = y - y.mean()
+    denom = np.linalg.norm(xc) * np.linalg.norm(yc)
+    if denom == 0:
+        return float("nan")
+    return float(np.dot(xc, yc) / denom)
+
+
+def _offdiag(a: np.ndarray) -> np.ndarray:
+    m = a.shape[0]
+    return a[~np.eye(m, dtype=bool)]
+
+
+# ---------------------------------------------------------------------------
+# Discovery-side fixed properties
+# ---------------------------------------------------------------------------
+
+class DiscoveryProps:
+    """Per-module discovery-dataset properties that stay fixed across the
+    permutation null (SURVEY.md §3.1: the discovery side of every statistic is
+    the actual module; only the test-side node set is permuted).
+
+    Attributes
+    ----------
+    corr : (m, m) discovery correlation submatrix over the module's nodes
+        (restricted to nodes present in the test dataset, in discovery order).
+    sign_corr : (m, m) elementwise signs of ``corr``.
+    degree : (m,) discovery within-module weighted degree.
+    contrib : (m,) discovery node contributions (None when data-less).
+    sign_contrib : (m,) signs of ``contrib`` (None when data-less).
+    """
+
+    def __init__(self, corr: np.ndarray, net: np.ndarray, data: np.ndarray | None):
+        self.corr = np.asarray(corr, dtype=np.float64)
+        self.sign_corr = np.sign(self.corr)
+        self.degree = weighted_degree(net)
+        if data is not None:
+            self.contrib = node_contribution(data)
+            self.sign_contrib = np.sign(self.contrib)
+        else:
+            self.contrib = None
+            self.sign_contrib = None
+
+
+# ---------------------------------------------------------------------------
+# The seven statistics
+# ---------------------------------------------------------------------------
+
+def module_stats(
+    disc: DiscoveryProps,
+    test_corr: np.ndarray,
+    test_net: np.ndarray,
+    test_data: np.ndarray | None,
+) -> np.ndarray:
+    """Compute the seven preservation statistics for one candidate test-side
+    node set against fixed discovery-side module properties.
+
+    Returns a length-7 vector in :data:`STAT_NAMES` order. Data-dependent
+    statistics are NaN when ``test_data``/``disc.contrib`` are absent
+    (SURVEY.md §2.2 data-less case).
+    """
+    out = np.full(N_STATS, np.nan)
+    test_corr = np.asarray(test_corr, dtype=np.float64)
+    test_net = np.asarray(test_net, dtype=np.float64)
+
+    out[0] = avg_edge_weight(test_net)
+    out[2] = pearson(_offdiag(disc.corr), _offdiag(test_corr))
+    out[3] = pearson(disc.degree, weighted_degree(test_net))
+
+    if test_data is not None and disc.contrib is not None:
+        nc = node_contribution(test_data)
+        out[1] = float(np.mean(nc**2))
+        out[4] = pearson(disc.contrib, nc)
+        out[5] = float(np.mean(_offdiag(disc.sign_corr * test_corr)))
+        out[6] = float(np.mean(disc.sign_contrib * nc))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Full permutation procedure (slow loop) — the CPU baseline
+# ---------------------------------------------------------------------------
+
+def permutation_null(
+    disc_props: list[DiscoveryProps],
+    module_sizes: list[int],
+    test_corr: np.ndarray,
+    test_net: np.ndarray,
+    test_data: np.ndarray | None,
+    pool: np.ndarray,
+    n_perm: int,
+    rng: np.random.Generator,
+) -> np.ndarray:
+    """Oracle permutation null: the reference's ``PermutationProcedure`` hot
+    loop (SURVEY.md §3.1) as a slow NumPy loop.
+
+    For each permutation, one random permutation of the candidate ``pool`` of
+    test-node indices is drawn and consecutive chunks of the per-module sizes
+    are assigned to modules — so, like the reference's label shuffle, the
+    random node sets within one permutation are disjoint across modules.
+
+    Returns ``(n_perm, n_modules, 7)`` null array.
+    """
+    pool = np.asarray(pool)
+    sizes = list(module_sizes)
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    assert offsets[-1] <= pool.size, "module sizes exceed candidate pool"
+    nulls = np.full((n_perm, len(sizes), N_STATS), np.nan)
+    for p in range(n_perm):
+        perm = rng.permutation(pool)
+        for k, disc in enumerate(disc_props):
+            idx = perm[offsets[k]: offsets[k + 1]]
+            sub_corr = test_corr[np.ix_(idx, idx)]
+            sub_net = test_net[np.ix_(idx, idx)]
+            sub_data = test_data[:, idx] if test_data is not None else None
+            nulls[p, k] = module_stats(disc, sub_corr, sub_net, sub_data)
+    return nulls

@@ -1,0 +1,449 @@
+"""Masked pure-JAX kernels for the seven NetRep preservation statistics.
+
+These are the TPU-native equivalents of the reference's C++ statistic kernels
+(``netStats.cpp``, SURVEY.md §2.2 / BASELINE.json:5), redesigned for XLA:
+
+- everything is a pure function of arrays → jit/vmap/shard_map compose;
+- module-size variability is handled by **pad-to-bucket + mask** (SURVEY.md
+  §7 "Hard parts"): every kernel takes a ``(m,)`` validity mask and padded
+  entries are provably inert (they contribute zero weight to every mean,
+  correlation, Gram matrix, and power-iteration step);
+- the summary profile (top left singular vector) is computed by masked power
+  iteration on the node-space Gram matrix (fixed iteration count → static
+  control flow under jit), or optionally by batched ``eigh`` for exact parity
+  (SURVEY.md §7 "Batched SVD on TPU");
+- matmuls accumulate in float32 via ``preferred_element_type`` so bfloat16
+  inputs stay MXU-friendly without losing the statistics' precision.
+
+Semantics are defined by the NumPy oracle (:mod:`netrep_tpu.ops.oracle`);
+oracle-parity is enforced by ``tests/test_stats_oracle.py``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .oracle import N_STATS, STAT_NAMES  # noqa: F401  (canonical order)
+
+_EPS = 1e-30
+
+
+def _f32(x):
+    return jnp.asarray(x, dtype=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Masked building blocks
+# ---------------------------------------------------------------------------
+
+def masked_mean(x: jnp.ndarray, w: jnp.ndarray, axis=None) -> jnp.ndarray:
+    """Mean of ``x`` over entries where ``w`` (0/1 weights) is set."""
+    w = _f32(w)
+    tot = jnp.sum(w, axis=axis)
+    return jnp.sum(_f32(x) * w, axis=axis) / jnp.maximum(tot, _EPS)
+
+
+def masked_pearson(x: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Pearson correlation of ``x`` and ``y`` over the masked entries of the
+    last axis; NaN when either side is degenerate (oracle parity)."""
+    w = _f32(w)
+    x = _f32(x) * w
+    y = _f32(y) * w
+    n = jnp.maximum(jnp.sum(w, axis=-1), _EPS)
+    mx = jnp.sum(x, axis=-1) / n
+    my = jnp.sum(y, axis=-1) / n
+    xc = (x - mx[..., None]) * w
+    yc = (y - my[..., None]) * w
+    cov = jnp.sum(xc * yc, axis=-1)
+    vx = jnp.sum(xc * xc, axis=-1)
+    vy = jnp.sum(yc * yc, axis=-1)
+    denom = jnp.sqrt(vx) * jnp.sqrt(vy)
+    return jnp.where(denom > 0, cov / jnp.maximum(denom, _EPS), jnp.nan)
+
+
+def offdiag_mask(w: jnp.ndarray) -> jnp.ndarray:
+    """(m, m) pair mask: both endpoints valid, diagonal excluded."""
+    w = _f32(w)
+    pair = w[..., :, None] * w[..., None, :]
+    m = w.shape[-1]
+    return pair * (1.0 - jnp.eye(m, dtype=jnp.float32))
+
+
+def standardize_masked(data: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Column-standardize ``data`` (..., n_samples, m): mean 0, sd 1 (ddof=1)
+    per valid column; invalid or zero-variance columns become all-zero."""
+    data = _f32(data) * w[..., None, :]
+    ns = data.shape[-2]
+    mu = jnp.mean(data, axis=-2, keepdims=True)
+    xc = data - mu
+    var = jnp.sum(xc * xc, axis=-2, keepdims=True) / jnp.maximum(ns - 1, 1)
+    sd = jnp.sqrt(var)
+    good = sd > 0
+    z = jnp.where(good, xc / jnp.maximum(sd, _EPS), 0.0)
+    return z * w[..., None, :]
+
+
+def weighted_degree_masked(net: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Within-module weighted degree over valid nodes, diagonal excluded."""
+    pair = offdiag_mask(w)
+    return jnp.sum(_f32(net) * pair, axis=-1)
+
+
+def summary_profile_masked(
+    zdata: jnp.ndarray,
+    w: jnp.ndarray,
+    n_iter: int = 60,
+    method: str = "power",
+) -> jnp.ndarray:
+    """Summary profile of a (pre-standardized, masked) module data slice:
+    top left singular vector, sign-anchored to correlate positively with the
+    module's mean node profile (SURVEY.md §2.2).
+
+    ``method='power'`` runs fixed-count masked power iteration on the
+    node-space Gram matrix ``G = Z^T Z`` — static shapes and pure matmuls, the
+    MXU-friendly replacement for the reference's per-permutation Armadillo SVD
+    (SURVEY.md §7 "Batched SVD on TPU"). ``method='eigh'`` uses the exact
+    symmetric eigendecomposition (slower under vmap, used for parity tests).
+
+    Parameters
+    ----------
+    zdata : (..., n_samples, m) standardized masked data (columns of invalid
+        nodes all-zero — as produced by :func:`standardize_masked`).
+    w : (..., m) validity mask.
+
+    Returns
+    -------
+    (..., n_samples) unit-norm summary profile.
+    """
+    w = _f32(w)
+    gram = jnp.matmul(
+        jnp.swapaxes(zdata, -1, -2), zdata, preferred_element_type=jnp.float32
+    )
+    if method == "eigh":
+        _vals, vecs = jnp.linalg.eigh(gram)
+        v = vecs[..., :, -1] * w
+    elif method == "power":
+        def step(v, _):
+            v = jnp.einsum("...ij,...j->...i", gram, v)
+            v = v * w
+            v = v / jnp.maximum(jnp.linalg.norm(v, axis=-1, keepdims=True), _EPS)
+            return v, None
+
+        # broadcast the start vector to the gram's full batch shape up front —
+        # the scan carry must have a fixed type even when the mask carries
+        # fewer batch dims than the data (broadcast-batched callers).
+        batch = jnp.broadcast_shapes(gram.shape[:-2], w.shape[:-1])
+        v0 = jnp.broadcast_to(w, batch + w.shape[-1:])
+        v0 = v0 / jnp.maximum(jnp.linalg.norm(v0, axis=-1, keepdims=True), _EPS)
+        v, _ = jax.lax.scan(step, v0, None, length=n_iter)
+    else:
+        raise ValueError(f"unknown summary method: {method!r}")
+
+    prof = jnp.einsum("...si,...i->...s", zdata, v)
+    prof = prof / jnp.maximum(jnp.linalg.norm(prof, axis=-1, keepdims=True), _EPS)
+    anchor = jnp.sum(zdata, axis=-1)  # ∝ mean node profile over valid nodes
+    sign = jnp.sign(jnp.sum(prof * anchor, axis=-1, keepdims=True))
+    sign = jnp.where(sign == 0, 1.0, sign)
+    return prof * sign
+
+
+def node_contribution_masked(zdata: jnp.ndarray, prof: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Pearson correlation of each valid node's (standardized) data with the
+    summary profile. ``prof`` is mean-zero by construction (columns of
+    ``zdata`` are mean-zero), so this reduces to normalized dot products."""
+    p = prof - jnp.mean(prof, axis=-1, keepdims=True)
+    num = jnp.einsum("...si,...s->...i", zdata, p)
+    xn = jnp.linalg.norm(zdata, axis=-2)
+    pn = jnp.linalg.norm(p, axis=-1, keepdims=True)
+    denom = xn * pn
+    nc = jnp.where(denom > 0, num / jnp.maximum(denom, _EPS), 0.0)
+    return nc * w
+
+
+# ---------------------------------------------------------------------------
+# Discovery-side fixed properties (device-resident pytree)
+# ---------------------------------------------------------------------------
+
+class DiscProps(NamedTuple):
+    """Padded per-module discovery-side properties held fixed across the
+    permutation null (SURVEY.md §3.1). All arrays are padded to the module's
+    bucket capacity ``m`` and masked by ``mask``.
+
+    ``contrib``/``sign_contrib`` are all-zero (and ``has_data`` False) in the
+    data-less variant — the kernels then emit NaN for data statistics
+    (SURVEY.md §2.2).
+    """
+
+    corr: jnp.ndarray          # (..., m, m)
+    sign_corr: jnp.ndarray     # (..., m, m)
+    degree: jnp.ndarray        # (..., m)
+    contrib: jnp.ndarray       # (..., m)
+    sign_contrib: jnp.ndarray  # (..., m)
+    mask: jnp.ndarray          # (..., m) 0/1
+
+
+def make_disc_props(corr, net, data, mask, summary_method: str = "eigh") -> DiscProps:
+    """Build :class:`DiscProps` from padded discovery submatrices.
+
+    ``data`` may be None (data-less variant). Uses exact ``eigh`` summary by
+    default — this runs once per module, not in the hot loop.
+    """
+    corr = _f32(corr)
+    net = _f32(net)
+    mask = _f32(mask)
+    pair = offdiag_mask(mask)
+    corr = corr * pair  # zero padded rows/cols and diagonal influence
+    degree = jnp.sum(net * pair, axis=-1)
+    if data is not None:
+        z = standardize_masked(data, mask)
+        prof = summary_profile_masked(z, mask, method=summary_method)
+        contrib = node_contribution_masked(z, prof, mask)
+    else:
+        contrib = jnp.zeros_like(degree)
+    return DiscProps(
+        corr=corr,
+        sign_corr=jnp.sign(corr),
+        degree=degree,
+        contrib=contrib,
+        sign_contrib=jnp.sign(contrib),
+        mask=mask,
+    )
+
+
+# ---------------------------------------------------------------------------
+# The seven statistics on gathered (padded) test submatrices
+# ---------------------------------------------------------------------------
+
+def stats_from_parts(
+    disc: DiscProps,
+    avg_weight: jnp.ndarray,          # (...,) precomputed mean off-diag weight
+    test_degree: jnp.ndarray,         # (..., m) precomputed weighted degree
+    test_corr: jnp.ndarray | None,    # (..., m, m) pair-masked, or None
+    test_zdata: jnp.ndarray | None,   # (..., n_samples, m) standardized+masked
+    n_iter: int = 60,
+    summary_method: str = "power",
+) -> jnp.ndarray:
+    """Assemble the seven statistics from precomputed topology parts — the
+    common core of the dense path (parts from the gathered ``test_net``
+    submatrix) and the sparse path (parts from padded neighbor lists,
+    :mod:`netrep_tpu.ops.sparse`). ``test_corr`` must already be multiplied
+    by the off-diagonal pair mask. Statistics whose inputs are absent
+    (``test_corr``/``test_zdata`` None) come back NaN (SURVEY.md §2.2)."""
+    w = disc.mask
+    pair = offdiag_mask(w)
+    npair = jnp.maximum(jnp.sum(pair, axis=(-1, -2)), _EPS)
+    nanlike = jnp.full_like(_f32(avg_weight), jnp.nan)
+
+    flat = lambda a: a.reshape(*a.shape[:-2], -1)
+    if test_corr is not None:
+        cor_cor = masked_pearson(flat(disc.corr), flat(test_corr), flat(pair))
+    else:
+        cor_cor = nanlike
+
+    cor_degree = masked_pearson(disc.degree, test_degree, w)
+
+    if test_zdata is not None:
+        prof = summary_profile_masked(test_zdata, w, n_iter=n_iter, method=summary_method)
+        nc = node_contribution_masked(test_zdata, prof, w)
+        coherence = masked_mean(nc * nc, w, axis=-1)
+        cor_contrib = masked_pearson(disc.contrib, nc, w)
+        avg_cor = (
+            jnp.sum(disc.sign_corr * test_corr, axis=(-1, -2)) / npair
+            if test_corr is not None else nanlike
+        )
+        avg_contrib = masked_mean(disc.sign_contrib * nc, w, axis=-1)
+    else:
+        coherence = cor_contrib = avg_cor = avg_contrib = nanlike
+
+    return jnp.stack(
+        [avg_weight, coherence, cor_cor, cor_degree, cor_contrib, avg_cor, avg_contrib],
+        axis=-1,
+    )
+
+
+def module_stats_masked(
+    disc: DiscProps,
+    test_corr: jnp.ndarray,   # (..., m, m)
+    test_net: jnp.ndarray,    # (..., m, m)
+    test_zdata: jnp.ndarray | None,  # (..., n_samples, m) standardized+masked
+    n_iter: int = 60,
+    summary_method: str = "power",
+) -> jnp.ndarray:
+    """Compute the seven statistics for one (batched) padded test node set.
+
+    Returns ``(..., 7)`` in :data:`~netrep_tpu.ops.oracle.STAT_NAMES` order.
+    Data statistics are NaN when ``test_zdata`` is None (SURVEY.md §2.2).
+    """
+    w = disc.mask
+    pair = offdiag_mask(w)
+    test_corr = _f32(test_corr) * pair
+    test_net = _f32(test_net) * pair
+    npair = jnp.maximum(jnp.sum(pair, axis=(-1, -2)), _EPS)
+
+    avg_weight = jnp.sum(test_net, axis=(-1, -2)) / npair
+    test_degree = jnp.sum(test_net, axis=-1)
+
+    return stats_from_parts(
+        disc, avg_weight, test_degree, test_corr, test_zdata,
+        n_iter=n_iter, summary_method=summary_method,
+    )
+
+
+def gather_submatrix_mxu(
+    M: jnp.ndarray,        # (n, n) symmetric matrix
+    idx_sorted: jnp.ndarray,  # (m,) ASCENDING indices (padded slots = n)
+    unsort: jnp.ndarray,   # (m, m) permutation matrix P, P[a, i] = [order[a] == i]
+) -> jnp.ndarray:
+    """TPU-fast submatrix gather ``M[idx, idx]`` decomposed into ops the
+    hardware likes (SURVEY.md §7 "Gather bandwidth" — this is the hot-loop
+    access pattern):
+
+    1. **row gather with ascending indices** — whole-row slices are
+       DMA-friendly and sorted order restores HBM locality (measured ~50×
+       faster than random-order row gathers on the bench chip; the naive
+       2D ``M[idx[:,None], idx[None,:]]`` lowers to per-element (1,1)-slice
+       gathers at ~15M elements/s);
+    2. **column select as a one-hot matmul on the MXU** — selecting m of n
+       columns is ``rows @ onehot(idxᵀ)``, exact for 0/1 one-hots;
+    3. **unsort via small permutation matmuls** — the statistics pair
+       test-side entry i with discovery-side entry i, so the sorted-basis
+       submatrix is rotated back with ``Pᵀ S P`` (two (m, m) MXU matmuls)
+       instead of on-chip scatter ops.
+
+    Padded slots carry the sentinel ``n``: their row gather clips to row
+    n-1 (junk, masked out downstream) and their one-hot column is all-zero.
+    """
+    n = M.shape[-1]
+    m = idx_sorted.shape[-1]
+    rows = jnp.take(M, idx_sorted, axis=0, mode="clip")          # (m, n)
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (n, m), 0)
+    onehot = (col_ids == idx_sorted[None, :]).astype(M.dtype)     # (n, m)
+    sub_sorted = jnp.matmul(rows, onehot, preferred_element_type=jnp.float32)
+    # rotate back to the original (discovery-paired) order: Pᵀ S P
+    out = jnp.matmul(
+        jnp.swapaxes(unsort, -1, -2),
+        jnp.matmul(sub_sorted, unsort, preferred_element_type=jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out
+
+
+def gather_and_stats_mxu(
+    disc: DiscProps,
+    idx: jnp.ndarray,          # (m,) int32 test-node indices (padded)
+    test_corr: jnp.ndarray,    # (n, n)
+    test_net: jnp.ndarray | None,    # (n, n); None with net_beta set
+    test_dataT: jnp.ndarray | None,  # (n, n_samples) TRANSPOSED data
+    n_iter: int = 60,
+    summary_method: str = "power",
+    net_beta: float | None = None,
+) -> jnp.ndarray:
+    """MXU/DMA-friendly variant of :func:`gather_and_stats` (see
+    :func:`gather_submatrix_mxu`), ~10-20x faster on TPU at genome scale,
+    where the per-element gather emitter crawls. Value fidelity: the one-hot
+    and permutation matmuls are exact selections in exact arithmetic, but
+    XLA's default-precision f32 matmul on TPU truncates operands to
+    bfloat16, so gathered VALUES carry up to ~4e-3 relative rounding there
+    (attenuated ~1/m in the statistics, which average over >= m^2 entries —
+    negligible against permutation-null Monte-Carlo noise; see BASELINE.md
+    §precision). On backends with true f32 matmuls (CPU) the selection is
+    exact. ``test_dataT`` is the data matrix transposed once at engine init
+    so the per-instance data slice is a contiguous row gather instead of a
+    strided column gather."""
+    n = test_corr.shape[-1]
+    m = idx.shape[-1]
+    w = disc.mask
+    # sentinel-pad, sort ascending; padded slots sort to the end
+    idx_eff = jnp.where(w > 0, idx, n).astype(jnp.int32)
+    order = jnp.argsort(idx_eff)
+    idx_sorted = jnp.take(idx_eff, order, axis=0)
+    pos = jax.lax.broadcasted_iota(jnp.int32, (m, m), 1)
+    unsort = (pos == order[:, None]).astype(test_corr.dtype)      # P (m, m)
+
+    sub_corr = gather_submatrix_mxu(test_corr, idx_sorted, unsort)
+    # derived network (net_beta): |corr|**β of the GATHERED submatrix —
+    # halves the row traffic of the bandwidth-bound hot loop and avoids the
+    # second gather's own bf16 selection rounding (the derived values carry
+    # only the corr gather's rounding, amplified ~β× by the power)
+    sub_net = (
+        derived_net(sub_corr, net_beta) if test_net is None
+        else gather_submatrix_mxu(test_net, idx_sorted, unsort)
+    )
+
+    if test_dataT is not None:
+        rows_d = jnp.take(test_dataT, idx_sorted, axis=0, mode="clip")  # (m, s)
+        sub_d = jnp.matmul(
+            jnp.swapaxes(unsort, -1, -2), rows_d,
+            preferred_element_type=jnp.float32,
+        )                                                          # (m, s)
+        zdata = standardize_masked(jnp.swapaxes(sub_d, -1, -2), w)
+    else:
+        zdata = None
+    return module_stats_masked(
+        disc, sub_corr, sub_net, zdata, n_iter=n_iter, summary_method=summary_method
+    )
+
+
+def gather_zdata(
+    test_dataT: jnp.ndarray,   # (n, n_samples) TRANSPOSED data
+    idx: jnp.ndarray,          # (..., m) int32 node indices (padded)
+    mask: jnp.ndarray,         # (..., m) validity mask
+) -> jnp.ndarray:
+    """Slice per-module data columns out of the TRANSPOSED data matrix and
+    standardize: the single place the (n, n_samples) layout contract lives
+    (row gather + swapaxes; see :func:`gather_and_stats` for why the
+    transposed layout). Supports leading batch axes on ``idx``."""
+    sub_d = jnp.take(test_dataT, idx, axis=0)          # (..., m, n_samples)
+    return standardize_masked(jnp.swapaxes(sub_d, -1, -2), mask)
+
+
+def derived_net(sub_corr: jnp.ndarray, net_beta: float) -> jnp.ndarray:
+    """Soft-threshold network submatrix derived on device from the gathered
+    correlation: ``|corr|**β`` (the WGCNA construction). Deriving instead of
+    gathering a stored n×n network halves the hot loop's HBM row traffic and
+    the engine's matrix footprint (BASELINE.md roofline: the gather is
+    bandwidth-bound) — elementwise functions commute with gathers, so the
+    result equals gathering a precomputed ``|corr|**β`` matrix up to
+    float rounding."""
+    return jnp.abs(sub_corr) ** net_beta
+
+
+def gather_and_stats(
+    disc: DiscProps,
+    idx: jnp.ndarray,          # (..., m) int32 test-node indices (padded)
+    test_corr: jnp.ndarray,    # (n, n)
+    test_net: jnp.ndarray | None,    # (n, n); None with net_beta set
+    test_dataT: jnp.ndarray | None,  # (n, n_samples) TRANSPOSED data
+    n_iter: int = 60,
+    summary_method: str = "power",
+    net_beta: float | None = None,
+) -> jnp.ndarray:
+    """Gather a module's test submatrices by index and compute the seven
+    statistics — the per-permutation unit of work in the reference's hot loop
+    (SURVEY.md §3.1: O(m²) gather + kernels), expressed as one fused XLA
+    computation. ``idx`` is a single module's ``(m,)`` index vector — batching
+    over permutations/modules is done by ``vmap`` of this function. ``idx``
+    may carry arbitrary in-range values at padded positions (the mask zeroes
+    their influence).
+
+    The 2D advanced-index gather is exact (no matmul in the value path) and,
+    measured on TPU v5e in the engine's batched ``(batch, K, m)`` index
+    layout, runs at 50-120 Gelem/s — the whole per-permutation submatrix
+    extraction (~1M useful elements at north-star shapes) costs ~20 µs.
+    ``test_dataT`` is the data matrix transposed once at engine init: the
+    per-module data slice is then a row gather; gathering columns of the
+    (n_samples, n) layout instead lowers to strided per-element loads on TPU
+    (measured ~10x whole-chunk slowdown — the round-1 ``direct`` mode's
+    mistake)."""
+    sub_corr = test_corr[idx[:, None], idx[None, :]]
+    sub_net = (
+        derived_net(sub_corr, net_beta) if test_net is None
+        else test_net[idx[:, None], idx[None, :]]
+    )
+    zdata = gather_zdata(test_dataT, idx, disc.mask) if test_dataT is not None else None
+    return module_stats_masked(
+        disc, sub_corr, sub_net, zdata, n_iter=n_iter, summary_method=summary_method
+    )

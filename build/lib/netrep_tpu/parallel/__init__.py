@@ -1,0 +1,2 @@
+"""Parallel execution: the vmap/jit permutation engine with optional
+mesh-sharded chunks (SURVEY.md §2.3 parallelism table)."""

@@ -1,0 +1,135 @@
+"""Multi-host runtime initialization — the cross-host half of the
+distributed communication backend (SURVEY.md §2.3 last row, §5 "Distributed
+communication backend"): the reference is a single shared-memory process
+(OpenMP [B:5], no MPI/NCCL); at TPU-pod scale the equivalent is one JAX
+process per host joined through the coordination service, with a global
+``jax.sharding.Mesh`` whose ``perm`` axis spans hosts (collectives ride ICI
+within a slice, DCN across hosts — :mod:`netrep_tpu.parallel.mesh`).
+
+Usage on each host (identical SPMD program, reference-style API untouched)::
+
+    from netrep_tpu.parallel import distributed, mesh
+    distributed.initialize()            # env-driven; no-op single-host
+    m = mesh.make_mesh()                # jax.devices() now spans all hosts
+    module_preservation(..., mesh=m)
+
+The permutation engine gathers each host's shard of the null distribution
+with ``process_allgather`` (:mod:`netrep_tpu.parallel.engine`), so every
+process returns the full result — matching the reference's single-process
+semantics from the user's point of view.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+logger = logging.getLogger("netrep_tpu")
+
+#: Environment variables consulted when arguments are omitted (the standard
+#: JAX coordination-service contract; also auto-detected on Cloud TPU VMs,
+#: where jax.distributed.initialize() needs no arguments at all).
+ENV_VARS = {
+    "coordinator_address": "JAX_COORDINATOR_ADDRESS",
+    "num_processes": "JAX_NUM_PROCESSES",
+    "process_id": "JAX_PROCESS_ID",
+}
+
+
+def is_initialized() -> bool:
+    """Whether the multi-host runtime is up (single-process runs: False)."""
+    return jax.distributed.is_initialized()
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    **kwargs,
+) -> dict:
+    """Join the JAX coordination service — idempotent, env-var driven.
+
+    Arguments default from ``ENV_VARS``; on Cloud TPU VMs all three may be
+    omitted (JAX auto-detects the pod topology). Calling again after a
+    successful join is a no-op (the reference has no analogous step — its
+    "backend" is process-local threads — so this function is deliberately
+    safe to call unconditionally at program start).
+
+    Returns a summary dict: ``process_id``, ``process_count``,
+    ``local_device_count``, ``global_device_count``.
+    """
+    if not is_initialized():
+        coordinator_address = coordinator_address or os.environ.get(
+            ENV_VARS["coordinator_address"]
+        )
+        if num_processes is None and ENV_VARS["num_processes"] in os.environ:
+            num_processes = int(os.environ[ENV_VARS["num_processes"]])
+        if process_id is None and ENV_VARS["process_id"] in os.environ:
+            process_id = int(os.environ[ENV_VARS["process_id"]])
+        given = (coordinator_address, num_processes, process_id)
+        if any(v is not None for v in given) and any(v is None for v in given):
+            raise ValueError(
+                "partial multi-host configuration: coordinator_address, "
+                "num_processes and process_id must be given (or set via "
+                f"{sorted(ENV_VARS.values())}) together, got "
+                f"address={coordinator_address!r} num={num_processes!r} "
+                f"id={process_id!r}. On Cloud TPU VMs omit all three."
+            )
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs,
+            )
+        except Exception as exc:
+            if any(v is not None for v in given):
+                raise  # explicit configuration that failed — surface it
+            # No configuration given and the auto-detect join failed — on a
+            # plain single machine that is the expected "no cluster" case,
+            # but on a real pod it could be a transient coordinator failure
+            # whose silent fallback would hang the OTHER hosts at their
+            # first collective. Log loudly enough to diagnose that.
+            logger.warning(
+                "multi-host auto-detection did not join a coordination "
+                "service (%s: %s); continuing single-process. If this host "
+                "IS part of a pod, other hosts will hang — set "
+                "%s/%s/%s explicitly.",
+                type(exc).__name__, exc, *sorted(ENV_VARS.values()),
+                exc_info=logger.isEnabledFor(logging.DEBUG),
+            )
+        else:
+            logger.info(
+                "joined coordination service: process %d/%d, %d local "
+                "device(s)", jax.process_index(), jax.process_count(),
+                jax.local_device_count(),
+            )
+    return {
+        "process_id": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }
+
+
+def gather_to_host(x):
+    """Return ``x`` as a host-local numpy array on every process.
+
+    Single-process (the common case): a plain transfer. Multi-host: the
+    array's shards live on other hosts' devices, so a ``process_allgather``
+    assembles the global value first — this is the cross-host hop of the
+    null-distribution collection (engine ``write`` path).
+    """
+    import numpy as np
+
+    # Key on the ARRAY's addressability, not process_count: in a multi-host
+    # program an engine run without the global mesh yields fully-addressable
+    # outputs, for which process_allgather would take its host-local branch
+    # and concatenate copies across processes instead of replicating.
+    if not getattr(x, "is_fully_addressable", True):
+        from jax.experimental import multihost_utils
+
+        x = multihost_utils.process_allgather(x, tiled=True)
+    return np.asarray(x)

@@ -1,0 +1,756 @@
+"""The TPU-native permutation engine — the rebuild of the reference's C++
+``PermutationProcedure`` hot path (SURVEY.md §2.2, §3.1; BASELINE.json:5).
+
+Reference design → TPU design:
+
+- OpenMP threads claiming permutation chunks → ``vmap`` over a permutation
+  chunk, jit-compiled once per module-size bucket, dispatched chunk-by-chunk
+  from the host (SURVEY.md §2.3 row "data parallelism over permutations").
+- Per-permutation Armadillo submatrix gathers + SVD → fused XLA gather +
+  masked power iteration inside the vmapped kernel
+  (:func:`netrep_tpu.ops.stats.gather_and_stats`).
+- Disjoint null-array slices per thread → functional: each chunk returns its
+  slice, the host writes it into the preallocated null array.
+- Progress/interrupt polling from the R-facing thread → chunked dispatch:
+  Python regains control between device calls, so ``KeyboardInterrupt``
+  aborts cleanly with partial nulls retained (SURVEY.md §5).
+- Variable module sizes vs XLA static shapes → pad-to-bucket + masks
+  (SURVEY.md §7 "Hard parts"): modules are grouped into power-of-two-capacity
+  buckets; each bucket traces/compiles exactly once per chunk shape.
+
+Optional SPMD scale-out: pass a :class:`jax.sharding.Mesh` and the chunk's
+per-permutation key array is sharded along the mesh's permutation axis, so
+XLA partitions the whole chunk computation across devices over ICI
+(SURVEY.md §2.3, §5 "distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import stats as jstats
+from ..ops.oracle import N_STATS
+from ..utils.config import EngineConfig
+
+
+def run_checkpointed_chunks(
+    base: "PermutationEngine",
+    n_perm: int,
+    key,
+    fn: Callable,
+    alloc_shape: tuple[int, ...],
+    write: Callable[[np.ndarray, list, int, int], None],
+    progress: Callable[[int, int], None] | None = None,
+    nulls_init: np.ndarray | None = None,
+    start_perm: int = 0,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 8192,
+    perm_axis: int = 0,
+    fingerprint_extra: bytes = b"",
+) -> tuple[np.ndarray, int]:
+    """The single chunked/interruptible/checkpointable null loop shared by
+    :class:`PermutationEngine` and ``MultiTestEngine`` (one implementation so
+    the two paths cannot drift — SURVEY.md §5 "failure detection",
+    "checkpoint/resume").
+
+    ``fn(keys) -> outs`` evaluates one chunk; ``write(nulls, outs, done,
+    take)`` scatters the chunk into the preallocated ``nulls`` array;
+    ``alloc_shape`` allocates it when neither ``nulls_init`` nor a readable
+    checkpoint provides one; ``perm_axis`` locates the permutation axis in
+    the null array; ``fingerprint_extra`` extends the engine fingerprint for
+    wrappers whose problem has extra structure (e.g. the test-dataset count).
+    """
+    # Key-handling hooks let non-JAX engines (the native C++ backend) reuse
+    # this loop with their own RNG-stream identity: `prepare_key` normalizes
+    # the user seed, `key_data` yields the array stored in checkpoints to
+    # refuse cross-stream resume.
+    prepare = getattr(base, "prepare_key", None)
+    if prepare is not None:
+        key = prepare(key)
+    elif isinstance(key, int):
+        key = jax.random.key(key)
+
+    save = None
+    if checkpoint_path is not None:
+        from ..utils import checkpoint as ckpt
+
+        fp = ckpt.engine_fingerprint(base)
+        if fingerprint_extra:
+            fp = np.concatenate(
+                [fp, np.frombuffer(fingerprint_extra, dtype=np.uint8)]
+            )
+        key_data = getattr(base, "key_data", None)
+        kd = (
+            np.asarray(key_data(key)) if key_data is not None
+            else np.asarray(jax.random.key_data(key))
+        )
+        loaded = ckpt.load_null_checkpoint(checkpoint_path)
+        if loaded is not None:
+            nulls_init, start_perm = ckpt.validate_resume(
+                loaded, n_perm, kd, fp, checkpoint_path, perm_axis=perm_axis
+            )
+
+        def save(nulls, done):
+            ckpt.save_null_checkpoint(checkpoint_path, nulls, done, kd, fp)
+
+    C = base.effective_chunk()
+    # JAX engines keep the full chunk shape on the tail (fixed shapes hit the
+    # compile cache); dynamic-shape engines (the native C++ backend) opt into
+    # clamping so the tail doesn't burn up to chunk-1 wasted permutations.
+    dynamic = getattr(base, "dynamic_chunk", False)
+    nulls = nulls_init if nulls_init is not None else np.full(alloc_shape, np.nan)
+    # Double-buffered loop: dispatch chunk k+1 (async on accelerators) BEFORE
+    # the synchronous host transfer of chunk k in `write`, so device compute
+    # overlaps the device→host copy. On the tunneled TPU backend the serial
+    # transfer gap was ~25% of wall-clock (round-2 profile); on synchronous
+    # backends (native C++) the order change is a no-op.
+    dispatched = start_perm
+    completed = start_perm
+    last_saved = completed
+    pending: tuple | None = None  # (outs, at, take)
+    try:
+        while dispatched < n_perm or pending is not None:
+            nxt = None
+            if dispatched < n_perm:
+                take = min(C, n_perm - dispatched)
+                keys = base.perm_keys(key, dispatched, take if dynamic else C)
+                nxt = (fn(keys), dispatched, take)
+                dispatched += take
+            if pending is not None:
+                outs, at, take_p = pending
+                write(nulls, outs, at, take_p)
+                completed = at + take_p
+                if progress is not None:
+                    progress(completed, n_perm)
+                if save is not None and completed - last_saved >= checkpoint_every:
+                    save(nulls, completed)
+                    last_saved = completed
+            pending = nxt
+    except KeyboardInterrupt:
+        # the reference's clean Ctrl-C path (SURVEY.md §5): flush the
+        # pending chunk (its compute is finished on synchronous backends and
+        # already dispatched on async ones — write blocks only until the
+        # device drains), then return the partial null; callers read the
+        # completed count and keep finished work. A second Ctrl-C during the
+        # flush abandons the pending chunk instead.
+        if pending is not None:
+            try:
+                outs, at, take_p = pending
+                write(nulls, outs, at, take_p)
+                completed = at + take_p
+            except KeyboardInterrupt:
+                pass
+    if save is not None and completed > last_saved:
+        save(nulls, completed)
+    return nulls, completed
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _perm_keys_jit(key: jax.Array, start: jax.Array, count: int) -> jax.Array:
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        start + jnp.arange(count, dtype=jnp.uint32)
+    )
+
+
+def check_derived_network(corr, net, beta: float, what: str) -> None:
+    """Check that ``net == |corr|**beta`` before the engine commits to
+    deriving network submatrices on device
+    (``EngineConfig.network_from_correlation``): exhaustive for matrices up
+    to 64k entries, a fixed-seed random flat sample of 64k entries beyond
+    (any *strided* sample would alias onto the columns divisible by
+    gcd(stride, n), leaving most of the matrix unchecked). A mismatch means
+    the knob contradicts the data the user actually supplied."""
+    c = np.asarray(corr).reshape(-1)
+    m = np.asarray(net).reshape(-1)
+    if c.size <= 65536:
+        want = np.abs(c) ** beta
+        got = m
+    else:
+        ii = np.random.default_rng(0).integers(0, c.size, size=65536)
+        want = np.abs(c[ii]) ** beta
+        got = m[ii]
+    if not np.allclose(got, want, rtol=1e-3, atol=1e-4):
+        worst = float(np.max(np.abs(got - want)))
+        raise ValueError(
+            f"network_from_correlation={beta} but the supplied {what} "
+            f"network is not |correlation|**{beta} (max sampled deviation "
+            f"{worst:.3g}); drop the config knob or fix the inputs"
+        )
+
+
+def make_row_sharded_observed(gather_rep, net_beta: float | None = None) -> Callable:
+    """Jitted observed-pass kernel over row-sharded matrices: collective
+    gather + exact-eigh statistics. Shared by :class:`PermutationEngine` and
+    ``MultiTestEngine`` so the two observed paths cannot drift. With
+    ``net_beta`` the network submatrix derives from the gathered correlation
+    (``tn`` is None then)."""
+
+    from .sharded import gather_corr_net
+
+    @jax.jit
+    def _obs(disc, idx, tc, tn, tdT):
+        sub_c, sub_n = gather_corr_net(gather_rep, tc, tn, idx, net_beta)
+        zd = (
+            jstats.gather_zdata(tdT, idx, disc.mask)
+            if tdT is not None else None
+        )
+        return jstats.module_stats_masked(
+            disc, sub_c, sub_n, zd, summary_method="eigh"
+        )
+
+    return _obs
+
+
+@dataclasses.dataclass(frozen=True)
+class ModuleSpec:
+    """One discovery module's overlap bookkeeping (SURVEY.md §3.1).
+
+    ``disc_idx`` / ``test_idx`` are aligned: position i refers to the same
+    node (by name) in the discovery and test datasets. Their common length is
+    ``nVarsPresent`` for this module.
+    """
+
+    label: str
+    disc_idx: np.ndarray
+    test_idx: np.ndarray
+
+    @property
+    def size(self) -> int:
+        return len(self.test_idx)
+
+
+@dataclasses.dataclass
+class _Bucket:
+    cap: int
+    module_pos: list[int]          # positions in the global module order
+    disc: jstats.DiscProps         # batched (K, cap[, cap]) discovery props
+    obs_idx: jnp.ndarray           # (K, cap) observed test indices (padded)
+    slices: list[tuple[int, int]]  # (offset, size) into the pooled permutation
+
+
+def _pad_to(a: np.ndarray, cap: int, axes: Sequence[int]) -> np.ndarray:
+    pad = [(0, 0)] * a.ndim
+    for ax in axes:
+        pad[ax] = (0, cap - a.shape[ax])
+    return np.pad(a, pad)
+
+
+class PermutationEngine:
+    """Permutation-null engine for one (discovery, test) dataset pair.
+
+    Parameters
+    ----------
+    disc_corr, disc_net : (n_d, n_d) discovery correlation / network.
+    disc_data : (n_samples_d, n_d) discovery data, or None (data-less
+        variant, SURVEY.md §2.2).
+    test_corr, test_net : (n_t, n_t) test correlation / network.
+    test_data : (n_samples_t, n_t) test data, or None.
+    modules : ordered module specs (global module order = this order).
+    pool : candidate test-node indices the null draws from — the overlap set
+        for ``null='overlap'`` or all test nodes for ``null='all'``
+        (SURVEY.md §3.1).
+    config : engine tuning knobs.
+    mesh : optional device mesh; when given, permutation chunks are sharded
+        along ``config.mesh_axis``.
+    """
+
+    def __init__(
+        self,
+        disc_corr: np.ndarray,
+        disc_net: np.ndarray,
+        disc_data: np.ndarray | None,
+        test_corr: np.ndarray,
+        test_net: np.ndarray,
+        test_data: np.ndarray | None,
+        modules: Sequence[ModuleSpec],
+        pool: np.ndarray,
+        config: EngineConfig = EngineConfig(),
+        mesh: Mesh | None = None,
+        discovery_only: bool = False,
+    ):
+        """``discovery_only=True`` builds only the discovery-side buckets and
+        pool bookkeeping (test matrices may be None) — used by wrappers like
+        :class:`~netrep_tpu.parallel.multitest.MultiTestEngine` that supply
+        their own test side; ``observed``/``run_null`` must not be called."""
+        self.config = config
+        self.mesh = mesh
+        self.modules = list(modules)
+        self.discovery_only = discovery_only
+        self.has_data = disc_data is not None and (
+            discovery_only or test_data is not None
+        )
+        self.n_modules = len(self.modules)
+
+        self.row_sharded = (
+            mesh is not None and config.matrix_sharding == "row"
+        )
+        if config.matrix_sharding not in ("replicated", "row"):
+            raise ValueError(
+                f"matrix_sharding must be 'replicated' or 'row', got "
+                f"{config.matrix_sharding!r}"
+            )
+        if config.matrix_sharding == "row" and mesh is None:
+            raise ValueError("matrix_sharding='row' requires a mesh")
+
+        dtype = jnp.dtype(config.dtype)
+        # One gather-mode rule for replicated AND row-sharded paths (VERDICT
+        # r1 item 3 lifted the old row_sharded → 'direct' force): 'mxu' on
+        # accelerators, 'direct' on CPU, per EngineConfig.gather_mode.
+        self.gather_mode = config.resolved_gather_mode(jax.default_backend())
+        # Derived-network mode: never store/gather the n×n network on device
+        # (EngineConfig.network_from_correlation) — submatrices come from
+        # |gathered corr|**β. Sample-check the claim against the supplied
+        # matrices first.
+        self.net_beta = config.network_from_correlation
+        if self.net_beta is not None:
+            check_derived_network(
+                disc_corr, disc_net, self.net_beta, "discovery"
+            )
+            if not discovery_only:
+                check_derived_network(
+                    test_corr, test_net, self.net_beta, "test"
+                )
+        if self.row_sharded:
+            # bound for the sharded gatherer's per-dispatch working set on
+            # the LOCAL permutation axis (mirrors the replicated path's
+            # lax.map batching; the mxu row buffers are (K·cap, n) per perm)
+            local_chunk = self.effective_chunk() // mesh.shape[config.mesh_axis]
+            self._gather_perm_batch = config.resolved_perm_batch(
+                self.gather_mode, jax.default_backend(), max(local_chunk, 1)
+            )
+        if discovery_only:
+            self._test_corr = self._test_net = None
+            if self.row_sharded:
+                from .sharded import make_sharded_gatherer
+
+                self._gather_perm = make_sharded_gatherer(
+                    mesh, config.mesh_axis, mode=self.gather_mode,
+                    perm_batch=self._gather_perm_batch,
+                )
+                self._gather_rep = make_sharded_gatherer(
+                    mesh, None, mode=self.gather_mode
+                )
+        elif self.row_sharded:
+            from .mesh import ROW_AXIS
+            from .sharded import (
+                make_sharded_gatherer, pad_square_to_multiple, shard_rows,
+            )
+
+            d_row = mesh.shape[ROW_AXIS]
+            self._test_corr = shard_rows(
+                jnp.asarray(pad_square_to_multiple(test_corr, d_row), dtype), mesh
+            )
+            self._test_net = (
+                None if self.net_beta is not None
+                else shard_rows(
+                    jnp.asarray(pad_square_to_multiple(test_net, d_row), dtype),
+                    mesh,
+                )
+            )
+            self._gather_perm = make_sharded_gatherer(
+                mesh, config.mesh_axis, mode=self.gather_mode,
+                perm_batch=self._gather_perm_batch,
+            )
+            self._gather_rep = make_sharded_gatherer(
+                mesh, None, mode=self.gather_mode
+            )
+        else:
+            self._test_corr = jnp.asarray(test_corr, dtype)
+            self._test_net = (
+                None if self.net_beta is not None
+                else jnp.asarray(test_net, dtype)
+            )
+        # The data matrix is transposed ONCE at init and ONLY the transposed
+        # copy is kept on device: every mode then slices per-module data as a
+        # row gather of (n, n_samples). Gathering columns of the
+        # (n_samples, n) layout lowers to strided per-element loads on TPU
+        # (measured ~10x whole-chunk slowdown in round 1's direct mode), and
+        # keeping the untransposed copy too would double the data matrix's
+        # HBM footprint at Config D scale.
+        self._test_dataT = (
+            jnp.asarray(np.asarray(test_data).T, dtype)
+            if (self.has_data and test_data is not None)
+            else None
+        )
+
+        sizes = [m.size for m in self.modules]
+        if min(sizes, default=1) < 2:
+            bad = [m.label for m in self.modules if m.size < 2]
+            raise ValueError(
+                f"modules {bad} have fewer than 2 nodes present in the test "
+                "dataset; preservation statistics are undefined"
+            )
+        self.total_take = int(np.sum(sizes))
+        self.pool = np.asarray(pool, dtype=np.int32)
+        if self.total_take > self.pool.size:
+            raise ValueError(
+                f"module sizes (total {self.total_take}) exceed the null "
+                f"candidate pool ({self.pool.size}); use null='all' or drop "
+                "modules"
+            )
+        self._pool_dev = jnp.asarray(self.pool)
+
+        # --- bucket construction: jit once per module-size bucket [B:5] ---
+        # Discovery submatrices are gathered on device (jnp.take) so large
+        # discovery matrices never need a host round-trip (Config D scale,
+        # SURVEY.md §6). Discovery inputs may be numpy or jax arrays.
+        offsets = np.concatenate([[0], np.cumsum(sizes)])
+        by_cap: dict[int, list[int]] = {}
+        for k, m in enumerate(self.modules):
+            by_cap.setdefault(config.rounded_cap(m.size), []).append(k)
+
+        d_data = (
+            jnp.asarray(disc_data, jnp.float32) if self.has_data else None
+        )
+        # The discovery matrices ride as jit ARGUMENTS (not closure
+        # captures — captured device arrays become compile-time constants:
+        # 3.2 GB baked into the bucket-build executable at Config D scale).
+        net_beta = self.net_beta
+        if self.row_sharded:
+            from .mesh import ROW_AXIS
+            from .sharded import pad_square_to_multiple, shard_rows
+
+            d_row = mesh.shape[ROW_AXIS]
+            d_corr = shard_rows(
+                jnp.asarray(pad_square_to_multiple(disc_corr, d_row), jnp.float32),
+                mesh,
+            )
+            d_net = (
+                None if net_beta is not None
+                else shard_rows(
+                    jnp.asarray(
+                        pad_square_to_multiple(disc_net, d_row), jnp.float32
+                    ),
+                    mesh,
+                )
+            )
+            gather_rep = self._gather_rep
+
+            from .sharded import gather_corr_net
+
+            @jax.jit
+            def _disc_bucket(dc, dn, dd, idx, mask):
+                corr_b, net_b = gather_corr_net(
+                    gather_rep, dc, dn, idx, net_beta
+                )
+                data_b = (
+                    jax.vmap(lambda ix: jnp.take(dd, ix, axis=1))(idx)
+                    if dd is not None
+                    else None
+                )
+                return jstats.make_disc_props(corr_b, net_b, data_b, mask)
+        else:
+            d_corr = jnp.asarray(disc_corr, jnp.float32)
+            d_net = (
+                None if net_beta is not None
+                else jnp.asarray(disc_net, jnp.float32)
+            )
+
+            @jax.jit
+            def _disc_bucket(dc, dn, dd, idx, mask):
+                # idx: (K, cap) padded discovery indices; mask: (K, cap)
+                sub = lambda mat, ix: mat[ix[:, None], ix[None, :]]
+                corr_b = jax.vmap(partial(sub, dc))(idx)
+                net_b = (
+                    jstats.derived_net(corr_b, net_beta) if dn is None
+                    else jax.vmap(partial(sub, dn))(idx)
+                )
+                data_b = (
+                    jax.vmap(lambda ix: jnp.take(dd, ix, axis=1))(idx)
+                    if dd is not None
+                    else None
+                )
+                return jstats.make_disc_props(corr_b, net_b, data_b, mask)
+
+        self.buckets: list[_Bucket] = []
+        for cap in sorted(by_cap):
+            pos = by_cap[cap]
+            didx_b, mask_b, obs_b, slices = [], [], [], []
+            for k in pos:
+                mod = self.modules[k]
+                didx_b.append(_pad_to(mod.disc_idx.astype(np.int32), cap, (0,)))
+                mask = np.zeros(cap, np.float32)
+                mask[: mod.size] = 1.0
+                mask_b.append(mask)
+                obs_b.append(_pad_to(mod.test_idx.astype(np.int32), cap, (0,)))
+                slices.append((int(offsets[k]), mod.size))
+
+            disc = _disc_bucket(
+                d_corr, d_net, d_data,
+                jnp.asarray(np.stack(didx_b)), jnp.asarray(np.stack(mask_b))
+            )
+            self.buckets.append(
+                _Bucket(cap, pos, disc, jnp.asarray(np.stack(obs_b)), slices)
+            )
+
+        self._chunk_fn_cached: Callable | None = None
+        self._observed_fn: Callable | None = None
+
+    # ------------------------------------------------------------------
+    # Observed pass (SURVEY.md §3.1 "observed pass")
+    # ------------------------------------------------------------------
+
+    def fingerprint_arrays(self):
+        """Problem matrices sampled into the checkpoint fingerprint
+        (:func:`netrep_tpu.utils.checkpoint.content_digest`): test-side
+        device matrices plus the bucketed discovery properties, so a
+        completed checkpoint is never silently reused against changed data."""
+        arrays = [self._test_corr, self._test_net, self._test_dataT]
+        for b in self.buckets:
+            arrays.extend(
+                f for f in b.disc if f is not None and hasattr(f, "reshape")
+            )
+        return arrays
+
+    # -- shared chunk/key contract (single source of truth for the
+    #    reproducibility guarantee; also used by MultiTestEngine) ----------
+
+    def effective_chunk(self) -> int:
+        """Chunk size, rounded to a multiple of the mesh's permutation axis."""
+        C = self.config.chunk_size
+        if self.mesh is not None:
+            ax = self.mesh.shape[self.config.mesh_axis]
+            C = max(ax, (C // ax) * ax)
+        return C
+
+    @staticmethod
+    def perm_keys(key: jax.Array, start: int, count: int) -> jax.Array:
+        """Per-permutation keys ``fold_in(key, i)`` for i in [start, start+count)
+        — the chunk-size- and mesh-independent seeding contract
+        (SURVEY.md §7 "RNG semantics"). Jitted (static count, traced start):
+        eager dispatch costs ~1s per op on tunneled TPU backends, which
+        would dwarf the chunk compute in the hot loop."""
+        return _perm_keys_jit(key, jnp.uint32(start), int(count))
+
+    def observed(self) -> np.ndarray:
+        """(n_modules, 7) observed statistics on the actual overlap sets."""
+        if self.discovery_only:
+            raise RuntimeError(
+                "engine was built discovery_only; test-side passes live in "
+                "the wrapping engine"
+            )
+        if self._observed_fn is None:
+            if self.row_sharded:
+                self._observed_fn = make_row_sharded_observed(
+                    self._gather_rep, self.net_beta
+                )
+            else:
+                self._observed_fn = jax.jit(
+                    jax.vmap(
+                        partial(
+                            jstats.gather_and_stats_mxu
+                            if self.gather_mode == "mxu"
+                            else jstats.gather_and_stats,
+                            n_iter=self.config.power_iters,
+                            summary_method="eigh",  # observed: exact, runs once
+                            net_beta=self.net_beta,
+                        ),
+                        in_axes=(0, 0, None, None, None),
+                    )
+                )
+        out = np.full((self.n_modules, N_STATS), np.nan)
+        for b in self.buckets:
+            res = self._observed_fn(
+                b.disc, b.obs_idx, self._test_corr, self._test_net,
+                self._test_dataT,
+            )
+            out[b.module_pos] = np.asarray(res, dtype=np.float64)
+        return out
+
+    # ------------------------------------------------------------------
+    # Null chunks
+    # ------------------------------------------------------------------
+
+    def chunk_args(self) -> tuple:
+        """Device operands of the chunk program. Passed to the jitted chunk
+        as ARGUMENTS, never captured in its closure: closure-captured device
+        arrays become compile-time constants, and baking the n×n matrices
+        into the executable (3+ GB at Config D scale) multiplies compile
+        time and HBM footprint."""
+        return (
+            self._pool_dev,
+            self._test_corr,
+            self._test_net,
+            self._test_dataT,
+            [b.disc for b in self.buckets],
+        )
+
+    def chunk_body(self) -> Callable:
+        """The unjitted chunk program: draw a node permutation per chunk
+        element, slice per-module index sets in the fixed module order
+        (disjoint within a permutation — the reference's label-shuffle
+        semantics, SURVEY.md §3.1), and run all bucket kernels. Signature:
+        ``chunk(keys, *chunk_args) -> [per-bucket (C, K_b, 7) arrays]``
+        with ``chunk_args`` as produced by :meth:`chunk_args` (used by
+        ``__graft_entry__.entry``)."""
+        cfg = self.config
+        # only static structure may be closed over (see chunk_args)
+        caps_slices = [(b.cap, tuple(b.slices)) for b in self.buckets]
+        row_sharded = self.row_sharded
+        gather_perm = self._gather_perm if row_sharded else None
+        if row_sharded:
+            from .sharded import gather_corr_net as _gcn
+        gather_mode = self.gather_mode
+        perm_batch = cfg.resolved_perm_batch(
+            gather_mode, jax.default_backend(), self.effective_chunk()
+        )
+        net_beta = self.net_beta
+        kernel = partial(
+            jstats.gather_and_stats_mxu if gather_mode == "mxu"
+            else jstats.gather_and_stats,
+            n_iter=cfg.power_iters,
+            summary_method=cfg.summary_method,
+            net_beta=net_beta,
+        )
+
+        def chunk(keys: jax.Array, pool, tc, tn, td, discs) -> list[jax.Array]:
+            # keys: (C,) typed PRNG keys, one per permutation
+            if row_sharded:
+                perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)
+                outs = []
+                for (cap, slices), disc in zip(caps_slices, discs):
+                    cols = []
+                    for off, size in slices:
+                        idx = perm[:, off: off + size]
+                        idx = jnp.pad(idx, ((0, 0), (0, cap - size)))
+                        cols.append(idx)
+                    idx_b = jnp.stack(cols, axis=1)  # (C, K, cap)
+                    # collective-assembled gathers from the row-sharded
+                    # matrices; statistics batch over (C, K) by broadcasting
+                    # (disc props carry the K axis).
+                    sub_c, sub_n = _gcn(gather_perm, tc, tn, idx_b, net_beta)
+                    zd = (
+                        jstats.gather_zdata(td, idx_b, disc.mask)
+                        if td is not None else None
+                    )
+                    outs.append(
+                        jstats.module_stats_masked(
+                            disc, sub_c, sub_n, zd,
+                            n_iter=cfg.power_iters,
+                            summary_method=cfg.summary_method,
+                        )
+                    )
+                return outs
+
+            # Replicated path: sequence permutations with lax.map (one device
+            # dispatch; batch_size bounds the mxu path's (batch, rows, n)
+            # gather working set in HBM), vmap over each bucket's modules.
+            def per_perm(key):
+                perm = jax.random.permutation(key, pool)
+                outs_p = []
+                for (cap, slices), disc in zip(caps_slices, discs):
+                    cols = []
+                    for off, size in slices:
+                        idx = perm[off: off + size]
+                        cols.append(jnp.pad(idx, (0, cap - size)))
+                    idx_b = jnp.stack(cols, axis=0)  # (K, cap)
+                    over_mods = jax.vmap(kernel, in_axes=(0, 0, None, None, None))
+                    outs_p.append(over_mods(disc, idx_b, tc, tn, td))
+                return outs_p
+
+            return jax.lax.map(per_perm, keys, batch_size=perm_batch)
+
+        return chunk
+
+    def _build_chunk_fn(self) -> Callable:
+        """Jit the chunk body (operands as arguments, :meth:`chunk_args`),
+        sharding the per-permutation key array (and outputs) along the
+        mesh's permutation axis when a mesh is present — XLA then partitions
+        the whole chunk across devices over ICI (SURVEY.md §2.3)."""
+        chunk = self.chunk_body()
+        cfg = self.config
+        args = self.chunk_args()
+        if self.mesh is not None:
+            keys_sharding = NamedSharding(self.mesh, P(cfg.mesh_axis))
+            out_shardings = [
+                NamedSharding(self.mesh, P(cfg.mesh_axis))
+                for _ in self.buckets
+            ]
+            jitted = jax.jit(chunk, out_shardings=out_shardings)
+
+            def fn(keys):
+                # shard keys explicitly; the matrix operands keep their own
+                # (committed) shardings — replicated or row-sharded
+                return jitted(jax.device_put(keys, keys_sharding), *args)
+
+            return fn
+        jitted = jax.jit(chunk)
+        return lambda keys: jitted(keys, *args)
+
+    def _chunk_fn(self) -> Callable:
+        if self._chunk_fn_cached is None:
+            self._chunk_fn_cached = self._build_chunk_fn()
+        return self._chunk_fn_cached
+
+    def run_null(
+        self,
+        n_perm: int,
+        key: jax.Array | int = 0,
+        progress: Callable[[int, int], None] | None = None,
+        nulls_init: np.ndarray | None = None,
+        start_perm: int = 0,
+        checkpoint_path: str | None = None,
+        checkpoint_every: int = 8192,
+    ) -> tuple[np.ndarray, int]:
+        """Compute the permutation null distribution.
+
+        Parameters
+        ----------
+        n_perm : total permutations.
+        key : PRNG key (or integer seed) — the engine's reproducibility
+            contract: same key + same inputs = same null, independent of
+            chunk size and mesh (SURVEY.md §7 "RNG semantics").
+        progress : optional callback ``(done, total)`` per chunk.
+        nulls_init, start_perm : resume support — a partially-filled null
+            array and the index to continue from (SURVEY.md §5
+            "checkpoint/resume").
+        checkpoint_path : when set, the partial null is persisted there
+            (atomic ``.npz``) every ``checkpoint_every`` permutations, on
+            interrupt, and on completion; an existing compatible checkpoint
+            at the path is resumed from automatically (exact: per-permutation
+            keys depend only on (key, index)). Mismatched problem/seed
+            raises (SURVEY.md §5 "checkpoint/resume").
+        checkpoint_every : checkpoint cadence in permutations (rounded up to
+            whole chunks).
+
+        Returns
+        -------
+        (nulls, completed) — ``(n_perm, n_modules, 7)`` array (NaN rows
+        beyond ``completed`` if interrupted) and the number of completed
+        permutations. A ``KeyboardInterrupt`` during the loop returns the
+        partial result instead of raising (the reference's Ctrl-C path,
+        SURVEY.md §5 "failure detection").
+        """
+        if self.discovery_only:
+            raise RuntimeError(
+                "engine was built discovery_only; test-side passes live in "
+                "the wrapping engine"
+            )
+
+        def write(nulls, outs, done, take):
+            from .distributed import gather_to_host
+
+            for b, out in zip(self.buckets, outs):
+                # transfer the whole chunk output and slice on the HOST: a
+                # device-side `out[:take]` is an eager op, and eager dispatch
+                # on tunneled backends costs ~1s per op (the arrays are tiny).
+                # gather_to_host additionally allgathers across processes on
+                # multi-host meshes, where the perm-axis shards live on other
+                # hosts' devices and np.asarray alone would fail.
+                arr = gather_to_host(out).astype(np.float64)
+                nulls[done: done + take, b.module_pos] = arr[:take]
+
+        return run_checkpointed_chunks(
+            self, n_perm, key, self._chunk_fn(),
+            (n_perm, self.n_modules, N_STATS), write,
+            progress=progress, nulls_init=nulls_init, start_perm=start_perm,
+            checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+        )

@@ -1,0 +1,59 @@
+"""Device-mesh helpers — the framework's distributed communication backend
+(SURVEY.md §2.3 last row, §5): the reference is single-process OpenMP with no
+network backend; the TPU-native equivalent is a `jax.sharding.Mesh` whose
+axes carry XLA collectives over ICI (within a slice) and DCN (across hosts).
+
+Axes used by this framework:
+
+- ``perm`` — data parallelism over permutations (the reference's OpenMP axis).
+- ``row``  — tensor-style sharding of the n×n correlation/network matrices
+  across devices (the large-``n`` scale axis, SURVEY.md §5 "long-context");
+  module gathers then assemble submatrices with ``psum`` collectives
+  (:mod:`netrep_tpu.parallel.sharded`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+PERM_AXIS = "perm"
+ROW_AXIS = "row"
+
+
+def make_mesh(
+    n_perm_shards: int | None = None,
+    n_row_shards: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a ``(perm, row)`` mesh over the available devices.
+
+    Defaults to all devices on the permutation axis (the embarrassingly
+    parallel axis — the right default for networks that fit in one HBM).
+    ``n_row_shards > 1`` trades permutation parallelism for matrix sharding
+    when the three n×n matrices exceed a single device's HBM
+    (SURVEY.md §2.3 "tensor/model parallelism" row: 20k×20k f32 ≈ 1.6 GB
+    each; 50k² ≈ 10 GB each).
+
+    On multi-host deployments ``jax.devices()`` spans all hosts and the
+    ``perm`` axis rides DCN between hosts while ``row`` should stay within a
+    host's ICI domain (devices are laid out perm-major to that end).
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if n_perm_shards is None:
+        if n % n_row_shards:
+            raise ValueError(
+                f"{n} devices not divisible by n_row_shards={n_row_shards}"
+            )
+        n_perm_shards = n // n_row_shards
+    need = n_perm_shards * n_row_shards
+    if need > n:
+        raise ValueError(
+            f"mesh {n_perm_shards}×{n_row_shards} needs {need} devices, "
+            f"have {n}"
+        )
+    grid = np.array(devices[:need]).reshape(n_perm_shards, n_row_shards)
+    return Mesh(grid, (PERM_AXIS, ROW_AXIS))

@@ -1,0 +1,327 @@
+"""Multi-test-dataset vmap path — Config C (BASELINE.json:9; SURVEY.md §2.3
+"multi-dataset parallelism"): the reference loops (discovery, test) pairs
+sequentially in R; on TPU, when several test cohorts share one node universe
+(the common consortium design: same genes measured in every cohort), the
+engine vmaps the whole permutation kernel over a stacked (T, n, n) test-matrix
+axis — one compiled program, T× the arithmetic intensity per gather of the
+shared permutation index batch.
+
+Statistical note: the same permutation node-sets are reused across the T test
+datasets within one run. Nulls remain valid per pair (each dataset's matrices
+are independent of the shared index draw); only the *joint* distribution
+across datasets is coupled, which the reference's sequential independent runs
+don't expose either way because p-values are computed per pair.
+
+Config C composes with Config D (``matrix_sharding='row'``): each cohort's
+n×n matrices are row-sharded individually across the mesh's row axis and the
+chunk program loops the small T axis over the shared permutation index batch
+— a multi-cohort genome-scale consortium run holds T×n²/D_row per device
+instead of T×n² (VERDICT r1 item 7).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import stats as jstats
+from ..ops.oracle import N_STATS
+from ..utils.checkpoint import content_digest as ckpt_digest
+from ..utils.config import EngineConfig
+from .engine import ModuleSpec, PermutationEngine
+
+
+class MultiTestEngine:
+    """Permutation engine for one discovery dataset against T stacked test
+    datasets with identical node universes.
+
+    Wraps :class:`PermutationEngine` for bucket construction (discovery-side
+    properties, sizes, pool validation) and adds a dataset axis to the test
+    side of every kernel via vmap.
+    """
+
+    def __init__(
+        self,
+        disc_corr, disc_net, disc_data,
+        test_corrs,   # (T, n, n)
+        test_nets,    # (T, n, n)
+        test_datas,   # list of (samples_t, n) per dataset (ragged ok) or None
+        modules: Sequence[ModuleSpec],
+        pool: np.ndarray,
+        config: EngineConfig = EngineConfig(),
+        mesh=None,
+    ):
+        test_corrs = np.asarray(test_corrs)
+        self.T = test_corrs.shape[0]
+        # Base engine: discovery-side buckets + pool validation only — no
+        # throwaway test-side device transfer (the test side lives here).
+        # With matrix_sharding='row' it also builds the sharded gatherers
+        # (discovery_only + row path in PermutationEngine.__init__).
+        self._base = PermutationEngine(
+            disc_corr, disc_net,
+            disc_data if test_datas is not None else None,
+            None, None, None,
+            modules, pool, config=config, mesh=mesh, discovery_only=True,
+        )
+        self.row_sharded = self._base.row_sharded
+        self.net_beta = self._base.net_beta  # sample-checked per dataset below
+        dtype = jnp.dtype(config.dtype)
+        if self.net_beta is not None:
+            from .engine import check_derived_network
+
+            for t in range(self.T):
+                check_derived_network(
+                    test_corrs[t], test_nets[t], self.net_beta, f"test[{t}]",
+                )
+        if self.row_sharded:
+            # Config C × Config D composition (VERDICT r1 item 7): each test
+            # dataset's n×n matrices are row-sharded individually and the
+            # chunk program loops the (small) T axis over the shared
+            # permutation index batch — the stacked (T, n, n) tensor never
+            # materializes on one device, and permutation draws stay shared
+            # across cohorts exactly as on the replicated vmap path.
+            from .mesh import ROW_AXIS
+            from .sharded import pad_square_to_multiple, shard_rows
+
+            d_row = mesh.shape[ROW_AXIS]
+            self._tc = [
+                shard_rows(
+                    jnp.asarray(pad_square_to_multiple(c, d_row), dtype), mesh
+                )
+                for c in test_corrs
+            ]
+            self._tn = (
+                None if self.net_beta is not None
+                else [
+                    shard_rows(
+                        jnp.asarray(pad_square_to_multiple(m, d_row), dtype),
+                        mesh,
+                    )
+                    for m in np.asarray(test_nets)
+                ]
+            )
+        else:
+            self._tc = jnp.asarray(test_corrs, dtype)
+            self._tn = (
+                None if self.net_beta is not None
+                else jnp.asarray(test_nets, dtype)
+            )
+        # ragged sample counts across datasets are allowed → keep a list and
+        # vmap only when uniform, else python-loop the T axis for data.
+        # Data is stored TRANSPOSED — (T, n, samples) — so per-module slices
+        # are row gathers (see ops.stats.gather_and_stats).
+        if test_datas is None:
+            self._td = None
+            self._uniform_samples = True
+        else:
+            shapes = {np.asarray(d).shape for d in test_datas}
+            self._uniform_samples = len(shapes) == 1
+            if self._uniform_samples and not self.row_sharded:
+                self._td = jnp.asarray(
+                    np.stack([np.asarray(d).T for d in test_datas]), dtype
+                )
+            else:
+                # per-dataset list (ragged samples, or row-sharded — where
+                # the T axis is a host-side loop and `td[t]` must be free
+                # Python list indexing, not an eager device slice)
+                self._td = [jnp.asarray(np.asarray(d).T, dtype) for d in test_datas]
+        self.config = config
+        self.mesh = mesh
+        self.modules = self._base.modules
+        self.n_modules = self._base.n_modules
+        self._chunk_cached: Callable | None = None
+        self._obs_fn_cached: Callable | None = None
+
+    # -- kernel composition ------------------------------------------------
+
+    def _stats_stack(self, summary_method: str):
+        """vmap composition: modules → (optionally) permutations → datasets."""
+        one = partial(
+            jstats.gather_and_stats,
+            n_iter=self.config.power_iters,
+            summary_method=summary_method,
+            net_beta=self.net_beta,
+        )
+        over_mod = jax.vmap(one, in_axes=(0, 0, None, None, None))
+        return over_mod
+
+    def _tn_at(self, t):
+        """Per-dataset network operand: None in derived-network mode."""
+        return None if self._tn is None else self._tn[t]
+
+    def observed(self) -> np.ndarray:
+        """(T, n_modules, 7) observed statistics."""
+        out = np.full((self.T, self.n_modules, N_STATS), np.nan)
+        if self.row_sharded:
+            if self._obs_fn_cached is None:
+                from .engine import make_row_sharded_observed
+
+                self._obs_fn_cached = make_row_sharded_observed(
+                    self._base._gather_rep, self.net_beta
+                )
+            _obs = self._obs_fn_cached
+            for t in range(self.T):
+                td_t = None if self._td is None else self._td[t]
+                for b in self._base.buckets:
+                    res = _obs(
+                        b.disc, b.obs_idx, self._tc[t], self._tn_at(t), td_t
+                    )
+                    out[t, b.module_pos] = np.asarray(res, dtype=np.float64)
+            return out
+        over_mod = self._stats_stack("eigh")
+        if self._td is None or self._uniform_samples:
+            over_test = jax.jit(jax.vmap(
+                over_mod,
+                in_axes=(None, None, 0, None if self._tn is None else 0,
+                         None if self._td is None else 0),
+            ))
+            for b in self._base.buckets:
+                res = over_test(b.disc, b.obs_idx, self._tc, self._tn, self._td)
+                out[:, b.module_pos] = np.asarray(res, dtype=np.float64)
+        else:
+            fn = jax.jit(over_mod)
+            for t in range(self.T):
+                for b in self._base.buckets:
+                    res = fn(b.disc, b.obs_idx, self._tc[t], self._tn_at(t),
+                             self._td[t])
+                    out[t, b.module_pos] = np.asarray(res, dtype=np.float64)
+        return out
+
+    def _chunk_fn(self) -> Callable:
+        if self._chunk_cached is not None:
+            return self._chunk_cached
+        cfg = self.config
+        base = self._base
+        uniform = self._td is None or self._uniform_samples
+        td_absent = self._td is None
+        T = self.T
+        caps_slices = [(b.cap, tuple(b.slices)) for b in base.buckets]
+        over_mod = self._stats_stack(cfg.summary_method)
+        over_perm = jax.vmap(over_mod, in_axes=(None, 0, None, None, None))
+
+        # device operands are jit ARGUMENTS, not closure captures — captured
+        # device arrays become compile-time constants (T·n² baked into the
+        # executable at multi-cohort scale)
+        chunk_args = (
+            base._pool_dev, self._tc, self._tn, self._td,
+            [b.disc for b in base.buckets],
+        )
+
+        row_sharded = self.row_sharded
+        gather_perm = base._gather_perm if row_sharded else None
+        net_beta = self.net_beta
+        tn_absent = self._tn is None
+        if row_sharded:
+            from .sharded import gather_corr_net
+
+        def chunk(keys, pool, tc, tn, td, discs):
+            perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)
+            outs = []
+            for (cap, slices), disc in zip(caps_slices, discs):
+                cols = []
+                for off, size in slices:
+                    idx = perm[:, off: off + size]
+                    cols.append(jnp.pad(idx, ((0, 0), (0, cap - size))))
+                idx_b = jnp.stack(cols, axis=1)  # (C, K, cap)
+                if row_sharded:
+                    # Config C × row sharding: T is small — loop datasets
+                    # over the SHARED index batch; each cohort's submatrices
+                    # assemble from its own row-sharded matrices (psum over
+                    # the row axis), never materializing (T, n, n) anywhere.
+                    per_t = []
+                    for t in range(T):
+                        sub_c, sub_n = gather_corr_net(
+                            gather_perm, tc[t],
+                            None if tn_absent else tn[t], idx_b, net_beta,
+                        )
+                        zd = (
+                            jstats.gather_zdata(td[t], idx_b, disc.mask)
+                            if not td_absent else None
+                        )
+                        per_t.append(jstats.module_stats_masked(
+                            disc, sub_c, sub_n, zd,
+                            n_iter=cfg.power_iters,
+                            summary_method=cfg.summary_method,
+                        ))
+                    outs.append(jnp.stack(per_t))        # (T, C, K, 7)
+                elif uniform:
+                    over_test = jax.vmap(
+                        over_perm,
+                        in_axes=(None, None, 0, None if tn_absent else 0,
+                                 None if td_absent else 0),
+                    )
+                    outs.append(over_test(disc, idx_b, tc, tn, td))  # (T,C,K,7)
+                else:
+                    outs.append(jnp.stack([
+                        over_perm(disc, idx_b, tc[t],
+                                  None if tn_absent else tn[t], td[t])
+                        for t in range(T)
+                    ]))
+            return outs
+
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            ksh = NamedSharding(self.mesh, P(cfg.mesh_axis))
+            osh = [
+                NamedSharding(self.mesh, P(None, cfg.mesh_axis))
+                for _ in base.buckets
+            ]
+            jitted = jax.jit(chunk, out_shardings=osh)
+            self._chunk_cached = lambda keys: jitted(
+                jax.device_put(keys, ksh), *chunk_args
+            )
+        else:
+            jitted = jax.jit(chunk)
+            self._chunk_cached = lambda keys: jitted(keys, *chunk_args)
+        return self._chunk_cached
+
+    def _fingerprint_extra(self) -> bytes:
+        """Checkpoint identity of the test side (_tc/_tn/_td are per-dataset
+        lists when row-sharded or ragged, single stacked arrays otherwise)."""
+        as_list = lambda x: (
+            list(x) if isinstance(x, list) else [x]
+        )
+        digest = ckpt_digest(
+            as_list(self._tc) + as_list(self._tn) + as_list(self._td)
+        )
+        return f"|T:{self.T}|td:{digest}".encode()
+
+    def run_null(self, n_perm: int, key=0, progress=None,
+                 nulls_init=None, start_perm: int = 0,
+                 checkpoint_path: str | None = None,
+                 checkpoint_every: int = 8192):
+        """(T, n_perm, n_modules, 7) null array + completed count; same
+        chunked/interruptible/reproducible/resumable/checkpointable contract
+        as the base engine (key derivation and chunk rounding are shared
+        helpers on :class:`PermutationEngine` so the two paths cannot
+        drift)."""
+        def write(nulls, outs, done, take):
+            from .distributed import gather_to_host
+
+            for b, outarr in zip(self._base.buckets, outs):
+                # full-chunk transfer, host-side slice (device slicing is an
+                # eager op — ~1s dispatch on tunneled backends); a single
+                # advanced index (module_pos) keeps its axis position in the
+                # assignment target. Cross-host allgather on multi-host
+                # meshes.
+                arr = gather_to_host(outarr).astype(np.float64)
+                nulls[:, done: done + take, b.module_pos] = arr[:, :take]
+
+        from .engine import run_checkpointed_chunks
+
+        return run_checkpointed_chunks(
+            self._base, n_perm, key, self._chunk_fn(),
+            (self.T, n_perm, self.n_modules, N_STATS), write,
+            progress=progress, nulls_init=nulls_init, start_perm=start_perm,
+            checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+            perm_axis=1,
+            # the test-side matrices live on this wrapper (the base engine is
+            # discovery-only), so their content digest rides fingerprint_extra
+            fingerprint_extra=self._fingerprint_extra(),
+        )

@@ -1,0 +1,222 @@
+"""Row-sharded n×n matrices with collective-assembled module gathers —
+the framework's "context parallelism" (SURVEY.md §5 "long-context": the role
+of the long axis is played by network size n; at 50k nodes the three n×n f32
+matrices are ~10 GB each and must be sharded across the mesh, with module
+submatrix gathers assembled by collectives; §7 step 5, Config D
+[BASELINE.json:10]).
+
+Design: a matrix is laid out ``P(ROW_AXIS, None)`` — each device owns a
+contiguous block of rows (full row width, so the column gather is local).
+A module gather ``M[idx][:, idx]`` becomes, inside ``shard_map``:
+
+1. local column gather ``block[:, idx]`` — (rows/D, m), pure local HBM reads;
+2. local row selection: positions of ``idx`` that fall inside this device's
+   row block, others zeroed;
+3. ``psum`` over the row axis — each shard contributes its disjoint rows, the
+   sum assembles the full (m, m) submatrix on every shard.
+
+The psum rides ICI and moves only O(m²) per gather — m ≪ n, so the collective
+is tiny compared to the HBM savings of never materializing n² on one device.
+
+Data matrices (samples × n, samples ≪ n) stay replicated and are gathered
+with a plain ``take`` outside the shard region.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import ROW_AXIS
+
+try:  # jax ≥ 0.6 exports shard_map at top level; older under experimental
+    _shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_rows(mat, mesh: Mesh, axis: str = ROW_AXIS):
+    """Place an (n, n) matrix with rows sharded over ``axis``. Rows must
+    divide evenly by the axis size (pad first: :func:`pad_rows_to_multiple`)."""
+    n = mat.shape[0]
+    d = mesh.shape[axis]
+    if n % d:
+        raise ValueError(
+            f"rows ({n}) not divisible by mesh axis {axis!r} size {d}; "
+            "pad the matrix first (pad_rows_to_multiple)"
+        )
+    return jax.device_put(mat, NamedSharding(mesh, P(axis, None)))
+
+
+def pad_square_to_multiple(mat, d: int):
+    """Zero-pad both axes of a square matrix to a multiple of ``d`` (padding
+    is inert: gather indices only ever point at real nodes)."""
+    import numpy as np
+
+    n = mat.shape[0]
+    pad = (-n) % d
+    if pad == 0:
+        return mat
+    return np.pad(np.asarray(mat), [(0, pad), (0, pad)])
+
+
+def gather_submatrix_local(block: jnp.ndarray, idx: jnp.ndarray, axis: str = ROW_AXIS):
+    """Inside ``shard_map``: assemble ``M[idx][:, idx]`` from this device's
+    row block via the local-gather + psum recipe (module docstring).
+
+    ``block`` is (rows_per_shard, n); ``idx`` is (m,) global row/col indices,
+    replicated across the row axis. Returns the full (m, m) submatrix
+    (identical on every row shard after the psum).
+
+    This is the *direct* (exact advanced-indexing) variant — what XLA:CPU
+    runs fastest. Its ``block[:, idx]`` column gather lowers to per-element
+    loads on TPU (the pattern ``ops/stats.py`` measured at ~15 Melem/s);
+    accelerators should use :func:`gather_submatrix_local_mxu` (the engine
+    picks per ``EngineConfig.gather_mode``, same rule as the replicated
+    path)."""
+    rows_per = block.shape[0]
+    start = jax.lax.axis_index(axis) * rows_per
+    rel = idx - start
+    in_block = (rel >= 0) & (rel < rows_per)
+    safe = jnp.where(in_block, rel, 0)
+    cols = block[:, idx]                       # (rows_per, m) local gather
+    part = jnp.where(in_block[:, None], cols[safe, :], 0.0)  # (m, m)
+    return jax.lax.psum(part, axis)
+
+
+def gather_submatrix_local_mxu(
+    block: jnp.ndarray, idx: jnp.ndarray, axis: str = ROW_AXIS
+):
+    """TPU-fast sharded submatrix gather: the sorted-row + one-hot-matmul
+    technique of :func:`netrep_tpu.ops.stats.gather_submatrix_mxu` applied
+    *inside* the shard_map (VERDICT r1 item 3 — the direct variant's
+    column gather crawls on TPU):
+
+    1. sort the indices ascending (DMA-friendly row order);
+    2. local ROW gather from this device's (rows_per, n) block — rows owned
+       by other shards are zeroed, not fetched;
+    3. column select as a one-hot matmul riding the MXU → this shard's
+       additive (m, m) contribution in the sorted basis;
+    4. ``psum`` over the row axis assembles the full sorted submatrix —
+       the collective moves only O(m²);
+    5. rotate back to the original (discovery-paired) order with the
+       permutation matmuls ``Pᵀ S P``.
+
+    Value fidelity matches the replicated mxu path: selection matmuls are
+    exact in exact arithmetic; on TPU the default-precision f32 matmul
+    carries bf16 operand rounding (~4e-3 relative, attenuated ~1/m in the
+    statistics — see EngineConfig.gather_mode)."""
+    rows_per, n = block.shape
+    m = idx.shape[-1]
+    order = jnp.argsort(idx)
+    idx_sorted = jnp.take(idx, order)
+    pos = jax.lax.broadcasted_iota(jnp.int32, (m, m), 1)
+    unsort = (pos == order[:, None]).astype(block.dtype)          # P (m, m)
+
+    start = jax.lax.axis_index(axis) * rows_per
+    rel = idx_sorted - start
+    in_block = (rel >= 0) & (rel < rows_per)
+    safe = jnp.clip(rel, 0, rows_per - 1)
+    rows = jnp.where(in_block[:, None], block[safe, :], 0.0)      # (m, n)
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (n, m), 0)
+    onehot = (col_ids == idx_sorted[None, :]).astype(block.dtype)  # (n, m)
+    part = jnp.matmul(rows, onehot, preferred_element_type=jnp.float32)
+    sub_sorted = jax.lax.psum(part, axis)
+    return jnp.matmul(
+        jnp.swapaxes(unsort, -1, -2),
+        jnp.matmul(sub_sorted, unsort, preferred_element_type=jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def gather_corr_net(gather, tc, tn, idx, net_beta):
+    """Single dispatch point for derived-network mode over a sharded
+    gatherer: with ``tn`` present, gather the (corr, net) submatrix pair;
+    with ``tn`` None, gather only the correlation and derive the network as
+    ``|corr|**net_beta`` on device (EngineConfig.network_from_correlation).
+    One helper so the observed, discovery-bucket, null-chunk, and multi-test
+    paths cannot drift."""
+    from ..ops import stats as jstats
+
+    if tn is None:
+        sub_c = gather(tc, None, idx)
+        return sub_c, jstats.derived_net(sub_c, net_beta)
+    return gather(tc, tn, idx)
+
+
+def make_sharded_gatherer(
+    mesh: Mesh,
+    batch_axis: str | None = None,
+    mode: str = "direct",
+    perm_batch: int | None = None,
+):
+    """Build a ``shard_map``-wrapped batched gather over row-sharded
+    correlation/network matrices.
+
+    Returns ``gather(corr, net, idx)`` with ``idx`` (..., m) int32
+    (arbitrary leading batch dims) → ``(sub_corr, sub_net)`` each
+    (..., m, m). With ``batch_axis`` set (e.g. the permutation axis), the
+    leading batch dim of ``idx`` and of the outputs stays sharded over that
+    mesh axis — permutation data parallelism composes with row sharding on a
+    2-D mesh, and each psum assembles only the local permutation shard's
+    submatrices.
+
+    ``mode`` selects the per-shard gather kernel: ``'direct'`` (exact
+    advanced indexing — CPU) or ``'mxu'`` (sorted-row + one-hot matmuls —
+    TPU; :func:`gather_submatrix_local_mxu`). ``perm_batch`` bounds the
+    working set on 3-D ``(C, K, m)`` index batches: the local permutation
+    axis is evaluated ``perm_batch`` at a time with ``lax.map`` inside the
+    shard region (the mxu row buffers are (K·m, n) per permutation — at
+    genome scale an unbatched chunk would not fit in HBM), mirroring the
+    replicated path's ``EngineConfig.perm_batch``."""
+    if mode not in ("direct", "mxu"):
+        raise ValueError(f"mode must be 'direct' or 'mxu', got {mode!r}")
+    local = (
+        gather_submatrix_local if mode == "direct"
+        else gather_submatrix_local_mxu
+    )
+
+    def batched(one, idx_rep):
+        if idx_rep.ndim == 1:
+            return one(idx_rep)
+        over_mods = jax.vmap(one)
+        if idx_rep.ndim == 2:
+            return over_mods(idx_rep)
+        if idx_rep.ndim == 3 and perm_batch is not None:
+            # (C_local, K, m): bound the per-dispatch working set
+            return jax.lax.map(over_mods, idx_rep, batch_size=perm_batch)
+        fn = over_mods
+        for _ in range(idx_rep.ndim - 2):
+            fn = jax.vmap(fn)
+        return fn(idx_rep)
+
+    def body(corr_blk, net_blk, idx_rep):
+        return batched(
+            lambda ix: (local(corr_blk, ix), local(net_blk, ix)), idx_rep
+        )
+
+    def body_single(blk, idx_rep):
+        return batched(lambda ix: local(blk, ix), idx_rep)
+
+    idx_spec = P(batch_axis) if batch_axis else P()
+
+    def gather(corr, net, idx):
+        """``net=None`` gathers only the correlation submatrices (derived-
+        network mode, EngineConfig.network_from_correlation) and returns a
+        single array instead of a pair."""
+        if net is None:
+            return _shard_map(
+                body_single,
+                mesh=mesh,
+                in_specs=(P(ROW_AXIS, None), idx_spec),
+                out_specs=idx_spec,
+            )(corr, idx)
+        return _shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(ROW_AXIS, None), P(ROW_AXIS, None), idx_spec),
+            out_specs=(idx_spec, idx_spec),
+        )(corr, net, idx)
+
+    return gather

@@ -1,0 +1,307 @@
+"""Sparse permutation engine — Config E (BASELINE.json:11): permutation
+nulls over kNN-graph adjacencies without ever materializing an ``n × n``
+matrix. Same contract as :class:`~netrep_tpu.parallel.engine.
+PermutationEngine` (bucketed static shapes, chunked/interruptible/
+checkpointable null loop, chunk- and mesh-independent RNG), different data
+plane: padded neighbor lists + on-the-fly correlation
+(:mod:`netrep_tpu.ops.sparse`).
+
+The reference has no sparse mode (SURVEY.md §2.3: its only scale axis is
+dense ``n²`` matrices in shared memory); this engine is the rebuild's answer
+to the survey's "sharded gather + masked reduction is this domain's context
+parallelism" item for graphs whose adjacency is structurally sparse. The
+working set per chunk is ``O(C·K·cap·k)`` — at Config E scale (n=50k,
+k≈30) a 64-permutation chunk over 20 modules of ≤200 nodes is ~100 MB,
+versus 10 GB for one dense adjacency.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops import sparse as jsparse
+from ..ops.oracle import N_STATS
+from ..ops.sparse import SparseAdjacency
+from ..utils.config import EngineConfig
+from .engine import ModuleSpec, PermutationEngine, run_checkpointed_chunks
+
+
+class _SparseBucket:
+    def __init__(self, cap, module_pos, disc, obs_idx, slices):
+        self.cap = cap
+        self.module_pos = module_pos
+        self.disc = disc
+        self.obs_idx = obs_idx
+        self.slices = slices
+
+
+class SparsePermutationEngine:
+    """Permutation-null engine for one (discovery, test) pair of sparse
+    networks.
+
+    Parameters
+    ----------
+    disc_adj, test_adj : :class:`~netrep_tpu.ops.sparse.SparseAdjacency`.
+    disc_data, test_data : (n_samples, n) data matrices or None. Without
+        data, a precomputed sparse correlation (``disc_corr``/``test_corr``
+        below) keeps four statistics finite; with neither, only
+        ``avg.weight`` and ``cor.degree`` are defined (see
+        :mod:`netrep_tpu.ops.sparse` on why sparse data-less differs from
+        dense data-less).
+    modules : ordered :class:`ModuleSpec` list (discovery/test index pairs).
+    pool : candidate test-node ids the null samples from (SURVEY.md §3.1).
+    config, mesh : as for :class:`PermutationEngine`; ``mesh`` shards the
+        permutation axis (``config.mesh_axis``) — the adjacency itself is
+        replicated (n·k floats is small by construction).
+    """
+
+    def __init__(
+        self,
+        disc_adj: SparseAdjacency,
+        disc_data,
+        test_adj: SparseAdjacency,
+        test_data,
+        modules: Sequence[ModuleSpec],
+        pool: np.ndarray,
+        config: EngineConfig = EngineConfig(),
+        mesh=None,
+        disc_corr: SparseAdjacency | None = None,
+        test_corr: SparseAdjacency | None = None,
+    ):
+        """``disc_corr``/``test_corr`` are optional PRECOMPUTED sparse
+        correlations (same neighbor-list format as the adjacency): they feed
+        the correlation statistics instead of the on-the-fly ``zᵀz`` — and
+        in the data-less case restore cor.cor/avg.cor for topology-only
+        users (VERDICT r1 item 8)."""
+        if config.matrix_sharding == "row":
+            raise NotImplementedError(
+                "matrix_sharding='row' does not apply to the sparse engine: "
+                "the padded neighbor lists are O(n·k) and are replicated"
+            )
+        self.config = config
+        self.mesh = mesh
+        self.modules = list(modules)
+        self.n_modules = len(self.modules)
+        self.has_data = disc_data is not None and test_data is not None
+
+        bad = [m.label for m in self.modules if m.size < 2]
+        if bad:
+            raise ValueError(
+                f"modules {bad} have fewer than 2 nodes present in the test "
+                "dataset; drop them before building the engine"
+            )
+
+        dtype = jnp.dtype(config.dtype)
+        self._nbr = jnp.asarray(test_adj.nbr)
+        self._wgt = jnp.asarray(test_adj.wgt, dtype)
+        self._test_data = (
+            jnp.asarray(test_data, dtype) if self.has_data else None
+        )
+        self.has_corr = disc_corr is not None and test_corr is not None
+        if (disc_corr is None) != (test_corr is None):
+            raise ValueError(
+                "provide both disc_corr and test_corr sparse correlations, "
+                "or neither"
+            )
+        if self.has_corr:
+            for what, c, adj in (("disc", disc_corr, disc_adj),
+                                 ("test", test_corr, test_adj)):
+                if not isinstance(c, SparseAdjacency) or c.n != adj.n:
+                    raise ValueError(
+                        f"{what}_corr must be a SparseAdjacency over the "
+                        f"same {adj.n} nodes as the {what} network"
+                    )
+            self._cnbr = jnp.asarray(test_corr.nbr)
+            self._cwgt = jnp.asarray(test_corr.wgt, dtype)
+        else:
+            self._cnbr = self._cwgt = None
+        self.pool = np.asarray(pool, dtype=np.int32)
+        self.total_take = sum(m.size for m in self.modules)
+        if self.total_take > self.pool.size:
+            raise ValueError(
+                f"total module size ({self.total_take}) exceeds the "
+                f"candidate pool ({self.pool.size}); use null='all' or drop "
+                "modules"
+            )
+        self._pool_dev = jnp.asarray(self.pool)
+
+        # bucket modules by padded capacity so each bucket compiles once
+        # (SURVEY.md §7 "Variable module sizes vs. XLA static shapes")
+        disc_nbr = jnp.asarray(disc_adj.nbr)
+        disc_wgt = jnp.asarray(disc_adj.wgt, dtype)
+        disc_cnbr = jnp.asarray(disc_corr.nbr) if self.has_corr else None
+        disc_cwgt = (
+            jnp.asarray(disc_corr.wgt, dtype) if self.has_corr else None
+        )
+        disc_data_dev = (
+            jnp.asarray(disc_data, dtype) if self.has_data else None
+        )
+        by_cap: dict[int, list[int]] = {}
+        for k, m in enumerate(self.modules):
+            by_cap.setdefault(config.rounded_cap(m.size), []).append(k)
+
+        offsets = np.concatenate(
+            [[0], np.cumsum([m.size for m in self.modules])]
+        ).astype(int)
+
+        self.buckets: list[_SparseBucket] = []
+        for cap, pos in sorted(by_cap.items()):
+            K = len(pos)
+            disc_idx = np.zeros((K, cap), dtype=np.int32)
+            obs_idx = np.zeros((K, cap), dtype=np.int32)
+            mask = np.zeros((K, cap), dtype=np.float32)
+            slices = []
+            for row, k in enumerate(pos):
+                m = self.modules[k]
+                sz = m.size
+                disc_idx[row, :sz] = np.asarray(m.disc_idx, dtype=np.int32)
+                obs_idx[row, :sz] = np.asarray(m.test_idx, dtype=np.int32)
+                mask[row, :sz] = 1.0
+                slices.append((int(offsets[k]), sz))
+            disc = jsparse.make_disc_props_sparse(
+                disc_nbr, disc_wgt, disc_data_dev,
+                jnp.asarray(disc_idx), jnp.asarray(mask),
+                corr_nbr=disc_cnbr,
+                corr_wgt=disc_cwgt,
+            )
+            self.buckets.append(
+                _SparseBucket(cap, pos, disc, jnp.asarray(obs_idx), slices)
+            )
+
+        self._chunk_fn_cached: Callable | None = None
+        self._observed_fn = None
+
+    # shared chunk/key contract — single source of truth on the dense engine
+    effective_chunk = PermutationEngine.effective_chunk
+    perm_keys = staticmethod(PermutationEngine.perm_keys)
+
+    def fingerprint_arrays(self):
+        arrays = [self._nbr, self._wgt, self._test_data,
+                  self._cnbr, self._cwgt]
+        for b in self.buckets:
+            arrays.extend(
+                f for f in b.disc if f is not None and hasattr(f, "reshape")
+            )
+        return arrays
+
+    def observed(self) -> np.ndarray:
+        """(n_modules, 7) observed statistics on the actual overlap sets."""
+        if self._observed_fn is None:
+            self._observed_fn = jax.jit(
+                jax.vmap(
+                    partial(
+                        jsparse.sparse_gather_and_stats,
+                        n_iter=self.config.power_iters,
+                        summary_method="eigh",  # observed: exact, runs once
+                    ),
+                    in_axes=(0, 0, None, None, None, None, None),
+                )
+            )
+        out = np.full((self.n_modules, N_STATS), np.nan)
+        for b in self.buckets:
+            res = self._observed_fn(
+                b.disc, b.obs_idx, self._nbr, self._wgt, self._test_data,
+                self._cnbr, self._cwgt,
+            )
+            out[b.module_pos] = np.asarray(res, dtype=np.float64)
+        return out
+
+    def chunk_args(self) -> tuple:
+        """Device operands, passed to the jitted chunk as arguments (not
+        closure captures — captured device arrays become compile-time
+        constants; see :meth:`PermutationEngine.chunk_args`)."""
+        return (
+            self._pool_dev, self._nbr, self._wgt, self._test_data,
+            self._cnbr, self._cwgt,
+            [b.disc for b in self.buckets],
+        )
+
+    def chunk_body(self) -> Callable:
+        """Unjitted chunk program; same permutation-draw semantics as the
+        dense engine (one pool shuffle per permutation, consecutive module
+        slices — disjoint node sets within a permutation). Signature:
+        ``chunk(keys, *chunk_args)``."""
+        cfg = self.config
+        caps_slices = [(b.cap, tuple(b.slices)) for b in self.buckets]
+
+        def chunk(keys: jax.Array, pool, nbr, wgt, td, cnbr, cwgt, discs) -> list[jax.Array]:
+            perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)
+            outs = []
+            for (cap, slices), disc in zip(caps_slices, discs):
+                cols = []
+                for off, size in slices:
+                    idx = perm[:, off: off + size]
+                    idx = jnp.pad(idx, ((0, 0), (0, cap - size)))
+                    cols.append(idx)
+                idx_b = jnp.stack(cols, axis=1)  # (C, K, cap)
+                inner = jax.vmap(
+                    partial(
+                        jsparse.sparse_gather_and_stats,
+                        n_iter=cfg.power_iters,
+                        summary_method=cfg.summary_method,
+                    ),
+                    in_axes=(0, 0, None, None, None, None, None),
+                )
+                over_perms = jax.vmap(
+                    inner, in_axes=(None, 0, None, None, None, None, None)
+                )
+                outs.append(over_perms(disc, idx_b, nbr, wgt, td, cnbr, cwgt))
+            return outs
+
+        return chunk
+
+    def _chunk_fn(self) -> Callable:
+        if self._chunk_fn_cached is None:
+            chunk = self.chunk_body()
+            args = self.chunk_args()
+            if self.mesh is not None:
+                ksh = NamedSharding(self.mesh, P(self.config.mesh_axis))
+                osh = [
+                    NamedSharding(self.mesh, P(self.config.mesh_axis))
+                    for _ in self.buckets
+                ]
+                jitted = jax.jit(chunk, out_shardings=osh)
+                self._chunk_fn_cached = lambda keys: jitted(
+                    jax.device_put(keys, ksh), *args
+                )
+            else:
+                jitted = jax.jit(chunk)
+                self._chunk_fn_cached = lambda keys: jitted(keys, *args)
+        return self._chunk_fn_cached
+
+    def run_null(
+        self,
+        n_perm: int,
+        key: jax.Array | int = 0,
+        progress: Callable[[int, int], None] | None = None,
+        nulls_init: np.ndarray | None = None,
+        start_perm: int = 0,
+        checkpoint_path: str | None = None,
+        checkpoint_every: int = 8192,
+    ) -> tuple[np.ndarray, int]:
+        """Same contract as :meth:`PermutationEngine.run_null` (chunked,
+        interruptible, resumable, checkpointable; same-seed ⇒ same null)."""
+
+        def write(nulls, outs, done, take):
+            from .distributed import gather_to_host
+
+            for b, out in zip(self.buckets, outs):
+                # full-chunk transfer, host-side slice (device slicing is an
+                # eager op — ~1s dispatch on tunneled backends); cross-host
+                # allgather on multi-host meshes
+                arr = gather_to_host(out).astype(np.float64)
+                nulls[done: done + take, b.module_pos] = arr[:take]
+
+        return run_checkpointed_chunks(
+            self, n_perm, key, self._chunk_fn(),
+            (n_perm, self.n_modules, N_STATS), write,
+            progress=progress, nulls_init=nulls_init, start_perm=start_perm,
+            checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+        )

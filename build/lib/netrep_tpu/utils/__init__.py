@@ -1,0 +1,1 @@
+"""Shared utilities: engine configuration (`config`)."""

@@ -1,0 +1,168 @@
+"""Null-distribution checkpoint/resume (SURVEY.md §5 "Checkpoint / resume").
+
+The reference has no checkpointing — a 100k-permutation run is
+all-or-nothing. The rebuild's chunked dispatch makes save/resume trivial and
+exact: the null array plus the PRNG key data fully determine the remaining
+work (per-permutation keys are ``fold_in(key, i)``, independent of chunk size
+and mesh — :meth:`netrep_tpu.parallel.engine.PermutationEngine.perm_keys`),
+so resuming produces bit-identical results to an uninterrupted run.
+
+Format: a single ``.npz`` with the partial null array, completion counter,
+PRNG key data, and an engine fingerprint that guards against resuming onto a
+different problem (wrong dataset pair, module set, or pool).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+
+import numpy as np
+
+# v2: fingerprint gained the sampled content digest — v1 checkpoints get a
+# clear version error instead of a misleading "different problem" mismatch.
+# v3: round-2 hot-path changes (multiple-of-32 bucket capacities, transposed
+# data-matrix fingerprint arrays) alter the fingerprint for identical inputs;
+# the bump turns the resulting mismatch into a clear version error.
+_FORMAT_VERSION = 3
+
+
+def content_digest(arrays) -> str:
+    """Cheap content digest of problem matrices: shapes plus a strided
+    sample of up to 4096 elements per array. Catches "same module layout,
+    different data" mix-ups without hashing genome-scale matrices in full
+    (a completed checkpoint would otherwise be silently reused against
+    changed inputs — stale nulls vs fresh observed statistics)."""
+    h = hashlib.blake2b(digest_size=8)
+    for a in arrays:
+        if a is None:
+            h.update(b"-")
+            continue
+        # keep device arrays on device until the small strided sample is
+        # taken — digesting a sharded 20k×20k matrix must not pull the full
+        # array to the host
+        h.update(str(a.shape).encode() + str(a.dtype).encode())
+        flat = a.reshape(-1)
+        step = max(1, flat.size // 4096)
+        h.update(np.asarray(flat[::step][:4096], dtype=np.float64).tobytes())
+    return h.hexdigest()
+
+
+def engine_fingerprint(engine) -> np.ndarray:
+    """Structural + sampled-content fingerprint of a
+    :class:`PermutationEngine` problem: module labels/sizes, pool, data
+    presence, and (when the engine exposes ``fingerprint_arrays()``) a
+    strided-sample digest of the underlying matrices."""
+    parts = [str(_FORMAT_VERSION), str(int(engine.has_data))]
+    for m in engine.modules:
+        parts.append(f"{m.label}:{m.size}")
+    parts.append(f"pool:{engine.pool.size}:{int(np.sum(engine.pool)) & 0xFFFFFFFF}")
+    arrays = getattr(engine, "fingerprint_arrays", None)
+    if arrays is not None:
+        parts.append("digest:" + content_digest(arrays()))
+    return np.frombuffer("|".join(parts).encode(), dtype=np.uint8)
+
+
+def atomic_savez(path: str, **arrays) -> None:
+    """Atomically write a compressed ``.npz``: ``mkstemp`` in the target
+    directory (unique across threads/processes) + ``os.replace``, so an
+    interrupt or a concurrent writer never corrupts an existing file.
+    Shared by checkpoints and result-object saves."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def save_null_checkpoint(
+    path: str,
+    nulls: np.ndarray,
+    completed: int,
+    key_data: np.ndarray,
+    fingerprint: np.ndarray,
+) -> None:
+    """Atomically persist a (possibly partial) null array (see
+    :func:`atomic_savez`)."""
+    atomic_savez(
+        path,
+        version=np.int64(_FORMAT_VERSION),
+        nulls=nulls,
+        completed=np.int64(completed),
+        key_data=np.asarray(key_data),
+        fingerprint=fingerprint,
+    )
+
+
+def load_null_checkpoint(path: str) -> dict | None:
+    """Load a checkpoint, or ``None`` when the file doesn't exist."""
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as z:
+        if "version" not in z.files:
+            raise ValueError(
+                f"{path!r} is not a null checkpoint (no version marker — "
+                "saved PreservationResult files and other .npz files cannot "
+                "be resumed from)"
+            )
+        if int(z["version"]) != _FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint {path!r} has format version {int(z['version'])}, "
+                f"this build reads version {_FORMAT_VERSION}"
+            )
+        return {
+            "nulls": z["nulls"],
+            "completed": int(z["completed"]),
+            "key_data": z["key_data"],
+            "fingerprint": z["fingerprint"],
+        }
+
+
+def validate_resume(
+    ckpt: dict,
+    n_perm: int,
+    key_data: np.ndarray,
+    fingerprint: np.ndarray,
+    path: str,
+    perm_axis: int = 0,
+) -> tuple[np.ndarray, int]:
+    """Check a loaded checkpoint against the current run; returns
+    ``(nulls_init, start_perm)`` ready for
+    :meth:`PermutationEngine.run_null`. Raises with a specific message on any
+    mismatch (SURVEY.md §2.1: informative errors are part of the surface)."""
+    fp = ckpt["fingerprint"]
+    if fp.shape != fingerprint.shape or not np.array_equal(fp, fingerprint):
+        raise ValueError(
+            f"checkpoint {path!r} was written for a different problem "
+            "(module set, sizes, pool, or data presence differ); refusing to "
+            "resume — delete the file or point elsewhere"
+        )
+    kd = np.asarray(ckpt["key_data"])
+    if kd.shape != np.asarray(key_data).shape or not np.array_equal(kd, key_data):
+        raise ValueError(
+            f"checkpoint {path!r} was written with a different PRNG key/seed; "
+            "resuming would splice two different null distributions — use the "
+            "original seed or delete the checkpoint"
+        )
+    nulls = ckpt["nulls"]
+    if nulls.shape[perm_axis] < n_perm:
+        shape = list(nulls.shape)
+        shape[perm_axis] = n_perm
+        grown = np.full(shape, np.nan)
+        sel = [slice(None)] * nulls.ndim
+        sel[perm_axis] = slice(0, nulls.shape[perm_axis])
+        grown[tuple(sel)] = nulls
+        nulls = grown
+    elif nulls.shape[perm_axis] > n_perm:
+        # shrinking run: honor the caller's (n_perm, ...) shape contract
+        sel = [slice(None)] * nulls.ndim
+        sel[perm_axis] = slice(0, n_perm)
+        nulls = nulls[tuple(sel)].copy()
+    completed = min(int(ckpt["completed"]), n_perm)
+    return nulls, completed

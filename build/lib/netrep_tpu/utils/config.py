@@ -1,0 +1,121 @@
+"""TPU engine configuration.
+
+The reference's knobs are plain function arguments (SURVEY.md §5 "Config /
+flag system" — args-only philosophy, kept for the public API); the handful of
+TPU-specific tuning parameters live in this small dataclass instead of
+growing the user-facing signatures.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Tuning knobs for the permutation engine (SURVEY.md §5).
+
+    Attributes
+    ----------
+    chunk_size : permutations evaluated per device dispatch. Chunking bounds
+        device memory, lets Python regain control between dispatches
+        (KeyboardInterrupt → clean partial results, SURVEY.md §5 "failure
+        detection"), and is the save/resume granularity.
+    summary_method : 'power' (masked power iteration — MXU-friendly, the
+        default) or 'eigh' (exact; used by parity tests).
+    power_iters : fixed power-iteration count (static under jit). The
+        default 60 is chosen from measured drift vs exact eigh at
+        north-star module shapes (m=200, s=128, f32 —
+        tests/test_power_vs_eigh.py): structured modules, including a
+        near-degenerate two-factor case at gap ratio 0.98, agree to ~1e-5
+        on every statistic by 60 iterations; null-like random modules never
+        converge in *direction* (Marchenko–Pastur bulk) but their statistic
+        distributions are rotation-invariant, leaving only a ≲5e-4
+        systematic coherence underestimate — far below the null sd. Raising
+        iterations past 60 buys nothing measurable; 40 doubles the
+        coherence bias; each step is one fused m×m matmul, so 60 costs ~2%
+        of the chunk on the mxu path.
+    bucket_rounding : module bucket capacities are rounded up to the next
+        power of two and at least this value — fewer distinct compiled
+        programs (SURVEY.md §7: jit once per module-size bucket).
+    dtype : matrix element dtype on device ('float32' or 'bfloat16' for the
+        gather-bound large-n path; statistics always accumulate in f32).
+    mesh_axis : name of the permutation data-parallel mesh axis.
+    matrix_sharding : 'replicated' (matrices fit in one HBM; permutation
+        axis only) or 'row' (n×n matrices row-sharded over the mesh's row
+        axis with psum-assembled module gathers — SURVEY.md §5 long-context
+        analogue, Config D scale).
+    gather_mode : 'direct' (batched 2D advanced-index gather — exact; what
+        XLA:CPU runs fastest; on TPU the per-element gather emitter crawls at
+        ~60 Melem/s, round-2 measured, so it loses by ~10x there), 'mxu'
+        (sorted row gather + one-hot column-select matmuls,
+        :func:`netrep_tpu.ops.stats.gather_and_stats_mxu` — the TPU winner:
+        XLA materializes the gathered row blocks at ~200-300 GB/s and the
+        selection rides the MXU), or 'auto' (mxu on TPU-like accelerators,
+        direct on CPU). Value fidelity on the mxu path: XLA's
+        default-precision f32 matmul truncates operands to bfloat16, so
+        gathered VALUES carry up to ~4e-3 relative rounding on TPU
+        (statistics attenuate this ~1/m; see ``BASELINE.md`` §precision).
+    network_from_correlation : soft-threshold power β when the network is
+        the WGCNA construction ``|correlation|**β``. When set, the engine
+        never stores or gathers the n×n network on device: network
+        submatrices derive elementwise from the gathered correlation —
+        halving both HBM matrix footprint and the bandwidth-bound hot
+        loop's row traffic (BASELINE.md roofline). The supplied network is
+        sample-checked against ``|corr|**β`` at engine build (mismatch
+        raises). Ignored by ``backend='native'`` (host matrices, no HBM
+        constraint) and the sparse engine (its network IS the sparse
+        structure).
+    perm_batch : permutations evaluated concurrently inside one chunk
+        dispatch (``lax.map`` batch size), bounding the per-dispatch working
+        set in HBM; the chunk itself stays one dispatch, so host round-trips
+        are unaffected. None (default) resolves per gather mode: the mxu
+        path's (batch, Σ K_b·cap_b, n) row blocks cap it at 2; the direct
+        path's working set is just the (batch, K, cap, cap) submatrices, so
+        it runs 64 at a time on accelerators and whole-chunk on CPU.
+    """
+
+    chunk_size: int = 128
+    summary_method: str = "power"
+    power_iters: int = 60
+    bucket_rounding: int = 8
+    dtype: str = "float32"
+    mesh_axis: str = "perm"
+    matrix_sharding: str = "replicated"
+    gather_mode: str = "auto"
+    perm_batch: int | None = None
+    network_from_correlation: float | None = None
+
+    def resolved_gather_mode(self, platform: str) -> str:
+        if self.gather_mode == "auto":
+            # accelerators (tpu / the axon tunnel backend) get the
+            # sorted-rows+MXU path; XLA:CPU's native gather is already fast
+            return "direct" if platform == "cpu" else "mxu"
+        if self.gather_mode not in ("direct", "mxu"):
+            raise ValueError(
+                f"gather_mode must be 'auto', 'direct', or 'mxu', got "
+                f"{self.gather_mode!r}"
+            )
+        return self.gather_mode
+
+    def resolved_perm_batch(self, gather_mode: str, platform: str, chunk: int) -> int:
+        if self.perm_batch is not None:
+            return max(1, min(self.perm_batch, chunk))
+        if gather_mode == "mxu":
+            return min(2, chunk)
+        return chunk if platform == "cpu" else min(64, chunk)
+
+    def rounded_cap(self, size: int) -> int:
+        """Bucket capacity for a module of ``size`` nodes: powers of two up
+        to 32, then multiples of 32. The dominant hot-loop cost is the
+        (Σ K_b·cap_b, n) row-block traffic, linear in Σcap — multiple-of-32
+        rounding wastes ≤31 padded rows per module where power-of-two
+        rounding wasted up to 2x (measured ~20% less row traffic at
+        north-star module sizes), while staying sublane-aligned (8) for the
+        row blocks. Per-bucket programs still compile once per cap."""
+        cap = self.bucket_rounding
+        while cap < size and cap < 32:
+            cap *= 2
+        if size <= cap:
+            return cap
+        return -(-size // 32) * 32

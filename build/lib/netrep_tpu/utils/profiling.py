@@ -1,0 +1,149 @@
+"""Tracing / profiling hooks (SURVEY.md §5 "Tracing / profiling").
+
+The reference has nothing beyond a progress bar and ``verbose=`` messages —
+users profile with ``system.time``/``Rprof`` (SURVEY.md §5). The rebuild
+exposes the TPU-native equivalents:
+
+- ``profile=`` on :func:`netrep_tpu.module_preservation` captures a
+  ``jax.profiler`` trace (TensorBoard/Perfetto ``.xplane.pb``) of the
+  permutation run plus per-pair wall-clock and per-chunk timings, attached
+  to each result as ``result.profile``.
+- :func:`summarize_trace` aggregates the captured device-op durations into a
+  printable table without needing TensorBoard — the same parsing the round-2
+  hot-loop work used to find the gather bottleneck
+  (``benchmarks/profile_chunk.py``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import logging
+import os
+import re
+import time
+from typing import Callable
+
+logger = logging.getLogger("netrep_tpu")
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir: str | None):
+    """Best-effort ``jax.profiler.trace`` context: profiling must never turn
+    a working run into a failing one (e.g. when the backend's profiler
+    plugin is unavailable), so failures degrade to a warning."""
+    if trace_dir is None:
+        yield
+        return
+    os.makedirs(trace_dir, exist_ok=True)
+    import jax
+
+    try:
+        with jax.profiler.trace(trace_dir):
+            yield
+    except Exception as e:  # pragma: no cover - backend-dependent
+        logger.warning("profiler trace failed (%s: %s); timings are still "
+                       "collected", type(e).__name__, e)
+        yield
+
+
+class PairTimer:
+    """Collects per-pair wall-clock and per-chunk durations.
+
+    The chunk timer piggybacks on the engine's ``progress`` callback — the
+    loop calls it once per completed chunk, so inter-call deltas are chunk
+    wall times (including the overlapped host transfer of the
+    double-buffered loop).
+    """
+
+    def __init__(self, trace_dir: str | None):
+        self.trace_dir = trace_dir
+        self.chunk_s: list[float] = []
+        self.observed_s: float | None = None
+        self.null_s: float | None = None
+        self._t0: float | None = None
+
+    def time_observed(self, fn: Callable):
+        t0 = time.perf_counter()
+        out = fn()
+        self.observed_s = time.perf_counter() - t0
+        return out
+
+    def wrap_progress(self, progress: Callable | None) -> Callable:
+        self._t0 = self._null_start = time.perf_counter()
+
+        def cb(done, total):
+            now = time.perf_counter()
+            self.chunk_s.append(now - self._t0)
+            self._t0 = now
+            if progress is not None:
+                progress(done, total)
+
+        return cb
+
+    def finish_null(self, completed: int) -> dict:
+        self.null_s = time.perf_counter() - self._null_start
+        return self.as_dict(completed)
+
+    def as_dict(self, completed: int) -> dict:
+        """The ``result.profile`` payload (SURVEY.md §5 deliverable)."""
+        chunks = self.chunk_s
+        return {
+            "trace_dir": self.trace_dir,
+            "observed_s": self.observed_s,
+            "null_s": self.null_s,
+            "completed": completed,
+            "perms_per_sec": (
+                completed / self.null_s if self.null_s else None
+            ),
+            "chunk_ms": [s * 1e3 for s in chunks],
+            # the first chunk's time includes jit compilation; later chunks
+            # hit the executable cache (SURVEY.md §7: jit once per bucket)
+            "compile_chunk_ms": chunks[0] * 1e3 if chunks else None,
+            "steady_chunk_ms": (
+                sorted(chunks[1:])[len(chunks[1:]) // 2] * 1e3
+                if len(chunks) > 1 else None
+            ),
+        }
+
+
+def resolve_profile_dir(profile) -> str | None:
+    """``profile=`` argument → trace directory (None = profiling off)."""
+    if profile is None or profile is False:
+        return None
+    if profile is True:
+        return os.path.join(os.getcwd(), "netrep_profile")
+    return str(profile)
+
+
+def summarize_trace(trace_dir: str, top: int = 20) -> list[tuple[str, float, float]]:
+    """Aggregate a captured trace's device-op durations.
+
+    Returns ``[(op_name, total_ms, percent), ...]`` sorted by time, summed
+    over accelerator planes (empty on hosts whose trace has no device
+    plane). Lets users see the hot ops without TensorBoard.
+    """
+    import jax
+
+    paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                             recursive=True))
+    if not paths:
+        return []
+    pd_ = jax.profiler.ProfileData.from_serialized_xspace(
+        open(paths[-1], "rb").read()
+    )
+    per_op: dict[str, float] = {}
+    total = 0.0
+    for plane in pd_.planes:
+        if "tpu" not in plane.name.lower() and "gpu" not in plane.name.lower():
+            continue
+        for line in plane.lines:
+            for ev in line.events:
+                base = re.sub(r"[.\d]+$", "", ev.name)
+                per_op[base] = per_op.get(base, 0.0) + ev.duration_ns
+                total += ev.duration_ns
+    ranked = sorted(per_op.items(), key=lambda kv: -kv[1])[:top]
+    return [
+        (name, ns / 1e6, (ns / total * 100.0) if total else 0.0)
+        for name, ns in ranked
+    ]

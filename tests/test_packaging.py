@@ -3,7 +3,10 @@ package discovery finds every subpackage, and the native source ships as
 package data (the lazy first-use build depends on it being installed)."""
 
 import os
-import tomllib
+
+import pytest
+
+tomllib = pytest.importorskip("tomllib")  # stdlib from 3.11; skip on 3.10
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
