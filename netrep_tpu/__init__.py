@@ -24,6 +24,7 @@ __all__ = [
     "make_example_pair",
     "PreservationResult",
     "combine_analyses",
+    "results_table",
     "SparseAdjacency",
     "sparse_module_preservation",
     "sparse_network_properties",
@@ -61,7 +62,7 @@ def __getattr__(name):
         from .utils.profiling import summarize_trace
 
         return summarize_trace
-    if name in ("PreservationResult", "combine_analyses"):
+    if name in ("PreservationResult", "combine_analyses", "results_table"):
         from .models import results
 
         return getattr(results, name)

@@ -111,6 +111,27 @@ class PreservationResult:
             if np.isfinite(p) and p < thresh
         ]
 
+    def to_frame(self):
+        """Long-format (tidy) table of this pair's results: one row per
+        module × statistic with observed value, p-value, and the overlap
+        bookkeeping — the shape downstream analyses (grouping, filtering,
+        ggplot-style plotting) want, complementing the reference-shaped
+        wide frames (:meth:`observed_frame` / :meth:`p_frame`)."""
+        if pd is None:  # pragma: no cover - pandas is an extra
+            raise ImportError("to_frame requires pandas")
+        k, t = len(self.module_labels), len(STAT_NAMES)
+        return pd.DataFrame({
+            "discovery": self.discovery,
+            "test": self.test,
+            "module": np.repeat(self.module_labels, t),
+            "statistic": list(STAT_NAMES) * k,
+            "observed": self.observed.reshape(-1),
+            "p_value": self.p_values.reshape(-1),
+            "n_vars_present": np.repeat(self.n_vars_present, t),
+            "prop_vars_present": np.repeat(self.prop_vars_present, t),
+            "total_size": np.repeat(self.total_size, t),
+        })
+
     _SAVE_VERSION = 1
 
     def save(self, path: str) -> None:
@@ -337,6 +358,32 @@ def _combine_pair_results(results, allow_duplicate_nulls):
         n_perm=int(sum(r.n_perm for r in results)),
         completed=completed,
         total_space=total_space,
+    )
+
+
+def results_table(results):
+    """One tidy table across every (discovery, test) pair — accepts a single
+    :class:`PreservationResult`, a ``{test: result}`` dict, or the full
+    ``{discovery: {test: result}}`` nesting from ``simplify=False``.
+    Concatenates each pair's :meth:`PreservationResult.to_frame`."""
+    if isinstance(results, PreservationResult):
+        return results.to_frame()
+    if isinstance(results, dict):
+        frames = []
+        for v in results.values():
+            inner = v.values() if isinstance(v, dict) else [v]
+            for r in inner:
+                if not isinstance(r, PreservationResult):
+                    raise TypeError(
+                        f"expected PreservationResult values, got {type(r).__name__}"
+                    )
+                frames.append(r.to_frame())
+        if not frames:
+            raise ValueError("no results to tabulate")
+        return pd.concat(frames, ignore_index=True)
+    raise TypeError(
+        "results_table takes a PreservationResult or the nested dict "
+        f"module_preservation returns, got {type(results).__name__}"
     )
 
 

@@ -219,3 +219,31 @@ def test_preserved_modules_call():
         assert r.preserved_modules(alpha=0.7, adjust="none") == ["a", "b", "d"]
     with pytest.raises(ValueError, match="adjust"):
         r.preserved_modules(adjust="fdr")
+
+
+def test_to_frame_and_results_table(two_runs):
+    pd = pytest.importorskip("pandas")
+    from netrep_tpu import results_table
+
+    a, _ = two_runs
+    f = a.to_frame()
+    assert list(f.columns) == ["discovery", "test", "module", "statistic",
+                               "observed", "p_value", "n_vars_present",
+                               "prop_vars_present", "total_size"]
+    assert len(f) == len(a.module_labels) * 7
+    # a specific cell matches the wide frames
+    row = f[(f.module == a.module_labels[0]) & (f.statistic == "avg.weight")]
+    assert float(row.observed.iloc[0]) == a.observed[0, 0]
+    assert float(row.p_value.iloc[0]) == a.p_values[0, 0]
+
+    # nested dict input concatenates
+    nested = {"disc": {"test": a}}
+    t = results_table(nested)
+    pd.testing.assert_frame_equal(t, f)
+    assert results_table(a).equals(f)
+    with pytest.raises(TypeError):
+        results_table([a])
+    with pytest.raises(TypeError):
+        results_table({"disc": {"test": 42}})
+    with pytest.raises(ValueError, match="no results"):
+        results_table({})
