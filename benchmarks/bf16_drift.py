@@ -1,0 +1,58 @@
+"""Statistic-level bf16-vs-f32 drift at north-star scale (one JSON line).
+
+bf16 matrix storage halves the HBM traffic of the bandwidth-bound gather
+(BASELINE.md roofline); this measures what it costs in accuracy: the same
+64-permutation null at 20k genes / 50 modules under both dtypes, reporting
+the max and RMS statistic-level deviation alongside the null's own
+Monte-Carlo scale (the std of each statistic across permutations). The
+deviation is acceptable when it sits far below the Monte-Carlo scale —
+the criterion BASELINE.md's precision note applies to the mxu gather.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from bench import build_problem, ensure_backend, make_specs  # noqa: E402
+
+
+def main(genes=20_000, modules=50, perms=64, samples=128):
+    devices = ensure_backend()
+    from netrep_tpu.parallel.engine import PermutationEngine
+    from netrep_tpu.utils.config import EngineConfig
+
+    (d_data, d_corr, d_net), (t_data, t_corr, t_net) = build_problem(
+        genes, modules, samples
+    )
+    specs = make_specs(genes, modules, 30, 200)
+    pool = np.arange(genes, dtype=np.int32)
+
+    nulls = {}
+    for dtype in ("float32", "bfloat16"):
+        eng = PermutationEngine(
+            d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool,
+            config=EngineConfig(chunk_size=perms, power_iters=40, dtype=dtype),
+        )
+        arr, done = eng.run_null(perms, key=0)
+        assert done == perms
+        nulls[dtype] = np.asarray(arr)
+
+    diff = nulls["bfloat16"] - nulls["float32"]
+    mc_scale = nulls["float32"].std(axis=0)  # (modules, 7) null spread
+    print(json.dumps({
+        "metric": f"bf16-vs-f32 statistic drift ({genes} genes / {modules} "
+                  f"modules, {perms} perms)",
+        "max_abs_drift": float(np.nanmax(np.abs(diff))),
+        "rms_drift": float(np.sqrt(np.nanmean(diff ** 2))),
+        "median_mc_scale": float(np.nanmedian(mc_scale)),
+        "drift_over_mc": float(
+            np.nanmax(np.abs(diff)) / np.nanmedian(mc_scale)
+        ),
+        "device": str(devices[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()
