@@ -14,7 +14,7 @@ import sys
 
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root, cwd-independent
 from bench import build_problem, ensure_backend, make_specs  # noqa: E402
 
 

@@ -223,6 +223,14 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                profiling):
     """Pair-loop body of :func:`module_preservation` (split out so the
     profiler trace context can bracket it without deep nesting)."""
+
+    def pair_progress():
+        # verbose=True with no user callback gets the reference-style
+        # textual progress bar, fresh per pair so rate/ETA restart
+        from ..utils.progress import resolve_progress
+
+        return resolve_progress(progress, verbose)
+
     interrupted = False
     for d_name, t_names in by_disc.items():
         if interrupted:
@@ -275,7 +283,8 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
             )
             nulls, completed = engine.run_null(
                 np_this, key=seed,
-                progress=timer.wrap_progress(progress) if timer else progress,
+                progress=(timer.wrap_progress(pair_progress())
+                          if timer else pair_progress()),
                 checkpoint_path=ckpt_path(d_name, "+".join(t_names)),
                 checkpoint_every=checkpoint_every,
             )
@@ -320,7 +329,8 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
             )
             nulls, completed = engine.run_null(
                 np_this, key=seed,
-                progress=timer.wrap_progress(progress) if timer else progress,
+                progress=(timer.wrap_progress(pair_progress())
+                          if timer else pair_progress()),
                 checkpoint_path=ckpt_path(d_name, t_name),
                 checkpoint_every=checkpoint_every,
             )

@@ -118,6 +118,7 @@ def sparse_module_preservation(
     seed: int = 0,
     config: EngineConfig | None = None,
     mesh=None,
+    verbose: bool = False,
     progress: Callable[[int, int], None] | None = None,
     checkpoint_path: str | None = None,
     checkpoint_every: int = 8192,
@@ -229,6 +230,14 @@ def sparse_module_preservation(
         specs, pool, config=config or EngineConfig(), mesh=mesh,
         disc_corr=discovery_correlation, test_corr=test_correlation,
     )
+    if verbose:
+        logger.info(
+            "sparse %r → %r: %d modules, %d permutations",
+            discovery, test, len(labels), n_perm,
+        )
+    from ..utils.progress import resolve_progress
+
+    progress = resolve_progress(progress, verbose)
     observed = engine.observed()
     nulls, completed = engine.run_null(
         n_perm, key=seed, progress=progress,

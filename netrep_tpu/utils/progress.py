@@ -1,0 +1,83 @@
+"""Textual progress for the permutation null — the rebuild of the
+reference's C++-main-thread progress bar (SURVEY.md §5 "Metrics / logging":
+``verbose=TRUE`` prints stage messages and a textual progress bar). The
+engine's chunked host loop already reports ``(done, total)`` per chunk; this
+renders it: a carriage-return bar on TTYs, throttled ~2 updates/s, and
+plain log-style lines every ~10% on non-interactive streams so CI logs
+stay readable.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Callable
+
+
+def resolve_progress(
+    progress: Callable[[int, int], None] | None, verbose: bool
+) -> Callable[[int, int], None] | None:
+    """The one rule for both API surfaces (dense and sparse): a user
+    callback wins; otherwise ``verbose=True`` gets the default printer."""
+    if progress is not None:
+        return progress
+    return make_progress_printer() if verbose else None
+
+
+def make_progress_printer(
+    stream=None,
+    min_interval: float = 0.5,
+    bar_width: int = 28,
+    _clock: Callable[[], float] = time.monotonic,
+) -> Callable[[int, int], None]:
+    """Build a ``(done, total)`` callback rendering permutation progress.
+
+    One printer per (discovery, test) pair. Rate and ETA are measured from
+    the first callback onward — ``(done - done0) / elapsed`` — so the
+    first chunk's compile time and any checkpoint-resumed permutations from
+    a previous session don't inflate the rate; the very first line shows no
+    rate (nothing has been measured yet).
+    """
+    if stream is None:
+        stream = sys.stderr
+    tty = bool(getattr(stream, "isatty", lambda: False)())
+    state = {"t0": None, "done0": 0, "last": float("-inf"), "last_frac": -1.0}
+
+    def cb(done: int, total: int) -> None:
+        now = _clock()
+        first = state["t0"] is None
+        if first:
+            state["t0"], state["done0"] = now, done
+        finished = done >= total
+        if tty:
+            if not finished and not first and now - state["last"] < min_interval:
+                return
+        else:
+            # non-interactive: a line per ~10% step (and the final line)
+            frac_step = int(10 * done / total) if total else 10
+            if not finished and frac_step <= state["last_frac"]:
+                return
+            state["last_frac"] = frac_step
+        state["last"] = now
+        elapsed = now - state["t0"]
+        measured = done - state["done0"]
+        rate = measured / elapsed if elapsed > 0 and measured > 0 else None
+        eta = (total - done) / rate if rate else float("inf")
+        frac = done / total if total else 1.0
+        rate_s = f"{rate:8.1f}/s" if rate else " " * 8 + "-/s"
+        if tty:
+            filled = int(bar_width * frac)
+            bar = "=" * filled + " " * (bar_width - filled)
+            end = "\n" if finished else ""
+            stream.write(
+                f"\r[{bar}] {done}/{total} perms "
+                f"({100 * frac:5.1f}%) {rate_s} ETA {eta:6.1f}s{end}"
+            )
+        else:
+            stream.write(
+                f"permutations: {done}/{total} ({100 * frac:.0f}%), "
+                f"{rate_s.strip()}, ETA {eta:.0f}s\n"
+            )
+        stream.flush()
+
+    return cb
