@@ -323,8 +323,14 @@ class PermutationEngine:
             # the LOCAL permutation axis (mirrors the replicated path's
             # lax.map batching; the mxu row buffers are (K·cap, n) per perm)
             local_chunk = self.effective_chunk() // mesh.shape[config.mesh_axis]
+            ref_mat = test_corr if test_corr is not None else disc_corr
             self._gather_perm_batch = config.resolved_perm_batch(
-                self.gather_mode, jax.default_backend(), max(local_chunk, 1)
+                self.gather_mode, jax.default_backend(), max(local_chunk, 1),
+                bytes_per_perm=self._mxu_bytes_per_perm(
+                    int(np.asarray(ref_mat).shape[-1]),
+                    None if test_data is None
+                    else int(np.asarray(test_data).shape[0]),
+                ),
             )
         if discovery_only:
             self._test_corr = self._test_net = None
@@ -569,6 +575,21 @@ class PermutationEngine:
     # Null chunks
     # ------------------------------------------------------------------
 
+    def _mxu_bytes_per_perm(self, n_cols: int, n_samples: int | None) -> int:
+        """Per-permutation working set of the mxu gather: the (Σ cap, n) row
+        blocks for each stored matrix (one when the network derives from the
+        correlation) plus the (Σ cap, s) data blocks. Sizes the lax.map
+        batch against ``EngineConfig.mxu_batch_budget_bytes`` — a fixed
+        small batch leaves small problems latency-bound, an unbounded one
+        OOMs at genome scale."""
+        itemsize = jnp.dtype(self.config.dtype).itemsize
+        cap_rows = sum(self.config.rounded_cap(m.size) for m in self.modules)
+        n_mats = 1 if self.net_beta is not None else 2
+        total = cap_rows * n_cols * itemsize * n_mats
+        if n_samples:
+            total += cap_rows * n_samples * itemsize
+        return total
+
     def chunk_args(self) -> tuple:
         """Device operands of the chunk program. Passed to the jitted chunk
         as ARGUMENTS, never captured in its closure: closure-captured device
@@ -600,7 +621,12 @@ class PermutationEngine:
             from .sharded import gather_corr_net as _gcn
         gather_mode = self.gather_mode
         perm_batch = cfg.resolved_perm_batch(
-            gather_mode, jax.default_backend(), self.effective_chunk()
+            gather_mode, jax.default_backend(), self.effective_chunk(),
+            bytes_per_perm=self._mxu_bytes_per_perm(
+                int(self._test_corr.shape[-1]),
+                None if self._test_dataT is None
+                else int(self._test_dataT.shape[-1]),
+            ),
         )
         net_beta = self.net_beta
         kernel = partial(

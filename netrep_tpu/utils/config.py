@@ -70,9 +70,17 @@ class EngineConfig:
         dispatch (``lax.map`` batch size), bounding the per-dispatch working
         set in HBM; the chunk itself stays one dispatch, so host round-trips
         are unaffected. None (default) resolves per gather mode: the mxu
-        path's (batch, Σ K_b·cap_b, n) row blocks cap it at 2; the direct
+        path sizes the batch so its (batch, Σ K_b·cap_b, n) gathered row
+        blocks stay within ``mxu_batch_budget_bytes`` (≈2 at north-star
+        shapes — the hand-tuned round-2 value — but much larger on smaller
+        problems like Config B, whose per-permutation working set is tiny
+        and which a fixed batch of 2 leaves latency-bound); the direct
         path's working set is just the (batch, K, cap, cap) submatrices, so
         it runs 64 at a time on accelerators and whole-chunk on CPU.
+    mxu_batch_budget_bytes : HBM budget for the mxu gather's row-block
+        intermediates used when ``perm_batch`` is None (default 2 GiB —
+        reproduces the hand-tuned batch of 2 at north-star shapes and sits
+        comfortably inside a 16 GiB HBM next to the stored matrices).
     """
 
     chunk_size: int = 128
@@ -85,6 +93,7 @@ class EngineConfig:
     gather_mode: str = "auto"
     perm_batch: int | None = None
     network_from_correlation: float | None = None
+    mxu_batch_budget_bytes: int = 2 << 30
 
     def resolved_gather_mode(self, platform: str) -> str:
         if self.gather_mode == "auto":
@@ -98,10 +107,22 @@ class EngineConfig:
             )
         return self.gather_mode
 
-    def resolved_perm_batch(self, gather_mode: str, platform: str, chunk: int) -> int:
+    def resolved_perm_batch(
+        self,
+        gather_mode: str,
+        platform: str,
+        chunk: int,
+        bytes_per_perm: int | None = None,
+    ) -> int:
+        """``bytes_per_perm`` is the mxu path's gathered-row working set for
+        ONE permutation (Σ K_b·cap_b × n × itemsize × matrices); when the
+        engine supplies it, the batch fills ``mxu_batch_budget_bytes``."""
         if self.perm_batch is not None:
             return max(1, min(self.perm_batch, chunk))
         if gather_mode == "mxu":
+            if bytes_per_perm and bytes_per_perm > 0:
+                fit = int(self.mxu_batch_budget_bytes // bytes_per_perm)
+                return max(1, min(fit, 64, chunk))
             return min(2, chunk)
         return chunk if platform == "cpu" else min(64, chunk)
 
