@@ -250,3 +250,27 @@ def test_bfloat16_storage_tracks_float32(setup):
     # same permutation draws (keys are dtype-independent), bf16-rounded stats
     np.testing.assert_allclose(nb, nf, atol=5e-2)
     assert np.isfinite(nb).all()
+
+
+def test_bfloat16_composes_with_derived_network(setup):
+    """bf16 storage × derived network (|corr|**β): the two HBM-traffic
+    levers used together — network submatrices derive from bf16-gathered
+    correlations, statistics still track the f32 stored-network run."""
+    d, t, modules, pool = setup
+    t_net = np.abs(t["correlation"]) ** 2
+    d_net = np.abs(d["correlation"]) ** 2
+    kw = dict(chunk_size=16, summary_method="eigh")
+    ref = PermutationEngine(
+        d["correlation"], d_net, d["data"], t["correlation"], t_net, t["data"],
+        modules, pool, config=EngineConfig(**kw, dtype="float32"),
+    )
+    combo = PermutationEngine(
+        d["correlation"], d_net, d["data"], t["correlation"], t_net, t["data"],
+        modules, pool,
+        config=EngineConfig(**kw, dtype="bfloat16",
+                            network_from_correlation=2.0),
+    )
+    nf, _ = ref.run_null(10, key=1)
+    nc, _ = combo.run_null(10, key=1)
+    np.testing.assert_allclose(nc, nf, atol=5e-2)
+    assert np.isfinite(nc).all()
