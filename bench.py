@@ -51,9 +51,10 @@ def ensure_backend(probe_timeout: float = 120.0):
     tunnel produces a fast, explicit error line instead of an opaque hang;
     registration errors still fall back to automatic backend selection."""
     import os
-    import subprocess
 
     import jax
+
+    from netrep_tpu.utils.backend import probe_default_backend, tunnel_expected
 
     want = os.environ.get("JAX_PLATFORMS", "")
     if want and "axon" not in want:
@@ -70,16 +71,11 @@ def ensure_backend(probe_timeout: float = 120.0):
             # down — the very hang this function exists to prevent)
             jax.config.update("jax_platforms", "cpu")
             return jax.devices()
-    if "axon" in want or os.path.exists("/root/.axon_site"):
-        try:
-            # only a TIMEOUT means the tunnel is hung-dead; a fast nonzero
-            # exit (e.g. plugin registration RuntimeError) falls through to
-            # the auto-backend fallback below, as before
-            subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=probe_timeout, capture_output=True,
-            )
-        except subprocess.TimeoutExpired:
+    if tunnel_expected():
+        # only a TIMEOUT means the tunnel is hung-dead; a fast "error" probe
+        # (e.g. plugin registration RuntimeError) falls through to the
+        # auto-backend fallback below, as before
+        if probe_default_backend(probe_timeout) == "timeout":
             print(json.dumps({
                 "metric": "backend probe",
                 "error": (

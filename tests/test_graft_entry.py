@@ -1,0 +1,85 @@
+"""Driver-entry regression tests (VERDICT r2 item 1): both
+``__graft_entry__`` functions must complete on a tunnel-less machine —
+round 2's MULTICHIP artifact went red because ``dryrun_multichip`` dialed
+the axon TPU plugin (which hangs, not errors, when the tunnel is down) for
+a dryrun that needs zero TPU devices.
+
+Each entry runs in a subprocess with the driver's hostile environment
+(``JAX_PLATFORMS=axon``) reproduced, under a hard wall budget. A hang here
+is exactly the round-2 failure mode; the subprocess kill turns it into a
+test failure instead of a CI freeze.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The driver pins JAX_PLATFORMS=axon. Reproduce that; the entries must
+# neutralize it themselves (the point of the test). Drop the conftest's
+# cpu forcing for the child. The hang this guards against only reproduces
+# where the axon plugin actually registers (sitecustomize requires
+# /root/.axon_site on PYTHONPATH); pin that explicitly so the test doesn't
+# silently degrade to a plain budget check on machines that happen to have
+# the site but not the PYTHONPATH entry. Where the site is absent entirely,
+# the tests still assert the entries complete within budget.
+_AXON_SITE = "/root/.axon_site"
+_DRIVER_ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "axon",
+    "JAX_NUM_CPU_DEVICES": "8",
+}
+if os.path.isdir(_AXON_SITE) and _AXON_SITE not in _DRIVER_ENV.get("PYTHONPATH", ""):
+    _DRIVER_ENV["PYTHONPATH"] = (
+        _AXON_SITE + os.pathsep + _DRIVER_ENV.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+
+
+def _run(code: str, timeout: float) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO,
+        env=_DRIVER_ENV,
+        timeout=timeout,
+        capture_output=True,
+        text=True,
+    )
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_completes_within_budget():
+    # 120 s wall budget per VERDICT r2 "Next round" item 1. The verified
+    # fixed runtime is ~8 s; the budget absorbs cold XLA compiles.
+    try:
+        proc = _run(
+            "import __graft_entry__ as g; g.dryrun_multichip(8); print('OK')",
+            timeout=120,
+        )
+    except subprocess.TimeoutExpired as e:
+        pytest.fail(
+            f"dryrun_multichip(8) exceeded the 120 s wall budget (the "
+            f"round-2 rc=124 hang): {e}"
+        )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "OK" in proc.stdout
+
+
+@pytest.mark.slow
+def test_entry_compiles_within_budget():
+    code = (
+        "import __graft_entry__ as g\n"
+        "import jax\n"
+        "fn, args = g.entry()\n"
+        "out = jax.jit(fn)(*args)\n"
+        "jax.block_until_ready(out)\n"
+        "print('OK')\n"
+    )
+    try:
+        proc = _run(code, timeout=240)
+    except subprocess.TimeoutExpired as e:
+        pytest.fail(f"entry() compile check hung past its wall budget: {e}")
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "OK" in proc.stdout
