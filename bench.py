@@ -50,27 +50,18 @@ def ensure_backend(probe_timeout: float = 120.0):
     when the tunnel is down. Probe in a killable subprocess first so a dead
     tunnel produces a fast, explicit error line instead of an opaque hang;
     registration errors still fall back to automatic backend selection."""
-    import os
-
     import jax
 
-    from netrep_tpu.utils.backend import probe_default_backend, tunnel_expected
+    from netrep_tpu.utils.backend import (
+        honor_explicit_platform, probe_default_backend, tunnel_expected,
+    )
 
-    want = os.environ.get("JAX_PLATFORMS", "")
-    if want and "axon" not in want:
-        # An explicit non-TPU platform (e.g. JAX_PLATFORMS=cpu): the axon
-        # plugin's get_backend hook still dials the tunnel first — the env
-        # var alone does NOT stop it — so force the platform via jax.config
-        # before any device call.
-        jax.config.update("jax_platforms", want)
-        try:
-            return jax.devices()
-        except RuntimeError:
-            # requested platform unavailable → CPU (NOT automatic selection,
-            # which would dial the axon plugin and hang when the tunnel is
-            # down — the very hang this function exists to prevent)
-            jax.config.update("jax_platforms", "cpu")
-            return jax.devices()
+    # An explicit non-TPU platform (e.g. JAX_PLATFORMS=cpu) is honored via
+    # the live config — the env var alone does NOT stop the axon plugin's
+    # get_backend hook from dialing the tunnel.
+    devs = honor_explicit_platform()
+    if devs is not None:
+        return devs
     if tunnel_expected():
         # only a TIMEOUT means the tunnel is hung-dead; a fast "error" probe
         # (e.g. plugin registration RuntimeError) falls through to the

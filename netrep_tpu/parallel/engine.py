@@ -612,6 +612,13 @@ class PermutationEngine:
         ``chunk(keys, *chunk_args) -> [per-bucket (C, K_b, 7) arrays]``
         with ``chunk_args`` as produced by :meth:`chunk_args` (used by
         ``__graft_entry__.entry``)."""
+        if self.discovery_only:
+            # explicit contract error; without it the _test_corr.shape deref
+            # below surfaces as an opaque AttributeError on None
+            raise RuntimeError(
+                "engine was built discovery_only and has no test matrices; "
+                "the wrapping engine owns the chunk program"
+            )
         cfg = self.config
         # only static structure may be closed over (see chunk_args)
         caps_slices = [(b.cap, tuple(b.slices)) for b in self.buckets]

@@ -3,8 +3,15 @@
 sequentially in R; on TPU, when several test cohorts share one node universe
 (the common consortium design: same genes measured in every cohort), the
 engine vmaps the whole permutation kernel over a stacked (T, n, n) test-matrix
-axis — one compiled program, T× the arithmetic intensity per gather of the
-shared permutation index batch.
+axis — one compiled program sharing one permutation index batch across all T
+cohorts.
+
+What that buys, measured (BASELINE.md Config C row): code-path parity with
+multi-device meshes and one compile instead of T, NOT single-chip speedup at
+genome scale — at 5k genes one cohort already saturates the chip (vmapped
+1.03× vs sequential on TPU v5e). The vmap stacking wins where each cohort
+under-fills the device (small n: 1.27× at toy scale on CPU) or where the T
+axis maps onto a mesh axis.
 
 Statistical note: the same permutation node-sets are reused across the T test
 datasets within one run. Nulls remain valid per pair (each dataset's matrices

@@ -34,6 +34,25 @@ def tunnel_expected() -> bool:
     return "axon" in want or (not want and os.path.exists("/root/.axon_site"))
 
 
+def honor_explicit_platform():
+    """If ``JAX_PLATFORMS`` names an explicit non-axon platform, force it via
+    the live config (rule 1 above) and return its devices, falling back to
+    CPU when that platform is unavailable — never automatic selection, which
+    would dial the axon plugin. Returns ``None`` when no explicit non-axon
+    platform is set (callers continue with their own tunnel policy)."""
+    import jax
+
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if not want or "axon" in want:
+        return None
+    jax.config.update("jax_platforms", want)
+    try:
+        return jax.devices()
+    except RuntimeError:
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()
+
+
 def probe_default_backend(timeout: float) -> str:
     """Probe ``jax.devices()`` in a killable subprocess.
 
@@ -50,21 +69,21 @@ def probe_default_backend(timeout: float) -> str:
     return "ok" if rc == 0 else "error"
 
 
-def resolve_backend_or_cpu(probe_timeout: float = 90.0) -> None:
+def resolve_backend_or_cpu(probe_timeout: float | None = None) -> None:
     """Make the next ``jax.devices()`` call hang-safe: honor an explicit
     non-TPU platform, keep a probed-live tunnel, and force the CPU platform
     (live config, per rule 1 above) in every case that cannot be proven
     responsive. Used by ``__graft_entry__`` — the driver's compile-check
-    entries must complete regardless of tunnel state."""
+    entries must complete regardless of tunnel state. The probe budget is
+    overridable via ``NETREP_BACKEND_PROBE_TIMEOUT`` (CI shortens it; the
+    driver keeps the default)."""
     import jax
 
-    want = os.environ.get("JAX_PLATFORMS", "")
-    if want and "axon" not in want:
-        jax.config.update("jax_platforms", want)
-        try:
-            jax.devices()
-        except RuntimeError:
-            jax.config.update("jax_platforms", "cpu")
+    if probe_timeout is None:
+        probe_timeout = float(
+            os.environ.get("NETREP_BACKEND_PROBE_TIMEOUT", "90")
+        )
+    if honor_explicit_platform() is not None:
         return
     if tunnel_expected() and probe_default_backend(probe_timeout) != "ok":
         jax.config.update("jax_platforms", "cpu")
