@@ -162,7 +162,7 @@ def bench_north(args, label=None):
     pool = np.arange(args.genes, dtype=np.int32)
     cfg = EngineConfig(
         chunk_size=args.chunk, summary_method="power", power_iters=40,
-        dtype=args.dtype,
+        dtype=args.dtype, gather_mode=args.gather_mode,
         # the bench problem's network IS |corr|**2 by construction, so
         # derived mode computes the identical statistics while halving the
         # gather traffic (the roofline bottleneck, BASELINE.md)
@@ -188,6 +188,7 @@ def bench_north(args, label=None):
         "device": str(jax.devices()[0]),
         "dtype": args.dtype,
         "chunk": args.chunk,
+        "gather_mode": engine.gather_mode,  # resolved, not the 'auto' alias
     })
 
 
@@ -377,7 +378,7 @@ def bench_d(args):
     specs = make_specs(args.genes, args.modules, lo, hi)
     pool = np.arange(args.genes, dtype=np.int32)
     cfg = EngineConfig(
-        chunk_size=args.chunk, power_iters=40,
+        chunk_size=args.chunk, power_iters=40, gather_mode=args.gather_mode,
         network_from_correlation=2.0 if args.derived_net else None,
     )
     engine = PermutationEngine(
@@ -494,6 +495,9 @@ def main():
     ap.add_argument("--chunk", type=int, default=256)
     ap.add_argument("--samples", type=int, default=128)
     ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--gather-mode", default="auto",
+                    choices=["auto", "direct", "mxu", "fused"],
+                    help="EngineConfig.gather_mode for north/B/D configs")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config for a fast correctness pass")
     ap.add_argument("--derived-net", action="store_true",

@@ -12,6 +12,7 @@ Usage: python benchmarks/microbench_parts.py [--cap C] [--K K] [--batch B]
 from __future__ import annotations
 
 import argparse
+import sys
 import time
 
 import numpy as np
@@ -19,8 +20,17 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
 
 def ensure_backend():
+    # honor JAX_PLATFORMS=cpu etc. via the live config (the env var alone
+    # does not stop the axon plugin's dial — it HANGS on a dead tunnel)
+    from netrep_tpu.utils.backend import honor_explicit_platform
+
+    devs = honor_explicit_platform()
+    if devs is not None:
+        return devs
     try:
         return jax.devices()
     except RuntimeError:
@@ -116,6 +126,29 @@ def main():
     f = jax.jit(lambda Mx, ix: fused(Mx, ix, "default"))
     t = bench(f, M16, idx, reps=args.reps)
     print(f"fused gather+colsel bf16:    {t*1e3:8.2f} ms  ({FL/t/1e12:6.1f} TFLOP/s)")
+
+    # bf16 take row: is XLA's gather byte-limited (bf16 ≈ 2× f32 GB/s-
+    # equivalent) or row-descriptor-limited (no gain)? Decides whether bf16
+    # storage alone buys the roofline factor. Independent of Pallas.
+    t = bench(rowg, M16, idx, reps=args.reps)
+    print(f"row gather bf16:             {t*1e3:8.2f} ms  "
+          f"({B*K*cap*n*2/t/1e9:6.1f} GB/s)")
+
+    # fused Pallas kernel (ops/fused_gather): per-row DMA + in-VMEM one-hot
+    # select — ONE HBM pass over the row set vs the take+matmul passes above.
+    # The decision row for flipping gather_mode auto to 'fused' on TPU.
+    try:
+        from netrep_tpu.ops.fused_gather import gather_submatrix_fused
+
+        idx_flat = idx.reshape(B * K, cap)
+        for name, Mx in [("f32", M), ("bf16", M16)]:  # M16 defined above
+            f = jax.jit(lambda Mm, ix: gather_submatrix_fused(Mm, ix))
+            t = bench(f, Mx, idx_flat, reps=args.reps)
+            nb = B * K * cap * n * Mx.dtype.itemsize
+            print(f"pallas fused gather {name}:    {t*1e3:8.2f} ms  "
+                  f"({nb/t/1e9:6.1f} GB/s rows, {FL/t/1e12:5.1f} TFLOP/s eq)")
+    except Exception as e:  # pallas unavailable on this backend
+        print(f"pallas fused gather: SKIPPED ({type(e).__name__}: {e})")
 
     # correctness check of selection variants vs true gather
     sub_true = np.asarray(M)[np.asarray(idx)[0, 0][:, None], np.asarray(idx)[0, 0][None, :]]

@@ -351,7 +351,8 @@ def _combine_pair_results(results, allow_duplicate_nulls):
                 # chance collisions rather than rejecting on the first match.
                 expected = 0.0
                 threshold = 0.05 * min(s for s in sizes if s) + 0.5
-            if cross_pairs > threshold and cross_pairs == 1 and min(sizes) > 1:
+            if (cross_pairs > threshold and cross_pairs == 1
+                    and min(s for s in sizes if s) > 1):
                 # A single colliding pair in a large space is far more often
                 # one legitimate chance collision than a duplicated seed (a
                 # duplicated seed replicates the whole smaller block): warn,

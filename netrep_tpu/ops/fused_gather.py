@@ -1,0 +1,172 @@
+"""Fused submatrix gather: one-HBM-pass Pallas/Mosaic kernel for the hot
+loop's access pattern ``M[idx[:, None], idx[None, :]]`` (SURVEY.md §7
+"Gather bandwidth"; the reference's per-permutation Armadillo submatrix
+slice, SURVEY.md §3.1).
+
+Why a kernel (BASELINE.md roofline, round-2 measurements): the XLA path
+(:func:`netrep_tpu.ops.stats.gather_submatrix_mxu`) materializes the
+``(cap, n)`` gathered row block in HBM at ~200-300 GB/s, materializes the
+``(n, cap)`` one-hot, then re-reads both for the column-select matmul —
+several HBM passes over a block that is used exactly once, on a loop that is
+bandwidth-bound. This kernel instead:
+
+1. DMAs each needed row of ``M`` directly HBM→VMEM (one 4·n-byte contiguous
+   copy per row — row order is irrelevant to per-row DMAs, so the argsort /
+   unsort-permutation machinery of the mxu path disappears entirely);
+2. generates one-hot tiles on the fly in VMEM and accumulates the
+   column-select ``rows @ onehot`` on the MXU, tile by tile;
+3. writes only the ``(cap, cap)`` selected submatrix back to HBM.
+
+Total HBM traffic: ``cap·n`` read + ``cap²`` written — the algorithm's
+ideal for a row-fetch design — versus ~3-5 passes of ``cap·n`` for the XLA
+path. Selection values carry the same rounding as the mxu path (the one-hot
+matmul runs at the dtype's native MXU precision: exact 0/1 selection in
+exact arithmetic; bf16 operand truncation for f32 inputs on TPU — see
+BASELINE.md §precision).
+
+CPU/testing: ``interpret=True`` runs the kernel in the Pallas interpreter —
+used by the parity tests; the engine only selects this path on TPU-like
+backends.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Column tile of the in-VMEM one-hot select matmul. 512 lanes keeps the
+# (rows, tile) @ (tile, cap) matmuls MXU-shaped while bounding the one-hot
+# value to tile·cap·4 B.
+_COL_TILE = 512
+# Max rows DMA'd/resident per grid step: bounds the VMEM rows buffer to
+# 128·(n rounded to tile)·itemsize (10.5 MB at n=20k f32).
+_ROW_BLOCK = 128
+
+
+def _kernel(idx_smem, M_ref, idx_ref, out_ref, rows_buf, sems, *,
+            n: int, rb: int, n_tiles: int):
+    """One grid step: DMA ``rb`` rows of ``M`` (indices from the scalar-
+    prefetched ``idx_smem``), then column-select against the full ``cap``
+    index set of this instance.
+
+    Refs: idx_smem (G, R) SMEM int32 (R = padded row count); M_ref (n, n)
+    HBM; idx_ref (1, cap) VMEM int32 (this instance's column indices);
+    out_ref (1, rb, cap) VMEM; rows_buf (rb, n_tiles·tile) VMEM scratch;
+    sems (rb,) DMA semaphores.
+    """
+    g = pl.program_id(0)
+    r = pl.program_id(1)
+
+    def row_copy(a):
+        # padded slots carry the sentinel n: clamp to a junk row (masked
+        # downstream), mirroring the mxu path's mode="clip"
+        src = jnp.clip(idx_smem[g, r * rb + a], 0, n - 1)
+        return pltpu.make_async_copy(
+            M_ref.at[pl.ds(src, 1), :],
+            rows_buf.at[pl.ds(a, 1), pl.ds(0, n)],
+            sems.at[a],
+        )
+
+    def start(a, _):
+        row_copy(a).start()
+        return _
+
+    def wait(a, _):
+        row_copy(a).wait()
+        return _
+
+    jax.lax.fori_loop(0, rb, start, None, unroll=8)
+    jax.lax.fori_loop(0, rb, wait, None, unroll=8)
+
+    cols = idx_ref[0, :]  # (cap,) int32
+    acc = jnp.zeros((rb, cols.shape[0]), jnp.float32)
+    for t in range(n_tiles):
+        c0 = t * _COL_TILE
+        tile = rows_buf[:, c0: c0 + _COL_TILE]
+        if (t + 1) * _COL_TILE > n:
+            # final tile spills past n: the buffer tail is uninitialized
+            # VMEM — zero it so 0·garbage (potential NaN) cannot reach the
+            # accumulator through the dot
+            in_range = (
+                c0 + jax.lax.broadcasted_iota(jnp.int32, tile.shape, 1) < n
+            )
+            tile = jnp.where(in_range, tile, 0)
+        col_ids = c0 + jax.lax.broadcasted_iota(
+            jnp.int32, (_COL_TILE, cols.shape[0]), 0
+        )
+        onehot = (col_ids == cols[None, :]).astype(tile.dtype)
+        acc += jax.lax.dot(
+            tile, onehot, preferred_element_type=jnp.float32
+        )
+    out_ref[0] = acc.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _run(M, idx, *, interpret: bool):
+    n = M.shape[-1]
+    G, cap = idx.shape
+    rb = min(cap, _ROW_BLOCK)
+    n_row_blocks = -(-cap // rb)
+    rpad = n_row_blocks * rb
+    if rpad != cap:
+        # pad the ROW axis so every grid step owns exactly rb rows; padded
+        # slots use the sentinel n (junk row, masked downstream)
+        idx_rows = jnp.concatenate(
+            [idx, jnp.full((G, rpad - cap), n, jnp.int32)], axis=1
+        )
+    else:
+        idx_rows = idx
+    n_tiles = -(-n // _COL_TILE)
+
+    kernel = functools.partial(
+        _kernel, n=n, rb=rb, n_tiles=n_tiles
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(G, n_row_blocks),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),          # M stays in HBM
+            pl.BlockSpec((1, cap), lambda g, r, *_: (g, 0)),  # column idx
+        ],
+        out_specs=pl.BlockSpec((1, rb, cap), lambda g, r, *_: (g, r, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rb, n_tiles * _COL_TILE), M.dtype),
+            pltpu.SemaphoreType.DMA((rb,)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((G, rpad, cap), jnp.float32),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * G * rpad * n_tiles * _COL_TILE * cap,
+            bytes_accessed=G * cap * n * M.dtype.itemsize + G * rpad * cap * 4,
+            transcendentals=0,
+        ),
+    )(idx_rows, M, idx)
+    return out[:, :cap, :] if rpad != cap else out
+
+
+def gather_submatrix_fused(
+    M: jnp.ndarray,     # (n, n)
+    idx: jnp.ndarray,   # (..., cap) int32; sentinel n at padded slots
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Batched fused submatrix gather: ``out[..., a, b] = M[idx[..., a],
+    idx[..., b]]`` with sentinel slots clamped on the row side and
+    yielding zero columns. Returns f32 ``(..., cap, cap)``.
+
+    ``idx`` needs NO sort: per-row DMA cost is order-independent, unlike the
+    mxu path's XLA gather (which needs ascending rows for DMA locality).
+    """
+    batch = idx.shape[:-1]
+    cap = idx.shape[-1]
+    flat = idx.reshape(-1, cap).astype(jnp.int32)
+    out = _run(M, flat, interpret=interpret)
+    return out.reshape(*batch, cap, cap)

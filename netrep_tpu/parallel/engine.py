@@ -292,6 +292,13 @@ class PermutationEngine:
         self.row_sharded = (
             mesh is not None and config.matrix_sharding == "row"
         )
+        if config.gather_mode == "fused" and (
+            mesh is not None or config.matrix_sharding == "row"
+        ):
+            raise ValueError(
+                "gather_mode='fused' currently supports replicated matrices "
+                "without a mesh; use 'mxu' for sharded/mesh runs"
+            )
         if config.matrix_sharding not in ("replicated", "row"):
             raise ValueError(
                 f"matrix_sharding must be 'replicated' or 'row', got "
@@ -672,6 +679,67 @@ class PermutationEngine:
                         )
                     )
                 return outs
+
+            if gather_mode == "fused":
+                # Fused-kernel path: scan over perm sub-batches; each batch
+                # flattens (B, K) instances into the Pallas kernel's grid
+                # (ops/fused_gather.py — one HBM pass per row set, one-hot
+                # select in VMEM). Structure mirrors the row-sharded branch:
+                # batched indices, broadcast-batched statistics.
+                from ..ops.fused_gather import gather_submatrix_fused as _gsf
+
+                # Pallas/Mosaic compiles on TPU-like backends; CPU (CI) runs
+                # the interpreter so the fused path stays testable everywhere
+                gather_submatrix_fused = partial(
+                    _gsf, interpret=jax.default_backend() == "cpu"
+                )
+                C = keys.shape[0]
+                B = min(perm_batch, C)
+                # pad the key array up to a whole number of batches (padded
+                # permutations are computed and discarded) — a divisor
+                # search instead would collapse prime chunk sizes to B=1,
+                # a ~B× slowdown on residual chunks
+                Cp = -(-C // B) * B
+
+                def batch_body(_, keys_b):
+                    perm = jax.vmap(
+                        lambda k: jax.random.permutation(k, pool)
+                    )(keys_b)
+                    outs_b = []
+                    for (cap, slices), disc in zip(caps_slices, discs):
+                        cols = []
+                        for off, size in slices:
+                            idxp = perm[:, off: off + size]
+                            cols.append(
+                                jnp.pad(idxp, ((0, 0), (0, cap - size)))
+                            )
+                        idx_b = jnp.stack(cols, axis=1)  # (B, K, cap)
+                        sub_c = gather_submatrix_fused(tc, idx_b)
+                        sub_n = (
+                            jstats.derived_net(sub_c, net_beta)
+                            if tn is None
+                            else gather_submatrix_fused(tn, idx_b)
+                        )
+                        zd = (
+                            jstats.gather_zdata(td, idx_b, disc.mask)
+                            if td is not None else None
+                        )
+                        outs_b.append(jstats.module_stats_masked(
+                            disc, sub_c, sub_n, zd,
+                            n_iter=cfg.power_iters,
+                            summary_method=cfg.summary_method,
+                        ))
+                    return None, outs_b
+
+                kp = (
+                    jnp.concatenate([keys, keys[-1:].repeat(Cp - C, axis=0)])
+                    if Cp != C else keys
+                )
+                _, outs = jax.lax.scan(
+                    batch_body, None, kp.reshape(Cp // B, B)
+                )
+                # (Cp//B, B, K, 7) -> (C, K, 7) per bucket (drop pad tail)
+                return [o.reshape((-1,) + o.shape[2:])[:C] for o in outs]
 
             # Replicated path: sequence permutations with lax.map (one device
             # dispatch; batch_size bounds the mxu path's (batch, rows, n)

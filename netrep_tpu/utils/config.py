@@ -51,8 +51,12 @@ class EngineConfig:
         (sorted row gather + one-hot column-select matmuls,
         :func:`netrep_tpu.ops.stats.gather_and_stats_mxu` — the TPU winner:
         XLA materializes the gathered row blocks at ~200-300 GB/s and the
-        selection rides the MXU), or 'auto' (mxu on TPU-like accelerators,
-        direct on CPU). Value fidelity on the mxu path: XLA's
+        selection rides the MXU), 'fused' (Pallas one-pass kernel: per-row
+        HBM→VMEM DMA + on-chip one-hot column select, no materialized row
+        block or sort machinery — :mod:`netrep_tpu.ops.fused_gather`;
+        replicated matrices only, opt-in until TPU-measured), or 'auto'
+        (mxu on TPU-like accelerators, direct on CPU). Value fidelity on
+        the mxu and fused paths: XLA's
         default-precision f32 matmul truncates operands to bfloat16, so
         gathered VALUES carry up to ~4e-3 relative rounding on TPU
         (statistics attenuate this ~1/m; see ``BASELINE.md`` §precision).
@@ -98,12 +102,15 @@ class EngineConfig:
     def resolved_gather_mode(self, platform: str) -> str:
         if self.gather_mode == "auto":
             # accelerators (tpu / the axon tunnel backend) get the
-            # sorted-rows+MXU path; XLA:CPU's native gather is already fast
+            # sorted-rows+MXU path; XLA:CPU's native gather is already fast.
+            # 'fused' (the Pallas one-pass kernel) must currently be opted
+            # into explicitly — it becomes the auto accelerator choice once
+            # TPU-measured faster than 'mxu' (benchmarks/microbench_parts).
             return "direct" if platform == "cpu" else "mxu"
-        if self.gather_mode not in ("direct", "mxu"):
+        if self.gather_mode not in ("direct", "mxu", "fused"):
             raise ValueError(
-                f"gather_mode must be 'auto', 'direct', or 'mxu', got "
-                f"{self.gather_mode!r}"
+                f"gather_mode must be 'auto', 'direct', 'mxu', or 'fused', "
+                f"got {self.gather_mode!r}"
             )
         return self.gather_mode
 
@@ -119,6 +126,11 @@ class EngineConfig:
         engine supplies it, the batch fills ``mxu_batch_budget_bytes``."""
         if self.perm_batch is not None:
             return max(1, min(self.perm_batch, chunk))
+        if gather_mode == "fused":
+            # the fused kernel keeps row blocks in VMEM — HBM working set is
+            # just the (batch, K, cap, cap) outputs; a large batch amortizes
+            # kernel grid overhead across permutations
+            return min(32, chunk)
         if gather_mode == "mxu":
             if bytes_per_perm and bytes_per_perm > 0:
                 fit = int(self.mxu_batch_budget_bytes // bytes_per_perm)

@@ -1,0 +1,130 @@
+"""Parity tests for the fused (Pallas) gather path — the round-3 roofline
+lever (BASELINE.md roofline: the hot loop is bandwidth-bound on the row
+gather; the fused kernel does one HBM pass per row set instead of the XLA
+path's several). On CPU the kernel runs in the Pallas interpreter; the
+engine contract is that 'fused' computes the SAME null as 'direct' given
+the same seed (selection is exact 0/1 arithmetic in f32 on CPU).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from netrep_tpu.ops.fused_gather import gather_submatrix_fused
+from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+from netrep_tpu.utils.config import EngineConfig
+
+
+def _problem(rng, n_disc=90, n_test=80, n_samples=12,
+             sizes=(7, 9, 34)):  # crosses one bucket boundary
+    def build(n):
+        x = rng.standard_normal((n_samples, n))
+        c = np.corrcoef(x, rowvar=False)
+        return x, c, np.abs(c) ** 2
+
+    d = build(n_disc)
+    t = build(n_test)
+    specs, pos = [], 0
+    for k, sz in enumerate(sizes):
+        idx = np.arange(pos, pos + sz, dtype=np.int32)
+        specs.append(ModuleSpec(str(k + 1), idx, idx))
+        pos += sz
+    pool = np.arange(n_test, dtype=np.int32)
+    return d, t, specs, pool
+
+
+def test_kernel_matches_advanced_indexing(rng):
+    n = 300
+    M = rng.standard_normal((n, n)).astype(np.float32)
+    idx = rng.integers(0, n, size=(4, 5, 24)).astype(np.int32)
+    out = np.asarray(
+        gather_submatrix_fused(jnp.asarray(M), jnp.asarray(idx), interpret=True)
+    )
+    ref = M[idx[..., :, None], idx[..., None, :]]
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_kernel_sentinel_columns_zero_rows_clamped(rng):
+    n = 150
+    M = rng.standard_normal((n, n)).astype(np.float32)
+    idx = rng.integers(0, n, size=(2, 16)).astype(np.int32)
+    idx[:, -3:] = n  # sentinel padding
+    out = np.asarray(
+        gather_submatrix_fused(jnp.asarray(M), jnp.asarray(idx), interpret=True)
+    )
+    ref = M[idx[..., :, None].clip(0, n - 1), idx[..., None, :].clip(0, n - 1)]
+    ref[..., :, -3:] = 0.0  # sentinel columns zero out
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_fused_null_matches_direct(rng):
+    d, t, specs, pool = _problem(rng)
+    nulls = {}
+    for mode in ("direct", "fused"):
+        eng = PermutationEngine(
+            d[1], d[2], d[0], t[1], t[2], t[0], specs, pool,
+            config=EngineConfig(
+                chunk_size=8, gather_mode=mode, summary_method="power",
+                power_iters=30,
+            ),
+        )
+        out, done = eng.run_null(16, key=7)
+        assert done == 16
+        nulls[mode] = out
+    # same seed => same permutations; CPU f32 selection exact on both paths
+    np.testing.assert_allclose(
+        nulls["fused"], nulls["direct"], rtol=1e-5, atol=1e-6
+    )
+
+
+def test_fused_null_derived_network_and_chunk_invariance(rng):
+    d, t, specs, pool = _problem(rng)
+    cfgs = [
+        EngineConfig(chunk_size=c, gather_mode="fused",
+                     network_from_correlation=2.0, power_iters=30)
+        for c in (4, 16)
+    ]
+    outs = []
+    for cfg in cfgs:
+        eng = PermutationEngine(
+            d[1], d[2], d[0], t[1], t[2], t[0], specs, pool, config=cfg
+        )
+        out, _ = eng.run_null(16, key=3)
+        outs.append(out)
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5, atol=1e-6)
+    assert np.isfinite(outs[0]).all()
+
+
+def test_fused_prime_chunk_pads_batches(rng):
+    # chunk 7 with perm_batch 4: Cp=8, one padded permutation computed and
+    # dropped — results must still match the direct path exactly
+    d, t, specs, pool = _problem(rng)
+    eng = PermutationEngine(
+        d[1], d[2], d[0], t[1], t[2], t[0], specs, pool,
+        config=EngineConfig(chunk_size=7, gather_mode="fused",
+                            perm_batch=4, power_iters=30),
+    )
+    ref = PermutationEngine(
+        d[1], d[2], d[0], t[1], t[2], t[0], specs, pool,
+        config=EngineConfig(chunk_size=7, gather_mode="direct",
+                            power_iters=30),
+    )
+    out, done = eng.run_null(14, key=9)
+    exp, _ = ref.run_null(14, key=9)
+    assert done == 14
+    np.testing.assert_allclose(out, exp, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_rejects_mesh():
+    rng = np.random.default_rng(0)
+    d, t, specs, pool = _problem(rng)
+    from netrep_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(n_perm_shards=len(jax.devices("cpu")), n_row_shards=1)
+    with pytest.raises(ValueError, match="fused"):
+        PermutationEngine(
+            d[1], d[2], d[0], t[1], t[2], t[0], specs, pool,
+            config=EngineConfig(gather_mode="fused"), mesh=mesh,
+        )
