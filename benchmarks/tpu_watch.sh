@@ -63,10 +63,17 @@ while :; do
          && [ "${PIPESTATUS[0]}" -eq 0 ]; then
         echo "$key" >>"$STATE"
       elif probe; then
-        # tunnel still alive => the step itself is broken (not an outage):
-        # mark it done-with-failure so the queue can't loop on it forever
-        echo "--- $key FAILED with tunnel alive; skipping permanently ---" | tee -a "$LOG"
-        echo "$key" >>"$STATE"
+        # tunnel alive after the failure: could be a genuinely broken step
+        # OR a mid-step outage whose tunnel recovered before the timeout
+        # killed us. Retry once (FAIL marker); only a second failure with
+        # the tunnel alive is skipped permanently.
+        if grep -qx "$key FAIL" "$STATE"; then
+          echo "--- $key FAILED twice with tunnel alive; skipping permanently ---" | tee -a "$LOG"
+          echo "$key" >>"$STATE"
+        else
+          echo "--- $key FAILED with tunnel alive; will retry once ---" | tee -a "$LOG"
+          echo "$key FAIL" >>"$STATE"
+        fi
       else
         echo "--- $key FAILED/timed out; reprobing tunnel ---" | tee -a "$LOG"
         break   # tunnel died mid-step; fall back to probing

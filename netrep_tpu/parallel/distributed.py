@@ -114,6 +114,20 @@ def initialize(
     }
 
 
+def to_global(x, sharding):
+    """Place a host-local array onto ``sharding``. Single-process (fully
+    addressable): a plain ``device_put``. Multi-host: ``device_put`` rejects
+    non-addressable shardings, so assemble the global array from each
+    process's addressable shards — valid because the engine's SPMD contract
+    has every process compute the identical host-local value (keys from the
+    same seed, replicated matrices)."""
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_callback(
+        x.shape, sharding, lambda idx: x[idx]
+    )
+
+
 def gather_to_host(x):
     """Return ``x`` as a host-local numpy array on every process.
 

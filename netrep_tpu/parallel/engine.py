@@ -243,6 +243,21 @@ def _pad_to(a: np.ndarray, cap: int, axes: Sequence[int]) -> np.ndarray:
     return np.pad(a, pad)
 
 
+def _idx_blocks(perm, cap: int, slices) -> jnp.ndarray:
+    """Slice one bucket's per-module index sets out of a drawn permutation
+    and zero-pad each to the bucket capacity: ``perm`` is ``(..., P)``,
+    result ``(..., K, cap)``. The single definition of the chunk paths'
+    module-index layout (replicated / row-sharded / fused branches all use
+    it — padding semantics must not drift between them; padded slots are
+    masked downstream)."""
+    cols = []
+    for off, size in slices:
+        idx = perm[..., off: off + size]
+        pad = [(0, 0)] * (idx.ndim - 1) + [(0, cap - size)]
+        cols.append(jnp.pad(idx, pad))
+    return jnp.stack(cols, axis=-2)
+
+
 class PermutationEngine:
     """Permutation-null engine for one (discovery, test) dataset pair.
 
@@ -657,12 +672,7 @@ class PermutationEngine:
                 perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)
                 outs = []
                 for (cap, slices), disc in zip(caps_slices, discs):
-                    cols = []
-                    for off, size in slices:
-                        idx = perm[:, off: off + size]
-                        idx = jnp.pad(idx, ((0, 0), (0, cap - size)))
-                        cols.append(idx)
-                    idx_b = jnp.stack(cols, axis=1)  # (C, K, cap)
+                    idx_b = _idx_blocks(perm, cap, slices)  # (C, K, cap)
                     # collective-assembled gathers from the row-sharded
                     # matrices; statistics batch over (C, K) by broadcasting
                     # (disc props carry the K axis).
@@ -707,13 +717,7 @@ class PermutationEngine:
                     )(keys_b)
                     outs_b = []
                     for (cap, slices), disc in zip(caps_slices, discs):
-                        cols = []
-                        for off, size in slices:
-                            idxp = perm[:, off: off + size]
-                            cols.append(
-                                jnp.pad(idxp, ((0, 0), (0, cap - size)))
-                            )
-                        idx_b = jnp.stack(cols, axis=1)  # (B, K, cap)
+                        idx_b = _idx_blocks(perm, cap, slices)  # (B, K, cap)
                         sub_c = gather_submatrix_fused(tc, idx_b)
                         sub_n = (
                             jstats.derived_net(sub_c, net_beta)
@@ -748,11 +752,7 @@ class PermutationEngine:
                 perm = jax.random.permutation(key, pool)
                 outs_p = []
                 for (cap, slices), disc in zip(caps_slices, discs):
-                    cols = []
-                    for off, size in slices:
-                        idx = perm[off: off + size]
-                        cols.append(jnp.pad(idx, (0, cap - size)))
-                    idx_b = jnp.stack(cols, axis=0)  # (K, cap)
+                    idx_b = _idx_blocks(perm, cap, slices)  # (K, cap)
                     over_mods = jax.vmap(kernel, in_axes=(0, 0, None, None, None))
                     outs_p.append(over_mods(disc, idx_b, tc, tn, td))
                 return outs_p
@@ -770,17 +770,35 @@ class PermutationEngine:
         cfg = self.config
         args = self.chunk_args()
         if self.mesh is not None:
+            from .distributed import to_global
+
             keys_sharding = NamedSharding(self.mesh, P(cfg.mesh_axis))
             out_shardings = [
                 NamedSharding(self.mesh, P(cfg.mesh_axis))
                 for _ in self.buckets
             ]
             jitted = jax.jit(chunk, out_shardings=out_shardings)
+            if not keys_sharding.is_fully_addressable:
+                # Multi-host mesh: every operand of the jitted computation
+                # must be a global array. Matrices/disc-props are identical
+                # on every process (SPMD contract) → replicate them over the
+                # mesh; row-sharded inputs already carry global shardings.
+                rep = NamedSharding(self.mesh, P())
+
+                def _globalize(a):
+                    if not hasattr(a, "shape"):
+                        return a
+                    sh = getattr(a, "sharding", None)
+                    if sh is not None and not sh.is_fully_addressable:
+                        return a  # already global (e.g. row-sharded)
+                    return to_global(a, rep)
+
+                args = jax.tree.map(_globalize, args)
 
             def fn(keys):
                 # shard keys explicitly; the matrix operands keep their own
                 # (committed) shardings — replicated or row-sharded
-                return jitted(jax.device_put(keys, keys_sharding), *args)
+                return jitted(to_global(keys, keys_sharding), *args)
 
             return fn
         jitted = jax.jit(chunk)

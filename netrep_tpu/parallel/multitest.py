@@ -279,9 +279,11 @@ class MultiTestEngine:
                 NamedSharding(self.mesh, P(None, cfg.mesh_axis))
                 for _ in base.buckets
             ]
+            from .distributed import to_global
+
             jitted = jax.jit(chunk, out_shardings=osh)
             self._chunk_cached = lambda keys: jitted(
-                jax.device_put(keys, ksh), *chunk_args
+                to_global(keys, ksh), *chunk_args
             )
         else:
             jitted = jax.jit(chunk)

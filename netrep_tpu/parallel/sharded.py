@@ -46,7 +46,12 @@ def shard_rows(mat, mesh: Mesh, axis: str = ROW_AXIS):
             f"rows ({n}) not divisible by mesh axis {axis!r} size {d}; "
             "pad the matrix first (pad_rows_to_multiple)"
         )
-    return jax.device_put(mat, NamedSharding(mesh, P(axis, None)))
+    from .distributed import to_global
+
+    # to_global == device_put single-process; on multi-host meshes it
+    # assembles the global array from each process's addressable shards
+    # (device_put rejects non-addressable shardings)
+    return to_global(mat, NamedSharding(mesh, P(axis, None)))
 
 
 def pad_square_to_multiple(mat, d: int):

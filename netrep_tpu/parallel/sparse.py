@@ -267,9 +267,11 @@ class SparsePermutationEngine:
                     NamedSharding(self.mesh, P(self.config.mesh_axis))
                     for _ in self.buckets
                 ]
+                from .distributed import to_global
+
                 jitted = jax.jit(chunk, out_shardings=osh)
                 self._chunk_fn_cached = lambda keys: jitted(
-                    jax.device_put(keys, ksh), *args
+                    to_global(keys, ksh), *args
                 )
             else:
                 jitted = jax.jit(chunk)

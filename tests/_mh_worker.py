@@ -1,0 +1,86 @@
+"""Worker process for the 2-process multi-host test
+(``tests/test_multihost.py``). Each rank joins a localhost coordination
+service, builds the global (perm,) mesh spanning both processes' virtual CPU
+devices, runs a small sharded permutation null, and writes the gathered
+(global) null to ``--out`` — the parent asserts both ranks produced the
+identical full null via ``gather_to_host``'s ``process_allgather`` branch.
+"""
+
+import argparse
+import os
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", required=True)
+    ap.add_argument("--num-processes", type=int, required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--local-devices", type=int, default=4)
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    # Env before any jax backend init: virtual CPU devices per process.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={args.local_devices}"
+    ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from netrep_tpu.parallel import distributed
+    from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+    from netrep_tpu.parallel.mesh import make_mesh
+    from netrep_tpu.utils.config import EngineConfig
+
+    info = distributed.initialize(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+    assert info["process_count"] == args.num_processes, info
+    assert info["global_device_count"] == args.num_processes * args.local_devices
+
+    # identical problem on every rank (SPMD contract)
+    rng = np.random.default_rng(0)
+    n, ns = 64, 12
+
+    def build():
+        x = rng.standard_normal((ns, n))
+        c = np.corrcoef(x, rowvar=False)
+        return x, c, np.abs(c) ** 2
+
+    d_data, d_corr, d_net = build()
+    t_data, t_corr, t_net = build()
+    sizes = (6, 9)
+    specs, pos = [], 0
+    for k, sz in enumerate(sizes):
+        idx = np.arange(pos, pos + sz, dtype=np.int32)
+        specs.append(ModuleSpec(str(k + 1), idx, idx))
+        pos += sz
+    pool = np.arange(n, dtype=np.int32)
+
+    n_dev = info["global_device_count"]
+    mesh = make_mesh(n_perm_shards=n_dev, n_row_shards=1)
+    engine = PermutationEngine(
+        d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool,
+        config=EngineConfig(chunk_size=2 * n_dev, summary_method="power",
+                            power_iters=30),
+        mesh=mesh,
+    )
+    nulls, done = engine.run_null(4 * n_dev, key=21)
+    assert done == 4 * n_dev
+    assert np.isfinite(nulls).all()
+    np.save(args.out, nulls)
+    print(f"rank {args.process_id}: OK shape={nulls.shape}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
