@@ -41,21 +41,35 @@ import time
 import numpy as np
 
 TARGET_SECONDS = 60.0  # BASELINE.json:5 north-star
+#: set by ensure_backend when a dead TPU tunnel forced the CPU fallback.
+#: north/B then measure a reduced permutation count and project; the scale
+#: configs (C/D/E) emit an explicit skip row instead of running for hours
+#: on CPU (see main()).
+TPU_FALLBACK = False
 
 
-def ensure_backend(probe_timeout: float = 120.0):
+def ensure_backend(probe_timeout: float | None = None):
     """Resolve a usable JAX backend. The driver environment pins
     JAX_PLATFORMS=axon (the TPU tunnel), whose plugin registration is
     flaky — and whose ``jax.devices()`` HANGS indefinitely (not errors)
     when the tunnel is down. Probe in a killable subprocess first so a dead
     tunnel produces a fast, explicit error line instead of an opaque hang;
     registration errors still fall back to automatic backend selection."""
+    import os
+
     import jax
 
     from netrep_tpu.utils.backend import (
         honor_explicit_platform, probe_default_backend, tunnel_expected,
     )
 
+    if probe_timeout is None:
+        try:
+            probe_timeout = float(
+                os.environ.get("NETREP_BACKEND_PROBE_TIMEOUT", "120")
+            )
+        except ValueError:
+            probe_timeout = 120.0
     # An explicit non-TPU platform (e.g. JAX_PLATFORMS=cpu) is honored via
     # the live config — the env var alone does NOT stop the axon plugin's
     # get_backend hook from dialing the tunnel.
@@ -67,15 +81,24 @@ def ensure_backend(probe_timeout: float = 120.0):
         # (e.g. plugin registration RuntimeError) falls through to the
         # auto-backend fallback below, as before
         if probe_default_backend(probe_timeout) == "timeout":
+            # Round-2 aborted here (rc=1) and the round's driver-visible
+            # perf record was an error line. Fall back to CPU instead: the
+            # caller reduces the permutation count and the emitted row
+            # carries device + tpu_fallback markers, so a dead tunnel now
+            # yields a real (honestly-labeled) measurement.
             print(json.dumps({
                 "metric": "backend probe",
-                "error": (
+                "warning": (
                     "TPU tunnel (axon) unreachable: jax.devices() probe "
-                    f"did not complete in {probe_timeout:.0f}s; aborting "
-                    "instead of hanging. Re-run when the tunnel is up."
+                    f"did not complete in {probe_timeout:.0f}s; falling "
+                    "back to CPU at reduced permutation count."
                 ),
-            }))
-            raise SystemExit(1)
+            }), file=sys.stderr)
+            jax.config.update("jax_platforms", "cpu")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            global TPU_FALLBACK
+            TPU_FALLBACK = True
+            return jax.devices()
     try:
         return jax.devices()
     except RuntimeError:
@@ -171,25 +194,36 @@ def bench_north(args, label=None):
     engine = PermutationEngine(
         d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool, config=cfg
     )
-    elapsed = timed_null(engine, args.perms, cfg.chunk_size)
+    measured = args.perms
+    if TPU_FALLBACK:
+        # dead tunnel → CPU: measure a slice and project (the chunked loop
+        # is linear in n_perm); the row stays real and honestly labeled
+        measured = min(args.perms, max(2 * cfg.chunk_size, 256))
+    elapsed = timed_null(engine, measured, cfg.chunk_size)
+    projected = elapsed * args.perms / measured
     if label is None:
         label = "north-star config, BASELINE.json:5"
     if args.derived_net:
         label += "; derived network |corr|^2"
-    return emit({
+    row = {
         "metric": (
             f"wall-clock for {args.perms}-perm null, {args.genes} genes / "
             f"{args.modules} modules ({label})"
         ),
-        "value": round(elapsed, 3),
+        "value": round(projected, 3),
         "unit": "s",
-        "vs_baseline": round(TARGET_SECONDS / elapsed, 4),
-        "perms_per_sec": round(args.perms / elapsed, 2),
+        "vs_baseline": round(TARGET_SECONDS / projected, 4),
+        "perms_per_sec": round(measured / elapsed, 2),
         "device": str(jax.devices()[0]),
         "dtype": args.dtype,
         "chunk": args.chunk,
         "gather_mode": engine.gather_mode,  # resolved, not the 'auto' alias
-    })
+    }
+    if TPU_FALLBACK:
+        row["tpu_fallback"] = True
+        row["measured_perms"] = measured
+        row["metric"] += " [CPU fallback: TPU tunnel unreachable]"
+    return emit(row)
 
 
 def bench_a(args):
@@ -299,6 +333,63 @@ def bench_oracle(args):
         "perms_per_sec": round(pps, 3),
         "projected_10k_perm_s": round(10_000 / pps, 1),
         "device": "CPU (oracle)",
+    })
+
+
+def bench_native(args):
+    """Native C++ tier (``backend='native'``) at Config A/B shapes with a
+    thread sweep — the closest measurable analogue of the reference's
+    OpenMP performance, and the honest CPU denominator for "what does the
+    TPU buy over a good threaded CPU implementation" (VERDICT r2 item 5;
+    the round-2 52× figure compared against a 1-thread NumPy loop)."""
+    import os
+
+    import jax
+
+    # pure-CPU config: must run even when the TPU tunnel is hung
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from netrep_tpu.native import NativePermutationEngine, available
+
+    if not available():
+        return emit({"metric": "native backend", "error": "no C++ toolchain"})
+
+    resolve(args, 5000, 20, 200)
+    (d_data, d_corr, d_net), (t_data, t_corr, t_net) = [
+        tuple(np.asarray(a) for a in side)
+        for side in build_problem(args.genes, args.modules, args.samples)
+    ]
+    lo, hi = (30, 200) if not args.smoke else (8, 24)
+    specs = make_specs(args.genes, args.modules, lo, hi)
+    pool = np.arange(args.genes, dtype=np.int32)
+
+    cores = len(os.sched_getaffinity(0))
+    sweep = sorted({1, 2, 4, 8, cores} & set(range(1, cores + 1))) or [1]
+    rows = {}
+    for nt in sweep:
+        engine = NativePermutationEngine(
+            d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool,
+            n_threads=nt,
+        )
+        t0 = time.perf_counter()
+        nulls, done = engine.run_null(args.perms, key=0)
+        elapsed = time.perf_counter() - t0
+        assert done == args.perms and np.isfinite(nulls).all()
+        rows[nt] = round(args.perms / elapsed, 2)
+    best = max(rows.values())
+    return emit({
+        "metric": (
+            f"native C++ backend, {args.genes} genes / {args.modules} "
+            f"modules ({args.perms} perms measured; thread sweep on a "
+            f"{cores}-core box)"
+        ),
+        "value": round(args.perms / best, 3),
+        "unit": "s",
+        "vs_baseline": round(best * TARGET_SECONDS / 10_000, 4),
+        "perms_per_sec_by_threads": rows,
+        "projected_10k_perm_s": round(10_000 / best, 1),
+        "device": f"CPU native ({cores} cores)",
     })
 
 
@@ -488,7 +579,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="north",
                     choices=["north", "A", "B", "C", "D", "E", "oracle",
-                             "sharded"])
+                             "native", "sharded"])
     ap.add_argument("--genes", type=int, default=None)
     ap.add_argument("--modules", type=int, default=None)
     ap.add_argument("--perms", type=int, default=None)
@@ -521,6 +612,9 @@ def main():
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "benchmarks", "microbench_sharded_gather.py"),
         ])
+    if args.config == "native":
+        # self-contained CPU config (forces cpu platform itself)
+        return bench_native(args)
     if args.config == "oracle":
         # pure-CPU config: must run even when the TPU tunnel is hung (the
         # exact situation where the CPU baseline is the only runnable bench).
@@ -533,6 +627,16 @@ def main():
         jax.config.update("jax_platforms", "cpu")
         os.environ["JAX_PLATFORMS"] = "cpu"
     ensure_backend()
+    if TPU_FALLBACK and args.config in ("C", "D", "E"):
+        # scale configs exist to measure TPU behavior; running them to
+        # completion on fallback CPU takes hours — emit an explicit,
+        # parseable skip row instead (north/B project from a reduced count)
+        return emit({
+            "metric": f"Config {args.config}",
+            "error": "skipped: TPU tunnel unreachable (CPU fallback); this "
+                     "config is only meaningful on TPU",
+            "tpu_fallback": True,
+        })
     return {
         "north": bench_north, "A": bench_a, "B": bench_b,
         "C": bench_c, "D": bench_d, "E": bench_e, "oracle": bench_oracle,

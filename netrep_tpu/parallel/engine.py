@@ -700,8 +700,14 @@ class PermutationEngine:
 
                 # Pallas/Mosaic compiles on TPU-like backends; CPU (CI) runs
                 # the interpreter so the fused path stays testable everywhere
+                on_cpu = jax.default_backend() == "cpu"
                 gather_submatrix_fused = partial(
-                    _gsf, interpret=jax.default_backend() == "cpu"
+                    _gsf, interpret=on_cpu,
+                    # exact recovers f32 selection from the TPU MXU's bf16
+                    # operand truncation; CPU dots are exact already, so the
+                    # hi/lo split there would only ADD ~2^-16 noise — gate it
+                    # (keeps the config docstring's "no effect on CPU" true)
+                    exact=cfg.fused_exact and not on_cpu,
                 )
                 C = keys.shape[0]
                 B = min(perm_batch, C)

@@ -80,9 +80,12 @@ def resolve_backend_or_cpu(probe_timeout: float | None = None) -> None:
     import jax
 
     if probe_timeout is None:
-        probe_timeout = float(
-            os.environ.get("NETREP_BACKEND_PROBE_TIMEOUT", "90")
-        )
+        try:
+            probe_timeout = float(
+                os.environ.get("NETREP_BACKEND_PROBE_TIMEOUT", "90")
+            )
+        except ValueError:
+            probe_timeout = 90.0
     if honor_explicit_platform() is not None:
         return
     if tunnel_expected() and probe_default_backend(probe_timeout) != "ok":

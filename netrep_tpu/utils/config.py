@@ -95,6 +95,12 @@ class EngineConfig:
     mesh_axis: str = "perm"
     matrix_sharding: str = "replicated"
     gather_mode: str = "auto"
+    #: gather_mode='fused' only: select f32 values hi/lo-split over two bf16
+    #: MXU dots — ~f32-exact selection on TPU at the same one-pass HBM
+    #: traffic (2x non-dominant FLOPs), vs ~10x cost for gather_mode=
+    #: 'direct', the other exact-on-TPU option. No effect on CPU (exact
+    #: anyway) or bf16 storage (stored values always selected bit-true).
+    fused_exact: bool = False
     perm_batch: int | None = None
     network_from_correlation: float | None = None
     mxu_batch_budget_bytes: int = 2 << 30

@@ -59,6 +59,21 @@ def test_kernel_sentinel_columns_zero_rows_clamped(rng):
     np.testing.assert_array_equal(out, ref)
 
 
+def test_kernel_exact_mode_hilo(rng):
+    # hi/lo split must reproduce values to f32 precision even though both
+    # dots run in bf16 (the CPU interpreter uses f32 dots, so this also
+    # pins that the split arithmetic itself is lossless-composable)
+    n = 200
+    M = (rng.standard_normal((n, n)) * 100).astype(np.float32)
+    idx = rng.integers(0, n, size=(3, 32)).astype(np.int32)
+    out = np.asarray(gather_submatrix_fused(
+        jnp.asarray(M), jnp.asarray(idx), interpret=True, exact=True
+    ))
+    ref = M[idx[..., :, None], idx[..., None, :]]
+    # bf16(hi) + bf16(residual) reconstructs f32 to ~2^-16 relative
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=1e-4)
+
+
 def test_fused_null_matches_direct(rng):
     d, t, specs, pool = _problem(rng)
     nulls = {}
@@ -95,6 +110,24 @@ def test_fused_null_derived_network_and_chunk_invariance(rng):
         outs.append(out)
     np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5, atol=1e-6)
     assert np.isfinite(outs[0]).all()
+
+
+def test_fused_exact_config_matches_direct(rng):
+    d, t, specs, pool = _problem(rng)
+    eng = PermutationEngine(
+        d[1], d[2], d[0], t[1], t[2], t[0], specs, pool,
+        config=EngineConfig(chunk_size=8, gather_mode="fused",
+                            fused_exact=True, power_iters=30),
+    )
+    ref = PermutationEngine(
+        d[1], d[2], d[0], t[1], t[2], t[0], specs, pool,
+        config=EngineConfig(chunk_size=8, gather_mode="direct",
+                            power_iters=30),
+    )
+    out, _ = eng.run_null(8, key=2)
+    exp, _ = ref.run_null(8, key=2)
+    # hi/lo reconstruction is ~2^-16-relative; statistics attenuate further
+    np.testing.assert_allclose(out, exp, rtol=1e-4, atol=1e-5)
 
 
 def test_fused_prime_chunk_pads_batches(rng):
