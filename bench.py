@@ -417,7 +417,8 @@ def bench_c(args):
     lo, hi = (30, 200) if not args.smoke else (8, 24)
     specs = make_specs(genes, args.modules, lo, hi)
     pool = np.arange(genes, dtype=np.int32)
-    cfg = EngineConfig(chunk_size=args.chunk, power_iters=40)
+    cfg = EngineConfig(chunk_size=args.chunk, power_iters=40,
+                       gather_mode=args.gather_mode)
 
     multi = MultiTestEngine(
         d_corr, d_net, d_data,
@@ -447,6 +448,13 @@ def bench_c(args):
         "sequential_s": round(seq_s, 3),
         "vmap_perms_per_sec": round(n_perm / vmap_s, 2),
         "device": str(jax.devices()[0]),
+        # the multi-test path implements direct-batched and fused gathers
+        # only (no mxu branch) — report what each side ACTUALLY ran so a
+        # ratio across different gather implementations is visible
+        "vmap_gather_mode": (
+            "fused" if multi._base.gather_mode == "fused" else "direct-batched"
+        ),
+        "sequential_gather_mode": eng.gather_mode,
     })
 
 
@@ -588,7 +596,9 @@ def main():
     ap.add_argument("--dtype", default="float32")
     ap.add_argument("--gather-mode", default="auto",
                     choices=["auto", "direct", "mxu", "fused"],
-                    help="EngineConfig.gather_mode for north/B/D configs")
+                    help="EngineConfig.gather_mode for north/B/C/D configs "
+                         "(the multi-test side of C implements "
+                         "direct-batched and fused only)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config for a fast correctness pass")
     ap.add_argument("--derived-net", action="store_true",

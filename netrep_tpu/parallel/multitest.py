@@ -199,6 +199,78 @@ class MultiTestEngine:
                     out[t, b.module_pos] = np.asarray(res, dtype=np.float64)
         return out
 
+    def _build_fused_chunk(self, chunk_args) -> Callable:
+        """Fused-kernel chunk for the multi-test path: scan over perm
+        sub-batches; per batch the T cohorts loop over the SHARED index
+        blocks, each cohort's submatrices extracted by the one-pass Pallas
+        kernel (:mod:`netrep_tpu.ops.fused_gather`). Mirrors
+        ``PermutationEngine``'s fused branch; T divides the batch so the
+        per-dispatch submatrix working set stays bounded."""
+        import jax
+
+        from ..ops.fused_gather import gather_submatrix_fused as _gsf
+        from .engine import _idx_blocks
+
+        cfg = self.config
+        base = self._base
+        T = self.T
+        td_absent = self._td is None
+        tn_absent = self._tn is None
+        net_beta = self.net_beta
+        caps_slices = [(b.cap, tuple(b.slices)) for b in base.buckets]
+        on_cpu = jax.default_backend() == "cpu"
+        gsf = partial(
+            _gsf, interpret=on_cpu, exact=cfg.fused_exact and not on_cpu
+        )
+        pb = cfg.resolved_perm_batch("fused", jax.default_backend(), 1 << 30)
+        perm_batch = max(1, pb // T)
+
+        def chunk(keys, pool, tc, tn, td, discs):
+            C = keys.shape[0]
+            B = min(perm_batch, C)
+            Cp = -(-C // B) * B
+            kp = (
+                jnp.concatenate([keys, keys[-1:].repeat(Cp - C, axis=0)])
+                if Cp != C else keys
+            )
+
+            def batch_body(_, keys_b):
+                perm = jax.vmap(
+                    lambda k: jax.random.permutation(k, pool)
+                )(keys_b)
+                outs_b = []
+                for (cap, slices), disc in zip(caps_slices, discs):
+                    idx_b = _idx_blocks(perm, cap, slices)  # (B, K, cap)
+                    per_t = []
+                    for t in range(T):
+                        sub_c = gsf(tc[t], idx_b)
+                        sub_n = (
+                            jstats.derived_net(sub_c, net_beta)
+                            if tn_absent else gsf(tn[t], idx_b)
+                        )
+                        zd = (
+                            jstats.gather_zdata(td[t], idx_b, disc.mask)
+                            if not td_absent else None
+                        )
+                        per_t.append(jstats.module_stats_masked(
+                            disc, sub_c, sub_n, zd,
+                            n_iter=cfg.power_iters,
+                            summary_method=cfg.summary_method,
+                        ))
+                    outs_b.append(jnp.stack(per_t))  # (T, B, K, 7)
+                return None, outs_b
+
+            _, outs = jax.lax.scan(batch_body, None, kp.reshape(Cp // B, B))
+            # per bucket: (Cp//B, T, B, K, 7) -> (T, C, K, 7), pad dropped
+            return [
+                o.swapaxes(0, 1).reshape(T, Cp, *o.shape[3:])[:, :C]
+                for o in outs
+            ]
+
+        jitted = jax.jit(chunk)
+        self._chunk_cached = lambda keys: jitted(keys, *chunk_args)
+        return self._chunk_cached
+
     def _chunk_fn(self) -> Callable:
         if self._chunk_cached is not None:
             return self._chunk_cached
@@ -225,6 +297,9 @@ class MultiTestEngine:
         tn_absent = self._tn is None
         if row_sharded:
             from .sharded import gather_corr_net
+
+        if base.gather_mode == "fused" and not row_sharded:
+            return self._build_fused_chunk(chunk_args)
 
         def chunk(keys, pool, tc, tn, td, discs):
             perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)

@@ -59,6 +59,19 @@ def test_kernel_sentinel_columns_zero_rows_clamped(rng):
     np.testing.assert_array_equal(out, ref)
 
 
+def test_kernel_bf16_storage_selects_bit_true(rng):
+    # bf16 storage + fused kernel: stored values must be selected exactly
+    # (one-hot dot of bf16 values with f32 accumulate loses nothing) — the
+    # precision contract for the dtype='bfloat16' engine mode
+    n = 256
+    M = rng.standard_normal((n, n)).astype(np.float32)
+    M16 = jnp.asarray(M, jnp.bfloat16)
+    idx = rng.integers(0, n, size=(4, 24)).astype(np.int32)
+    out = np.asarray(gather_submatrix_fused(M16, jnp.asarray(idx), interpret=True))
+    ref = np.asarray(M16)[idx[..., :, None], idx[..., None, :]].astype(np.float32)
+    np.testing.assert_array_equal(out, ref)
+
+
 def test_kernel_exact_mode_hilo(rng):
     # hi/lo split must reproduce values to f32 precision even though both
     # dots run in bf16 (the CPU interpreter uses f32 dots, so this also
@@ -148,6 +161,37 @@ def test_fused_prime_chunk_pads_batches(rng):
     exp, _ = ref.run_null(14, key=9)
     assert done == 14
     np.testing.assert_allclose(out, exp, rtol=1e-5, atol=1e-6)
+
+
+def test_multitest_fused_matches_default(rng):
+    # Config C + fused kernel: same seed => same nulls as the default
+    # (direct-gather) multi-test path, both cohorts
+    from netrep_tpu.parallel.multitest import MultiTestEngine
+
+    d, t, specs, pool = _problem(rng)
+    t2_data = t[0] + rng.standard_normal(t[0].shape) * 0.5
+    t2_corr = np.corrcoef(t2_data, rowvar=False)
+    t2_net = np.abs(t2_corr) ** 2
+    args = (
+        d[1], d[2], d[0],
+        np.stack([t[1], t2_corr]),
+        np.stack([t[2], t2_net]),
+        [t[0], t2_data],
+        specs, pool,
+    )
+    nulls = {}
+    for mode in ("direct", "fused"):
+        eng = MultiTestEngine(
+            *args,
+            config=EngineConfig(chunk_size=6, gather_mode=mode,
+                                summary_method="eigh"),
+        )
+        out, done = eng.run_null(10, key=5)
+        assert done == 10 and out.shape[0] == 2
+        nulls[mode] = out
+    np.testing.assert_allclose(
+        nulls["fused"], nulls["direct"], rtol=1e-5, atol=2e-5
+    )
 
 
 def test_fused_rejects_mesh():
