@@ -10,8 +10,8 @@ Why a kernel (BASELINE.md roofline, round-2 measurements): the XLA path
 several HBM passes over a block that is used exactly once, on a loop that is
 bandwidth-bound. This kernel instead:
 
-1. DMAs each needed row of ``M`` directly HBM→VMEM (one 4·n-byte contiguous
-   copy per row — row order is irrelevant to per-row DMAs, so the argsort /
+1. DMAs each needed row of ``M`` directly HBM→VMEM (one contiguous copy per
+   row — row order is irrelevant to per-row DMAs, so the argsort /
    unsort-permutation machinery of the mxu path disappears entirely);
 2. generates one-hot tiles on the fly in VMEM and accumulates the
    column-select ``rows @ onehot`` on the MXU, tile by tile;
@@ -22,7 +22,16 @@ ideal for a row-fetch design — versus ~3-5 passes of ``cap·n`` for the XLA
 path. Selection values carry the same rounding as the mxu path (the one-hot
 matmul runs at the dtype's native MXU precision: exact 0/1 selection in
 exact arithmetic; bf16 operand truncation for f32 inputs on TPU — see
-BASELINE.md §precision).
+BASELINE.md §precision), or ~f32-exact with ``exact=True`` (hi/lo split).
+
+Two entry points share the kernel:
+
+- :func:`gather_submatrix_fused` — replicated (n, n) matrices (the
+  single-device / perm-sharded engine path);
+- :func:`gather_submatrix_fused_local` — a row-shard's LOCAL block inside
+  ``shard_map``: rows owned by other shards are zeroed (ownership mask), so
+  a ``psum`` over the row axis assembles the full submatrix
+  (:mod:`netrep_tpu.parallel.sharded`, mode='fused').
 
 CPU/testing: ``interpret=True`` runs the kernel in the Pallas interpreter —
 used by the parity tests; the engine only selects this path on TPU-like
@@ -47,54 +56,69 @@ _COL_TILE = 512
 _ROW_BLOCK = 128
 
 
-def _kernel(idx_smem, M_ref, idx_ref, out_ref, rows_buf, sems, *,
-            n: int, rb: int, n_tiles: int, exact: bool):
-    """One grid step: DMA ``rb`` rows of ``M`` (indices from the scalar-
-    prefetched ``idx_smem``), then column-select against the full ``cap``
-    index set of this instance.
+def _kernel(rowidx_smem, M_ref, colidx_ref, own_ref, out_ref, rows_buf, sems,
+            *, n_rows: int, n_cols: int, rb: int, n_tiles: int, exact: bool):
+    """One grid step: DMA ``rb`` rows of ``M`` (row indices from the
+    scalar-prefetched ``rowidx_smem`` — pre-clamped into ``[0, n_rows)`` by
+    the caller), zero the rows this instance does not own (``own_ref`` —
+    sentinel/padded slots in the replicated case, other shards' rows in the
+    row-sharded case), and column-select against the instance's ``cap``
+    column indices.
 
-    Refs: idx_smem (G, R) SMEM int32 (R = padded row count); M_ref (n, n)
-    HBM; idx_ref (1, cap) VMEM int32 (this instance's column indices);
-    out_ref (1, rb, cap) VMEM; rows_buf (rb, n_tiles·tile) VMEM scratch;
-    sems (rb,) DMA semaphores.
+    Refs: rowidx_smem (G, R) SMEM int32 (R = rb-padded row count); M_ref
+    (n_rows, n_cols) HBM; colidx_ref (1, cap) VMEM int32; own_ref (1, rb)
+    VMEM 0/1 row-ownership for THIS row block; out_ref (1, rb, cap) VMEM;
+    rows_buf (rb, n_tiles·tile) VMEM scratch; sems (rb,) DMA semaphores.
     """
     g = pl.program_id(0)
     r = pl.program_id(1)
 
     def row_copy(a):
-        # padded slots carry the sentinel n: clamp to a junk row (masked
-        # downstream), mirroring the mxu path's mode="clip"
-        src = jnp.clip(idx_smem[g, r * rb + a], 0, n - 1)
+        src = jnp.clip(rowidx_smem[g, r * rb + a], 0, n_rows - 1)
         return pltpu.make_async_copy(
             M_ref.at[pl.ds(src, 1), :],
-            rows_buf.at[pl.ds(a, 1), pl.ds(0, n)],
+            rows_buf.at[pl.ds(a, 1), pl.ds(0, n_cols)],
             sems.at[a],
         )
 
+    # un-owned slots carry a NEGATIVE row index: their DMA is skipped
+    # entirely (a row-sharded shard fetches ONLY its own rows — aggregate
+    # row traffic stays cap·n, not D·cap·n) and their buffer content is
+    # ignored via the where-mask below.
     def start(a, _):
-        row_copy(a).start()
+        @pl.when(rowidx_smem[g, r * rb + a] >= 0)
+        def _go():
+            row_copy(a).start()
         return _
 
     def wait(a, _):
-        row_copy(a).wait()
+        @pl.when(rowidx_smem[g, r * rb + a] >= 0)
+        def _go():
+            row_copy(a).wait()
         return _
 
     jax.lax.fori_loop(0, rb, start, None, unroll=8)
     jax.lax.fori_loop(0, rb, wait, None, unroll=8)
 
-    cols = idx_ref[0, :]  # (cap,) int32
+    cols = colidx_ref[0, :]                    # (cap,) int32
+    own = own_ref[0, :]                        # (rb,) 0/1 for THIS block
     acc = jnp.zeros((rb, cols.shape[0]), jnp.float32)
     for t in range(n_tiles):
         c0 = t * _COL_TILE
         tile = rows_buf[:, c0: c0 + _COL_TILE]
-        if (t + 1) * _COL_TILE > n:
-            # final tile spills past n: the buffer tail is uninitialized
-            # VMEM — zero it so 0·garbage (potential NaN) cannot reach the
-            # accumulator through the dot
+        if (t + 1) * _COL_TILE > n_cols:
+            # final tile spills past n_cols: the buffer tail is
+            # uninitialized VMEM — zero it so 0·garbage (potential NaN)
+            # cannot reach the accumulator through the dot
             in_range = (
-                c0 + jax.lax.broadcasted_iota(jnp.int32, tile.shape, 1) < n
+                c0 + jax.lax.broadcasted_iota(jnp.int32, tile.shape, 1)
+                < n_cols
             )
             tile = jnp.where(in_range, tile, 0)
+        # zero un-owned rows with a SELECT (never multiply: un-owned slots
+        # skipped their DMA, so the buffer holds uninitialized/stale VMEM —
+        # 0·NaN would poison the dot and, sharded, the psum)
+        tile = jnp.where(own[:, None] != 0, tile, jnp.zeros_like(tile))
         col_ids = c0 + jax.lax.broadcasted_iota(
             jnp.int32, (_COL_TILE, cols.shape[0]), 0
         )
@@ -119,31 +143,35 @@ def _kernel(idx_smem, M_ref, idx_ref, out_ref, rows_buf, sems, *,
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "exact"))
-def _run(M, idx, *, interpret: bool, exact: bool):
-    n = M.shape[-1]
-    G, cap = idx.shape
+def _run(M, row_idx, col_idx, own, *, interpret: bool, exact: bool):
+    """Flat-batched kernel launch: ``M`` (n_rows, n_cols); ``row_idx``
+    (G, cap) local row indices; ``col_idx`` (G, cap) column indices;
+    ``own`` (G, cap) 0/1 row-ownership. Returns (G, cap, cap) f32."""
+    n_rows, n_cols = M.shape
+    G, cap = row_idx.shape
     rb = min(cap, _ROW_BLOCK)
     n_row_blocks = -(-cap // rb)
     rpad = n_row_blocks * rb
     if rpad != cap:
         # pad the ROW axis so every grid step owns exactly rb rows; padded
-        # slots use the sentinel n (junk row, masked downstream)
-        idx_rows = jnp.concatenate(
-            [idx, jnp.full((G, rpad - cap), n, jnp.int32)], axis=1
-        )
-    else:
-        idx_rows = idx
-    n_tiles = -(-n // _COL_TILE)
+        # slots are un-owned (negative row index: DMA skipped, contribution
+        # zeroed)
+        pad = ((0, 0), (0, rpad - cap))
+        row_idx = jnp.pad(row_idx, pad, constant_values=-1)
+        own = jnp.pad(own, pad)
+    n_tiles = -(-n_cols // _COL_TILE)
 
     kernel = functools.partial(
-        _kernel, n=n, rb=rb, n_tiles=n_tiles, exact=exact
+        _kernel, n_rows=n_rows, n_cols=n_cols, rb=rb, n_tiles=n_tiles,
+        exact=exact,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(G, n_row_blocks),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),          # M stays in HBM
-            pl.BlockSpec((1, cap), lambda g, r, *_: (g, 0)),  # column idx
+            pl.BlockSpec((1, cap), lambda g, r, *_: (g, 0)),   # column idx
+            pl.BlockSpec((1, rb), lambda g, r, *_: (g, r)),    # ownership
         ],
         out_specs=pl.BlockSpec((1, rb, cap), lambda g, r, *_: (g, r, 0)),
         scratch_shapes=[
@@ -158,23 +186,26 @@ def _run(M, idx, *, interpret: bool, exact: bool):
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
             flops=2 * G * rpad * n_tiles * _COL_TILE * cap,
-            bytes_accessed=G * cap * n * M.dtype.itemsize + G * rpad * cap * 4,
+            bytes_accessed=(
+                G * cap * n_cols * M.dtype.itemsize + G * rpad * cap * 4
+            ),
             transcendentals=0,
         ),
-    )(idx_rows, M, idx)
+    )(row_idx, M, col_idx, own.astype(jnp.float32))
     return out[:, :cap, :] if rpad != cap else out
 
 
 def gather_submatrix_fused(
     M: jnp.ndarray,     # (n, n)
-    idx: jnp.ndarray,   # (..., cap) int32; sentinel n at padded slots
+    idx: jnp.ndarray,   # (..., cap) int32; sentinel >= n at padded slots
     *,
     interpret: bool = False,
     exact: bool = False,
 ) -> jnp.ndarray:
-    """Batched fused submatrix gather: ``out[..., a, b] = M[idx[..., a],
-    idx[..., b]]`` with sentinel slots clamped on the row side and
-    yielding zero columns. Returns f32 ``(..., cap, cap)``.
+    """Batched fused submatrix gather over a replicated matrix:
+    ``out[..., a, b] = M[idx[..., a], idx[..., b]]`` with sentinel
+    (out-of-range) slots yielding zero rows AND zero columns. Returns f32
+    ``(..., cap, cap)``.
 
     ``idx`` needs NO sort: per-row DMA cost is order-independent, unlike the
     mxu path's XLA gather (which needs ascending rows for DMA locality).
@@ -187,5 +218,32 @@ def gather_submatrix_fused(
     batch = idx.shape[:-1]
     cap = idx.shape[-1]
     flat = idx.reshape(-1, cap).astype(jnp.int32)
-    out = _run(M, flat, interpret=interpret, exact=exact)
+    own = (flat >= 0) & (flat < M.shape[0])
+    rows = jnp.where(own, flat, -1)  # negative => DMA skipped in-kernel
+    out = _run(M, rows, flat, own, interpret=interpret, exact=exact)
+    return out.reshape(*batch, cap, cap)
+
+
+def gather_submatrix_fused_local(
+    block: jnp.ndarray,   # (rows_per, n) — THIS shard's row block
+    idx: jnp.ndarray,     # (..., cap) int32 GLOBAL indices
+    row_start,            # scalar: first global row this shard owns
+    *,
+    interpret: bool = False,
+    exact: bool = False,
+) -> jnp.ndarray:
+    """Row-sharded variant for use inside ``shard_map``: DMA only the rows
+    of ``idx`` that fall inside this shard's block, zero the rest, and
+    column-select against the full (global) index set. The return value is
+    this shard's ADDITIVE contribution — ``psum`` over the row axis
+    assembles the full submatrix (the caller does the psum;
+    :mod:`netrep_tpu.parallel.sharded` mode='fused')."""
+    rows_per = block.shape[0]
+    batch = idx.shape[:-1]
+    cap = idx.shape[-1]
+    flat = idx.reshape(-1, cap).astype(jnp.int32)
+    rel = flat - row_start
+    own = (rel >= 0) & (rel < rows_per) & (flat < block.shape[1])
+    rows = jnp.where(own, rel, -1)  # un-owned rows: DMA skipped in-kernel
+    out = _run(block, rows, flat, own, interpret=interpret, exact=exact)
     return out.reshape(*batch, cap, cap)

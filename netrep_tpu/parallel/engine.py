@@ -243,6 +243,38 @@ def _pad_to(a: np.ndarray, cap: int, axes: Sequence[int]) -> np.ndarray:
     return np.pad(a, pad)
 
 
+def make_fused_gather(cfg: EngineConfig):
+    """Backend-gated partial of the fused Pallas gather shared by the
+    single-test and multi-test fused chunk paths: CPU runs the interpreter
+    (CI coverage), and ``fused_exact`` applies only off-CPU where plain
+    dots are not already exact — one definition so the precision gating
+    cannot drift between engines."""
+    from ..ops.fused_gather import gather_submatrix_fused as _gsf
+
+    on_cpu = jax.default_backend() == "cpu"
+    return partial(
+        _gsf, interpret=on_cpu, exact=cfg.fused_exact and not on_cpu
+    )
+
+
+def fused_scan(keys, B: int, batch_body):
+    """Pad the chunk's key array up to whole ``B``-batches (padded
+    permutations are computed and discarded — a divisor search would
+    collapse prime chunk sizes to batch 1), scan ``batch_body`` over the
+    batches, and return ``(outs, Cp)``: the stacked per-batch outputs and
+    the padded count. Shared by the fused chunk paths so the pad/scan
+    semantics cannot drift."""
+    C = keys.shape[0]
+    B = min(B, C)
+    Cp = -(-C // B) * B
+    kp = (
+        jnp.concatenate([keys, keys[-1:].repeat(Cp - C, axis=0)])
+        if Cp != C else keys
+    )
+    _, outs = jax.lax.scan(batch_body, None, kp.reshape(Cp // B, B))
+    return outs, Cp
+
+
 def _idx_blocks(perm, cap: int, slices) -> jnp.ndarray:
     """Slice one bucket's per-module index sets out of a drawn permutation
     and zero-pad each to the bucket capacity: ``perm`` is ``(..., P)``,
@@ -307,12 +339,12 @@ class PermutationEngine:
         self.row_sharded = (
             mesh is not None and config.matrix_sharding == "row"
         )
-        if config.gather_mode == "fused" and (
-            mesh is not None or config.matrix_sharding == "row"
-        ):
+        if (config.gather_mode == "fused" and mesh is not None
+                and config.matrix_sharding != "row"):
             raise ValueError(
-                "gather_mode='fused' currently supports replicated matrices "
-                "without a mesh; use 'mxu' for sharded/mesh runs"
+                "gather_mode='fused' with a mesh requires "
+                "matrix_sharding='row' (the kernel runs per-shard inside "
+                "shard_map); replicated+mesh runs use 'mxu'"
             )
         if config.matrix_sharding not in ("replicated", "row"):
             raise ValueError(
@@ -696,26 +728,7 @@ class PermutationEngine:
                 # (ops/fused_gather.py — one HBM pass per row set, one-hot
                 # select in VMEM). Structure mirrors the row-sharded branch:
                 # batched indices, broadcast-batched statistics.
-                from ..ops.fused_gather import gather_submatrix_fused as _gsf
-
-                # Pallas/Mosaic compiles on TPU-like backends; CPU (CI) runs
-                # the interpreter so the fused path stays testable everywhere
-                on_cpu = jax.default_backend() == "cpu"
-                gather_submatrix_fused = partial(
-                    _gsf, interpret=on_cpu,
-                    # exact recovers f32 selection from the TPU MXU's bf16
-                    # operand truncation; CPU dots are exact already, so the
-                    # hi/lo split there would only ADD ~2^-16 noise — gate it
-                    # (keeps the config docstring's "no effect on CPU" true)
-                    exact=cfg.fused_exact and not on_cpu,
-                )
-                C = keys.shape[0]
-                B = min(perm_batch, C)
-                # pad the key array up to a whole number of batches (padded
-                # permutations are computed and discarded) — a divisor
-                # search instead would collapse prime chunk sizes to B=1,
-                # a ~B× slowdown on residual chunks
-                Cp = -(-C // B) * B
+                gather_submatrix_fused = make_fused_gather(cfg)
 
                 def batch_body(_, keys_b):
                     perm = jax.vmap(
@@ -741,13 +754,8 @@ class PermutationEngine:
                         ))
                     return None, outs_b
 
-                kp = (
-                    jnp.concatenate([keys, keys[-1:].repeat(Cp - C, axis=0)])
-                    if Cp != C else keys
-                )
-                _, outs = jax.lax.scan(
-                    batch_body, None, kp.reshape(Cp // B, B)
-                )
+                C = keys.shape[0]
+                outs, _ = fused_scan(keys, perm_batch, batch_body)
                 # (Cp//B, B, K, 7) -> (C, K, 7) per bucket (drop pad tail)
                 return [o.reshape((-1,) + o.shape[2:])[:C] for o in outs]
 

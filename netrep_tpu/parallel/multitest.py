@@ -208,8 +208,7 @@ class MultiTestEngine:
         per-dispatch submatrix working set stays bounded."""
         import jax
 
-        from ..ops.fused_gather import gather_submatrix_fused as _gsf
-        from .engine import _idx_blocks
+        from .engine import _idx_blocks, fused_scan, make_fused_gather
 
         cfg = self.config
         base = self._base
@@ -218,21 +217,12 @@ class MultiTestEngine:
         tn_absent = self._tn is None
         net_beta = self.net_beta
         caps_slices = [(b.cap, tuple(b.slices)) for b in base.buckets]
-        on_cpu = jax.default_backend() == "cpu"
-        gsf = partial(
-            _gsf, interpret=on_cpu, exact=cfg.fused_exact and not on_cpu
-        )
+        gsf = make_fused_gather(cfg)
         pb = cfg.resolved_perm_batch("fused", jax.default_backend(), 1 << 30)
         perm_batch = max(1, pb // T)
 
         def chunk(keys, pool, tc, tn, td, discs):
             C = keys.shape[0]
-            B = min(perm_batch, C)
-            Cp = -(-C // B) * B
-            kp = (
-                jnp.concatenate([keys, keys[-1:].repeat(Cp - C, axis=0)])
-                if Cp != C else keys
-            )
 
             def batch_body(_, keys_b):
                 perm = jax.vmap(
@@ -260,7 +250,7 @@ class MultiTestEngine:
                     outs_b.append(jnp.stack(per_t))  # (T, B, K, 7)
                 return None, outs_b
 
-            _, outs = jax.lax.scan(batch_body, None, kp.reshape(Cp // B, B))
+            outs, Cp = fused_scan(keys, perm_batch, batch_body)
             # per bucket: (Cp//B, T, B, K, 7) -> (T, C, K, 7), pad dropped
             return [
                 o.swapaxes(0, 1).reshape(T, Cp, *o.shape[3:])[:, :C]

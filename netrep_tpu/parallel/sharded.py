@@ -35,6 +35,18 @@ try:  # jax ≥ 0.6 exports shard_map at top level; older under experimental
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
+# the replication/vma checker kwarg is version-dependent (check_vma on
+# jax ≥ 0.7, check_rep before); the fused mode must disable it because
+# pallas_call outputs carry no varying-axes annotation
+import inspect as _inspect
+
+_SM_PARAMS = _inspect.signature(_shard_map).parameters
+_NO_CHECK_KW = (
+    {"check_vma": False} if "check_vma" in _SM_PARAMS
+    else {"check_rep": False} if "check_rep" in _SM_PARAMS
+    else {}
+)
+
 
 def shard_rows(mat, mesh: Mesh, axis: str = ROW_AXIS):
     """Place an (n, n) matrix with rows sharded over ``axis``. Rows must
@@ -175,8 +187,54 @@ def make_sharded_gatherer(
     shard region (the mxu row buffers are (K·m, n) per permutation — at
     genome scale an unbatched chunk would not fit in HBM), mirroring the
     replicated path's ``EngineConfig.perm_batch``."""
-    if mode not in ("direct", "mxu"):
-        raise ValueError(f"mode must be 'direct' or 'mxu', got {mode!r}")
+    if mode not in ("direct", "mxu", "fused"):
+        raise ValueError(
+            f"mode must be 'direct', 'mxu', or 'fused', got {mode!r}"
+        )
+    if mode == "fused":
+        # One-pass Pallas kernel per shard (ops/fused_gather): DMA only the
+        # locally-owned rows, zero the rest, psum assembles — the kernel
+        # batches arbitrary leading dims itself (its grid bounds the VMEM
+        # working set), so no lax.map batching is needed here.
+        from ..ops.fused_gather import gather_submatrix_fused_local
+
+        interpret = jax.default_backend() == "cpu"
+
+        def local_fused(block, idx_rep, axis=ROW_AXIS):
+            rows_per = block.shape[0]
+            start = jax.lax.axis_index(axis) * rows_per
+            part = gather_submatrix_fused_local(
+                block, idx_rep, start, interpret=interpret
+            )
+            return jax.lax.psum(part, axis)
+
+        def body(corr_blk, net_blk, idx_rep):
+            return local_fused(corr_blk, idx_rep), local_fused(net_blk, idx_rep)
+
+        def body_single(blk, idx_rep):
+            return local_fused(blk, idx_rep)
+
+        idx_spec = P(batch_axis) if batch_axis else P()
+
+        def gather(corr, net, idx):
+            if net is None:
+                return _shard_map(
+                    body_single,
+                    mesh=mesh,
+                    in_specs=(P(ROW_AXIS, None), idx_spec),
+                    out_specs=idx_spec,
+                    **_NO_CHECK_KW,
+                )(corr, idx)
+            return _shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(ROW_AXIS, None), P(ROW_AXIS, None), idx_spec),
+                out_specs=(idx_spec, idx_spec),
+                **_NO_CHECK_KW,
+            )(corr, net, idx)
+
+        return gather
+
     local = (
         gather_submatrix_local if mode == "direct"
         else gather_submatrix_local_mxu

@@ -46,7 +46,7 @@ def test_kernel_matches_advanced_indexing(rng):
     np.testing.assert_array_equal(out, ref)
 
 
-def test_kernel_sentinel_columns_zero_rows_clamped(rng):
+def test_kernel_sentinel_rows_and_columns_zero(rng):
     n = 150
     M = rng.standard_normal((n, n)).astype(np.float32)
     idx = rng.integers(0, n, size=(2, 16)).astype(np.int32)
@@ -56,6 +56,7 @@ def test_kernel_sentinel_columns_zero_rows_clamped(rng):
     )
     ref = M[idx[..., :, None].clip(0, n - 1), idx[..., None, :].clip(0, n - 1)]
     ref[..., :, -3:] = 0.0  # sentinel columns zero out
+    ref[..., -3:, :] = 0.0  # sentinel rows are un-owned -> zero too
     np.testing.assert_array_equal(out, ref)
 
 
@@ -194,7 +195,7 @@ def test_multitest_fused_matches_default(rng):
     )
 
 
-def test_fused_rejects_mesh():
+def test_fused_rejects_replicated_mesh():
     rng = np.random.default_rng(0)
     d, t, specs, pool = _problem(rng)
     from netrep_tpu.parallel.mesh import make_mesh
@@ -205,3 +206,37 @@ def test_fused_rejects_mesh():
             d[1], d[2], d[0], t[1], t[2], t[0], specs, pool,
             config=EngineConfig(gather_mode="fused"), mesh=mesh,
         )
+
+
+def test_fused_row_sharded_matches_replicated(rng):
+    # Config D composition: row-sharded matrices + fused per-shard kernel
+    # (psum-assembled) must equal the replicated direct path with the same
+    # seed — exercised on the virtual 8-device CPU mesh in interpret mode
+    from netrep_tpu.parallel.mesh import make_mesh
+
+    d, t, specs, pool = _problem(rng)
+    n_dev = len(jax.devices("cpu"))
+    n_row = 2
+    mesh = make_mesh(n_perm_shards=n_dev // n_row, n_row_shards=n_row)
+    eng = PermutationEngine(
+        d[1], d[2], d[0], t[1], t[2], t[0], specs, pool,
+        config=EngineConfig(
+            chunk_size=2 * (n_dev // n_row), gather_mode="fused",
+            matrix_sharding="row", power_iters=30,
+        ),
+        mesh=mesh,
+    )
+    ref = PermutationEngine(
+        d[1], d[2], d[0], t[1], t[2], t[0], specs, pool,
+        config=EngineConfig(chunk_size=8, gather_mode="direct",
+                            power_iters=30),
+    )
+    n_perm = 2 * eng.effective_chunk()
+    out, done = eng.run_null(n_perm, key=11)
+    exp, _ = ref.run_null(n_perm, key=11)
+    assert done == n_perm
+    np.testing.assert_allclose(out, exp, rtol=1e-4, atol=1e-5)
+    # observed pass through the fused row-sharded gatherer
+    np.testing.assert_allclose(
+        eng.observed(), ref.observed(), rtol=1e-4, atol=1e-5
+    )
