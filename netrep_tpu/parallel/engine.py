@@ -339,13 +339,6 @@ class PermutationEngine:
         self.row_sharded = (
             mesh is not None and config.matrix_sharding == "row"
         )
-        if (config.gather_mode == "fused" and mesh is not None
-                and config.matrix_sharding != "row"):
-            raise ValueError(
-                "gather_mode='fused' with a mesh requires "
-                "matrix_sharding='row' (the kernel runs per-shard inside "
-                "shard_map); replicated+mesh runs use 'mxu'"
-            )
         if config.matrix_sharding not in ("replicated", "row"):
             raise ValueError(
                 f"matrix_sharding must be 'replicated' or 'row', got "
@@ -791,7 +784,29 @@ class PermutationEngine:
                 NamedSharding(self.mesh, P(cfg.mesh_axis))
                 for _ in self.buckets
             ]
-            jitted = jax.jit(chunk, out_shardings=out_shardings)
+            if self.gather_mode == "fused" and not self.row_sharded:
+                # Replicated matrices + perm-axis mesh: XLA's automatic
+                # partitioner cannot split a pallas_call, so the whole chunk
+                # runs under shard_map instead — each device evaluates its
+                # local key shard against the full (replicated) matrices;
+                # permutations are embarrassingly parallel, so the body
+                # needs no collectives. Specs: keys split on the perm axis,
+                # every matrix/disc-prop operand replicated (single specs
+                # broadcast over pytree operands).
+                from .sharded import _NO_CHECK_KW, _shard_map
+
+                smapped = _shard_map(
+                    chunk,
+                    mesh=self.mesh,
+                    # derive the replicated-spec count from the operand
+                    # tuple so a chunk-signature change cannot desync
+                    in_specs=(P(cfg.mesh_axis),) + (P(),) * len(args),
+                    out_specs=P(cfg.mesh_axis),
+                    **_NO_CHECK_KW,
+                )
+                jitted = jax.jit(smapped, out_shardings=out_shardings)
+            else:
+                jitted = jax.jit(chunk, out_shardings=out_shardings)
             if not keys_sharding.is_fully_addressable:
                 # Multi-host mesh: every operand of the jitted computation
                 # must be a global array. Matrices/disc-props are identical

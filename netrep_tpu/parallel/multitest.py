@@ -199,13 +199,14 @@ class MultiTestEngine:
                     out[t, b.module_pos] = np.asarray(res, dtype=np.float64)
         return out
 
-    def _build_fused_chunk(self, chunk_args) -> Callable:
-        """Fused-kernel chunk for the multi-test path: scan over perm
-        sub-batches; per batch the T cohorts loop over the SHARED index
-        blocks, each cohort's submatrices extracted by the one-pass Pallas
-        kernel (:mod:`netrep_tpu.ops.fused_gather`). Mirrors
+    def _fused_chunk_body(self) -> Callable:
+        """Unjitted fused-kernel chunk for the multi-test path: scan over
+        perm sub-batches; per batch the T cohorts loop over the SHARED
+        index blocks, each cohort's submatrices extracted by the one-pass
+        Pallas kernel (:mod:`netrep_tpu.ops.fused_gather`). Mirrors
         ``PermutationEngine``'s fused branch; T divides the batch so the
-        per-dispatch submatrix working set stays bounded."""
+        per-dispatch submatrix working set stays bounded. Jitting /
+        mesh-wrapping happens in :meth:`_finish_chunk`."""
         import jax
 
         from .engine import _idx_blocks, fused_scan, make_fused_gather
@@ -257,8 +258,45 @@ class MultiTestEngine:
                 for o in outs
             ]
 
-        jitted = jax.jit(chunk)
-        self._chunk_cached = lambda keys: jitted(keys, *chunk_args)
+        return chunk
+
+    def _finish_chunk(self, chunk, chunk_args, fused_rep: bool) -> Callable:
+        """Jit (and, with a mesh, shard) a chunk body. ``fused_rep`` marks
+        the fused replicated-matrices path, whose pallas_call XLA cannot
+        auto-partition: the whole chunk then runs under shard_map (keys
+        split on the perm axis, all other operands replicated — same
+        treatment as ``PermutationEngine._build_chunk_fn``)."""
+        cfg = self.config
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from .distributed import to_global
+
+            ksh = NamedSharding(self.mesh, P(cfg.mesh_axis))
+            osh = [
+                NamedSharding(self.mesh, P(None, cfg.mesh_axis))
+                for _ in self._base.buckets
+            ]
+            if fused_rep:
+                from .sharded import _NO_CHECK_KW, _shard_map
+
+                chunk = _shard_map(
+                    chunk,
+                    mesh=self.mesh,
+                    in_specs=(
+                        (P(cfg.mesh_axis),) + (P(),) * len(chunk_args)
+                    ),
+                    # outputs are (T, C, K, 7): perm axis is dim 1
+                    out_specs=P(None, cfg.mesh_axis),
+                    **_NO_CHECK_KW,
+                )
+            jitted = jax.jit(chunk, out_shardings=osh)
+            self._chunk_cached = lambda keys: jitted(
+                to_global(keys, ksh), *chunk_args
+            )
+        else:
+            jitted = jax.jit(chunk)
+            self._chunk_cached = lambda keys: jitted(keys, *chunk_args)
         return self._chunk_cached
 
     def _chunk_fn(self) -> Callable:
@@ -288,8 +326,10 @@ class MultiTestEngine:
         if row_sharded:
             from .sharded import gather_corr_net
 
-        if base.gather_mode == "fused" and not row_sharded:
-            return self._build_fused_chunk(chunk_args)
+        fused_rep = base.gather_mode == "fused" and not row_sharded
+        if fused_rep:
+            chunk = self._fused_chunk_body()
+            return self._finish_chunk(chunk, chunk_args, fused_rep=True)
 
         def chunk(keys, pool, tc, tn, td, discs):
             perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)
@@ -336,24 +376,7 @@ class MultiTestEngine:
                     ]))
             return outs
 
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            ksh = NamedSharding(self.mesh, P(cfg.mesh_axis))
-            osh = [
-                NamedSharding(self.mesh, P(None, cfg.mesh_axis))
-                for _ in base.buckets
-            ]
-            from .distributed import to_global
-
-            jitted = jax.jit(chunk, out_shardings=osh)
-            self._chunk_cached = lambda keys: jitted(
-                to_global(keys, ksh), *chunk_args
-            )
-        else:
-            jitted = jax.jit(chunk)
-            self._chunk_cached = lambda keys: jitted(keys, *chunk_args)
-        return self._chunk_cached
+        return self._finish_chunk(chunk, chunk_args, fused_rep=False)
 
     def _fingerprint_extra(self) -> bytes:
         """Checkpoint identity of the test side (_tc/_tn/_td are per-dataset

@@ -7,7 +7,6 @@ the same seed (selection is exact 0/1 arithmetic in f32 on CPU).
 """
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp
@@ -195,17 +194,68 @@ def test_multitest_fused_matches_default(rng):
     )
 
 
-def test_fused_rejects_replicated_mesh():
-    rng = np.random.default_rng(0)
-    d, t, specs, pool = _problem(rng)
+def test_fused_perm_mesh_replicated_matches_unmeshed(rng):
+    # replicated matrices + perm-axis mesh: the fused chunk runs under
+    # shard_map (XLA cannot auto-partition a pallas_call); same key =>
+    # same null as the unmeshed fused engine (mesh-invariance contract)
     from netrep_tpu.parallel.mesh import make_mesh
 
-    mesh = make_mesh(n_perm_shards=len(jax.devices("cpu")), n_row_shards=1)
-    with pytest.raises(ValueError, match="fused"):
-        PermutationEngine(
-            d[1], d[2], d[0], t[1], t[2], t[0], specs, pool,
-            config=EngineConfig(gather_mode="fused"), mesh=mesh,
-        )
+    d, t, specs, pool = _problem(rng)
+    n_dev = len(jax.devices("cpu"))
+    mesh = make_mesh(n_perm_shards=n_dev, n_row_shards=1)
+    eng = PermutationEngine(
+        d[1], d[2], d[0], t[1], t[2], t[0], specs, pool,
+        config=EngineConfig(chunk_size=2 * n_dev, gather_mode="fused",
+                            power_iters=30),
+        mesh=mesh,
+    )
+    ref = PermutationEngine(
+        d[1], d[2], d[0], t[1], t[2], t[0], specs, pool,
+        config=EngineConfig(chunk_size=8, gather_mode="fused",
+                            power_iters=30),
+    )
+    n_perm = 2 * eng.effective_chunk()
+    out, done = eng.run_null(n_perm, key=17)
+    exp, _ = ref.run_null(n_perm, key=17)
+    assert done == n_perm
+    np.testing.assert_allclose(out, exp, rtol=1e-5, atol=1e-6)
+
+
+def test_multitest_fused_perm_mesh_matches_unmeshed(rng):
+    # multi-test + fused + perm-axis mesh: chunk runs under shard_map —
+    # previously this combination silently ran single-device
+    from netrep_tpu.parallel.mesh import make_mesh
+    from netrep_tpu.parallel.multitest import MultiTestEngine
+
+    d, t, specs, pool = _problem(rng)
+    t2_data = t[0] + rng.standard_normal(t[0].shape) * 0.5
+    t2_corr = np.corrcoef(t2_data, rowvar=False)
+    t2_net = np.abs(t2_corr) ** 2
+    args = (
+        d[1], d[2], d[0],
+        np.stack([t[1], t2_corr]),
+        np.stack([t[2], t2_net]),
+        [t[0], t2_data],
+        specs, pool,
+    )
+    n_dev = len(jax.devices("cpu"))
+    mesh = make_mesh(n_perm_shards=n_dev, n_row_shards=1)
+    eng = MultiTestEngine(
+        *args,
+        config=EngineConfig(chunk_size=n_dev, gather_mode="fused",
+                            power_iters=30),
+        mesh=mesh,
+    )
+    ref = MultiTestEngine(
+        *args,
+        config=EngineConfig(chunk_size=4, gather_mode="fused",
+                            power_iters=30),
+    )
+    n_perm = 2 * eng._base.effective_chunk()
+    out, done = eng.run_null(n_perm, key=23)
+    exp, _ = ref.run_null(n_perm, key=23)
+    assert done == n_perm
+    np.testing.assert_allclose(out, exp, rtol=1e-5, atol=1e-6)
 
 
 def test_fused_row_sharded_matches_replicated(rng):
