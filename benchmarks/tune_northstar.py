@@ -1,7 +1,10 @@
-"""North-star tuning sweep: chunk size × perm_batch × dtype × power_iters
-on the real chip, at a reduced permutation count per point so the whole
-sweep stays under ~10 min. Prints one JSON line per point plus a final
-"best" line — feed the winner back into bench.py defaults if it beats them.
+"""North-star tuning sweep, two stages on the real chip: (1) the round-3
+DECISION grid — gather_mode (mxu/fused) × dtype (f32/bf16) × derived-net —
+8 points; (2) a chunk/perm_batch refinement around the stage-1 winner —
+4 more points. 12 points total, each paying a fresh jit compile (~20-40 s
+on TPU) plus the reduced-count run: budget ~15-20 min (tpu_watch.sh allows
+2400 s). Prints one JSON line per point plus a final "best" line — the
+winner decides what EngineConfig's accelerator defaults become.
 
 Usage: python benchmarks/tune_northstar.py [--perms 2048]
 """
@@ -43,21 +46,18 @@ def main():
     pool = np.arange(args.genes, dtype=np.int32)
 
     # each point pays a fresh jit compile (~20-40s on TPU) — keep the grid
-    # small: chunk × perm_batch around the current defaults, plus the bf16
-    # matrix variant the config supports but no bench has measured
-    grid = {
-        "chunk_size": [256, 512],
-        "perm_batch": [None, 4],
-        "dtype": ["float32", "bfloat16"],
-        "power_iters": [40],
-    }
-    best = None
-    for chunk, pb, dt, pi in itertools.product(
-        grid["chunk_size"], grid["perm_batch"], grid["dtype"],
-        grid["power_iters"],
-    ):
-        cfg = EngineConfig(chunk_size=chunk, perm_batch=pb, dtype=dt,
-                           power_iters=pi, summary_method="power")
+    # small. Primary sweep: the round-3 DECISION grid (gather_mode × dtype ×
+    # derived-net — which combination should become the accelerator default,
+    # VERDICT r2 item 3); then a refinement sweep of chunk/perm_batch around
+    # the winner.
+    def measure(chunk, pb, dt, pi, gm, derived):
+        cfg = EngineConfig(
+            chunk_size=chunk, perm_batch=pb, dtype=dt, power_iters=pi,
+            summary_method="power", gather_mode=gm,
+            network_from_correlation=2.0 if derived else None,
+        )
+        label = {"chunk": chunk, "perm_batch": pb, "dtype": dt,
+                 "gather_mode": gm, "derived_net": derived, "power_iters": pi}
         try:
             eng = PermutationEngine(
                 d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool,
@@ -67,19 +67,29 @@ def main():
             t0 = time.perf_counter()
             nulls, done = eng.run_null(args.perms, key=0)
             dt_s = time.perf_counter() - t0
-            ok = done == args.perms and np.isfinite(nulls).all()
-        except Exception as e:  # OOM etc: record and move on
-            print(json.dumps({"chunk": chunk, "perm_batch": pb, "dtype": dt,
-                              "power_iters": pi,
-                              "error": f"{type(e).__name__}"}))
-            continue
-        pps = args.perms / dt_s
-        row = {"chunk": chunk, "perm_batch": pb, "dtype": dt,
-               "power_iters": pi, "s": round(dt_s, 2),
-               "perms_per_sec": round(pps, 1), "ok": bool(ok)}
+            ok = done == args.perms and np.isfinite(np.asarray(nulls)).all()
+        except Exception as e:  # OOM, Mosaic compile failure etc: move on
+            print(json.dumps({**label, "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+            return None
+        row = {**label, "s": round(dt_s, 2),
+               "perms_per_sec": round(args.perms / dt_s, 1), "ok": bool(ok)}
         print(json.dumps(row), flush=True)
-        if ok and (best is None or pps > best["perms_per_sec"]):
+        return row if ok else None
+
+    best = None
+    for gm, dt, derived in itertools.product(
+        ["mxu", "fused"], ["float32", "bfloat16"], [False, True]
+    ):
+        row = measure(256, None, dt, 40, gm, derived)
+        if row and (best is None or row["perms_per_sec"] > best["perms_per_sec"]):
             best = row
+    if best is not None:
+        for chunk, pb in [(128, None), (512, None), (256, 4), (256, 64)]:
+            row = measure(chunk, pb, best["dtype"], 40,
+                          best["gather_mode"], best["derived_net"])
+            if row and row["perms_per_sec"] > best["perms_per_sec"]:
+                best = row
     print(json.dumps({"best": best, "device": str(jax.devices()[0])}))
     return 0
 
