@@ -37,6 +37,7 @@ QUEUE=(
   "sharded     1200 python benchmarks/microbench_sharded_gather.py"
   "configD     3600 python bench.py --config D"
   "configD_dn  3600 python bench.py --config D --derived-net"
+  "tune        2400 python benchmarks/tune_northstar.py"
 )
 
 probe() {

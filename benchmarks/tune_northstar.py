@@ -2,9 +2,10 @@
 DECISION grid — gather_mode (mxu/fused) × dtype (f32/bf16) × derived-net —
 8 points; (2) a chunk/perm_batch refinement around the stage-1 winner —
 4 more points. 12 points total, each paying a fresh jit compile (~20-40 s
-on TPU) plus the reduced-count run: budget ~15-20 min (tpu_watch.sh allows
-2400 s). Prints one JSON line per point plus a final "best" line — the
-winner decides what EngineConfig's accelerator defaults become.
+on TPU) plus the reduced-count run: budget ~15-20 min (the 2400 s timeouts
+in run_all_tpu.sh and tpu_watch.sh's "tune" entry allow it). Prints one
+JSON line per point plus a final "best" line — the winner decides what
+EngineConfig's accelerator defaults become.
 
 Usage: python benchmarks/tune_northstar.py [--perms 2048]
 """

@@ -54,6 +54,12 @@ _COL_TILE = 512
 # Max rows DMA'd/resident per grid step: bounds the VMEM rows buffer to
 # 128·(n rounded to tile)·itemsize (10.5 MB at n=20k f32).
 _ROW_BLOCK = 128
+# Outstanding row DMAs per grid step (semaphore-array size): a rolling
+# window — copy a reuses sem[a % _DMA_WINDOW] after waiting out its
+# previous user. 16 × 80 KB rows ≈ 1.3 MB in flight, ample to hide issue
+# latency, while keeping the semaphore footprint small (a per-row array of
+# up to 128 risks Mosaic resource limits).
+_DMA_WINDOW = 16
 
 
 def _kernel(rowidx_smem, M_ref, colidx_ref, own_ref, out_ref, rows_buf, sems,
@@ -68,7 +74,11 @@ def _kernel(rowidx_smem, M_ref, colidx_ref, own_ref, out_ref, rows_buf, sems,
     Refs: rowidx_smem (G, R) SMEM int32 (R = rb-padded row count); M_ref
     (n_rows, n_cols) HBM; colidx_ref (1, cap) VMEM int32; own_ref (1, rb)
     VMEM 0/1 row-ownership for THIS row block; out_ref (1, rb, cap) VMEM;
-    rows_buf (rb, n_tiles·tile) VMEM scratch; sems (rb,) DMA semaphores.
+    rows_buf (rb, n_tiles·tile) VMEM scratch; sems (min(rb, _DMA_WINDOW),)
+    DMA semaphores reused modularly — copy ``a`` rides slot
+    ``a % _DMA_WINDOW`` after waiting out that slot's previous copy, and
+    the tail drain waits only ``[rb - _DMA_WINDOW, rb)`` (earlier copies
+    were waited during the start loop; widening it would double-wait).
     """
     g = pl.program_id(0)
     r = pl.program_id(1)
@@ -78,27 +88,40 @@ def _kernel(rowidx_smem, M_ref, colidx_ref, own_ref, out_ref, rows_buf, sems,
         return pltpu.make_async_copy(
             M_ref.at[pl.ds(src, 1), :],
             rows_buf.at[pl.ds(a, 1), pl.ds(0, n_cols)],
-            sems.at[a],
+            sems.at[a % _DMA_WINDOW],
         )
 
-    # un-owned slots carry a NEGATIVE row index: their DMA is skipped
-    # entirely (a row-sharded shard fetches ONLY its own rows — aggregate
-    # row traffic stays cap·n, not D·cap·n) and their buffer content is
-    # ignored via the where-mask below.
+    def owned(a):
+        # un-owned slots carry a NEGATIVE row index: their DMA is skipped
+        # entirely (a row-sharded shard fetches ONLY its own rows —
+        # aggregate row traffic stays cap·n, not D·cap·n) and their buffer
+        # content is ignored via the where-mask below
+        return rowidx_smem[g, r * rb + a] >= 0
+
+    # rolling window: start copy a after waiting out the previous user of
+    # its semaphore slot (copy a - _DMA_WINDOW), then drain the tail
     def start(a, _):
-        @pl.when(rowidx_smem[g, r * rb + a] >= 0)
+        # index clamp: the guard predicate is ANDed with a >= window, but
+        # the operand itself must never read SMEM out of bounds
+        prev = jnp.maximum(a - _DMA_WINDOW, 0)
+
+        @pl.when((a >= _DMA_WINDOW) & owned(prev))
+        def _wait_prev():
+            row_copy(prev).wait()
+
+        @pl.when(owned(a))
         def _go():
             row_copy(a).start()
         return _
 
-    def wait(a, _):
-        @pl.when(rowidx_smem[g, r * rb + a] >= 0)
+    def drain(a, _):
+        @pl.when(owned(a))
         def _go():
             row_copy(a).wait()
         return _
 
     jax.lax.fori_loop(0, rb, start, None, unroll=8)
-    jax.lax.fori_loop(0, rb, wait, None, unroll=8)
+    jax.lax.fori_loop(max(0, rb - _DMA_WINDOW), rb, drain, None, unroll=8)
 
     cols = colidx_ref[0, :]                    # (cap,) int32
     own = own_ref[0, :]                        # (rb,) 0/1 for THIS block
@@ -176,7 +199,7 @@ def _run(M, row_idx, col_idx, own, *, interpret: bool, exact: bool):
         out_specs=pl.BlockSpec((1, rb, cap), lambda g, r, *_: (g, r, 0)),
         scratch_shapes=[
             pltpu.VMEM((rb, n_tiles * _COL_TILE), M.dtype),
-            pltpu.SemaphoreType.DMA((rb,)),
+            pltpu.SemaphoreType.DMA((min(rb, _DMA_WINDOW),)),
         ],
     )
     out = pl.pallas_call(

@@ -54,7 +54,9 @@ class EngineConfig:
         selection rides the MXU), 'fused' (Pallas one-pass kernel: per-row
         HBM→VMEM DMA + on-chip one-hot column select, no materialized row
         block or sort machinery — :mod:`netrep_tpu.ops.fused_gather`;
-        replicated matrices only, opt-in until TPU-measured), or 'auto'
+        composes with perm-axis meshes via shard_map, with
+        ``matrix_sharding='row'`` via a per-shard kernel + psum, and with
+        the multi-test engine; opt-in until TPU-measured), or 'auto'
         (mxu on TPU-like accelerators, direct on CPU). Value fidelity on
         the mxu and fused paths: XLA's
         default-precision f32 matmul truncates operands to bfloat16, so
