@@ -1,0 +1,53 @@
+"""Smoke-level CI guard for the bench CLI combinations the TPU watcher
+queue runs on tunnel recovery (benchmarks/tpu_watch.sh): a watcher step
+that crashes with the tunnel alive is skipped permanently after one retry,
+so a broken flag combination would silently cost a BASELINE row. Each case
+runs `bench.py --smoke` in a subprocess on the CPU backend and asserts one
+parseable JSON result line.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# every flag combination the watcher queue uses (plus native, which the
+# queue omits — it needs no TPU — but BASELINE rows rely on)
+CASES = [
+    [],
+    ["--dtype", "bfloat16"],
+    ["--derived-net"],
+    ["--dtype", "bfloat16", "--derived-net"],
+    ["--gather-mode", "fused"],
+    ["--gather-mode", "fused", "--dtype", "bfloat16", "--derived-net"],
+    ["--config", "B"],
+    ["--config", "C"],
+    ["--config", "C", "--genes", "900"],
+    ["--config", "D"],
+    ["--config", "D", "--derived-net"],
+    ["--config", "E"],
+    ["--config", "native"],
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("flags", CASES, ids=lambda f: " ".join(f) or "default")
+def test_bench_smoke_combination(flags):
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke", *flags],
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    if row.get("error") == "no C++ toolchain":
+        pytest.skip("no C++ toolchain on this machine")
+    assert "metric" in row and "error" not in row, row
+    assert row.get("value", 0) > 0 or "perms_per_sec_by_threads" in row, row
