@@ -77,8 +77,22 @@ def main() -> int:
     nulls, done = engine.run_null(4 * n_dev, key=21)
     assert done == 4 * n_dev
     assert np.isfinite(nulls).all()
+
+    # second engine, fused Pallas path on the same cross-process mesh: the
+    # shard_map-wrapped chunk must execute across processes and reproduce
+    # the same-seed null (interpret-mode kernel on CPU devices)
+    fused = PermutationEngine(
+        d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool,
+        config=EngineConfig(chunk_size=2 * n_dev, summary_method="power",
+                            power_iters=30, gather_mode="fused"),
+        mesh=mesh,
+    )
+    fnulls, fdone = fused.run_null(2 * n_dev, key=21)
+    assert fdone == 2 * n_dev
+    np.testing.assert_allclose(fnulls, nulls[: 2 * n_dev], atol=1e-4)
+
     np.save(args.out, nulls)
-    print(f"rank {args.process_id}: OK shape={nulls.shape}")
+    print(f"rank {args.process_id}: OK shape={nulls.shape} fused-parity-ok")
     return 0
 
 

@@ -26,7 +26,10 @@ CASES = [
     ["--gather-mode", "fused", "--dtype", "bfloat16", "--derived-net"],
     ["--config", "B"],
     ["--config", "C"],
-    ["--config", "C", "--genes", "900"],
+    # the watcher's reduced-genes C step; --genes must be passed WITHOUT
+    # --smoke to exercise the flag (smoke clobbers it), so keep perms tiny
+    ["--config", "C", "--genes", "900", "--modules", "4", "--perms", "32",
+     "--samples", "24"],
     ["--config", "D"],
     ["--config", "D", "--derived-net"],
     ["--config", "E"],
@@ -37,10 +40,22 @@ CASES = [
 @pytest.mark.slow
 @pytest.mark.parametrize("flags", CASES, ids=lambda f: " ".join(f) or "default")
 def test_bench_smoke_combination(flags):
+    # --smoke clobbers --genes/--modules/--perms; cases that exercise the
+    # explicit-shape flags (the watcher's reduced-genes C step) must run
+    # without it and carry their own tiny shape
+    cmd = [sys.executable, "bench.py"]
+    if "--genes" not in flags:
+        cmd.append("--smoke")
     proc = subprocess.run(
-        [sys.executable, "bench.py", "--smoke", *flags],
+        [*cmd, *flags],
         cwd=REPO,
-        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            # reuse the suite's persistent compile cache in the subprocess
+            # (conftest sets it via in-process jax.config only)
+            "JAX_COMPILATION_CACHE_DIR": os.path.join(REPO, ".jax_cache"),
+        },
         capture_output=True,
         text=True,
         timeout=600,
