@@ -16,6 +16,9 @@ LOG=${1:-/tmp/tpu_bench_results.jsonl}
 STATE=${2:-/tmp/tpu_watch_state}
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-60}
 SLEEP=${SLEEP:-150}
+# Hard stop (epoch seconds): libtpu is exclusive per process, so the watcher
+# must be gone before the driver's round-end bench needs the chip.
+CUTOFF_EPOCH=${CUTOFF_EPOCH:-}
 touch "$STATE"
 
 # Queue: "<key> <timeout_s> <command...>" — keys are the resume identity;
@@ -46,6 +49,10 @@ probe() {
 
 echo "== watcher start $(date -u +%FT%TZ) (log=$LOG state=$STATE) ==" | tee -a "$LOG"
 while :; do
+  if [ -n "$CUTOFF_EPOCH" ] && [ "$(date +%s)" -ge "$CUTOFF_EPOCH" ]; then
+    echo "== cutoff reached $(date -u +%FT%TZ); watcher exiting ==" | tee -a "$LOG"
+    exit 0
+  fi
   remaining=0
   for entry in "${QUEUE[@]}"; do
     key=${entry%% *}
@@ -59,6 +66,13 @@ while :; do
     for entry in "${QUEUE[@]}"; do
       read -r key tmo cmd <<<"$entry"
       grep -qx "$key" "$STATE" && continue
+      if [ -n "$CUTOFF_EPOCH" ] && \
+         [ "$(($(date +%s) + tmo))" -ge "$CUTOFF_EPOCH" ]; then
+        # a step whose timeout could cross the cutoff must not start: it
+        # would hold the exclusive TPU when the driver's bench needs it
+        echo "--- $key skipped: would cross cutoff ---" | tee -a "$LOG"
+        continue
+      fi
       echo "--- $key: $cmd ($(date -u +%FT%TZ)) ---" | tee -a "$LOG"
       if timeout "$tmo" bash -c "$cmd" 2>&1 | grep -v WARNING | tee -a "$LOG" \
          && [ "${PIPESTATUS[0]}" -eq 0 ]; then
