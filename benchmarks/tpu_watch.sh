@@ -19,6 +19,13 @@ SLEEP=${SLEEP:-150}
 # Hard stop (epoch seconds): libtpu is exclusive per process, so the watcher
 # must be gone before the driver's round-end bench needs the chip.
 CUTOFF_EPOCH=${CUTOFF_EPOCH:-}
+case "$CUTOFF_EPOCH" in
+  ''|*[!0-9]*)
+    if [ -n "$CUTOFF_EPOCH" ]; then
+      echo "CUTOFF_EPOCH must be epoch seconds (got '$CUTOFF_EPOCH')" >&2
+      exit 2
+    fi ;;
+esac
 touch "$STATE"
 
 # Queue: "<key> <timeout_s> <command...>" — keys are the resume identity;
@@ -49,9 +56,24 @@ probe() {
 
 echo "== watcher start $(date -u +%FT%TZ) (log=$LOG state=$STATE) ==" | tee -a "$LOG"
 while :; do
-  if [ -n "$CUTOFF_EPOCH" ] && [ "$(date +%s)" -ge "$CUTOFF_EPOCH" ]; then
-    echo "== cutoff reached $(date -u +%FT%TZ); watcher exiting ==" | tee -a "$LOG"
-    exit 0
+  # exit when the cutoff is reached, when the next probe could not finish
+  # before it, or when no unfinished step could ever start before it
+  if [ -n "$CUTOFF_EPOCH" ]; then
+    now=$(date +%s)
+    if [ "$((now + PROBE_TIMEOUT))" -ge "$CUTOFF_EPOCH" ]; then
+      echo "== cutoff window reached $(date -u +%FT%TZ); watcher exiting ==" | tee -a "$LOG"
+      exit 0
+    fi
+    startable=0
+    for entry in "${QUEUE[@]}"; do
+      read -r key tmo _ <<<"$entry"
+      grep -qx "$key" "$STATE" && continue
+      [ "$((now + tmo))" -lt "$CUTOFF_EPOCH" ] && startable=$((startable + 1))
+    done
+    if [ "$startable" -eq 0 ]; then
+      echo "== no step can finish before cutoff; watcher exiting $(date -u +%FT%TZ) ==" | tee -a "$LOG"
+      exit 0
+    fi
   fi
   remaining=0
   for entry in "${QUEUE[@]}"; do
