@@ -96,9 +96,22 @@ while :; do
         continue
       fi
       echo "--- $key: $cmd ($(date -u +%FT%TZ)) ---" | tee -a "$LOG"
-      if timeout "$tmo" bash -c "$cmd" 2>&1 | grep -v WARNING | tee -a "$LOG" \
-         && [ "${PIPESTATUS[0]}" -eq 0 ]; then
+      step_out=$(mktemp)
+      timeout "$tmo" bash -c "$cmd" 2>&1 | grep -v WARNING | tee -a "$LOG" "$step_out"
+      rc=${PIPESTATUS[0]}
+      # bench.py exits 0 on its own probe-race CPU-fallback rows, and the
+      # benchmark scripts that share bench.ensure_backend print its stderr
+      # "falling back to CPU" warning without the JSON marker; marking
+      # either done would silently lose the TPU measurement (ADVICE r3)
+      fellback=0
+      grep -qE '"tpu_fallback": true|falling back to CPU' "$step_out" \
+        && fellback=1
+      rm -f "$step_out"
+      if [ "$rc" -eq 0 ] && [ "$fellback" -eq 0 ]; then
         echo "$key" >>"$STATE"
+      elif [ "$fellback" -eq 1 ]; then
+        echo "--- $key emitted a CPU-fallback row (probe race); reprobing ---" | tee -a "$LOG"
+        break   # treat like a tunnel death: leave unmarked, fall back to probing
       elif probe; then
         # tunnel alive after the failure: could be a genuinely broken step
         # OR a mid-step outage whose tunnel recovered before the timeout

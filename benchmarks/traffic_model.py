@@ -1,0 +1,157 @@
+"""Analytic HBM-traffic model for the north-star hot loop (VERDICT r3 item
+6a: verify the fused kernel's one-pass traffic claim without a chip).
+
+Everything here is computed from the engine's own constants — module sizes
+from ``bench.make_specs``, bucket capacities from
+``EngineConfig.rounded_cap``, the fused kernel's DMA pattern from
+:mod:`netrep_tpu.ops.fused_gather` — plus exactly one measured anchor: the
+27.14 s north-star row (BASELINE.md, TPU v5 lite, 2026-07-29, mxu path).
+No reference numbers exist (SURVEY.md §0); the model's claims are:
+
+1. **One-pass bytes.** The fused kernel reads each selected row once
+   (HBM→VMEM DMA, skipping un-owned slots) and writes only the (cap, cap)
+   submatrix: per permutation ``Σ_b K_b·cap_b·n·itemsize`` per gathered
+   matrix plus ``Σ_b K_b·cap_b²·4`` out. The script recomputes this from
+   the caps and cross-checks it against the kernel's ``CostEstimate``
+   formula (same constants path the Mosaic scheduler sees).
+2. **Implied XLA pass count.** From the measured 2.714 ms/perm and the
+   one-pass byte count, back out how many effective HBM passes the XLA mxu
+   path makes at a given sustained bandwidth — the multiplier the fused
+   kernel removes.
+3. **Predicted fused north-star.** One-pass bytes at the same sustained
+   bandwidth the mxu measurement implies, for each (dtype, derived-net)
+   variant — the numbers ``benchmarks/tune_northstar.py`` will confirm or
+   refute the moment the tunnel returns.
+
+Usage: python benchmarks/traffic_model.py  (pure CPU arithmetic, instant).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import make_specs  # noqa: E402
+from netrep_tpu.utils.config import EngineConfig  # noqa: E402
+
+# Measured anchor: BASELINE.md north-star row (mxu path, f32, two matrices).
+MEASURED_S = 27.14
+N_PERM = 10_000
+GENES = 20_000
+MODULES = 50
+SAMPLES = 128
+# v5e peak HBM bandwidth (public spec, ~819 GB/s); the sustained fraction is
+# DERIVED from the anchor below, not assumed.
+PEAK_BW = 819e9
+
+
+def caps_for(genes, modules):
+    cfg = EngineConfig()
+    specs = make_specs(genes, modules)
+    return np.array([cfg.rounded_cap(len(s.disc_idx)) for s in specs])
+
+
+def one_pass_bytes(caps, n, itemsize, n_matrices, samples=None):
+    """Fused-kernel traffic per permutation: row DMAs once per gathered
+    matrix + (cap, cap) f32 outputs (+ the (cap, samples) data gather when
+    node contribution/data statistics are on)."""
+    rows = int(caps.sum()) * n * itemsize * n_matrices
+    outs = int((caps**2).sum()) * 4 * n_matrices
+    data = int(caps.sum()) * samples * 4 if samples else 0
+    return rows + outs + data
+
+
+def cost_estimate_bytes(caps, n, itemsize, n_matrices):
+    """The kernel's own CostEstimate formula (fused_gather._run), summed
+    over one permutation's instances (G=1 per module per matrix), using the
+    kernel's REAL row-block selection (`fused_gather._row_block`, including
+    the VMEM-guard downscale) so ``rpad`` — the padded out-block row count —
+    is what a launch at these shapes would actually report, not an
+    idealized rpad == cap."""
+    from netrep_tpu.ops.fused_gather import _row_block
+
+    total = 0
+    for cap in caps:
+        rb = _row_block(int(cap), n, itemsize)
+        rpad = -(-int(cap) // rb) * rb
+        total += n_matrices * (int(cap) * n * itemsize + rpad * int(cap) * 4)
+    return total
+
+
+def main():
+    caps = caps_for(GENES, MODULES)
+    t_perm = MEASURED_S / N_PERM
+
+    # --- claim 1: one-pass bytes, cross-checked against CostEstimate ---
+    # The kernel pads each bucket's out block to whole row blocks (rpad >=
+    # cap, VMEM-guard rb), so its CostEstimate sits slightly ABOVE the
+    # analytic ideal; the cross-check bounds that padding overhead instead
+    # of pretending the two formulas are identical.
+    b1_f32 = one_pass_bytes(caps, GENES, 4, 2, SAMPLES)
+    ce = cost_estimate_bytes(caps, GENES, 4, 2) + int(caps.sum()) * SAMPLES * 4
+    pad_overhead = ce / b1_f32 - 1.0
+    assert 0.0 <= pad_overhead < 0.02, (b1_f32, ce)
+
+    # --- claim 2: implied mxu pass count at the measured anchor ---
+    # sustained = bytes_actually_moved / t; with k effective passes over the
+    # one-pass row traffic, k = t * BW_sustained / b1. We bracket with the
+    # round-2 microbench sustained rate (235 GB/s ≈ 29% of peak was the
+    # ROOFLINE's estimate at its larger Σcap model; recompute both ways).
+    implied_bw_if_one_pass = b1_f32 / t_perm          # BW needed were mxu 1-pass
+    passes_at_60pct = t_perm * (0.6 * PEAK_BW) / b1_f32
+    passes_at_29pct = t_perm * (0.29 * PEAK_BW) / b1_f32
+
+    rows = [
+        {
+            "metric": "one-pass HBM bytes/perm, north-star f32 2-matrix "
+                      "(fused kernel analytic == its CostEstimate)",
+            "value": round(b1_f32 / 1e9, 4),
+            "unit": "GB",
+            "sum_cap": int(caps.sum()),
+            "cross_check": (
+                "kernel CostEstimate (real _row_block padding) exceeds the "
+                f"analytic ideal by {100 * pad_overhead:.2f}% — out-block "
+                "row padding only"
+            ),
+        },
+        {
+            "metric": "HBM bandwidth the 27.14s mxu row would need were it "
+                      "one-pass (lower => XLA makes extra passes)",
+            "value": round(implied_bw_if_one_pass / 1e9, 1),
+            "unit": "GB/s",
+            "peak_fraction": round(implied_bw_if_one_pass / PEAK_BW, 3),
+            "implied_passes_at_60pct_peak": round(passes_at_60pct, 2),
+            "implied_passes_at_29pct_peak": round(passes_at_29pct, 2),
+        },
+    ]
+
+    # --- claim 3: predicted fused north-star per variant ---
+    # Conservative sustained BW: whatever the mxu row achieved per byte of
+    # ONE pass (i.e., assume mxu was already one-pass => fused wins only via
+    # dtype/derived-net traffic cuts). Optimistic: 60% of peak (typical for
+    # well-pipelined DMA streams; the mxu row implies >= this if it makes
+    # >= implied_passes_at_60pct passes).
+    for label, itemsize, n_mat in [
+        ("f32 2-matrix", 4, 2),
+        ("f32 derived-net", 4, 1),
+        ("bf16 2-matrix", 2, 2),
+        ("bf16 derived-net", 2, 1),
+    ]:
+        b = one_pass_bytes(caps, GENES, itemsize, n_mat, SAMPLES)
+        rows.append({
+            "metric": f"predicted fused north-star, {label}",
+            "value": round(N_PERM * b / implied_bw_if_one_pass, 2),
+            "unit": "s (conservative: mxu-row-implied sustained BW)",
+            "optimistic_s": round(N_PERM * b / (0.6 * PEAK_BW), 2),
+            "bytes_per_perm_GB": round(b / 1e9, 4),
+        })
+    for r in rows:
+        print(json.dumps(r))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

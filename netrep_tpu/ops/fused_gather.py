@@ -60,6 +60,36 @@ _ROW_BLOCK = 128
 # latency, while keeping the semaphore footprint small (a per-row array of
 # up to 128 risks Mosaic resource limits).
 _DMA_WINDOW = 16
+# Budget for the VMEM rows scratch (ADVICE r3): rb·(n_tiles·_COL_TILE)·
+# itemsize is 10.5 MB at n=20k f32 with rb=128 — larger gene counts would
+# exceed TPU VMEM (~16 MiB/core, shared with the out block and one-hot
+# tiles) and fail Mosaic compilation. _run halves the row block until the
+# scratch fits this budget, or raises advising gather_mode='mxu'.
+_VMEM_BUDGET = 8 * 1024 * 1024
+
+
+def _row_block(cap: int, n_cols: int, itemsize: int) -> int:
+    """Row-block size for a fused-gather launch after the VMEM guard: start
+    at ``min(cap, _ROW_BLOCK)`` and shrink — halving, then rounding down to
+    a multiple of 8 to keep the rows/out blocks sublane-aligned, floor 8 —
+    until the ``rb x (col-tile-padded n_cols)`` scratch fits the budget.
+    Raises when even the floor doesn't fit. Module-level (not inlined in
+    ``_run``) so ``benchmarks/traffic_model.py`` can reproduce the kernel's
+    REAL padding in its CostEstimate cross-check."""
+    n_col_tiles = -(-n_cols // _COL_TILE)
+    row_bytes = n_col_tiles * _COL_TILE * itemsize
+    rb = min(cap, _ROW_BLOCK)
+    while rb > 8 and rb * row_bytes > _VMEM_BUDGET:
+        rb = max(8, (rb // 2) // 8 * 8)
+    if rb * row_bytes > _VMEM_BUDGET:
+        raise ValueError(
+            f"fused gather scratch needs {rb * row_bytes / 2**20:.1f} MiB of "
+            f"VMEM at the smallest row block ({rb} rows x {n_cols} cols, "
+            f"itemsize {itemsize}); over the {_VMEM_BUDGET / 2**20:.0f} MiB "
+            "budget — use gather_mode='mxu' (or bfloat16 storage) at this "
+            "scale"
+        )
+    return rb
 
 
 def _kernel(rowidx_smem, M_ref, colidx_ref, own_ref, out_ref, rows_buf, sems,
@@ -172,7 +202,7 @@ def _run(M, row_idx, col_idx, own, *, interpret: bool, exact: bool):
     ``own`` (G, cap) 0/1 row-ownership. Returns (G, cap, cap) f32."""
     n_rows, n_cols = M.shape
     G, cap = row_idx.shape
-    rb = min(cap, _ROW_BLOCK)
+    rb = _row_block(cap, n_cols, M.dtype.itemsize)
     n_row_blocks = -(-cap // rb)
     rpad = n_row_blocks * rb
     if rpad != cap:

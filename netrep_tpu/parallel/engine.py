@@ -248,13 +248,16 @@ def make_fused_gather(cfg: EngineConfig):
     single-test and multi-test fused chunk paths: CPU runs the interpreter
     (CI coverage), and ``fused_exact`` applies only off-CPU where plain
     dots are not already exact — one definition so the precision gating
-    cannot drift between engines."""
+    cannot drift between engines. ``fused_exact='always'`` overrides the
+    CPU gate so CI exercises the hi/lo engine path in interpret mode
+    (VERDICT r3: its first execution must not be on a TPU mid-benchmark)."""
     from ..ops.fused_gather import gather_submatrix_fused as _gsf
 
     on_cpu = jax.default_backend() == "cpu"
-    return partial(
-        _gsf, interpret=on_cpu, exact=cfg.fused_exact and not on_cpu
+    exact = bool(cfg.fused_exact) and (
+        cfg.fused_exact == "always" or not on_cpu
     )
+    return partial(_gsf, interpret=on_cpu, exact=exact)
 
 
 def fused_scan(keys, B: int, batch_body):

@@ -219,7 +219,11 @@ class MultiTestEngine:
         net_beta = self.net_beta
         caps_slices = [(b.cap, tuple(b.slices)) for b in base.buckets]
         gsf = make_fused_gather(cfg)
-        pb = cfg.resolved_perm_batch("fused", jax.default_backend(), 1 << 30)
+        # real effective chunk (not a sentinel) so an explicit cfg.perm_batch
+        # clamps exactly like the single-test engine's (ADVICE r3)
+        pb = cfg.resolved_perm_batch(
+            "fused", jax.default_backend(), base.effective_chunk()
+        )
         perm_batch = max(1, pb // T)
 
         def chunk(keys, pool, tc, tn, td, discs):

@@ -102,10 +102,19 @@ class EngineConfig:
     #: traffic (2x non-dominant FLOPs), vs ~10x cost for gather_mode=
     #: 'direct', the other exact-on-TPU option. No effect on CPU (exact
     #: anyway) or bf16 storage (stored values always selected bit-true).
-    fused_exact: bool = False
+    #: 'always' forces the split arithmetic even on CPU (interpret mode) —
+    #: CI coverage of the exact engine path, not a user-facing speedup.
+    fused_exact: bool | str = False
     perm_batch: int | None = None
     network_from_correlation: float | None = None
     mxu_batch_budget_bytes: int = 2 << 30
+
+    def __post_init__(self):
+        if self.fused_exact not in (True, False, "always"):
+            raise ValueError(
+                "fused_exact must be True, False, or 'always' (force the "
+                f"hi/lo split even on CPU, for CI); got {self.fused_exact!r}"
+            )
 
     def resolved_gather_mode(self, platform: str) -> str:
         if self.gather_mode == "auto":

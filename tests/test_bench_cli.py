@@ -38,6 +38,66 @@ CASES = [
 
 
 @pytest.mark.slow
+def test_bench_config_d_resumes_from_checkpoint():
+    # Config-D-shaped resumable smoke (VERDICT r3 item 6b): a partial
+    # checkpoint left by a mid-run tunnel death must be resumed by the next
+    # bench.py invocation (same stable path), the emitted row must say so,
+    # and the file must be cleaned up on success. Shape is unique to this
+    # test (not --smoke) so xdist neighbors can't race on the checkpoint.
+    import tempfile
+
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    import bench
+    from netrep_tpu.parallel.engine import PermutationEngine
+    from netrep_tpu.utils.config import EngineConfig
+
+    genes, modules, samples, perms, chunk = 900, 4, 24, 48, 16
+    (d_data, d_corr, d_net), (t_data, t_corr, t_net) = bench.build_problem(
+        genes, modules, samples
+    )
+    specs = bench.make_specs(genes, modules, 30, 200)
+    pool = np.arange(genes, dtype=np.int32)
+    engine = PermutationEngine(
+        d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool,
+        config=EngineConfig(chunk_size=chunk, power_iters=40,
+                            gather_mode="auto"),
+    )
+    ck = os.path.join(
+        tempfile.gettempdir(),
+        f"netrep_bench_d_{genes}x{modules}x{samples}x{perms}.npz",
+    )
+    if os.path.exists(ck):
+        os.remove(ck)
+    # simulate the dead-tunnel partial run bench_d would leave behind:
+    # same problem, same key=0 timing seed, a third of the permutations
+    nulls, done = engine.run_null(chunk, key=0, checkpoint_path=ck,
+                                  checkpoint_every=chunk)
+    assert done == chunk and os.path.exists(ck)
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--config", "D",
+         "--genes", str(genes), "--modules", str(modules),
+         "--samples", str(samples), "--perms", str(perms),
+         "--chunk", str(chunk)],
+        cwd=REPO,
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "JAX_COMPILATION_CACHE_DIR": os.path.join(REPO, ".jax_cache"),
+        },
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert f"resumed at {chunk}" in row["metric"], row
+    assert row["value"] > 0, row
+    assert not os.path.exists(ck)  # removed on success
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("flags", CASES, ids=lambda f: " ".join(f) or "default")
 def test_bench_smoke_combination(flags):
     # --smoke clobbers --genes/--modules/--perms; cases that exercise the
