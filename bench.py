@@ -63,6 +63,16 @@ def ensure_backend(probe_timeout: float | None = None):
         honor_explicit_platform, probe_default_backend, tunnel_expected,
     )
 
+    if os.environ.get("NETREP_FORCE_TPU_FALLBACK"):
+        # set by run_shielded's second attempt after the TPU child hung:
+        # behave exactly like a probe-detected dead tunnel (reduced-count
+        # projected rows / explicit skip rows, tpu_fallback markers)
+        jax.config.update("jax_platforms", "cpu")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        global TPU_FALLBACK
+        TPU_FALLBACK = True
+        return jax.devices()
+
     if probe_timeout is None:
         try:
             probe_timeout = float(
@@ -96,7 +106,6 @@ def ensure_backend(probe_timeout: float | None = None):
             }), file=sys.stderr)
             jax.config.update("jax_platforms", "cpu")
             os.environ["JAX_PLATFORMS"] = "cpu"
-            global TPU_FALLBACK
             TPU_FALLBACK = True
             return jax.devices()
     try:
@@ -583,6 +592,88 @@ def bench_e(args):
     })
 
 
+def run_shielded(args):
+    """Round-2's failure mode, second line of defense: a tunnel death
+    MID-RUN leaves device calls blocked in gRPC with no deadline — the
+    benchmark hangs and the driver records nothing (ensure_backend's probe
+    only protects startup). Run the TPU-touching configs in a killable
+    child instead: on timeout the child is killed and re-run once as an
+    explicit CPU fallback (NETREP_FORCE_TPU_FALLBACK → reduced-count
+    projected rows / skip rows, tpu_fallback markers); if even that times
+    out, emit an error row. Every path ends in one parseable JSON line.
+    ``NETREP_BENCH_TIMEOUT`` overrides the per-attempt budget."""
+    import os
+    import subprocess
+
+    import signal
+
+    default_tmo = {"D": 5400.0}.get(args.config, 1800.0)
+    try:
+        tmo = float(os.environ.get("NETREP_BENCH_TIMEOUT", default_tmo))
+    except ValueError:
+        tmo = default_tmo
+    cmd = [sys.executable, os.path.abspath(__file__), *sys.argv[1:]]
+
+    def _sigterm(signum, frame):
+        raise SystemExit(143)
+
+    def attempt(env):
+        # Popen + explicit kill (not subprocess.run): if THIS process is
+        # SIGTERMed (an outer watchdog), the libtpu-holding child must die
+        # with it or it would hold the exclusive chip as an orphan; the
+        # handler turns SIGTERM into SystemExit so the finally runs, and is
+        # installed BEFORE the fork so no window exists where the default
+        # disposition could kill the parent with a live child
+        prev = signal.signal(signal.SIGTERM, _sigterm)
+        child = None
+        try:
+            # new session => the child leads a process group, so the kill
+            # reaches grandchildren too (--config sharded spawns the
+            # microbench as a grandchild that would otherwise orphan alive
+            # holding the exclusive chip)
+            child = subprocess.Popen(cmd, env=env, start_new_session=True)
+            return child.wait(timeout=tmo)
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+            if child is not None and child.poll() is None:
+                try:
+                    os.killpg(child.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    child.kill()
+                child.wait()
+
+    try:
+        return attempt({**os.environ, "NETREP_BENCH_NO_SUBPROC": "1"})
+    except subprocess.TimeoutExpired:
+        if args.config == "sharded":
+            # the sharded microbench has no reduced-count CPU path and no
+            # tpu_fallback row markers — a fallback retry would burn the
+            # full budget again on a meaningless full-size CPU run
+            return emit({
+                "metric": "Config sharded",
+                "error": f"benchmark timed out ({tmo:.0f}s): TPU attempt "
+                         "hung (tunnel death mid-run?)",
+                "tpu_fallback": True,
+            })
+        print(json.dumps({
+            "metric": "bench shield",
+            "warning": f"benchmark child exceeded {tmo:.0f}s (tunnel death "
+                       "mid-run?); killed, retrying as explicit CPU fallback",
+        }), file=sys.stderr)
+    try:
+        return attempt({
+            **os.environ, "NETREP_BENCH_NO_SUBPROC": "1",
+            "NETREP_FORCE_TPU_FALLBACK": "1", "JAX_PLATFORMS": "cpu",
+        })
+    except subprocess.TimeoutExpired:
+        return emit({
+            "metric": f"Config {args.config}",
+            "error": f"benchmark timed out twice ({tmo:.0f}s each): TPU "
+                     "attempt hung and the CPU fallback did not finish",
+            "tpu_fallback": True,
+        })
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="north",
@@ -611,10 +702,26 @@ def main():
             500, 5, 64, 32, 32
         )
 
+    import os
+
+    from netrep_tpu.utils.backend import tunnel_expected
+
+    if (args.config in ("north", "A", "B", "C", "D", "E", "sharded")
+            and tunnel_expected()
+            and not os.environ.get("NETREP_BENCH_NO_SUBPROC")):
+        # every config that may touch the tunnel backend (A runs the JAX
+        # engine on the default backend too; sharded's microbench child
+        # would otherwise hang unkillably) runs in a killable child (see
+        # run_shielded); the env var marks the child so it executes
+        # directly. Only when the tunnel could actually be dialed: an
+        # explicit JAX_PLATFORMS=cpu run must not be killed at a TPU-sized
+        # timeout and mislabeled a dead tunnel. oracle/native force CPU
+        # themselves and are exempt either way.
+        return run_shielded(args)
+
     if args.config == "sharded":
         # dispatch BEFORE ensure_backend(): libtpu is exclusive per process,
         # so the parent must not acquire the chip the child needs
-        import os
         import subprocess
 
         return subprocess.call([
@@ -630,8 +737,6 @@ def main():
         # exact situation where the CPU baseline is the only runnable bench).
         # Both the live config AND the env var flip: ensure_backend's hang
         # probe triggers off the env var.
-        import os
-
         import jax
 
         jax.config.update("jax_platforms", "cpu")

@@ -142,12 +142,40 @@ def main():
         from netrep_tpu.ops.fused_gather import gather_submatrix_fused
 
         idx_flat = idx.reshape(B * K, cap)
+        on_cpu = jax.default_backend() == "cpu"  # interpreter there, like
+        # the engine's make_fused_gather — so a CPU run still exercises the
+        # parity code below instead of skipping the whole section
         for name, Mx in [("f32", M), ("bf16", M16)]:  # M16 defined above
-            f = jax.jit(lambda Mm, ix: gather_submatrix_fused(Mm, ix))
+            f = jax.jit(
+                lambda Mm, ix: gather_submatrix_fused(Mm, ix, interpret=on_cpu)
+            )
+            # PARITY FIRST (VERDICT r3 item 3): the first fused-kernel step
+            # on real Mosaic must be a small correctness check, not a
+            # benchmark — a silent miscompile here would poison every
+            # fused row after it. bf16/f32 MXU selection rounding bounds
+            # the tolerance (exact would be == for bf16 storage).
+            got = np.asarray(f(Mx, idx_flat))[0]
+            want = np.asarray(Mx)[np.asarray(idx_flat)[0][:, None],
+                                  np.asarray(idx_flat)[0][None, :]]
+            err = np.abs(got - want.astype(np.float32)).max()
+            scale = max(1e-9, np.abs(want.astype(np.float32)).max())
+            assert err / scale < 2e-2, (
+                f"pallas fused parity FAILED ({name}): rel err {err/scale:.2e}"
+            )
+            print(f"pallas fused parity {name}: rel err {err/scale:.2e} ok")
+            if on_cpu:
+                # parity is the point here; interpreter timings would land
+                # in the shared log in the same format as real TPU decision
+                # rows and poison the gather_mode flip data
+                print(f"pallas fused gather {name}: parity-only on CPU "
+                      "(interpret-mode timing suppressed)")
+                continue
             t = bench(f, Mx, idx_flat, reps=args.reps)
             nb = B * K * cap * n * Mx.dtype.itemsize
             print(f"pallas fused gather {name}:    {t*1e3:8.2f} ms  "
                   f"({nb/t/1e9:6.1f} GB/s rows, {FL/t/1e12:5.1f} TFLOP/s eq)")
+    except AssertionError:
+        raise  # parity failure must be LOUD, never a SKIPPED line
     except Exception as e:  # pallas unavailable on this backend
         print(f"pallas fused gather: SKIPPED ({type(e).__name__}: {e})")
 

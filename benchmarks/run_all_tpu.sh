@@ -9,7 +9,11 @@ echo "== $(date -u +%FT%TZ) TPU bench sweep ==" | tee -a "$LOG"
 
 run() {
   echo "--- $* ---" | tee -a "$LOG"
-  timeout "${T:-900}" "$@" 2>&1 | grep -v WARNING | tee -a "$LOG"
+  # this script IS the timeout layer (like tpu_watch.sh): disable bench.py's
+  # subprocess shield, whose larger budgets would never engage under the
+  # shorter outer T values and whose extra layer buys nothing here
+  NETREP_BENCH_NO_SUBPROC=1 timeout "${T:-900}" "$@" 2>&1 \
+    | grep -v WARNING | tee -a "$LOG"
 }
 
 T=300  run python bench.py --smoke                     # tunnel sanity

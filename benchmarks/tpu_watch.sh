@@ -97,7 +97,11 @@ while :; do
       fi
       echo "--- $key: $cmd ($(date -u +%FT%TZ)) ---" | tee -a "$LOG"
       step_out=$(mktemp)
-      timeout "$tmo" bash -c "$cmd" 2>&1 | grep -v WARNING | tee -a "$LOG" "$step_out"
+      # NO_SUBPROC: the watcher IS the timeout layer; bench.py's subprocess
+      # shield would otherwise orphan a chip-holding child when this
+      # timeout fires (timeout signals only the direct child)
+      timeout "$tmo" env NETREP_BENCH_NO_SUBPROC=1 bash -c "$cmd" 2>&1 \
+        | grep -v WARNING | tee -a "$LOG" "$step_out"
       rc=${PIPESTATUS[0]}
       # bench.py exits 0 on its own probe-race CPU-fallback rows, and the
       # benchmark scripts that share bench.ensure_backend print its stderr

@@ -63,33 +63,39 @@ _DMA_WINDOW = 16
 # Budget for the VMEM rows scratch (ADVICE r3): rb·(n_tiles·_COL_TILE)·
 # itemsize is 10.5 MB at n=20k f32 with rb=128 — larger gene counts would
 # exceed TPU VMEM (~16 MiB/core, shared with the out block and one-hot
-# tiles) and fail Mosaic compilation. _run halves the row block until the
-# scratch fits this budget, or raises advising gather_mode='mxu'.
+# tiles) and fail Mosaic compilation. _row_block picks the minimal-padding
+# sublane-aligned block whose scratch fits this budget, or raises advising
+# gather_mode='mxu'.
 _VMEM_BUDGET = 8 * 1024 * 1024
 
 
 def _row_block(cap: int, n_cols: int, itemsize: int) -> int:
-    """Row-block size for a fused-gather launch after the VMEM guard: start
-    at ``min(cap, _ROW_BLOCK)`` and shrink — halving, then rounding down to
-    a multiple of 8 to keep the rows/out blocks sublane-aligned, floor 8 —
-    until the ``rb x (col-tile-padded n_cols)`` scratch fits the budget.
-    Raises when even the floor doesn't fit. Module-level (not inlined in
-    ``_run``) so ``benchmarks/traffic_model.py`` can reproduce the kernel's
-    REAL padding in its CostEstimate cross-check."""
+    """Row-block size for a fused-gather launch after the VMEM guard.
+    Two-step choice: (1) the largest sublane-aligned block that fits the
+    ``rb x (col-tile-padded n_cols)`` scratch budget fixes the grid-step
+    count ``k = ceil(cap / limit)``; (2) within that step count, the
+    SMALLEST aligned block — padded rows skip their DMA but still pay the
+    select matmul and out-block writes, so minimizing ``k·rb - cap``
+    matters more than maximizing rb (e.g. cap=128 at n=20k f32 → rb=64,
+    two zero-pad steps, not rb=96 → 64 padded rows). Raises when even the
+    smallest block busts the budget. Module-level (not inlined in ``_run``)
+    so ``benchmarks/traffic_model.py`` can reproduce the kernel's REAL
+    padding in its CostEstimate cross-check."""
     n_col_tiles = -(-n_cols // _COL_TILE)
     row_bytes = n_col_tiles * _COL_TILE * itemsize
-    rb = min(cap, _ROW_BLOCK)
-    while rb > 8 and rb * row_bytes > _VMEM_BUDGET:
-        rb = max(8, (rb // 2) // 8 * 8)
-    if rb * row_bytes > _VMEM_BUDGET:
+    fit = max(8, _VMEM_BUDGET // row_bytes // 8 * 8)
+    limit = min(cap, _ROW_BLOCK, fit)
+    if limit * row_bytes > _VMEM_BUDGET:
         raise ValueError(
-            f"fused gather scratch needs {rb * row_bytes / 2**20:.1f} MiB of "
-            f"VMEM at the smallest row block ({rb} rows x {n_cols} cols, "
-            f"itemsize {itemsize}); over the {_VMEM_BUDGET / 2**20:.0f} MiB "
-            "budget — use gather_mode='mxu' (or bfloat16 storage) at this "
-            "scale"
+            f"fused gather scratch needs {limit * row_bytes / 2**20:.1f} MiB "
+            f"of VMEM at the smallest row block ({limit} rows x {n_cols} "
+            f"cols, itemsize {itemsize}); over the "
+            f"{_VMEM_BUDGET / 2**20:.0f} MiB budget — use gather_mode='mxu' "
+            "(or bfloat16 storage) at this scale"
         )
-    return rb
+    k = -(-cap // limit)            # grid steps at the largest fitting block
+    rows_per_step = -(-cap // k)    # smallest block covering cap in k steps
+    return min(limit, (rows_per_step + 7) // 8 * 8)
 
 
 def _kernel(rowidx_smem, M_ref, colidx_ref, own_ref, out_ref, rows_buf, sems,

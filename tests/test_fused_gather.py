@@ -87,6 +87,31 @@ def test_kernel_exact_mode_hilo(rng):
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=1e-4)
 
 
+def test_row_block_selection_at_real_scales():
+    # pure arithmetic — pins the VMEM guard's behavior at the gene counts
+    # users actually hit, against the default 8 MiB budget
+    from netrep_tpu.ops.fused_gather import _COL_TILE, _ROW_BLOCK, _row_block
+
+    # n=20k f32: ceil(20000/512)*512*4 = 80 KiB/row; 128 rows = 10 MiB > 8
+    # (ADVICE r3 flagged the full block as a Mosaic-compile risk) -> 96
+    # fits, but two steps are needed either way, so the minimal-padding
+    # block for 2 steps is 64 (rpad == cap, zero padded select FLOPs)
+    assert _row_block(128, 20_000, 4) == 64
+    assert _row_block(128, 20_000, 2) == 128   # bf16 halves the row bytes
+    assert _row_block(160, 20_000, 4) == 80    # 2 steps, zero pad (not 96)
+    assert _row_block(96, 100_000, 4) == 16    # review r4: halving gave 8
+    assert _row_block(128, 30_000, 4) == 64    # ADVICE r3's failing case
+    assert _row_block(128, 100_000, 4) == 16
+    assert _row_block(24, 600, 4) == 24        # small problems untouched
+    # alignment: every guarded result is a multiple of 8 (or == cap < 8)
+    for n in (20_000, 50_000, 100_000, 250_000):
+        rb = _row_block(128, n, 4)
+        assert rb % 8 == 0 and 8 <= rb <= _ROW_BLOCK, (n, rb)
+    with np.testing.assert_raises_regex(ValueError, "gather_mode='mxu'"):
+        _row_block(128, 3_000_000, 4)          # 8 rows still ~93 MiB
+    assert _COL_TILE % 128 == 0                # lane alignment invariant
+
+
 def test_kernel_vmem_guard_downscales_row_block(rng, monkeypatch):
     # a small VMEM budget must shrink the row block (ADVICE r3: large n
     # would otherwise exceed VMEM and fail Mosaic compilation) without
