@@ -634,13 +634,16 @@ def run_shielded(args):
             child = subprocess.Popen(cmd, env=env, start_new_session=True)
             return child.wait(timeout=tmo)
         finally:
-            signal.signal(signal.SIGTERM, prev)
+            # kill FIRST, restore the handler LAST: restoring first would
+            # reopen a window where a SIGTERM kills this parent with the
+            # default disposition before the child group dies
             if child is not None and child.poll() is None:
                 try:
                     os.killpg(child.pid, signal.SIGKILL)
                 except (ProcessLookupError, PermissionError):
                     child.kill()
                 child.wait()
+            signal.signal(signal.SIGTERM, prev)
 
     try:
         return attempt({**os.environ, "NETREP_BENCH_NO_SUBPROC": "1"})

@@ -154,9 +154,10 @@ def main():
             # benchmark — a silent miscompile here would poison every
             # fused row after it. bf16/f32 MXU selection rounding bounds
             # the tolerance (exact would be == for bf16 storage).
-            got = np.asarray(f(Mx, idx_flat))[0]
-            want = np.asarray(Mx)[np.asarray(idx_flat)[0][:, None],
-                                  np.asarray(idx_flat)[0][None, :]]
+            got = np.asarray(f(Mx, idx_flat))   # ALL B*K grid entries — a
+            # miscompile limited to g>0 grid steps must not slip through
+            ih = np.asarray(idx_flat)
+            want = np.asarray(Mx)[ih[:, :, None], ih[:, None, :]]
             err = np.abs(got - want.astype(np.float32)).max()
             scale = max(1e-9, np.abs(want.astype(np.float32)).max())
             assert err / scale < 2e-2, (

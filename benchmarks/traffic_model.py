@@ -48,8 +48,8 @@ SAMPLES = 128
 PEAK_BW = 819e9
 
 
-def caps_for(genes, modules):
-    cfg = EngineConfig()
+def caps_for(genes, modules, cap_granularity=32):
+    cfg = EngineConfig(cap_granularity=cap_granularity)
     specs = make_specs(genes, modules)
     return np.array([cfg.rounded_cap(len(s.disc_idx)) for s in specs])
 
@@ -148,6 +148,19 @@ def main():
             "optimistic_s": round(N_PERM * b / (0.6 * PEAK_BW), 2),
             "bytes_per_perm_GB": round(b / 1e9, 4),
         })
+    # --- bucket-granularity lever (EngineConfig.cap_granularity) ---------
+    caps8 = caps_for(GENES, MODULES, cap_granularity=8)
+    b8 = one_pass_bytes(caps8, GENES, 4, 2, SAMPLES)
+    rows.append({
+        "metric": "cap_granularity=8 vs 32: one-pass bytes/perm, f32 "
+                  "2-matrix (padding share of the bandwidth-bound traffic)",
+        "value": round(b8 / 1e9, 4),
+        "unit": "GB",
+        "sum_cap": int(caps8.sum()),
+        "vs_g32": round(b8 / b1_f32, 4),
+        "distinct_caps_g8": int(np.unique(caps8).size),
+        "distinct_caps_g32": int(np.unique(caps).size),
+    })
     for r in rows:
         print(json.dumps(r))
     return 0

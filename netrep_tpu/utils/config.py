@@ -93,6 +93,14 @@ class EngineConfig:
     summary_method: str = "power"
     power_iters: int = 60
     bucket_rounding: int = 8
+    #: bucket capacities above 32 round up to multiples of this (min 8,
+    #: multiple of 8 for sublane alignment). The hot loop's row traffic is
+    #: linear in Σcap, so finer granularity cuts the padding fraction of
+    #: the bandwidth-bound gather (~16% of Σcap at north-star module sizes
+    #: for 8 vs 32) — at the price of more distinct per-bucket compiled
+    #: programs (compile-time only; ~4x more caps at north-star sizes).
+    #: Kept at 32 until the tune sweep measures 8 faster on TPU.
+    cap_granularity: int = 32
     dtype: str = "float32"
     mesh_axis: str = "perm"
     matrix_sharding: str = "replicated"
@@ -114,6 +122,11 @@ class EngineConfig:
             raise ValueError(
                 "fused_exact must be True, False, or 'always' (force the "
                 f"hi/lo split even on CPU, for CI); got {self.fused_exact!r}"
+            )
+        if self.cap_granularity < 8 or self.cap_granularity % 8:
+            raise ValueError(
+                "cap_granularity must be a multiple of 8 (sublane "
+                f"alignment), >= 8; got {self.cap_granularity!r}"
             )
 
     def resolved_gather_mode(self, platform: str) -> str:
@@ -157,15 +170,19 @@ class EngineConfig:
 
     def rounded_cap(self, size: int) -> int:
         """Bucket capacity for a module of ``size`` nodes: powers of two up
-        to 32, then multiples of 32. The dominant hot-loop cost is the
+        to ``max(32, cap_granularity)``, then multiples of
+        ``cap_granularity`` (default 32). The dominant hot-loop cost is the
         (Σ K_b·cap_b, n) row-block traffic, linear in Σcap — multiple-of-32
         rounding wastes ≤31 padded rows per module where power-of-two
         rounding wasted up to 2x (measured ~20% less row traffic at
         north-star module sizes), while staying sublane-aligned (8) for the
-        row blocks. Per-bucket programs still compile once per cap."""
+        row blocks; ``cap_granularity=8`` trims the residual padding
+        (~16% of Σcap at north-star sizes) for ~4x more compiled bucket
+        programs. Per-bucket programs still compile once per cap."""
+        g = self.cap_granularity
         cap = self.bucket_rounding
-        while cap < size and cap < 32:
+        while cap < size and cap < max(32, g):
             cap *= 2
         if size <= cap:
             return cap
-        return -(-size // 32) * 32
+        return -(-size // g) * g

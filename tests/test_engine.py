@@ -79,6 +79,32 @@ def test_null_reproducible_and_chunk_invariant(setup):
     assert np.abs(n1 - n3).max() > 1e-3  # different key → different null
 
 
+def test_rounded_cap_granularity():
+    # default: powers of two to 32, then multiples of 32; granularity 8
+    # keeps the small-module ramp but trims padding above 32 — the row
+    # traffic knob for the bandwidth-bound hot loop
+    c32, c8 = EngineConfig(), EngineConfig(cap_granularity=8)
+    assert [c32.rounded_cap(s) for s in (3, 8, 20, 30, 33, 90, 200)] == \
+           [8, 8, 32, 32, 64, 96, 224]
+    assert [c8.rounded_cap(s) for s in (3, 8, 20, 30, 33, 90, 200)] == \
+           [8, 8, 32, 32, 40, 96, 200]
+    assert EngineConfig(cap_granularity=64).rounded_cap(90) == 128
+    for bad in (4, 12, 0):
+        with pytest.raises(ValueError):
+            EngineConfig(cap_granularity=bad)
+
+
+def test_null_invariant_under_cap_granularity(setup):
+    # masked nodes must be provably inert: the same seed's null may not
+    # move when bucket padding changes (granularity 8 vs 32 changes cap
+    # shapes only, never which nodes are real)
+    n1, _ = _engine(setup).run_null(16, key=5)
+    eng8 = _engine(setup, config=EngineConfig(
+        chunk_size=16, summary_method="eigh", cap_granularity=8))
+    n2, _ = eng8.run_null(16, key=5)
+    np.testing.assert_allclose(n1, n2, rtol=1e-5, atol=1e-6)
+
+
 def test_null_statistics_are_calibrated(setup):
     """Null values computed by the engine match the oracle's permutation
     procedure *distributionally* (SURVEY.md §7 'RNG semantics': statistical
