@@ -53,6 +53,25 @@ def honor_explicit_platform():
         return jax.devices()
 
 
+def enable_persistent_cache(repo_root: str | None = None) -> None:
+    """Point JAX's persistent compilation cache at the repo-local
+    ``.jax_cache`` dir (gitignored). Shared by ``tests/conftest.py`` and
+    ``__graft_entry__.dryrun_multichip`` so the two bootstraps cannot
+    diverge (dir or thresholds). A miss compiles exactly as before."""
+    import jax
+
+    if repo_root is None:
+        # this file lives at netrep_tpu/utils/backend.py — repo root is 3 up
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        )))
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(repo_root, ".jax_cache")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
 def probe_default_backend(timeout: float) -> str:
     """Probe ``jax.devices()`` in a killable subprocess.
 

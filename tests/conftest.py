@@ -28,12 +28,11 @@ jax.config.update("jax_platforms", "cpu")
 # repeated XLA compiles of 8-device shard_map programs (round-2 measurement:
 # 785 s on 4 workers, mostly compile). Cache survives across runs/workers in
 # a gitignored repo-local dir; min-compile-time 0.5 s keeps tiny programs out.
-_cache_dir = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+from netrep_tpu.utils.backend import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
