@@ -191,6 +191,34 @@ def test_fused_exact_always_runs_hilo_on_cpu(rng):
     np.testing.assert_allclose(out, exp, rtol=1e-4, atol=1e-5)
 
 
+def test_kernel_shape_fuzz_matches_advanced_indexing(rng):
+    # randomized shapes across the kernel's decision space: row-block
+    # boundaries (cap vs _ROW_BLOCK), column-tile spill (n vs _COL_TILE),
+    # sentinel density, batch dims — every draw must reproduce plain
+    # advanced indexing exactly in f32 interpret mode
+    for draw in range(6):
+        n = int(rng.integers(40, 1300))
+        cap = int(rng.integers(2, 150))
+        batch = tuple(rng.integers(1, 4, size=int(rng.integers(1, 3))))
+        M = rng.standard_normal((n, n)).astype(np.float32)
+        idx = rng.integers(0, n, size=(*batch, cap)).astype(np.int32)
+        n_sent = int(rng.integers(0, cap // 2 + 1))
+        if n_sent:
+            flat = idx.reshape(-1, cap)
+            for r in range(flat.shape[0]):  # sentinels at random slots
+                flat[r, rng.choice(cap, size=n_sent, replace=False)] = n
+        out = np.asarray(gather_submatrix_fused(
+            jnp.asarray(M), jnp.asarray(idx), interpret=True
+        ))
+        ref = M[idx[..., :, None].clip(0, n - 1),
+                idx[..., None, :].clip(0, n - 1)]
+        ref[np.broadcast_to((idx == n)[..., :, None], ref.shape)] = 0.0
+        ref[np.broadcast_to((idx == n)[..., None, :], ref.shape)] = 0.0
+        np.testing.assert_array_equal(
+            out, ref, err_msg=f"draw {draw}: n={n} cap={cap} batch={batch}"
+        )
+
+
 def test_fused_null_matches_direct(rng):
     d, t, specs, pool = _problem(rng)
     nulls = {}
