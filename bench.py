@@ -195,6 +195,7 @@ def bench_north(args, label=None):
     cfg = EngineConfig(
         chunk_size=args.chunk, summary_method="power", power_iters=40,
         dtype=args.dtype, gather_mode=args.gather_mode,
+        cap_granularity=args.cap_granularity,
         # the bench problem's network IS |corr|**2 by construction, so
         # derived mode computes the identical statistics while halving the
         # gather traffic (the roofline bottleneck, BASELINE.md)
@@ -214,6 +215,8 @@ def bench_north(args, label=None):
         label = "north-star config, BASELINE.json:5"
     if args.derived_net:
         label += "; derived network |corr|^2"
+    if args.cap_granularity != 32:
+        label += f"; cap_granularity {args.cap_granularity}"
     row = {
         "metric": (
             f"wall-clock for {args.perms}-perm null, {args.genes} genes / "
@@ -487,6 +490,7 @@ def bench_d(args):
     pool = np.arange(args.genes, dtype=np.int32)
     cfg = EngineConfig(
         chunk_size=args.chunk, power_iters=40, gather_mode=args.gather_mode,
+        cap_granularity=args.cap_granularity,
         network_from_correlation=2.0 if args.derived_net else None,
     )
     engine = PermutationEngine(
@@ -502,7 +506,9 @@ def bench_d(args):
     ck = os.path.join(
         tempfile.gettempdir(),
         f"netrep_bench_d_{args.genes}x{args.modules}x{args.samples}x{n_perm}"
-        + ("_dnet" if args.derived_net else "") + ".npz",
+        + ("_dnet" if args.derived_net else "")
+        + (f"_g{args.cap_granularity}" if args.cap_granularity != 32 else "")
+        + ".npz",
     )
     resumed_from = 0
     if os.path.exists(ck):
@@ -537,6 +543,8 @@ def bench_d(args):
         "metric": f"Config D ({args.genes} genes / {args.modules} modules, "
                   f"{n_perm} perms, checkpoint every 8192"
                   + ("; derived network |corr|^2" if args.derived_net else "")
+                  + (f"; cap_granularity {args.cap_granularity}"
+                     if args.cap_granularity != 32 else "")
                   + (f"; resumed at {resumed_from}, value projected from "
                      f"{done_this_run} timed perms" if resumed_from else "")
                   + ")",
@@ -695,6 +703,10 @@ def main():
                          "direct-batched and fused only)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config for a fast correctness pass")
+    ap.add_argument("--cap-granularity", type=int, default=32,
+                    help="EngineConfig.cap_granularity: bucket capacities "
+                         "round to multiples of this (8 trims ~11%% of the "
+                         "row traffic; north/B/D configs)")
     ap.add_argument("--derived-net", action="store_true",
                     help="EngineConfig(network_from_correlation=2.0): derive "
                          "network submatrices on device instead of storing "

@@ -30,15 +30,21 @@ touch "$STATE"
 
 # Queue: "<key> <timeout_s> <command...>" — keys are the resume identity;
 # edit freely, completed keys are skipped via $STATE.
+# Order = VERDICT r3 priority: headline row first, then the decision grid
+# (tune: 13 reduced-count points — the highest information per second if
+# the tunnel window is short), then full 10k-perm rows for the grid's
+# modes, then the scale configs (D's two ~1h steps must never starve tune).
 QUEUE=(
   "smoke       300  python bench.py --smoke"
   "parts       900  python benchmarks/microbench_parts.py"
   "north       900  python bench.py"
+  "tune        2400 python benchmarks/tune_northstar.py"
   "north_bf16  900  python bench.py --dtype bfloat16"
   "north_dnet  900  python bench.py --derived-net"
   "north_bf16_dnet 900 python bench.py --dtype bfloat16 --derived-net"
   "north_fused 900  python bench.py --gather-mode fused"
   "north_fused_bf16_dnet 900 python bench.py --gather-mode fused --dtype bfloat16 --derived-net"
+  "north_g8    900  python bench.py --cap-granularity 8"
   "bf16_drift  1200 python benchmarks/bf16_drift.py"
   "configB     900  python bench.py --config B"
   "configC     1200 python bench.py --config C"
@@ -47,7 +53,6 @@ QUEUE=(
   "sharded     1200 python benchmarks/microbench_sharded_gather.py"
   "configD     3600 python bench.py --config D"
   "configD_dn  3600 python bench.py --config D --derived-net"
-  "tune        2400 python benchmarks/tune_northstar.py"
 )
 
 probe() {

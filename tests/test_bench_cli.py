@@ -24,6 +24,7 @@ CASES = [
     ["--dtype", "bfloat16", "--derived-net"],
     ["--gather-mode", "fused"],
     ["--gather-mode", "fused", "--dtype", "bfloat16", "--derived-net"],
+    ["--cap-granularity", "8"],
     ["--config", "B"],
     ["--config", "C"],
     # the watcher's reduced-genes C step; --genes must be passed WITHOUT
