@@ -38,6 +38,47 @@ CASES = [
 ]
 
 
+def _run_cpu_subprocess(cmd, timeout, extra_env=None):
+    """Shared subprocess harness: CPU platform + the suite's persistent
+    compile cache (three tests were carrying this inline; a missed copy
+    would silently run uncached and inflate CI toward the timeouts)."""
+    return subprocess.run(
+        cmd,
+        cwd=REPO,
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "JAX_COMPILATION_CACHE_DIR": os.path.join(REPO, ".jax_cache"),
+            **(extra_env or {}),
+        },
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+@pytest.mark.slow
+def test_tune_sweep_runs_end_to_end_on_cpu():
+    # the decision grid (benchmarks/tune_northstar.py) is the highest-value
+    # step in the watcher queue after the headline row; a crash with the
+    # tunnel alive skips it permanently after one retry, so its full
+    # point-loop (mxu/fused x f32/bf16 x derived-net + refinement +
+    # granularity + exactness pricing) must be CI-proven like the bench
+    # CLI combos
+    proc = _run_cpu_subprocess(
+        [sys.executable, "benchmarks/tune_northstar.py", "--genes", "500",
+         "--modules", "3", "--samples", "16", "--perms", "16"],
+        timeout=580,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    lines = [json.loads(l) for l in proc.stdout.strip().splitlines()
+             if l.startswith("{")]
+    best = [l for l in lines if "best" in l]
+    assert best and best[-1]["best"] is not None, proc.stdout[-2000:]
+    ok_points = [l for l in lines if l.get("ok")]
+    assert len(ok_points) >= 12, (len(ok_points), proc.stdout[-2000:])
+
+
 def test_bench_shield_always_emits_a_row_on_hang():
     # a tunnel death mid-run blocks device calls forever; the shield must
     # kill the child and still end in ONE parseable JSON line with the
@@ -105,19 +146,11 @@ def test_bench_config_d_resumes_from_checkpoint():
     nulls, done = engine.run_null(chunk, key=0, checkpoint_path=ck,
                                   checkpoint_every=chunk)
     assert done == chunk and os.path.exists(ck)
-    proc = subprocess.run(
+    proc = _run_cpu_subprocess(
         [sys.executable, "bench.py", "--config", "D",
          "--genes", str(genes), "--modules", str(modules),
          "--samples", str(samples), "--perms", str(perms),
          "--chunk", str(chunk)],
-        cwd=REPO,
-        env={
-            **os.environ,
-            "JAX_PLATFORMS": "cpu",
-            "JAX_COMPILATION_CACHE_DIR": os.path.join(REPO, ".jax_cache"),
-        },
-        capture_output=True,
-        text=True,
         timeout=600,
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
@@ -136,20 +169,7 @@ def test_bench_smoke_combination(flags):
     cmd = [sys.executable, "bench.py"]
     if "--genes" not in flags:
         cmd.append("--smoke")
-    proc = subprocess.run(
-        [*cmd, *flags],
-        cwd=REPO,
-        env={
-            **os.environ,
-            "JAX_PLATFORMS": "cpu",
-            # reuse the suite's persistent compile cache in the subprocess
-            # (conftest sets it via in-process jax.config only)
-            "JAX_COMPILATION_CACHE_DIR": os.path.join(REPO, ".jax_cache"),
-        },
-        capture_output=True,
-        text=True,
-        timeout=600,
-    )
+    proc = _run_cpu_subprocess([*cmd, *flags], timeout=600)
     assert proc.returncode == 0, proc.stderr[-3000:]
     row = json.loads(proc.stdout.strip().splitlines()[-1])
     if row.get("error") == "no C++ toolchain":
