@@ -1,0 +1,106 @@
+"""Summarize a TPU-watcher results log into BASELINE-ready rows.
+
+The watcher (`tpu_watch.sh`) appends raw step output — JSON result lines
+interleaved with step headers and warnings — to its log. This tool pulls
+out the parseable result rows, drops CPU-fallback/error rows AND any row
+whose device field names a CPU (a probe race can let a step run on the
+fallback backend) — those must never be transcribed as TPU numbers
+(BASELINE.md provenance note) — and prints one compact line per
+measurement plus a markdown table snippet for BASELINE.md's Measured
+section. Rows without a device field are listed separately as
+unknown-provenance, never as clean results.
+
+Usage: python benchmarks/summarize_watch.py [logfile ...]
+       (default: benchmarks/tpu_results_r4.jsonl)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def rows_from(path: str) -> list[dict]:
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(row, dict):
+                    continue
+                rows.append(row)
+    except FileNotFoundError:
+        print(f"(no log at {path})", file=sys.stderr)
+    return rows
+
+
+def classify(row: dict) -> str:
+    if row.get("tpu_fallback") or "error" in row or "warning" in row:
+        return "dropped"
+    if "best" in row:
+        return "result" if row["best"] else "dropped"  # null = failed sweep
+    dev = str(row.get("device", ""))
+    if "cpu" in dev.lower():
+        return "dropped"  # probe race: step ran on the CPU fallback backend
+    if not dev:
+        # parseable but unattributable — surface it, never as a clean row
+        return "unknown" if ("value" in row or "s" in row) else "other"
+    if "metric" in row and "value" in row:
+        return "result"
+    if "perms_per_sec" in row or "s" in row:
+        return "result"  # tune-sweep grid point (device checked above)
+    return "other"
+
+
+def main(paths: list[str]) -> int:
+    results, unknown, dropped = [], [], 0
+    for p in paths:
+        for r in rows_from(p):
+            kind = classify(r)
+            if kind == "dropped":
+                dropped += 1
+            elif kind == "unknown":
+                unknown.append((p, r))
+            elif kind == "result":
+                results.append((p, r))
+    if dropped:
+        print(f"# dropped {dropped} fallback/error/warning/CPU rows "
+              "(never transcribe those as TPU numbers)", file=sys.stderr)
+    if unknown:
+        print("## unknown-provenance rows (no device field — attribute "
+              "before use)")
+        for p, r in unknown:
+            print(f"{p}: {json.dumps(r)}")
+        print()
+    if not results:
+        print("# no clean result rows yet")
+        return 0
+    print("## raw rows")
+    for p, r in results:
+        print(f"{p}: {json.dumps(r)}")
+    print()
+    print("## BASELINE.md table snippet (verify device column before use)")
+    print("| Config | Device | Result | Command |")
+    print("|---|---|---|---|")
+    for _, r in results:
+        if "metric" not in r or "value" not in r:
+            continue
+        extra = []
+        if "perms_per_sec" in r:
+            extra.append(f"{r['perms_per_sec']} perms/s")
+        if "vs_baseline" in r:
+            extra.append(f"vs_baseline {r['vs_baseline']}")
+        print(f"| {r['metric']} | {r.get('device', '?')} | "
+              f"**{r['value']} {r.get('unit', '')}** "
+              f"({'; '.join(extra)}) | — |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:] or ["benchmarks/tpu_results_r4.jsonl"]))

@@ -61,7 +61,10 @@ def main():
         label = {"chunk": chunk, "perm_batch": pb, "dtype": dt,
                  "gather_mode": gm, "derived_net": derived, "power_iters": pi,
                  **({"fused_exact": True} if exact else {}),
-                 **({"cap_granularity": cap_g} if cap_g != 32 else {})}
+                 **({"cap_granularity": cap_g} if cap_g != 32 else {}),
+                 # per-row provenance: a probe-race CPU fallback must be
+                 # identifiable row-by-row (summarize_watch drops non-TPU)
+                 "device": str(jax.devices()[0])}
         try:
             eng = PermutationEngine(
                 d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool,

@@ -38,7 +38,7 @@ CASES = [
 ]
 
 
-def _run_cpu_subprocess(cmd, timeout, extra_env=None):
+def _run_cpu_subprocess(cmd, timeout):
     """Shared subprocess harness: CPU platform + the suite's persistent
     compile cache (three tests were carrying this inline; a missed copy
     would silently run uncached and inflate CI toward the timeouts)."""
@@ -49,7 +49,6 @@ def _run_cpu_subprocess(cmd, timeout, extra_env=None):
             **os.environ,
             "JAX_PLATFORMS": "cpu",
             "JAX_COMPILATION_CACHE_DIR": os.path.join(REPO, ".jax_cache"),
-            **(extra_env or {}),
         },
         capture_output=True,
         text=True,
