@@ -43,11 +43,15 @@ def rows_from(path: str) -> list[dict]:
 def classify(row: dict) -> str:
     if row.get("tpu_fallback") or "error" in row or "warning" in row:
         return "dropped"
-    if "best" in row:
-        return "result" if row["best"] else "dropped"  # null = failed sweep
+    if row.get("ok") is False:
+        return "dropped"  # tune point that failed validation mid-run
     dev = str(row.get("device", ""))
     if "cpu" in dev.lower():
-        return "dropped"  # probe race: step ran on the CPU fallback backend
+        # probe race: step ran on the CPU fallback backend (applies to the
+        # tune sweep's final best line too — its points were CPU-timed)
+        return "dropped"
+    if "best" in row:
+        return "result" if row["best"] else "dropped"  # null = failed sweep
     if not dev:
         # parseable but unattributable — surface it, never as a clean row
         return "unknown" if ("value" in row or "s" in row) else "other"
@@ -55,11 +59,13 @@ def classify(row: dict) -> str:
         return "result"
     if "perms_per_sec" in row or "s" in row:
         return "result"  # tune-sweep grid point (device checked above)
+    # device-attributed but no standard value field (e.g. bf16_drift's
+    # table row) — listed by main() so no measurement silently vanishes
     return "other"
 
 
 def main(paths: list[str]) -> int:
-    results, unknown, dropped = [], [], 0
+    results, unknown, other, dropped = [], [], [], 0
     for p in paths:
         for r in rows_from(p):
             kind = classify(r)
@@ -67,15 +73,23 @@ def main(paths: list[str]) -> int:
                 dropped += 1
             elif kind == "unknown":
                 unknown.append((p, r))
+            elif kind == "other":
+                other.append((p, r))
             elif kind == "result":
                 results.append((p, r))
     if dropped:
-        print(f"# dropped {dropped} fallback/error/warning/CPU rows "
+        print(f"# dropped {dropped} fallback/error/warning/CPU/not-ok rows "
               "(never transcribe those as TPU numbers)", file=sys.stderr)
     if unknown:
         print("## unknown-provenance rows (no device field — attribute "
               "before use)")
         for p, r in unknown:
+            print(f"{p}: {json.dumps(r)}")
+        print()
+    if other:
+        print("## other parseable rows (non-standard shape, e.g. drift "
+              "tables — transcribe manually)")
+        for p, r in other:
             print(f"{p}: {json.dumps(r)}")
         print()
     if not results:

@@ -1,0 +1,74 @@
+"""CI guard for the watcher-log summarizer's provenance rules (repo
+convention: watcher-pipeline tooling is CI-proven — silent breakage costs
+BASELINE rows). The drop/keep classifier is safety-critical: a CPU-timed
+or failed row transcribed as a TPU number corrupts the decision grid."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "summarize_watch", os.path.join(REPO, "benchmarks", "summarize_watch.py")
+)
+summarize_watch = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(summarize_watch)
+classify = summarize_watch.classify
+
+
+def test_classify_provenance_rules():
+    tpu = "TPU v5 lite"
+    cases = [
+        # clean TPU rows
+        ({"metric": "north", "value": 27.1, "device": tpu}, "result"),
+        ({"chunk": 128, "ok": True, "s": 3.2, "perms_per_sec": 590.1,
+          "device": tpu}, "result"),
+        ({"best": {"chunk": 128}, "device": tpu}, "result"),
+        # drops: explicit markers
+        ({"metric": "x", "error": "skipped", "tpu_fallback": True}, "dropped"),
+        ({"metric": "backend probe", "warning": "falling back"}, "dropped"),
+        # drops: failed tune point even on TPU (review r4: ok flag)
+        ({"chunk": 128, "ok": False, "s": 1.0, "perms_per_sec": 9.9,
+          "device": tpu}, "dropped"),
+        # drops: CPU device — including the sweep's final best line
+        ({"chunk": 128, "ok": True, "s": 3.2, "device": "TFRT_CPU_0"},
+         "dropped"),
+        ({"best": {"chunk": 128}, "device": "TFRT_CPU_0"}, "dropped"),
+        ({"best": None, "device": tpu}, "dropped"),  # all points failed
+        # unknown: value without device attribution
+        ({"chunk": 64, "ok": True, "s": 9.9, "perms_per_sec": 100.0},
+         "unknown"),
+        # other: device-attributed non-standard shape (bf16_drift table)
+        ({"metric": "bf16 drift", "per_stat": {"coherence": 0.47},
+          "device": tpu}, "other"),
+    ]
+    for row, want in cases:
+        assert classify(row) == want, (row, classify(row), want)
+
+
+def test_cli_sections_account_for_every_parseable_row(tmp_path):
+    rows = [
+        {"metric": "north", "value": 27.1, "unit": "s", "vs_baseline": 2.21,
+         "perms_per_sec": 368.5, "device": "TPU v5 lite"},
+        {"metric": "Config D", "error": "skipped", "tpu_fallback": True},
+        {"chunk": 256, "ok": True, "s": 5.2, "device": "TFRT_CPU_0"},
+        {"chunk": 64, "ok": True, "s": 9.9, "perms_per_sec": 100.0},
+        {"metric": "bf16 drift", "per_stat": {"coherence": 0.47},
+         "device": "TPU v5 lite"},
+    ]
+    log = tmp_path / "watch.jsonl"
+    log.write_text("--- step ---\n" + "\n".join(json.dumps(r) for r in rows))
+    proc = subprocess.run(
+        [sys.executable, "benchmarks/summarize_watch.py", str(log)],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "north" in out and "27.1" in out            # clean row kept
+    assert "unknown-provenance" in out and '"chunk": 64' in out
+    assert "other parseable" in out and "bf16 drift" in out
+    assert "TFRT_CPU_0" not in out                     # CPU row never shown
+    assert "dropped 2" in proc.stderr                  # fallback + CPU
