@@ -50,11 +50,12 @@ def classify(row: dict) -> str:
         # probe race: step ran on the CPU fallback backend (applies to the
         # tune sweep's final best line too — its points were CPU-timed)
         return "dropped"
+    if not dev:
+        # parseable but unattributable — surface it, never as a clean
+        # result, a trusted best line, or a transcribe-me "other" row
+        return "unknown"
     if "best" in row:
         return "result" if row["best"] else "dropped"  # null = failed sweep
-    if not dev:
-        # parseable but unattributable — surface it, never as a clean row
-        return "unknown" if ("value" in row or "s" in row) else "other"
     if "metric" in row and "value" in row:
         return "result"
     if "perms_per_sec" in row or "s" in row:

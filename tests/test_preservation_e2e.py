@@ -184,6 +184,38 @@ def test_network_from_correlation_user_surface(toy_pair_module):
     np.testing.assert_array_equal(derived.p_values, base.p_values)
 
 
+def test_all_tpu_knobs_compose_end_to_end(toy_pair_module):
+    """Kitchen-sink integration: every TPU tuning knob at once — fused
+    Pallas gather (interpret on CPU) with forced hi/lo exact selection,
+    derived network, multiple-of-8 bucket capacities — must reproduce the
+    default path's null through the PUBLIC API. Guards knob interactions
+    no single-feature test crosses."""
+    d, t = _frames(toy_pair_module)
+    kwargs = dict(
+        network={"disc": d["network"], "test": t["network"]},
+        data={"disc": d["data"], "test": t["data"]},
+        correlation={"disc": d["correlation"], "test": t["correlation"]},
+        module_assignments=dict(toy_pair_module["labels"]),
+        discovery="disc", test="test", n_perm=40, seed=19,
+    )
+    base = module_preservation(
+        **kwargs, config=EngineConfig(chunk_size=16, summary_method="eigh")
+    )
+    stacked = module_preservation(
+        **kwargs,
+        config=EngineConfig(
+            chunk_size=16, summary_method="eigh", gather_mode="fused",
+            fused_exact="always", network_from_correlation=2.0,
+            cap_granularity=8,
+        ),
+    )
+    np.testing.assert_allclose(stacked.observed, base.observed,
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(stacked.nulls, base.nulls,
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(stacked.p_values, base.p_values)
+
+
 def test_result_save_load_roundtrip(result, tmp_path):
     """PreservationResult.save/load: the .rds-saving workflow equivalent."""
     path = str(tmp_path / "res.npz")

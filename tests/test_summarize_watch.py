@@ -38,8 +38,13 @@ def test_classify_provenance_rules():
          "dropped"),
         ({"best": {"chunk": 128}, "device": "TFRT_CPU_0"}, "dropped"),
         ({"best": None, "device": tpu}, "dropped"),  # all points failed
-        # unknown: value without device attribution
+        # unknown: anything without device attribution — value rows, best
+        # lines, and drift tables alike (review r4: a device-less best/drift
+        # row must never look clean or transcribe-ready)
         ({"chunk": 64, "ok": True, "s": 9.9, "perms_per_sec": 100.0},
+         "unknown"),
+        ({"best": {"chunk": 256, "perms_per_sec": 590}}, "unknown"),
+        ({"metric": "bf16 drift", "per_stat": {"coherence": 0.47}},
          "unknown"),
         # other: device-attributed non-standard shape (bf16_drift table)
         ({"metric": "bf16 drift", "per_stat": {"coherence": 0.47},
