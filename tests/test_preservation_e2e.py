@@ -184,18 +184,42 @@ def test_network_from_correlation_user_surface(toy_pair_module):
     np.testing.assert_array_equal(derived.p_values, base.p_values)
 
 
-def test_all_tpu_knobs_compose_end_to_end(toy_pair_module):
+def test_all_tpu_knobs_compose_end_to_end():
     """Kitchen-sink integration: every TPU tuning knob at once — fused
     Pallas gather (interpret on CPU) with forced hi/lo exact selection,
     derived network, multiple-of-8 bucket capacities — must reproduce the
     default path's null through the PUBLIC API. Guards knob interactions
-    no single-feature test crosses."""
-    d, t = _frames(toy_pair_module)
+    no single-feature test crosses. Uses a 38-node module so the
+    granularity knob actually changes bucket padding (the toy fixture's
+    <= 15-node modules round identically under g=8 and g=32)."""
+    assert (EngineConfig().rounded_cap(38)
+            != EngineConfig(cap_granularity=8).rounded_cap(38))
+    rng = np.random.default_rng(23)
+    n, s = 110, 30
+    names = [f"g{i}" for i in range(n)]
+
+    def build(seed):
+        r = np.random.default_rng(seed)
+        x = r.standard_normal((s, n))
+        x[:, :38] += r.standard_normal((s, 1)) * 1.3   # planted 38-node mod
+        x[:, 38:47] += r.standard_normal((s, 1)) * 1.1  # planted 9-node mod
+        df = pd.DataFrame(x, columns=names)
+        corr = df.corr().to_numpy()
+        return dict(
+            data=df,
+            correlation=pd.DataFrame(corr, index=names, columns=names),
+            network=pd.DataFrame(np.abs(corr) ** 2, index=names,
+                                 columns=names),
+        )
+
+    d, t = build(1), build(2)
+    assign = {nm: ("1" if i < 38 else "2" if i < 47 else "0")
+              for i, nm in enumerate(names)}
     kwargs = dict(
         network={"disc": d["network"], "test": t["network"]},
         data={"disc": d["data"], "test": t["data"]},
         correlation={"disc": d["correlation"], "test": t["correlation"]},
-        module_assignments=dict(toy_pair_module["labels"]),
+        module_assignments=assign,
         discovery="disc", test="test", n_perm=40, seed=19,
     )
     base = module_preservation(
