@@ -79,6 +79,70 @@ def test_null_reproducible_and_chunk_invariant(setup):
     assert np.abs(n1 - n3).max() > 1e-3  # different key → different null
 
 
+def _synthetic_problem(seed, sizes, n_disc, n_test, n_samples):
+    """Random pair + contiguous aligned ModuleSpecs (shared by the
+    reconstruction and granularity tests)."""
+    r = np.random.default_rng(seed)
+
+    def build(n):
+        x = r.standard_normal((n_samples, n))
+        c = np.corrcoef(x, rowvar=False)
+        return x, c, np.abs(c) ** 2
+
+    d, t = build(n_disc), build(n_test)
+    specs, pos = [], 0
+    for k, sz in enumerate(sizes):
+        idx = np.arange(pos, pos + sz, dtype=np.int32)
+        specs.append(ModuleSpec(str(k + 1), idx, idx))
+        pos += sz
+    return d, t, specs, np.arange(n_test, dtype=np.int32)
+
+
+def test_null_chunk_matches_oracle_reconstruction():
+    # strongest end-to-end net: reconstruct the engine's EXACT permutations
+    # on the host from the documented seeding contract (fold_in(key, i) →
+    # jax.random.permutation over the pool) and recompute each null entry
+    # with the NumPy oracle — validates draw → slice → gather → statistics
+    # as one path, not just the kernels. Sizes cross a bucket boundary so
+    # both bucket programs are covered.
+    import jax.numpy as jnp
+
+    (d_data, d_corr, d_net), (t_data, t_corr, t_net), specs, pool = \
+        _synthetic_problem(31, (34, 8, 5), n_disc=70, n_test=64, n_samples=14)
+    eng = PermutationEngine(
+        d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool,
+        config=EngineConfig(chunk_size=4, summary_method="eigh"),
+    )
+    n_perm = 6
+    nulls, done = eng.run_null(n_perm, key=5)
+    assert done == n_perm
+
+    keys = eng.perm_keys(jax.random.key(5), 0, n_perm)
+    pool_dev = jnp.asarray(pool)
+    for p in range(n_perm):
+        perm = np.asarray(jax.random.permutation(keys[p], pool_dev))
+        off = 0
+        for m, spec in enumerate(specs):
+            sz = len(spec.disc_idx)
+            idx = perm[off: off + sz]
+            off += sz
+            disc = oracle.DiscoveryProps(
+                d_corr[np.ix_(spec.disc_idx, spec.disc_idx)],
+                d_net[np.ix_(spec.disc_idx, spec.disc_idx)],
+                d_data[:, spec.disc_idx],
+            )
+            want = oracle.module_stats(
+                disc,
+                t_corr[np.ix_(idx, idx)],
+                t_net[np.ix_(idx, idx)],
+                t_data[:, idx],
+            )
+            np.testing.assert_allclose(
+                nulls[p, m], want, atol=2e-4,
+                err_msg=f"perm {p}, module {m}",
+            )
+
+
 def test_rounded_cap_granularity():
     # default: powers of two to 32, then multiples of 32; granularity 8
     # keeps the small-module ramp but trims padding above 32 — the row
@@ -99,21 +163,9 @@ def test_null_invariant_under_cap_granularity():
     # move when bucket padding changes. Needs a module > 32 nodes — below
     # that the power-of-two ramp gives both granularities identical caps
     # and the test is vacuous (the toy fixture's modules are all <= 15).
-    rng = np.random.default_rng(7)
-    n_disc, n_test, n_samples = 90, 80, 12
-
-    def build(n):
-        x = rng.standard_normal((n_samples, n))
-        c = np.corrcoef(x, rowvar=False)
-        return x, c, np.abs(c) ** 2
-
-    d, t = build(n_disc), build(n_test)
-    specs, pos = [], 0
-    for k, sz in enumerate((38, 9)):
-        idx = np.arange(pos, pos + sz, dtype=np.int32)
-        specs.append(ModuleSpec(str(k + 1), idx, idx))
-        pos += sz
-    pool = np.arange(n_test, dtype=np.int32)
+    d, t, specs, pool = _synthetic_problem(
+        7, (38, 9), n_disc=90, n_test=80, n_samples=12
+    )
 
     def run(g):
         eng = PermutationEngine(
