@@ -22,6 +22,8 @@ Usage: python benchmarks/microbench_gather.py [--genes N] [--chunk C] [--reps R]
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 import time
 from functools import partial
 
@@ -30,13 +32,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def ensure_backend():
-    try:
-        return jax.devices()
-    except RuntimeError:
-        jax.config.update("jax_platforms", "")
-        return jax.devices()
+# bench.ensure_backend: killable-subprocess tunnel probe (a hung-dead axon
+# dial becomes a fast CPU fallback) + persistent compile cache.
+from bench import ensure_backend  # noqa: E402
 
 
 def bench(fn, *args, reps=3, warmup=1):

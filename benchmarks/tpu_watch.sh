@@ -34,10 +34,17 @@ touch "$STATE"
 # (tune: 13 reduced-count points — the highest information per second if
 # the tunnel window is short), then full 10k-perm rows for the grid's
 # modes, then the scale configs (D's two ~1h steps must never starve tune).
+# Round-4 live-window learning (03:49-03:55 UTC): tunnel windows run ~5-7
+# minutes and die mid-step. The headline north row therefore goes FIRST
+# after smoke; the fused-parity gate that must precede any fused benchmark
+# row is the cheap --parity-only step (2 compiles), not the full parts
+# decomposition (many compiles — it ate the whole 7/31 window and timed
+# out). bench.py now enables the persistent compile cache, so a step killed
+# mid-compile resumes into cached programs next window.
 QUEUE=(
   "smoke       300  python bench.py --smoke"
-  "parts       900  python benchmarks/microbench_parts.py"
   "north       900  python bench.py"
+  "parity      600  python benchmarks/microbench_parts.py --parity-only"
   "tune        2400 python benchmarks/tune_northstar.py"
   "north_bf16  900  python bench.py --dtype bfloat16"
   "north_dnet  900  python bench.py --derived-net"
@@ -51,6 +58,7 @@ QUEUE=(
   "configC15   1200 python bench.py --config C --genes 1500"
   "configE     1200 python bench.py --config E"
   "sharded     1200 python benchmarks/microbench_sharded_gather.py"
+  "parts       900  python benchmarks/microbench_parts.py"
   "configD     3600 python bench.py --config D"
   "configD_dn  3600 python bench.py --config D --derived-net"
 )
@@ -100,12 +108,32 @@ while :; do
         echo "--- $key skipped: would cross cutoff ---" | tee -a "$LOG"
         continue
       fi
+      # Fused decision rows are only trustworthy after the parity gate has
+      # genuinely PASSED on real Mosaic (review finding: queue order alone
+      # does not stop a fused step from running after a parity failure).
+      # "parity PASS" is written only by a real success; a bare "parity"
+      # line without it means the gate failed twice and was retired.
+      case "$key" in
+        tune|north_fused*)
+          if ! grep -qx "parity PASS" "$STATE"; then
+            if grep -qx "parity MOSAICFAIL" "$STATE"; then
+              # only a REAL kernel failure (assertion/compile error on the
+              # chip, marked below) retires the fused grid — transient
+              # tunnel flaps leave the gate pending and the steps deferred
+              echo "--- $key skipped permanently: fused parity gate FAILED on Mosaic ---" | tee -a "$LOG"
+              echo "$key" >>"$STATE"
+            else
+              echo "--- $key deferred: fused parity gate not yet passed ---" | tee -a "$LOG"
+            fi
+            continue
+          fi ;;
+      esac
       echo "--- $key: $cmd ($(date -u +%FT%TZ)) ---" | tee -a "$LOG"
       step_out=$(mktemp)
       # NO_SUBPROC: the watcher IS the timeout layer; bench.py's subprocess
       # shield would otherwise orphan a chip-holding child when this
       # timeout fires (timeout signals only the direct child)
-      timeout "$tmo" env NETREP_BENCH_NO_SUBPROC=1 bash -c "$cmd" 2>&1 \
+      timeout "$tmo" env NETREP_BENCH_NO_SUBPROC=1 PYTHONUNBUFFERED=1 bash -c "$cmd" 2>&1 \
         | grep -v WARNING | tee -a "$LOG" "$step_out"
       rc=${PIPESTATUS[0]}
       # bench.py exits 0 on its own probe-race CPU-fallback rows, and the
@@ -115,18 +143,37 @@ while :; do
       fellback=0
       grep -qE '"tpu_fallback": true|falling back to CPU' "$step_out" \
         && fellback=1
+      # real on-chip parity failure: the kernel miscompiled or refused to
+      # compile (assertion / SKIPPED / CPU-drop exit) with the tunnel alive
+      mosaicfail=0
+      if [ "$key" = parity ] && [ "$rc" -ne 0 ] && [ "$fellback" -eq 0 ] && \
+         grep -qE 'pallas fused parity FAILED|pallas fused gather: SKIPPED' "$step_out"; then
+        mosaicfail=1
+      fi
       rm -f "$step_out"
       if [ "$rc" -eq 0 ] && [ "$fellback" -eq 0 ]; then
         echo "$key" >>"$STATE"
+        # PASS marker distinguishes a genuine success from the retired-
+        # after-two-failures bare key; the parity gate above keys off it
+        echo "$key PASS" >>"$STATE"
       elif [ "$fellback" -eq 1 ]; then
         echo "--- $key emitted a CPU-fallback row (probe race); reprobing ---" | tee -a "$LOG"
         break   # treat like a tunnel death: leave unmarked, fall back to probing
+      elif [ "$mosaicfail" -eq 1 ]; then
+        echo "--- parity FAILED on real Mosaic; retiring fused steps ---" | tee -a "$LOG"
+        echo "parity" >>"$STATE"
+        echo "parity MOSAICFAIL" >>"$STATE"
       elif probe; then
         # tunnel alive after the failure: could be a genuinely broken step
         # OR a mid-step outage whose tunnel recovered before the timeout
         # killed us. Retry once (FAIL marker); only a second failure with
-        # the tunnel alive is skipped permanently.
-        if grep -qx "$key FAIL" "$STATE"; then
+        # the tunnel alive is skipped permanently. Exception: the parity
+        # gate retries every window — retiring it on transient flaps would
+        # otherwise silently forfeit the whole fused decision grid, and a
+        # REAL kernel failure is caught by the mosaicfail branch above.
+        if [ "$key" = parity ]; then
+          echo "--- parity failed transiently (flap/timeout); will retry next window ---" | tee -a "$LOG"
+        elif grep -qx "$key FAIL" "$STATE"; then
           echo "--- $key FAILED twice with tunnel alive; skipping permanently ---" | tee -a "$LOG"
           echo "$key" >>"$STATE"
         else
