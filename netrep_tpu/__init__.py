@@ -31,6 +31,18 @@ __all__ = [
     "summarize_trace",
 ]
 
+#: the plot suite (reference exports plotModule + per-panel functions at
+#: package level, SURVEY.md §2.1 — a NetRep user expects them here, not
+#: behind a submodule import). Lazy like everything else, and deliberately
+#: NOT in ``__all__``: matplotlib is the optional ``plot`` extra, so a
+#: ``from netrep_tpu import *`` on a base install must not import it (and
+#: crash) just by iterating the export list. Attribute access still works.
+_PLOT_EXPORTS = frozenset({
+    "plot_module", "plot_data", "plot_correlation", "plot_network",
+    "plot_summary", "plot_contribution", "plot_degree",
+    "plot_module_sparse",
+})
+
 
 def __getattr__(name):
     # Lazy imports keep `import netrep_tpu` light (no jax trace-time cost)
@@ -62,6 +74,16 @@ def __getattr__(name):
         from .utils.profiling import summarize_trace
 
         return summarize_trace
+    if name in _PLOT_EXPORTS:
+        try:
+            from . import plot
+        except ImportError as e:
+            raise ImportError(
+                f"netrep_tpu.{name} needs matplotlib — install the plot "
+                "extra: pip install netrep-tpu[plot]"
+            ) from e
+
+        return getattr(plot, name)
     if name in ("PreservationResult", "combine_analyses", "results_table"):
         from .models import results
 

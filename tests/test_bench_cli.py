@@ -57,6 +57,26 @@ def _run_cpu_subprocess(cmd, timeout):
 
 
 @pytest.mark.slow
+def test_parity_only_gate_refuses_cpu_pass():
+    """The watcher's fused-parity gate records 'parity PASS' only on exit 0.
+    On CPU the kernel runs in the Pallas *interpreter* — no Mosaic proof —
+    so --parity-only must pass the parity assertions yet still exit nonzero
+    (3), or a probe-race CPU drop would permanently unlock the fused
+    decision steps without the kernel ever compiling on a chip."""
+    proc = _run_cpu_subprocess(
+        [sys.executable, "benchmarks/microbench_parts.py", "--parity-only",
+         "--genes", "600", "--K", "2", "--batch", "2"],
+        timeout=580,
+    )
+    assert proc.returncode == 3, (proc.returncode, proc.stdout[-2000:],
+                                  proc.stderr[-2000:])
+    # the parity checks themselves must have RUN and passed before the
+    # deliberate nonzero exit — both dtypes
+    assert proc.stdout.count("ok") >= 2, proc.stdout[-2000:]
+    assert "FAILED" not in proc.stdout, proc.stdout[-2000:]
+
+
+@pytest.mark.slow
 def test_tune_sweep_runs_end_to_end_on_cpu():
     # the decision grid (benchmarks/tune_northstar.py) is the highest-value
     # step in the watcher queue after the headline row; a crash with the

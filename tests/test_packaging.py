@@ -51,3 +51,23 @@ def test_declared_dependencies_cover_package_imports():
         for d in _project()["project"]["dependencies"]
     }
     assert {"numpy", "scipy", "jax"} <= deps
+
+
+def test_public_all_fully_resolvable():
+    """Every name in ``netrep_tpu.__all__`` must resolve through the lazy
+    ``__getattr__`` table — a drifted entry (e.g. a plot export added to
+    ``__all__`` but not to the dispatch) would raise AttributeError at the
+    exact moment a user (or ``from netrep_tpu import *``) touches it."""
+    import netrep_tpu
+
+    for name in netrep_tpu.__all__:
+        assert getattr(netrep_tpu, name) is not None, name
+    # the reference exports its plot suite at package level (SURVEY.md
+    # §2.1: plotModule + per-panel functions) — pin the analogues. They
+    # are lazy attributes OUTSIDE __all__ (matplotlib is the optional
+    # `plot` extra; star-import on a base install must not touch it)
+    pytest.importorskip("matplotlib")
+    for name in ("plot_module", "plot_data", "plot_correlation",
+                 "plot_network", "plot_contribution", "plot_degree"):
+        assert callable(getattr(netrep_tpu, name)), name
+        assert name not in netrep_tpu.__all__, name
