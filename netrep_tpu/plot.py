@@ -431,49 +431,81 @@ def draw_degree(ax, layout: ModuleLayout, show_names=False):
 # plotNetwork / plotContribution / plotDegree — SURVEY.md §2.1)
 # ---------------------------------------------------------------------------
 
-def _single_panel(draw, colorbar, **kwargs):
-    ax = kwargs.pop("ax", None)
-    show_names = kwargs.pop("show_node_names", True)
-    layout = _prepare(**kwargs)
+def _single_panel(draw, colorbar, ax=None, show_node_names=True,
+                  stats="full", **kwargs):
+    layout = _prepare(stats=stats, **kwargs)
     if ax is None:
         _fig, ax = plt.subplots(figsize=(8, 4))
-    art = draw(ax, layout, show_names=show_names)
+    art = draw(ax, layout, show_names=show_node_names)
     _module_header(ax, layout)
     if colorbar and art is not None:
         ax.figure.colorbar(art, ax=ax, fraction=0.04, pad=0.02)
     return ax
 
 
-def plot_data(network, data, correlation, module_assignments, **kw):
+# The per-panel functions share the composite's reference-shaped signature
+# (SURVEY.md §2.1: the reference's plot suite exposes one argument set
+# across plotModule and the panel plots). Explicit parameters — not **kw —
+# so the R shim's camelCase->snake_case mapping is machine-checkable
+# against a real signature (tests/test_r_shim.py).
+def plot_data(network, data=None, correlation=None, module_assignments=None,
+              modules=None, background_label: str = "0", discovery=None,
+              test=None, order_nodes_by="discovery", order_samples_by="test",
+              show_node_names: bool = True, ax=None):
     """Standalone data heatmap panel (reference ``plotData``)."""
     return _single_panel(
-        draw_data, True, network=network, data=data, correlation=correlation,
-        module_assignments=module_assignments, **kw,
+        draw_data, True, ax=ax, show_node_names=show_node_names,
+        stats="summary",
+        network=network, data=data, correlation=correlation,
+        module_assignments=module_assignments, modules=modules,
+        background_label=background_label, discovery=discovery, test=test,
+        order_nodes_by=order_nodes_by, order_samples_by=order_samples_by,
     )
 
 
-def plot_correlation(network, data=None, correlation=None, module_assignments=None, **kw):
+def plot_correlation(network, data=None, correlation=None,
+                     module_assignments=None, modules=None,
+                     background_label: str = "0", discovery=None, test=None,
+                     order_nodes_by="discovery", order_samples_by="test",
+                     show_node_names: bool = True, ax=None):
     """Standalone correlation heatmap panel (reference ``plotCorrelation``)."""
     return _single_panel(
-        draw_correlation, True, network=network, data=data,
-        correlation=correlation, module_assignments=module_assignments, **kw,
+        draw_correlation, True, ax=ax, show_node_names=show_node_names,
+        stats="none",
+        network=network, data=data, correlation=correlation,
+        module_assignments=module_assignments, modules=modules,
+        background_label=background_label, discovery=discovery, test=test,
+        order_nodes_by=order_nodes_by, order_samples_by=order_samples_by,
     )
 
 
-def plot_network(network, data=None, correlation=None, module_assignments=None, **kw):
+def plot_network(network, data=None, correlation=None,
+                 module_assignments=None, modules=None,
+                 background_label: str = "0", discovery=None, test=None,
+                 order_nodes_by="discovery", order_samples_by="test",
+                 show_node_names: bool = True, ax=None):
     """Standalone edge-weight heatmap panel (reference ``plotNetwork``)."""
     return _single_panel(
-        draw_network, True, network=network, data=data,
-        correlation=correlation, module_assignments=module_assignments, **kw,
+        draw_network, True, ax=ax, show_node_names=show_node_names,
+        stats="none",
+        network=network, data=data, correlation=correlation,
+        module_assignments=module_assignments, modules=modules,
+        background_label=background_label, discovery=discovery, test=test,
+        order_nodes_by=order_nodes_by, order_samples_by=order_samples_by,
     )
 
 
-def plot_summary(network, data, correlation, module_assignments, **kw):
+def plot_summary(network, data=None, correlation=None,
+                 module_assignments=None, modules=None,
+                 background_label: str = "0", discovery=None, test=None,
+                 order_nodes_by="discovery", order_samples_by="test",
+                 ax=None):
     """Standalone summary-profile bar panel (per sample)."""
-    ax = kw.pop("ax", None)
     layout = _prepare(
         network=network, data=data, correlation=correlation,
-        module_assignments=module_assignments, **kw,
+        module_assignments=module_assignments, modules=modules,
+        background_label=background_label, discovery=discovery, test=test,
+        order_nodes_by=order_nodes_by, order_samples_by=order_samples_by,
     )
     if ax is None:
         _fig, ax = plt.subplots(figsize=(3, 5))
@@ -481,19 +513,35 @@ def plot_summary(network, data, correlation, module_assignments, **kw):
     return ax
 
 
-def plot_contribution(network, data, correlation, module_assignments, **kw):
+def plot_contribution(network, data=None, correlation=None,
+                      module_assignments=None, modules=None,
+                      background_label: str = "0", discovery=None, test=None,
+                      order_nodes_by="discovery", order_samples_by="test",
+                      show_node_names: bool = True, ax=None):
     """Standalone node-contribution bar panel (reference ``plotContribution``)."""
     return _single_panel(
-        draw_contribution, False, network=network, data=data,
-        correlation=correlation, module_assignments=module_assignments, **kw,
+        draw_contribution, False, ax=ax, show_node_names=show_node_names,
+        stats="full",
+        network=network, data=data, correlation=correlation,
+        module_assignments=module_assignments, modules=modules,
+        background_label=background_label, discovery=discovery, test=test,
+        order_nodes_by=order_nodes_by, order_samples_by=order_samples_by,
     )
 
 
-def plot_degree(network, data=None, correlation=None, module_assignments=None, **kw):
+def plot_degree(network, data=None, correlation=None,
+                module_assignments=None, modules=None,
+                background_label: str = "0", discovery=None, test=None,
+                order_nodes_by="discovery", order_samples_by="test",
+                show_node_names: bool = True, ax=None):
     """Standalone weighted-degree bar panel (reference ``plotDegree``)."""
     return _single_panel(
-        draw_degree, False, network=network, data=data,
-        correlation=correlation, module_assignments=module_assignments, **kw,
+        draw_degree, False, ax=ax, show_node_names=show_node_names,
+        stats="none",
+        network=network, data=data, correlation=correlation,
+        module_assignments=module_assignments, modules=modules,
+        background_label=background_label, discovery=discovery, test=test,
+        order_nodes_by=order_nodes_by, order_samples_by=order_samples_by,
     )
 
 

@@ -123,13 +123,19 @@ requiredPerms <- function(alpha = 0.05, nTests = 1L,
 }
 
 #' Shared plot-call glue: drop NULL args (Python defaults apply), then
-#' force-set the order-mode arguments — NULL is a real mode there (input
+#' set the order-mode arguments — NULL is a real mode there (input
 #' order), so it must reach Python as None, not be dropped. Single-bracket
 #' list assignment stores NULL; $<- NULL would delete the element.
+#' An order argument already present in args came through `...` under its
+#' Python name (the documented extras channel) — that explicit value wins
+#' over the camelCase argument, which is indistinguishable from its
+#' R-level default here.
 .callPlot <- function(py_name, args, orderArgs) {
   plt <- reticulate::import("netrep_tpu.plot")
   args <- args[!vapply(args, is.null, logical(1))]
-  for (nm in names(orderArgs)) args[nm] <- orderArgs[nm]
+  for (nm in names(orderArgs)) {
+    if (!nm %in% names(args)) args[nm] <- orderArgs[nm]
+  }
   do.call(plt[[py_name]], args)
 }
 
@@ -213,7 +219,9 @@ combineAnalyses <- function(analysis1, analysis2,
                              allow_duplicate_nulls = allowDuplicateNulls, ...)
 }
 
-.plotModule_args <- list(
+# Shared camelCase->snake_case map for plotModule and the five panel
+# plots (one argument set across the suite, like the reference).
+.panelArgs <- list(
   network           = "network",
   data              = "data",
   correlation       = "correlation",
@@ -225,6 +233,8 @@ combineAnalyses <- function(analysis1, analysis2,
   orderNodesBy      = "order_nodes_by",
   orderSamplesBy    = "order_samples_by"
 )
+
+.plotModule_args <- .panelArgs
 
 plotModule <- function(network,
                        data = NULL,
@@ -238,6 +248,121 @@ plotModule <- function(network,
                        orderSamplesBy = "test",
                        ...) {
   .callPlot("plot_module",
+            list(network = network, data = data, correlation = correlation,
+                 module_assignments = moduleAssignments, modules = modules,
+                 background_label = backgroundLabel, discovery = discovery,
+                 test = test, ...),
+            list(order_nodes_by = orderNodesBy,
+                 order_samples_by = orderSamplesBy))
+}
+
+# Per-panel plot shims (reference: plotData / plotCorrelation / plotNetwork /
+# plotContribution / plotDegree — SURVEY.md §2.1 "Plot suite"). One shared
+# argument set, like the reference's panel plots; extras (showNodeNames via
+# show_node_names, ax) ride through `...` using the Python names.
+
+.plotData_args <- .panelArgs
+
+plotData <- function(network,
+                     data = NULL,
+                     correlation = NULL,
+                     moduleAssignments = NULL,
+                     modules = NULL,
+                     backgroundLabel = "0",
+                     discovery = NULL,
+                     test = NULL,
+                     orderNodesBy = "discovery",
+                     orderSamplesBy = "test",
+                     ...) {
+  .callPlot("plot_data",
+            list(network = network, data = data, correlation = correlation,
+                 module_assignments = moduleAssignments, modules = modules,
+                 background_label = backgroundLabel, discovery = discovery,
+                 test = test, ...),
+            list(order_nodes_by = orderNodesBy,
+                 order_samples_by = orderSamplesBy))
+}
+
+.plotCorrelation_args <- .panelArgs
+
+plotCorrelation <- function(network,
+                            data = NULL,
+                            correlation = NULL,
+                            moduleAssignments = NULL,
+                            modules = NULL,
+                            backgroundLabel = "0",
+                            discovery = NULL,
+                            test = NULL,
+                            orderNodesBy = "discovery",
+                            orderSamplesBy = "test",
+                            ...) {
+  .callPlot("plot_correlation",
+            list(network = network, data = data, correlation = correlation,
+                 module_assignments = moduleAssignments, modules = modules,
+                 background_label = backgroundLabel, discovery = discovery,
+                 test = test, ...),
+            list(order_nodes_by = orderNodesBy,
+                 order_samples_by = orderSamplesBy))
+}
+
+.plotNetwork_args <- .panelArgs
+
+plotNetwork <- function(network,
+                        data = NULL,
+                        correlation = NULL,
+                        moduleAssignments = NULL,
+                        modules = NULL,
+                        backgroundLabel = "0",
+                        discovery = NULL,
+                        test = NULL,
+                        orderNodesBy = "discovery",
+                        orderSamplesBy = "test",
+                        ...) {
+  .callPlot("plot_network",
+            list(network = network, data = data, correlation = correlation,
+                 module_assignments = moduleAssignments, modules = modules,
+                 background_label = backgroundLabel, discovery = discovery,
+                 test = test, ...),
+            list(order_nodes_by = orderNodesBy,
+                 order_samples_by = orderSamplesBy))
+}
+
+.plotContribution_args <- .panelArgs
+
+plotContribution <- function(network,
+                             data = NULL,
+                             correlation = NULL,
+                             moduleAssignments = NULL,
+                             modules = NULL,
+                             backgroundLabel = "0",
+                             discovery = NULL,
+                             test = NULL,
+                             orderNodesBy = "discovery",
+                             orderSamplesBy = "test",
+                             ...) {
+  .callPlot("plot_contribution",
+            list(network = network, data = data, correlation = correlation,
+                 module_assignments = moduleAssignments, modules = modules,
+                 background_label = backgroundLabel, discovery = discovery,
+                 test = test, ...),
+            list(order_nodes_by = orderNodesBy,
+                 order_samples_by = orderSamplesBy))
+}
+
+.plotDegree_args <- .panelArgs
+
+plotDegree <- function(network,
+                       data = NULL,
+                       correlation = NULL,
+                       moduleAssignments = NULL,
+                       modules = NULL,
+                       backgroundLabel = "0",
+                       discovery = NULL,
+                       test = NULL,
+                       orderNodesBy = "discovery",
+                       orderSamplesBy = "test",
+                       ...) {
+  .callPlot("plot_degree",
             list(network = network, data = data, correlation = correlation,
                  module_assignments = moduleAssignments, modules = modules,
                  background_label = backgroundLabel, discovery = discovery,

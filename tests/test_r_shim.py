@@ -17,17 +17,33 @@ def _r_source():
     return open(R_FILE).read()
 
 
+def _parse_r_list(body):
+    out = {}
+    for rname, pyname in re.findall(r"(\w+)\s*=\s*\"([\w.]+)\"", body):
+        out[rname] = pyname
+    assert out
+    return out
+
+
 def _mapping(name):
-    """Parse `.name_args <- list(rName = "py_name", ...)` from the stub."""
+    """Parse `.name_args <- list(rName = "py_name", ...)` from the stub.
+    One level of indirection is followed: `.name_args <- .sharedVar` looks
+    up `.sharedVar <- list(...)` (the panel plots share one map)."""
+    alias = re.search(
+        rf"\.{name}_args\s*<-\s*(\.\w+)\s*\n", _r_source()
+    )
+    if alias:
+        shared = re.escape(alias.group(1))
+        m = re.search(
+            rf"{shared}\s*<-\s*list\((.*?)\)\s*\n", _r_source(), flags=re.S
+        )
+        assert m, f"shared map {alias.group(1)} not found in r/netrep_tpu.R"
+        return _parse_r_list(m.group(1))
     m = re.search(
         rf"\.{name}_args\s*<-\s*list\((.*?)\)\s*\n", _r_source(), flags=re.S
     )
     assert m, f".{name}_args list not found in r/netrep_tpu.R"
-    out = {}
-    for rname, pyname in re.findall(r"(\w+)\s*=\s*\"([\w.]+)\"", m.group(1)):
-        out[rname] = pyname
-    assert out
-    return out
+    return _parse_r_list(m.group(1))
 
 
 def _r_defaults(fn_name):
@@ -74,6 +90,11 @@ CASES = [
      "network_properties"),
     ("requiredPerms", "netrep_tpu.ops.pvalues", "required_perms"),
     ("plotModule", "netrep_tpu.plot", "plot_module"),
+    ("plotData", "netrep_tpu.plot", "plot_data"),
+    ("plotCorrelation", "netrep_tpu.plot", "plot_correlation"),
+    ("plotNetwork", "netrep_tpu.plot", "plot_network"),
+    ("plotContribution", "netrep_tpu.plot", "plot_contribution"),
+    ("plotDegree", "netrep_tpu.plot", "plot_degree"),
     ("nodeOrder", "netrep_tpu.plot", "node_order"),
     ("sampleOrder", "netrep_tpu.plot", "sample_order"),
 ]
@@ -114,7 +135,9 @@ def test_reference_surface_is_complete():
     src = _r_source()
     doc = open(os.path.join(ROOT, "docs", "r-shim.md")).read()
     for fn in ("modulePreservation", "networkProperties", "requiredPerms",
-               "plotModule", "combineAnalyses", "nodeOrder", "sampleOrder"):
+               "plotModule", "plotData", "plotCorrelation", "plotNetwork",
+               "plotContribution", "plotDegree", "combineAnalyses",
+               "nodeOrder", "sampleOrder"):
         assert re.search(rf"^{fn}\s*<-\s*function", src, flags=re.M), fn
         assert fn in doc, f"{fn} undocumented in docs/r-shim.md"
 
