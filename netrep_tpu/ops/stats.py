@@ -400,15 +400,60 @@ def gather_zdata(
     return standardize_masked(jnp.swapaxes(sub_d, -1, -2), mask)
 
 
-def derived_net(sub_corr: jnp.ndarray, net_beta: float) -> jnp.ndarray:
+#: soft-threshold constructions `derived_net` can apply (the three WGCNA
+#: adjacency types; "unsigned" is the classic |corr|**β). DERIVED_FORMULA
+#: holds the human-readable formula per kind for error messages — a new
+#: kind is added HERE (both tables) and in derived_net's chain, nowhere
+#: else (check_derived_network reuses derived_net itself).
+DERIVED_NET_KINDS = ("unsigned", "signed", "signed-hybrid")
+DERIVED_FORMULA = {
+    "unsigned": "|correlation|**{b}",
+    "signed": "((1+correlation)/2)**{b}",
+    "signed-hybrid": "max(correlation, 0)**{b}",
+}
+
+
+def normalize_net_beta(net_beta) -> tuple[float, str]:
+    """Resolve ``EngineConfig.network_from_correlation``'s two accepted
+    spellings — a bare power β (the original knob, meaning unsigned) or a
+    ``(β, kind)`` pair — into ``(float, kind)``."""
+    if isinstance(net_beta, tuple):
+        if len(net_beta) != 2:
+            raise ValueError(
+                "network_from_correlation must be a power β or a "
+                f"(β, kind) pair, got a {len(net_beta)}-tuple: {net_beta!r}"
+            )
+        beta, kind = net_beta
+    else:
+        beta, kind = net_beta, "unsigned"
+    if kind not in DERIVED_NET_KINDS:
+        raise ValueError(
+            f"derived-network kind must be one of {DERIVED_NET_KINDS}, "
+            f"got {kind!r}"
+        )
+    return float(beta), kind
+
+
+def derived_net(sub_corr: jnp.ndarray, net_beta) -> jnp.ndarray:
     """Soft-threshold network submatrix derived on device from the gathered
-    correlation: ``|corr|**β`` (the WGCNA construction). Deriving instead of
-    gathering a stored n×n network halves the hot loop's HBM row traffic and
-    the engine's matrix footprint (BASELINE.md roofline: the gather is
-    bandwidth-bound) — elementwise functions commute with gathers, so the
-    result equals gathering a precomputed ``|corr|**β`` matrix up to
-    float rounding."""
-    return jnp.abs(sub_corr) ** net_beta
+    correlation (the WGCNA adjacency constructions): ``|corr|**β``
+    (unsigned, the default), ``((1+corr)/2)**β`` (signed), or
+    ``max(corr, 0)**β`` (signed hybrid). ``net_beta`` is a bare β or a
+    ``(β, kind)`` pair. Deriving instead of gathering a stored n×n network
+    halves the hot loop's HBM row traffic and the engine's matrix footprint
+    (BASELINE.md roofline: the gather is bandwidth-bound) — elementwise
+    functions commute with gathers, so the result equals gathering the
+    precomputed matrix up to float rounding."""
+    beta, kind = normalize_net_beta(net_beta)
+    if kind == "signed":
+        # clip guards fractional β against NaN when rounding (bf16 mxu
+        # selection, or user f32 a ULP below -1) pushes corr under -1
+        return jnp.clip((1.0 + sub_corr) * 0.5, 0.0, None) ** beta
+    if kind == "signed-hybrid":
+        # 0**β = 0 for β > 0, so clipping implements "corr**β where
+        # positive, else 0" without a where/NaN hazard at fractional β
+        return jnp.clip(sub_corr, 0.0, None) ** beta
+    return jnp.abs(sub_corr) ** beta
 
 
 def gather_and_stats(

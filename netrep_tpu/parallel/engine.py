@@ -160,28 +160,31 @@ def _perm_keys_jit(key: jax.Array, start: jax.Array, count: int) -> jax.Array:
     )
 
 
-def check_derived_network(corr, net, beta: float, what: str) -> None:
-    """Check that ``net == |corr|**beta`` before the engine commits to
-    deriving network submatrices on device
-    (``EngineConfig.network_from_correlation``): exhaustive for matrices up
+def check_derived_network(corr, net, net_beta, what: str) -> None:
+    """Check that ``net`` matches the claimed soft-threshold construction
+    before the engine commits to deriving network submatrices on device
+    (``EngineConfig.network_from_correlation``; β or (β, kind) — see
+    :func:`netrep_tpu.ops.stats.derived_net`): exhaustive for matrices up
     to 64k entries, a fixed-seed random flat sample of 64k entries beyond
     (any *strided* sample would alias onto the columns divisible by
     gcd(stride, n), leaving most of the matrix unchecked). A mismatch means
-    the knob contradicts the data the user actually supplied."""
+    the knob contradicts the data the user actually supplied. The expected
+    values come from :func:`~netrep_tpu.ops.stats.derived_net` itself (on
+    the host sample) — ONE formula site, so this check can never validate
+    a different construction than the device derives."""
+    beta, kind = jstats.normalize_net_beta(net_beta)
     c = np.asarray(corr).reshape(-1)
     m = np.asarray(net).reshape(-1)
-    if c.size <= 65536:
-        want = np.abs(c) ** beta
-        got = m
-    else:
+    if c.size > 65536:
         ii = np.random.default_rng(0).integers(0, c.size, size=65536)
-        want = np.abs(c[ii]) ** beta
-        got = m[ii]
-    if not np.allclose(got, want, rtol=1e-3, atol=1e-4):
-        worst = float(np.max(np.abs(got - want)))
+        c, m = c[ii], m[ii]
+    want = np.asarray(jstats.derived_net(jnp.asarray(c), net_beta))
+    if not np.allclose(m, want, rtol=1e-3, atol=1e-4):
+        worst = float(np.max(np.abs(m - want)))
+        formula = jstats.DERIVED_FORMULA[kind].format(b=beta)
         raise ValueError(
-            f"network_from_correlation={beta} but the supplied {what} "
-            f"network is not |correlation|**{beta} (max sampled deviation "
+            f"network_from_correlation={net_beta!r} but the supplied {what} "
+            f"network is not {formula} (max sampled deviation "
             f"{worst:.3g}); drop the config knob or fix the inputs"
         )
 

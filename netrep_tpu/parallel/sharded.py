@@ -150,8 +150,10 @@ def gather_submatrix_local_mxu(
 def gather_corr_net(gather, tc, tn, idx, net_beta):
     """Single dispatch point for derived-network mode over a sharded
     gatherer: with ``tn`` present, gather the (corr, net) submatrix pair;
-    with ``tn`` None, gather only the correlation and derive the network as
-    ``|corr|**net_beta`` on device (EngineConfig.network_from_correlation).
+    with ``tn`` None, gather only the correlation and derive the network on
+    device via :func:`netrep_tpu.ops.stats.derived_net` — ``net_beta`` is
+    that function's knob: a power β or a (β, kind) pair
+    (EngineConfig.network_from_correlation).
     One helper so the observed, discovery-bucket, null-chunk, and multi-test
     paths cannot drift."""
     from ..ops import stats as jstats

@@ -506,6 +506,7 @@ def plot_summary(network, data=None, correlation=None,
         module_assignments=module_assignments, modules=modules,
         background_label=background_label, discovery=discovery, test=test,
         order_nodes_by=order_nodes_by, order_samples_by=order_samples_by,
+        stats="summary",
     )
     if ax is None:
         _fig, ax = plt.subplots(figsize=(3, 5))

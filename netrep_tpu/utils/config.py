@@ -63,7 +63,10 @@ class EngineConfig:
         gathered VALUES carry up to ~4e-3 relative rounding on TPU
         (statistics attenuate this ~1/m; see ``BASELINE.md`` §precision).
     network_from_correlation : soft-threshold power β when the network is
-        the WGCNA construction ``|correlation|**β``. When set, the engine
+        the WGCNA construction ``|correlation|**β``, or a ``(β, kind)``
+        pair with ``kind`` in ``('unsigned', 'signed', 'signed-hybrid')``
+        covering the other WGCNA adjacency types (``((1+corr)/2)**β`` and
+        ``max(corr, 0)**β``). When set, the engine
         never stores or gathers the n×n network on device: network
         submatrices derive elementwise from the gathered correlation —
         halving both HBM matrix footprint and the bandwidth-bound hot
@@ -114,10 +117,25 @@ class EngineConfig:
     #: CI coverage of the exact engine path, not a user-facing speedup.
     fused_exact: bool | str = False
     perm_batch: int | None = None
-    network_from_correlation: float | None = None
+    network_from_correlation: float | tuple | None = None
     mxu_batch_budget_bytes: int = 2 << 30
 
     def __post_init__(self):
+        if self.network_from_correlation is not None:
+            # normalize early (list -> tuple so the value stays hashable for
+            # jit-static threading) and fail fast on a bad kind/β
+            from ..ops.stats import normalize_net_beta
+
+            knob = self.network_from_correlation
+            if isinstance(knob, list):
+                knob = tuple(knob)
+                object.__setattr__(self, "network_from_correlation", knob)
+            beta, _kind = normalize_net_beta(knob)
+            if not beta > 0:
+                raise ValueError(
+                    "network_from_correlation power must be > 0, got "
+                    f"{beta!r}"
+                )
         if self.fused_exact not in (True, False, "always"):
             raise ValueError(
                 "fused_exact must be True, False, or 'always' (force the "

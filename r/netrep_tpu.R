@@ -129,10 +129,14 @@ requiredPerms <- function(alpha = 0.05, nTests = 1L,
 #' An order argument already present in args came through `...` under its
 #' Python name (the documented extras channel) — that explicit value wins
 #' over the camelCase argument, which is indistinguishable from its
-#' R-level default here.
+#' R-level default here. Order arguments are exempt from the NULL-drop
+#' for the same reason the camelCase path force-sets them: NULL is a real
+#' mode (input order), so a `...`-supplied order NULL must survive to
+#' Python as None rather than being dropped and defaulted.
 .callPlot <- function(py_name, args, orderArgs) {
   plt <- reticulate::import("netrep_tpu.plot")
-  args <- args[!vapply(args, is.null, logical(1))]
+  is_order <- names(args) %in% names(orderArgs)
+  args <- args[is_order | !vapply(args, is.null, logical(1))]
   for (nm in names(orderArgs)) {
     if (!nm %in% names(args)) args[nm] <- orderArgs[nm]
   }

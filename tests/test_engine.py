@@ -327,6 +327,55 @@ def test_derived_network_matches_explicit(setup):
         np.testing.assert_allclose(dn, rn, rtol=2e-5, atol=2e-5)
 
 
+def test_derived_network_signed_kinds_match_explicit(setup):
+    """network_from_correlation=(β, kind): the signed and signed-hybrid
+    WGCNA adjacency constructions derive on device exactly like unsigned —
+    elementwise functions commute with gathers — so each must equal the
+    run with its explicitly-stored network."""
+    d, t, modules, pool = setup
+
+    def mk(ds, kind):
+        c = np.asarray(ds["correlation"])
+        net = (((1.0 + c) / 2.0) ** 2 if kind == "signed"
+               else np.clip(c, 0.0, None) ** 2)
+        return net.astype(np.float32)
+
+    for kind in ("signed", "signed-hybrid"):
+        ref = PermutationEngine(
+            d["correlation"], mk(d, kind), d["data"],
+            t["correlation"], mk(t, kind), t["data"], modules, pool,
+            config=EngineConfig(chunk_size=8, summary_method="eigh"),
+        )
+        der = PermutationEngine(
+            d["correlation"], mk(d, kind), d["data"],
+            t["correlation"], mk(t, kind), t["data"], modules, pool,
+            config=EngineConfig(chunk_size=8, summary_method="eigh",
+                                network_from_correlation=(2.0, kind)),
+        )
+        assert der._test_net is None  # the n x n network never hit the device
+        np.testing.assert_allclose(der.observed(), ref.observed(),
+                                   rtol=2e-5, atol=2e-5)
+        dn, done = der.run_null(12, key=4)
+        rn, _ = ref.run_null(12, key=4)
+        assert done == 12
+        np.testing.assert_allclose(dn, rn, rtol=2e-5, atol=2e-5)
+
+    # claiming signed-hybrid against the fixture's |corr|**2 network must
+    # fail the sample check with the kind's own formula in the message
+    with pytest.raises(ValueError, match=r"max\(correlation"):
+        PermutationEngine(
+            d["correlation"], d["network"], d["data"],
+            t["correlation"], t["network"], t["data"], modules, pool,
+            config=EngineConfig(network_from_correlation=(2.0, "signed-hybrid")),
+        )
+    with pytest.raises(ValueError, match="kind must be one of"):
+        EngineConfig(network_from_correlation=(2.0, "nope"))
+    with pytest.raises(ValueError, match="power must be > 0"):
+        EngineConfig(network_from_correlation=(-1.0, "signed"))
+    with pytest.raises(ValueError, match=r"\(β, kind\) pair"):
+        EngineConfig(network_from_correlation=(2.0, "signed", "extra"))
+
+
 def test_derived_network_mismatch_raises(setup):
     d, t, modules, pool = setup
     with pytest.raises(ValueError, match="not \\|correlation\\|"):
