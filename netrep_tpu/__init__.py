@@ -29,6 +29,7 @@ __all__ = [
     "sparse_module_preservation",
     "sparse_network_properties",
     "summarize_trace",
+    "make_mesh",
 ]
 
 #: the plot suite (reference exports plotModule + per-panel functions at
@@ -74,6 +75,10 @@ def __getattr__(name):
         from .utils.profiling import summarize_trace
 
         return summarize_trace
+    if name == "make_mesh":
+        from .parallel.mesh import make_mesh
+
+        return make_mesh
     if name in _PLOT_EXPORTS:
         try:
             from . import plot

@@ -431,7 +431,13 @@ def normalize_net_beta(net_beta) -> tuple[float, str]:
             f"derived-network kind must be one of {DERIVED_NET_KINDS}, "
             f"got {kind!r}"
         )
-    return float(beta), kind
+    try:
+        return float(beta), kind
+    except (TypeError, ValueError):
+        raise ValueError(
+            "network_from_correlation power must be numeric, got "
+            f"{beta!r}"
+        ) from None
 
 
 def derived_net(sub_corr: jnp.ndarray, net_beta) -> jnp.ndarray:
