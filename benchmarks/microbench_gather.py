@@ -38,15 +38,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # dial becomes a fast CPU fallback) + persistent compile cache.
 from bench import ensure_backend  # noqa: E402
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-def bench(fn, *args, reps=3, warmup=1):
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps
+# the shared variant-aware timing helper (microbench_parts): pass
+# `variants=` at any site whose rate matters through the tunnel — a plain
+# identical-rep loop is short-circuited there and prints impossible rates
+# (BASELINE.md "microbench-timing caveat"). This script's call sites do
+# not thread variants (it is not in the watcher queue); main() prints a
+# loud warning on accelerators instead so its rows are never transcribed.
+from microbench_parts import bench  # noqa: E402
 
 
 def make_problem(n, n_modules, seed=1):
@@ -68,6 +68,12 @@ def main():
     args = ap.parse_args()
     ensure_backend()
     print(f"device={jax.devices()[0]}")
+    if jax.default_backend() != "cpu":
+        print("WARNING-RATES-UNTRUSTWORTHY: this script's rep loops re-run "
+              "identical executions, which the TPU tunnel short-circuits "
+              "(BASELINE.md microbench-timing caveat) — do NOT transcribe "
+              "these rates; use bench.py / tune_northstar rows instead",
+              flush=True)
 
     n, C = args.genes, args.chunk
     M, sizes = make_problem(n, args.modules)

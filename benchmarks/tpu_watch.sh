@@ -14,8 +14,12 @@ set -u
 cd "$(dirname "$0")/.."
 LOG=${1:-/tmp/tpu_bench_results.jsonl}
 STATE=${2:-/tmp/tpu_watch_state}
-PROBE_TIMEOUT=${PROBE_TIMEOUT:-60}
-SLEEP=${SLEEP:-150}
+# 45/45 defaults (was 60/150): windows run ~5-7 min, so a dead-tunnel
+# probe cycle must stay well under a window or most of it is lost before
+# the queue even starts (BASELINE.md measurement-session note). A live
+# tunnel answers the probe in seconds; 45 s only bounds the hung case.
+PROBE_TIMEOUT=${PROBE_TIMEOUT:-45}
+SLEEP=${SLEEP:-45}
 # Hard stop (epoch seconds): libtpu is exclusive per process, so the watcher
 # must be gone before the driver's round-end bench needs the chip.
 CUTOFF_EPOCH=${CUTOFF_EPOCH:-}
