@@ -43,6 +43,12 @@ def rows_from(path: str) -> list[dict]:
 def classify(row: dict) -> str:
     if row.get("tpu_fallback") or "error" in row or "warning" in row:
         return "dropped"
+    if row.get("cached"):
+        # tune resume replay: the measurement already appears once as a
+        # fresh row in an earlier watcher attempt — transcribing each
+        # rerun's replay would list one measurement as if independently
+        # reproduced
+        return "dropped"
     if row.get("ok") is False:
         return "dropped"  # tune point that failed validation mid-run
     dev = str(row.get("device", ""))

@@ -76,6 +76,66 @@ def test_parity_only_gate_refuses_cpu_pass():
     assert "FAILED" not in proc.stdout, proc.stdout[-2000:]
 
 
+def test_tune_sweep_resumes_from_state(tmp_path):
+    """A tunnel death mid-tune must only cost the in-flight point: completed
+    points persist to --state keyed by the full sweep+point params (plus a
+    code fingerprint, so stale rows from an older engine never replay) and
+    are reused verbatim (printed with cached:true) on rerun. Pre-caching
+    the ENTIRE grid makes the rerun pure replay — seconds, no measuring —
+    and pins best-selection across cached rows."""
+    import importlib
+
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    tune = importlib.import_module("tune_northstar")
+    sweep = {"perms": 16, "genes": 500, "modules": 3, "samples": 16,
+             "code": tune.code_fingerprint()}
+
+    def entry(pps, chunk=256, pb=None, dt="float32", gm="mxu",
+              derived=False, cap_g=None):
+        label = {"chunk": chunk, "perm_batch": pb, "dtype": dt,
+                 "gather_mode": gm, "derived_net": derived,
+                 "power_iters": 40,
+                 **({"cap_granularity": cap_g} if cap_g else {}),
+                 "device": None}
+        key = json.dumps({**sweep, **label}, sort_keys=True)
+        row = {**label, "device": "TPU v5 lite0", "s": 1.0,
+               "perms_per_sec": pps, "ok": True}
+        return json.dumps({"key": key, "row": row})
+
+    lines = []
+    # stage 1: the full 8-point decision grid; mxu/f32/plain wins at 999
+    import itertools
+    for gm, dt, derived in itertools.product(
+        ["mxu", "fused"], ["float32", "bfloat16"], [False, True]
+    ):
+        win = gm == "mxu" and dt == "float32" and not derived
+        lines.append(entry(999.0 if win else 111.0, gm=gm, dt=dt,
+                           derived=derived))
+    # stage 2 refinements around the winner + the cap-granularity point
+    for chunk, pb in [(128, None), (512, None), (256, 4), (256, 64)]:
+        lines.append(entry(222.0, chunk=chunk, pb=pb))
+    lines.append(entry(333.0, cap_g=8))
+    state = tmp_path / "tune_state.jsonl"
+    state.write_text("\n".join(lines) + "\n")
+
+    proc = _run_cpu_subprocess(
+        [sys.executable, "benchmarks/tune_northstar.py", "--genes", "500",
+         "--modules", "3", "--samples", "16", "--perms", "16",
+         "--state", str(state)],
+        timeout=580,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = [json.loads(l) for l in proc.stdout.strip().splitlines()
+           if l.startswith("{")]
+    cached = [l for l in out if l.get("cached")]
+    assert len(cached) == 13, (len(cached), proc.stdout[-2000:])
+    best = [l for l in out if "best" in l][-1]["best"]
+    assert best["perms_per_sec"] == 999.0, best
+    # CPU rows must never be written back into the resume state
+    entries = [json.loads(l) for l in state.read_text().splitlines()]
+    assert len(entries) == 13, len(entries)
+
+
 @pytest.mark.slow
 def test_tune_sweep_runs_end_to_end_on_cpu():
     # the decision grid (benchmarks/tune_northstar.py) is the highest-value

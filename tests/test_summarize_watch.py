@@ -30,6 +30,10 @@ def test_classify_provenance_rules():
         # drops: explicit markers
         ({"metric": "x", "error": "skipped", "tpu_fallback": True}, "dropped"),
         ({"metric": "backend probe", "warning": "falling back"}, "dropped"),
+        # drops: tune resume replay — already transcribed once as the
+        # original fresh row; each watcher rerun re-prints it
+        ({"chunk": 128, "ok": True, "s": 3.2, "perms_per_sec": 590.1,
+          "device": tpu, "cached": True}, "dropped"),
         # drops: failed tune point even on TPU (review r4: ok flag)
         ({"chunk": 128, "ok": False, "s": 1.0, "perms_per_sec": 9.9,
           "device": tpu}, "dropped"),
