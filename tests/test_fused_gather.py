@@ -326,6 +326,44 @@ def test_multitest_fused_matches_default(rng):
     )
 
 
+def test_multitest_fused_resolves_batch_against_real_chunk(rng, monkeypatch):
+    """ADVICE r3: the fused multi-test path once passed a 1<<30 sentinel as
+    the chunk to resolved_perm_batch, silently skipping the clamp of an
+    explicit perm_batch. Null VALUES cannot discriminate (batching only
+    changes scheduling), so pin the resolution call itself: the chunk
+    argument must be the engine's real effective chunk."""
+    from netrep_tpu.parallel.multitest import MultiTestEngine
+
+    d, t, specs, pool = _problem(rng)
+    args = (
+        d[1], d[2], d[0],
+        np.stack([t[1]]), np.stack([t[2]]), [t[0]],
+        specs, pool,
+    )
+    seen = []
+    orig = EngineConfig.resolved_perm_batch
+
+    def spy(self, gather_mode, platform, chunk, bytes_per_perm=None):
+        seen.append((gather_mode, chunk))
+        return orig(self, gather_mode, platform, chunk, bytes_per_perm)
+
+    monkeypatch.setattr(EngineConfig, "resolved_perm_batch", spy)
+    eng = MultiTestEngine(
+        *args,
+        config=EngineConfig(chunk_size=6, gather_mode="fused",
+                            summary_method="eigh", perm_batch=64),
+    )
+    out, done = eng.run_null(8, key=5)
+    assert done == 8
+    fused_calls = [c for gm, c in seen if gm == "fused"]
+    assert fused_calls, "fused path never resolved a perm batch"
+    for chunk in fused_calls:
+        assert chunk == eng._base.effective_chunk() == 6, (
+            f"fused multi-test resolved perm_batch against chunk={chunk}, "
+            "not the engine's real effective chunk"
+        )
+
+
 def test_fused_perm_mesh_replicated_matches_unmeshed(rng):
     # replicated matrices + perm-axis mesh: the fused chunk runs under
     # shard_map (XLA cannot auto-partition a pallas_call); same key =>
