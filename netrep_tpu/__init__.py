@@ -30,6 +30,7 @@ __all__ = [
     "sparse_network_properties",
     "summarize_trace",
     "make_mesh",
+    "selftest",
 ]
 
 #: the plot suite (reference exports plotModule + per-panel functions at
@@ -79,6 +80,10 @@ def __getattr__(name):
         from .parallel.mesh import make_mesh
 
         return make_mesh
+    if name == "selftest":
+        from .utils.selftest import selftest
+
+        return selftest
     if name in _PLOT_EXPORTS:
         try:
             from . import plot

@@ -1,0 +1,149 @@
+"""On-device numerical self-check for new deployments.
+
+A user bringing this framework up on unfamiliar hardware (a new TPU
+generation, a different driver/libtpu, an experimental backend like the
+axon tunnel) needs one call that answers "does this device compute what
+the NumPy oracle computes?" before trusting a 100k-permutation run.
+:func:`selftest` builds a deterministic multi-bucket toy problem, runs the
+observed pass and a small permutation null on the current default backend,
+and cross-checks both against the pure-NumPy oracle — including
+reconstructing one permutation from the documented seeding contract
+(``fold_in(key, i)`` → ``jax.random.permutation`` over the pool), so the
+draw → slice → gather → statistics path is validated end-to-end on the
+device, not just the kernels (the same contract
+``tests/test_engine.py::test_null_chunk_matches_oracle_reconstruction``
+pins on CPU).
+
+The reference has no analogue (its single backend is the host CPU); this
+is deployment tooling a multi-backend framework owes its users.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+#: statistic-level tolerance: CPU agrees with the oracle to ~1e-5; TPU's
+#: default-precision f32 matmuls truncate gather operands to bfloat16
+#: (~4e-3 relative on values, attenuated ~1/m by the statistics —
+#: BASELINE.md §Precision). Real breakage (wrong indices, bad collective,
+#: miscompiled kernel) shows up orders of magnitude above this.
+_ATOL = 2e-2
+
+
+def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True) -> dict:
+    """Run the on-device numerical self-check; return a summary dict.
+
+    Raises ``RuntimeError`` with the failing comparison when the device
+    disagrees with the NumPy oracle beyond rounding tolerances.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import oracle
+    from ..parallel.engine import ModuleSpec, PermutationEngine
+    from .config import EngineConfig
+
+    t_start = time.perf_counter()
+    device = str(jax.devices()[0])
+
+    # deterministic multi-bucket problem: sizes straddle the 32-cap bucket
+    # boundary so at least two compiled bucket programs execute
+    rng = np.random.default_rng(seed)
+    sizes = (40, 18, 9)
+    n, s = 96, 24
+
+    def build():
+        x = rng.standard_normal((s, n)).astype(np.float32)
+        c = np.corrcoef(x, rowvar=False).astype(np.float32)
+        np.fill_diagonal(c, 1.0)
+        return x, c, (np.abs(c) ** 2).astype(np.float32)
+
+    (d_data, d_corr, d_net), (t_data, t_corr, t_net) = build(), build()
+    specs, pos = [], 0
+    for k, sz in enumerate(sizes):
+        idx = np.arange(pos, pos + sz, dtype=np.int32)
+        specs.append(ModuleSpec(str(k + 1), idx, idx))
+        pos += sz
+    pool = np.arange(n, dtype=np.int32)
+
+    eng = PermutationEngine(
+        d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool,
+        config=EngineConfig(chunk_size=16, summary_method="eigh"),
+    )
+
+    def _oracle_stats(idx_per_module):
+        rows = []
+        for spec, idx in zip(specs, idx_per_module):
+            disc = oracle.DiscoveryProps(
+                d_corr[np.ix_(spec.disc_idx, spec.disc_idx)],
+                d_net[np.ix_(spec.disc_idx, spec.disc_idx)],
+                d_data[:, spec.disc_idx],
+            )
+            rows.append(oracle.module_stats(
+                disc, t_corr[np.ix_(idx, idx)], t_net[np.ix_(idx, idx)],
+                t_data[:, idx],
+            ))
+        return np.stack(rows)
+
+    # 1) observed pass vs oracle. This toy problem always has data, so
+    # every statistic is defined: any non-finite observed entry is device
+    # breakage (nanmax would silently skip it — review-caught hole)
+    obs = np.asarray(eng.observed())
+    want_obs = _oracle_stats([spec.test_idx for spec in specs])
+    if not np.isfinite(obs).all():
+        raise RuntimeError(
+            f"selftest FAILED on {device}: observed statistics contain "
+            "non-finite values"
+        )
+    obs_dev = float(np.max(np.abs(obs - want_obs)))
+    if not (obs_dev < _ATOL):
+        raise RuntimeError(
+            f"selftest FAILED on {device}: observed statistics deviate "
+            f"from the NumPy oracle by {obs_dev:.3g} (tolerance {_ATOL}) — "
+            "the device is not computing what the host computes"
+        )
+
+    # 2) permutation null: finite, and one permutation reconstructed from
+    #    the seeding contract matches the oracle end-to-end
+    nulls, done = eng.run_null(n_perm, key=seed)
+    nulls = np.asarray(nulls)
+    if done != n_perm or not np.isfinite(nulls).all():
+        raise RuntimeError(
+            f"selftest FAILED on {device}: null incomplete or non-finite "
+            f"({done}/{n_perm} permutations)"
+        )
+    p_check = min(3, n_perm - 1)
+    keys = eng.perm_keys(jax.random.key(seed), 0, n_perm)
+    perm = np.asarray(jax.random.permutation(keys[p_check], jnp.asarray(pool)))
+    off, idxs = 0, []
+    for sz in sizes:
+        idxs.append(perm[off: off + sz])
+        off += sz
+    null_dev = float(np.nanmax(np.abs(nulls[p_check] - _oracle_stats(idxs))))
+    if not (null_dev < _ATOL):
+        raise RuntimeError(
+            f"selftest FAILED on {device}: permutation {p_check} of the "
+            f"null deviates from the oracle reconstruction by "
+            f"{null_dev:.3g} (tolerance {_ATOL}) — draw/gather/statistics "
+            "disagree between device and host"
+        )
+
+    out = {
+        "ok": True,
+        "device": device,
+        "backend": jax.default_backend(),
+        "n_perm": int(n_perm),
+        "observed_max_abs_dev": obs_dev,
+        "null_reconstruction_max_abs_dev": null_dev,
+        "elapsed_s": round(time.perf_counter() - t_start, 2),
+    }
+    if verbose:
+        print(
+            f"netrep_tpu selftest OK on {device}: observed dev "
+            f"{obs_dev:.2e}, null-reconstruction dev {null_dev:.2e}, "
+            f"{n_perm} perms in {out['elapsed_s']}s"
+        )
+    return out

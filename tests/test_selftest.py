@@ -1,0 +1,61 @@
+"""netrep_tpu.selftest: the on-device numerical sanity check must pass on
+a healthy backend and FAIL LOUDLY when device math diverges from the
+oracle — a selftest that cannot fail is worse than none."""
+
+import numpy as np
+import pytest
+
+import netrep_tpu
+
+
+def test_selftest_passes_on_cpu(capsys):
+    out = netrep_tpu.selftest(n_perm=8, verbose=True)
+    assert out["ok"] and out["backend"] == "cpu"
+    # CPU is the oracle-exactness tier: deviations are float32 rounding,
+    # far under the cross-device tolerance
+    assert out["observed_max_abs_dev"] < 1e-4
+    assert out["null_reconstruction_max_abs_dev"] < 1e-4
+    assert "selftest OK" in capsys.readouterr().out
+
+
+def test_selftest_detects_wrong_observed(monkeypatch):
+    from netrep_tpu.parallel.engine import PermutationEngine
+
+    orig = PermutationEngine.observed
+    monkeypatch.setattr(
+        PermutationEngine, "observed",
+        lambda self: np.asarray(orig(self)) + 0.1,
+    )
+    with pytest.raises(RuntimeError, match="observed statistics deviate"):
+        netrep_tpu.selftest(n_perm=8, verbose=False)
+
+
+def test_selftest_detects_nan_observed(monkeypatch):
+    """A NaN in one observed statistic must fail the selftest — nanmax
+    would silently skip it (review-caught hole)."""
+    from netrep_tpu.parallel.engine import PermutationEngine
+
+    orig = PermutationEngine.observed
+
+    def nan_one(self):
+        o = np.asarray(orig(self)).copy()
+        o[0, 0] = np.nan
+        return o
+
+    monkeypatch.setattr(PermutationEngine, "observed", nan_one)
+    with pytest.raises(RuntimeError, match="non-finite"):
+        netrep_tpu.selftest(n_perm=8, verbose=False)
+
+
+def test_selftest_detects_wrong_null(monkeypatch):
+    from netrep_tpu.parallel.engine import PermutationEngine
+
+    orig = PermutationEngine.run_null
+
+    def bad(self, n_perm, key=0, **kw):
+        nulls, done = orig(self, n_perm, key=key, **kw)
+        return np.asarray(nulls) + 0.1, done
+
+    monkeypatch.setattr(PermutationEngine, "run_null", bad)
+    with pytest.raises(RuntimeError, match="deviates from the oracle"):
+        netrep_tpu.selftest(n_perm=8, verbose=False)
