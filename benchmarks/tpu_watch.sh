@@ -49,6 +49,7 @@ QUEUE=(
   "smoke       300  python bench.py --smoke"
   "north       900  python bench.py"
   "parity      600  python benchmarks/microbench_parts.py --parity-only"
+  "selftest    600  python -c 'import bench; bench.ensure_backend(); import netrep_tpu; r = netrep_tpu.selftest(); assert r[\"backend\"] != \"cpu\", r'"
   "tune        2400 python benchmarks/tune_northstar.py"
   "north_bf16  900  python bench.py --dtype bfloat16"
   "north_dnet  900  python bench.py --derived-net"
@@ -153,6 +154,16 @@ while :; do
       if [ "$key" = parity ] && [ "$rc" -ne 0 ] && [ "$fellback" -eq 0 ] && \
          grep -qE 'pallas fused parity FAILED|pallas fused gather: SKIPPED' "$step_out"; then
         mosaicfail=1
+      fi
+      # genuine on-device numerical-validation failure (not a flap/CPU
+      # drop): every subsequent row from this device would be untrusted —
+      # halt the queue loudly rather than fill BASELINE from broken math
+      if [ "$key" = selftest ] && [ "$rc" -ne 0 ] && [ "$fellback" -eq 0 ] && \
+         grep -q 'selftest FAILED' "$step_out"; then
+        echo "== DEVICE FAILED NUMERICAL SELFTEST; halting queue $(date -u +%FT%TZ) ==" | tee -a "$LOG"
+        echo '{"warning": "device failed numerical selftest; queue halted - rows after this point would be untrusted"}' >>"$LOG"
+        rm -f "$step_out"
+        exit 3
       fi
       rm -f "$step_out"
       if [ "$rc" -eq 0 ] && [ "$fellback" -eq 0 ]; then

@@ -232,6 +232,37 @@ def module_stats(
 # Full permutation procedure (slow loop) — the CPU baseline
 # ---------------------------------------------------------------------------
 
+def module_stats_for_indices(
+    d_corr: np.ndarray,
+    d_net: np.ndarray,
+    d_data: np.ndarray | None,
+    t_corr: np.ndarray,
+    t_net: np.ndarray,
+    t_data: np.ndarray | None,
+    disc_idx_per_module: list[np.ndarray],
+    test_idx_per_module: list[np.ndarray],
+) -> np.ndarray:
+    """All-module oracle statistics for explicit per-module test-node index
+    sets: the shared reconstruction primitive used by the CPU contract test
+    (``tests/test_engine.py``) and the on-device deployment check
+    (:func:`netrep_tpu.utils.selftest.selftest`), so the two cannot drift
+    in how slices map to statistics. Returns ``(n_modules, 7)``."""
+    rows = []
+    for di, ti in zip(disc_idx_per_module, test_idx_per_module):
+        disc = DiscoveryProps(
+            d_corr[np.ix_(di, di)],
+            d_net[np.ix_(di, di)],
+            d_data[:, di] if d_data is not None else None,
+        )
+        rows.append(module_stats(
+            disc,
+            t_corr[np.ix_(ti, ti)],
+            t_net[np.ix_(ti, ti)],
+            t_data[:, ti] if t_data is not None else None,
+        ))
+    return np.stack(rows)
+
+
 def permutation_null(
     disc_props: list[DiscoveryProps],
     module_sizes: list[int],

@@ -46,6 +46,8 @@ def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True) -> dict:
     from ..parallel.engine import ModuleSpec, PermutationEngine
     from .config import EngineConfig
 
+    if n_perm < 1:
+        raise ValueError(f"n_perm must be >= 1, got {n_perm}")
     t_start = time.perf_counter()
     device = str(jax.devices()[0])
 
@@ -75,18 +77,10 @@ def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True) -> dict:
     )
 
     def _oracle_stats(idx_per_module):
-        rows = []
-        for spec, idx in zip(specs, idx_per_module):
-            disc = oracle.DiscoveryProps(
-                d_corr[np.ix_(spec.disc_idx, spec.disc_idx)],
-                d_net[np.ix_(spec.disc_idx, spec.disc_idx)],
-                d_data[:, spec.disc_idx],
-            )
-            rows.append(oracle.module_stats(
-                disc, t_corr[np.ix_(idx, idx)], t_net[np.ix_(idx, idx)],
-                t_data[:, idx],
-            ))
-        return np.stack(rows)
+        return oracle.module_stats_for_indices(
+            d_corr, d_net, d_data, t_corr, t_net, t_data,
+            [spec.disc_idx for spec in specs], idx_per_module,
+        )
 
     # 1) observed pass vs oracle. This toy problem always has data, so
     # every statistic is defined: any non-finite observed entry is device
@@ -122,7 +116,11 @@ def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True) -> dict:
     for sz in sizes:
         idxs.append(perm[off: off + sz])
         off += sz
-    null_dev = float(np.nanmax(np.abs(nulls[p_check] - _oracle_stats(idxs))))
+    # np.max, not nanmax: the device side is isfinite-checked above, and a
+    # NaN in the oracle reconstruction (degenerate toy — should be
+    # impossible) propagates to a failing comparison instead of being
+    # silently skipped
+    null_dev = float(np.max(np.abs(nulls[p_check] - _oracle_stats(idxs))))
     if not (null_dev < _ATOL):
         raise RuntimeError(
             f"selftest FAILED on {device}: permutation {p_check} of the "

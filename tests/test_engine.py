@@ -121,26 +121,18 @@ def test_null_chunk_matches_oracle_reconstruction():
     pool_dev = jnp.asarray(pool)
     for p in range(n_perm):
         perm = np.asarray(jax.random.permutation(keys[p], pool_dev))
-        off = 0
-        for m, spec in enumerate(specs):
+        off, idxs = 0, []
+        for spec in specs:
             sz = len(spec.disc_idx)
-            idx = perm[off: off + sz]
+            idxs.append(perm[off: off + sz])
             off += sz
-            disc = oracle.DiscoveryProps(
-                d_corr[np.ix_(spec.disc_idx, spec.disc_idx)],
-                d_net[np.ix_(spec.disc_idx, spec.disc_idx)],
-                d_data[:, spec.disc_idx],
-            )
-            want = oracle.module_stats(
-                disc,
-                t_corr[np.ix_(idx, idx)],
-                t_net[np.ix_(idx, idx)],
-                t_data[:, idx],
-            )
-            np.testing.assert_allclose(
-                nulls[p, m], want, atol=2e-4,
-                err_msg=f"perm {p}, module {m}",
-            )
+        want = oracle.module_stats_for_indices(
+            d_corr, d_net, d_data, t_corr, t_net, t_data,
+            [spec.disc_idx for spec in specs], idxs,
+        )
+        np.testing.assert_allclose(
+            nulls[p], want, atol=2e-4, err_msg=f"perm {p}",
+        )
 
 
 def test_rounded_cap_granularity():

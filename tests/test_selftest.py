@@ -59,3 +59,8 @@ def test_selftest_detects_wrong_null(monkeypatch):
     monkeypatch.setattr(PermutationEngine, "run_null", bad)
     with pytest.raises(RuntimeError, match="deviates from the oracle"):
         netrep_tpu.selftest(n_perm=8, verbose=False)
+
+
+def test_selftest_rejects_degenerate_n_perm():
+    with pytest.raises(ValueError, match="n_perm must be >= 1"):
+        netrep_tpu.selftest(n_perm=0)
