@@ -1,7 +1,11 @@
 #!/bin/bash
-# One-shot: every pending TPU measurement for BASELINE.md (VERDICT r1 items
-# 1/3/4). Run when the axon tunnel is up; each line is appended to the log
-# as it lands so a mid-run tunnel death loses nothing.
+# One-shot manual sweep: every pending TPU measurement for BASELINE.md.
+# Prefer `tpu_watch.sh` (resumable, probe-gated, parity/selftest-gated) —
+# this script is the no-state fallback for a human sitting on a live
+# tunnel. Order = the watcher queue's priority order: the headline
+# north-star row first after the smoke sanity, gates before anything
+# fused, scale configs last (BASELINE.md "measurement-session note":
+# windows run ~5-7 min, so later lines may never execute).
 set -u
 cd "$(dirname "$0")/.."
 LOG=${1:-/tmp/tpu_bench_results.jsonl}
@@ -12,18 +16,22 @@ run() {
   # this script IS the timeout layer (like tpu_watch.sh): disable bench.py's
   # subprocess shield, whose larger budgets would never engage under the
   # shorter outer T values and whose extra layer buys nothing here
-  NETREP_BENCH_NO_SUBPROC=1 timeout "${T:-900}" "$@" 2>&1 \
+  NETREP_BENCH_NO_SUBPROC=1 PYTHONUNBUFFERED=1 timeout "${T:-900}" "$@" 2>&1 \
     | grep -v WARNING | tee -a "$LOG"
 }
 
 T=300  run python bench.py --smoke                     # tunnel sanity
+T=900  run python bench.py                             # north-star FIRST
+T=600  run python benchmarks/microbench_parts.py --parity-only  # Mosaic gate
+T=600  run python -c 'import bench; bench.ensure_backend(); import netrep_tpu; r = netrep_tpu.selftest(); assert r["backend"] != "cpu", r'
+T=2400 run python benchmarks/tune_northstar.py         # decision grid (resumable)
+T=900  run python bench.py --derived-net               # |corr|^2 derived mode
+T=900  run python bench.py --dtype bfloat16
+T=1200 run python benchmarks/bf16_drift.py
 T=600  run python bench.py --config B
 T=900  run python bench.py --config C
 T=600  run python bench.py --config E
 T=900  run python benchmarks/microbench_sharded_gather.py
-T=2400 run python benchmarks/tune_northstar.py
-T=600  run python bench.py                             # north-star, current
-T=600  run python bench.py --derived-net               # |corr|^2 derived mode
 T=2400 run python bench.py --config D                  # 100k perms, stored net
 T=2400 run python bench.py --config D --derived-net    # 100k perms, derived
 echo "== done $(date -u +%FT%TZ) ==" | tee -a "$LOG"
