@@ -15,15 +15,28 @@ run() {
   echo "--- $* ---" | tee -a "$LOG"
   # this script IS the timeout layer (like tpu_watch.sh): disable bench.py's
   # subprocess shield, whose larger budgets would never engage under the
-  # shorter outer T values and whose extra layer buys nothing here
+  # shorter outer T values and whose extra layer buys nothing here.
+  # Returns the COMMAND's status (grep/tee must not mask it — the gate
+  # lines below depend on it).
   NETREP_BENCH_NO_SUBPROC=1 PYTHONUNBUFFERED=1 timeout "${T:-900}" "$@" 2>&1 \
     | grep -v WARNING | tee -a "$LOG"
+  return "${PIPESTATUS[0]}"
+}
+
+halt() {
+  # a failed gate means every later row would be untrusted (CPU fallback,
+  # miscompiled kernel, broken device math) — same policy as tpu_watch.sh
+  echo "== GATE FAILED ($1); halting sweep $(date -u +%FT%TZ) ==" | tee -a "$LOG"
+  echo '{"warning": "'"$1"' gate failed; sweep halted - rows after this point would be untrusted"}' >>"$LOG"
+  exit 3
 }
 
 T=300  run python bench.py --smoke                     # tunnel sanity
 T=900  run python bench.py                             # north-star FIRST
-T=600  run python benchmarks/microbench_parts.py --parity-only  # Mosaic gate
-T=600  run python -c 'import bench; bench.ensure_backend(); import netrep_tpu; r = netrep_tpu.selftest(); assert r["backend"] != "cpu", r'
+T=600  run python benchmarks/microbench_parts.py --parity-only \
+  || halt "fused-parity"                               # Mosaic gate
+T=600  run python -c 'import bench; bench.ensure_backend(); import netrep_tpu; r = netrep_tpu.selftest(); assert r["backend"] != "cpu", r' \
+  || halt "device-selftest"
 T=2400 run python benchmarks/tune_northstar.py         # decision grid (resumable)
 T=900  run python bench.py --derived-net               # |corr|^2 derived mode
 T=900  run python bench.py --dtype bfloat16

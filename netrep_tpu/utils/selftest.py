@@ -33,8 +33,16 @@ import numpy as np
 _ATOL = 2e-2
 
 
-def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True) -> dict:
+def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True,
+             mesh=None) -> dict:
     """Run the on-device numerical self-check; return a summary dict.
+
+    With ``mesh`` (a :func:`netrep_tpu.make_mesh` mesh) the null runs
+    sharded — permutation chunks over the ``perm`` axis, and with
+    ``n_row_shards > 1`` the matrices row-sharded with collective module
+    gathers — so a pod deployment can validate its ICI/DCN collective
+    path against the same oracle before a large run, not just one chip's
+    arithmetic.
 
     Raises ``RuntimeError`` with the failing comparison when the device
     disagrees with the NumPy oracle beyond rounding tolerances.
@@ -71,9 +79,24 @@ def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True) -> dict:
         pos += sz
     pool = np.arange(n, dtype=np.int32)
 
+    cfg_kw = {}
+    if mesh is not None:
+        from ..parallel.mesh import ROW_AXIS
+
+        n_row = mesh.shape.get(ROW_AXIS, 1)
+        if n % max(1, n_row):
+            raise ValueError(
+                f"selftest's {n}-node toy problem is not divisible by the "
+                f"mesh's {n_row} row shards — use n_row_shards dividing {n}"
+            )
+        cfg_kw["matrix_sharding"] = "row" if n_row > 1 else "replicated"
+    # chunk_size needs no mesh adjustment: the engine's effective_chunk()
+    # already rounds it onto the mesh's perm axis
     eng = PermutationEngine(
         d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool,
-        config=EngineConfig(chunk_size=16, summary_method="eigh"),
+        config=EngineConfig(chunk_size=16, summary_method="eigh",
+                            **cfg_kw),
+        mesh=mesh,
     )
 
     def _oracle_stats(idx_per_module):
@@ -133,6 +156,7 @@ def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True) -> dict:
         "ok": True,
         "device": device,
         "backend": jax.default_backend(),
+        "mesh": None if mesh is None else dict(mesh.shape),
         "n_perm": int(n_perm),
         "observed_max_abs_dev": obs_dev,
         "null_reconstruction_max_abs_dev": null_dev,
