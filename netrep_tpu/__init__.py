@@ -31,6 +31,7 @@ __all__ = [
     "summarize_trace",
     "make_mesh",
     "selftest",
+    "properties_table",
 ]
 
 #: the plot suite (reference exports plotModule + per-panel functions at
@@ -49,12 +50,14 @@ _PLOT_EXPORTS = frozenset({
 def __getattr__(name):
     # Lazy imports keep `import netrep_tpu` light (no jax trace-time cost)
     # until an API that needs it is touched.
-    if name in ("module_preservation", "network_properties"):
+    if name in ("module_preservation", "network_properties",
+                "properties_table"):
         from .models import preservation, properties
 
         return {
             "module_preservation": preservation.module_preservation,
             "network_properties": properties.network_properties,
+            "properties_table": properties.properties_table,
         }[name]
     if name in ("required_perms", "permp"):
         from .ops import pvalues

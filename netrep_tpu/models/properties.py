@@ -95,3 +95,62 @@ def network_properties(
             inner = next(iter(out.values()))
             return next(iter(inner.values())) if len(inner) == 1 else inner
     return out
+
+
+def properties_table(
+    network,
+    data=None,
+    correlation=None,
+    module_assignments=None,
+    modules=None,
+    background_label: str = "0",
+    discovery=None,
+    test=None,
+    self_preservation: bool = True,
+):
+    """Tidy node-level export of observed network properties: one row per
+    (discovery, test, module, node) with that node's ``degree`` and
+    ``contribution`` plus the module-level ``avg_weight``/``coherence``
+    repeated on each row — the long-format frame users of the reference
+    assemble by hand from ``networkProperties()``'s nested lists (the
+    preservation-side analogue is :func:`netrep_tpu.results_table`).
+    Arguments are :func:`network_properties`'s; requires pandas."""
+    try:
+        import pandas as pd
+    except ImportError as e:
+        raise ImportError(
+            "properties_table requires pandas — install the frames extra: "
+            "pip install netrep-tpu[frames]"
+        ) from e
+
+    full = network_properties(
+        network, data=data, correlation=correlation,
+        module_assignments=module_assignments, modules=modules,
+        background_label=background_label, discovery=discovery, test=test,
+        self_preservation=self_preservation, simplify=False,
+    )
+    rows = []
+    for d_name, tests in full.items():
+        for t_name, mods in tests.items():
+            for lab, props in mods.items():
+                if props is None:  # module absent from this dataset
+                    continue
+                contrib = props["contribution"]
+                for i, nm in enumerate(props["node_names"]):
+                    rows.append({
+                        "discovery": d_name,
+                        "test": t_name,
+                        "module": lab,
+                        "node": nm,
+                        "degree": float(props["degree"][i]),
+                        "contribution": (
+                            float(contrib[i]) if contrib is not None
+                            else float("nan")
+                        ),
+                        "avg_weight": float(props["avg_weight"]),
+                        "coherence": float(props["coherence"]),
+                    })
+    return pd.DataFrame(
+        rows, columns=["discovery", "test", "module", "node", "degree",
+                       "contribution", "avg_weight", "coherence"],
+    )

@@ -1,0 +1,72 @@
+"""network_properties / properties_table: observed per-module properties
+and their tidy node-level export, pinned against the NumPy oracle."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import netrep_tpu
+from netrep_tpu.ops import oracle
+
+
+@pytest.fixture(scope="module")
+def toy():
+    rng = np.random.default_rng(17)
+    n, s = 60, 30
+    x = rng.standard_normal((s, n)).astype(np.float32)
+    z = (x - x.mean(0)) / x.std(0)
+    c = np.clip(z.T @ z / s, -1, 1).astype(np.float32)
+    np.fill_diagonal(c, 1.0)
+    net = (np.abs(c) ** 2).astype(np.float32)
+    labels = np.array(["1"] * 20 + ["2"] * 25 + ["0"] * 15)
+    kw = dict(
+        network={"d": net, "t": net}, data={"d": x, "t": x},
+        correlation={"d": c, "t": c}, module_assignments=labels,
+        discovery="d", test="t",
+    )
+    return x, net, labels, kw
+
+
+def test_network_properties_shapes(toy):
+    x, net, labels, kw = toy
+    props = netrep_tpu.network_properties(**kw)
+    assert set(props) == {"1", "2"}
+    p1 = props["1"]
+    assert len(p1["node_names"]) == 20
+    assert p1["degree"].shape == (20,)
+    assert p1["summary"].shape == (x.shape[0],)
+    assert np.isfinite(p1["coherence"])
+
+
+def test_properties_table_matches_oracle(toy):
+    x, net, labels, kw = toy
+    df = netrep_tpu.properties_table(**kw)
+    assert isinstance(df, pd.DataFrame)
+    assert list(df.columns) == ["discovery", "test", "module", "node",
+                                "degree", "contribution", "avg_weight",
+                                "coherence"]
+    # one row per (module, node): modules 1 (20 nodes) and 2 (25 nodes)
+    assert len(df) == 45
+    assert set(df["module"]) == {"1", "2"}
+
+    # pin module 1's rows against the oracle directly
+    m1 = df[df["module"] == "1"].reset_index(drop=True)
+    idx = np.arange(20)
+    deg = oracle.weighted_degree(net[np.ix_(idx, idx)])
+    deg = deg / np.max(np.abs(deg))
+    np.testing.assert_allclose(m1["degree"].to_numpy(), deg, atol=1e-6)
+    nc = oracle.node_contribution(x[:, idx])
+    np.testing.assert_allclose(m1["contribution"].to_numpy(), nc, atol=1e-6)
+    assert np.allclose(m1["avg_weight"].to_numpy(),
+                       oracle.avg_edge_weight(net[np.ix_(idx, idx)]))
+    assert np.allclose(m1["coherence"].to_numpy(), float(np.mean(nc ** 2)))
+
+
+def test_properties_table_data_less(toy):
+    _x, _net, _labels, kw = toy
+    kw2 = {k: v for k, v in kw.items() if k != "data"}
+    df = netrep_tpu.properties_table(**kw2)
+    assert len(df) == 45
+    assert df["contribution"].isna().all()
+    assert df["coherence"].isna().all()
+    assert np.isfinite(df["degree"].to_numpy()).all()
