@@ -35,6 +35,10 @@ CASES = [
     ["--config", "D", "--derived-net"],
     ["--config", "E"],
     ["--config", "native"],
+    # the pure-NumPy oracle row — the CPU denominator BASELINE.md's
+    # speedup claims divide by; not in the watcher queue (needs no TPU)
+    # but a silent break would cost the baseline side of every comparison
+    ["--config", "oracle"],
 ]
 
 
