@@ -153,3 +153,25 @@ def test_vmap_over_permutations(rng):
         sub = np.ix_(idx, idx)
         expected = oracle.module_stats(disc_o, t_corr[sub], t_net[sub], t_data[:, idx])
         np.testing.assert_allclose(got[p], expected, atol=1e-4)
+
+
+def test_module_stats_for_indices_data_less():
+    """The shared reconstruction helper's data-less path: topology
+    statistics computed, data-dependent ones NaN — same contract as
+    module_stats (SURVEY.md §2.2 data-less case)."""
+    rng = np.random.default_rng(23)
+    n = 30
+    x = rng.standard_normal((12, n))
+    c = np.corrcoef(x, rowvar=False)
+    net = np.abs(c) ** 2
+    di = [np.arange(0, 8), np.arange(8, 20)]
+    ti = [np.arange(5, 13), np.arange(13, 25)]
+    out = oracle.module_stats_for_indices(
+        c, net, None, c, net, None, di, ti,
+    )
+    assert out.shape == (2, 7)
+    # avg.weight, cor.cor, cor.degree computable; the rest NaN
+    computable = [0, 2, 3]
+    assert np.isfinite(out[:, computable]).all()
+    nan_stats = [i for i in range(7) if i not in computable]
+    assert np.isnan(out[:, nan_stats]).all()
