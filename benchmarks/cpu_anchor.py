@@ -1,0 +1,132 @@
+"""Second, independently-measured anchor for the traffic model (VERDICT r4
+item 9 / weak #1): the model's sustained-bandwidth estimate previously
+rested entirely on the single unreproduced 27.14 s TPU row. This script
+measures, on the local CPU at the REAL north-star shape (n=20k, the
+engine's own bucket caps):
+
+1. a STREAM-like sustained copy bandwidth (the host's achievable peak),
+2. XLA's row-gather sustained bytes/s over the same matrices the mxu
+   path gathers (the bandwidth-bound part of the hot loop — the colsel
+   matmul is FLOP-bound on CPU and says nothing about bytes/s there).
+
+Their ratio is the *structural* gather efficiency XLA reaches at these
+shapes (descriptor overhead vs streaming) — a property of the lowered
+gather, not of the part — and `efficiency × TPU peak` is a sustained-BW
+estimate that does not depend on the 27.14 s row. traffic_model.py reads
+the JSON this writes and prints both anchors and their disagreement.
+
+Run on an OTHERWISE IDLE machine (1-core box: a concurrent pytest run
+poisons both measurements): python benchmarks/cpu_anchor.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "cpu_anchor.json")
+
+
+def _time_calls(fn, variants, reps):
+    """Median wall-clock per call, cycling distinct inputs (habit from the
+    tunnel discipline; on CPU it also defeats any result caching)."""
+    import jax
+
+    jax.block_until_ready(fn(*variants[-1]))  # warm/compile
+    ts = []
+    for r in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*variants[r % len(variants)]))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from bench import make_specs
+    from netrep_tpu.utils.config import EngineConfig
+
+    genes, modules, reps = 20_000, 50, 7
+    cfg = EngineConfig()
+    specs = make_specs(genes, modules)
+    caps = np.array([cfg.rounded_cap(len(s.disc_idx)) for s in specs])
+    sum_cap = int(caps.sum())
+
+    # --- 1) STREAM-like copy: sustained bytes/s the host can actually move.
+    # 400 MB operands (far beyond LLC); y = x + 1.0 streams one read + one
+    # write per element.
+    n_el = 100_000_000
+    xs = [jnp.arange(v, v + n_el, dtype=jnp.float32) for v in range(3)]
+    add1 = jax.jit(lambda x: x + 1.0)
+    t_stream = _time_calls(add1, [(x,) for x in xs], reps)
+    stream_bw = 2 * n_el * 4 / t_stream
+
+    # --- 2) XLA row gather at north-star shape: the engine's mxu path
+    # gathers Σ_b K_b·cap_b sorted rows of each (n, n) matrix per
+    # permutation. Values don't matter for bandwidth; one big uniform
+    # matrix stands in for corr/net.
+    M = jax.random.normal(jax.random.key(0), (genes, genes),
+                          dtype=jnp.float32)
+    jax.block_until_ready(M)
+
+    def make_idx(seed):
+        raw = jax.random.choice(jax.random.key(seed), genes, (sum_cap,),
+                                replace=True)
+        return jnp.sort(raw).astype(jnp.int32)
+
+    idxs = [make_idx(v) for v in range(reps + 1)]
+    rowg = jax.jit(lambda Mx, ix: jnp.take(Mx, ix, axis=0))
+    t_gather = _time_calls(rowg, [(M, ix) for ix in idxs], reps)
+    # Two accountings, both reported (review r5: the choice moves the
+    # efficiency 2x, so hiding it would cook the anchor):
+    # - read-only: the gather's useful HBM READ traffic (what the traffic
+    #   model's one-pass byte count measures on the TPU side);
+    # - read+write: the gather also materializes a (sum_cap, genes)
+    #   output, so the bytes it physically moves are ~2x — the
+    #   symmetric-accounting twin of the STREAM denominator (which
+    #   counts one read + one write per element).
+    gather_bytes = sum_cap * genes * 4
+    eff_read = (gather_bytes / t_gather) / stream_bw
+    eff_rw = (2 * gather_bytes / t_gather) / stream_bw
+
+    out = {
+        "machine": "cpu-1core" if os.cpu_count() == 1 else f"cpu-{os.cpu_count()}core",
+        "genes": genes,
+        "modules": modules,
+        "sum_cap": sum_cap,
+        "stream_copy_GBps": round(stream_bw / 1e9, 2),
+        "row_gather_read_GBps": round(gather_bytes / t_gather / 1e9, 2),
+        "gather_efficiency_read_only": round(eff_read, 4),
+        "gather_efficiency_rw": round(eff_rw, 4),
+        "gather_bytes_per_call_GB": round(gather_bytes / 1e9, 4),
+        "t_stream_s": round(t_stream, 4),
+        "t_gather_s": round(t_gather, 4),
+        "reps": reps,
+        "note": (
+            "efficiencies = XLA row-gather rate over STREAM copy rate at "
+            "north-star shape on this host, under read-only vs "
+            "read+write byte accounting (the gather materializes its "
+            "output, so rw ~= 2x read-only); traffic_model.py uses "
+            "[read_only, rw] * TPU peak as the second sustained-BW "
+            "anchor BRACKET"
+        ),
+    }
+    with open(OUT_PATH, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,13 +40,14 @@ from bench import ensure_backend  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# the shared variant-aware timing helper (microbench_parts): pass
-# `variants=` at any site whose rate matters through the tunnel — a plain
-# identical-rep loop is short-circuited there and prints impossible rates
-# (BASELINE.md "microbench-timing caveat"). This script's call sites do
-# not thread variants (it is not in the watcher queue); main() prints a
-# loud warning on accelerators instead so its rows are never transcribed.
-from microbench_parts import bench  # noqa: E402
+# the shared variant-aware timing helper (microbench_parts): every timed
+# site threads `variants=` — distinct same-shape inputs cycled across reps
+# — because the tunnel short-circuits repeated identical executions and
+# prints impossible rates (BASELINE.md "microbench-timing caveat"). On
+# accelerators bench() enforces this (raises when variants are missing or
+# too few), so this script's rates are transcribable evidence now
+# (VERDICT r4 item 2).
+from microbench_parts import DEFAULT_WARMUP, bench  # noqa: E402
 
 
 def make_problem(n, n_modules, seed=1):
@@ -68,12 +69,6 @@ def main():
     args = ap.parse_args()
     ensure_backend()
     print(f"device={jax.devices()[0]}")
-    if jax.default_backend() != "cpu":
-        print("WARNING-RATES-UNTRUSTWORTHY: this script's rep loops re-run "
-              "identical executions, which the TPU tunnel short-circuits "
-              "(BASELINE.md microbench-timing caveat) — do NOT transcribe "
-              "these rates; use bench.py / tune_northstar rows instead",
-              flush=True)
 
     n, C = args.genes, args.chunk
     M, sizes = make_problem(n, args.modules)
@@ -81,6 +76,11 @@ def main():
     offsets = np.concatenate([[0], np.cumsum(sizes)])
     sum_m2 = int((sizes.astype(np.int64) ** 2).sum())
     print(f"n={n} modules={len(sizes)} T={T} sum_m2={sum_m2} chunk={C}")
+
+    # one distinct input draw per timed-or-warmup call (bench() cycles
+    # them): the tunnel must never see the same execution twice in any
+    # rate that could be transcribed
+    V = max(1, args.reps) + DEFAULT_WARMUP
 
     # bucket sizes to powers of two (same rule as EngineConfig.rounded_cap)
     def cap_of(s):
@@ -94,9 +94,15 @@ def main():
     print("buckets:", {c: len(v) for c, v in by_cap.items()})
 
     pool = jnp.arange(n, dtype=jnp.int32)
-    keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.key(7), i))(
-        jnp.arange(C, dtype=jnp.uint32)
-    )
+
+    def keyset(v):
+        # disjoint fold_in ranges per variant — same shapes, different draws
+        return jax.vmap(lambda i: jax.random.fold_in(jax.random.key(7), i))(
+            jnp.arange(C, dtype=jnp.uint32) + jnp.uint32(v * C)
+        )
+
+    keysets = [keyset(v) for v in range(V)]
+    keys = keysets[0]
 
     def run(name, thunk):
         if args.only and args.only not in name:
@@ -107,25 +113,38 @@ def main():
             print(f"{name}: FAILED {type(e).__name__}: {e}")
 
     # ---------------- primitives -------------------------------------------
-    idx_T_sorted = jnp.sort(jax.random.choice(jax.random.key(1), n, (T,), replace=False))
-    idx_T_rand = jax.random.permutation(jax.random.key(2), idx_T_sorted)
+    idx_sorted_vs = [
+        jnp.sort(jax.random.choice(jax.random.key(1 + v), n, (T,), replace=False))
+        for v in range(V)
+    ]
+    idx_rand_vs = [
+        jax.random.permutation(jax.random.key(101 + v), s)
+        for v, s in enumerate(idx_sorted_vs)
+    ]
+    idx_T_sorted = idx_sorted_vs[0]
+    sorted_variants = [(M, s) for s in idx_sorted_vs]
 
     def prims():
-        t = bench(jax.jit(lambda: jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)), reps=args.reps)
+        draw_all = jax.jit(
+            lambda ks: jax.vmap(lambda k: jax.random.permutation(k, pool))(ks)
+        )
+        t = bench(draw_all, keys, reps=args.reps,
+                  variants=[(ks,) for ks in keysets])
         print(f"prim perm_draw x{C}:              {t*1e3:8.2f} ms  ({t/C*1e3:.3f} ms/perm)")
 
         rowg = jax.jit(lambda Mx, idx: jnp.take(Mx, idx, axis=0))
-        t = bench(rowg, M, idx_T_sorted, reps=args.reps)
+        t = bench(rowg, M, idx_T_sorted, reps=args.reps, variants=sorted_variants)
         print(f"prim row_gather (T,n) sorted:     {t*1e3:8.2f} ms  ({T*n*4/t/1e9:.0f} GB/s)")
-        t = bench(rowg, M, idx_T_rand, reps=args.reps)
+        t = bench(rowg, M, idx_rand_vs[0], reps=args.reps,
+                  variants=[(M, r) for r in idx_rand_vs])
         print(f"prim row_gather (T,n) random:     {t*1e3:8.2f} ms  ({T*n*4/t/1e9:.0f} GB/s)")
 
         tr = jax.jit(lambda Mx, idx: jnp.take(Mx, idx, axis=0).T)
-        t = bench(tr, M, idx_T_sorted, reps=args.reps)
+        t = bench(tr, M, idx_T_sorted, reps=args.reps, variants=sorted_variants)
         print(f"prim gather+transpose (n,T):      {t*1e3:8.2f} ms")
 
         twog = jax.jit(lambda Mx, idx: jnp.take(jnp.take(Mx, idx, axis=0).T, idx, axis=0))
-        t = bench(twog, M, idx_T_sorted, reps=args.reps)
+        t = bench(twog, M, idx_T_sorted, reps=args.reps, variants=sorted_variants)
         print(f"prim gather.T gather (T,T):       {t*1e3:8.2f} ms")
 
         colsel = jax.jit(
@@ -135,11 +154,11 @@ def main():
                 preferred_element_type=jnp.float32,
             )
         )
-        t = bench(colsel, M, idx_T_sorted, reps=args.reps)
+        t = bench(colsel, M, idx_T_sorted, reps=args.reps, variants=sorted_variants)
         print(f"prim gather+onehot (T,T):         {t*1e3:8.2f} ms  ({2*T*T*n/t/1e12:.1f} TFLOP/s)")
 
         direct2d = jax.jit(lambda Mx, idx: Mx[idx[:, None], idx[None, :]])
-        t = bench(direct2d, M, idx_T_sorted, reps=args.reps)
+        t = bench(direct2d, M, idx_T_sorted, reps=args.reps, variants=sorted_variants)
         print(f"prim direct 2D gather (T,T):      {t*1e3:8.2f} ms  ({T*T/t/1e6:.0f} Melem/s)")
 
     run("prim", prims)
@@ -206,10 +225,13 @@ def main():
         jitted = jax.jit(chunk)
         return lambda ks: jitted(ks, M)
 
+    key_variants = [(ks,) for ks in keysets]
+
     for name, fn in [("direct", sub_direct), ("mxu", sub_mxu), ("transpose", sub_transpose)]:
         for batch in ([2, 8] if name != "direct" else [2]):
             def go(name=name, fn=fn, batch=batch):
-                t = bench(chunk_of(fn, batch), keys, reps=args.reps)
+                t = bench(chunk_of(fn, batch), keys, reps=args.reps,
+                          variants=key_variants)
                 print(f"chunk {name:9s} batch={batch}:         {t*1e3:8.2f} ms  ({t/C*1e3:6.3f} ms/perm)")
             run(f"chunk-{name}-b{batch}", go)
 
@@ -261,7 +283,8 @@ def main():
     for name, inner in [("2stage+direct", sub_direct_T), ("2stage+mxu", sub_mxu_T)]:
         for batch in [2, 8]:
             def go(name=name, inner=inner, batch=batch):
-                t = bench(chunk_twostage(inner, batch), keys, reps=args.reps)
+                t = bench(chunk_twostage(inner, batch), keys, reps=args.reps,
+                          variants=key_variants)
                 print(f"chunk {name:13s} batch={batch}:     {t*1e3:8.2f} ms  ({t/C*1e3:6.3f} ms/perm)")
             run(f"2stage-{name.split('+')[1]}-b{batch}", go)
 

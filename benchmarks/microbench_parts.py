@@ -32,7 +32,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import ensure_backend  # noqa: E402
 
 
-def bench(fn, *args, reps=5, warmup=2, variants=None):
+# bench()'s default warmup count, exported so variant-list sizing at call
+# sites (here and microbench_gather) can never drift from the enforcement
+# threshold below (review r5: a hard-coded '+ 2' would silently break if
+# this default changed)
+DEFAULT_WARMUP = 2
+
+
+def bench(fn, *args, reps=5, warmup=DEFAULT_WARMUP, variants=None):
     """Average wall-clock per call. ``variants`` — arg tuples cycled across
     reps so no two timed calls are the identical (fn, args) execution: the
     axon tunnel appears to short-circuit repeated identical executions
@@ -44,8 +51,28 @@ def bench(fn, *args, reps=5, warmup=2, variants=None):
     reps+warmup variants are supplied; the single output reference is
     rebound per rep (device buffers free as execution drains — holding all
     reps' outputs would multiply peak HBM by reps), and the final
-    block_until_ready covers the whole in-order stream."""
+    block_until_ready covers the whole in-order stream.
+
+    On accelerators this is ENFORCED (VERDICT r4 item 2): fewer than
+    reps+warmup distinct variants means some timed call repeats a prior
+    execution, which the tunnel can short-circuit into a fabricated rate
+    — raise instead of printing a number that is not a measurement. CPU
+    runs (CI, local smoke) are exempt; there is no tunnel to fool."""
     calls = [tuple(v) for v in variants] if variants else [tuple(args)]
+    if jax.default_backend() != "cpu":
+        # identity-distinct, not just enough of them: [(M, idx)] * 7 would
+        # satisfy a bare count check while every timed call is still the
+        # identical execution the tunnel short-circuits (review r5)
+        distinct = {tuple(id(a) for a in c) for c in calls}
+        if len(calls) < reps + warmup or len(distinct) < len(calls):
+            raise RuntimeError(
+                f"bench() on an accelerator requires >= reps+warmup "
+                f"({reps}+{warmup}) DISTINCT input variants, got "
+                f"{len(distinct)} distinct of {len(calls)}: repeated "
+                "identical executions are short-circuited by the TPU "
+                "tunnel and produce physically impossible rates "
+                "(BASELINE.md microbench-timing caveat)"
+            )
     for w in range(warmup):
         jax.block_until_ready(fn(*calls[-1 - (w % len(calls))]))
     out = None
@@ -146,12 +173,13 @@ def main():
         return jnp.sort(raw, axis=-1)
 
     # distinct index draws cycled across bench reps (see bench(): the
-    # tunnel short-circuits repeated identical executions). reps+3 draws:
-    # timed reps cycle from the start, warmup (2) consumes the tail, and
-    # one spare covers fused_parity dropping variant 0 (its parity check
-    # already executed that one) — no timed call ever repeats any prior
-    # execution. Each draw is a (B, K, cap) int32 — negligible memory.
-    idxs = [make_idx(1 + r) for r in range(max(1, args.reps) + 3)]
+    # tunnel short-circuits repeated identical executions). reps + warmup
+    # + 1 draws: timed reps cycle from the start, warmup consumes the
+    # tail, and one spare covers fused_parity dropping variant 0 (its
+    # parity check already executed that one) — no timed call ever
+    # repeats any prior execution. Each draw is a (B, K, cap) int32 —
+    # negligible memory.
+    idxs = [make_idx(1 + r) for r in range(max(1, args.reps) + DEFAULT_WARMUP + 1)]
     idx = idxs[0]
 
     if args.parity_only:

@@ -11,7 +11,7 @@ section. Rows without a device field are listed separately as
 unknown-provenance, never as clean results.
 
 Usage: python benchmarks/summarize_watch.py [logfile ...]
-       (default: benchmarks/tpu_results_r4.jsonl)
+       (default: benchmarks/tpu_results_r5.jsonl + r4)
 """
 
 from __future__ import annotations
@@ -124,4 +124,4 @@ def main(paths: list[str]) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1:] or ["benchmarks/tpu_results_r4.jsonl"]))
+    sys.exit(main(sys.argv[1:] or ["benchmarks/tpu_results_r5.jsonl", "benchmarks/tpu_results_r4.jsonl"]))

@@ -148,12 +148,27 @@ while :; do
       fellback=0
       grep -qE '"tpu_fallback": true|falling back to CPU' "$step_out" \
         && fellback=1
-      # real on-chip parity failure: the kernel miscompiled or refused to
-      # compile (assertion / SKIPPED / CPU-drop exit) with the tunnel alive
+      # real on-chip parity failure. The explicit FAILED assertion with a
+      # live reprobe retires the fused grid immediately (the kernel ran
+      # and produced wrong numbers — definitive). A 'SKIPPED' line is
+      # ambiguous: it can mean Mosaic genuinely refused to compile the
+      # kernel, OR the generic except-branch caught a tunnel death
+      # mid-compile (advisor r4) — so SKIPPED gets one free retry: the
+      # first SKIPPED-with-live-reprobe records a strike, the second
+      # retires. A SKIPPED whose reprobe fails is a tunnel death: no
+      # strike, retry next window.
       mosaicfail=0
-      if [ "$key" = parity ] && [ "$rc" -ne 0 ] && [ "$fellback" -eq 0 ] && \
-         grep -qE 'pallas fused parity FAILED|pallas fused gather: SKIPPED' "$step_out"; then
-        mosaicfail=1
+      if [ "$key" = parity ] && [ "$rc" -ne 0 ] && [ "$fellback" -eq 0 ]; then
+        if grep -q 'pallas fused parity FAILED' "$step_out" && probe; then
+          mosaicfail=1
+        elif grep -q 'pallas fused gather: SKIPPED' "$step_out" && probe; then
+          if grep -qx "parity SKIP1" "$STATE"; then
+            mosaicfail=1
+          else
+            echo "parity SKIP1" >>"$STATE"
+            echo "--- parity SKIPPED with tunnel alive; one more strike retires the fused grid ---" | tee -a "$LOG"
+          fi
+        fi
       fi
       # genuine on-device numerical-validation failure (not a flap/CPU
       # drop): every subsequent row from this device would be untrusted —

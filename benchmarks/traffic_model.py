@@ -148,6 +148,55 @@ def main():
             "optimistic_s": round(N_PERM * b / (0.6 * PEAK_BW), 2),
             "bytes_per_perm_GB": round(b / 1e9, 4),
         })
+    # --- second, independently-measured anchor (VERDICT r4 weak #1) -----
+    # benchmarks/cpu_anchor.py measures XLA's row-gather efficiency vs
+    # STREAM on the local CPU at this exact shape; efficiency * TPU peak
+    # estimates sustained BW without the 27.14 s row. Printing both
+    # anchors and their disagreement keeps the model honest about how
+    # much still hangs on the single TPU measurement.
+    anchor_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "cpu_anchor.json")
+    if os.path.exists(anchor_path):
+        with open(anchor_path) as f:
+            cpu_anchor = json.load(f)
+        # BRACKET, not a point (review r5): read-only accounting matches
+        # the one-pass byte count's read-traffic basis; read+write is the
+        # symmetric twin of the STREAM denominator. The truth for the
+        # TPU-side transfer lies between them.
+        eff_lo = cpu_anchor["gather_efficiency_read_only"]
+        eff_hi = cpu_anchor["gather_efficiency_rw"]
+        bw2_lo, bw2_hi = eff_lo * PEAK_BW, eff_hi * PEAK_BW
+        rows.append({
+            "metric": "sustained-BW anchors: TPU-row-implied (were mxu "
+                      "one-pass) vs CPU-gather-efficiency * TPU peak "
+                      "[read-only, read+write accounting]",
+            "anchor1_tpu_row_GBps": round(implied_bw_if_one_pass / 1e9, 1),
+            "anchor2_cpu_eff_GBps": [round(bw2_lo / 1e9, 1),
+                                     round(bw2_hi / 1e9, 1)],
+            "cpu_gather_efficiency": [eff_lo, eff_hi],
+            "cpu_stream_GBps": cpu_anchor["stream_copy_GBps"],
+            "cpu_row_gather_read_GBps": cpu_anchor["row_gather_read_GBps"],
+            "anchor1_within_anchor2_envelope": bool(
+                implied_bw_if_one_pass <= bw2_hi
+            ),
+            "disagreement_anchor2_over_anchor1": [
+                round(bw2_lo / implied_bw_if_one_pass, 2),
+                round(bw2_hi / implied_bw_if_one_pass, 2),
+            ],
+            "implied_mxu_passes_at_anchor2": [
+                round(t_perm * bw2_lo / b1_f32, 2),
+                round(t_perm * bw2_hi / b1_f32, 2),
+            ],
+            "unit": "GB/s",
+        })
+    else:
+        rows.append({
+            "metric": "second sustained-BW anchor",
+            "value": "MISSING — run benchmarks/cpu_anchor.py on an idle "
+                     "machine; until then every prediction above rests on "
+                     "the single 27.14 s TPU row",
+        })
+
     # --- bucket-granularity lever (EngineConfig.cap_granularity) ---------
     caps8 = caps_for(GENES, MODULES, cap_granularity=8)
     b8 = one_pass_bytes(caps8, GENES, 4, 2, SAMPLES)

@@ -26,6 +26,7 @@ XLA partitions the whole chunk computation across devices over ICI
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from functools import partial
 from typing import Callable, Sequence
@@ -178,7 +179,18 @@ def check_derived_network(corr, net, net_beta, what: str) -> None:
     if c.size > 65536:
         ii = np.random.default_rng(0).integers(0, c.size, size=65536)
         c, m = c[ii], m[ii]
-    want = np.asarray(jstats.derived_net(jnp.asarray(c), net_beta))
+    # Evaluate the expected sample on the host CPU: on tunneled TPU
+    # backends each eager dispatch costs ~1 s, and this runs at engine
+    # construction inside a ~5-7 min measurement window (advisor r4).
+    # Under JAX_PLATFORMS=axon only the axon platform is initialized and
+    # jax.devices("cpu") RAISES — fall back to the default device there
+    # (the pre-optimization behavior) rather than dying in construction.
+    try:
+        cpu_dev = jax.devices("cpu")[0]
+    except RuntimeError:
+        cpu_dev = None
+    with jax.default_device(cpu_dev) if cpu_dev is not None else contextlib.nullcontext():
+        want = np.asarray(jstats.derived_net(jnp.asarray(c), net_beta))
     if not np.allclose(m, want, rtol=1e-3, atol=1e-4):
         worst = float(np.max(np.abs(m - want)))
         formula = jstats.DERIVED_FORMULA[kind].format(b=beta)

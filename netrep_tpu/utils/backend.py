@@ -53,11 +53,46 @@ def honor_explicit_platform():
         return jax.devices()
 
 
+def host_cpu_fingerprint() -> str:
+    """Short stable fingerprint of this host's CPU instruction-set features.
+
+    XLA:CPU AOT executables compiled for machine features the executing
+    host lacks can SIGILL — the real cross-machine risk behind round 4's
+    ``cpu_aot_loader`` errors. Embedding this fingerprint in the cache
+    path guarantees hosts with different REAL feature sets never exchange
+    AOT entries. What it cannot silence: XLA also records compile-time
+    pseudo-features (``+prefer-no-scatter``/``+prefer-no-gather``) that
+    host detection never reports, so the loader still logs a
+    machine-feature mismatch on every reuse — including same-host, where
+    it is cosmetic (no pseudo-feature can SIGILL). Paths whose output an
+    artifact-checker reads (``dryrun_multichip``) therefore skip the
+    cache entirely; tests and bench tolerate the log noise for the
+    warm-cache win.
+    """
+    import hashlib
+    import platform
+
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    basis = f"{platform.machine()}|{feats}"
+    return hashlib.sha256(basis.encode()).hexdigest()[:12]
+
+
 def enable_persistent_cache(repo_root: str | None = None) -> None:
     """Point JAX's persistent compilation cache at the repo-local
-    ``.jax_cache`` dir (gitignored). Shared by ``tests/conftest.py`` and
-    ``__graft_entry__.dryrun_multichip`` so the two bootstraps cannot
-    diverge (dir or thresholds). A miss compiles exactly as before."""
+    ``.jax_cache/<cpu-fingerprint>`` dir (gitignored). Shared by
+    ``tests/conftest.py`` and ``__graft_entry__.dryrun_multichip`` so the
+    two bootstraps cannot diverge (dir or thresholds). A miss compiles
+    exactly as before. The per-host-CPU subdir removes the cross-machine
+    AOT reuse that risks SIGILL (see :func:`host_cpu_fingerprint` —
+    including what it deliberately does NOT try to silence)."""
     import jax
 
     if repo_root is None:
@@ -66,7 +101,8 @@ def enable_persistent_cache(repo_root: str | None = None) -> None:
             os.path.abspath(__file__)
         )))
     jax.config.update(
-        "jax_compilation_cache_dir", os.path.join(repo_root, ".jax_cache")
+        "jax_compilation_cache_dir",
+        os.path.join(repo_root, ".jax_cache", host_cpu_fingerprint()),
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -1,0 +1,46 @@
+"""The persistent compile cache must be keyed by host CPU features.
+
+Round 4's MULTICHIP artifact tail was full of ``cpu_aot_loader`` errors:
+AOT executables compiled on a machine with ``amx-fp16``/``avx10.1`` were
+loaded on a host lacking them — "could lead to execution errors such as
+SIGILL". The fix embeds a fingerprint of the host's instruction-set
+features in the cache path so entries can never cross machines with
+different feature sets (VERDICT r4 item 3).
+"""
+
+import jax
+
+from netrep_tpu.utils import backend
+
+
+def test_fingerprint_is_short_stable_hex():
+    a, b = backend.host_cpu_fingerprint(), backend.host_cpu_fingerprint()
+    assert a == b
+    assert len(a) == 12
+    int(a, 16)  # hex
+
+
+def test_cache_dir_is_keyed_by_cpu_fingerprint():
+    # conftest already called enable_persistent_cache; re-invoking is
+    # idempotent and lets this test read the configured value directly
+    backend.enable_persistent_cache()
+    cache_dir = jax.config.jax_compilation_cache_dir
+    assert cache_dir.endswith(backend.host_cpu_fingerprint())
+    parent = cache_dir.rsplit("/", 2)[-2]
+    assert parent == ".jax_cache"
+
+
+def test_fingerprint_changes_with_feature_set(monkeypatch, tmp_path):
+    # simulate a different host by redirecting /proc/cpuinfo
+    real = backend.host_cpu_fingerprint()
+    fake = tmp_path / "cpuinfo"
+    fake.write_text("flags\t\t: fpu sse sse2 hypothetical-isa-ext\n")
+    orig_open = open
+
+    def fake_open(path, *a, **kw):
+        if path == "/proc/cpuinfo":
+            return orig_open(fake, *a, **kw)
+        return orig_open(path, *a, **kw)
+
+    monkeypatch.setattr("builtins.open", fake_open)
+    assert backend.host_cpu_fingerprint() != real

@@ -1,0 +1,107 @@
+"""No timed microbench rep may reuse identical inputs (VERDICT r4 item 2).
+
+The axon tunnel short-circuits repeated identical executions: the 7/31
+live window printed 3.7 TB/s row-gather on an 819 GB/s part because every
+timed rep re-ran the same jitted fn on the same arrays (BASELINE.md
+"microbench-timing caveat"). Three layers of defense, all pinned here:
+
+1. the shared ``bench()`` helper REFUSES to time on an accelerator unless
+   given >= reps+warmup distinct input variants;
+2. with enough variants, the timed calls are pairwise distinct and
+   disjoint from the warmup calls;
+3. every ``bench(...)`` call site in ``benchmarks/`` threads ``variants=``
+   (static AST sweep — a CPU-quiet site would otherwise only blow up
+   mid-tunnel-window, the worst possible time).
+"""
+
+import ast
+import glob
+import os
+import subprocess
+import sys
+
+import jax
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bench():
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    from microbench_parts import bench
+
+    return bench
+
+
+def test_bench_refuses_missing_or_insufficient_variants_on_accelerator(
+    monkeypatch,
+):
+    bench = _bench()
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    with pytest.raises(RuntimeError, match="DISTINCT input variants"):
+        bench(lambda x: x, 1, reps=3, warmup=2)
+    with pytest.raises(RuntimeError, match="DISTINCT input variants"):
+        # 4 variants < reps+warmup = 5: some timed rep would repeat
+        bench(lambda x: x, reps=3, warmup=2,
+              variants=[(1,), (2,), (3,), (4,)])
+    with pytest.raises(RuntimeError, match="DISTINCT input variants"):
+        # enough entries but identical objects: every timed call is still
+        # the same execution (review r5 — count alone is not enforcement)
+        dup = ([1.0],)
+        bench(lambda x: x, reps=3, warmup=2, variants=[dup] * 5)
+
+
+def test_bench_timed_calls_distinct_and_disjoint_from_warmup(monkeypatch):
+    bench = _bench()
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(jax, "block_until_ready", lambda x: x)
+    seen = []
+    variants = [(i,) for i in range(5)]
+    bench(seen.append, reps=3, warmup=2, variants=variants)
+    warm, timed = seen[:2], seen[2:]
+    assert len(timed) == 3
+    assert len(set(timed)) == len(timed), "timed reps repeated an input"
+    assert not set(timed) & set(warm), "a timed rep repeated a warmup input"
+
+
+def test_bench_still_permissive_on_cpu():
+    # CI and local smoke runs have no tunnel to fool; plain reps are fine
+    bench = _bench()
+    assert jax.default_backend() == "cpu"
+    t = bench(lambda x: x, 1, reps=2, warmup=1)
+    assert t >= 0
+
+
+def test_every_bench_call_site_threads_variants():
+    sites = []
+    for path in glob.glob(os.path.join(REPO, "benchmarks", "*.py")):
+        tree = ast.parse(open(path).read(), filename=path)
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "bench"
+            ):
+                if not any(kw.arg == "variants" for kw in node.keywords):
+                    sites.append(f"{os.path.basename(path)}:{node.lineno}")
+    assert not sites, (
+        f"bench() call sites without variants= (tunnel-unsafe): {sites}"
+    )
+
+
+@pytest.mark.slow
+def test_microbench_gather_smoke_cpu():
+    # tiny-shape end-to-end run: every section must execute its variant
+    # threading without error (run() converts failures to FAILED lines)
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "NETREP_BACKEND_PROBE_TIMEOUT": "5",
+    }
+    proc = subprocess.run(
+        [sys.executable, "benchmarks/microbench_gather.py",
+         "--genes", "1200", "--modules", "3", "--chunk", "4", "--reps", "1"],
+        cwd=REPO, env=env, timeout=600, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "FAILED" not in proc.stdout, proc.stdout[-4000:]

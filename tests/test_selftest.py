@@ -18,6 +18,27 @@ def test_selftest_passes_on_cpu(capsys):
     assert "selftest OK" in capsys.readouterr().out
 
 
+def test_selftest_catches_small_regression_on_cpu(monkeypatch):
+    """Backend-conditional tolerance (VERDICT r4 item 8): on CPU the bound
+    is ~1e-4, so a 1e-3 device-math regression — which the old uniform
+    2e-2 MXU-sized bound waved through — must now fail."""
+    from netrep_tpu.parallel.engine import PermutationEngine
+
+    orig = PermutationEngine.observed
+    monkeypatch.setattr(
+        PermutationEngine, "observed",
+        lambda self: np.asarray(orig(self)) + 1e-3,
+    )
+    with pytest.raises(RuntimeError, match="observed statistics deviate"):
+        netrep_tpu.selftest(n_perm=8, verbose=False)
+
+
+def test_selftest_runs_multiple_shapes():
+    out = netrep_tpu.selftest(n_perm=8, verbose=False)
+    assert out["n_shapes"] >= 2
+    assert out["atol"] == 1e-4  # CPU tier
+
+
 def test_selftest_detects_wrong_observed(monkeypatch):
     from netrep_tpu.parallel.engine import PermutationEngine
 
