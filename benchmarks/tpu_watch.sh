@@ -49,7 +49,7 @@ QUEUE=(
   "smoke       300  python bench.py --smoke"
   "north       900  python bench.py"
   "parity      600  python benchmarks/microbench_parts.py --parity-only"
-  "selftest    600  python -c 'import bench; bench.ensure_backend(); import netrep_tpu; r = netrep_tpu.selftest(); assert r[\"backend\"] != \"cpu\", r'"
+  "selftest    600  python -c 'import bench; bench.ensure_backend(); import netrep_tpu; r = netrep_tpu.selftest(max_shapes=1); assert r[\"backend\"] != \"cpu\", r'"
   "tune        2400 python benchmarks/tune_northstar.py"
   "north_bf16  900  python bench.py --dtype bfloat16"
   "north_dnet  900  python bench.py --derived-net"
@@ -158,6 +158,7 @@ while :; do
       # retires. A SKIPPED whose reprobe fails is a tunnel death: no
       # strike, retry next window.
       mosaicfail=0
+      skipstrike=0
       if [ "$key" = parity ] && [ "$rc" -ne 0 ] && [ "$fellback" -eq 0 ]; then
         if grep -q 'pallas fused parity FAILED' "$step_out" && probe; then
           mosaicfail=1
@@ -167,6 +168,7 @@ while :; do
           else
             echo "parity SKIP1" >>"$STATE"
             echo "--- parity SKIPPED with tunnel alive; one more strike retires the fused grid ---" | tee -a "$LOG"
+            skipstrike=1
           fi
         fi
       fi
@@ -193,6 +195,11 @@ while :; do
         echo "--- parity FAILED on real Mosaic; retiring fused steps ---" | tee -a "$LOG"
         echo "parity" >>"$STATE"
         echo "parity MOSAICFAIL" >>"$STATE"
+      elif [ "$skipstrike" -eq 1 ]; then
+        # strike already recorded and logged above; skip the generic
+        # handler so the same event is not re-probed (45 s of a short
+        # window) and re-classified as a transient flap (review r5)
+        :
       elif probe; then
         # tunnel alive after the failure: could be a genuinely broken step
         # OR a mid-step outage whose tunnel recovered before the timeout

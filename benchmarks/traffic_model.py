@@ -176,7 +176,11 @@ def main():
             "cpu_gather_efficiency": [eff_lo, eff_hi],
             "cpu_stream_GBps": cpu_anchor["stream_copy_GBps"],
             "cpu_row_gather_read_GBps": cpu_anchor["row_gather_read_GBps"],
-            "anchor1_within_anchor2_envelope": bool(
+            # the claim the code actually tests (review r5): anchor1 does
+            # not EXCEED the independently-derived achievable upper bound.
+            # Sitting below the lower edge is expected — it just means the
+            # mxu path makes >1 effective pass.
+            "anchor1_below_anchor2_upper": bool(
                 implied_bw_if_one_pass <= bw2_hi
             ),
             "disagreement_anchor2_over_anchor1": [

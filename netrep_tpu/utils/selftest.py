@@ -49,7 +49,7 @@ _SHAPES = (
 
 
 def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True,
-             mesh=None) -> dict:
+             mesh=None, max_shapes: int | None = None) -> dict:
     """Run the on-device numerical self-check; return a summary dict.
 
     With ``mesh`` (a :func:`netrep_tpu.make_mesh` mesh) the null runs
@@ -63,6 +63,12 @@ def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True,
     is held to ~1e-4; the ~2e-2 bound applies only where TPU MXU bf16
     truncation is real device behavior, so a genuine device-math
     regression cannot hide under hardware-rounding headroom.
+
+    ``max_shapes`` bounds how many of the validated problem shapes run
+    (None = all). CI runs every shape; time-boxed deployments — the
+    watcher's on-chip gate inside a ~5-7 min tunnel window — pass
+    ``max_shapes=1`` to keep the gate to one shape's compiles while the
+    multi-shape coverage still holds on every CPU CI run.
 
     Raises ``RuntimeError`` with the failing comparison when the device
     disagrees with the NumPy oracle beyond those tolerances.
@@ -81,21 +87,23 @@ def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True,
     backend = jax.default_backend()
     atol = _ATOL_EXACT if backend == "cpu" else _ATOL_MXU
 
+    if max_shapes is not None and max_shapes < 1:
+        raise ValueError(f"max_shapes must be >= 1 or None, got {max_shapes}")
+    shapes = _SHAPES if max_shapes is None else _SHAPES[:max_shapes]
     n_row = 1
     if mesh is not None:
         from ..parallel.mesh import ROW_AXIS
 
         n_row = mesh.shape.get(ROW_AXIS, 1)
-        bad = [n for _, n, _ in _SHAPES if n % max(1, n_row)]
+        bad = [n for _, n, _ in shapes if n % max(1, n_row)]
         if bad:
             raise ValueError(
                 f"selftest node counts {bad} are not divisible by the "
                 f"mesh's {n_row} row shards — use n_row_shards dividing "
-                f"{[n for _, n, _ in _SHAPES]}"
+                f"{[n for _, n, _ in shapes]}"
             )
-
     obs_dev_max, null_dev_max = 0.0, 0.0
-    for sizes, n, s in _SHAPES:
+    for sizes, n, s in shapes:
         rng = np.random.default_rng(seed)
 
         def build():
@@ -191,7 +199,7 @@ def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True,
         "backend": backend,
         "mesh": None if mesh is None else dict(mesh.shape),
         "n_perm": int(n_perm),
-        "n_shapes": len(_SHAPES),
+        "n_shapes": len(shapes),
         "atol": atol,
         "observed_max_abs_dev": obs_dev_max,
         "null_reconstruction_max_abs_dev": null_dev_max,
@@ -201,7 +209,7 @@ def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True,
         print(
             f"netrep_tpu selftest OK on {device}: observed dev "
             f"{obs_dev_max:.2e}, null-reconstruction dev {null_dev_max:.2e} "
-            f"across {len(_SHAPES)} shapes (atol {atol}), "
+            f"across {len(shapes)} shapes (atol {atol}), "
             f"{n_perm} perms in {out['elapsed_s']}s"
         )
     return out

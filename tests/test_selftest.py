@@ -39,6 +39,13 @@ def test_selftest_runs_multiple_shapes():
     assert out["atol"] == 1e-4  # CPU tier
 
 
+def test_selftest_max_shapes_bounds_work():
+    """The watcher's on-chip gate runs max_shapes=1 to fit a short tunnel
+    window; the bound must actually limit the shapes executed."""
+    out = netrep_tpu.selftest(n_perm=8, verbose=False, max_shapes=1)
+    assert out["ok"] and out["n_shapes"] == 1
+
+
 def test_selftest_detects_wrong_observed(monkeypatch):
     from netrep_tpu.parallel.engine import PermutationEngine
 
