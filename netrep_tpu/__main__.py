@@ -1,0 +1,75 @@
+"""Deployment CLI: ``python -m netrep_tpu <command>``.
+
+The reference's install-validation story is ``R CMD check``; a JAX
+framework deployed onto unfamiliar hardware (new TPU generation, tunneled
+backend) needs the equivalent one-liner. Commands:
+
+- ``selftest`` (default) — run :func:`netrep_tpu.selftest` on the current
+  default backend and exit nonzero on any device-vs-oracle disagreement
+  (tolerances are backend-conditional; see utils/selftest.py).
+- ``version`` — print the package version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _positive(value: str) -> int:
+    n = int(value)
+    if n < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {n}")
+    return n
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m netrep_tpu")
+    sub = ap.add_subparsers(dest="cmd")
+    st = sub.add_parser("selftest", help="on-device numerical self-check")
+    # argparse-level validation: a usage error must fail instantly, before
+    # the backend resolution below (which can spend its probe budget on a
+    # dead tunnel)
+    st.add_argument("--n-perm", type=_positive, default=32)
+    st.add_argument("--seed", type=int, default=0)
+    st.add_argument("--max-shapes", type=_positive, default=None)
+    st.add_argument("--json", action="store_true",
+                    help="print the summary dict as one JSON line")
+    sub.add_parser("version", help="print the package version")
+    args = ap.parse_args(argv)
+    if args.cmd is None:
+        # bare invocation = selftest with its own argparse defaults (ONE
+        # source of defaults; bare flags are not supported — subcommand
+        # flags belong after `selftest`)
+        args = ap.parse_args(["selftest", *(argv or [])])
+
+    import netrep_tpu
+
+    if args.cmd == "version":
+        print(netrep_tpu.__version__)
+        return 0
+    # Hang-safe backend resolution BEFORE any jax.devices() call: this
+    # image's sitecustomize re-pins the axon (tunneled TPU) plugin at
+    # interpreter startup, and a dead tunnel HANGS the dial instead of
+    # erroring — the exact failure the driver entries guard against
+    # (utils/backend.py). An explicit non-axon platform is honored; an
+    # unresponsive tunnel drops to CPU.
+    from netrep_tpu.utils.backend import resolve_backend_or_cpu
+
+    resolve_backend_or_cpu()
+    try:
+        out = netrep_tpu.selftest(
+            n_perm=args.n_perm, seed=args.seed, verbose=not args.json,
+            max_shapes=args.max_shapes,
+        )
+    except (RuntimeError, ValueError) as e:
+        print(f"selftest FAILED: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

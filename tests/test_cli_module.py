@@ -1,0 +1,86 @@
+"""``python -m netrep_tpu`` — the deployment CLI must run the selftest,
+honor flags, and exit nonzero on failure so scripts and CI can gate on it.
+
+Subprocesses share the suite's persistent compile cache via
+``JAX_COMPILATION_CACHE_DIR`` (they don't load conftest, and a cold
+selftest compile is ~2 min on this 1-core box)."""
+
+import json
+import os
+import subprocess
+import sys
+
+from netrep_tpu.utils.backend import host_cpu_fingerprint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {
+    **os.environ,
+    # the image's sitecustomize re-pins JAX_PLATFORMS=axon at interpreter
+    # startup, so the CLI's resolve_backend_or_cpu() does the real work;
+    # a short probe budget keeps the dead-tunnel fallback fast in CI
+    "JAX_PLATFORMS": "cpu",
+    "NETREP_BACKEND_PROBE_TIMEOUT": "10",
+    "JAX_COMPILATION_CACHE_DIR": os.path.join(
+        REPO, ".jax_cache", host_cpu_fingerprint()
+    ),
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.5",
+}
+
+
+def _run(*args, timeout=420):
+    return subprocess.run(
+        [sys.executable, "-m", "netrep_tpu", *args],
+        cwd=REPO, env=ENV, timeout=timeout, capture_output=True, text=True,
+    )
+
+
+def test_version():
+    proc = _run("version")
+    assert proc.returncode == 0
+    import netrep_tpu
+
+    assert proc.stdout.strip() == netrep_tpu.__version__
+
+
+def test_selftest_json_single_shape():
+    proc = _run("selftest", "--n-perm", "8", "--max-shapes", "1", "--json")
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["ok"] and row["n_shapes"] == 1
+
+
+def test_bad_max_shapes_fails_fast_at_argparse():
+    import time
+
+    t0 = time.perf_counter()
+    proc = _run("selftest", "--n-perm", "8", "--max-shapes", "0")
+    took = time.perf_counter() - t0
+    assert proc.returncode == 2  # argparse usage error
+    assert "must be >= 1" in proc.stderr
+    # usage errors must not pay the backend probe budget (review r5)
+    assert took < 30, took
+
+
+def test_cli_hang_safe_under_dead_tunnel():
+    """The CLI's distinguishing behavior: under the driver's hostile env
+    (axon plugin pinned, tunnel dead) `python -m netrep_tpu selftest`
+    must fall back to CPU within the probe budget instead of hanging —
+    the round-2 rc=124 failure mode (same pattern as test_graft_entry)."""
+    axon_site = "/root/.axon_site"
+    env = {
+        **ENV,
+        "JAX_PLATFORMS": "axon",
+        "NETREP_BACKEND_PROBE_TIMEOUT": "20",
+    }
+    if os.path.isdir(axon_site) and axon_site not in env.get("PYTHONPATH", ""):
+        env["PYTHONPATH"] = (
+            axon_site + os.pathsep + env.get("PYTHONPATH", "")
+        ).rstrip(os.pathsep)
+    proc = subprocess.run(
+        [sys.executable, "-m", "netrep_tpu", "selftest",
+         "--n-perm", "8", "--max-shapes", "1", "--json"],
+        cwd=REPO, env=env, timeout=420, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["ok"]
