@@ -56,3 +56,47 @@ def toy_pair(rng):
 @pytest.fixture(scope="module")
 def toy_pair_module():
     return make_toy_pair(np.random.default_rng(42))
+
+
+@pytest.fixture(scope="session")
+def toy_pair_session():
+    return make_toy_pair(np.random.default_rng(42))
+
+
+def pair_frames(pair):
+    """Package a toy pair as pandas inputs (named nodes) — the one shared
+    copy of this transform (review r5: it was duplicated per test file)."""
+    import pandas as pd
+
+    def mk(ds):
+        names = ds["names"]
+        return dict(
+            data=pd.DataFrame(ds["data"], columns=names),
+            correlation=pd.DataFrame(ds["correlation"], index=names,
+                                     columns=names),
+            network=pd.DataFrame(ds["network"], index=names, columns=names),
+        )
+
+    return mk(pair["discovery"]), mk(pair["test"])
+
+
+@pytest.fixture(scope="session")
+def result(toy_pair_session):
+    """One full module_preservation run shared by every API-surface test
+    (session scope: the engine pass is the suite's unit of expensive work)."""
+    from netrep_tpu import module_preservation
+    from netrep_tpu.utils.config import EngineConfig
+
+    d, t = pair_frames(toy_pair_session)
+    return module_preservation(
+        network={"disc": d["network"], "test": t["network"]},
+        data={"disc": d["data"], "test": t["data"]},
+        correlation={"disc": d["correlation"], "test": t["correlation"]},
+        module_assignments=dict(toy_pair_session["labels"]),
+        discovery="disc",
+        test="test",
+        n_perm=250,
+        seed=123,
+        config=EngineConfig(chunk_size=64, summary_method="power",
+                            power_iters=50),
+    )

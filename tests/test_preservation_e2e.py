@@ -23,31 +23,11 @@ except Exception:
 CFG = EngineConfig(chunk_size=64, summary_method="power", power_iters=50)
 
 
-def _frames(pair):
-    """Package the toy pair as pandas inputs (named nodes)."""
-    d, t = pair["discovery"], pair["test"]
-    mk = lambda ds: dict(
-        data=pd.DataFrame(ds["data"], columns=ds["names"]),
-        correlation=pd.DataFrame(ds["correlation"], index=ds["names"], columns=ds["names"]),
-        network=pd.DataFrame(ds["network"], index=ds["names"], columns=ds["names"]),
-    )
-    return mk(d), mk(t)
-
-
-@pytest.fixture(scope="module")
-def result(toy_pair_module):
-    d, t = _frames(toy_pair_module)
-    return module_preservation(
-        network={"disc": d["network"], "test": t["network"]},
-        data={"disc": d["data"], "test": t["data"]},
-        correlation={"disc": d["correlation"], "test": t["correlation"]},
-        module_assignments={nm: lab for nm, lab in toy_pair_module["labels"].items()},
-        discovery="disc",
-        test="test",
-        n_perm=250,
-        seed=123,
-        config=CFG,
-    )
+# the pandas packaging and the shared 250-perm `result` fixture live in
+# conftest.py (session-scoped: one engine pass serves every API-surface
+# test; its kwargs — n_perm=250, seed=123, chunk 64, power summary —
+# are what the assertions below pin)
+from conftest import pair_frames as _frames  # noqa: E402
 
 
 def test_simplified_single_pair(result):

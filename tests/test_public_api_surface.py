@@ -1,0 +1,64 @@
+"""Direct pins on small public API members that are otherwise only
+exercised indirectly — a rename or a silent semantic change in any of
+these would break user code without failing a test naming it."""
+
+import math
+
+import numpy as np
+import pytest
+
+import netrep_tpu
+from netrep_tpu.ops.oracle import STAT_NAMES
+
+
+# `result` is the session-scoped 250-perm run from conftest.py — shared
+# with test_preservation_e2e so the suite pays for one engine pass
+
+
+def test_observed_frame_and_stat_names(result):
+    frame = result.observed_frame()
+    assert tuple(frame.columns) == STAT_NAMES == result.stat_names
+    assert list(frame.index) == list(result.module_labels)
+    np.testing.assert_array_equal(frame.to_numpy(), result.observed)
+
+
+def test_repr_is_the_s3_print_analogue(result):
+    text = repr(result)
+    assert "Module preservation" in text and "p-values:" in text
+    for name in STAT_NAMES:
+        assert name in text
+
+
+def test_log_total_permutations():
+    from netrep_tpu.ops.pvalues import (
+        log_total_permutations, total_permutations,
+    )
+
+    # falling factorial 5!/(5-3)! = 60 for one 3-node module from 5
+    assert math.isclose(log_total_permutations(5, [3]), math.log(60))
+    assert math.isclose(total_permutations(5, [3]), 60.0)
+    # oversubscribed pool -> inf (engine would reject it earlier)
+    assert log_total_permutations(4, [3, 2]) == float("inf")
+
+
+def test_sparse_adjacency_nnz():
+    rows = np.array([0, 1, 2])
+    cols = np.array([1, 2, 0])
+    vals = np.array([0.5, 0.25, 0.125], dtype=np.float32)
+    adj = netrep_tpu.SparseAdjacency.from_coo(rows, cols, vals, n=4)
+    # symmetrized: each edge stored in both directions — k must be exactly
+    # the max per-node degree after symmetrization (k >= 1 is tautological:
+    # from_coo clamps k to 1)
+    assert adj.nnz == 6
+    assert adj.k == 2
+
+
+def test_resolved_gather_mode_contract():
+    from netrep_tpu.utils.config import EngineConfig
+
+    cfg = EngineConfig()
+    assert cfg.resolved_gather_mode("cpu") == "direct"
+    assert cfg.resolved_gather_mode("tpu") == "mxu"
+    assert EngineConfig(gather_mode="fused").resolved_gather_mode("cpu") == "fused"
+    with pytest.raises(ValueError, match="gather_mode"):
+        EngineConfig(gather_mode="bogus").resolved_gather_mode("cpu")
