@@ -68,12 +68,42 @@ QUEUE=(
   "configD_dn  3600 python bench.py --config D --derived-net"
 )
 
+# Test hooks (tests/test_tpu_watch_logic.py): QUEUE_FILE replaces the
+# queue (one "<key> <timeout> <cmd...>" per line) and PROBE_CMD replaces
+# the tunnel dial, so the state machine — resume, fallback, parity
+# strikes, selftest halt, cutoff — is testable without a chip. Unset in
+# production.
+if [ -n "${QUEUE_FILE:-}" ]; then
+  QUEUE=()
+  while IFS= read -r line; do
+    [ -n "$line" ] && QUEUE+=("$line")
+  done < "$QUEUE_FILE"
+fi
+
 probe() {
+  if [ -n "${PROBE_CMD:-}" ]; then
+    # same timeout bound as production: the cutoff math budgets
+    # now + PROBE_TIMEOUT, so a blocking stub must not hang past it
+    timeout "$PROBE_TIMEOUT" bash -c "$PROBE_CMD" >/dev/null 2>&1
+    return
+  fi
   timeout "$PROBE_TIMEOUT" python -c "import jax; jax.devices()" >/dev/null 2>&1
 }
 
 echo "== watcher start $(date -u +%FT%TZ) (log=$LOG state=$STATE) ==" | tee -a "$LOG"
 while :; do
+  # drained first: with a cutoff set, an empty queue would otherwise be
+  # reported as "no step can finish before cutoff" (review r5 — the test
+  # harness caught the misleading exit line)
+  remaining=0
+  for entry in "${QUEUE[@]}"; do
+    key=${entry%% *}
+    grep -qx "$key" "$STATE" || remaining=$((remaining + 1))
+  done
+  if [ "$remaining" -eq 0 ]; then
+    echo "== queue drained $(date -u +%FT%TZ) ==" | tee -a "$LOG"
+    exit 0
+  fi
   # exit when the cutoff is reached, when the next probe could not finish
   # before it, or when no unfinished step could ever start before it
   if [ -n "$CUTOFF_EPOCH" ]; then
@@ -92,15 +122,6 @@ while :; do
       echo "== no step can finish before cutoff; watcher exiting $(date -u +%FT%TZ) ==" | tee -a "$LOG"
       exit 0
     fi
-  fi
-  remaining=0
-  for entry in "${QUEUE[@]}"; do
-    key=${entry%% *}
-    grep -qx "$key" "$STATE" || remaining=$((remaining + 1))
-  done
-  if [ "$remaining" -eq 0 ]; then
-    echo "== queue drained $(date -u +%FT%TZ) ==" | tee -a "$LOG"
-    exit 0
   fi
   if probe; then
     for entry in "${QUEUE[@]}"; do
