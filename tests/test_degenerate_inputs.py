@@ -1,0 +1,96 @@
+"""Degenerate real-world inputs the engine must handle gracefully: NaN
+correlations (constant gene), modules with <2 overlapping nodes (dropped
+with a warning, like the reference), nothing-to-test, and a constant
+data column behind a sanitized correlation (zero-variance guard in the
+standardization). None of these paths had a test naming them — and a NaN
+slipping into a null on-chip would trip the watcher's selftest halt."""
+
+import logging
+import warnings
+
+import numpy as np
+import pytest
+
+import netrep_tpu
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.default_rng(3)
+    n, s = 40, 20
+    x = rng.standard_normal((s, n)).astype(np.float32)
+    for k in range(2):
+        x[:, k * 10:(k + 1) * 10] += 0.9 * rng.standard_normal(s)[:, None]
+    y = rng.standard_normal((s, n)).astype(np.float32)
+    cy = np.corrcoef(y, rowvar=False).astype(np.float32)
+    np.fill_diagonal(cy, 1.0)
+    labels = np.array(["1"] * 10 + ["2"] * 10 + ["0"] * 20)
+    return x, y, cy, np.abs(cy) ** 2, labels
+
+
+def _run(x, y, c, cy, nety, labels, net_d=None, **kw):
+    return netrep_tpu.module_preservation(
+        network={"d": np.abs(c) ** 2 if net_d is None else net_d, "t": nety},
+        data={"d": x, "t": y},
+        correlation={"d": c, "t": cy},
+        module_assignments={"d": labels},
+        discovery="d", test="t", verbose=False, **kw,
+    )
+
+
+def test_nan_correlation_rejected_with_informative_error(problem):
+    x, y, cy, nety, labels = problem
+    x = x.copy()
+    x[:, 5] = 2.5  # constant gene -> NaN correlation row
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        c = np.corrcoef(x, rowvar=False).astype(np.float32)
+    assert np.isnan(c).any()
+    # sanitized network, NaN correlation: the CORRELATION finiteness check
+    # itself must fire (an unsanitized network would mask it — review r5)
+    with pytest.raises(ValueError, match="correlation .* non-finite"):
+        _run(x, y, c, cy, nety, labels, n_perm=8,
+             net_d=np.nan_to_num(np.abs(c) ** 2))
+
+
+def test_constant_data_column_stays_finite(problem):
+    # user sanitized the correlation but the raw data still carries the
+    # constant column: the standardization's zero-variance guard must keep
+    # every statistic and p-value finite
+    x, y, cy, nety, labels = problem
+    x = x.copy()
+    x[:, 5] = 2.5
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        c = np.corrcoef(x, rowvar=False).astype(np.float32)
+    c = np.nan_to_num(c)
+    np.fill_diagonal(c, 1.0)
+    res = _run(x, y, c, cy, nety, labels, n_perm=16)
+    assert np.isfinite(res.observed).all()
+    assert np.isfinite(res.nulls).all()
+    assert np.isfinite(res.p_values).all()
+
+
+def test_small_modules_dropped_with_warning(problem, caplog):
+    x, y, cy, nety, labels = problem
+    labels = labels.astype(object).copy()
+    labels[0] = "solo"  # module with a single node
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        c = np.corrcoef(x, rowvar=False).astype(np.float32)
+    np.fill_diagonal(c, 1.0)
+    with caplog.at_level(logging.WARNING, logger="netrep_tpu"):
+        res = _run(x, y, c, cy, nety, labels, n_perm=8)
+    assert any("dropping module" in r.getMessage() for r in caplog.records)
+    assert "solo" not in res.module_labels
+    assert set(res.module_labels) == {"1", "2"}
+
+
+def test_all_modules_too_small_raises(problem):
+    x, y, cy, nety, labels = problem
+    labels = np.array(["0"] * 40, dtype=object)
+    labels[0] = "solo"
+    c = np.corrcoef(x, rowvar=False).astype(np.float32)
+    np.fill_diagonal(c, 1.0)
+    with pytest.raises(ValueError, match="nothing to test"):
+        _run(x, y, c, cy, nety, labels, n_perm=8)
