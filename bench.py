@@ -158,6 +158,17 @@ def make_specs(n_genes, n_modules, lo=30, hi=200, seed=1):
     return specs
 
 
+def make_specs_auto(n_genes, n_modules, seed=1):
+    """Module-size range for benchmark scripts at arbitrary ``--genes``:
+    the north-star 30-200 range when the pool fits it (>= 10k genes), the
+    smoke range (8, 24) below — ONE clamp site shared by tune_northstar,
+    bf16_drift and microbench_sharded_gather (review r5: the clamp was
+    copy-pasted, and one script lacked make_specs' oversubscription
+    assert entirely)."""
+    lo, hi = (30, 200) if n_genes >= 10_000 else (8, 24)
+    return make_specs(n_genes, n_modules, lo, hi, seed)
+
+
 def timed_null(engine, n_perm, chunk, **kw):
     """Warm up one chunk (compile, excluded — once-per-shape), then time."""
     import jax

@@ -15,7 +15,7 @@ import sys
 import numpy as np
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root, cwd-independent
-from bench import build_problem, ensure_backend, make_specs  # noqa: E402
+from bench import build_problem, ensure_backend, make_specs_auto  # noqa: E402
 
 
 def main(genes=20_000, modules=50, perms=64, samples=128):
@@ -26,7 +26,7 @@ def main(genes=20_000, modules=50, perms=64, samples=128):
     (d_data, d_corr, d_net), (t_data, t_corr, t_net) = build_problem(
         genes, modules, samples
     )
-    specs = make_specs(genes, modules, 30, 200)
+    specs = make_specs_auto(genes, modules)
     pool = np.arange(genes, dtype=np.int32)
 
     nulls = {}
@@ -78,4 +78,12 @@ def main(genes=20_000, modules=50, perms=64, samples=128):
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--genes", type=int, default=20_000)
+    ap.add_argument("--modules", type=int, default=50)
+    ap.add_argument("--perms", type=int, default=64)
+    ap.add_argument("--samples", type=int, default=128)
+    a = ap.parse_args()
+    main(a.genes, a.modules, a.perms, a.samples)

@@ -22,7 +22,7 @@ import numpy as np
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-from bench import build_problem, ensure_backend  # noqa: E402
+from bench import build_problem, ensure_backend, make_specs_auto  # noqa: E402
 
 
 def main():
@@ -39,22 +39,17 @@ def main():
     ensure_backend()
     from jax.sharding import Mesh
 
-    from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+    from netrep_tpu.parallel.engine import PermutationEngine
     from netrep_tpu.parallel.mesh import PERM_AXIS, ROW_AXIS
     from netrep_tpu.utils.config import EngineConfig
 
     (d_data, d_corr, d_net), (t_data, t_corr, t_net) = build_problem(
         args.genes, args.modules, args.samples
     )
-    rng = np.random.default_rng(1)
-    sizes = np.exp(
-        rng.uniform(np.log(30), np.log(200), size=args.modules)
-    ).astype(int)
-    specs, pos = [], 0
-    for k, sz in enumerate(sizes):
-        idx = np.arange(pos, pos + sz, dtype=np.int32)
-        specs.append(ModuleSpec(str(k + 1), idx, idx))
-        pos += sz
+    # shared spec builder (review r5): the hand-rolled copy here lacked
+    # make_specs' oversubscription assert, so small --genes runs could
+    # silently clip module indices into duplicated rows
+    specs = make_specs_auto(args.genes, args.modules)
     pool = np.arange(args.genes, dtype=np.int32)
 
     mesh1 = Mesh(

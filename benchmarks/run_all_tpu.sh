@@ -35,8 +35,8 @@ T=300  run python bench.py --smoke                     # tunnel sanity
 T=900  run python bench.py                             # north-star FIRST
 T=600  run python benchmarks/microbench_parts.py --parity-only \
   || halt "fused-parity"                               # Mosaic gate
-T=600  run python -c 'import bench; bench.ensure_backend(); import netrep_tpu; r = netrep_tpu.selftest(); assert r["backend"] != "cpu", r' \
-  || halt "device-selftest"
+T=600  run python -c 'import bench; bench.ensure_backend(); import netrep_tpu; r = netrep_tpu.selftest(max_shapes=1); assert r["backend"] != "cpu", r' \
+  || halt "device-selftest"                            # 1 shape: window budget
 T=2400 run python benchmarks/tune_northstar.py         # decision grid (resumable)
 T=900  run python bench.py --derived-net               # |corr|^2 derived mode
 T=900  run python bench.py --dtype bfloat16

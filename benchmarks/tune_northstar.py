@@ -29,7 +29,7 @@ import numpy as np
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-from bench import build_problem, ensure_backend, make_specs  # noqa: E402
+from bench import build_problem, ensure_backend, make_specs_auto  # noqa: E402
 
 #: perf-relevant sources hashed into every resume key: a row measured
 #: against old engine code must never replay as fresh decision data after
@@ -81,8 +81,7 @@ def main():
     (d_data, d_corr, d_net), (t_data, t_corr, t_net) = build_problem(
         args.genes, args.modules, args.samples
     )
-    lo, hi = (30, 200) if args.genes >= 10_000 else (8, 24)
-    specs = make_specs(args.genes, args.modules, lo, hi)
+    specs = make_specs_auto(args.genes, args.modules)
     pool = np.arange(args.genes, dtype=np.int32)
 
     # each point pays a fresh jit compile (~20-40s on TPU) — keep the grid

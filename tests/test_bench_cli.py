@@ -11,7 +11,10 @@ import os
 import subprocess
 import sys
 
+import numpy as np
 import pytest
+
+from netrep_tpu.utils.backend import host_cpu_fingerprint as _fp
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -52,7 +55,11 @@ def _run_cpu_subprocess(cmd, timeout):
         env={
             **os.environ,
             "JAX_PLATFORMS": "cpu",
-            "JAX_COMPILATION_CACHE_DIR": os.path.join(REPO, ".jax_cache"),
+            # fingerprinted subdir — the same dir enable_persistent_cache
+            # resolves, so children share the suite's warm cache
+            "JAX_COMPILATION_CACHE_DIR": os.path.join(
+                REPO, ".jax_cache", _fp()
+            ),
         },
         capture_output=True,
         text=True,
@@ -61,6 +68,39 @@ def _run_cpu_subprocess(cmd, timeout):
 
 
 @pytest.mark.slow
+def test_sharded_microbench_smoke():
+    """The watcher's `sharded` step: a crash with the tunnel alive is
+    skipped permanently after one retry, so the script must run end-to-end
+    on CPU at tiny shapes (same policy as the bench.py CASES)."""
+    proc = _run_cpu_subprocess(
+        [sys.executable, "benchmarks/microbench_sharded_gather.py",
+         "--genes", "400", "--modules", "3", "--perms", "16",
+         "--chunk", "8", "--samples", "16"],
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rows = [json.loads(l) for l in proc.stdout.splitlines()
+            if l.startswith("{")]
+    assert len(rows) == 3
+    assert all(r["perms_per_sec"] > 0 for r in rows)
+
+
+@pytest.mark.slow
+def test_bf16_drift_smoke():
+    """The watcher's `bf16_drift` step at tiny shapes: one parseable JSON
+    line with the per-statistic drift table."""
+    proc = _run_cpu_subprocess(
+        [sys.executable, "benchmarks/bf16_drift.py",
+         "--genes", "400", "--modules", "3", "--perms", "16",
+         "--samples", "16"],
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "per_statistic" in row and len(row["per_statistic"]) == 7
+    assert np.isfinite(row["max_abs_drift"])
+
+
 def test_parity_only_gate_refuses_cpu_pass():
     """The watcher's fused-parity gate records 'parity PASS' only on exit 0.
     On CPU the kernel runs in the Pallas *interpreter* — no Mosaic proof —
@@ -200,7 +240,6 @@ def test_bench_config_d_resumes_from_checkpoint():
     # test (not --smoke) so xdist neighbors can't race on the checkpoint.
     import tempfile
 
-    import numpy as np
 
     sys.path.insert(0, REPO)
     import bench
