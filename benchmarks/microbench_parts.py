@@ -39,6 +39,23 @@ from bench import ensure_backend  # noqa: E402
 DEFAULT_WARMUP = 2
 
 
+def _value_digest(a):
+    """Cheap per-argument value identity for the variant-enforcement guard:
+    shape + dtype + the first few elements (one small host transfer per
+    argument — setup cost, not timed). Object identity alone is not enough
+    (ADVICE r5): value-identical copies like ``[(M.copy(),) for _ in
+    range(9)]`` are distinct objects, but every timed rep still executes
+    the same computation the tunnel short-circuits."""
+    if hasattr(a, "shape") and hasattr(a, "dtype"):
+        try:
+            lead = a[(0,) * max(a.ndim - 1, 0)] if a.ndim else a
+            head = np.asarray(lead[:8] if getattr(lead, "ndim", 0) else lead)
+            return (str(a.shape), str(a.dtype), head.tobytes())
+        except Exception:
+            return ("opaque-array", id(a))
+    return ("scalar", repr(a))
+
+
 def bench(fn, *args, reps=5, warmup=DEFAULT_WARMUP, variants=None):
     """Average wall-clock per call. ``variants`` — arg tuples cycled across
     reps so no two timed calls are the identical (fn, args) execution: the
@@ -60,16 +77,21 @@ def bench(fn, *args, reps=5, warmup=DEFAULT_WARMUP, variants=None):
     runs (CI, local smoke) are exempt; there is no tunnel to fool."""
     calls = [tuple(v) for v in variants] if variants else [tuple(args)]
     if jax.default_backend() != "cpu":
-        # identity-distinct, not just enough of them: [(M, idx)] * 7 would
-        # satisfy a bare count check while every timed call is still the
-        # identical execution the tunnel short-circuits (review r5)
+        # identity-distinct AND value-distinct: [(M, idx)] * 7 satisfies a
+        # bare count check, and [(M.copy(), idx.copy()) for _ in range(7)]
+        # satisfies an id check (ADVICE r5) — while every timed call is
+        # still the identical execution the tunnel short-circuits. The
+        # value digest (shape/dtype + leading elements) rejects both.
         distinct = {tuple(id(a) for a in c) for c in calls}
-        if len(calls) < reps + warmup or len(distinct) < len(calls):
+        distinct_vals = {tuple(_value_digest(a) for a in c) for c in calls}
+        if (len(calls) < reps + warmup or len(distinct) < len(calls)
+                or len(distinct_vals) < len(calls)):
             raise RuntimeError(
                 f"bench() on an accelerator requires >= reps+warmup "
                 f"({reps}+{warmup}) DISTINCT input variants, got "
-                f"{len(distinct)} distinct of {len(calls)}: repeated "
-                "identical executions are short-circuited by the TPU "
+                f"{len(distinct)} id-distinct / {len(distinct_vals)} "
+                f"value-distinct of {len(calls)}: repeated identical "
+                "executions are short-circuited by the TPU "
                 "tunnel and produce physically impossible rates "
                 "(BASELINE.md microbench-timing caveat)"
             )

@@ -23,7 +23,9 @@ import zlib
 
 import numpy as np
 
-__all__ = ["make_example_pair", "load_example"]
+__all__ = [
+    "make_example_pair", "load_example", "make_mixed_pair", "pair_frames",
+]
 
 
 def make_example_pair(
@@ -115,6 +117,91 @@ def make_example_pair(
             str(k): sz for k, sz in enumerate(module_sizes, start=1)
         },
     )
+
+
+def make_mixed_pair(
+    n_genes: int,
+    n_modules: int,
+    n_samples: int = 40,
+    module_size: tuple[int, int] = (16, 28),
+    preserved_fraction: float = 0.5,
+    strength: tuple[float, float] = (0.6, 2.2),
+    seed: int = 0,
+) -> dict:
+    """Mixed preserved/random fixture for the adaptive (sequential
+    early-stopping) engine: the first ``preserved_fraction`` of the planted
+    modules replicate in the test dataset, the rest are noise there.
+
+    Each module is a single latent factor with *heterogeneous per-node
+    loadings* drawn once and reused in the test dataset for preserved
+    modules — equal loadings would leave the within-module correlation
+    pattern flat and ``cor.cor``/``cor.degree`` without signal, making even
+    genuinely preserved modules look borderline. Preserved modules come out
+    significant on every statistic; random modules on none — the
+    clean separation the sequential stopping rules retire fastest on, and
+    the decision-agreement oracle tests and ``bench.py --config adaptive``
+    both need.
+
+    Returns ``{discovery, test, specs, pool}`` where ``discovery``/``test``
+    are ``(data, correlation, network)`` float32 triples, ``specs`` is the
+    aligned ``(label, indices)`` module list (labels "1", "2", ... in
+    planted order: preserved first), and ``pool`` is the full node range.
+    """
+    rng = np.random.default_rng(seed)
+    sizes = rng.integers(module_size[0], module_size[1] + 1, size=n_modules)
+    if int(sizes.sum()) > n_genes:
+        raise ValueError(
+            f"planted modules ({int(sizes.sum())} nodes) exceed "
+            f"n_genes={n_genes}"
+        )
+    n_preserved = int(round(preserved_fraction * n_modules))
+    xd = rng.standard_normal((n_samples, n_genes))
+    xt = rng.standard_normal((n_samples, n_genes))
+    specs, pos = [], 0
+    for k, sz in enumerate(sizes):
+        load = rng.uniform(*strength, size=int(sz))
+        xd[:, pos: pos + sz] += rng.standard_normal((n_samples, 1)) * load
+        if k < n_preserved:
+            xt[:, pos: pos + sz] += rng.standard_normal((n_samples, 1)) * load
+        specs.append((str(k + 1), np.arange(pos, pos + sz, dtype=np.int32)))
+        pos += sz
+
+    def mats(x):
+        corr = np.corrcoef(x, rowvar=False)
+        np.fill_diagonal(corr, 1.0)
+        return (
+            x.astype(np.float32),
+            corr.astype(np.float32),
+            (np.abs(corr) ** 2).astype(np.float32),
+        )
+
+    return dict(
+        discovery=mats(xd),
+        test=mats(xt),
+        specs=specs,
+        pool=np.arange(n_genes, dtype=np.int32),
+        n_preserved=n_preserved,
+    )
+
+
+def pair_frames(pair: dict) -> tuple[dict, dict]:
+    """Package a :func:`make_example_pair` result as the pandas inputs
+    (named nodes) ``module_preservation`` takes — the one shared copy of
+    this transform for tests, docs, and notebooks. Lives here (not in a
+    test conftest) so imports are path-stable under any pytest import mode.
+    """
+    import pandas as pd
+
+    def mk(ds):
+        names = ds["names"]
+        return dict(
+            data=pd.DataFrame(ds["data"], columns=names),
+            correlation=pd.DataFrame(ds["correlation"], index=names,
+                                     columns=names),
+            network=pd.DataFrame(ds["network"], index=names, columns=names),
+        )
+
+    return mk(pair["discovery"]), mk(pair["test"])
 
 
 def load_example(seed: int = 42) -> dict:

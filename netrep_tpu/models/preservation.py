@@ -63,13 +63,24 @@ def _overlap_setup(disc_ds, test_ds, assignments, modules, background_label, nul
 
 
 def _make_result(d_name, t_name, labels, counts, observed, nulls, completed,
-                 np_this, alternative, total_space, profile=None):
-    p_values = pv.permutation_pvalues(
-        observed, nulls[:completed], alternative, total_nperm=total_space
-    )
+                 np_this, alternative, total_space, profile=None,
+                 p_type="fixed"):
+    if p_type == "sequential":
+        # adaptive run: retired modules' null rows are NaN past their
+        # retirement — Phipson–Smyth at each module's own count
+        p_values, n_perm_used = pv.sequential_pvalues(
+            observed, nulls[:completed], alternative, total_nperm=total_space
+        )
+    else:
+        p_values = pv.permutation_pvalues(
+            observed, nulls[:completed], alternative, total_nperm=total_space
+        )
+        n_perm_used = None
     n_present = np.array([counts[lab][0] for lab in labels])
     tot = np.array([counts[lab][1] for lab in labels])
     return PreservationResult(
+        n_perm_used=n_perm_used,
+        p_type=p_type,
         discovery=d_name,
         test=t_name,
         module_labels=labels,
@@ -113,6 +124,8 @@ def module_preservation(
     checkpoint_every: int = 8192,
     backend: str = "jax",
     profile=None,
+    adaptive: bool = False,
+    adaptive_rule=None,
 ):
     """Permutation test of network module preservation across datasets.
 
@@ -136,6 +149,21 @@ def module_preservation(
       permutations and on interrupt; re-running the same call resumes
       exactly (SURVEY.md §5 "checkpoint/resume" — an improvement over the
       reference's all-or-nothing runs).
+    - ``adaptive`` — sequential early-stopping nulls (Besag & Clifford
+      1991; :mod:`netrep_tpu.ops.sequential`): ``n_perm`` becomes a
+      ceiling, and each module stops drawing permutations once its
+      accept/reject decision at the stop rule's alpha is statistically
+      settled — clearly-preserved and clearly-null modules retire after a
+      few hundred draws instead of the full budget, and retired modules
+      drop out of subsequent device chunks entirely. P-values are then
+      Phipson–Smyth at each module's own count (``p_type='sequential'``,
+      per-module counts in ``result.n_perm_used``). Off by default: the
+      default path is bit-identical to previous releases. Requires the
+      default ``backend='jax'``.
+    - ``adaptive_rule`` — optional
+      :class:`~netrep_tpu.ops.sequential.StopRule` overriding the stopping
+      knobs (exceedance budget ``h``, decision ``alpha``, CP interval
+      ``confidence``, ``min_perms`` floor).
     - ``profile`` — tracing/profiling (SURVEY.md §5; the reference offers
       only ``verbose=`` + ``system.time``): ``True`` captures a
       ``jax.profiler`` trace under ``./netrep_profile``, a string names the
@@ -158,6 +186,11 @@ def module_preservation(
         )
     if backend not in ("jax", "native"):
         raise ValueError(f"backend must be 'jax' or 'native', got {backend!r}")
+    if adaptive and backend != "jax":
+        raise ValueError(
+            "adaptive=True requires backend='jax' (the native C++ tier has "
+            "no retirement re-bucketing); run it fixed-n or switch backends"
+        )
     if backend == "native":
         # the threaded C++ permutation procedure (netrep_tpu/native) — the
         # CPU tier mirroring the reference's OpenMP PermutationProcedure
@@ -211,6 +244,7 @@ def module_preservation(
             alternative, n_perm, auto_n_perm, engine_cls, config, mesh,
             vmap_tests, backend, seed, progress, ckpt_path, checkpoint_every,
             verbose, simplify, results, trace_dir, profiling,
+            adaptive, adaptive_rule,
         )
     finally:
         trace_cm.__exit__(None, None, None)
@@ -220,9 +254,28 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                alternative, n_perm, auto_n_perm, engine_cls, config, mesh,
                vmap_tests, backend, seed, progress, ckpt_path,
                checkpoint_every, verbose, simplify, results, trace_dir,
-               profiling):
+               profiling, adaptive=False, adaptive_rule=None):
     """Pair-loop body of :func:`module_preservation` (split out so the
     profiler trace context can bracket it without deep nesting)."""
+
+    def run_pair_null(engine, np_this, observed, prog, ck):
+        """One pair's null: fixed (default, bit-identical to previous
+        releases) or adaptive sequential early-stopping. Returns
+        ``(nulls, completed, interrupted)`` — adaptive runs legitimately
+        complete below ``np_this`` when every module retires, so the
+        interrupt signal comes from the loop, not the count."""
+        if adaptive:
+            nulls, completed, finished = engine.run_null_adaptive(
+                np_this, observed, key=seed, alternative=alternative,
+                rule=adaptive_rule, progress=prog, checkpoint_path=ck,
+                checkpoint_every=checkpoint_every,
+            )
+            return nulls, completed, not finished
+        nulls, completed = engine.run_null(
+            np_this, key=seed, progress=prog, checkpoint_path=ck,
+            checkpoint_every=checkpoint_every,
+        )
+        return nulls, completed, completed < np_this
 
     def pair_progress():
         # verbose=True with no user callback gets the reference-style
@@ -281,15 +334,13 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                 timer.time_observed(engine.observed) if timer
                 else engine.observed()
             )
-            nulls, completed = engine.run_null(
-                np_this, key=seed,
-                progress=(timer.wrap_progress(pair_progress())
-                          if timer else pair_progress()),
-                checkpoint_path=ckpt_path(d_name, "+".join(t_names)),
-                checkpoint_every=checkpoint_every,
+            nulls, completed, interrupted = run_pair_null(
+                engine, np_this, observed,
+                (timer.wrap_progress(pair_progress())
+                 if timer else pair_progress()),
+                ckpt_path(d_name, "+".join(t_names)),
             )
             prof_dict = timer.finish_null(completed) if timer else None
-            interrupted = completed < np_this
             if interrupted:
                 logger.warning(
                     "interrupted after %d/%d permutations; p-values use the "
@@ -302,6 +353,7 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                     d_name, t_name, labels, counts, observed[ti],
                     nulls[ti], completed, np_this, alternative, total_space,
                     profile=prof_dict,  # one vmapped run → shared timings
+                    p_type="sequential" if adaptive else "fixed",
                 )
             continue
 
@@ -327,20 +379,20 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                 timer.time_observed(engine.observed) if timer
                 else engine.observed()
             )
-            nulls, completed = engine.run_null(
-                np_this, key=seed,
-                progress=(timer.wrap_progress(pair_progress())
-                          if timer else pair_progress()),
-                checkpoint_path=ckpt_path(d_name, t_name),
-                checkpoint_every=checkpoint_every,
+            nulls, completed, was_interrupted = run_pair_null(
+                engine, np_this, observed,
+                (timer.wrap_progress(pair_progress())
+                 if timer else pair_progress()),
+                ckpt_path(d_name, t_name),
             )
             total_space = pv.total_permutations(pool.size, [m.size for m in mod_specs])
             results.setdefault(d_name, {})[t_name] = _make_result(
                 d_name, t_name, labels, counts, observed, nulls, completed,
                 np_this, alternative, total_space,
                 profile=timer.finish_null(completed) if timer else None,
+                p_type="sequential" if adaptive else "fixed",
             )
-            if completed < np_this:
+            if was_interrupted:
                 # Ctrl-C aborts the whole multi-pair run, not just the
                 # current pair (the reference's clean user-interrupt,
                 # SURVEY.md §5); pairs finished so far are returned.

@@ -54,6 +54,16 @@ class PreservationResult:
                                   # (may be inf); kept so p-values can be
                                   # recomputed exactly when results are
                                   # merged by combine_analyses()
+    n_perm_used: np.ndarray | None = None  # (n_modules,) permutations each
+                                  # module actually drew — differs across
+                                  # modules only for adaptive runs (retired
+                                  # modules stop early; their null rows are
+                                  # NaN past retirement). None = fixed run,
+                                  # every module saw `completed`.
+    p_type: str = "fixed"         # 'fixed' (every module at n_perm) or
+                                  # 'sequential' (Besag–Clifford early
+                                  # stopping; p-values are Phipson–Smyth at
+                                  # each module's own n_perm_used)
 
     @property
     def stat_names(self) -> tuple[str, ...]:
@@ -130,7 +140,17 @@ class PreservationResult:
             "n_vars_present": np.repeat(self.n_vars_present, t),
             "prop_vars_present": np.repeat(self.prop_vars_present, t),
             "total_size": np.repeat(self.total_size, t),
+            "n_perm_used": np.repeat(self.module_n_perm(), t),
         })
+
+    def module_n_perm(self) -> np.ndarray:
+        """(n_modules,) permutations backing each module's p-values:
+        ``n_perm_used`` for adaptive runs, ``completed`` broadcast for
+        fixed runs — one accessor so downstream code never branches."""
+        if self.n_perm_used is not None:
+            return np.asarray(self.n_perm_used, dtype=np.int64)
+        return np.full(len(self.module_labels), int(self.completed),
+                       dtype=np.int64)
 
     _SAVE_VERSION = 1
 
@@ -157,9 +177,15 @@ class PreservationResult:
                 else "inf" if np.isinf(self.total_space)
                 else float(self.total_space)
             ),
+            "p_type": self.p_type,
         }
+        extra = (
+            {} if self.n_perm_used is None
+            else {"n_perm_used": np.asarray(self.n_perm_used)}
+        )
         atomic_savez(
             path,
+            **extra,
             # top-level format marker checked FIRST on load, so a foreign
             # .npz (e.g. a null checkpoint) gets an informative error even
             # if a future format changes the meta encoding
@@ -211,6 +237,12 @@ class PreservationResult:
                     float(ts) if (ts := meta.get("total_space")) is not None
                     else None
                 ),
+                # optional adaptive-run fields (absent in pre-adaptive
+                # files — same version, additive keys)
+                n_perm_used=(
+                    z["n_perm_used"] if "n_perm_used" in z.files else None
+                ),
+                p_type=meta.get("p_type", "fixed"),
             )
 
 
@@ -320,10 +352,17 @@ def _combine_pair_results(results, allow_duplicate_nulls):
         # independent uniform sampling from `total_space` predicts.
         from collections import Counter
 
+        # All-NaN rows carry no draw identity (defensive: adaptive runs NaN
+        # retired modules' rows, and a fully-NaN row would hash identically
+        # across unrelated inputs) — exclude them from the collision count.
+        # Known limitation: an adaptive and a fixed run of the SAME seed
+        # NaN-mask the same draw differently, so their rows hash apart and
+        # that duplication goes undetected here.
         per_block = [
             Counter(
                 hashlib.sha256(np.ascontiguousarray(row)).digest()
                 for row in block
+                if not np.isnan(row).all()
             )
             for block in blocks
         ]
@@ -382,7 +421,17 @@ def _combine_pair_results(results, allow_duplicate_nulls):
     p_values = pv.permutation_pvalues(
         first.observed, nulls, first.alternative, total_nperm=total_space
     )
+    # pooling with any sequential input keeps per-module permutation counts
+    # ragged (each block contributes its own NaN-tailed rows); the counts
+    # are recomputed from the pooled array, which permutation_pvalues
+    # already groups by — the Phipson–Smyth estimator composes unchanged
+    any_seq = any(
+        r.p_type == "sequential" or r.n_perm_used is not None
+        for r in results
+    )
     return PreservationResult(
+        n_perm_used=pv.effective_nperm(nulls) if any_seq else None,
+        p_type="sequential" if any_seq else "fixed",
         discovery=first.discovery,
         test=first.test,
         module_labels=list(first.module_labels),

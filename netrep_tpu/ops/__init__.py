@@ -1,2 +1,3 @@
 """Compute kernels: NumPy oracle semantics (`oracle`), JAX masked statistic
-kernels (`stats`), and exact permutation p-values (`pvalues`)."""
+kernels (`stats`), exact permutation p-values (`pvalues`), and the
+sequential early-stopping monitor for adaptive nulls (`sequential`)."""

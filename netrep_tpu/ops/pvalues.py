@@ -170,6 +170,48 @@ def permutation_pvalues(
     return p
 
 
+def effective_nperm(nulls: np.ndarray) -> np.ndarray:
+    """Per-module permutation counts actually present in a null array —
+    rows where *any* statistic is finite count (an adaptive run NaNs the
+    whole (module, :) row past retirement; a data-less run NaNs only the
+    data statistics, which must still count as drawn permutations).
+
+    ``nulls`` is ``(nperm, n_modules, n_stats)``; returns ``(n_modules,)``.
+    """
+    return np.asarray(
+        (~np.isnan(nulls)).any(axis=-1).sum(axis=0), dtype=np.int64
+    )
+
+
+def sequential_pvalues(
+    observed: np.ndarray,
+    nulls: np.ndarray,
+    alternative: str = "greater",
+    total_nperm: float | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Sequential (early-stopped) permutation p-values — the estimator the
+    adaptive engine's nulls are read with (``p_type='sequential'``).
+
+    The adaptive loop (Besag & Clifford 1991 stopping,
+    :mod:`netrep_tpu.ops.sequential`) retires each module at its own
+    permutation count and leaves the module's null rows NaN past
+    retirement. Because retirement happens only at chunk boundaries on
+    tallied counts, the per-module estimator is exactly Phipson–Smyth at
+    the module's ``n_used`` — :func:`permutation_pvalues` already groups
+    cells by effective permutation count, so this composes with the exact-p
+    machinery unchanged; what this wrapper adds is the per-module
+    ``n_perm_used`` bookkeeping the results layer records.
+
+    Returns ``(p_values, n_perm_used)`` with ``n_perm_used`` of shape
+    ``(n_modules,)``.
+    """
+    nulls = np.asarray(nulls)
+    return (
+        permutation_pvalues(observed, nulls, alternative, total_nperm),
+        effective_nperm(nulls),
+    )
+
+
 def log_total_permutations(pool_size: int, module_sizes) -> float:
     """Natural log of the number of *ordered* disjoint node-set assignments —
     the size of the permutation space sampled by the engine: the falling

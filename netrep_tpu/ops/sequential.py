@@ -1,0 +1,268 @@
+"""Sequential early-stopping for the permutation null (Besag & Clifford
+1991, *Sequential Monte Carlo p-values*; Phipson & Smyth 2010 §4).
+
+The fixed-``n_perm`` engine spends the same permutation budget on every
+module, but most modules are statistically decided long before the budget
+is exhausted: a clearly-null module racks up exceedances almost every draw
+(Besag–Clifford: once ``h`` exceedances have occurred, the p-value estimate
+``(c+1)/(n+1)`` has bounded relative resampling error and cannot cross a
+small ``alpha`` anymore), and a clearly-preserved module's exceedance count
+stays at 0 until even the top of its Clopper–Pearson interval sits below
+``alpha``. :class:`StopMonitor` folds each chunk's per-(module, statistic)
+exceedance counts into running tallies on the host and retires modules whose
+decision at ``alpha`` is settled for every computable statistic — the engine
+then re-buckets the remaining modules so later chunks genuinely shrink
+(:meth:`netrep_tpu.parallel.engine.PermutationEngine.rebucket`).
+
+Both stopping rules compose exactly with the Phipson–Smyth estimator
+(:func:`netrep_tpu.ops.pvalues.permp`): a retired module's p-value is
+``permp(c, n_used)`` at its per-module permutation count, which is what
+:func:`netrep_tpu.ops.pvalues.permutation_pvalues` already computes from a
+null array whose retired tail is NaN. Decisions are taken only at chunk
+boundaries, so they are deterministic in (seed, chunk size) and
+checkpoint/resume-exact (the tallies and retired set ride the checkpoint —
+``utils/checkpoint.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+_ALTERNATIVES = ("greater", "less", "two.sided")
+
+
+@dataclasses.dataclass(frozen=True)
+class StopRule:
+    """Stopping-rule knobs for :class:`StopMonitor`.
+
+    Attributes
+    ----------
+    h : Besag–Clifford exceedance budget: a (module, statistic) cell is
+        decided once its exceedance count reaches ``h`` — the sequential
+        estimator ``(c+1)/(n+1)`` then has coefficient of variation
+        ≲ 1/sqrt(h) and, for any ``n_used >= h/alpha``, can no longer fall
+        below ``alpha``. 16 bounds the relative resampling error at ~25%,
+        ample for accept/reject at alpha=0.05 (the estimate itself is ≥
+        17/(n+1), decided far above alpha whenever the rule can fire).
+    alpha : decision threshold the CP rule settles against (the per-test
+        significance level the caller will read the p-values at).
+    confidence : coverage of the Clopper–Pearson interval used by the
+        "decided at alpha" rule. 0.999 keeps the per-cell risk of retiring
+        on the wrong side of alpha at 1e-3 — small against the Monte-Carlo
+        error a fixed-n run carries anyway.
+    min_perms : never retire a module before this many permutations, so
+        every module's null gets a floor sample even when the rules fire
+        instantly (and so tiny-alpha CP decisions aren't made from a
+        handful of draws).
+    """
+
+    h: int = 16
+    alpha: float = 0.05
+    confidence: float = 0.999
+    min_perms: int = 128
+
+    def __post_init__(self):
+        if self.h < 1:
+            raise ValueError(f"h must be >= 1, got {self.h}")
+        if not 0 < self.alpha < 1:
+            raise ValueError(f"alpha must be in (0, 1), got {self.alpha}")
+        if not 0.5 <= self.confidence < 1:
+            raise ValueError(
+                f"confidence must be in [0.5, 1), got {self.confidence}"
+            )
+        if self.min_perms < 1:
+            raise ValueError(
+                f"min_perms must be >= 1, got {self.min_perms}"
+            )
+
+
+def _cp_bounds(c: np.ndarray, n: int, delta: float):
+    """Two-sided Clopper–Pearson ``1 - delta`` interval for a binomial
+    proportion with ``c`` successes of ``n`` — vectorized in ``c``."""
+    from scipy import stats as _sstats
+
+    c = np.asarray(c, dtype=np.float64)
+    lo = np.where(c > 0, _sstats.beta.ppf(delta / 2, c, n - c + 1), 0.0)
+    hi = np.where(c < n, _sstats.beta.ppf(1 - delta / 2, c + 1, n - c), 1.0)
+    return lo, hi
+
+
+class StopMonitor:
+    """Host-side running tallies + retirement decisions for an adaptive
+    permutation run.
+
+    Parameters
+    ----------
+    observed : (n_modules, n_cells) observed statistics. Callers with extra
+        axes flatten them into the cell axis (the multi-test engine folds
+        its T datasets in as ``(K, T*7)``); NaN cells (data-less variant)
+        are never computable and do not block retirement.
+    alternative : 'greater' | 'less' | 'two.sided' — must match the tail
+        convention the final p-values will use
+        (:func:`netrep_tpu.ops.pvalues.exceedance_counts`). Two-sided
+        tallies keep BOTH tails (min-of-sums ≠ sum-of-mins across chunks).
+    rule : :class:`StopRule`.
+    """
+
+    def __init__(self, observed: np.ndarray, alternative: str, rule: StopRule):
+        if alternative not in _ALTERNATIVES:
+            raise ValueError(
+                f"alternative must be one of {_ALTERNATIVES}, "
+                f"got {alternative!r}"
+            )
+        self.observed = np.atleast_2d(np.asarray(observed, dtype=np.float64))
+        self.alternative = alternative
+        self.rule = rule
+        k, s = self.observed.shape
+        self.hi = np.zeros((k, s), dtype=np.int64)   # nulls >= observed
+        self.lo = np.zeros((k, s), dtype=np.int64)   # nulls <= observed
+        self.n_used = np.zeros(k, dtype=np.int64)
+        self.active = np.ones(k, dtype=bool)
+        #: total permutation indices folded so far — always a whole number
+        #: of chunks. May lag the loop's `completed` counter by one chunk
+        #: when an interrupt lands between the null write and the fold; the
+        #: adaptive loop re-folds the gap from the null array on resume so
+        #: the two can never diverge across a checkpoint.
+        self.folded = 0
+        self._nan_cells = np.isnan(self.observed)
+
+    # -- state ------------------------------------------------------------
+
+    @property
+    def n_modules(self) -> int:
+        return self.observed.shape[0]
+
+    def active_positions(self) -> np.ndarray:
+        """Global module positions still drawing permutations (sorted)."""
+        return np.flatnonzero(self.active)
+
+    def any_active(self) -> bool:
+        return bool(self.active.any())
+
+    def counts(self) -> np.ndarray:
+        """(n_modules, n_cells) tail-resolved exceedance counts — the same
+        convention as :func:`~netrep_tpu.ops.pvalues.exceedance_counts`
+        (min tail for two-sided; callers double the p there)."""
+        if self.alternative == "greater":
+            return self.hi
+        if self.alternative == "less":
+            return self.lo
+        return np.minimum(self.hi, self.lo)
+
+    def state_arrays(self) -> dict[str, np.ndarray]:
+        """Checkpointable tallies + retired set (restored by
+        :meth:`restore_state`); keys are the checkpoint extras namespace."""
+        return {
+            "seq_hi": self.hi,
+            "seq_lo": self.lo,
+            "seq_n_used": self.n_used,
+            "seq_active": self.active,
+            "seq_folded": np.int64(self.folded),
+        }
+
+    def restore_state(self, extras: dict) -> None:
+        """Restore tallies + retired set from checkpoint extras; shape
+        mismatches mean the checkpoint belongs to a different problem."""
+        try:
+            hi, lo = extras["seq_hi"], extras["seq_lo"]
+            n_used, active = extras["seq_n_used"], extras["seq_active"]
+            folded = extras["seq_folded"]
+        except KeyError:
+            raise ValueError(
+                "checkpoint has no sequential-stopping state (it was "
+                "written by a non-adaptive run); resume it with "
+                "adaptive=False or delete it"
+            ) from None
+        if hi.shape != self.hi.shape or active.shape != self.active.shape:
+            raise ValueError(
+                "checkpoint sequential-stopping state has a different "
+                "module/statistic shape; refusing to resume"
+            )
+        self.hi = np.asarray(hi, dtype=np.int64)
+        self.lo = np.asarray(lo, dtype=np.int64)
+        self.n_used = np.asarray(n_used, dtype=np.int64)
+        self.active = np.asarray(active, dtype=bool)
+        self.folded = int(folded)
+        # self-heal: decisions are a pure function of the tallies, so
+        # retire anything already decided — covers an interrupt that
+        # landed between a fold and its retirement flags
+        pos = self.active_positions()
+        if pos.size:
+            self.active[pos[self._decided(pos)]] = False
+
+    # -- updates ----------------------------------------------------------
+
+    def update(self, vals: np.ndarray, take: int) -> np.ndarray:
+        """Fold one chunk's null values for the currently-active modules
+        into the tallies and retire freshly-decided modules.
+
+        Parameters
+        ----------
+        vals : (take, n_active, n_cells) null statistics, module axis in
+            :meth:`active_positions` order.
+        take : permutations in this chunk.
+
+        Returns
+        -------
+        Global positions of modules retired by this chunk (possibly empty).
+        Decisions depend only on the tallies, so they are identical for an
+        interrupted+resumed run evaluating the same chunks.
+        """
+        pos = self.active_positions()
+        vals = np.asarray(vals, dtype=np.float64)
+        if vals.shape[:2] != (take, pos.size):
+            raise ValueError(
+                f"chunk values have shape {vals.shape}, expected "
+                f"({take}, {pos.size}, n_cells)"
+            )
+        obs = self.observed[pos]
+        # NaN null entries compare False on both tails — they contribute
+        # nothing, matching exceedance_counts' NaN handling. Stage the new
+        # tallies and commit them in one statement at the end: a
+        # KeyboardInterrupt mid-update must not leave one tail folded and
+        # the other not (resume re-folds by `folded`, so a torn commit
+        # would double-count; restore_state re-derives the retirement
+        # flags, which may lag this commit harmlessly).
+        with np.errstate(invalid="ignore"):
+            hi, lo = self.hi.copy(), self.lo.copy()
+            hi[pos] += (vals >= obs[None]).sum(axis=0)
+            lo[pos] += (vals <= obs[None]).sum(axis=0)
+        n_used = self.n_used.copy()
+        n_used[pos] += int(take)
+        self.hi, self.lo, self.n_used, self.folded = (
+            hi, lo, n_used, self.folded + int(take)
+        )
+        newly = pos[self._decided(pos)]
+        self.active[newly] = False
+        return newly
+
+    def _decided(self, pos: np.ndarray) -> np.ndarray:
+        """Per-module decision mask for the modules at ``pos``: every
+        computable cell is settled by the Besag–Clifford ``h`` rule or the
+        CP decided-at-alpha rule, and the floor sample is met."""
+        rule = self.rule
+        out = np.zeros(pos.size, dtype=bool)
+        for j, p in enumerate(pos):
+            n = int(self.n_used[p])
+            if n < rule.min_perms:
+                continue
+            if self.alternative == "greater":
+                c, thresh = self.hi[p], rule.alpha
+            elif self.alternative == "less":
+                c, thresh = self.lo[p], rule.alpha
+            else:
+                # two-sided p is min-tail doubled: the decision boundary on
+                # the min-tail proportion is alpha/2
+                c, thresh = np.minimum(self.hi[p], self.lo[p]), rule.alpha / 2
+            by_h = c >= rule.h
+            cp_lo, cp_hi = _cp_bounds(c, n, 1.0 - rule.confidence)
+            by_cp = (cp_lo > thresh) | (cp_hi < thresh)
+            out[j] = bool(np.all(by_h | by_cp | self._nan_cells[p]))
+        return out
+
+    def total_evaluated(self) -> int:
+        """Σ per-module permutations drawn — the adaptive work metric the
+        bench row reports against ``n_modules * n_perm``."""
+        return int(self.n_used.sum())

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import time
 from functools import partial
 from typing import Callable, Sequence
 
@@ -69,30 +70,14 @@ def run_checkpointed_chunks(
     the null array; ``fingerprint_extra`` extends the engine fingerprint for
     wrappers whose problem has extra structure (e.g. the test-dataset count).
     """
-    # Key-handling hooks let non-JAX engines (the native C++ backend) reuse
-    # this loop with their own RNG-stream identity: `prepare_key` normalizes
-    # the user seed, `key_data` yields the array stored in checkpoints to
-    # refuse cross-stream resume.
-    prepare = getattr(base, "prepare_key", None)
-    if prepare is not None:
-        key = prepare(key)
-    elif isinstance(key, int):
-        key = jax.random.key(key)
+    key = _resolve_key(base, key)
 
     save = None
+    loaded = None
     if checkpoint_path is not None:
         from ..utils import checkpoint as ckpt
 
-        fp = ckpt.engine_fingerprint(base)
-        if fingerprint_extra:
-            fp = np.concatenate(
-                [fp, np.frombuffer(fingerprint_extra, dtype=np.uint8)]
-            )
-        key_data = getattr(base, "key_data", None)
-        kd = (
-            np.asarray(key_data(key)) if key_data is not None
-            else np.asarray(jax.random.key_data(key))
-        )
+        kd, fp = _checkpoint_identity(base, key, fingerprint_extra)
         loaded = ckpt.load_null_checkpoint(checkpoint_path)
         if loaded is not None:
             nulls_init, start_perm = ckpt.validate_resume(
@@ -117,6 +102,10 @@ def run_checkpointed_chunks(
     completed = start_perm
     last_saved = completed
     pending: tuple | None = None  # (outs, at, take)
+    # (completed, wall-time) after each chunk lands: the steady-state
+    # throughput between the first and last marks (first chunk's compile
+    # excluded) feeds the persistent autotune cache (utils/autotune.py)
+    t_marks: list[tuple[int, float]] = []
     try:
         while dispatched < n_perm or pending is not None:
             nxt = None
@@ -129,6 +118,7 @@ def run_checkpointed_chunks(
                 outs, at, take_p = pending
                 write(nulls, outs, at, take_p)
                 completed = at + take_p
+                t_marks.append((completed, time.perf_counter()))
                 if progress is not None:
                     progress(completed, n_perm)
                 if save is not None and completed - last_saved >= checkpoint_every:
@@ -151,7 +141,165 @@ def run_checkpointed_chunks(
                 pass
     if save is not None and completed > last_saved:
         save(nulls, completed)
+    record = getattr(base, "record_chunk_throughput", None)
+    if record is not None and len(t_marks) >= 3:
+        # >= 3 chunks: drop the first mark (its interval absorbed the
+        # compile) and require a real steady-state window
+        (c0, t0), (c1, t1) = t_marks[0], t_marks[-1]
+        if t1 > t0 and c1 > c0:
+            record((c1 - c0) / (t1 - t0))
     return nulls, completed
+
+
+def _resolve_key(base, key):
+    """Key-handling hooks let non-JAX engines (the native C++ backend) reuse
+    the chunk loops with their own RNG-stream identity: ``prepare_key``
+    normalizes the user seed, ``key_data`` (see
+    :func:`_checkpoint_identity`) yields the array stored in checkpoints to
+    refuse cross-stream resume."""
+    prepare = getattr(base, "prepare_key", None)
+    if prepare is not None:
+        return prepare(key)
+    if isinstance(key, int):
+        return jax.random.key(key)
+    return key
+
+
+def _checkpoint_identity(base, key, fingerprint_extra: bytes):
+    """(key_data, fingerprint) pair stored in / validated against null
+    checkpoints — one derivation shared by the fixed and adaptive loops."""
+    from ..utils import checkpoint as ckpt
+
+    fp = ckpt.engine_fingerprint(base)
+    if fingerprint_extra:
+        fp = np.concatenate(
+            [fp, np.frombuffer(fingerprint_extra, dtype=np.uint8)]
+        )
+    key_data = getattr(base, "key_data", None)
+    kd = (
+        np.asarray(key_data(key)) if key_data is not None
+        else np.asarray(jax.random.key_data(key))
+    )
+    return kd, fp
+
+
+def run_adaptive_chunks(
+    base: "PermutationEngine",
+    n_perm: int,
+    key,
+    fn_builder: Callable[[], Callable],
+    alloc_shape: tuple[int, ...],
+    write: Callable[[np.ndarray, list, int, int], None],
+    slice_vals: Callable[[np.ndarray, int, int, np.ndarray], np.ndarray],
+    monitor,
+    rebucket: Callable[[np.ndarray], None],
+    progress: Callable[[int, int], None] | None = None,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 8192,
+    perm_axis: int = 0,
+    fingerprint_extra: bytes = b"",
+) -> tuple[np.ndarray, int, bool]:
+    """Adaptive scheduling layer around the shared chunked null loop: after
+    each chunk a host-side :class:`~netrep_tpu.ops.sequential.StopMonitor`
+    folds the chunk's per-(module, statistic) exceedance counts into running
+    tallies and retires decided modules; retired modules *drop out* of
+    subsequent chunks — ``rebucket`` rebuilds the engine's buckets for the
+    remaining set (fewer vmap lanes, smaller buckets) and ``fn_builder``
+    re-jits the shrunken chunk program — rather than merely masking work.
+
+    RNG contract: every chunk still draws ``fold_in(key, i)`` permutations
+    over the full pool, and re-bucketing preserves each surviving module's
+    original slice offsets into the drawn permutation
+    (:meth:`PermutationEngine.rebucket`), so an active module's null rows
+    are bit-identical to the fixed-``n_perm`` run's rows at the same
+    permutation indices.
+
+    ``slice_vals(nulls, done, take, positions)`` views the chunk just
+    written as the ``(take, n_active, n_cells)`` array the monitor tallies
+    (engines with extra axes — the multi-test T axis — fold them into the
+    cell axis here). Checkpoints carry the monitor's tallies + retired set
+    (``extra=`` in :func:`~netrep_tpu.utils.checkpoint.save_null_checkpoint`)
+    and are written only at chunk boundaries, where decisions are
+    deterministic — so a mid-run checkpoint resumes to the same final
+    result as an uninterrupted run.
+
+    Returns ``(nulls, completed, finished)``; rows past each module's
+    retirement stay NaN (that is the per-module ``n_perm_used`` record —
+    :func:`netrep_tpu.ops.pvalues.effective_nperm`). ``finished`` is False
+    only for a ``KeyboardInterrupt`` partial result.
+
+    Double-buffering is deliberately absent here (unlike
+    :func:`run_checkpointed_chunks`): the monitor must see chunk *k* before
+    chunk *k+1*'s module set is known, so the dispatch chain is inherently
+    synchronous. The throughput cost is bounded by the device→host copy of
+    chunks that shrink as modules retire.
+    """
+    key = _resolve_key(base, key)
+    nulls = np.full(alloc_shape, np.nan)
+    completed = 0
+    save = None
+    if checkpoint_path is not None:
+        from ..utils import checkpoint as ckpt
+
+        kd, fp = _checkpoint_identity(base, key, fingerprint_extra)
+        loaded = ckpt.load_null_checkpoint(checkpoint_path)
+        if loaded is not None:
+            nulls, completed = ckpt.validate_resume(
+                loaded, n_perm, kd, fp, checkpoint_path, perm_axis=perm_axis
+            )
+            if completed:
+                monitor.restore_state(loaded.get("extras") or {})
+                gap = completed - monitor.folded
+                if gap > 0:
+                    # an interrupt landed between a chunk's null write and
+                    # its tally fold: re-fold the written-but-unfolded rows
+                    # so decisions match an uninterrupted run exactly
+                    monitor.update(
+                        slice_vals(nulls, monitor.folded, gap,
+                                   monitor.active_positions()),
+                        gap,
+                    )
+
+        def save(nulls, done):
+            ckpt.save_null_checkpoint(
+                checkpoint_path, nulls, done, kd, fp,
+                extra=monitor.state_arrays(),
+            )
+
+    pos = monitor.active_positions()
+    if pos.size and pos.size < monitor.n_modules:
+        rebucket(pos)  # resumed mid-run: shrink to the restored active set
+    fn = fn_builder() if monitor.any_active() else None
+    C = base.effective_chunk()
+    dynamic = getattr(base, "dynamic_chunk", False)
+    last_saved = completed
+    finished = True
+    try:
+        while completed < n_perm and monitor.any_active():
+            pos = monitor.active_positions()
+            take = min(C, n_perm - completed)
+            keys = base.perm_keys(key, completed, take if dynamic else C)
+            outs = fn(keys)
+            write(nulls, outs, completed, take)
+            completed += take
+            newly = monitor.update(
+                slice_vals(nulls, completed - take, take, pos), take
+            )
+            if progress is not None:
+                progress(completed, n_perm)
+            if newly.size and monitor.any_active():
+                rebucket(monitor.active_positions())
+                fn = fn_builder()
+            if save is not None and completed - last_saved >= checkpoint_every:
+                save(nulls, completed)
+                last_saved = completed
+    except KeyboardInterrupt:
+        # chunk-boundary abort: tallies were only ever folded for fully
+        # written chunks, so the checkpoint below resumes exactly
+        finished = False
+    if save is not None and completed > last_saved:
+        save(nulls, completed)
+    return nulls, completed, finished
 
 
 @partial(jax.jit, static_argnums=(2,))
@@ -564,6 +712,74 @@ class PermutationEngine:
 
         self._chunk_fn_cached: Callable | None = None
         self._observed_fn: Callable | None = None
+        #: pristine full-module bucket list — `rebucket` always filters from
+        #: this, so successive retirements never compound filtering error
+        self._buckets_full: list[_Bucket] = self.buckets
+        #: (cache, key, perm_batch) set by chunk_body when autotune applies;
+        #: `record_chunk_throughput` writes the measured rate back to it
+        self._autotune_record: tuple | None = None
+
+    def rebucket(self, active) -> None:
+        """Rebuild the bucket list for the module subset ``active`` (global
+        positions) — the adaptive engine's retirement path: later chunks
+        run genuinely smaller bucket programs (fewer vmap lanes), not
+        masked work.
+
+        The RNG contract survives because each surviving module keeps its
+        ORIGINAL ``(offset, size)`` slice into the drawn permutation
+        (slices are copied, never recomputed from the shrunken module set),
+        and permutations are still drawn over the full pool — so a
+        surviving module's index sets for permutation ``i`` are identical
+        to the fixed-``n_perm`` run's. Per-bucket discovery properties and
+        observed indices are row-filtered on device (cheap gathers).
+        ``rebucket(range(n_modules))`` restores the full set.
+        """
+        keep = {int(a) for a in np.asarray(active, dtype=np.int64).ravel()}
+        bad = keep - set(range(self.n_modules))
+        if bad:
+            raise ValueError(f"unknown module positions: {sorted(bad)}")
+        new = []
+        for b in self._buckets_full:
+            sel = [i for i, p in enumerate(b.module_pos) if p in keep]
+            if not sel:
+                continue
+            if len(sel) == len(b.module_pos):
+                new.append(b)
+                continue
+            sel_a = np.asarray(sel)
+            new.append(_Bucket(
+                b.cap,
+                [b.module_pos[i] for i in sel],
+                jax.tree.map(lambda a: a[sel_a], b.disc),
+                b.obs_idx[sel_a],
+                [b.slices[i] for i in sel],
+            ))
+        if not new:
+            raise ValueError("rebucket needs at least one active module")
+        self.buckets = new
+        self._chunk_fn_cached = None
+
+    def autotune_key(self, extra: str = "") -> str:
+        """Problem-shape key for the persistent throughput cache: backend ×
+        gather mode × per-bucket (cap, module count) signature × chunk."""
+        from ..utils.autotune import make_key
+
+        caps = ",".join(
+            f"{b.cap}x{len(b.module_pos)}" for b in self.buckets
+        )
+        return make_key(
+            jax.default_backend(), self.gather_mode, caps,
+            self.effective_chunk(), extra,
+        )
+
+    def record_chunk_throughput(self, perms_per_sec: float) -> None:
+        """Steady-state chunk throughput callback from the null loop —
+        persists the measurement for the (key, perm_batch) this engine
+        resolved, so the next build with the same problem shape reuses the
+        best-measured batch instead of the static byte-budget heuristic."""
+        if self._autotune_record is not None:
+            cache, key, pb = self._autotune_record
+            cache.record(key, pb, perms_per_sec)
 
     # ------------------------------------------------------------------
     # Observed pass (SURVEY.md §3.1 "observed pass")
@@ -692,13 +908,23 @@ class PermutationEngine:
         if row_sharded:
             from .sharded import gather_corr_net as _gcn
         gather_mode = self.gather_mode
-        perm_batch = cfg.resolved_perm_batch(
+        heuristic = cfg.resolved_perm_batch(
             gather_mode, jax.default_backend(), self.effective_chunk(),
             bytes_per_perm=self._mxu_bytes_per_perm(
                 int(self._test_corr.shape[-1]),
                 None if self._test_dataT is None
                 else int(self._test_dataT.shape[-1]),
             ),
+        )
+        # measured-throughput override of the static byte-budget heuristic
+        # (utils/autotune.py): reuse the best-recorded batch for this
+        # problem shape; the null loop records what this run measures
+        from ..utils.autotune import resolve_perm_batch
+
+        at_key = self.autotune_key()
+        perm_batch, at_cache = resolve_perm_batch(cfg, at_key, heuristic)
+        self._autotune_record = (
+            (at_cache, at_key, perm_batch) if at_cache is not None else None
         )
         net_beta = self.net_beta
         kernel = partial(
@@ -900,6 +1126,17 @@ class PermutationEngine:
                 "engine was built discovery_only; test-side passes live in "
                 "the wrapping engine"
             )
+        return run_checkpointed_chunks(
+            self, n_perm, key, self._chunk_fn(),
+            (n_perm, self.n_modules, N_STATS), self._null_write(),
+            progress=progress, nulls_init=nulls_init, start_perm=start_perm,
+            checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+        )
+
+    def _null_write(self) -> Callable:
+        """Chunk→null scatter shared by the fixed and adaptive loops. Reads
+        ``self.buckets`` at call time, so after a `rebucket` it scatters
+        exactly the surviving modules."""
 
         def write(nulls, outs, done, take):
             from .distributed import gather_to_host
@@ -914,9 +1151,58 @@ class PermutationEngine:
                 arr = gather_to_host(out).astype(np.float64)
                 nulls[done: done + take, b.module_pos] = arr[:take]
 
-        return run_checkpointed_chunks(
-            self, n_perm, key, self._chunk_fn(),
-            (n_perm, self.n_modules, N_STATS), write,
-            progress=progress, nulls_init=nulls_init, start_perm=start_perm,
-            checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+        return write
+
+    def run_null_adaptive(
+        self,
+        n_perm: int,
+        observed: np.ndarray,
+        key: jax.Array | int = 0,
+        alternative: str = "greater",
+        rule=None,
+        progress: Callable[[int, int], None] | None = None,
+        checkpoint_path: str | None = None,
+        checkpoint_every: int = 8192,
+    ) -> tuple[np.ndarray, int, bool]:
+        """Sequential early-stopping variant of :meth:`run_null`
+        (:func:`run_adaptive_chunks`): ``n_perm`` becomes a *ceiling* —
+        modules whose accept/reject decision at the stop rule's alpha is
+        settled retire early and drop out of later chunks, leaving their
+        remaining null rows NaN (per-module counts:
+        :func:`netrep_tpu.ops.pvalues.effective_nperm`).
+
+        ``observed`` are this engine's observed statistics (the monitor
+        tallies exceedances against them) and ``alternative`` must match
+        the tail the final p-values will use. Returns ``(nulls, completed,
+        finished)`` — ``completed`` is the *deepest* module's permutation
+        count, ``finished`` False only on ``KeyboardInterrupt``.
+        """
+        from ..ops.sequential import StopMonitor, StopRule
+
+        if self.discovery_only:
+            raise RuntimeError(
+                "engine was built discovery_only; test-side passes live in "
+                "the wrapping engine"
+            )
+        monitor = StopMonitor(
+            np.asarray(observed, dtype=np.float64).reshape(
+                self.n_modules, -1
+            ),
+            alternative, rule or StopRule(),
         )
+
+        def slice_vals(nulls, done, take, pos):
+            return nulls[done: done + take][:, pos, :]
+
+        try:
+            return run_adaptive_chunks(
+                self, n_perm, key, self._chunk_fn,
+                (n_perm, self.n_modules, N_STATS), self._null_write(),
+                slice_vals, monitor, self.rebucket,
+                progress=progress, checkpoint_path=checkpoint_path,
+                checkpoint_every=checkpoint_every,
+            )
+        finally:
+            # leave the engine reusable at full strength (e.g. a fixed-n
+            # run after an adaptive one on the same instance)
+            self.rebucket(range(self.n_modules))

@@ -224,7 +224,18 @@ class MultiTestEngine:
         pb = cfg.resolved_perm_batch(
             "fused", jax.default_backend(), base.effective_chunk()
         )
-        perm_batch = max(1, pb // T)
+        # measured-throughput override of the byte-budget heuristic, same
+        # mechanism as the single-test chunk (utils/autotune.py); the key
+        # carries T so multi-cohort measurements never cross-pollinate
+        from ..utils.autotune import resolve_perm_batch
+
+        at_key = base.autotune_key(extra=f"T{T}")
+        perm_batch, at_cache = resolve_perm_batch(
+            cfg, at_key, max(1, pb // T)
+        )
+        base._autotune_record = (
+            (at_cache, at_key, perm_batch) if at_cache is not None else None
+        )
 
         def chunk(keys, pool, tc, tn, td, discs):
             C = keys.shape[0]
@@ -393,15 +404,11 @@ class MultiTestEngine:
         )
         return f"|T:{self.T}|td:{digest}".encode()
 
-    def run_null(self, n_perm: int, key=0, progress=None,
-                 nulls_init=None, start_perm: int = 0,
-                 checkpoint_path: str | None = None,
-                 checkpoint_every: int = 8192):
-        """(T, n_perm, n_modules, 7) null array + completed count; same
-        chunked/interruptible/reproducible/resumable/checkpointable contract
-        as the base engine (key derivation and chunk rounding are shared
-        helpers on :class:`PermutationEngine` so the two paths cannot
-        drift)."""
+    def _null_write(self) -> Callable:
+        """Chunk→null scatter shared by the fixed and adaptive loops (reads
+        the base engine's buckets at call time — see
+        :meth:`PermutationEngine._null_write`)."""
+
         def write(nulls, outs, done, take):
             from .distributed import gather_to_host
 
@@ -414,11 +421,30 @@ class MultiTestEngine:
                 arr = gather_to_host(outarr).astype(np.float64)
                 nulls[:, done: done + take, b.module_pos] = arr[:, :take]
 
+        return write
+
+    def rebucket(self, active) -> None:
+        """Shrink to the surviving module subset (adaptive retirement) —
+        delegates the bucket rebuild to the base engine (original
+        permutation-slice offsets preserved) and invalidates this wrapper's
+        jitted chunk."""
+        self._base.rebucket(active)
+        self._chunk_cached = None
+
+    def run_null(self, n_perm: int, key=0, progress=None,
+                 nulls_init=None, start_perm: int = 0,
+                 checkpoint_path: str | None = None,
+                 checkpoint_every: int = 8192):
+        """(T, n_perm, n_modules, 7) null array + completed count; same
+        chunked/interruptible/reproducible/resumable/checkpointable contract
+        as the base engine (key derivation and chunk rounding are shared
+        helpers on :class:`PermutationEngine` so the two paths cannot
+        drift)."""
         from .engine import run_checkpointed_chunks
 
         return run_checkpointed_chunks(
             self._base, n_perm, key, self._chunk_fn(),
-            (self.T, n_perm, self.n_modules, N_STATS), write,
+            (self.T, n_perm, self.n_modules, N_STATS), self._null_write(),
             progress=progress, nulls_init=nulls_init, start_perm=start_perm,
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
             perm_axis=1,
@@ -426,3 +452,41 @@ class MultiTestEngine:
             # discovery-only), so their content digest rides fingerprint_extra
             fingerprint_extra=self._fingerprint_extra(),
         )
+
+    def run_null_adaptive(self, n_perm: int, observed, key=0,
+                          alternative: str = "greater", rule=None,
+                          progress=None,
+                          checkpoint_path: str | None = None,
+                          checkpoint_every: int = 8192):
+        """Sequential early-stopping variant of :meth:`run_null`
+        (:meth:`PermutationEngine.run_null_adaptive` semantics). A module
+        retires only when its decision is settled in EVERY test dataset:
+        the ``(T, n_modules, 7)`` observed statistics fold into the
+        monitor's cell axis as ``(n_modules, T*7)``, so each (dataset,
+        statistic) cell is tallied independently and the shared permutation
+        draw still serves all T cohorts of the surviving modules."""
+        from ..ops.sequential import StopMonitor, StopRule
+        from .engine import run_adaptive_chunks
+
+        obs = np.asarray(observed, dtype=np.float64)
+        monitor = StopMonitor(
+            np.moveaxis(obs, 0, 1).reshape(self.n_modules, -1),
+            alternative, rule or StopRule(),
+        )
+
+        def slice_vals(nulls, done, take, pos):
+            block = nulls[:, done: done + take][:, :, pos, :]
+            # (T, take, P, 7) -> (take, P, T*7): dataset axis joins stats
+            return np.moveaxis(block, 0, 2).reshape(take, pos.size, -1)
+
+        try:
+            return run_adaptive_chunks(
+                self._base, n_perm, key, self._chunk_fn,
+                (self.T, n_perm, self.n_modules, N_STATS),
+                self._null_write(), slice_vals, monitor, self.rebucket,
+                progress=progress, checkpoint_path=checkpoint_path,
+                checkpoint_every=checkpoint_every, perm_axis=1,
+                fingerprint_extra=self._fingerprint_extra(),
+            )
+        finally:
+            self.rebucket(range(self.n_modules))

@@ -1,0 +1,144 @@
+"""Persistent per-(backend, bucket-caps, chunk) throughput autotune cache.
+
+The engine's per-dispatch permutation batch (``EngineConfig.perm_batch``) is
+derived from a static byte-budget heuristic
+(:meth:`~netrep_tpu.utils.config.EngineConfig.resolved_perm_batch`). That
+heuristic cannot see what the box is actually delivering — the round-5
+driver bench drifted 752→982 s on the identical CPU-fallback config with no
+code change, and nothing recorded per-chunk throughput to tell contention
+from regression. This module closes the loop: the chunked null loop records
+the *measured* steady-state permutations/second for the (backend, bucket
+shape, chunk, gather mode, perm batch) it ran, and the next engine build
+with the same key reuses the best-measured batch instead of re-deriving the
+heuristic value.
+
+Storage is one JSON file under the same fingerprinted cache dir as the
+persistent XLA compile cache (``.jax_cache/<cpu-fingerprint>/``), so
+entries never migrate across hosts with different real machine features —
+the same isolation rule the AOT cache needs
+(:func:`netrep_tpu.utils.backend.host_cpu_fingerprint`). Writes are atomic
+(tempfile + ``os.replace``) and loads are tolerant: a corrupt or
+foreign-format file is treated as empty, never raised to the engine's hot
+path. Reusing a different measured batch re-partitions the chunk's
+``lax.map`` and thus reorders f32 accumulation — value drift at
+float-rounding level only (~1e-7 relative), identical in kind to what an
+explicit ``perm_batch`` change always caused; an empty cache leaves the
+heuristic path untouched.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+#: keep this many most-recent measurements per (key, setting) — enough to
+#: smooth box-contention noise without the file growing unboundedly
+_KEEP = 8
+_FORMAT = 1
+
+
+def default_path() -> str:
+    """Autotune store beside the persistent compile cache: the repo-local
+    ``.jax_cache/<cpu-fingerprint>/autotune.json``."""
+    from .backend import host_cpu_fingerprint
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    return os.path.join(
+        repo_root, ".jax_cache", host_cpu_fingerprint(), "autotune.json"
+    )
+
+
+def make_key(backend: str, gather_mode: str, caps: str, chunk: int,
+             extra: str = "") -> str:
+    """Cache key for one engine problem shape: backend × gather mode ×
+    bucket-cap signature × chunk size (+ wrapper-specific ``extra``, e.g.
+    the multi-test dataset count)."""
+    key = f"{backend}|{gather_mode}|caps:{caps}|chunk:{int(chunk)}"
+    return key + (f"|{extra}" if extra else "")
+
+
+class AutotuneCache:
+    """Tiny persistent map ``key -> {setting: [perms_per_sec, ...]}``.
+
+    ``setting`` is the tunable value as a string (currently the resolved
+    ``perm_batch``). Concurrent writers (parallel test processes) race
+    benignly: each read-merge-replace keeps its own measurements plus
+    whatever the last writer stored; losing a few samples only delays
+    convergence.
+    """
+
+    def __init__(self, path: str | None = None):
+        self.path = path or default_path()
+
+    def _load(self) -> dict:
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            if data.get("format") != _FORMAT or not isinstance(
+                data.get("entries"), dict
+            ):
+                return {}
+            return data["entries"]
+        except (OSError, ValueError):
+            return {}
+
+    def record(self, key: str, setting: int, perms_per_sec: float) -> None:
+        """Append one steady-state throughput measurement (best-effort: an
+        unwritable cache dir silently skips — tuning is never load-bearing)."""
+        if not perms_per_sec > 0:
+            return
+        entries = self._load()
+        samples = entries.setdefault(key, {}).setdefault(str(int(setting)), [])
+        samples.append(round(float(perms_per_sec), 3))
+        del samples[:-_KEEP]
+        try:
+            d = os.path.dirname(self.path)
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=d, suffix=".json.tmp")
+            with os.fdopen(fd, "w") as f:
+                json.dump({"format": _FORMAT, "entries": entries}, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass
+
+    def best_setting(self, key: str) -> int | None:
+        """Setting with the best median recorded throughput for ``key``, or
+        None when nothing has been measured yet (callers fall back to the
+        static heuristic). Median, not max: a single contention-free lucky
+        sample must not pin a batch size forever."""
+        entries = self._load().get(key)
+        if not entries:
+            return None
+        def med(v):
+            s = sorted(v)
+            return s[len(s) // 2]
+        try:
+            return int(max(entries, key=lambda k: med(entries[k])))
+        except (ValueError, TypeError):
+            return None
+
+    def throughput(self, key: str, setting: int) -> list[float]:
+        """Recorded samples for (key, setting) — diagnostics/tests."""
+        return list(self._load().get(key, {}).get(str(int(setting)), []))
+
+
+def resolve_perm_batch(config, key: str, heuristic: int):
+    """Autotuned perm-batch resolution shared by the engines: an explicit
+    ``config.perm_batch`` or ``autotune=False`` keeps the static value;
+    otherwise the best-measured setting for ``key`` (if any) replaces the
+    byte-budget heuristic. Returns ``(perm_batch, cache_or_None)`` — the
+    cache handle is what the run loop records the measured throughput to.
+    """
+    if not getattr(config, "autotune", False):
+        return heuristic, None
+    cache = AutotuneCache()
+    if config.perm_batch is not None:
+        # explicit setting: honor it (it already rode the resolved value in
+        # ``heuristic``) but still record its measured throughput, so batch
+        # sweeps populate the cache with real alternatives
+        return heuristic, cache
+    best = cache.best_setting(key)
+    return (best if best is not None and best > 0 else heuristic), cache

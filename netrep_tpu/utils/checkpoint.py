@@ -87,9 +87,17 @@ def save_null_checkpoint(
     completed: int,
     key_data: np.ndarray,
     fingerprint: np.ndarray,
+    extra: dict | None = None,
 ) -> None:
     """Atomically persist a (possibly partial) null array (see
-    :func:`atomic_savez`)."""
+    :func:`atomic_savez`). ``extra`` maps names to arrays of auxiliary
+    loop state — the adaptive engine stores its sequential-stopping
+    tallies and retired set here (``x_``-prefixed keys, so plain resumes
+    of old checkpoints are unaffected and old builds simply ignore them).
+    """
+    extras = {
+        f"x_{k}": np.asarray(v) for k, v in (extra or {}).items()
+    }
     atomic_savez(
         path,
         version=np.int64(_FORMAT_VERSION),
@@ -97,6 +105,7 @@ def save_null_checkpoint(
         completed=np.int64(completed),
         key_data=np.asarray(key_data),
         fingerprint=fingerprint,
+        **extras,
     )
 
 
@@ -121,6 +130,11 @@ def load_null_checkpoint(path: str) -> dict | None:
             "completed": int(z["completed"]),
             "key_data": z["key_data"],
             "fingerprint": z["fingerprint"],
+            # auxiliary loop state (adaptive tallies/retired set); empty
+            # for checkpoints written by fixed-n runs
+            "extras": {
+                k[2:]: z[k] for k in z.files if k.startswith("x_")
+            },
         }
 
 

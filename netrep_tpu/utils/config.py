@@ -90,6 +90,18 @@ class EngineConfig:
         intermediates used when ``perm_batch`` is None (default 2 GiB —
         reproduces the hand-tuned batch of 2 at north-star shapes and sits
         comfortably inside a 16 GiB HBM next to the stored matrices).
+    autotune : persist measured steady-state chunk throughput per
+        (backend, bucket shape, chunk, gather mode, perm batch) to the
+        fingerprinted cache dir and reuse the best-measured ``perm_batch``
+        for the same problem shape instead of re-deriving the static
+        byte-budget heuristic (:mod:`netrep_tpu.utils.autotune`). With an
+        empty cache the heuristic value runs unchanged (the default path
+        is untouched); once a *different* batch has measured faster,
+        reusing it re-partitions the chunk's ``lax.map``, which reorders
+        f32 accumulation — value drift at float-rounding level (~1e-7
+        relative), the same drift an explicit ``perm_batch`` change always
+        caused. An explicit ``perm_batch`` is still honored verbatim (its
+        throughput is recorded, so sweeps feed the cache).
     """
 
     chunk_size: int = 128
@@ -119,6 +131,7 @@ class EngineConfig:
     perm_batch: int | None = None
     network_from_correlation: float | tuple | None = None
     mxu_batch_budget_bytes: int = 2 << 30
+    autotune: bool = True
 
     def __post_init__(self):
         if self.network_from_correlation is not None:

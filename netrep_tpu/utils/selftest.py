@@ -31,17 +31,26 @@ import numpy as np
 #: BASELINE.md §Precision). Real breakage (wrong indices, bad collective,
 #: miscompiled kernel) shows up orders of magnitude above this.
 _ATOL_MXU = 2e-2
-#: tolerance on backends with exact f32 matmuls (CPU): agreement with the
-#: oracle is ~1e-5 there, so a uniform MXU-sized bound would wave a 100×
-#: device-math regression through (VERDICT r4 item 8) — hold CPU to the
-#: float32-rounding tier instead.
+#: tolerance everywhere MXU bf16 truncation is NOT real device behavior:
+#: agreement with the oracle is ~1e-5 on exact-f32-matmul backends, so a
+#: uniform MXU-sized bound would wave a 100× device-math regression
+#: through (VERDICT r4 item 8; ADVICE r5 closed the same hole for unknown
+#: accelerators — e.g. GPU — which previously inherited the loose tier).
 _ATOL_EXACT = 1e-4
+#: backends KNOWN to truncate f32 matmul operands to bf16 and therefore
+#: granted the loose tier: TPU proper, and the axon tunnel (a TPU behind a
+#: gRPC dial — same MXU). Everything else, including backends this list
+#: has never seen, defaults to the tight tier; a genuinely-truncating new
+#: accelerator then fails loudly and gets added here deliberately.
+_TRUNCATING_BACKENDS = ("tpu", "axon")
 
-#: (module sizes, n nodes, n samples) per validated problem. The first
-#: straddles the 32-cap bucket boundary so at least two compiled bucket
-#: programs execute; the second is larger (different caps, different
-#: one-hot/matmul tilings) so a shape-dependent miscompile cannot hide
-#: behind the small shape (VERDICT r4 item 8).
+#: (module sizes, n nodes, n samples) per validated problem, ordered
+#: smallest-problem first. The first straddles the 32-cap bucket boundary
+#: so at least two compiled bucket programs execute; the second is larger
+#: (different caps, different one-hot/matmul tilings) so a shape-dependent
+#: miscompile cannot hide behind the small shape (VERDICT r4 item 8).
+#: ``max_shapes`` keeps the LARGEST shapes (the tail of this tuple) — see
+#: :func:`selftest`.
 _SHAPES = (
     ((40, 18, 9), 96, 24),
     ((72, 40, 21), 192, 32),
@@ -65,10 +74,12 @@ def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True,
     regression cannot hide under hardware-rounding headroom.
 
     ``max_shapes`` bounds how many of the validated problem shapes run
-    (None = all). CI runs every shape; time-boxed deployments — the
-    watcher's on-chip gate inside a ~5-7 min tunnel window — pass
-    ``max_shapes=1`` to keep the gate to one shape's compiles while the
-    multi-shape coverage still holds on every CPU CI run.
+    (None = all), keeping the LARGEST shapes: a time-boxed on-chip gate
+    (the watcher's, inside a ~5-7 min tunnel window) passes
+    ``max_shapes=1`` and must not be satisfiable by the small shape alone
+    — a shape-dependent miscompile (tiling, padding) hides exactly there
+    (VERDICT r5 weak #5). Multi-shape coverage still holds on every CPU
+    CI run.
 
     Raises ``RuntimeError`` with the failing comparison when the device
     disagrees with the NumPy oracle beyond those tolerances.
@@ -85,11 +96,15 @@ def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True,
     t_start = time.perf_counter()
     device = str(jax.devices()[0])
     backend = jax.default_backend()
-    atol = _ATOL_EXACT if backend == "cpu" else _ATOL_MXU
+    atol = (
+        _ATOL_MXU if backend in _TRUNCATING_BACKENDS else _ATOL_EXACT
+    )
 
     if max_shapes is not None and max_shapes < 1:
         raise ValueError(f"max_shapes must be >= 1 or None, got {max_shapes}")
-    shapes = _SHAPES if max_shapes is None else _SHAPES[:max_shapes]
+    # keep the LARGEST shapes (_SHAPES is ordered ascending): a one-shape
+    # gate must exercise the shape where miscompiles hide, not the cheap one
+    shapes = _SHAPES if max_shapes is None else _SHAPES[-max_shapes:]
     n_row = 1
     if mesh is not None:
         from ..parallel.mesh import ROW_AXIS
@@ -200,6 +215,7 @@ def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True,
         "mesh": None if mesh is None else dict(mesh.shape),
         "n_perm": int(n_perm),
         "n_shapes": len(shapes),
+        "shape_nodes": [n for _, n, _ in shapes],
         "atol": atol,
         "observed_max_abs_dev": obs_dev_max,
         "null_reconstruction_max_abs_dev": null_dev_max,

@@ -1,0 +1,344 @@
+"""Adaptive permutation engine: sequential early stopping
+(ops/sequential.py), retirement re-bucketing (engine.rebucket), and the
+API/results/checkpoint threading.
+
+The oracle tests pin the ISSUE acceptance criteria: on a seeded mixed
+half-preserved/half-random fixture the adaptive run must reach the SAME
+per-module accept/reject decisions at alpha=0.05 as the full-n
+Phipson–Smyth run while evaluating >= 3x fewer total permutations, active
+modules' null rows must match the fixed run's bit-for-bit at the same
+permutation indices (the ``fold_in(key, i)`` RNG contract survives
+re-bucketing), and a checkpoint written mid-run must resume to the same
+final result as an uninterrupted run.
+"""
+
+import numpy as np
+import pytest
+
+from netrep_tpu.data import make_mixed_pair
+from netrep_tpu.ops import pvalues as pv
+from netrep_tpu.ops.sequential import StopMonitor, StopRule
+from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+from netrep_tpu.utils.config import EngineConfig
+
+CFG = EngineConfig(chunk_size=64, summary_method="eigh")
+N_PERM = 1200
+
+
+@pytest.fixture(scope="module")
+def mixed():
+    return make_mixed_pair(320, 6, n_samples=40, seed=7)
+
+
+def _engine(mixed, config=CFG):
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    return PermutationEngine(
+        dc, dn, dd, tc, tn, td, specs, mixed["pool"], config=config
+    )
+
+
+@pytest.fixture(scope="module")
+def runs(mixed):
+    """One fixed + one adaptive run shared by the oracle assertions."""
+    eng = _engine(mixed)
+    observed = np.asarray(eng.observed())
+    nulls_f, done_f = eng.run_null(N_PERM, key=0)
+    eng2 = _engine(mixed)
+    nulls_a, done_a, finished = eng2.run_null_adaptive(
+        N_PERM, observed, key=0
+    )
+    return dict(observed=observed, nulls_f=np.asarray(nulls_f),
+                done_f=done_f, nulls_a=np.asarray(nulls_a), done_a=done_a,
+                finished=finished)
+
+
+# ---------------------------------------------------------------------------
+# StopMonitor / StopRule units
+# ---------------------------------------------------------------------------
+
+def test_stop_rule_validation():
+    with pytest.raises(ValueError, match="h must be"):
+        StopRule(h=0)
+    with pytest.raises(ValueError, match="alpha"):
+        StopRule(alpha=1.5)
+    with pytest.raises(ValueError, match="confidence"):
+        StopRule(confidence=0.2)
+    with pytest.raises(ValueError, match="min_perms"):
+        StopRule(min_perms=0)
+    with pytest.raises(ValueError, match="alternative"):
+        StopMonitor(np.zeros((2, 3)), "sideways", StopRule())
+
+
+def test_two_sided_tallies_are_per_tail_additive():
+    """Two-sided exceedance is min(hi, lo) of the TOTAL tallies: folding
+    per-chunk min-tail counts instead would under-count (min of sums !=
+    sum of mins) — the monitor must keep both tails."""
+    rng = np.random.default_rng(0)
+    obs = np.zeros((2, 3))
+    nulls = rng.standard_normal((96, 2, 3))
+    mon = StopMonitor(obs, "two.sided", StopRule(min_perms=10_000))
+    for i in range(0, 96, 32):
+        mon.update(nulls[i: i + 32], 32)
+    want, _eff = pv.exceedance_counts(obs, nulls, "two.sided")
+    np.testing.assert_array_equal(mon.counts(), want)
+    # one-sided tallies agree with exceedance_counts too
+    mon_g = StopMonitor(obs, "greater", StopRule(min_perms=10_000))
+    mon_g.update(nulls, 96)
+    want_g, _ = pv.exceedance_counts(obs, nulls, "greater")
+    np.testing.assert_array_equal(mon_g.counts(), want_g)
+
+
+def test_monitor_state_roundtrip_and_fixed_checkpoint_rejection():
+    obs = np.zeros((3, 2))
+    mon = StopMonitor(obs, "greater", StopRule(min_perms=8, h=4))
+    mon.update(np.ones((8, 3, 2)), 8)
+    state = mon.state_arrays()
+    mon2 = StopMonitor(obs, "greater", StopRule(min_perms=8, h=4))
+    mon2.restore_state(state)
+    np.testing.assert_array_equal(mon2.hi, mon.hi)
+    np.testing.assert_array_equal(mon2.active, mon.active)
+    assert mon2.folded == mon.folded
+    # a fixed-run checkpoint has no sequential state: informative error
+    with pytest.raises(ValueError, match="non-adaptive"):
+        mon2.restore_state({})
+    # different problem shape: refuse
+    mon3 = StopMonitor(np.zeros((4, 2)), "greater", StopRule())
+    with pytest.raises(ValueError, match="different"):
+        mon3.restore_state(state)
+
+
+def test_nan_observed_cells_never_block_retirement():
+    obs = np.array([[0.0, np.nan]])
+    mon = StopMonitor(obs, "greater", StopRule(h=4, min_perms=8))
+    vals = np.ones((32, 1, 2))  # every draw exceeds the computable cell
+    newly = mon.update(vals, 32)
+    assert newly.tolist() == [0] and not mon.any_active()
+
+
+# ---------------------------------------------------------------------------
+# Oracle: decisions, permutation budget, RNG contract (ISSUE acceptance)
+# ---------------------------------------------------------------------------
+
+def test_adaptive_decisions_match_fixed_at_alpha(runs):
+    """Sequential estimator decisions agree with full-n Phipson–Smyth at
+    alpha=0.05 for every module on the mixed fixture."""
+    p_f = pv.permutation_pvalues(runs["observed"],
+                                 runs["nulls_f"][: runs["done_f"]])
+    p_a, n_used = pv.sequential_pvalues(runs["observed"],
+                                        runs["nulls_a"][: runs["done_a"]])
+    dec_f = np.nanmax(p_f, axis=1) < 0.05
+    dec_a = np.nanmax(p_a, axis=1) < 0.05
+    np.testing.assert_array_equal(dec_f, dec_a)
+    # the fixture separates cleanly: preserved modules significant,
+    # random modules not — so the agreement above is a real decision test
+    assert dec_f.tolist() == [True] * 3 + [False] * 3
+
+
+def test_adaptive_cuts_total_permutations_3x(runs):
+    assert runs["finished"]
+    n_used = pv.effective_nperm(runs["nulls_a"][: runs["done_a"]])
+    total_adaptive = int(n_used.sum())
+    total_fixed = runs["done_f"] * n_used.size
+    assert total_adaptive * 3 <= total_fixed, (total_adaptive, total_fixed)
+    # every module paid at least the rule's floor sample
+    assert (n_used >= StopRule().min_perms).all()
+
+
+def test_rebucketing_preserves_rng_contract(runs):
+    """Active modules' null rows are identical to the fixed run's at the
+    same permutation indices, across every retirement re-bucketing: the
+    per-permutation draw is fold_in(key, i) over the full pool and
+    surviving modules keep their original slice offsets."""
+    n_used = pv.effective_nperm(runs["nulls_a"][: runs["done_a"]])
+    for m, k in enumerate(n_used):
+        np.testing.assert_allclose(
+            runs["nulls_a"][:k, m], runs["nulls_f"][:k, m],
+            rtol=0, atol=1e-12,
+        )
+        # and NaN past retirement — per-module counts are recoverable
+        assert np.isnan(runs["nulls_a"][k:, m]).all()
+
+
+def test_rebucket_validation(mixed):
+    eng = _engine(mixed)
+    with pytest.raises(ValueError, match="at least one"):
+        eng.rebucket([])
+    with pytest.raises(ValueError, match="unknown module positions"):
+        eng.rebucket([99])
+    # restoring the full set leaves the original bucket objects intact
+    eng.rebucket([0, 2])
+    assert sum(len(b.module_pos) for b in eng.buckets) == 2
+    eng.rebucket(range(eng.n_modules))
+    assert sum(len(b.module_pos) for b in eng.buckets) == eng.n_modules
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint / resume
+# ---------------------------------------------------------------------------
+
+def test_adaptive_checkpoint_resume_equals_uninterrupted(mixed, tmp_path):
+    eng = _engine(mixed)
+    observed = np.asarray(eng.observed())
+    ref_nulls, ref_done, ref_fin = _engine(mixed).run_null_adaptive(
+        N_PERM, observed, key=3
+    )
+    assert ref_fin
+
+    ck = str(tmp_path / "adaptive.npz")
+    chunks_seen = []
+
+    def interrupt_after_two(done, total):
+        chunks_seen.append(done)
+        if len(chunks_seen) == 2:
+            raise KeyboardInterrupt
+
+    part_nulls, part_done, part_fin = _engine(mixed).run_null_adaptive(
+        N_PERM, observed, key=3, progress=interrupt_after_two,
+        checkpoint_path=ck, checkpoint_every=64,
+    )
+    assert not part_fin and 0 < part_done < ref_done
+
+    fin_nulls, fin_done, fin_fin = _engine(mixed).run_null_adaptive(
+        N_PERM, observed, key=3, checkpoint_path=ck, checkpoint_every=64,
+    )
+    assert fin_fin and fin_done == ref_done
+    np.testing.assert_allclose(
+        np.asarray(fin_nulls), np.asarray(ref_nulls), rtol=0, atol=1e-12
+    )
+
+
+def test_adaptive_refuses_fixed_run_checkpoint(mixed, tmp_path):
+    ck = str(tmp_path / "fixed.npz")
+    eng = _engine(mixed)
+    observed = np.asarray(eng.observed())
+    eng.run_null(128, key=3, checkpoint_path=ck)
+    with pytest.raises(ValueError, match="non-adaptive"):
+        _engine(mixed).run_null_adaptive(
+            N_PERM, observed, key=3, checkpoint_path=ck
+        )
+
+
+# ---------------------------------------------------------------------------
+# sequential p-values / results threading
+# ---------------------------------------------------------------------------
+
+def test_sequential_pvalues_are_permp_at_module_counts():
+    rng = np.random.default_rng(1)
+    obs = np.array([[0.5, 0.2], [0.1, 0.9]])
+    nulls = rng.uniform(size=(100, 2, 2))
+    nulls[60:, 1] = np.nan  # module 1 retired at 60
+    p, n_used = pv.sequential_pvalues(obs, nulls)
+    assert n_used.tolist() == [100, 60]
+    counts, _ = pv.exceedance_counts(obs, nulls)
+    np.testing.assert_allclose(p[0], pv.permp(counts[0], 100))
+    np.testing.assert_allclose(p[1], pv.permp(counts[1], 60))
+
+
+def test_module_preservation_adaptive_api(toy_pair_module, tmp_path):
+    """adaptive=True through the public API: sequential p_type, per-module
+    n_perm_used recorded, decisions match the fixed run, and the result
+    round-trips through .npz and combine_analyses."""
+    from netrep_tpu import module_preservation
+    from netrep_tpu.data import pair_frames
+    from netrep_tpu.models.results import (
+        PreservationResult, combine_analyses,
+    )
+
+    d, t = pair_frames(toy_pair_module)
+    kw = dict(
+        network={"disc": d["network"], "test": t["network"]},
+        data={"disc": d["data"], "test": t["data"]},
+        correlation={"disc": d["correlation"], "test": t["correlation"]},
+        module_assignments=dict(toy_pair_module["labels"]),
+        discovery="disc", test="test", n_perm=600, seed=11,
+        config=EngineConfig(chunk_size=64),
+    )
+    fixed = module_preservation(**kw)
+    res = module_preservation(**kw, adaptive=True)
+    assert res.p_type == "sequential"
+    assert res.n_perm_used is not None and (res.n_perm_used >= 1).all()
+    assert int(res.n_perm_used.sum()) < fixed.completed * len(res.module_labels)
+    assert res.preserved_modules() == fixed.preserved_modules()
+    assert "n_perm_used" in res.to_frame().columns
+    np.testing.assert_array_equal(res.module_n_perm(), res.n_perm_used)
+    assert (fixed.module_n_perm() == fixed.completed).all()
+
+    path = str(tmp_path / "adaptive_result.npz")
+    res.save(path)
+    back = PreservationResult.load(path)
+    assert back.p_type == "sequential"
+    np.testing.assert_array_equal(back.n_perm_used, res.n_perm_used)
+    np.testing.assert_array_equal(back.nulls, res.nulls)
+
+    other = module_preservation(**{**kw, "seed": 12}, adaptive=True)
+    comb = combine_analyses(res, other)
+    assert comb.p_type == "sequential"
+    np.testing.assert_array_equal(
+        comb.n_perm_used,
+        pv.effective_nperm(comb.nulls),
+    )
+    # pooled counts are the sum of the inputs' per-module counts
+    np.testing.assert_array_equal(
+        comb.n_perm_used, res.n_perm_used + other.n_perm_used
+    )
+
+
+def test_adaptive_rejects_native_backend(toy_pair_module):
+    from netrep_tpu import module_preservation
+    from netrep_tpu.data import pair_frames
+
+    d, t = pair_frames(toy_pair_module)
+    with pytest.raises(ValueError, match="adaptive=True requires"):
+        module_preservation(
+            network={"disc": d["network"], "test": t["network"]},
+            correlation={"disc": d["correlation"],
+                         "test": t["correlation"]},
+            module_assignments=dict(toy_pair_module["labels"]),
+            discovery="disc", test="test", n_perm=10,
+            backend="native", adaptive=True,
+        )
+
+
+def test_multitest_adaptive_matches_fixed_decisions():
+    """MultiTestEngine.run_null_adaptive: a module retires only when
+    decided in every cohort; active rows match the fixed multitest run."""
+    from netrep_tpu.parallel.multitest import MultiTestEngine
+
+    mixed = make_mixed_pair(200, 4, n_samples=36, seed=5)
+    (dd, dc, dn) = mixed["discovery"]
+    (td, tc, tn) = mixed["test"]
+    # second cohort: an independently-seeded test side, same node universe
+    (td2, tc2, tn2) = make_mixed_pair(200, 4, n_samples=36, seed=6)["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    cfg = EngineConfig(chunk_size=64, summary_method="eigh")
+
+    def make():
+        return MultiTestEngine(
+            dc, dn, dd, np.stack([tc, tc2]), np.stack([tn, tn2]),
+            [td, td2], specs, mixed["pool"], config=cfg,
+        )
+
+    eng = make()
+    observed = np.asarray(eng.observed())       # (2, K, 7)
+    nulls_f, done_f = eng.run_null(600, key=0)
+    nulls_a, done_a, finished = make().run_null_adaptive(
+        600, observed, key=0
+    )
+    assert finished
+    nulls_f, nulls_a = np.asarray(nulls_f), np.asarray(nulls_a)
+    for ti in range(2):
+        p_f = pv.permutation_pvalues(observed[ti], nulls_f[ti, :done_f])
+        p_a, n_used = pv.sequential_pvalues(observed[ti],
+                                            nulls_a[ti, :done_a])
+        np.testing.assert_array_equal(
+            np.nanmax(p_f, axis=1) < 0.05, np.nanmax(p_a, axis=1) < 0.05
+        )
+        for m, k in enumerate(n_used):
+            np.testing.assert_allclose(
+                nulls_a[ti, :k, m], nulls_f[ti, :k, m], rtol=0, atol=1e-12
+            )
+    total = pv.effective_nperm(
+        np.moveaxis(nulls_a[:, :done_a], 0, 2).reshape(done_a, 4, -1)
+    ).sum()
+    assert total < done_f * 4  # strictly less work than fixed

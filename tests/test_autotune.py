@@ -1,0 +1,175 @@
+"""Persistent per-(backend, bucket-caps, chunk) autotune cache
+(utils/autotune.py): record/reuse semantics, corruption tolerance, and the
+engine integration — the null loop must record measured steady-state
+throughput and the next engine build with the same problem shape must
+reuse the best-measured perm batch instead of the byte-budget heuristic.
+Tuning never changes values: the default path stays bit-identical.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from netrep_tpu.utils import autotune
+from netrep_tpu.utils.autotune import AutotuneCache, make_key, resolve_perm_batch
+from netrep_tpu.utils.config import EngineConfig
+
+
+def test_record_and_best_setting(tmp_path):
+    cache = AutotuneCache(str(tmp_path / "at.json"))
+    key = make_key("cpu", "direct", "32x2", 64)
+    assert cache.best_setting(key) is None
+    cache.record(key, 8, 100.0)
+    cache.record(key, 16, 300.0)
+    cache.record(key, 16, 200.0)
+    assert cache.best_setting(key) == 16
+    # median beats a single lucky sample: three slow measurements for 32
+    # with one outlier must not overtake 16's median
+    cache.record(key, 32, 9000.0)
+    cache.record(key, 32, 50.0)
+    cache.record(key, 32, 60.0)
+    assert cache.best_setting(key) == 16
+    assert cache.throughput(key, 16) == [300.0, 200.0]
+
+
+def test_sample_window_bounded(tmp_path):
+    cache = AutotuneCache(str(tmp_path / "at.json"))
+    key = make_key("cpu", "direct", "32x1", 64)
+    for i in range(20):
+        cache.record(key, 4, float(i + 1))
+    assert len(cache.throughput(key, 4)) == autotune._KEEP
+
+
+def test_corrupt_or_foreign_file_treated_as_empty(tmp_path):
+    path = str(tmp_path / "at.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    cache = AutotuneCache(path)
+    assert cache.best_setting("anything") is None
+    cache.record("k", 2, 10.0)  # recovers by rewriting
+    assert cache.best_setting("k") == 2
+    with open(path, "w") as f:
+        json.dump({"format": 999, "entries": {"k": {"2": [1.0]}}}, f)
+    assert AutotuneCache(path).best_setting("k") is None
+
+
+def test_resolve_perm_batch_contract(tmp_path, monkeypatch):
+    monkeypatch.setattr(autotune, "default_path",
+                        lambda: str(tmp_path / "at.json"))
+    key = make_key("cpu", "mxu", "64x3", 128)
+    # autotune off: heuristic, nothing recorded
+    pb, cache = resolve_perm_batch(EngineConfig(autotune=False), key, 4)
+    assert pb == 4 and cache is None
+    # autotune on, empty cache: heuristic, but a recording handle
+    pb, cache = resolve_perm_batch(EngineConfig(), key, 4)
+    assert pb == 4 and cache is not None
+    # a better-measured setting overrides the heuristic
+    cache.record(key, 4, 50.0)
+    cache.record(key, 12, 400.0)
+    pb, _ = resolve_perm_batch(EngineConfig(), key, 4)
+    assert pb == 12
+    # an explicit perm_batch is honored (rides in as the resolved value)
+    # while keeping the recording handle so sweeps feed the cache
+    pb, cache = resolve_perm_batch(EngineConfig(perm_batch=2), key, 2)
+    assert pb == 2 and cache is not None
+
+
+@pytest.fixture
+def toy_engine_parts():
+    from netrep_tpu.parallel.engine import ModuleSpec
+
+    rng = np.random.default_rng(0)
+    n, s = 96, 24
+    x = rng.standard_normal((s, n)).astype(np.float32)
+    c = np.corrcoef(x, rowvar=False).astype(np.float32)
+    np.fill_diagonal(c, 1.0)
+    net = (np.abs(c) ** 2).astype(np.float32)
+    specs = [
+        ModuleSpec("1", np.arange(0, 12, dtype=np.int32),
+                   np.arange(0, 12, dtype=np.int32)),
+        ModuleSpec("2", np.arange(12, 30, dtype=np.int32),
+                   np.arange(12, 30, dtype=np.int32)),
+    ]
+    pool = np.arange(n, dtype=np.int32)
+    return (c, net, x), specs, pool
+
+
+def _build(parts, config):
+    from netrep_tpu.parallel.engine import PermutationEngine
+
+    (c, net, x), specs, pool = parts
+    return PermutationEngine(c, net, x, c, net, x, specs, pool,
+                             config=config)
+
+
+def test_engine_records_and_reuses_measured_throughput(
+    toy_engine_parts, tmp_path, monkeypatch
+):
+    monkeypatch.setattr(autotune, "default_path",
+                        lambda: str(tmp_path / "at.json"))
+    cfg = EngineConfig(chunk_size=16, summary_method="eigh")
+    eng = _build(toy_engine_parts, cfg)
+    eng.run_null(64, key=0)  # 4 chunks: enough steady-state marks
+    assert eng._autotune_record is not None
+    cache, key, pb = eng._autotune_record
+    assert key == eng.autotune_key()
+    samples = cache.throughput(key, pb)
+    assert samples and all(v > 0 for v in samples)
+    # a (synthetic) better setting recorded for the SAME key is what the
+    # next engine build resolves — the heuristic is no longer re-derived
+    cache.record(key, 7, samples[0] * 1000)
+    eng2 = _build(toy_engine_parts, cfg)
+    eng2.chunk_body()
+    assert eng2._autotune_record[2] == 7
+
+
+def test_autotune_empty_cache_is_bit_identical(toy_engine_parts, tmp_path,
+                                               monkeypatch):
+    """With nothing measured yet the heuristic runs unchanged — the
+    default path stays bit-identical to a run with autotune disabled."""
+    monkeypatch.setattr(autotune, "default_path",
+                        lambda: str(tmp_path / "at.json"))
+    base_cfg = EngineConfig(chunk_size=16, summary_method="eigh",
+                            autotune=False)
+    nulls_off, done = _build(toy_engine_parts, base_cfg).run_null(48, key=1)
+    nulls_on, done_on = _build(
+        toy_engine_parts, EngineConfig(chunk_size=16, summary_method="eigh")
+    ).run_null(48, key=1)
+    assert done == done_on
+    np.testing.assert_array_equal(np.asarray(nulls_off),
+                                  np.asarray(nulls_on))
+
+
+def test_autotuned_batch_drifts_only_at_float_rounding(toy_engine_parts,
+                                                       tmp_path,
+                                                       monkeypatch):
+    """Reusing a measured batch re-partitions lax.map — accumulation-order
+    drift at f32 rounding level only (the docstring's honest claim)."""
+    monkeypatch.setattr(autotune, "default_path",
+                        lambda: str(tmp_path / "at.json"))
+    base_cfg = EngineConfig(chunk_size=16, summary_method="eigh",
+                            autotune=False)
+    nulls_off, _ = _build(toy_engine_parts, base_cfg).run_null(48, key=1)
+    eng = _build(toy_engine_parts, EngineConfig(chunk_size=16,
+                                                summary_method="eigh"))
+    AutotuneCache().record(eng.autotune_key(), 3, 1e9)
+    nulls_on, _ = eng.run_null(48, key=1)
+    assert eng._autotune_record[2] == 3
+    np.testing.assert_allclose(np.asarray(nulls_off), np.asarray(nulls_on),
+                               rtol=2e-6, atol=2e-7)
+
+
+def test_unwritable_cache_dir_is_nonfatal(tmp_path):
+    blocked = tmp_path / "blocked"
+    blocked.mkdir()
+    os.chmod(blocked, 0o500)
+    try:
+        cache = AutotuneCache(str(blocked / "sub" / "at.json"))
+        cache.record("k", 2, 10.0)  # must not raise, whatever happens
+        # root ignores the mode bits, so the write may have succeeded —
+        # only the no-crash behavior is the contract here
+        assert cache.best_setting("k") in (None, 2)
+    finally:
+        os.chmod(blocked, 0o700)

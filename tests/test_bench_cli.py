@@ -42,6 +42,7 @@ CASES = [
     # speedup claims divide by; not in the watcher queue (needs no TPU)
     # but a silent break would cost the baseline side of every comparison
     ["--config", "oracle"],
+    ["--config", "adaptive"],
 ]
 
 
@@ -200,6 +201,24 @@ def test_tune_sweep_runs_end_to_end_on_cpu():
     assert best and best[-1]["best"] is not None, proc.stdout[-2000:]
     ok_points = [l for l in lines if l.get("ok")]
     assert len(ok_points) >= 12, (len(ok_points), proc.stdout[-2000:])
+
+
+@pytest.mark.slow
+def test_bench_adaptive_row_reports_both_passes():
+    """The adaptive config's one-row contract (ISSUE r6 acceptance): the
+    sequential-stopping wall-clock AND permutations-evaluated land beside
+    the fixed-n numbers, with the decision-agreement verdict."""
+    proc = _run_cpu_subprocess(
+        [sys.executable, "bench.py", "--config", "adaptive", "--smoke"],
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["perms_evaluated_adaptive"] < row["perms_evaluated_fixed"]
+    assert row["perm_reduction_x"] > 1.0
+    assert row["value"] > 0 and row["fixed_s"] > 0
+    assert row["decisions_agree_at_alpha05"] is True
+    assert len(row["n_perm_used"]) > 0
 
 
 def test_bench_shield_always_emits_a_row_on_hang():

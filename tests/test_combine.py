@@ -229,8 +229,11 @@ def test_to_frame_and_results_table(two_runs):
     f = a.to_frame()
     assert list(f.columns) == ["discovery", "test", "module", "statistic",
                                "observed", "p_value", "n_vars_present",
-                               "prop_vars_present", "total_size"]
+                               "prop_vars_present", "total_size",
+                               "n_perm_used"]
     assert len(f) == len(a.module_labels) * 7
+    # fixed runs report the shared completed count per module
+    assert (f.n_perm_used == a.completed).all()
     # a specific cell matches the wide frames
     row = f[(f.module == a.module_labels[0]) & (f.statistic == "avg.weight")]
     assert float(row.observed.iloc[0]) == a.observed[0, 0]

@@ -49,6 +49,15 @@ def test_bench_refuses_missing_or_insufficient_variants_on_accelerator(
         # the same execution (review r5 — count alone is not enforcement)
         dup = ([1.0],)
         bench(lambda x: x, reps=3, warmup=2, variants=[dup] * 5)
+    with pytest.raises(RuntimeError, match="value-distinct"):
+        # distinct objects but identical VALUES (ADVICE r5): .copy()
+        # variants pass an id check while the tunnel still short-circuits
+        # the repeated execution — the value digest must reject them
+        import numpy as np
+
+        base = np.arange(6, dtype=np.float32)
+        bench(lambda x: x, reps=3, warmup=2,
+              variants=[(base.copy(),) for _ in range(5)])
 
 
 def test_bench_timed_calls_distinct_and_disjoint_from_warmup(monkeypatch):

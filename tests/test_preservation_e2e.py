@@ -23,11 +23,13 @@ except Exception:
 CFG = EngineConfig(chunk_size=64, summary_method="power", power_iters=50)
 
 
-# the pandas packaging and the shared 250-perm `result` fixture live in
-# conftest.py (session-scoped: one engine pass serves every API-surface
-# test; its kwargs — n_perm=250, seed=123, chunk 64, power summary —
-# are what the assertions below pin)
-from conftest import pair_frames as _frames  # noqa: E402
+# the shared 250-perm `result` fixture lives in conftest.py
+# (session-scoped: one engine pass serves every API-surface test; its
+# kwargs — n_perm=250, seed=123, chunk 64, power summary — are what the
+# assertions below pin). The pandas packaging helper is a package import
+# (ADVICE r5: `from conftest import ...` relies on pytest's prepend import
+# mode and dies under importmode=importlib).
+from netrep_tpu.data import pair_frames as _frames  # noqa: E402
 
 
 def test_simplified_single_pair(result):
