@@ -27,6 +27,11 @@ BASELINE.md):
                      evaluated for each, and decision agreement at
                      alpha=0.05 (measurable on CPU; clamped north-star
                      shape)
+    --config superchunk  streaming executor (store_nulls=False): scan-fused
+                     superchunk dispatch + on-device exceedance tallies vs
+                     the fixed-n chunk loop on the same problem/key — one
+                     row with both wall-clocks, dispatches issued, and
+                     device→host bytes (counts parity asserted first)
     --config oracle  pure-NumPy oracle (the reference-style CPU loop) on the
                      north-star problem shape at a reduced permutation count
                      (default 50) — the per-config "oracle-CPU" baseline row;
@@ -745,6 +750,99 @@ def bench_adaptive(args):
     })
 
 
+def bench_superchunk(args):
+    """Superchunk streaming executor (``store_nulls=False``) vs the fixed-n
+    chunk loop on the SAME problem and key: one row carrying both
+    wall-clocks plus the dispatch and device→host-byte counters
+    (``utils.profiling.NullProfile``) for each side — the measured form of
+    the ISSUE-2 acceptance criteria (≥2× fewer dispatches, ≥10× lower
+    transfer volume, wall-clock no worse on the CPU fallback). The
+    streamed tallies are asserted equal to the materialized null's
+    exceedance counts before any number is emitted, so a fast-but-wrong
+    row is impossible. Adaptive-row comparability: same mixed fixture as
+    ``--config adaptive``."""
+    import jax
+
+    from netrep_tpu.data import make_mixed_pair
+    from netrep_tpu.ops import pvalues as pv
+    from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+    from netrep_tpu.utils.config import EngineConfig
+    from netrep_tpu.utils.profiling import NullProfile
+
+    resolve(args, 2000, 16, 4000)
+    if args.smoke:
+        args.genes, args.modules, args.perms = 400, 6, 600
+    superchunk = 8
+    mixed = make_mixed_pair(
+        args.genes, args.modules, n_samples=args.samples, seed=7
+    )
+    (d_data, d_corr, d_net) = mixed["discovery"]
+    (t_data, t_corr, t_net) = mixed["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    cfg = EngineConfig(chunk_size=args.chunk, power_iters=40,
+                       gather_mode=args.gather_mode, superchunk=superchunk)
+
+    def make_engine():
+        return PermutationEngine(
+            d_corr, d_net, d_data, t_corr, t_net, t_data, specs,
+            mixed["pool"], config=cfg,
+        )
+
+    fixed_eng = make_engine()
+    observed = np.asarray(fixed_eng.observed())
+    _ = fixed_eng.run_null(cfg.chunk_size, key=99)  # compile warm-up
+    prof_fixed = NullProfile()
+    t0 = time.perf_counter()
+    nulls_f, done_f = fixed_eng.run_null(args.perms, key=0,
+                                         profile=prof_fixed)
+    fixed_s = time.perf_counter() - t0
+    assert done_f == args.perms
+
+    stream_eng = make_engine()
+    _ = stream_eng.run_null_streaming(  # compile warm-up (distinct key)
+        superchunk * cfg.chunk_size, observed, key=99
+    )
+    prof_stream = NullProfile()
+    t0 = time.perf_counter()
+    sc = stream_eng.run_null_streaming(args.perms, observed, key=0,
+                                       profile=prof_stream)
+    stream_s = time.perf_counter() - t0
+    assert sc.completed == args.perms
+
+    # parity gate: streamed tallies == materialized exceedance counts
+    hi, lo, eff = pv.tail_counts(observed, np.asarray(nulls_f)[:done_f])
+    assert (sc.hi == hi).all() and (sc.lo == lo).all() and \
+        (sc.eff == eff).all(), "streaming/materialized count mismatch"
+
+    return emit({
+        "metric": (
+            f"superchunk streaming executor (store_nulls=False, "
+            f"superchunk={superchunk}) vs fixed-n chunk loop, "
+            f"{args.genes} genes / {args.modules} modules, "
+            f"{args.perms} perms, chunk {args.chunk}"
+        ),
+        "value": round(stream_s, 3),
+        "unit": "s",
+        "vs_baseline": round(fixed_s / stream_s, 3),  # speedup over fixed
+        "fixed_s": round(fixed_s, 3),
+        "stream_perms_per_sec": round(args.perms / stream_s, 2),
+        "fixed_perms_per_sec": round(args.perms / fixed_s, 2),
+        "dispatches_stream": prof_stream.dispatches,
+        "dispatches_fixed": prof_fixed.dispatches,
+        "dispatch_reduction_x": round(
+            prof_fixed.dispatches / max(prof_stream.dispatches, 1), 2
+        ),
+        "host_bytes_stream": prof_stream.host_bytes,
+        "host_bytes_fixed": prof_fixed.host_bytes,
+        "transfer_reduction_x": round(
+            prof_fixed.host_bytes / max(prof_stream.host_bytes, 1), 2
+        ),
+        "counts_parity": True,  # asserted above
+        "device": str(jax.devices()[0]),
+        "chunk": args.chunk,
+    })
+
+
 def run_shielded(args):
     """Round-2's failure mode, second line of defense: a tunnel death
     MID-RUN leaves device calls blocked in gRPC with no deadline — the
@@ -834,7 +932,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="north",
                     choices=["north", "A", "B", "C", "D", "E", "oracle",
-                             "native", "sharded", "adaptive"])
+                             "native", "sharded", "adaptive", "superchunk"])
     ap.add_argument("--genes", type=int, default=None)
     ap.add_argument("--modules", type=int, default=None)
     ap.add_argument("--perms", type=int, default=None)
@@ -867,7 +965,7 @@ def main():
     from netrep_tpu.utils.backend import tunnel_expected
 
     if (args.config in ("north", "A", "B", "C", "D", "E", "sharded",
-                        "adaptive")
+                        "adaptive", "superchunk")
             and tunnel_expected()
             and not os.environ.get("NETREP_BENCH_NO_SUBPROC")):
         # every config that may touch the tunnel backend (A runs the JAX
@@ -916,7 +1014,7 @@ def main():
     return {
         "north": bench_north, "A": bench_a, "B": bench_b,
         "C": bench_c, "D": bench_d, "E": bench_e, "oracle": bench_oracle,
-        "adaptive": bench_adaptive,
+        "adaptive": bench_adaptive, "superchunk": bench_superchunk,
     }[args.config](args)
 
 

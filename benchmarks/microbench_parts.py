@@ -164,6 +164,72 @@ def fused_parity(M, M16, idx, B, K, cap, n, reps=5, FL=None, time_it=True,
     return True
 
 
+def dispatch_overhead(n: int, cap: int, K: int, B: int, reps: int,
+                      fuse: int = 8):
+    """Dispatch-amortization microbench (ISSUE 2): the SAME per-chunk
+    computation issued as ``fuse`` separate jitted dispatches vs ONE
+    ``lax.scan``-fused dispatch of all ``fuse`` chunks — the isolated
+    measurement of what the superchunk executor saves per backend (on the
+    tunneled TPU backend each dispatch costs ~1 s of host round-trip; on
+    CPU the gap is Python/jit-call overhead only). The chunk body mirrors
+    the engine's hot shape (row gather + one-hot column-select matmul +
+    reduce) without its full statistics, keeping the sweep inside a
+    tunnel window. Prints per-chunk ms for both and the overhead delta."""
+    key = jax.random.key(7)
+    M = jax.random.normal(key, (n, n), dtype=jnp.float32)
+
+    def chunk_body(ix):
+        rows = jnp.take(M, ix, axis=0)           # (B, K, cap, n)
+        oh = (
+            jax.lax.broadcasted_iota(jnp.int32, (B, K, n, cap), 2)
+            == ix[:, :, None, :]
+        ).astype(jnp.float32)
+        sub = jnp.matmul(rows, oh, preferred_element_type=jnp.float32)
+        return sub.sum(axis=(2, 3))              # (B, K) reduce → tiny out
+
+    one = jax.jit(chunk_body)
+
+    @jax.jit
+    def fused(ix_stack):                          # (fuse, B, K, cap)
+        def body(carry, ix):
+            return carry + chunk_body(ix).sum(), None
+        out, _ = jax.lax.scan(body, jnp.float32(0), ix_stack)
+        return out
+
+    def make_idx(seed):
+        return jnp.sort(jax.random.randint(
+            jax.random.key(seed), (B, K, cap), 0, n, dtype=jnp.int32
+        ), axis=-1)
+
+    n_var = max(1, reps) + DEFAULT_WARMUP
+    # each variant: fuse distinct chunk index sets — pre-split for the
+    # serial path (an eager per-call slice would add dispatches the real
+    # chunk loop does not issue) and pre-stacked for the fused path
+    groups = [
+        [make_idx(1000 + v * fuse + j) for j in range(fuse)]
+        for v in range(n_var)
+    ]
+    stacks = [jnp.stack(g) for g in groups]
+
+    def serial(*ixs):
+        out = None
+        for ix in ixs:  # fuse separate dispatches
+            out = one(ix)
+        return out
+
+    t_serial = bench(serial, *groups[0], reps=reps,
+                     variants=[tuple(g) for g in groups])
+    t_fused = bench(fused, stacks[0], reps=reps,
+                    variants=[(s,) for s in stacks])
+    per_serial = t_serial / fuse * 1e3
+    per_fused = t_fused / fuse * 1e3
+    print(f"dispatch overhead ({fuse} chunks): separate "
+          f"{t_serial*1e3:8.2f} ms ({per_serial:6.2f} ms/chunk)  "
+          f"scan-fused {t_fused*1e3:8.2f} ms ({per_fused:6.2f} ms/chunk)  "
+          f"amortization {per_serial/per_fused:5.2f}x, "
+          f"{per_serial-per_fused:6.2f} ms/chunk saved")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--genes", type=int, default=20_000)
@@ -302,6 +368,10 @@ def main():
     # The decision row for flipping gather_mode auto to 'fused' on TPU.
     fused_parity(M, M16, idx, B, K, cap, n, reps=args.reps, FL=FL,
                  idx_variants=idxs)
+
+    # 1-vs-K dispatch amortization: the superchunk executor's win, pinned
+    # per backend (ISSUE 2 — dispatch-overhead microbench)
+    dispatch_overhead(n, cap, K, B, args.reps)
 
     # correctness check of selection variants vs true gather
     sub_true = np.asarray(M)[np.asarray(idx)[0, 0][:, None], np.asarray(idx)[0, 0][None, :]]

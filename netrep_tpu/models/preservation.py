@@ -15,6 +15,7 @@ because XLA owns device parallelism (SURVEY.md §2.3 intra-op row).
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 from typing import Callable
 
@@ -64,8 +65,21 @@ def _overlap_setup(disc_ds, test_ds, assignments, modules, background_label, nul
 
 def _make_result(d_name, t_name, labels, counts, observed, nulls, completed,
                  np_this, alternative, total_space, profile=None,
-                 p_type="fixed"):
-    if p_type == "sequential":
+                 p_type="fixed", stream=None):
+    hi = lo = eff = None
+    if stream is not None:
+        # streaming run (store_nulls=False): exact Phipson–Smyth from the
+        # device-tallied exceedance counts — identical to the materialized
+        # path's p-values for the same key (ops.pvalues.counts_pvalues)
+        p_values = pv.counts_pvalues(
+            observed, stream.hi, stream.lo, stream.eff, alternative,
+            total_nperm=total_space,
+        )
+        hi, lo, eff = stream.hi, stream.lo, stream.eff
+        n_perm_used = (
+            np.asarray(stream.n_perm_used) if p_type == "sequential" else None
+        )
+    elif p_type == "sequential":
         # adaptive run: retired modules' null rows are NaN past their
         # retirement — Phipson–Smyth at each module's own count
         p_values, n_perm_used = pv.sequential_pvalues(
@@ -86,6 +100,9 @@ def _make_result(d_name, t_name, labels, counts, observed, nulls, completed,
         module_labels=labels,
         observed=observed,
         nulls=nulls,
+        counts_hi=hi,
+        counts_lo=lo,
+        counts_eff=eff,
         p_values=p_values,
         n_vars_present=n_present,
         prop_vars_present=n_present / tot,
@@ -126,6 +143,7 @@ def module_preservation(
     profile=None,
     adaptive: bool = False,
     adaptive_rule=None,
+    store_nulls: bool = True,
 ):
     """Permutation test of network module preservation across datasets.
 
@@ -164,6 +182,18 @@ def module_preservation(
       :class:`~netrep_tpu.ops.sequential.StopRule` overriding the stopping
       knobs (exceedance budget ``h``, decision ``alpha``, CP interval
       ``confidence``, ``min_perms`` floor).
+    - ``store_nulls`` — ``False`` streams the null: the engine fuses
+      ``config.superchunk`` chunks per device dispatch (``jax.lax.scan``)
+      and folds per-(module, statistic) exceedance tallies on device, so
+      only O(modules·7) counts ever reach the host — ~superchunk× fewer
+      dispatches, ~chunk× less device→host traffic, and host memory
+      independent of ``n_perm``. P-values are the identical exact
+      Phipson–Smyth numbers (they only ever need the counts); the result
+      carries ``counts_hi/counts_lo/counts_eff`` and ``nulls=None``, so
+      keep the default ``True`` when you want the materialized null for
+      plots or diagnostics. Composes with ``adaptive`` (decisions are
+      bit-identical to the materialized adaptive run) and ``vmap_tests``;
+      requires the default ``backend='jax'``.
     - ``profile`` — tracing/profiling (SURVEY.md §5; the reference offers
       only ``verbose=`` + ``system.time``): ``True`` captures a
       ``jax.profiler`` trace under ``./netrep_profile``, a string names the
@@ -190,6 +220,12 @@ def module_preservation(
         raise ValueError(
             "adaptive=True requires backend='jax' (the native C++ tier has "
             "no retirement re-bucketing); run it fixed-n or switch backends"
+        )
+    if not store_nulls and backend != "jax":
+        raise ValueError(
+            "store_nulls=False requires backend='jax' (the streaming "
+            "tallies are folded on device inside the scan-fused dispatch); "
+            "run the native backend with store_nulls=True"
         )
     if backend == "native":
         # the threaded C++ permutation procedure (netrep_tpu/native) — the
@@ -244,7 +280,7 @@ def module_preservation(
             alternative, n_perm, auto_n_perm, engine_cls, config, mesh,
             vmap_tests, backend, seed, progress, ckpt_path, checkpoint_every,
             verbose, simplify, results, trace_dir, profiling,
-            adaptive, adaptive_rule,
+            adaptive, adaptive_rule, store_nulls,
         )
     finally:
         trace_cm.__exit__(None, None, None)
@@ -254,28 +290,44 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                alternative, n_perm, auto_n_perm, engine_cls, config, mesh,
                vmap_tests, backend, seed, progress, ckpt_path,
                checkpoint_every, verbose, simplify, results, trace_dir,
-               profiling, adaptive=False, adaptive_rule=None):
+               profiling, adaptive=False, adaptive_rule=None,
+               store_nulls=True):
     """Pair-loop body of :func:`module_preservation` (split out so the
     profiler trace context can bracket it without deep nesting)."""
 
     def run_pair_null(engine, np_this, observed, prog, ck):
         """One pair's null: fixed (default, bit-identical to previous
-        releases) or adaptive sequential early-stopping. Returns
-        ``(nulls, completed, interrupted)`` — adaptive runs legitimately
-        complete below ``np_this`` when every module retires, so the
-        interrupt signal comes from the loop, not the count."""
+        releases) or adaptive sequential early-stopping, each materialized
+        (store_nulls=True) or streaming. Returns ``(nulls, stream,
+        completed, interrupted)`` — exactly one of ``nulls``/``stream`` is
+        set; adaptive runs legitimately complete below ``np_this`` when
+        every module retires, so the interrupt signal comes from the loop,
+        not the count."""
+        if not store_nulls:
+            if adaptive:
+                sc = engine.run_null_adaptive_streaming(
+                    np_this, observed, key=seed, alternative=alternative,
+                    rule=adaptive_rule, progress=prog, checkpoint_path=ck,
+                    checkpoint_every=checkpoint_every,
+                )
+                return None, sc, sc.completed, not sc.finished
+            sc = engine.run_null_streaming(
+                np_this, observed, key=seed, progress=prog,
+                checkpoint_path=ck, checkpoint_every=checkpoint_every,
+            )
+            return None, sc, sc.completed, sc.completed < np_this
         if adaptive:
             nulls, completed, finished = engine.run_null_adaptive(
                 np_this, observed, key=seed, alternative=alternative,
                 rule=adaptive_rule, progress=prog, checkpoint_path=ck,
                 checkpoint_every=checkpoint_every,
             )
-            return nulls, completed, not finished
+            return nulls, None, completed, not finished
         nulls, completed = engine.run_null(
             np_this, key=seed, progress=prog, checkpoint_path=ck,
             checkpoint_every=checkpoint_every,
         )
-        return nulls, completed, completed < np_this
+        return nulls, None, completed, completed < np_this
 
     def pair_progress():
         # verbose=True with no user callback gets the reference-style
@@ -334,7 +386,7 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                 timer.time_observed(engine.observed) if timer
                 else engine.observed()
             )
-            nulls, completed, interrupted = run_pair_null(
+            nulls, stream, completed, interrupted = run_pair_null(
                 engine, np_this, observed,
                 (timer.wrap_progress(pair_progress())
                  if timer else pair_progress()),
@@ -351,9 +403,19 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
             for ti, t_name in enumerate(t_names):
                 results.setdefault(d_name, {})[t_name] = _make_result(
                     d_name, t_name, labels, counts, observed[ti],
-                    nulls[ti], completed, np_this, alternative, total_space,
+                    None if nulls is None else nulls[ti],
+                    completed, np_this, alternative, total_space,
                     profile=prof_dict,  # one vmapped run → shared timings
                     p_type="sequential" if adaptive else "fixed",
+                    # streamed tallies carry the T axis; each pair's result
+                    # gets its own (n_modules, 7) slice
+                    stream=(
+                        None if stream is None
+                        else dataclasses.replace(
+                            stream, hi=stream.hi[ti], lo=stream.lo[ti],
+                            eff=stream.eff[ti],
+                        )
+                    ),
                 )
             continue
 
@@ -379,7 +441,7 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                 timer.time_observed(engine.observed) if timer
                 else engine.observed()
             )
-            nulls, completed, was_interrupted = run_pair_null(
+            nulls, stream, completed, was_interrupted = run_pair_null(
                 engine, np_this, observed,
                 (timer.wrap_progress(pair_progress())
                  if timer else pair_progress()),
@@ -391,6 +453,7 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                 np_this, alternative, total_space,
                 profile=timer.finish_null(completed) if timer else None,
                 p_type="sequential" if adaptive else "fixed",
+                stream=stream,
             )
             if was_interrupted:
                 # Ctrl-C aborts the whole multi-pair run, not just the

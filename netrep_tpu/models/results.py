@@ -37,7 +37,9 @@ class PreservationResult:
     test: str
     module_labels: list[str]
     observed: np.ndarray          # (n_modules, 7)
-    nulls: np.ndarray             # (n_perm, n_modules, 7)
+    nulls: np.ndarray | None      # (n_perm, n_modules, 7); None for
+                                  # streaming (store_nulls=False) runs —
+                                  # the exceedance tallies below replace it
     p_values: np.ndarray          # (n_modules, 7)
     n_vars_present: np.ndarray    # (n_modules,)
     prop_vars_present: np.ndarray
@@ -64,6 +66,16 @@ class PreservationResult:
                                   # 'sequential' (Besag–Clifford early
                                   # stopping; p-values are Phipson–Smyth at
                                   # each module's own n_perm_used)
+    counts_hi: np.ndarray | None = None  # (n_modules, 7) null draws >= observed
+    counts_lo: np.ndarray | None = None  # (n_modules, 7) null draws <= observed
+    counts_eff: np.ndarray | None = None  # (n_modules, 7) valid draws per cell
+                                  # — the streaming (store_nulls=False)
+                                  # run's sufficient statistics: p-values
+                                  # are ops.pvalues.counts_pvalues of
+                                  # these, and combine_analyses pools them
+                                  # when no null array exists. None on
+                                  # store_nulls=True runs (the null array
+                                  # carries strictly more information).
 
     @property
     def stat_names(self) -> tuple[str, ...]:
@@ -178,11 +190,19 @@ class PreservationResult:
                 else float(self.total_space)
             ),
             "p_type": self.p_type,
+            # streaming (store_nulls=False) results have no null array —
+            # the flag (additive key, same format version) tells load() to
+            # restore nulls=None instead of the empty placeholder below
+            "store_nulls": self.nulls is not None,
         }
         extra = (
             {} if self.n_perm_used is None
             else {"n_perm_used": np.asarray(self.n_perm_used)}
         )
+        for name in ("counts_hi", "counts_lo", "counts_eff"):
+            val = getattr(self, name)
+            if val is not None:
+                extra[name] = np.asarray(val)
         atomic_savez(
             path,
             **extra,
@@ -192,7 +212,10 @@ class PreservationResult:
             result_version=np.int64(self._SAVE_VERSION),
             meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
             observed=self.observed,
-            nulls=self.nulls,
+            nulls=(
+                self.nulls if self.nulls is not None
+                else np.zeros((0,) + self.observed.shape)
+            ),
             p_values=self.p_values,
             n_vars_present=self.n_vars_present,
             prop_vars_present=self.prop_vars_present,
@@ -223,7 +246,16 @@ class PreservationResult:
                 test=meta["test"],
                 module_labels=[str(l) for l in meta["module_labels"]],
                 observed=z["observed"],
-                nulls=z["nulls"],
+                # store_nulls=False results persist an empty placeholder;
+                # files from before the flag existed always carried nulls
+                nulls=(
+                    z["nulls"] if meta.get("store_nulls", True) else None
+                ),
+                counts_hi=z["counts_hi"] if "counts_hi" in z.files else None,
+                counts_lo=z["counts_lo"] if "counts_lo" in z.files else None,
+                counts_eff=(
+                    z["counts_eff"] if "counts_eff" in z.files else None
+                ),
                 p_values=z["p_values"],
                 n_vars_present=z["n_vars_present"],
                 prop_vars_present=z["prop_vars_present"],
@@ -267,6 +299,17 @@ def combine_analyses(*analyses, allow_duplicate_nulls: bool = False):
     Identical null blocks across inputs (the same seed run twice) would
     silently double-count correlated permutations, biasing p-values; this is
     detected via a content hash and raises unless ``allow_duplicate_nulls``.
+
+    Streaming results (``store_nulls=False``) combine too: when any input
+    lacks a null array, every input is lifted into count space
+    (:func:`netrep_tpu.ops.pvalues.tail_counts` for materialized inputs),
+    the per-cell tallies and draw counts are summed, and the exact
+    Phipson–Smyth p-values recompute from the pooled counts — the same
+    numbers pooling the null arrays would give. The combined result then
+    carries counts but no nulls. Caveat: without null rows there is
+    nothing to content-hash, so the duplicate-seed check above cannot run
+    on count-only merges — splitting a run across seeds remains the
+    caller's responsibility there.
     """
     if len(analyses) < 2:
         raise ValueError("combine_analyses needs at least two results")
@@ -340,6 +383,9 @@ def _combine_pair_results(results, allow_duplicate_nulls):
                 f"results record different permutation-space sizes "
                 f"({total_space!r} vs {s!r})"
             )
+
+    if any(r.nulls is None for r in results):
+        return _combine_count_results(results, total_space)
 
     blocks = [np.asarray(r.nulls[: r.completed]) for r in results]
     if not allow_duplicate_nulls:
@@ -437,6 +483,66 @@ def _combine_pair_results(results, allow_duplicate_nulls):
         module_labels=list(first.module_labels),
         observed=first.observed,
         nulls=nulls,
+        p_values=p_values,
+        n_vars_present=first.n_vars_present,
+        prop_vars_present=first.prop_vars_present,
+        total_size=first.total_size,
+        alternative=first.alternative,
+        n_perm=int(sum(r.n_perm for r in results)),
+        completed=completed,
+        total_space=total_space,
+    )
+
+
+def _combine_count_results(results, total_space):
+    """Pool results in count space — the merge path when any input is a
+    streaming (``store_nulls=False``) result: per-cell exceedance tallies
+    and valid-draw counts are additive across independent runs, and the
+    Phipson–Smyth estimator over the pooled counts equals the estimator
+    over the pooled null arrays (it only ever reads counts)."""
+    from ..ops import pvalues as pv
+
+    first = results[0]
+    parts = []
+    for r in results:
+        if r.counts_hi is not None:
+            parts.append((
+                np.asarray(r.counts_hi, dtype=np.int64),
+                np.asarray(r.counts_lo, dtype=np.int64),
+                np.asarray(r.counts_eff, dtype=np.int64),
+            ))
+        elif r.nulls is not None:
+            parts.append(pv.tail_counts(r.observed, r.nulls[: r.completed]))
+        else:
+            raise ValueError(
+                f"result ({r.discovery!r}, {r.test!r}) carries neither a "
+                "null array nor exceedance counts; it cannot be combined"
+            )
+    hi = sum(p[0] for p in parts)
+    lo = sum(p[1] for p in parts)
+    eff = sum(p[2] for p in parts)
+    p_values = pv.counts_pvalues(
+        first.observed, hi, lo, eff, first.alternative,
+        total_nperm=total_space,
+    )
+    completed = int(sum(r.completed for r in results))
+    any_seq = any(
+        r.p_type == "sequential" or r.n_perm_used is not None
+        for r in results
+    )
+    return PreservationResult(
+        n_perm_used=(
+            sum(r.module_n_perm() for r in results) if any_seq else None
+        ),
+        p_type="sequential" if any_seq else "fixed",
+        discovery=first.discovery,
+        test=first.test,
+        module_labels=list(first.module_labels),
+        observed=first.observed,
+        nulls=None,
+        counts_hi=hi,
+        counts_lo=lo,
+        counts_eff=eff,
         p_values=p_values,
         n_vars_present=first.n_vars_present,
         prop_vars_present=first.prop_vars_present,

@@ -141,6 +141,22 @@ def exceedance_counts(
     return cnt, eff
 
 
+def _grouped_permp(counts, eff, total_nperm) -> np.ndarray:
+    """Vectorized :func:`permp` over a (counts, effective-nperm) cell grid:
+    cells are grouped by effective permutation count (usually one group —
+    NaN-free nulls) instead of calling per cell. Zero-draw cells stay NaN.
+    Shared by the null-array and streamed-counts p-value paths so the
+    estimator cannot drift between them."""
+    flat_c = np.asarray(counts, dtype=np.float64).reshape(-1)
+    flat_n = np.asarray(eff, dtype=np.int64).reshape(-1)
+    p = np.full(flat_c.shape, np.nan)
+    for n in np.unique(flat_n):
+        sel = flat_n == n
+        if n > 0:
+            p[sel] = permp(flat_c[sel], int(n), total_nperm)
+    return p.reshape(np.asarray(counts).shape)
+
+
 def permutation_pvalues(
     observed: np.ndarray,
     nulls: np.ndarray,
@@ -154,16 +170,62 @@ def permutation_pvalues(
     """
     observed = np.asarray(observed, dtype=np.float64)
     counts, eff = exceedance_counts(observed, nulls, alternative)
-    flat_c = counts.reshape(-1)
-    flat_n = eff.reshape(-1)
-    p = np.full(flat_c.shape, np.nan)
-    # permp is vectorized in the count; group cells by effective nperm
-    # (usually one group — NaN-free nulls) instead of calling per cell.
-    for n in np.unique(flat_n):
-        sel = flat_n == n
-        if n > 0:
-            p[sel] = permp(flat_c[sel], int(n), total_nperm)
-    p = p.reshape(counts.shape)
+    p = _grouped_permp(counts, eff, total_nperm)
+    if alternative == "two.sided":
+        p = np.minimum(2.0 * p, 1.0)
+    p[np.isnan(observed)] = np.nan
+    return p
+
+
+def tail_counts(
+    observed: np.ndarray, nulls: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Both-tail exceedance tallies + per-cell valid draw counts of a
+    materialized null array — the lift from null space into the streaming
+    executor's count space (``(hi, lo, eff)``, each shaped like one null
+    row). Lets :func:`netrep_tpu.models.results.combine_analyses` pool a
+    materialized result with count-only (``store_nulls=False``) results,
+    and pins streaming/materialized parity in tests: a streamed run's
+    device tallies must equal this function applied to the same key's
+    materialized null."""
+    observed = np.asarray(observed, dtype=np.float64)
+    nulls = np.asarray(nulls)
+    with np.errstate(invalid="ignore"):
+        hi = (nulls >= observed[None]).sum(axis=0)
+        lo = (nulls <= observed[None]).sum(axis=0)
+    eff = (~np.isnan(nulls)).sum(axis=0)
+    return (hi.astype(np.int64), lo.astype(np.int64), eff.astype(np.int64))
+
+
+def counts_pvalues(
+    observed: np.ndarray,
+    hi: np.ndarray,
+    lo: np.ndarray,
+    eff: np.ndarray,
+    alternative: str = "greater",
+    total_nperm: float | None = None,
+) -> np.ndarray:
+    """Exact Phipson–Smyth p-values straight from streamed exceedance
+    tallies (``store_nulls=False``): ``hi``/``lo`` are the per-(module,
+    statistic) counts of null draws at least / at most as extreme as the
+    observed value and ``eff`` the per-cell valid (non-NaN) draw counts —
+    exactly what :func:`tail_counts` computes from a materialized null, so
+    the two result modes produce identical p-values for identical counts
+    (the estimator itself is the shared :func:`_grouped_permp`). The tail
+    convention matches :func:`exceedance_counts` (two-sided: min tail,
+    doubled, capped at 1); NaN observed statistics yield NaN p-values."""
+    observed = np.asarray(observed, dtype=np.float64)
+    hi = np.asarray(hi)
+    lo = np.asarray(lo)
+    if alternative == "greater":
+        cnt = hi
+    elif alternative == "less":
+        cnt = lo
+    elif alternative == "two.sided":
+        cnt = np.minimum(hi, lo)
+    else:
+        raise ValueError(f"unknown alternative: {alternative!r}")
+    p = _grouped_permp(cnt, eff, total_nperm)
     if alternative == "two.sided":
         p = np.minimum(2.0 * p, 1.0)
     p[np.isnan(observed)] = np.nan

@@ -118,6 +118,10 @@ class StopMonitor:
         k, s = self.observed.shape
         self.hi = np.zeros((k, s), dtype=np.int64)   # nulls >= observed
         self.lo = np.zeros((k, s), dtype=np.int64)   # nulls <= observed
+        #: per-cell valid (non-NaN) draw counts — tracked only by the
+        #: streaming (store_nulls=False) adaptive path, which has no null
+        #: array to recover them from; None on materialized runs
+        self.eff: np.ndarray | None = None
         self.n_used = np.zeros(k, dtype=np.int64)
         self.active = np.ones(k, dtype=bool)
         #: total permutation indices folded so far — always a whole number
@@ -154,13 +158,16 @@ class StopMonitor:
     def state_arrays(self) -> dict[str, np.ndarray]:
         """Checkpointable tallies + retired set (restored by
         :meth:`restore_state`); keys are the checkpoint extras namespace."""
-        return {
+        out = {
             "seq_hi": self.hi,
             "seq_lo": self.lo,
             "seq_n_used": self.n_used,
             "seq_active": self.active,
             "seq_folded": np.int64(self.folded),
         }
+        if self.eff is not None:
+            out["seq_eff"] = self.eff
+        return out
 
     def restore_state(self, extras: dict) -> None:
         """Restore tallies + retired set from checkpoint extras; shape
@@ -185,6 +192,10 @@ class StopMonitor:
         self.n_used = np.asarray(n_used, dtype=np.int64)
         self.active = np.asarray(active, dtype=bool)
         self.folded = int(folded)
+        self.eff = (
+            np.asarray(extras["seq_eff"], dtype=np.int64)
+            if "seq_eff" in extras else None
+        )
         # self-heal: decisions are a pure function of the tallies, so
         # retire anything already decided — covers an interrupt that
         # landed between a fold and its retirement flags
@@ -233,6 +244,68 @@ class StopMonitor:
         n_used[pos] += int(take)
         self.hi, self.lo, self.n_used, self.folded = (
             hi, lo, n_used, self.folded + int(take)
+        )
+        newly = pos[self._decided(pos)]
+        self.active[newly] = False
+        return newly
+
+    def update_counts(
+        self, hi: np.ndarray, lo: np.ndarray, take: int,
+        eff: np.ndarray | None = None,
+    ) -> np.ndarray:
+        """Fold one chunk's *device-computed* per-(module, statistic)
+        exceedance tallies for the currently-active modules — the
+        streaming-mode (``store_nulls=False``) twin of :meth:`update`:
+        the engine already counted ``null >= observed`` / ``null <=
+        observed`` inside the chunk dispatch, so no host-side null slice
+        exists to re-tally; transfers shrink from O(chunk·modules·cells)
+        raw nulls to O(modules·cells) counts per chunk.
+
+        Parameters
+        ----------
+        hi, lo : (n_active, n_cells) integer exceedance counts for this
+            chunk, module axis in :meth:`active_positions` order. Device
+            comparisons are f32-vs-f32 on exactly the values the
+            materialized path widens to f64, so the folded tallies are
+            identical to :meth:`update` on the same chunk — decisions
+            cannot diverge between the two modes.
+        take : permutations in this chunk.
+        eff : optional (n_active, n_cells) valid (non-NaN) draw counts;
+            when given they accumulate in :attr:`eff` — the streaming
+            path's replacement for reading per-cell validity off the null
+            array at p-value time. Folded in the same single-statement
+            commit as the tallies, so a Ctrl-C can never tear the two
+            apart (the checkpoint stays resume-exact).
+
+        Returns
+        -------
+        Global positions of modules retired by this chunk, as
+        :meth:`update`.
+        """
+        pos = self.active_positions()
+        hi = np.asarray(hi, dtype=np.int64)
+        lo = np.asarray(lo, dtype=np.int64)
+        want = (pos.size, self.observed.shape[1])
+        if hi.shape != want or lo.shape != want:
+            raise ValueError(
+                f"chunk counts have shapes {hi.shape}/{lo.shape}, expected "
+                f"{want}"
+            )
+        # same torn-commit discipline as update(): stage, then commit in
+        # one statement
+        new_hi, new_lo = self.hi.copy(), self.lo.copy()
+        new_hi[pos] += hi
+        new_lo[pos] += lo
+        n_used = self.n_used.copy()
+        n_used[pos] += int(take)
+        new_eff = self.eff
+        if eff is not None:
+            new_eff = (
+                self.eff if self.eff is not None else np.zeros_like(self.hi)
+            ).copy()
+            new_eff[pos] += np.asarray(eff, dtype=np.int64)
+        self.hi, self.lo, self.n_used, self.eff, self.folded = (
+            new_hi, new_lo, n_used, new_eff, self.folded + int(take)
         )
         newly = pos[self._decided(pos)]
         self.active[newly] = False

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import logging
 import time
 from functools import partial
 from typing import Callable, Sequence
@@ -41,6 +42,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import stats as jstats
 from ..ops.oracle import N_STATS
 from ..utils.config import EngineConfig
+
+logger = logging.getLogger("netrep_tpu")
 
 
 def run_checkpointed_chunks(
@@ -57,6 +60,7 @@ def run_checkpointed_chunks(
     checkpoint_every: int = 8192,
     perm_axis: int = 0,
     fingerprint_extra: bytes = b"",
+    profile=None,
 ) -> tuple[np.ndarray, int]:
     """The single chunked/interruptible/checkpointable null loop shared by
     :class:`PermutationEngine` and ``MultiTestEngine`` (one implementation so
@@ -68,7 +72,10 @@ def run_checkpointed_chunks(
     ``alloc_shape`` allocates it when neither ``nulls_init`` nor a readable
     checkpoint provides one; ``perm_axis`` locates the permutation axis in
     the null array; ``fingerprint_extra`` extends the engine fingerprint for
-    wrappers whose problem has extra structure (e.g. the test-dataset count).
+    wrappers whose problem has extra structure (e.g. the test-dataset count);
+    ``profile`` (a :class:`~netrep_tpu.utils.profiling.NullProfile`) counts
+    the dispatches this loop issues — two per chunk: key derivation + the
+    chunk program (host-transfer bytes are counted by ``write``).
     """
     key = _resolve_key(base, key)
 
@@ -114,6 +121,8 @@ def run_checkpointed_chunks(
                 keys = base.perm_keys(key, dispatched, take if dynamic else C)
                 nxt = (fn(keys), dispatched, take)
                 dispatched += take
+                if profile is not None:
+                    profile.record_dispatch(2)  # key derivation + chunk
             if pending is not None:
                 outs, at, take_p = pending
                 write(nulls, outs, at, take_p)
@@ -142,12 +151,23 @@ def run_checkpointed_chunks(
     if save is not None and completed > last_saved:
         save(nulls, completed)
     record = getattr(base, "record_chunk_throughput", None)
-    if record is not None and len(t_marks) >= 3:
-        # >= 3 chunks: drop the first mark (its interval absorbed the
-        # compile) and require a real steady-state window
-        (c0, t0), (c1, t1) = t_marks[0], t_marks[-1]
-        if t1 > t0 and c1 > c0:
-            record((c1 - c0) / (t1 - t0))
+    if record is not None:
+        if len(t_marks) >= 2:
+            # the interval BEFORE mark 0 absorbed the first chunk's compile,
+            # so the span mark 0 → last mark is pure steady state — two
+            # marks (one post-compile chunk interval) already measure a real
+            # rate. The old `>= 3` guard silently dropped every short
+            # autotuned run (e.g. superchunk-era chunk counts), starving the
+            # cache of exactly the configurations it was added to learn.
+            (c0, t0), (c1, t1) = t_marks[0], t_marks[-1]
+            if t1 > t0 and c1 > c0:
+                record((c1 - c0) / (t1 - t0))
+        elif t_marks:
+            logger.debug(
+                "throughput not recorded: only %d chunk(s) completed, so no "
+                "interval excludes the first chunk's compile time; run at "
+                "least 2 chunks to feed the autotune cache", len(t_marks),
+            )
     return nulls, completed
 
 
@@ -181,6 +201,387 @@ def _checkpoint_identity(base, key, fingerprint_extra: bytes):
         else np.asarray(jax.random.key_data(key))
     )
     return kd, fp
+
+
+# ---------------------------------------------------------------------------
+# Superchunk executor / streaming tallies (store_nulls=False)
+# ---------------------------------------------------------------------------
+
+#: namespace prefix of the streaming-counts checkpoint identity: a
+#: streaming checkpoint must never resume into a materialized run (its
+#: "nulls" array is an empty placeholder — the resumed rows would be NaN)
+#: and vice versa, so the two modes get disjoint fingerprints and the
+#: mismatch raises before any work is lost.
+_STREAM_FP = b"stream-counts|"
+
+
+@dataclasses.dataclass
+class StreamCounts:
+    """Result of a streaming (``store_nulls=False``) null run: per-(module,
+    statistic) exceedance tallies instead of the materialized null array.
+
+    ``hi``/``lo`` count null draws ``>=`` / ``<=`` the observed statistic
+    (both tails are kept — two-sided needs ``min`` of the *totals*) and
+    ``eff`` the valid (non-NaN) draws per cell; shapes match one null row:
+    ``(n_modules, 7)``, or ``(T, n_modules, 7)`` for the multi-test engine.
+    Feed them to :func:`netrep_tpu.ops.pvalues.counts_pvalues` — for the
+    same key they are bit-identical to
+    :func:`~netrep_tpu.ops.pvalues.tail_counts` of the materialized run.
+    ``n_perm_used``/``finished`` are set by the adaptive streaming loop.
+    """
+
+    hi: np.ndarray
+    lo: np.ndarray
+    eff: np.ndarray
+    completed: int
+    n_perm_used: np.ndarray | None = None
+    finished: bool = True
+
+
+def make_count_buckets(perm_axis: int):
+    """Per-bucket on-device tally fold shared by the single-test
+    (``perm_axis=0``: outputs ``(C, K_b, 7)``) and multi-test
+    (``perm_axis=1``: outputs ``(T, C, K_b, 7)``) streaming paths: compare
+    each chunk output against the observed statistics and reduce the
+    permutation axis to ``(hi, lo, eff)`` int32 counts.
+
+    Parity contract: comparisons run f32-vs-f32 on exactly the values the
+    materialized path widens to f64 on the host (widening is exact), and
+    NaN compares False on both tails there as here — so these counts equal
+    :func:`netrep_tpu.ops.pvalues.tail_counts` of the same chunk's
+    materialized rows, bit for bit. ``mask`` (perm-axis validity) excludes
+    the padded tail draws the materialized path discards host-side.
+    """
+
+    def count_buckets(outs, obs, mask):
+        res = []
+        for o, ob in zip(outs, obs):
+            shape = [1] * o.ndim
+            shape[perm_axis] = mask.shape[0]
+            sel = mask.reshape(shape)
+            ob_b = jnp.expand_dims(ob, perm_axis)
+            res.append((
+                jnp.sum((o >= ob_b) & sel, axis=perm_axis, dtype=jnp.int32),
+                jnp.sum((o <= ob_b) & sel, axis=perm_axis, dtype=jnp.int32),
+                jnp.sum(~jnp.isnan(o) & sel, axis=perm_axis,
+                        dtype=jnp.int32),
+            ))
+        return res
+    return count_buckets
+
+
+def chunk_count_deltas(chunk, count_buckets, axis_name, keys_c, valid_c,
+                       chunk_ops, obs):
+    """Evaluate one chunk and reduce it to per-bucket ``(hi, lo, eff)``
+    count deltas on device — the shared body of the fixed superchunk scan
+    and the adaptive per-chunk count dispatch. ``axis_name`` is set only
+    under ``shard_map`` (the fused replicated-matrices path): the validity
+    mask then offsets by the shard's column position and the per-shard
+    partial counts ``psum`` into full-chunk counts."""
+    outs = chunk(keys_c, *chunk_ops)
+    col = jnp.arange(keys_c.shape[0], dtype=jnp.int32)
+    if axis_name is not None:
+        col = col + jax.lax.axis_index(axis_name) * keys_c.shape[0]
+    mask = col < valid_c
+    deltas = count_buckets(outs, obs, mask)
+    if axis_name is not None:
+        deltas = jax.lax.psum(deltas, axis_name)
+    return deltas
+
+
+def build_stream_super(chunk, count_buckets, axis_name=None):
+    """The superchunk program: ``jax.lax.scan`` over K consecutive
+    permutation chunks in ONE device dispatch, the carry holding the
+    running per-(module, statistic) tallies — K× fewer host round-trips
+    than the chunk-by-chunk loop while the working set stays one chunk of
+    HBM (the scan body materializes a single chunk's statistics at a
+    time). Callers jit with ``donate_argnums=(0,)`` so the carry is
+    updated in place instead of doubling the tally footprint.
+
+    Signature of the returned function:
+    ``super_fn(tallies, keys, valid, chunk_ops, obs) -> tallies`` with
+    ``keys`` ``(K, C)`` per-permutation PRNG keys and ``valid`` ``(K,)``
+    per-chunk valid-permutation counts (the tail superchunk keeps the
+    compiled ``(K, C)`` shape — trailing chunks simply carry ``valid=0``,
+    so one program serves the whole run).
+    """
+
+    def super_fn(tallies, keys, valid, chunk_ops, obs):
+        def body(carry, xs):
+            keys_c, valid_c = xs
+            deltas = chunk_count_deltas(
+                chunk, count_buckets, axis_name, keys_c, valid_c,
+                chunk_ops, obs,
+            )
+            new = [
+                tuple(t + d for t, d in zip(ts, ds))
+                for ts, ds in zip(carry, deltas)
+            ]
+            return new, None
+
+        out, _ = jax.lax.scan(body, tallies, (keys, valid))
+        return out
+
+    return super_fn
+
+
+def run_stream_superchunks(
+    base,
+    n_perm: int,
+    key,
+    fn: Callable,
+    superchunk: int,
+    chunk_size: int,
+    init_tallies: Callable,
+    pull_tallies: Callable,
+    progress: Callable[[int, int], None] | None = None,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 8192,
+    fingerprint_extra: bytes = b"",
+    profile=None,
+) -> StreamCounts:
+    """Fixed-``n_perm`` streaming loop shared by :class:`PermutationEngine`
+    and ``MultiTestEngine``: dispatch one scan-fused superchunk of
+    ``superchunk`` chunks at a time (``fn`` from
+    :func:`build_stream_super`, jitted with a donated carry), pulling only
+    the O(modules·7) tallies back per superchunk — vs O(chunk·modules·7)
+    null rows per chunk in the materialized loop.
+
+    ``init_tallies(host_or_None)`` builds the device carry (zeros, or
+    restored from a checkpoint's host tallies); ``pull_tallies(carry)``
+    returns global ``(hi, lo, eff)`` numpy arrays. Checkpoints reuse the
+    null-checkpoint container (format version unchanged) with the tallies
+    riding ``x_``-prefixed extras and an empty placeholder null array; the
+    identity fingerprint is namespaced so streaming and materialized
+    checkpoints can never cross-resume. Resume is exact: tallies are saved
+    only at superchunk boundaries, and per-permutation keys depend only on
+    ``(key, index)``.
+
+    A ``KeyboardInterrupt`` returns the tallies of the last completed
+    superchunk (the tally fold and the ``completed`` counter commit in one
+    statement), mirroring the materialized loop's clean Ctrl-C contract.
+    """
+    key = _resolve_key(base, key)
+    K, C = int(superchunk), int(chunk_size)
+    completed = 0
+    host0 = None
+    save = None
+    if checkpoint_path is not None:
+        from ..utils import checkpoint as ckpt
+
+        kd, fp = _checkpoint_identity(
+            base, key, _STREAM_FP + fingerprint_extra
+        )
+        loaded = ckpt.load_null_checkpoint(checkpoint_path)
+        if loaded is not None:
+            extras = loaded.get("extras") or {}
+            if "stream_hi" not in extras:
+                raise ValueError(
+                    f"checkpoint {checkpoint_path!r} has no streaming "
+                    "tallies (it was written by a store_nulls=True run); "
+                    "resume it with store_nulls=True or delete it"
+                )
+            ckpt.validate_identity(loaded, kd, fp, checkpoint_path)
+            completed = min(int(loaded["completed"]), n_perm)
+            host0 = (extras["stream_hi"], extras["stream_lo"],
+                     extras["stream_eff"])
+
+        def save(hi, lo, eff, done):
+            ckpt.save_null_checkpoint(
+                checkpoint_path, np.zeros((0,)), done, kd, fp,
+                extra={"stream_hi": hi, "stream_lo": lo, "stream_eff": eff},
+            )
+
+    tallies = init_tallies(host0)
+    hi = lo = eff = None
+    last_saved = completed
+    t_marks: list[tuple[int, float]] = []
+    try:
+        while completed < n_perm:
+            take = min(K * C, n_perm - completed)
+            keys = base.perm_keys2d(key, completed, K, C)
+            # per-chunk valid counts: the tail superchunk keeps the
+            # compiled (K, C) shape, trailing chunks run with valid=0 and
+            # the padded draws are computed and discarded (same policy as
+            # the materialized loop's full-shape tail chunk)
+            valid = np.clip(
+                n_perm - completed - np.arange(K, dtype=np.int64) * C, 0, C
+            ).astype(np.int32)
+            # fold + counter commit in one statement (clean-Ctrl-C
+            # contract: a consistent partial result at any interrupt)
+            tallies, completed = fn(tallies, keys, valid), completed + take
+            hi, lo, eff = pull_tallies(tallies)
+            t_marks.append((completed, time.perf_counter()))
+            if profile is not None:
+                nbytes = hi.nbytes + lo.nbytes + eff.nbytes
+                profile.record_dispatch(2)  # key derivation + superchunk
+                profile.record_transfer(nbytes)
+                profile.record_superchunk(2, nbytes, take)
+            if progress is not None:
+                progress(completed, n_perm)
+            if save is not None and completed - last_saved >= checkpoint_every:
+                save(hi, lo, eff, completed)
+                last_saved = completed
+    except KeyboardInterrupt:
+        pass
+    if hi is None:
+        # resumed-already-complete, or interrupted before the first
+        # superchunk landed: report the carry as initialized
+        hi, lo, eff = pull_tallies(tallies)
+    if save is not None and completed > last_saved:
+        save(hi, lo, eff, completed)
+    record = getattr(base, "record_stream_throughput", None)
+    if record is not None and len(t_marks) >= 2:
+        # same steady-state rule as the materialized loop: the interval
+        # before mark 0 absorbed the compile
+        (c0, t0), (c1, t1) = t_marks[0], t_marks[-1]
+        if t1 > t0 and c1 > c0:
+            record((c1 - c0) / (t1 - t0))
+    return StreamCounts(hi=hi, lo=lo, eff=eff, completed=completed)
+
+
+def run_adaptive_stream_chunks(
+    base,
+    n_perm: int,
+    key,
+    fn_builder: Callable[[], Callable],
+    counts_to_active: Callable,
+    monitor,
+    rebucket: Callable[[np.ndarray], None],
+    progress: Callable[[int, int], None] | None = None,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 8192,
+    fingerprint_extra: bytes = b"",
+    profile=None,
+) -> tuple:
+    """Adaptive (sequential early-stopping) streaming loop: one chunk per
+    dispatch — decisions must land at CHUNK boundaries exactly as the
+    materialized adaptive loop takes them, so retirement is bit-identical
+    between ``store_nulls`` modes — but the dispatch returns per-bucket
+    ``(hi, lo, eff)`` *counts* and the
+    :class:`~netrep_tpu.ops.sequential.StopMonitor` folds them directly
+    (:meth:`~netrep_tpu.ops.sequential.StopMonitor.update_counts`) instead
+    of re-tallying host-side null slices: the device→host transfer drops
+    from O(chunk·modules·cells) to O(modules·cells) per chunk.
+
+    ``fn_builder() -> fn(keys, valid)`` jits the count program for the
+    current bucket set (re-invoked after each retirement re-bucketing);
+    ``counts_to_active(outs, pos)`` assembles its output into
+    ``(hi, lo, eff)`` host arrays over the active modules in
+    :meth:`~netrep_tpu.ops.sequential.StopMonitor.active_positions` order.
+    Checkpoints carry the monitor state (tallies + retired set + per-cell
+    ``eff``) in ``x_``-prefixed extras; there is no written-but-unfolded
+    gap to re-fold on resume — counts and monitor commit in one statement.
+
+    Returns ``(monitor, completed, finished)``.
+    """
+    key = _resolve_key(base, key)
+    completed = 0
+    save = None
+    if checkpoint_path is not None:
+        from ..utils import checkpoint as ckpt
+
+        kd, fp = _checkpoint_identity(
+            base, key, _STREAM_FP + fingerprint_extra
+        )
+        loaded = ckpt.load_null_checkpoint(checkpoint_path)
+        if loaded is not None:
+            ckpt.validate_identity(loaded, kd, fp, checkpoint_path)
+            monitor.restore_state(loaded.get("extras") or {})
+            completed = min(int(loaded["completed"]), n_perm)
+
+        def save(done):
+            ckpt.save_null_checkpoint(
+                checkpoint_path, np.zeros((0,)), done, kd, fp,
+                extra=monitor.state_arrays(),
+            )
+
+    pos = monitor.active_positions()
+    if pos.size and pos.size < monitor.n_modules:
+        rebucket(pos)  # resumed mid-run: shrink to the restored active set
+    fn = fn_builder() if monitor.any_active() else None
+    C = base.effective_chunk()
+    last_saved = completed
+    finished = True
+    try:
+        while completed < n_perm and monitor.any_active():
+            pos = monitor.active_positions()
+            take = min(C, n_perm - completed)
+            keys = base.perm_keys(key, completed, C)
+            outs = fn(keys, np.int32(take))
+            hi_a, lo_a, eff_a = counts_to_active(outs, pos)
+            if profile is not None:
+                profile.record_dispatch(2)
+                profile.record_transfer(
+                    hi_a.nbytes + lo_a.nbytes + eff_a.nbytes
+                )
+            newly = monitor.update_counts(hi_a, lo_a, take, eff=eff_a)
+            completed = monitor.folded
+            if progress is not None:
+                progress(completed, n_perm)
+            if newly.size and monitor.any_active():
+                rebucket(monitor.active_positions())
+                fn = fn_builder()
+            if save is not None and completed - last_saved >= checkpoint_every:
+                save(completed)
+                last_saved = completed
+    except KeyboardInterrupt:
+        # chunk-boundary abort: the monitor folds counts atomically, so
+        # the checkpoint below resumes exactly
+        finished = False
+        completed = monitor.folded
+    if save is not None and completed > last_saved:
+        save(completed)
+    return monitor, completed, finished
+
+
+def _trim_tail_shards(out, take: int, axis: int = 0):
+    """Multi-host tail chunks only: drop whole trailing perm-axis shards
+    of a chunk output before the cross-host allgather, so the final
+    (``take < C``) chunk does not move its padded tail over DCN. Slicing
+    happens only where the sharding allows it — on whole-shard boundaries
+    (a mid-shard slice would trigger a resharding collective instead of
+    saving one) — and never on fully-addressable arrays, keeping the
+    documented eager-op-avoidance on tunneled single-host backends (each
+    eager device op costs ~1 s there; the host-side ``[:take]`` slice in
+    ``write`` stays the single-host policy)."""
+    if take >= out.shape[axis] or getattr(out, "is_fully_addressable", True):
+        return out
+    try:
+        rows = out.sharding.shard_shape(out.shape)[axis]
+    except Exception:  # unknown sharding object: transfer as before
+        return out
+    if not rows or rows <= 0:
+        return out
+    keep = -(-take // rows) * rows
+    if keep >= out.shape[axis]:
+        return out
+    sel = [slice(None)] * out.ndim
+    sel[axis] = slice(0, keep)
+    return out[tuple(sel)]
+
+
+def _globalize_replicated(mesh, tree):
+    """Multi-host meshes: every operand of a jitted computation must be a
+    global array. Host-local operands are identical on every process (the
+    SPMD contract — keys from the same seed, replicated matrices), so
+    replicate them over the mesh; operands already carrying global
+    shardings (e.g. row-sharded matrices) pass through untouched."""
+    from .distributed import to_global
+
+    rep = NamedSharding(mesh, P())
+    if rep.is_fully_addressable:
+        return tree
+
+    def _globalize(a):
+        if not hasattr(a, "shape"):
+            return a
+        sh = getattr(a, "sharding", None)
+        if sh is not None and not sh.is_fully_addressable:
+            return a  # already global (e.g. row-sharded)
+        return to_global(a, rep)
+
+    return jax.tree.map(_globalize, tree)
 
 
 def run_adaptive_chunks(
@@ -307,6 +708,18 @@ def _perm_keys_jit(key: jax.Array, start: jax.Array, count: int) -> jax.Array:
     return jax.vmap(lambda i: jax.random.fold_in(key, i))(
         start + jnp.arange(count, dtype=jnp.uint32)
     )
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _perm_keys2d_jit(key: jax.Array, start: jax.Array, k: int, c: int):
+    """(K, C) per-permutation keys for one superchunk — the same
+    ``fold_in(key, i)`` contract as :func:`_perm_keys_jit`, reshaped
+    INSIDE the jit (an eager reshape of a typed-key array would cost a
+    ~1 s dispatch per superchunk on tunneled backends)."""
+    ks = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        start + jnp.arange(k * c, dtype=jnp.uint32)
+    )
+    return ks.reshape(k, c)
 
 
 def check_derived_network(corr, net, net_beta, what: str) -> None:
@@ -718,6 +1131,14 @@ class PermutationEngine:
         #: (cache, key, perm_batch) set by chunk_body when autotune applies;
         #: `record_chunk_throughput` writes the measured rate back to it
         self._autotune_record: tuple | None = None
+        #: (cache, key, superchunk) set by run_null_streaming when autotune
+        #: applies; `record_stream_throughput` writes the measured rate back
+        self._stream_autotune_record: tuple | None = None
+        #: jitted streaming programs, keyed by the observed-statistics bytes
+        #: (a fresh closure per call would re-trace/re-compile every run —
+        #: the same reason _chunk_fn_cached exists); invalidated by rebucket
+        self._stream_super_cached: tuple | None = None
+        self._stream_count_cached: tuple | None = None
 
     def rebucket(self, active) -> None:
         """Rebuild the bucket list for the module subset ``active`` (global
@@ -758,6 +1179,8 @@ class PermutationEngine:
             raise ValueError("rebucket needs at least one active module")
         self.buckets = new
         self._chunk_fn_cached = None
+        self._stream_super_cached = None
+        self._stream_count_cached = None
 
     def autotune_key(self, extra: str = "") -> str:
         """Problem-shape key for the persistent throughput cache: backend ×
@@ -780,6 +1203,16 @@ class PermutationEngine:
         if self._autotune_record is not None:
             cache, key, pb = self._autotune_record
             cache.record(key, pb, perms_per_sec)
+
+    def record_stream_throughput(self, perms_per_sec: float) -> None:
+        """Steady-state streaming throughput callback
+        (:func:`run_stream_superchunks`) — persists the measurement for the
+        (key, superchunk) this run resolved, so the next streaming run with
+        the same problem shape reuses the best-measured fused dispatch
+        depth (:func:`netrep_tpu.utils.autotune.resolve_superchunk`)."""
+        if self._stream_autotune_record is not None:
+            cache, key, k = self._stream_autotune_record
+            cache.record(key, k, perms_per_sec)
 
     # ------------------------------------------------------------------
     # Observed pass (SURVEY.md §3.1 "observed pass")
@@ -816,6 +1249,15 @@ class PermutationEngine:
         eager dispatch costs ~1s per op on tunneled TPU backends, which
         would dwarf the chunk compute in the hot loop."""
         return _perm_keys_jit(key, jnp.uint32(start), int(count))
+
+    @staticmethod
+    def perm_keys2d(key: jax.Array, start: int, k: int, c: int) -> jax.Array:
+        """(k, c) per-permutation keys for one superchunk — row j holds
+        chunk j's keys ``fold_in(key, start + j*c + i)``, so the streaming
+        executor draws exactly the permutations the chunk-by-chunk loop
+        draws at the same indices (the RNG contract is shared, not
+        re-derived)."""
+        return _perm_keys2d_jit(key, jnp.uint32(start), int(k), int(c))
 
     def observed(self) -> np.ndarray:
         """(n_modules, 7) observed statistics on the actual overlap sets."""
@@ -1053,20 +1495,10 @@ class PermutationEngine:
                 jitted = jax.jit(chunk, out_shardings=out_shardings)
             if not keys_sharding.is_fully_addressable:
                 # Multi-host mesh: every operand of the jitted computation
-                # must be a global array. Matrices/disc-props are identical
-                # on every process (SPMD contract) → replicate them over the
-                # mesh; row-sharded inputs already carry global shardings.
-                rep = NamedSharding(self.mesh, P())
-
-                def _globalize(a):
-                    if not hasattr(a, "shape"):
-                        return a
-                    sh = getattr(a, "sharding", None)
-                    if sh is not None and not sh.is_fully_addressable:
-                        return a  # already global (e.g. row-sharded)
-                    return to_global(a, rep)
-
-                args = jax.tree.map(_globalize, args)
+                # must be a global array (_globalize_replicated replicates
+                # the host-local ones; row-sharded inputs already carry
+                # global shardings).
+                args = _globalize_replicated(self.mesh, args)
 
             def fn(keys):
                 # shard keys explicitly; the matrix operands keep their own
@@ -1091,6 +1523,7 @@ class PermutationEngine:
         start_perm: int = 0,
         checkpoint_path: str | None = None,
         checkpoint_every: int = 8192,
+        profile=None,
     ) -> tuple[np.ndarray, int]:
         """Compute the permutation null distribution.
 
@@ -1112,6 +1545,10 @@ class PermutationEngine:
             raises (SURVEY.md §5 "checkpoint/resume").
         checkpoint_every : checkpoint cadence in permutations (rounded up to
             whole chunks).
+        profile : optional :class:`~netrep_tpu.utils.profiling.NullProfile`
+            accumulating dispatch counts and device→host transfer bytes —
+            the denominators of the streaming executor's amortization claims
+            (``bench.py --config superchunk``).
 
         Returns
         -------
@@ -1128,12 +1565,13 @@ class PermutationEngine:
             )
         return run_checkpointed_chunks(
             self, n_perm, key, self._chunk_fn(),
-            (n_perm, self.n_modules, N_STATS), self._null_write(),
+            (n_perm, self.n_modules, N_STATS), self._null_write(profile),
             progress=progress, nulls_init=nulls_init, start_perm=start_perm,
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+            profile=profile,
         )
 
-    def _null_write(self) -> Callable:
+    def _null_write(self, profile=None) -> Callable:
         """Chunk→null scatter shared by the fixed and adaptive loops. Reads
         ``self.buckets`` at call time, so after a `rebucket` it scatters
         exactly the surviving modules."""
@@ -1145,10 +1583,16 @@ class PermutationEngine:
                 # transfer the whole chunk output and slice on the HOST: a
                 # device-side `out[:take]` is an eager op, and eager dispatch
                 # on tunneled backends costs ~1s per op (the arrays are tiny).
-                # gather_to_host additionally allgathers across processes on
-                # multi-host meshes, where the perm-axis shards live on other
-                # hosts' devices and np.asarray alone would fail.
-                arr = gather_to_host(out).astype(np.float64)
+                # On MULTI-HOST meshes only, _trim_tail_shards first drops
+                # whole trailing perm-axis shards of a tail chunk so the
+                # padded tail never crosses DCN; gather_to_host then
+                # allgathers across processes (the perm-axis shards live on
+                # other hosts' devices and np.asarray alone would fail).
+                arr = gather_to_host(
+                    _trim_tail_shards(out, take)
+                ).astype(np.float64)
+                if profile is not None:
+                    profile.record_transfer(arr.nbytes)
                 nulls[done: done + take, b.module_pos] = arr[:take]
 
         return write
@@ -1206,3 +1650,272 @@ class PermutationEngine:
             # leave the engine reusable at full strength (e.g. a fixed-n
             # run after an adaptive one on the same instance)
             self.rebucket(range(self.n_modules))
+
+    # ------------------------------------------------------------------
+    # Streaming tallies (store_nulls=False) — superchunk executor
+    # ------------------------------------------------------------------
+
+    def _obs_buckets(self, observed) -> list:
+        """Per-bucket observed statistics as device f32 operands of the
+        streaming count programs. The f64→f32 cast is exact for statistics
+        the engine itself computed (they are widened f32 values), which is
+        what keeps device-side comparisons bit-identical to the
+        materialized path's host-side f64 comparisons."""
+        obs = np.asarray(observed, dtype=np.float64).reshape(
+            self.n_modules, N_STATS
+        )
+        return [
+            jnp.asarray(obs[b.module_pos], jnp.float32) for b in self.buckets
+        ]
+
+    def _stream_fused_rep(self) -> bool:
+        """Whether the chunk program runs under shard_map (fused kernel +
+        perm-axis mesh over replicated matrices) — the streaming programs
+        must then shard the same way and psum their per-shard counts."""
+        return (
+            self.gather_mode == "fused" and not self.row_sharded
+            and self.mesh is not None
+        )
+
+    def _stream_super_fn(self, observed) -> Callable:
+        """Cached :meth:`_build_stream_super` — jit caches by function
+        identity, so handing it a fresh closure per run would re-trace and
+        re-compile the whole superchunk program every call (measured ~7×
+        the steady-state run time at toy scale)."""
+        sig = np.asarray(observed, dtype=np.float64).tobytes()
+        if (self._stream_super_cached is None
+                or self._stream_super_cached[0] != sig):
+            self._stream_super_cached = (
+                sig, self._build_stream_super(observed)
+            )
+        return self._stream_super_cached[1]
+
+    def _stream_count_fn(self, observed) -> Callable:
+        """Cached :meth:`_build_stream_count_fn` (see
+        :meth:`_stream_super_fn`); the cache is invalidated by
+        :meth:`rebucket`, so each retirement still re-jits the shrunken
+        program exactly once."""
+        sig = np.asarray(observed, dtype=np.float64).tobytes()
+        if (self._stream_count_cached is None
+                or self._stream_count_cached[0] != sig):
+            self._stream_count_cached = (
+                sig, self._build_stream_count_fn(observed)
+            )
+        return self._stream_count_cached[1]
+
+    def _build_stream_super(self, observed) -> Callable:
+        """Jit the superchunk program (scan-fused chunks + donated tally
+        carry) with the same mesh composition rules as
+        :meth:`_build_chunk_fn`; returns ``fn(tallies, keys, valid)``."""
+        chunk = self.chunk_body()
+        args = self.chunk_args()
+        obs = self._obs_buckets(observed)
+        cfg = self.config
+        fused_rep = self._stream_fused_rep()
+        axis = cfg.mesh_axis if fused_rep else None
+        super_fn = build_stream_super(chunk, make_count_buckets(0), axis)
+        if self.mesh is not None:
+            from .distributed import to_global
+
+            ksh = NamedSharding(self.mesh, P(None, cfg.mesh_axis))
+            if fused_rep:
+                from .sharded import _NO_CHECK_KW, _shard_map
+
+                super_fn = _shard_map(
+                    super_fn,
+                    mesh=self.mesh,
+                    in_specs=(P(), P(None, cfg.mesh_axis), P(), P(), P()),
+                    out_specs=P(),
+                    **_NO_CHECK_KW,
+                )
+            jitted = jax.jit(super_fn, donate_argnums=(0,))
+            args, obs = _globalize_replicated(self.mesh, (args, obs))
+            return lambda tallies, keys, valid: jitted(
+                tallies, to_global(keys, ksh), valid, args, obs
+            )
+        jitted = jax.jit(super_fn, donate_argnums=(0,))
+        return lambda tallies, keys, valid: jitted(
+            tallies, keys, valid, args, obs
+        )
+
+    def _build_stream_count_fn(self, observed) -> Callable:
+        """Jit the per-chunk count program of the ADAPTIVE streaming path
+        (one chunk per dispatch — decisions stay at chunk boundaries, so
+        retirement is bit-identical to the materialized adaptive loop);
+        returns ``fn(keys, valid) -> [per-bucket (hi, lo, eff)]``. Reads
+        ``self.buckets`` at build time: re-invoked after each retirement
+        re-bucketing."""
+        chunk = self.chunk_body()
+        args = self.chunk_args()
+        obs = self._obs_buckets(observed)
+        cfg = self.config
+        fused_rep = self._stream_fused_rep()
+        axis = cfg.mesh_axis if fused_rep else None
+        count_buckets = make_count_buckets(0)
+
+        def count_fn(keys, valid, chunk_ops, obs_b):
+            return chunk_count_deltas(
+                chunk, count_buckets, axis, keys, valid, chunk_ops, obs_b
+            )
+
+        if self.mesh is not None:
+            from .distributed import to_global
+
+            ksh = NamedSharding(self.mesh, P(cfg.mesh_axis))
+            if fused_rep:
+                from .sharded import _NO_CHECK_KW, _shard_map
+
+                count_fn = _shard_map(
+                    count_fn,
+                    mesh=self.mesh,
+                    in_specs=(P(cfg.mesh_axis), P(), P(), P()),
+                    out_specs=P(),
+                    **_NO_CHECK_KW,
+                )
+            jitted = jax.jit(count_fn)
+            args, obs = _globalize_replicated(self.mesh, (args, obs))
+            return lambda keys, valid: jitted(
+                to_global(keys, ksh), valid, args, obs
+            )
+        jitted = jax.jit(count_fn)
+        return lambda keys, valid: jitted(keys, valid, args, obs)
+
+    def _stream_tallies_init(self, host=None) -> list:
+        """Device tally carry for :func:`run_stream_superchunks`: per-bucket
+        ``(hi, lo, eff)`` int32 zeros, or a checkpoint's host tallies
+        re-bucketed. int32 holds exceedance counts to 2^31 permutations —
+        far past any feasible run."""
+        out = []
+        for b in self.buckets:
+            shape = (len(b.module_pos), N_STATS)
+            if host is None:
+                vals = [np.zeros(shape, np.int32) for _ in range(3)]
+            else:
+                vals = [
+                    np.asarray(a)[b.module_pos].astype(np.int32)
+                    for a in host
+                ]
+            out.append(tuple(jnp.asarray(v) for v in vals))
+        if self.mesh is not None:
+            out = _globalize_replicated(self.mesh, out)
+        return out
+
+    def _stream_tallies_pull(self, tallies) -> tuple:
+        """Device tallies → global ``(hi, lo, eff)`` int64 host arrays —
+        the O(modules·7) per-superchunk transfer (cross-host allgather on
+        multi-host meshes)."""
+        from .distributed import gather_to_host
+
+        hi = np.zeros((self.n_modules, N_STATS), np.int64)
+        lo = np.zeros_like(hi)
+        eff = np.zeros_like(hi)
+        for b, (h, l, e) in zip(self.buckets, tallies):
+            hi[b.module_pos] = gather_to_host(h)
+            lo[b.module_pos] = gather_to_host(l)
+            eff[b.module_pos] = gather_to_host(e)
+        return hi, lo, eff
+
+    def _counts_to_active(self, outs, pos) -> tuple:
+        """Adaptive streaming: per-bucket count deltas → ``(hi, lo, eff)``
+        host arrays over the active modules in ``pos`` order (the bucket
+        set covers exactly the active modules after re-bucketing)."""
+        hi, lo, eff = self._stream_tallies_pull(outs)
+        return hi[pos], lo[pos], eff[pos]
+
+    def run_null_streaming(
+        self,
+        n_perm: int,
+        observed: np.ndarray,
+        key: jax.Array | int = 0,
+        progress: Callable[[int, int], None] | None = None,
+        checkpoint_path: str | None = None,
+        checkpoint_every: int = 8192,
+        profile=None,
+    ) -> StreamCounts:
+        """Streaming-mode (``store_nulls=False``) variant of
+        :meth:`run_null` — the superchunk executor: K consecutive chunks
+        fuse into one ``lax.scan`` dispatch whose donated carry holds the
+        per-(module, statistic) exceedance tallies against ``observed``,
+        so the host issues ~K× fewer dispatches and pulls O(modules·7)
+        counts per superchunk instead of O(chunk·modules·7) null rows —
+        while host memory drops from O(n_perm·modules·7) to O(modules·7).
+
+        K is ``config.superchunk``, autotune-resolved when None
+        (:func:`netrep_tpu.utils.autotune.resolve_superchunk`). For the
+        same key the returned tallies are bit-identical to
+        :func:`~netrep_tpu.ops.pvalues.tail_counts` of :meth:`run_null`'s
+        materialized null — feed them to
+        :func:`~netrep_tpu.ops.pvalues.counts_pvalues` for identical exact
+        Phipson–Smyth p-values. Checkpoint/interrupt contracts mirror
+        :meth:`run_null` (:func:`run_stream_superchunks`)."""
+        if self.discovery_only:
+            raise RuntimeError(
+                "engine was built discovery_only; test-side passes live in "
+                "the wrapping engine"
+            )
+        from ..utils.autotune import resolve_superchunk
+
+        sk_key = self.autotune_key(extra="superchunk")
+        K, cache = resolve_superchunk(self.config, sk_key)
+        self._stream_autotune_record = (
+            (cache, sk_key, K) if cache is not None else None
+        )
+        return run_stream_superchunks(
+            self, n_perm, key, self._stream_super_fn(observed), K,
+            self.effective_chunk(),
+            self._stream_tallies_init, self._stream_tallies_pull,
+            progress=progress, checkpoint_path=checkpoint_path,
+            checkpoint_every=checkpoint_every, profile=profile,
+        )
+
+    def run_null_adaptive_streaming(
+        self,
+        n_perm: int,
+        observed: np.ndarray,
+        key: jax.Array | int = 0,
+        alternative: str = "greater",
+        rule=None,
+        progress: Callable[[int, int], None] | None = None,
+        checkpoint_path: str | None = None,
+        checkpoint_every: int = 8192,
+        profile=None,
+    ) -> StreamCounts:
+        """Streaming-mode variant of :meth:`run_null_adaptive`: the
+        :class:`~netrep_tpu.ops.sequential.StopMonitor` folds
+        device-computed counts directly
+        (:func:`run_adaptive_stream_chunks`) — decisions land at the same
+        chunk boundaries on the same tallies, so retirement is
+        bit-identical to the materialized adaptive run at the same key.
+        Returns a :class:`StreamCounts` with per-module ``n_perm_used``
+        and the Ctrl-C ``finished`` flag."""
+        from ..ops.sequential import StopMonitor, StopRule
+
+        if self.discovery_only:
+            raise RuntimeError(
+                "engine was built discovery_only; test-side passes live in "
+                "the wrapping engine"
+            )
+        monitor = StopMonitor(
+            np.asarray(observed, dtype=np.float64).reshape(
+                self.n_modules, -1
+            ),
+            alternative, rule or StopRule(),
+        )
+        try:
+            monitor, completed, finished = run_adaptive_stream_chunks(
+                self, n_perm, key,
+                lambda: self._stream_count_fn(observed),
+                self._counts_to_active, monitor, self.rebucket,
+                progress=progress, checkpoint_path=checkpoint_path,
+                checkpoint_every=checkpoint_every, profile=profile,
+            )
+        finally:
+            self.rebucket(range(self.n_modules))
+        eff = monitor.eff if monitor.eff is not None else np.zeros_like(
+            monitor.hi
+        )
+        return StreamCounts(
+            hi=monitor.hi.copy(), lo=monitor.lo.copy(), eff=eff.copy(),
+            completed=completed, n_perm_used=monitor.n_used.copy(),
+            finished=finished,
+        )

@@ -143,6 +143,9 @@ class MultiTestEngine:
         self.n_modules = self._base.n_modules
         self._chunk_cached: Callable | None = None
         self._obs_fn_cached: Callable | None = None
+        #: jitted streaming programs keyed by (adaptive, observed bytes) —
+        #: see PermutationEngine._stream_super_fn; cleared by rebucket
+        self._stream_cached: dict = {}
 
     # -- kernel composition ------------------------------------------------
 
@@ -317,6 +320,14 @@ class MultiTestEngine:
     def _chunk_fn(self) -> Callable:
         if self._chunk_cached is not None:
             return self._chunk_cached
+        chunk, chunk_args, fused_rep = self._chunk_parts()
+        return self._finish_chunk(chunk, chunk_args, fused_rep=fused_rep)
+
+    def _chunk_parts(self) -> tuple:
+        """(unjitted chunk, chunk operands, fused_rep flag) — the chunk
+        program before jit/mesh wrapping, shared by :meth:`_chunk_fn` and
+        the streaming (``store_nulls=False``) builders so the two dispatch
+        modes evaluate the identical per-chunk computation."""
         cfg = self.config
         base = self._base
         uniform = self._td is None or self._uniform_samples
@@ -343,8 +354,7 @@ class MultiTestEngine:
 
         fused_rep = base.gather_mode == "fused" and not row_sharded
         if fused_rep:
-            chunk = self._fused_chunk_body()
-            return self._finish_chunk(chunk, chunk_args, fused_rep=True)
+            return self._fused_chunk_body(), chunk_args, True
 
         def chunk(keys, pool, tc, tn, td, discs):
             perm = jax.vmap(lambda k: jax.random.permutation(k, pool))(keys)
@@ -391,7 +401,7 @@ class MultiTestEngine:
                     ]))
             return outs
 
-        return self._finish_chunk(chunk, chunk_args, fused_rep=False)
+        return chunk, chunk_args, False
 
     def _fingerprint_extra(self) -> bytes:
         """Checkpoint identity of the test side (_tc/_tn/_td are per-dataset
@@ -404,21 +414,27 @@ class MultiTestEngine:
         )
         return f"|T:{self.T}|td:{digest}".encode()
 
-    def _null_write(self) -> Callable:
+    def _null_write(self, profile=None) -> Callable:
         """Chunk→null scatter shared by the fixed and adaptive loops (reads
         the base engine's buckets at call time — see
         :meth:`PermutationEngine._null_write`)."""
 
         def write(nulls, outs, done, take):
             from .distributed import gather_to_host
+            from .engine import _trim_tail_shards
 
             for b, outarr in zip(self._base.buckets, outs):
                 # full-chunk transfer, host-side slice (device slicing is an
                 # eager op — ~1s dispatch on tunneled backends); a single
                 # advanced index (module_pos) keeps its axis position in the
-                # assignment target. Cross-host allgather on multi-host
-                # meshes.
-                arr = gather_to_host(outarr).astype(np.float64)
+                # assignment target. On multi-host meshes only,
+                # _trim_tail_shards drops whole trailing perm-axis (dim 1)
+                # shards of a tail chunk before the cross-host allgather.
+                arr = gather_to_host(
+                    _trim_tail_shards(outarr, take, axis=1)
+                ).astype(np.float64)
+                if profile is not None:
+                    profile.record_transfer(arr.nbytes)
                 nulls[:, done: done + take, b.module_pos] = arr[:, :take]
 
         return write
@@ -430,11 +446,12 @@ class MultiTestEngine:
         jitted chunk."""
         self._base.rebucket(active)
         self._chunk_cached = None
+        self._stream_cached = {}
 
     def run_null(self, n_perm: int, key=0, progress=None,
                  nulls_init=None, start_perm: int = 0,
                  checkpoint_path: str | None = None,
-                 checkpoint_every: int = 8192):
+                 checkpoint_every: int = 8192, profile=None):
         """(T, n_perm, n_modules, 7) null array + completed count; same
         chunked/interruptible/reproducible/resumable/checkpointable contract
         as the base engine (key derivation and chunk rounding are shared
@@ -444,10 +461,11 @@ class MultiTestEngine:
 
         return run_checkpointed_chunks(
             self._base, n_perm, key, self._chunk_fn(),
-            (self.T, n_perm, self.n_modules, N_STATS), self._null_write(),
+            (self.T, n_perm, self.n_modules, N_STATS),
+            self._null_write(profile),
             progress=progress, nulls_init=nulls_init, start_perm=start_perm,
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
-            perm_axis=1,
+            perm_axis=1, profile=profile,
             # the test-side matrices live on this wrapper (the base engine is
             # discovery-only), so their content digest rides fingerprint_extra
             fingerprint_extra=self._fingerprint_extra(),
@@ -490,3 +508,222 @@ class MultiTestEngine:
             )
         finally:
             self.rebucket(range(self.n_modules))
+
+    # ------------------------------------------------------------------
+    # Streaming tallies (store_nulls=False) — superchunk executor
+    # ------------------------------------------------------------------
+
+    def _obs_buckets(self, observed) -> list:
+        """Per-bucket (T, K_b, 7) observed statistics as device f32
+        operands of the streaming count programs (the f64→f32 cast is
+        exact for engine-computed statistics — see
+        :meth:`PermutationEngine._obs_buckets`)."""
+        import jax.numpy as jnp
+
+        obs = np.asarray(observed, dtype=np.float64).reshape(
+            self.T, self.n_modules, N_STATS
+        )
+        return [
+            jnp.asarray(obs[:, b.module_pos], jnp.float32)
+            for b in self._base.buckets
+        ]
+
+    def _stream_program(self, observed, adaptive: bool):
+        """Cached :meth:`_build_stream_program` — a fresh closure per run
+        would re-trace/re-compile the whole program every call."""
+        key = (bool(adaptive),
+               np.asarray(observed, dtype=np.float64).tobytes())
+        if key not in self._stream_cached:
+            self._stream_cached[key] = self._build_stream_program(
+                observed, adaptive
+            )
+        return self._stream_cached[key]
+
+    def _build_stream_program(self, observed, adaptive: bool):
+        """Jit a streaming program with the multi-test axis layout
+        (outputs ``(T, C, K_b, 7)`` → counts reduce perm axis 1) and the
+        same mesh composition as :meth:`_finish_chunk`. ``adaptive=False``
+        returns the superchunk scan ``fn(tallies, keys, valid)``;
+        ``adaptive=True`` the per-chunk count ``fn(keys, valid)``."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .engine import (
+            _globalize_replicated, build_stream_super, chunk_count_deltas,
+            make_count_buckets,
+        )
+
+        chunk, args, fused_rep = self._chunk_parts()
+        obs = self._obs_buckets(observed)
+        cfg = self.config
+        shard = fused_rep and self.mesh is not None
+        axis = cfg.mesh_axis if shard else None
+        count_buckets = make_count_buckets(1)
+        if adaptive:
+            def program(keys, valid, chunk_ops, obs_b):
+                return chunk_count_deltas(
+                    chunk, count_buckets, axis, keys, valid, chunk_ops,
+                    obs_b,
+                )
+            keys_spec = P(cfg.mesh_axis)
+            donate = ()
+        else:
+            program = build_stream_super(chunk, count_buckets, axis)
+            keys_spec = P(None, cfg.mesh_axis)
+            donate = (0,)
+        if self.mesh is not None:
+            from .distributed import to_global
+
+            ksh = NamedSharding(self.mesh, keys_spec)
+            if shard:
+                from .sharded import _NO_CHECK_KW, _shard_map
+
+                head = () if adaptive else (P(),)
+                program = _shard_map(
+                    program,
+                    mesh=self.mesh,
+                    in_specs=head + (keys_spec, P(), P(), P()),
+                    out_specs=P(),
+                    **_NO_CHECK_KW,
+                )
+            jitted = jax.jit(program, donate_argnums=donate)
+            args, obs = _globalize_replicated(self.mesh, (args, obs))
+            if adaptive:
+                return lambda keys, valid: jitted(
+                    to_global(keys, ksh), valid, args, obs
+                )
+            return lambda tallies, keys, valid: jitted(
+                tallies, to_global(keys, ksh), valid, args, obs
+            )
+        jitted = jax.jit(program, donate_argnums=donate)
+        if adaptive:
+            return lambda keys, valid: jitted(keys, valid, args, obs)
+        return lambda tallies, keys, valid: jitted(
+            tallies, keys, valid, args, obs
+        )
+
+    def _stream_tallies_init(self, host=None) -> list:
+        """Per-bucket (T, K_b, 7) int32 tally carry (zeros or restored
+        from a checkpoint's (T, n_modules, 7) host tallies)."""
+        import jax.numpy as jnp
+
+        from .engine import _globalize_replicated
+
+        out = []
+        for b in self._base.buckets:
+            if host is None:
+                vals = [
+                    np.zeros((self.T, len(b.module_pos), N_STATS), np.int32)
+                    for _ in range(3)
+                ]
+            else:
+                vals = [
+                    np.asarray(a)[:, b.module_pos].astype(np.int32)
+                    for a in host
+                ]
+            out.append(tuple(jnp.asarray(v) for v in vals))
+        if self.mesh is not None:
+            out = _globalize_replicated(self.mesh, out)
+        return out
+
+    def _stream_tallies_pull(self, tallies) -> tuple:
+        """Device tallies → global ``(T, n_modules, 7)`` int64 arrays."""
+        from .distributed import gather_to_host
+
+        shape = (self.T, self.n_modules, N_STATS)
+        hi = np.zeros(shape, np.int64)
+        lo = np.zeros_like(hi)
+        eff = np.zeros_like(hi)
+        for b, (h, l, e) in zip(self._base.buckets, tallies):
+            hi[:, b.module_pos] = gather_to_host(h)
+            lo[:, b.module_pos] = gather_to_host(l)
+            eff[:, b.module_pos] = gather_to_host(e)
+        return hi, lo, eff
+
+    def _counts_to_active(self, outs, pos) -> tuple:
+        """Adaptive streaming: (T, K_b, 7) count deltas → ``(n_active,
+        T*7)`` host arrays in the monitor's cell layout (dataset axis
+        folded into the statistic axis, matching ``run_null_adaptive``'s
+        ``slice_vals`` convention)."""
+        hi, lo, eff = self._stream_tallies_pull(outs)
+
+        def to_cells(a):
+            return np.moveaxis(a[:, pos], 0, 1).reshape(pos.size, -1)
+
+        return to_cells(hi), to_cells(lo), to_cells(eff)
+
+    def run_null_streaming(self, n_perm: int, observed, key=0,
+                           progress=None,
+                           checkpoint_path: str | None = None,
+                           checkpoint_every: int = 8192, profile=None):
+        """Streaming-mode (``store_nulls=False``) variant of
+        :meth:`run_null` — the superchunk executor over the shared
+        permutation draw, tallying every (dataset, module, statistic) cell
+        on device (see :meth:`PermutationEngine.run_null_streaming`).
+        Returns a :class:`~netrep_tpu.parallel.engine.StreamCounts` with
+        ``(T, n_modules, 7)`` tallies."""
+        from ..utils.autotune import resolve_superchunk
+        from .engine import run_stream_superchunks
+
+        base = self._base
+        sk_key = base.autotune_key(extra=f"T{self.T}|superchunk")
+        K, cache = resolve_superchunk(self.config, sk_key)
+        base._stream_autotune_record = (
+            (cache, sk_key, K) if cache is not None else None
+        )
+        return run_stream_superchunks(
+            base, n_perm, key, self._stream_program(observed, False),
+            K, base.effective_chunk(),
+            self._stream_tallies_init, self._stream_tallies_pull,
+            progress=progress, checkpoint_path=checkpoint_path,
+            checkpoint_every=checkpoint_every,
+            fingerprint_extra=self._fingerprint_extra(), profile=profile,
+        )
+
+    def run_null_adaptive_streaming(self, n_perm: int, observed, key=0,
+                                    alternative: str = "greater", rule=None,
+                                    progress=None,
+                                    checkpoint_path: str | None = None,
+                                    checkpoint_every: int = 8192,
+                                    profile=None):
+        """Streaming-mode variant of :meth:`run_null_adaptive`: the
+        monitor folds device-computed (dataset × statistic) counts
+        directly, with retirement decisions bit-identical to the
+        materialized adaptive run at the same key (see
+        :meth:`PermutationEngine.run_null_adaptive_streaming`). Returns a
+        :class:`~netrep_tpu.parallel.engine.StreamCounts` with
+        ``(T, n_modules, 7)`` tallies and per-module ``n_perm_used``."""
+        from ..ops.sequential import StopMonitor, StopRule
+        from .engine import StreamCounts, run_adaptive_stream_chunks
+
+        obs = np.asarray(observed, dtype=np.float64)
+        monitor = StopMonitor(
+            np.moveaxis(obs, 0, 1).reshape(self.n_modules, -1),
+            alternative, rule or StopRule(),
+        )
+        try:
+            monitor, completed, finished = run_adaptive_stream_chunks(
+                self._base, n_perm, key,
+                lambda: self._stream_program(observed, True),
+                self._counts_to_active, monitor, self.rebucket,
+                progress=progress, checkpoint_path=checkpoint_path,
+                checkpoint_every=checkpoint_every,
+                fingerprint_extra=self._fingerprint_extra(),
+                profile=profile,
+            )
+        finally:
+            self.rebucket(range(self.n_modules))
+
+        def to_result(a):
+            # (n_modules, T*7) monitor cells -> (T, n_modules, 7)
+            return np.moveaxis(
+                np.asarray(a).reshape(self.n_modules, self.T, N_STATS), 0, 1
+            ).copy()
+
+        eff = monitor.eff if monitor.eff is not None else np.zeros_like(
+            monitor.hi
+        )
+        return StreamCounts(
+            hi=to_result(monitor.hi), lo=to_result(monitor.lo),
+            eff=to_result(eff), completed=completed,
+            n_perm_used=monitor.n_used.copy(), finished=finished,
+        )

@@ -142,3 +142,37 @@ def resolve_perm_batch(config, key: str, heuristic: int):
         return heuristic, cache
     best = cache.best_setting(key)
     return (best if best is not None and best > 0 else heuristic), cache
+
+
+#: static fallback for the streaming executor's superchunk when nothing has
+#: been measured yet: 8 chunks per dispatch amortizes the ~1 s tunneled
+#: dispatch latency ~8× while the scan carry keeps the working set at one
+#: chunk of HBM; on CPU the scan is the same compute with fewer Python
+#: round-trips, so the value is safe as a universal default.
+DEFAULT_SUPERCHUNK = 8
+
+
+def resolve_superchunk(config, key: str, default: int = DEFAULT_SUPERCHUNK):
+    """Autotuned superchunk resolution for the streaming executor
+    (:meth:`netrep_tpu.parallel.engine.PermutationEngine.run_null_streaming`):
+    an explicit ``config.superchunk`` is honored verbatim; otherwise the
+    best-measured setting recorded for ``key`` — perms/s per (backend,
+    bucket shape, chunk, gather mode, *superchunk*) — replaces the static
+    default. Returns ``(superchunk, cache_or_None)``; the streaming loop
+    records its measured steady-state perms/s back to the cache handle, so
+    superchunk sweeps (and ordinary runs) converge on the fastest fused
+    dispatch depth per problem shape. ``autotune=False`` disables both the
+    lookup and the recording.
+    """
+    explicit = getattr(config, "superchunk", None)
+    if not getattr(config, "autotune", False):
+        return (max(1, int(explicit)) if explicit is not None else default,
+                None)
+    cache = AutotuneCache()
+    if explicit is not None:
+        # explicit setting: honor it but record its throughput, so sweeps
+        # populate the cache with real alternatives (same contract as
+        # resolve_perm_batch)
+        return max(1, int(explicit)), cache
+    best = cache.best_setting(key)
+    return (best if best is not None and best > 0 else default), cache

@@ -138,6 +138,32 @@ def load_null_checkpoint(path: str) -> dict | None:
         }
 
 
+def validate_identity(
+    ckpt: dict,
+    key_data: np.ndarray,
+    fingerprint: np.ndarray,
+    path: str,
+) -> None:
+    """Problem/seed identity checks shared by the materialized and
+    streaming-counts resume paths (the streaming path has no null array to
+    reshape, so :func:`validate_resume` splits in two): raises with a
+    specific message on any mismatch."""
+    fp = ckpt["fingerprint"]
+    if fp.shape != fingerprint.shape or not np.array_equal(fp, fingerprint):
+        raise ValueError(
+            f"checkpoint {path!r} was written for a different problem "
+            "(module set, sizes, pool, data presence, or store_nulls mode "
+            "differ); refusing to resume — delete the file or point elsewhere"
+        )
+    kd = np.asarray(ckpt["key_data"])
+    if kd.shape != np.asarray(key_data).shape or not np.array_equal(kd, key_data):
+        raise ValueError(
+            f"checkpoint {path!r} was written with a different PRNG key/seed; "
+            "resuming would splice two different null distributions — use the "
+            "original seed or delete the checkpoint"
+        )
+
+
 def validate_resume(
     ckpt: dict,
     n_perm: int,
@@ -150,20 +176,7 @@ def validate_resume(
     ``(nulls_init, start_perm)`` ready for
     :meth:`PermutationEngine.run_null`. Raises with a specific message on any
     mismatch (SURVEY.md §2.1: informative errors are part of the surface)."""
-    fp = ckpt["fingerprint"]
-    if fp.shape != fingerprint.shape or not np.array_equal(fp, fingerprint):
-        raise ValueError(
-            f"checkpoint {path!r} was written for a different problem "
-            "(module set, sizes, pool, or data presence differ); refusing to "
-            "resume — delete the file or point elsewhere"
-        )
-    kd = np.asarray(ckpt["key_data"])
-    if kd.shape != np.asarray(key_data).shape or not np.array_equal(kd, key_data):
-        raise ValueError(
-            f"checkpoint {path!r} was written with a different PRNG key/seed; "
-            "resuming would splice two different null distributions — use the "
-            "original seed or delete the checkpoint"
-        )
+    validate_identity(ckpt, key_data, fingerprint, path)
     nulls = ckpt["nulls"]
     if nulls.shape[perm_axis] < n_perm:
         shape = list(nulls.shape)

@@ -102,6 +102,18 @@ class EngineConfig:
         relative), the same drift an explicit ``perm_batch`` change always
         caused. An explicit ``perm_batch`` is still honored verbatim (its
         throughput is recorded, so sweeps feed the cache).
+    superchunk : streaming executor only (``store_nulls=False``): number of
+        consecutive permutation chunks fused into ONE device dispatch via
+        ``jax.lax.scan`` — the scan body evaluates one chunk (working set
+        stays one chunk of HBM) and folds per-(module, statistic)
+        exceedance tallies into the donated carry, so the host issues
+        ~superchunk× fewer dispatches and transfers O(modules·7) counts
+        per superchunk instead of O(chunk·modules·7) raw nulls. None
+        (default) resolves from the persistent autotune cache's
+        best-measured value for this problem shape, falling back to 8
+        (:func:`netrep_tpu.utils.autotune.resolve_superchunk`). Ignored by
+        the materialized (``store_nulls=True``) null loop, whose
+        chunk-by-chunk output is the user-facing null array.
     """
 
     chunk_size: int = 128
@@ -132,6 +144,7 @@ class EngineConfig:
     network_from_correlation: float | tuple | None = None
     mxu_batch_budget_bytes: int = 2 << 30
     autotune: bool = True
+    superchunk: int | None = None
 
     def __post_init__(self):
         if self.network_from_correlation is not None:
@@ -158,6 +171,11 @@ class EngineConfig:
             raise ValueError(
                 "cap_granularity must be a multiple of 8 (sublane "
                 f"alignment), >= 8; got {self.cap_granularity!r}"
+            )
+        if self.superchunk is not None and self.superchunk < 1:
+            raise ValueError(
+                f"superchunk must be >= 1 or None (autotuned), got "
+                f"{self.superchunk!r}"
             )
 
     def resolved_gather_mode(self, platform: str) -> str:

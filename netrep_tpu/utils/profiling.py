@@ -17,6 +17,7 @@ exposes the TPU-native equivalents:
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import glob
 import logging
 import os
@@ -25,6 +26,49 @@ import time
 from typing import Callable
 
 logger = logging.getLogger("netrep_tpu")
+
+
+@dataclasses.dataclass
+class NullProfile:
+    """Dispatch/transfer accounting for one null run — the observability
+    counterpart of the superchunk executor's claims (ISSUE 2): the chunked
+    loops count every jitted program they launch and every byte they pull
+    to the host, so "K× fewer dispatches, O(m·7) transferred per
+    superchunk" is a measured row (``bench.py --config superchunk``), not
+    an assertion. ``superchunks`` records one entry per streaming
+    superchunk (dispatches issued for it + host bytes pulled), letting a
+    regression in either show up per-dispatch rather than only in totals.
+    """
+
+    #: jitted program launches issued (chunk/superchunk programs + the
+    #: per-chunk key derivation — each is one host→device round-trip that
+    #: costs ~1 s of dispatch latency on tunneled backends)
+    dispatches: int = 0
+    #: bytes moved device→host (null chunks or streamed tallies)
+    host_bytes: int = 0
+    #: per-superchunk records: {"dispatches", "host_bytes", "perms"}
+    superchunks: list = dataclasses.field(default_factory=list)
+
+    def record_dispatch(self, n: int = 1) -> None:
+        self.dispatches += int(n)
+
+    def record_transfer(self, nbytes: int) -> None:
+        self.host_bytes += int(nbytes)
+
+    def record_superchunk(self, dispatches: int, host_bytes: int,
+                          perms: int) -> None:
+        self.superchunks.append({
+            "dispatches": int(dispatches),
+            "host_bytes": int(host_bytes),
+            "perms": int(perms),
+        })
+
+    def as_dict(self) -> dict:
+        return {
+            "dispatches": self.dispatches,
+            "host_bytes": self.host_bytes,
+            "superchunks": list(self.superchunks),
+        }
 
 
 @contextlib.contextmanager
@@ -116,24 +160,58 @@ def resolve_profile_dir(profile) -> str | None:
     return str(profile)
 
 
-def summarize_trace(trace_dir: str, top: int = 20) -> list[tuple[str, float, float]]:
-    """Aggregate a captured trace's device-op durations.
+#: op-name patterns that mark device↔host (or cross-device) data movement
+#: in a trace — the "transfer" side of the scan-body/transfer split. XLA
+#: names differ per backend/version, so matching is deliberately broad;
+#: everything matching neither bucket lands in "other".
+_TRANSFER_OPS = re.compile(
+    r"copy|transfer|infeed|outfeed|send|recv|h2d|d2h", re.IGNORECASE
+)
+#: op-name patterns of the streaming executor's fused dispatch: lax.scan
+#: lowers to a while loop, so its body ops carry while/scan context names.
+_SCAN_OPS = re.compile(r"scan|while|body", re.IGNORECASE)
 
-    Returns ``[(op_name, total_ms, percent), ...]`` sorted by time, summed
-    over accelerator planes (empty on hosts whose trace has no device
-    plane). Lets users see the hot ops without TensorBoard.
+
+def trace_time_split(trace_dir: str) -> dict:
+    """Classify a captured trace's device-op time into scan-body vs
+    transfer vs other — re-measuring the round-2 profile's "serial
+    device→host transfer gap is ~25% of wall-clock" claim after the
+    superchunk executor amortizes it: a streaming run's split should show
+    the transfer share collapsing while scan-body time dominates.
+
+    Returns ``{"scan_body_ms", "transfer_ms", "other_ms", "total_ms",
+    "transfer_frac"}`` summed over accelerator planes (all zeros on
+    host-only traces). Name-pattern classification is heuristic — use it
+    for before/after deltas on one backend, not cross-backend absolutes.
     """
+    split = {"scan_body_ms": 0.0, "transfer_ms": 0.0, "other_ms": 0.0}
+    for name, ns in _device_op_durations(trace_dir).items():
+        if _TRANSFER_OPS.search(name):
+            split["transfer_ms"] += ns / 1e6
+        elif _SCAN_OPS.search(name):
+            split["scan_body_ms"] += ns / 1e6
+        else:
+            split["other_ms"] += ns / 1e6
+    total = sum(split.values())
+    split["total_ms"] = total
+    split["transfer_frac"] = (split["transfer_ms"] / total) if total else 0.0
+    return split
+
+
+def _device_op_durations(trace_dir: str) -> dict[str, float]:
+    """Per-op total duration (ns) over accelerator planes of the newest
+    xplane in ``trace_dir`` — the shared parse behind
+    :func:`summarize_trace` and :func:`trace_time_split`."""
     import jax
 
     paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
                              recursive=True))
     if not paths:
-        return []
+        return {}
     pd_ = jax.profiler.ProfileData.from_serialized_xspace(
         open(paths[-1], "rb").read()
     )
     per_op: dict[str, float] = {}
-    total = 0.0
     for plane in pd_.planes:
         if "tpu" not in plane.name.lower() and "gpu" not in plane.name.lower():
             continue
@@ -141,9 +219,25 @@ def summarize_trace(trace_dir: str, top: int = 20) -> list[tuple[str, float, flo
             for ev in line.events:
                 base = re.sub(r"[.\d]+$", "", ev.name)
                 per_op[base] = per_op.get(base, 0.0) + ev.duration_ns
-                total += ev.duration_ns
+    return per_op
+
+
+def summarize_trace(trace_dir: str, top: int = 20, split: bool = False):
+    """Aggregate a captured trace's device-op durations.
+
+    Returns ``[(op_name, total_ms, percent), ...]`` sorted by time, summed
+    over accelerator planes (empty on hosts whose trace has no device
+    plane). Lets users see the hot ops without TensorBoard. With
+    ``split=True`` returns ``(rows, split_dict)`` where ``split_dict`` is
+    :func:`trace_time_split`'s scan-body/transfer/other classification.
+    """
+    per_op = _device_op_durations(trace_dir)
+    total = sum(per_op.values())
     ranked = sorted(per_op.items(), key=lambda kv: -kv[1])[:top]
-    return [
+    rows = [
         (name, ns / 1e6, (ns / total * 100.0) if total else 0.0)
         for name, ns in ranked
     ]
+    if split:
+        return rows, trace_time_split(trace_dir)
+    return rows

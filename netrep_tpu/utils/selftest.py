@@ -205,6 +205,31 @@ def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True,
                 f"backend '{backend}') — draw/gather/statistics disagree "
                 "between device and host"
             )
+
+        # 3) streaming tallies (store_nulls=False): the superchunk
+        #    executor's on-device exceedance counts must equal the
+        #    materialized null's counts BIT-FOR-BIT on this backend — both
+        #    run the same device arithmetic, so the comparison is exact
+        #    even where MXU bf16 truncation loosens the oracle tolerance
+        #    above (this is the truncating-backend half of the ISSUE-2
+        #    streaming-parity acceptance criterion)
+        from ..ops import pvalues as pv
+
+        sc = eng.run_null_streaming(n_perm, obs, key=seed)
+        s_hi, s_lo, s_eff = pv.tail_counts(obs, nulls[:done])
+        if (sc.completed != done or (sc.hi != s_hi).any()
+                or (sc.lo != s_lo).any() or (sc.eff != s_eff).any()):
+            bad = int(
+                (sc.hi != s_hi).sum() + (sc.lo != s_lo).sum()
+                + (sc.eff != s_eff).sum()
+            )
+            raise RuntimeError(
+                f"selftest FAILED on {device} at {shape_tag}: streaming "
+                f"(store_nulls=False) exceedance tallies disagree with the "
+                f"materialized null in {bad} cell(s) — the scan-fused "
+                "superchunk dispatch is not computing the chunk loop's "
+                "statistics"
+            )
         obs_dev_max = max(obs_dev_max, obs_dev)
         null_dev_max = max(null_dev_max, null_dev)
 
@@ -219,6 +244,7 @@ def selftest(n_perm: int = 32, seed: int = 0, verbose: bool = True,
         "atol": atol,
         "observed_max_abs_dev": obs_dev_max,
         "null_reconstruction_max_abs_dev": null_dev_max,
+        "streaming_counts_exact": True,  # raised above otherwise
         "elapsed_s": round(time.perf_counter() - t_start, 2),
     }
     if verbose:

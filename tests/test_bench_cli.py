@@ -43,6 +43,10 @@ CASES = [
     # but a silent break would cost the baseline side of every comparison
     ["--config", "oracle"],
     ["--config", "adaptive"],
+    # streaming-executor row (ISSUE 2): counts parity is asserted inside
+    # the bench, so this smoke case also guards the superchunk dispatch
+    # path end-to-end
+    ["--config", "superchunk"],
 ]
 
 

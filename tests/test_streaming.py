@@ -1,0 +1,535 @@
+"""Superchunk executor + streaming tallies (``store_nulls=False``) —
+ISSUE 2 acceptance: for the same key the streaming mode reproduces the
+materialized mode's exceedance counts, Phipson–Smyth p-values, and
+adaptive retirement decisions EXACTLY (device f32 comparisons on the
+values the host widens to f64), a mid-superchunk checkpoint resumes to
+the uninterrupted result, and the default path is untouched.
+"""
+
+import numpy as np
+import pytest
+
+from netrep_tpu.data import make_mixed_pair
+from netrep_tpu.ops import pvalues as pv
+from netrep_tpu.ops.sequential import StopMonitor, StopRule
+from netrep_tpu.parallel.engine import (
+    ModuleSpec, PermutationEngine, _trim_tail_shards,
+)
+from netrep_tpu.utils.config import EngineConfig
+from netrep_tpu.utils.profiling import NullProfile
+
+# superchunk=3 with chunk 64 and N_PERM=300 leaves a partial tail chunk
+# AND a partial tail superchunk — the masked-validity path runs in every
+# parity assertion below, not just a dedicated test
+CFG = EngineConfig(chunk_size=64, summary_method="eigh", superchunk=3,
+                   autotune=False)
+N_PERM = 300
+
+
+@pytest.fixture(scope="module")
+def mixed():
+    return make_mixed_pair(320, 6, n_samples=40, seed=7)
+
+
+def _engine(mixed, config=CFG):
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    return PermutationEngine(
+        dc, dn, dd, tc, tn, td, specs, mixed["pool"], config=config
+    )
+
+
+@pytest.fixture(scope="module")
+def runs(mixed):
+    """One materialized + one streaming fixed run, same key — shared by
+    the parity assertions."""
+    eng = _engine(mixed)
+    observed = np.asarray(eng.observed())
+    nulls, done = eng.run_null(N_PERM, key=0)
+    stream = eng.run_null_streaming(N_PERM, observed, key=0)
+    return dict(observed=observed, nulls=np.asarray(nulls), done=done,
+                stream=stream)
+
+
+# ---------------------------------------------------------------------------
+# counts / p-value layer units
+# ---------------------------------------------------------------------------
+
+def test_counts_pvalues_match_permutation_pvalues():
+    """counts_pvalues on tail_counts of a null array == permutation_pvalues
+    on the array, for every alternative, including NaN observed cells and
+    NaN null entries (per-cell effective counts)."""
+    rng = np.random.default_rng(3)
+    obs = rng.standard_normal((4, 7))
+    obs[1, 2] = np.nan
+    nulls = rng.standard_normal((200, 4, 7))
+    nulls[150:, 0, :] = np.nan   # early-retired module
+    nulls[::7, 2, 3] = np.nan    # scattered invalid draws
+    hi, lo, eff = pv.tail_counts(obs, nulls)
+    for alt in ("greater", "less", "two.sided"):
+        want = pv.permutation_pvalues(obs, nulls, alt, total_nperm=5000)
+        got = pv.counts_pvalues(obs, hi, lo, eff, alt, total_nperm=5000)
+        np.testing.assert_array_equal(want, got)
+    with pytest.raises(ValueError, match="alternative"):
+        pv.counts_pvalues(obs, hi, lo, eff, "sideways")
+
+
+def test_update_counts_equals_update():
+    """Folding device-computed counts reaches the same tallies, n_used and
+    retirement decisions as folding the raw null values."""
+    rng = np.random.default_rng(0)
+    obs = np.zeros((3, 2))
+    vals = rng.standard_normal((96, 3, 2))
+    rule = StopRule(h=8, min_perms=32)
+    a = StopMonitor(obs, "two.sided", rule)
+    b = StopMonitor(obs, "two.sided", rule)
+    for i in range(0, 96, 32):
+        chunk = vals[i: i + 32]
+        pos = a.active_positions()
+        newly_a = a.update(chunk[:, pos], 32)
+        pos_b = b.active_positions()
+        assert (pos == pos_b).all()
+        hi = (chunk[:, pos_b] >= obs[pos_b][None]).sum(axis=0)
+        lo = (chunk[:, pos_b] <= obs[pos_b][None]).sum(axis=0)
+        eff = np.full_like(hi, 32)
+        newly_b = b.update_counts(hi, lo, 32, eff=eff)
+        np.testing.assert_array_equal(newly_a, newly_b)
+    np.testing.assert_array_equal(a.hi, b.hi)
+    np.testing.assert_array_equal(a.lo, b.lo)
+    np.testing.assert_array_equal(a.n_used, b.n_used)
+    np.testing.assert_array_equal(a.active, b.active)
+    # eff rides the monitor state (streaming checkpoints restore it)
+    assert "seq_eff" in b.state_arrays() and "seq_eff" not in a.state_arrays()
+    c = StopMonitor(obs, "two.sided", rule)
+    c.restore_state(b.state_arrays())
+    np.testing.assert_array_equal(c.eff, b.eff)
+    with pytest.raises(ValueError, match="expected"):
+        b.update_counts(np.zeros((9, 2)), np.zeros((9, 2)), 4)
+
+
+# ---------------------------------------------------------------------------
+# fixed-n streaming parity (engine level)
+# ---------------------------------------------------------------------------
+
+def test_streaming_counts_match_materialized(runs):
+    sc = runs["stream"]
+    assert sc.completed == runs["done"] == N_PERM
+    hi, lo, eff = pv.tail_counts(runs["observed"],
+                                 runs["nulls"][: runs["done"]])
+    np.testing.assert_array_equal(sc.hi, hi)
+    np.testing.assert_array_equal(sc.lo, lo)
+    np.testing.assert_array_equal(sc.eff, eff)
+
+
+def test_streaming_pvalues_match_materialized(runs):
+    sc = runs["stream"]
+    for alt in ("greater", "less", "two.sided"):
+        want = pv.permutation_pvalues(
+            runs["observed"], runs["nulls"][: runs["done"]], alt
+        )
+        got = pv.counts_pvalues(runs["observed"], sc.hi, sc.lo, sc.eff, alt)
+        np.testing.assert_array_equal(want, got)
+
+
+def test_streaming_invariant_to_superchunk(mixed, runs):
+    """The fused dispatch depth is a pure scheduling knob: K=1 and K=8
+    reproduce the K=3 tallies bit-for-bit (same keys, same fold order per
+    module cell — integer adds commute)."""
+    for k in (1, 8):
+        cfg = EngineConfig(chunk_size=64, summary_method="eigh",
+                           superchunk=k, autotune=False)
+        sc = _engine(mixed, cfg).run_null_streaming(
+            N_PERM, runs["observed"], key=0
+        )
+        np.testing.assert_array_equal(sc.hi, runs["stream"].hi)
+        np.testing.assert_array_equal(sc.lo, runs["stream"].lo)
+        np.testing.assert_array_equal(sc.eff, runs["stream"].eff)
+
+
+def test_streaming_dispatch_and_transfer_amortization(mixed, runs):
+    """The executor's reason to exist, measured: ≥2× fewer dispatches and
+    ≥10× fewer device→host bytes than the materialized loop at equal
+    n_perm (the bench row pins the full-size ratios; this pins the
+    mechanism in CI)."""
+    prof_f, prof_s = NullProfile(), NullProfile()
+    eng = _engine(mixed)
+    observed = runs["observed"]
+    eng.run_null(N_PERM, key=0, profile=prof_f)
+    eng.run_null_streaming(N_PERM, observed, key=0, profile=prof_s)
+    assert prof_f.dispatches >= 2 * prof_s.dispatches, (
+        prof_f.dispatches, prof_s.dispatches
+    )
+    assert prof_f.host_bytes >= 10 * prof_s.host_bytes, (
+        prof_f.host_bytes, prof_s.host_bytes
+    )
+    # per-superchunk records cover the whole run
+    assert sum(r["perms"] for r in prof_s.superchunks) == N_PERM
+
+
+# ---------------------------------------------------------------------------
+# adaptive streaming parity
+# ---------------------------------------------------------------------------
+
+def test_adaptive_streaming_matches_materialized(mixed):
+    eng = _engine(mixed)
+    observed = np.asarray(eng.observed())
+    nulls, done, fin = eng.run_null_adaptive(1200, observed, key=0)
+    sc = _engine(mixed).run_null_adaptive_streaming(1200, observed, key=0)
+    assert sc.finished == fin
+    nulls = np.asarray(nulls)[:done]
+    # identical retirement decisions ⇒ identical per-module counts
+    np.testing.assert_array_equal(sc.n_perm_used, pv.effective_nperm(nulls))
+    hi, lo, eff = pv.tail_counts(observed, nulls)
+    np.testing.assert_array_equal(sc.hi, hi)
+    np.testing.assert_array_equal(sc.lo, lo)
+    np.testing.assert_array_equal(sc.eff, eff)
+    p_mat, _ = pv.sequential_pvalues(observed, nulls)
+    p_str = pv.counts_pvalues(observed, sc.hi, sc.lo, sc.eff)
+    np.testing.assert_array_equal(p_mat, p_str)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume
+# ---------------------------------------------------------------------------
+
+def _interrupt_after(n):
+    seen = []
+
+    def cb(done, total):
+        seen.append(done)
+        if len(seen) == n:
+            raise KeyboardInterrupt
+
+    return cb
+
+
+def test_streaming_checkpoint_resume_mid_superchunk(mixed, runs, tmp_path):
+    ck = str(tmp_path / "stream.npz")
+    part = _engine(mixed).run_null_streaming(
+        N_PERM, runs["observed"], key=0, progress=_interrupt_after(1),
+        checkpoint_path=ck, checkpoint_every=64,
+    )
+    # interrupted after the first superchunk: resume continues mid-run
+    assert 0 < part.completed < N_PERM
+    fin = _engine(mixed).run_null_streaming(
+        N_PERM, runs["observed"], key=0, checkpoint_path=ck,
+        checkpoint_every=64,
+    )
+    assert fin.completed == N_PERM
+    np.testing.assert_array_equal(fin.hi, runs["stream"].hi)
+    np.testing.assert_array_equal(fin.lo, runs["stream"].lo)
+    np.testing.assert_array_equal(fin.eff, runs["stream"].eff)
+
+
+def test_adaptive_streaming_checkpoint_resume(mixed, tmp_path):
+    eng = _engine(mixed)
+    observed = np.asarray(eng.observed())
+    ref = _engine(mixed).run_null_adaptive_streaming(1200, observed, key=3)
+    assert ref.finished
+    ck = str(tmp_path / "astream.npz")
+    part = _engine(mixed).run_null_adaptive_streaming(
+        1200, observed, key=3, progress=_interrupt_after(2),
+        checkpoint_path=ck, checkpoint_every=64,
+    )
+    assert not part.finished and 0 < part.completed < ref.completed
+    fin = _engine(mixed).run_null_adaptive_streaming(
+        1200, observed, key=3, checkpoint_path=ck, checkpoint_every=64,
+    )
+    assert fin.finished and fin.completed == ref.completed
+    np.testing.assert_array_equal(fin.hi, ref.hi)
+    np.testing.assert_array_equal(fin.lo, ref.lo)
+    np.testing.assert_array_equal(fin.eff, ref.eff)
+    np.testing.assert_array_equal(fin.n_perm_used, ref.n_perm_used)
+
+
+def test_streaming_and_materialized_checkpoints_never_cross(
+    mixed, runs, tmp_path
+):
+    ck_s = str(tmp_path / "s.npz")
+    ck_m = str(tmp_path / "m.npz")
+    _engine(mixed).run_null_streaming(
+        128, runs["observed"], key=0, checkpoint_path=ck_s
+    )
+    _engine(mixed).run_null(128, key=0, checkpoint_path=ck_m)
+    # a materialized resume of a streaming checkpoint would fabricate NaN
+    # null rows for "completed" permutations — the namespaced fingerprint
+    # refuses it (and vice versa, with a mode-specific message)
+    with pytest.raises(ValueError, match="different problem"):
+        _engine(mixed).run_null(N_PERM, key=0, checkpoint_path=ck_s)
+    with pytest.raises(ValueError, match="no streaming tallies"):
+        _engine(mixed).run_null_streaming(
+            N_PERM, runs["observed"], key=0, checkpoint_path=ck_m
+        )
+
+
+# ---------------------------------------------------------------------------
+# module_preservation API / results / combine
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def api_kwargs(toy_pair_module):
+    from netrep_tpu.data import pair_frames
+
+    d, t = pair_frames(toy_pair_module)
+    return dict(
+        network={"disc": d["network"], "test": t["network"]},
+        data={"disc": d["data"], "test": t["data"]},
+        correlation={"disc": d["correlation"], "test": t["correlation"]},
+        module_assignments=dict(toy_pair_module["labels"]),
+        discovery="disc", test="test", n_perm=300, seed=11,
+        config=EngineConfig(chunk_size=64, superchunk=2, autotune=False),
+    )
+
+
+def test_module_preservation_store_nulls_false(api_kwargs, tmp_path):
+    from netrep_tpu import module_preservation
+    from netrep_tpu.models.results import PreservationResult
+
+    mat = module_preservation(**api_kwargs)
+    strm = module_preservation(**api_kwargs, store_nulls=False)
+    assert strm.nulls is None and strm.p_type == "fixed"
+    assert strm.counts_hi is not None and strm.counts_eff is not None
+    np.testing.assert_array_equal(mat.p_values, strm.p_values)
+    assert strm.preserved_modules() == mat.preserved_modules()
+    # .npz round-trip keeps counts and the nulls-absent marker
+    path = str(tmp_path / "stream_result.npz")
+    strm.save(path)
+    back = PreservationResult.load(path)
+    assert back.nulls is None
+    np.testing.assert_array_equal(back.counts_hi, strm.counts_hi)
+    np.testing.assert_array_equal(back.p_values, strm.p_values)
+    # materialized results still round-trip with nulls and no counts
+    mat.save(path)
+    back_m = PreservationResult.load(path)
+    assert back_m.nulls is not None and back_m.counts_hi is None
+
+
+def test_module_preservation_adaptive_streaming(api_kwargs):
+    from netrep_tpu import module_preservation
+
+    am = module_preservation(**api_kwargs, adaptive=True)
+    asr = module_preservation(**api_kwargs, adaptive=True,
+                              store_nulls=False)
+    assert asr.p_type == "sequential" and asr.nulls is None
+    np.testing.assert_array_equal(am.n_perm_used, asr.n_perm_used)
+    np.testing.assert_array_equal(am.p_values, asr.p_values)
+    np.testing.assert_array_equal(am.module_n_perm(), asr.module_n_perm())
+
+
+def test_combine_analyses_pools_counts(api_kwargs):
+    from netrep_tpu import module_preservation
+    from netrep_tpu.models.results import combine_analyses
+
+    s1 = module_preservation(**api_kwargs, store_nulls=False)
+    s2 = module_preservation(**{**api_kwargs, "seed": 12},
+                             store_nulls=False)
+    comb = combine_analyses(s1, s2)
+    assert comb.nulls is None
+    np.testing.assert_array_equal(comb.counts_hi, s1.counts_hi + s2.counts_hi)
+    np.testing.assert_array_equal(
+        comb.counts_eff, s1.counts_eff + s2.counts_eff
+    )
+    assert comb.completed == s1.completed + s2.completed
+    # mixed merge: the materialized input is lifted into count space, so
+    # the pooled p-values equal the all-streaming merge of the same runs
+    m2 = module_preservation(**{**api_kwargs, "seed": 12})
+    comb_mixed = combine_analyses(s1, m2)
+    np.testing.assert_array_equal(comb_mixed.p_values, comb.p_values)
+
+
+def test_store_nulls_false_rejects_native_backend(api_kwargs):
+    from netrep_tpu import module_preservation
+
+    kw = {k: v for k, v in api_kwargs.items() if k != "data"}
+    with pytest.raises(ValueError, match="store_nulls=False requires"):
+        module_preservation(**kw, backend="native", store_nulls=False)
+
+
+def test_vmap_tests_streaming_parity(toy_pair_module):
+    from netrep_tpu import module_preservation
+    from netrep_tpu.data import pair_frames
+
+    d, t = pair_frames(toy_pair_module)
+    kw = dict(
+        network={"d": d["network"], "t1": t["network"],
+                 "t2": t["network"]},
+        data={"d": d["data"], "t1": t["data"], "t2": t["data"]},
+        correlation={"d": d["correlation"], "t1": t["correlation"],
+                     "t2": t["correlation"]},
+        module_assignments=dict(toy_pair_module["labels"]),
+        discovery="d", test=["t1", "t2"], n_perm=200, seed=3,
+        config=EngineConfig(chunk_size=64, superchunk=2, autotune=False),
+        vmap_tests=True, simplify=False,
+    )
+    rm = module_preservation(**kw)
+    rs = module_preservation(**kw, store_nulls=False)
+    for t_name in ("t1", "t2"):
+        assert rs["d"][t_name].nulls is None
+        np.testing.assert_array_equal(
+            rm["d"][t_name].p_values, rs["d"][t_name].p_values
+        )
+
+
+# ---------------------------------------------------------------------------
+# multi-test engine parity
+# ---------------------------------------------------------------------------
+
+def test_multitest_streaming_parity():
+    from netrep_tpu.parallel.multitest import MultiTestEngine
+
+    mixed = make_mixed_pair(200, 4, n_samples=36, seed=5)
+    (dd, dc, dn) = mixed["discovery"]
+    (td, tc, tn) = mixed["test"]
+    (td2, tc2, tn2) = make_mixed_pair(200, 4, n_samples=36, seed=6)["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    cfg = EngineConfig(chunk_size=64, summary_method="eigh", superchunk=2,
+                      autotune=False)
+
+    def make():
+        return MultiTestEngine(
+            dc, dn, dd, np.stack([tc, tc2]), np.stack([tn, tn2]),
+            [td, td2], specs, mixed["pool"], config=cfg,
+        )
+
+    eng = make()
+    observed = np.asarray(eng.observed())   # (2, K, 7)
+    nulls, done = eng.run_null(200, key=0)  # 200: partial tail superchunk
+    # tail_counts wants the perm axis leading
+    perm_first = np.asarray(nulls)[:, :done].transpose(1, 0, 2, 3)
+    hi, lo, eff = pv.tail_counts(observed, perm_first)
+    sc = make().run_null_streaming(200, observed, key=0)
+    np.testing.assert_array_equal(sc.hi, hi)
+    np.testing.assert_array_equal(sc.lo, lo)
+    np.testing.assert_array_equal(sc.eff, eff)
+
+    nulls_a, done_a, fin = make().run_null_adaptive(600, observed, key=0)
+    sca = make().run_null_adaptive_streaming(600, observed, key=0)
+    assert sca.finished == fin
+    pf = np.asarray(nulls_a)[:, :done_a].transpose(1, 0, 2, 3)
+    hi_a, lo_a, eff_a = pv.tail_counts(observed, pf)
+    np.testing.assert_array_equal(sca.hi, hi_a)
+    np.testing.assert_array_equal(sca.lo, lo_a)
+    np.testing.assert_array_equal(sca.eff, eff_a)
+    for ti in range(2):
+        p_m, _ = pv.sequential_pvalues(observed[ti],
+                                       np.asarray(nulls_a)[ti, :done_a])
+        p_s = pv.counts_pvalues(observed[ti], sca.hi[ti], sca.lo[ti],
+                                sca.eff[ti])
+        np.testing.assert_array_equal(p_m, p_s)
+
+
+# ---------------------------------------------------------------------------
+# mesh composition
+# ---------------------------------------------------------------------------
+
+def test_streaming_parity_on_perm_mesh(mixed):
+    from netrep_tpu.parallel import mesh as meshmod
+
+    cfg = EngineConfig(chunk_size=32, summary_method="eigh", superchunk=2,
+                       autotune=False)
+    mesh = meshmod.make_mesh(n_perm_shards=4)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    eng = PermutationEngine(dc, dn, dd, tc, tn, td, specs, mixed["pool"],
+                            config=cfg, mesh=mesh)
+    observed = np.asarray(eng.observed())
+    nulls, done = eng.run_null(100, key=0)
+    hi, lo, eff = pv.tail_counts(observed, np.asarray(nulls)[:done])
+    sc = eng.run_null_streaming(100, observed, key=0)
+    np.testing.assert_array_equal(sc.hi, hi)
+    np.testing.assert_array_equal(sc.lo, lo)
+    np.testing.assert_array_equal(sc.eff, eff)
+
+
+def test_streaming_parity_fused_shard_map(mixed):
+    """gather_mode='fused' + perm-axis mesh: the streaming program runs
+    under shard_map with per-shard masks and psum'd counts — the exotic
+    composition most likely to drift from the chunk loop."""
+    from netrep_tpu.parallel import mesh as meshmod
+
+    cfg = EngineConfig(chunk_size=32, summary_method="eigh", superchunk=2,
+                       autotune=False, gather_mode="fused")
+    mesh = meshmod.make_mesh(n_perm_shards=4)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    eng = PermutationEngine(dc, dn, dd, tc, tn, td, specs, mixed["pool"],
+                            config=cfg, mesh=mesh)
+    observed = np.asarray(eng.observed())
+    nulls, done = eng.run_null(80, key=0)  # partial tail chunk
+    hi, lo, eff = pv.tail_counts(observed, np.asarray(nulls)[:done])
+    sc = eng.run_null_streaming(80, observed, key=0)
+    np.testing.assert_array_equal(sc.hi, hi)
+    np.testing.assert_array_equal(sc.lo, lo)
+    np.testing.assert_array_equal(sc.eff, eff)
+
+
+# ---------------------------------------------------------------------------
+# satellites: tail-shard trim + throughput recording from 2 marks
+# ---------------------------------------------------------------------------
+
+class _FakeSharding:
+    def __init__(self, shard_rows):
+        self._rows = shard_rows
+
+    def shard_shape(self, shape):
+        return (self._rows,) + tuple(shape[1:])
+
+
+class _FakeGlobalArray:
+    """Stand-in for a multi-host (non-fully-addressable) chunk output —
+    CI has no second host, so the trim logic is pinned structurally."""
+
+    is_fully_addressable = False
+
+    def __init__(self, arr, shard_rows):
+        self._arr = arr
+        self.sharding = _FakeSharding(shard_rows)
+
+    @property
+    def shape(self):
+        return self._arr.shape
+
+    @property
+    def ndim(self):
+        return self._arr.ndim
+
+    def __getitem__(self, sel):
+        return self._arr[sel]
+
+
+def test_trim_tail_shards_slices_whole_shards_only():
+    base = np.arange(64 * 3 * 7, dtype=np.float64).reshape(64, 3, 7)
+    # single-host arrays (fully addressable) are NEVER sliced — eager-op
+    # avoidance on tunneled backends
+    out = _trim_tail_shards(base, 10)
+    assert out is base
+    # multi-host tail: keep ceil(take/shard_rows) whole shards
+    fake = _FakeGlobalArray(base, shard_rows=16)
+    trimmed = _trim_tail_shards(fake, 10)
+    assert trimmed.shape == (16, 3, 7)
+    np.testing.assert_array_equal(trimmed, base[:16])
+    trimmed = _trim_tail_shards(fake, 17)
+    assert trimmed.shape == (32, 3, 7)
+    # full chunk: untouched
+    assert _trim_tail_shards(fake, 64) is fake
+    # take aligned past the last shard boundary: untouched
+    assert _trim_tail_shards(fake, 49) is fake or \
+        _trim_tail_shards(fake, 49).shape == (64, 3, 7)
+
+
+def test_throughput_recorded_from_two_chunks(mixed, tmp_path,
+                                             monkeypatch):
+    """Satellite: a 2-chunk run must feed the autotune cache (the old
+    `>= 3` mark guard silently dropped it)."""
+    from netrep_tpu.utils import autotune
+
+    monkeypatch.setattr(
+        autotune, "default_path",
+        lambda: str(tmp_path / "autotune.json"),
+    )
+    cfg = EngineConfig(chunk_size=64, summary_method="eigh", autotune=True)
+    eng = _engine(mixed, cfg)
+    eng.run_null(128, key=0)  # exactly 2 chunks
+    cache = autotune.AutotuneCache()
+    key, pb = eng._autotune_record[1], eng._autotune_record[2]
+    assert cache.throughput(key, pb), "2-chunk run did not record"
