@@ -57,6 +57,16 @@ TARGET_SECONDS = 60.0  # BASELINE.json:5 north-star
 #: configs (C/D/E) emit an explicit skip row instead of running for hours
 #: on CPU (see main()).
 TPU_FALLBACK = False
+#: telemetry JSONL path when --telemetry / NETREP_TELEMETRY is set: an
+#: ambient netrep_tpu.utils.telemetry.Telemetry bus is activated for the
+#: whole bench process, so engine runs emit per-chunk/superchunk events
+#: beside the metric row — BENCH trajectories then carry per-phase
+#: breakdowns, not just wall-clock (ISSUE 3). The metric row names the
+#: file so the two stay linked.
+TELEMETRY_PATH = None
+#: the live ambient-activation context manager (held for the process
+#: lifetime; see the --telemetry block in main())
+_TEL_CM = None
 
 
 def ensure_backend(probe_timeout: float | None = None):
@@ -233,6 +243,8 @@ def timed_null(engine, n_perm, chunk, **kw):
 
 
 def emit(payload):
+    if TELEMETRY_PATH and isinstance(payload, dict):
+        payload.setdefault("telemetry", TELEMETRY_PATH)
     print(json.dumps(payload))
     return 0
 
@@ -946,6 +958,12 @@ def main():
                          "direct-batched and fused only)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config for a fast correctness pass")
+    ap.add_argument("--telemetry", default=None, metavar="PATH",
+                    help="append structured run-telemetry events (JSONL) "
+                         "here; the metric row gains a 'telemetry' pointer. "
+                         "Defaults from NETREP_TELEMETRY (the tpu_watch.sh "
+                         "loop sets it). Aggregate with `python -m "
+                         "netrep_tpu telemetry PATH`")
     ap.add_argument("--cap-granularity", type=int, default=32,
                     help="EngineConfig.cap_granularity: bucket capacities "
                          "round to multiples of this (8 trims ~11%% of the "
@@ -977,6 +995,29 @@ def main():
         # timeout and mislabeled a dead tunnel. oracle/native force CPU
         # themselves and are exempt either way.
         return run_shielded(args)
+
+    tel_path = args.telemetry or os.environ.get("NETREP_TELEMETRY")
+    if tel_path:
+        # ambient bus for the whole bench process: engine loops, backend
+        # probes, autotune lookups and checkpoint saves all emit to it
+        # (activated AFTER the shield dispatch — the shield parent only
+        # babysits the child, which activates its own)
+        global TELEMETRY_PATH
+        TELEMETRY_PATH = tel_path
+        import atexit
+
+        from netrep_tpu.utils.telemetry import Telemetry
+
+        _tel = Telemetry(
+            tel_path, run_id=f"bench-{args.config}-{os.getpid()}"
+        )
+        # keep the context-manager object referenced for the process
+        # lifetime: a discarded generator-CM is closed on GC, which would
+        # silently deactivate the ambient bus
+        global _TEL_CM
+        _TEL_CM = _tel.activate()
+        _TEL_CM.__enter__()
+        atexit.register(_tel.close)
 
     if args.config == "sharded":
         # dispatch BEFORE ensure_backend(): libtpu is exclusive per process,

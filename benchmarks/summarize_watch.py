@@ -10,6 +10,13 @@ measurement plus a markdown table snippet for BASELINE.md's Measured
 section. Rows without a device field are listed separately as
 unknown-provenance, never as clean results.
 
+Telemetry event lines (the `netrep_tpu.utils.telemetry` JSONL schema —
+``{"v": 1, ..., "ev": ..., "data": {...}}``; the watcher points bench at a
+``*_telemetry.jsonl`` sibling via NETREP_TELEMETRY, but mixed logs work
+too) are recognized and summarized as a per-phase time split — so watch
+summaries show where each measurement window's wall-clock went (observed
+vs chunks vs superchunks vs checkpoints), not just the final number.
+
 Usage: python benchmarks/summarize_watch.py [logfile ...]
        (default: benchmarks/tpu_results_r5.jsonl + r4)
 """
@@ -18,6 +25,11 @@ from __future__ import annotations
 
 import json
 import sys
+
+#: telemetry event-schema version this summarizer understands (mirrors
+#: netrep_tpu.utils.telemetry.SCHEMA_VERSION; kept literal so the script
+#: stays standalone-runnable without the package on sys.path)
+TELEMETRY_SCHEMA = 1
 
 
 def rows_from(path: str) -> list[dict]:
@@ -41,6 +53,11 @@ def rows_from(path: str) -> list[dict]:
 
 
 def classify(row: dict) -> str:
+    if (row.get("v") == TELEMETRY_SCHEMA and isinstance(row.get("ev"), str)
+            and isinstance(row.get("data"), dict)):
+        # structured telemetry event (netrep_tpu.utils.telemetry): not a
+        # measurement row — aggregated into the per-phase split instead
+        return "telemetry"
     if row.get("tpu_fallback") or "error" in row or "warning" in row:
         return "dropped"
     if row.get("cached"):
@@ -71,8 +88,22 @@ def classify(row: dict) -> str:
     return "other"
 
 
+def telemetry_split(rows: list[dict]) -> dict:
+    """Per-phase time split of telemetry events: ``{ev: [n, total_s]}``
+    over every event carrying a numeric ``s`` duration (chunk, superchunk,
+    observed, pair, null_run_end, allgather, backend_probe...)."""
+    per: dict[str, list] = {}
+    for r in rows:
+        s = (r.get("data") or {}).get("s")
+        if isinstance(s, (int, float)) and not isinstance(s, bool):
+            agg = per.setdefault(r["ev"], [0, 0.0])
+            agg[0] += 1
+            agg[1] += float(s)
+    return per
+
+
 def main(paths: list[str]) -> int:
-    results, unknown, other, dropped = [], [], [], 0
+    results, unknown, other, dropped, telemetry = [], [], [], 0, []
     for p in paths:
         for r in rows_from(p):
             kind = classify(r)
@@ -84,6 +115,17 @@ def main(paths: list[str]) -> int:
                 other.append((p, r))
             elif kind == "result":
                 results.append((p, r))
+            elif kind == "telemetry":
+                telemetry.append(r)
+    if telemetry:
+        split = telemetry_split(telemetry)
+        print(f"## telemetry per-phase time split ({len(telemetry)} events)")
+        total = sum(v[1] for v in split.values()) or 1.0
+        for ev in sorted(split, key=lambda k: -split[k][1]):
+            n, s = split[ev]
+            print(f"{ev}: {s:.3f}s over {n} event(s) "
+                  f"({100 * s / total:.0f}% of timed phases)")
+        print()
     if dropped:
         print(f"# dropped {dropped} fallback/error/warning/CPU/not-ok rows "
               "(never transcribe those as TPU numbers)", file=sys.stderr)

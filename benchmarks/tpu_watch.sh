@@ -14,6 +14,14 @@ set -u
 cd "$(dirname "$0")/.."
 LOG=${1:-/tmp/tpu_bench_results.jsonl}
 STATE=${2:-/tmp/tpu_watch_state}
+# Structured run telemetry (ISSUE 3): every bench step appends events to
+# $TELEMETRY (bench.py reads NETREP_TELEMETRY), and after each step the
+# aggregate is re-rendered as a Prometheus text exposition at $PROM so a
+# node scraper / textfile collector can watch the loop's progress. Both
+# are best-effort: a missing python or empty log skips silently.
+TELEMETRY=${TELEMETRY:-${LOG%.jsonl}_telemetry.jsonl}
+PROM=${PROM:-${TELEMETRY%.jsonl}.prom}
+export NETREP_TELEMETRY="$TELEMETRY"
 # 45/45 defaults (was 60/150): windows run ~5-7 min, so a dead-tunnel
 # probe cycle must stay well under a window or most of it is lost before
 # the queue even starts (BASELINE.md measurement-session note). A live
@@ -162,6 +170,12 @@ while :; do
       timeout "$tmo" env NETREP_BENCH_NO_SUBPROC=1 PYTHONUNBUFFERED=1 bash -c "$cmd" 2>&1 \
         | grep -v WARNING | tee -a "$LOG" "$step_out"
       rc=${PIPESTATUS[0]}
+      # refresh the Prometheus exposition from the telemetry log (scrape
+      # surface of the loop); never lets a render failure mark a step
+      if [ -s "$TELEMETRY" ]; then
+        timeout 60 python -m netrep_tpu telemetry "$TELEMETRY" --prom \
+          >"$PROM.tmp" 2>/dev/null && mv "$PROM.tmp" "$PROM" || rm -f "$PROM.tmp"
+      fi
       # bench.py exits 0 on its own probe-race CPU-fallback rows, and the
       # benchmark scripts that share bench.ensure_backend print its stderr
       # "falling back to CPU" warning without the JSON marker; marking

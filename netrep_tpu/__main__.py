@@ -8,6 +8,11 @@ backend) needs the equivalent one-liner. Commands:
   default backend and exit nonzero on any device-vs-oracle disagreement
   (tolerances are backend-conditional; see utils/selftest.py).
 - ``version`` — print the package version.
+- ``telemetry <run.jsonl>`` — aggregate a telemetry event log (ISSUE 3;
+  written by ``module_preservation(telemetry=...)`` or ``bench.py
+  --telemetry``) into the human summary table offline; ``--prom`` emits
+  the Prometheus text exposition instead, ``--json`` the raw registry.
+  Runs without touching any backend — safe on a box whose tunnel is dead.
 """
 
 from __future__ import annotations
@@ -37,12 +42,41 @@ def main(argv=None) -> int:
     st.add_argument("--json", action="store_true",
                     help="print the summary dict as one JSON line")
     sub.add_parser("version", help="print the package version")
+    tl = sub.add_parser(
+        "telemetry", help="aggregate a telemetry JSONL into a summary report"
+    )
+    tl.add_argument("path", help="telemetry event log (JSONL)")
+    tl.add_argument("--prom", action="store_true",
+                    help="Prometheus text exposition instead of the table")
+    tl.add_argument("--json", action="store_true",
+                    help="aggregated registry as one JSON line")
     args = ap.parse_args(argv)
     if args.cmd is None:
         # bare invocation = selftest with its own argparse defaults (ONE
         # source of defaults; bare flags are not supported — subcommand
         # flags belong after `selftest`)
         args = ap.parse_args(["selftest", *(argv or [])])
+
+    if args.cmd == "telemetry":
+        # pure-offline aggregation: must not resolve a backend (this is
+        # the report you run precisely when the tunnel is dead)
+        from netrep_tpu.utils.telemetry import aggregate_file
+
+        try:
+            reg = aggregate_file(args.path)
+        except OSError as e:
+            print(f"cannot read {args.path!r}: {e}", file=sys.stderr)
+            return 1
+        if reg.n_events == 0:
+            print(f"no telemetry events in {args.path!r}", file=sys.stderr)
+            return 1
+        if args.prom:
+            sys.stdout.write(reg.render_prometheus())
+        elif args.json:
+            print(json.dumps(reg.as_dict()))
+        else:
+            print(reg.render_summary())
+        return 0
 
     import netrep_tpu
 

@@ -15,14 +15,17 @@ because XLA owns device parallelism (SURVEY.md §2.3 intra-op row).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
+import time
 from typing import Callable
 
 import numpy as np
 
 from ..ops import pvalues as pv
 from ..parallel.engine import ModuleSpec, PermutationEngine
+from ..utils import telemetry as tm
 from ..utils.config import EngineConfig
 from ..utils.profiling import PairTimer, device_trace, resolve_profile_dir
 from . import dataset as ds
@@ -144,6 +147,7 @@ def module_preservation(
     adaptive: bool = False,
     adaptive_rule=None,
     store_nulls: bool = True,
+    telemetry=None,
 ):
     """Permutation test of network module preservation across datasets.
 
@@ -202,6 +206,22 @@ def module_preservation(
       median) to each result as ``result.profile``. Inspect the trace with
       TensorBoard/Perfetto or
       :func:`netrep_tpu.utils.profiling.summarize_trace`.
+    - ``telemetry`` — unified run telemetry (ISSUE 3;
+      :mod:`netrep_tpu.utils.telemetry`): ``True`` appends structured
+      events (run/pair/observed spans, per-chunk and per-superchunk
+      dispatch+transfer counters, checkpoint saves/resumes, adaptive
+      retirements, backend fallbacks, stall-watchdog alerts) to
+      ``./netrep_telemetry.jsonl``; a string names the JSONL path; an
+      existing :class:`~netrep_tpu.utils.telemetry.Telemetry` bus is used
+      as-is. While the run executes, the bus is also *ambient*, so every
+      layer (engine loops, checkpoints, autotune, backend) emits to it. A
+      stall watchdog is armed per null run: when no chunk completes within
+      ``stall_factor``× the measured steady-state chunk time it emits
+      ``stall_suspected`` and warns once — the dead-tunnel hang the
+      backend code documents. Aggregate the file offline with
+      ``python -m netrep_tpu telemetry <run.jsonl>``. Off by default;
+      disabled runs are bit-identical and pay only a ``None`` check.
+      ``result.profile`` gains a ``"telemetry"`` pointer to the sink path.
 
     Returns
     -------
@@ -270,19 +290,37 @@ def module_preservation(
     trace_dir = resolve_profile_dir(profile)
     profiling = profile is not None and profile is not False
 
+    tel, tel_owned = tm.resolve_arg(telemetry)
+
     results: dict[str, dict[str, PreservationResult]] = {}
     interrupted = False
     trace_cm = device_trace(trace_dir)
     trace_cm.__enter__()  # covers every pair's device work; closed below
+    tel_cm = tel.activate() if tel is not None else None
+    if tel_cm is not None:
+        tel_cm.__enter__()  # ambient for every layer below (engine loops,
+        # checkpoints, autotune, backend) — closed below
+        tel.emit(
+            "run_start", pairs=sum(len(v) for v in by_disc.values()),
+            null=null, alternative=alternative, adaptive=bool(adaptive),
+            store_nulls=bool(store_nulls), backend=backend, seed=int(seed),
+        )
     try:
-        return _run_pairs(
+        out = _run_pairs(
             by_disc, datasets, assign, modules, background_label, null,
             alternative, n_perm, auto_n_perm, engine_cls, config, mesh,
             vmap_tests, backend, seed, progress, ckpt_path, checkpoint_every,
             verbose, simplify, results, trace_dir, profiling,
-            adaptive, adaptive_rule, store_nulls,
+            adaptive, adaptive_rule, store_nulls, tel,
         )
+        if tel is not None:
+            tel.emit("run_end", pairs_done=sum(len(v) for v in results.values()))
+        return out
     finally:
+        if tel_cm is not None:
+            tel_cm.__exit__(None, None, None)
+            if tel_owned:
+                tel.close()
         trace_cm.__exit__(None, None, None)
 
 
@@ -291,9 +329,28 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                vmap_tests, backend, seed, progress, ckpt_path,
                checkpoint_every, verbose, simplify, results, trace_dir,
                profiling, adaptive=False, adaptive_rule=None,
-               store_nulls=True):
+               store_nulls=True, tel=None):
     """Pair-loop body of :func:`module_preservation` (split out so the
     profiler trace context can bracket it without deep nesting)."""
+
+    def observed_span(d_name, t_name, n_modules):
+        """Telemetry span over one pair's observed pass (no-op when off)."""
+        if tel is None:
+            return contextlib.nullcontext()
+        return tel.span(
+            "observed", discovery=str(d_name), test=str(t_name),
+            n_modules=int(n_modules),
+        )
+
+    def attach_telemetry(prof):
+        """``result.profile`` gains a pointer to the telemetry sink, so a
+        result object always names the event log that explains its run."""
+        if tel is None or tel.path is None:
+            return prof
+        prof = dict(prof or {})
+        prof.setdefault("telemetry", tel.path)
+        prof.setdefault("telemetry_run", tel.run_id)
+        return prof
 
     def run_pair_null(engine, np_this, observed, prog, ck):
         """One pair's null: fixed (default, bit-identical to previous
@@ -374,6 +431,13 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                     "discovery %r → tests %s (vmapped): %d modules, %d "
                     "permutations", d_name, t_names, len(labels), np_this,
                 )
+            t_pair0 = time.perf_counter()
+            if tel is not None:
+                tel.emit(
+                    "pair_start", discovery=str(d_name),
+                    test="+".join(map(str, t_names)), vmapped=True,
+                    n_modules=len(labels), n_perm=int(np_this),
+                )
             engine = MultiTestEngine(
                 disc_ds.correlation, disc_ds.network, disc_ds.data,
                 np.stack([datasets[t].correlation for t in t_names]),
@@ -382,17 +446,29 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                 mod_specs, pool, config=config, mesh=mesh,
             )
             timer = PairTimer(trace_dir) if profiling else None
-            observed = (
-                timer.time_observed(engine.observed) if timer
-                else engine.observed()
-            )
+            with observed_span(d_name, "+".join(map(str, t_names)),
+                               len(labels)):
+                observed = (
+                    timer.time_observed(engine.observed) if timer
+                    else engine.observed()
+                )
             nulls, stream, completed, interrupted = run_pair_null(
                 engine, np_this, observed,
                 (timer.wrap_progress(pair_progress())
                  if timer else pair_progress()),
                 ckpt_path(d_name, "+".join(t_names)),
             )
-            prof_dict = timer.finish_null(completed) if timer else None
+            prof_dict = attach_telemetry(
+                timer.finish_null(completed) if timer else None
+            )
+            if tel is not None:
+                tel.emit(
+                    "pair_end", discovery=str(d_name),
+                    test="+".join(map(str, t_names)),
+                    s=time.perf_counter() - t_pair0,
+                    completed=int(completed),
+                    interrupted=bool(interrupted),
+                )
             if interrupted:
                 logger.warning(
                     "interrupted after %d/%d permutations; p-values use the "
@@ -431,27 +507,44 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                     "discovery %r → test %r: %d modules, %d permutations, "
                     "null=%r", d_name, t_name, len(labels), np_this, null,
                 )
+            t_pair0 = time.perf_counter()
+            if tel is not None:
+                tel.emit(
+                    "pair_start", discovery=str(d_name), test=str(t_name),
+                    vmapped=False, n_modules=len(labels),
+                    n_perm=int(np_this),
+                )
             engine = engine_cls(
                 disc_ds.correlation, disc_ds.network, disc_ds.data,
                 test_ds.correlation, test_ds.network, test_ds.data,
                 mod_specs, pool, config=config, mesh=mesh,
             )
             timer = PairTimer(trace_dir) if profiling else None
-            observed = (
-                timer.time_observed(engine.observed) if timer
-                else engine.observed()
-            )
+            with observed_span(d_name, t_name, len(labels)):
+                observed = (
+                    timer.time_observed(engine.observed) if timer
+                    else engine.observed()
+                )
             nulls, stream, completed, was_interrupted = run_pair_null(
                 engine, np_this, observed,
                 (timer.wrap_progress(pair_progress())
                  if timer else pair_progress()),
                 ckpt_path(d_name, t_name),
             )
+            if tel is not None:
+                tel.emit(
+                    "pair_end", discovery=str(d_name), test=str(t_name),
+                    s=time.perf_counter() - t_pair0,
+                    completed=int(completed),
+                    interrupted=bool(was_interrupted),
+                )
             total_space = pv.total_permutations(pool.size, [m.size for m in mod_specs])
             results.setdefault(d_name, {})[t_name] = _make_result(
                 d_name, t_name, labels, counts, observed, nulls, completed,
                 np_this, alternative, total_space,
-                profile=timer.finish_null(completed) if timer else None,
+                profile=attach_telemetry(
+                    timer.finish_null(completed) if timer else None
+                ),
                 p_type="sequential" if adaptive else "fixed",
                 stream=stream,
             )

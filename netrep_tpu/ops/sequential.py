@@ -124,6 +124,11 @@ class StopMonitor:
         self.eff: np.ndarray | None = None
         self.n_used = np.zeros(k, dtype=np.int64)
         self.active = np.ones(k, dtype=bool)
+        #: optional :class:`~netrep_tpu.utils.telemetry.Telemetry` bus the
+        #: adaptive loops attach — retirement decisions are emitted HERE
+        #: (the tallies live here) as one ``module_retired`` event per
+        #: retired module, carrying its per-cell exceedance tallies
+        self.telemetry = None
         #: total permutation indices folded so far — always a whole number
         #: of chunks. May lag the loop's `completed` counter by one chunk
         #: when an interrupt lands between the null write and the fold; the
@@ -247,6 +252,7 @@ class StopMonitor:
         )
         newly = pos[self._decided(pos)]
         self.active[newly] = False
+        self._emit_retired(newly)
         return newly
 
     def update_counts(
@@ -309,7 +315,25 @@ class StopMonitor:
         )
         newly = pos[self._decided(pos)]
         self.active[newly] = False
+        self._emit_retired(newly)
         return newly
+
+    def _emit_retired(self, newly: np.ndarray) -> None:
+        """Telemetry for each freshly-retired module: its per-cell
+        exceedance tallies and permutation count at the decision point —
+        the machine-readable record of WHY the adaptive run stopped
+        drawing for it (ISSUE 3). No bus attached = no cost."""
+        if self.telemetry is None or not newly.size:
+            return
+        for p in newly:
+            p = int(p)
+            self.telemetry.emit(
+                "module_retired", module=p,
+                n_perm_used=int(self.n_used[p]),
+                folded=int(self.folded),
+                hi=self.hi[p].tolist(), lo=self.lo[p].tolist(),
+                n_active_left=int(self.active.sum()),
+            )
 
     def _decided(self, pos: np.ndarray) -> np.ndarray:
         """Per-module decision mask for the modules at ``pos``: every

@@ -106,6 +106,17 @@ def initialize(
                 "device(s)", jax.process_index(), jax.process_count(),
                 jax.local_device_count(),
             )
+            from ..utils.telemetry import current as _tel
+
+            tel = _tel()
+            if tel is not None:
+                tel.emit(
+                    "distributed_init",
+                    process_id=jax.process_index(),
+                    process_count=jax.process_count(),
+                    local_devices=jax.local_device_count(),
+                    global_devices=jax.device_count(),
+                )
     return {
         "process_id": jax.process_index(),
         "process_count": jax.process_count(),
@@ -143,7 +154,19 @@ def gather_to_host(x):
     # outputs, for which process_allgather would take its host-local branch
     # and concatenate copies across processes instead of replicating.
     if not getattr(x, "is_fully_addressable", True):
+        import time
+
         from jax.experimental import multihost_utils
 
+        from ..utils.telemetry import current as _tel
+
+        t0 = time.perf_counter()
         x = multihost_utils.process_allgather(x, tiled=True)
+        tel = _tel()
+        if tel is not None:
+            # the cross-host DCN hop of null collection: per-allgather
+            # timing makes a slow host / sick DCN link visible per event
+            # instead of only in the run's total (ISSUE 3)
+            tel.emit("allgather", s=time.perf_counter() - t0,
+                     bytes=int(getattr(x, "nbytes", 0)))
     return np.asarray(x)

@@ -41,9 +41,31 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import stats as jstats
 from ..ops.oracle import N_STATS
+from ..utils import telemetry as tm
 from ..utils.config import EngineConfig
 
 logger = logging.getLogger("netrep_tpu")
+
+
+def _telemetry_profile(telemetry, profile):
+    """Resolve the run's telemetry bus (explicit or ambient, ONCE — the
+    disabled hot path pays a single ``None`` check per run) and, when
+    telemetry is on, ensure a :class:`~netrep_tpu.utils.profiling.NullProfile`
+    exists so dispatch/host-byte counters can fold into chunk events even
+    when the caller didn't ask for one."""
+    tel = tm.resolve(telemetry)
+    if tel is not None and profile is None:
+        from ..utils.profiling import NullProfile
+
+        profile = NullProfile()
+    return tel, profile
+
+
+def _profile_totals(profile) -> tuple[int, int]:
+    return (
+        (profile.dispatches, profile.host_bytes)
+        if profile is not None else (0, 0)
+    )
 
 
 def run_checkpointed_chunks(
@@ -61,6 +83,7 @@ def run_checkpointed_chunks(
     perm_axis: int = 0,
     fingerprint_extra: bytes = b"",
     profile=None,
+    telemetry=None,
 ) -> tuple[np.ndarray, int]:
     """The single chunked/interruptible/checkpointable null loop shared by
     :class:`PermutationEngine` and ``MultiTestEngine`` (one implementation so
@@ -75,9 +98,14 @@ def run_checkpointed_chunks(
     wrappers whose problem has extra structure (e.g. the test-dataset count);
     ``profile`` (a :class:`~netrep_tpu.utils.profiling.NullProfile`) counts
     the dispatches this loop issues — two per chunk: key derivation + the
-    chunk program (host-transfer bytes are counted by ``write``).
+    chunk program (host-transfer bytes are counted by ``write``);
+    ``telemetry`` (a :class:`~netrep_tpu.utils.telemetry.Telemetry`, or the
+    ambient bus when None) gets per-chunk events with the profile's
+    dispatch/host-byte deltas folded in, a run start/end envelope, and a
+    stall watchdog armed for the run.
     """
     key = _resolve_key(base, key)
+    telemetry, profile = _telemetry_profile(telemetry, profile)
 
     save = None
     loaded = None
@@ -113,6 +141,12 @@ def run_checkpointed_chunks(
     # throughput between the first and last marks (first chunk's compile
     # excluded) feeds the persistent autotune cache (utils/autotune.py)
     t_marks: list[tuple[int, float]] = []
+    wd = tm.arm_watchdog(telemetry)
+    prev_t = t_run0 = time.perf_counter()
+    d0, b0 = prev_d, prev_b = _profile_totals(profile)
+    if telemetry is not None:
+        telemetry.emit("null_run_start", mode="materialized",
+                       n_perm=int(n_perm), start_perm=int(start_perm))
     try:
         while dispatched < n_perm or pending is not None:
             nxt = None
@@ -128,6 +162,16 @@ def run_checkpointed_chunks(
                 write(nulls, outs, at, take_p)
                 completed = at + take_p
                 t_marks.append((completed, time.perf_counter()))
+                if telemetry is not None:
+                    now = t_marks[-1][1]
+                    d, b = _profile_totals(profile)
+                    telemetry.emit(
+                        "chunk", done=int(completed), total=int(n_perm),
+                        take=int(take_p), s=now - prev_t,
+                        dispatches=d - prev_d, host_bytes=b - prev_b,
+                    )
+                    prev_t, prev_d, prev_b = now, d, b
+                    wd.beat()
                 if progress is not None:
                     progress(completed, n_perm)
                 if save is not None and completed - last_saved >= checkpoint_every:
@@ -148,8 +192,18 @@ def run_checkpointed_chunks(
                 completed = at + take_p
             except KeyboardInterrupt:
                 pass
+    finally:
+        if wd is not None:
+            wd.stop()
     if save is not None and completed > last_saved:
         save(nulls, completed)
+    if telemetry is not None:
+        d, b = _profile_totals(profile)
+        telemetry.emit(
+            "null_run_end", mode="materialized", completed=int(completed),
+            n_perm=int(n_perm), s=time.perf_counter() - t_run0,
+            dispatches=d - d0, host_bytes=b - b0,
+        )
     record = getattr(base, "record_chunk_throughput", None)
     if record is not None:
         if len(t_marks) >= 2:
@@ -339,6 +393,7 @@ def run_stream_superchunks(
     checkpoint_every: int = 8192,
     fingerprint_extra: bytes = b"",
     profile=None,
+    telemetry=None,
 ) -> StreamCounts:
     """Fixed-``n_perm`` streaming loop shared by :class:`PermutationEngine`
     and ``MultiTestEngine``: dispatch one scan-fused superchunk of
@@ -360,8 +415,12 @@ def run_stream_superchunks(
     A ``KeyboardInterrupt`` returns the tallies of the last completed
     superchunk (the tally fold and the ``completed`` counter commit in one
     statement), mirroring the materialized loop's clean Ctrl-C contract.
+    ``telemetry`` gets one ``superchunk`` event per fused dispatch (the
+    dispatch/host-byte counters :class:`NullProfile` folds) plus the run
+    envelope and a stall watchdog, exactly like the materialized loop.
     """
     key = _resolve_key(base, key)
+    telemetry, profile = _telemetry_profile(telemetry, profile)
     K, C = int(superchunk), int(chunk_size)
     completed = 0
     host0 = None
@@ -396,6 +455,14 @@ def run_stream_superchunks(
     hi = lo = eff = None
     last_saved = completed
     t_marks: list[tuple[int, float]] = []
+    wd = tm.arm_watchdog(telemetry)
+    prev_t = t_run0 = time.perf_counter()
+    d0, b0 = _profile_totals(profile)
+    if telemetry is not None:
+        telemetry.emit(
+            "null_run_start", mode="streaming", n_perm=int(n_perm),
+            start_perm=int(completed), superchunk=K, chunk=C,
+        )
     try:
         while completed < n_perm:
             take = min(K * C, n_perm - completed)
@@ -417,6 +484,15 @@ def run_stream_superchunks(
                 profile.record_dispatch(2)  # key derivation + superchunk
                 profile.record_transfer(nbytes)
                 profile.record_superchunk(2, nbytes, take)
+            if telemetry is not None:
+                now = t_marks[-1][1]
+                telemetry.emit(
+                    "superchunk", done=int(completed), total=int(n_perm),
+                    perms=int(take), s=now - prev_t, dispatches=2,
+                    host_bytes=int(hi.nbytes + lo.nbytes + eff.nbytes),
+                )
+                prev_t = now
+                wd.beat()
             if progress is not None:
                 progress(completed, n_perm)
             if save is not None and completed - last_saved >= checkpoint_every:
@@ -424,6 +500,9 @@ def run_stream_superchunks(
                 last_saved = completed
     except KeyboardInterrupt:
         pass
+    finally:
+        if wd is not None:
+            wd.stop()
     if hi is None:
         # resumed-already-complete, or interrupted before the first
         # superchunk landed: report the carry as initialized
@@ -437,6 +516,13 @@ def run_stream_superchunks(
         (c0, t0), (c1, t1) = t_marks[0], t_marks[-1]
         if t1 > t0 and c1 > c0:
             record((c1 - c0) / (t1 - t0))
+    if telemetry is not None:
+        d, b = _profile_totals(profile)
+        telemetry.emit(
+            "null_run_end", mode="streaming", completed=int(completed),
+            n_perm=int(n_perm), s=time.perf_counter() - t_run0,
+            dispatches=d - d0, host_bytes=b - b0,
+        )
     return StreamCounts(hi=hi, lo=lo, eff=eff, completed=completed)
 
 
@@ -453,6 +539,7 @@ def run_adaptive_stream_chunks(
     checkpoint_every: int = 8192,
     fingerprint_extra: bytes = b"",
     profile=None,
+    telemetry=None,
 ) -> tuple:
     """Adaptive (sequential early-stopping) streaming loop: one chunk per
     dispatch — decisions must land at CHUNK boundaries exactly as the
@@ -476,6 +563,10 @@ def run_adaptive_stream_chunks(
     Returns ``(monitor, completed, finished)``.
     """
     key = _resolve_key(base, key)
+    telemetry, profile = _telemetry_profile(telemetry, profile)
+    # retirement events come from the monitor itself (per-module tallies
+    # live there); the loop only provides the bus
+    monitor.telemetry = telemetry
     completed = 0
     save = None
     if checkpoint_path is not None:
@@ -503,6 +594,14 @@ def run_adaptive_stream_chunks(
     C = base.effective_chunk()
     last_saved = completed
     finished = True
+    wd = tm.arm_watchdog(telemetry)
+    prev_t = t_run0 = time.perf_counter()
+    d0, b0 = _profile_totals(profile)
+    if telemetry is not None:
+        telemetry.emit(
+            "null_run_start", mode="adaptive-streaming", n_perm=int(n_perm),
+            start_perm=int(completed), chunk=C,
+        )
     try:
         while completed < n_perm and monitor.any_active():
             pos = monitor.active_positions()
@@ -517,6 +616,18 @@ def run_adaptive_stream_chunks(
                 )
             newly = monitor.update_counts(hi_a, lo_a, take, eff=eff_a)
             completed = monitor.folded
+            if telemetry is not None:
+                now = time.perf_counter()
+                telemetry.emit(
+                    "chunk", done=int(completed), total=int(n_perm),
+                    take=int(take), s=now - prev_t, dispatches=2,
+                    host_bytes=int(
+                        hi_a.nbytes + lo_a.nbytes + eff_a.nbytes
+                    ),
+                    active_modules=int(monitor.active.sum()),
+                )
+                prev_t = now
+                wd.beat()
             if progress is not None:
                 progress(completed, n_perm)
             if newly.size and monitor.any_active():
@@ -530,8 +641,19 @@ def run_adaptive_stream_chunks(
         # the checkpoint below resumes exactly
         finished = False
         completed = monitor.folded
+    finally:
+        if wd is not None:
+            wd.stop()
     if save is not None and completed > last_saved:
         save(completed)
+    if telemetry is not None:
+        d, b = _profile_totals(profile)
+        telemetry.emit(
+            "null_run_end", mode="adaptive-streaming",
+            completed=int(completed), n_perm=int(n_perm),
+            s=time.perf_counter() - t_run0, dispatches=d - d0,
+            host_bytes=b - b0, perms_evaluated=int(monitor.total_evaluated()),
+        )
     return monitor, completed, finished
 
 
@@ -599,6 +721,7 @@ def run_adaptive_chunks(
     checkpoint_every: int = 8192,
     perm_axis: int = 0,
     fingerprint_extra: bytes = b"",
+    telemetry=None,
 ) -> tuple[np.ndarray, int, bool]:
     """Adaptive scheduling layer around the shared chunked null loop: after
     each chunk a host-side :class:`~netrep_tpu.ops.sequential.StopMonitor`
@@ -636,6 +759,8 @@ def run_adaptive_chunks(
     chunks that shrink as modules retire.
     """
     key = _resolve_key(base, key)
+    telemetry = tm.resolve(telemetry)
+    monitor.telemetry = telemetry
     nulls = np.full(alloc_shape, np.nan)
     completed = 0
     save = None
@@ -675,6 +800,13 @@ def run_adaptive_chunks(
     dynamic = getattr(base, "dynamic_chunk", False)
     last_saved = completed
     finished = True
+    wd = tm.arm_watchdog(telemetry)
+    prev_t = t_run0 = time.perf_counter()
+    if telemetry is not None:
+        telemetry.emit(
+            "null_run_start", mode="adaptive", n_perm=int(n_perm),
+            start_perm=int(completed), chunk=C,
+        )
     try:
         while completed < n_perm and monitor.any_active():
             pos = monitor.active_positions()
@@ -686,6 +818,15 @@ def run_adaptive_chunks(
             newly = monitor.update(
                 slice_vals(nulls, completed - take, take, pos), take
             )
+            if telemetry is not None:
+                now = time.perf_counter()
+                telemetry.emit(
+                    "chunk", done=int(completed), total=int(n_perm),
+                    take=int(take), s=now - prev_t,
+                    active_modules=int(monitor.active.sum()),
+                )
+                prev_t = now
+                wd.beat()
             if progress is not None:
                 progress(completed, n_perm)
             if newly.size and monitor.any_active():
@@ -698,8 +839,17 @@ def run_adaptive_chunks(
         # chunk-boundary abort: tallies were only ever folded for fully
         # written chunks, so the checkpoint below resumes exactly
         finished = False
+    finally:
+        if wd is not None:
+            wd.stop()
     if save is not None and completed > last_saved:
         save(nulls, completed)
+    if telemetry is not None:
+        telemetry.emit(
+            "null_run_end", mode="adaptive", completed=int(completed),
+            n_perm=int(n_perm), s=time.perf_counter() - t_run0,
+            perms_evaluated=int(monitor.total_evaluated()),
+        )
     return nulls, completed, finished
 
 
@@ -1524,6 +1674,7 @@ class PermutationEngine:
         checkpoint_path: str | None = None,
         checkpoint_every: int = 8192,
         profile=None,
+        telemetry=None,
     ) -> tuple[np.ndarray, int]:
         """Compute the permutation null distribution.
 
@@ -1549,6 +1700,12 @@ class PermutationEngine:
             accumulating dispatch counts and device→host transfer bytes —
             the denominators of the streaming executor's amortization claims
             (``bench.py --config superchunk``).
+        telemetry : optional :class:`~netrep_tpu.utils.telemetry.Telemetry`
+            event bus (defaults to the ambient bus when one is active —
+            e.g. under ``module_preservation(telemetry=...)``): per-chunk
+            events, run envelope, stall watchdog. Off (None, no ambient
+            bus) costs one ``None`` check per run and results are
+            bit-identical.
 
         Returns
         -------
@@ -1563,12 +1720,16 @@ class PermutationEngine:
                 "engine was built discovery_only; test-side passes live in "
                 "the wrapping engine"
             )
+        # resolve BEFORE building the write closure: when telemetry is on
+        # and the caller passed no profile, the auto-created one must be
+        # the instance `write` records transfer bytes to
+        telemetry, profile = _telemetry_profile(telemetry, profile)
         return run_checkpointed_chunks(
             self, n_perm, key, self._chunk_fn(),
             (n_perm, self.n_modules, N_STATS), self._null_write(profile),
             progress=progress, nulls_init=nulls_init, start_perm=start_perm,
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
-            profile=profile,
+            profile=profile, telemetry=telemetry,
         )
 
     def _null_write(self, profile=None) -> Callable:
@@ -1607,6 +1768,7 @@ class PermutationEngine:
         progress: Callable[[int, int], None] | None = None,
         checkpoint_path: str | None = None,
         checkpoint_every: int = 8192,
+        telemetry=None,
     ) -> tuple[np.ndarray, int, bool]:
         """Sequential early-stopping variant of :meth:`run_null`
         (:func:`run_adaptive_chunks`): ``n_perm`` becomes a *ceiling* —
@@ -1644,7 +1806,7 @@ class PermutationEngine:
                 (n_perm, self.n_modules, N_STATS), self._null_write(),
                 slice_vals, monitor, self.rebucket,
                 progress=progress, checkpoint_path=checkpoint_path,
-                checkpoint_every=checkpoint_every,
+                checkpoint_every=checkpoint_every, telemetry=telemetry,
             )
         finally:
             # leave the engine reusable at full strength (e.g. a fixed-n
@@ -1831,6 +1993,7 @@ class PermutationEngine:
         checkpoint_path: str | None = None,
         checkpoint_every: int = 8192,
         profile=None,
+        telemetry=None,
     ) -> StreamCounts:
         """Streaming-mode (``store_nulls=False``) variant of
         :meth:`run_null` — the superchunk executor: K consecutive chunks
@@ -1866,6 +2029,7 @@ class PermutationEngine:
             self._stream_tallies_init, self._stream_tallies_pull,
             progress=progress, checkpoint_path=checkpoint_path,
             checkpoint_every=checkpoint_every, profile=profile,
+            telemetry=telemetry,
         )
 
     def run_null_adaptive_streaming(
@@ -1879,6 +2043,7 @@ class PermutationEngine:
         checkpoint_path: str | None = None,
         checkpoint_every: int = 8192,
         profile=None,
+        telemetry=None,
     ) -> StreamCounts:
         """Streaming-mode variant of :meth:`run_null_adaptive`: the
         :class:`~netrep_tpu.ops.sequential.StopMonitor` folds
@@ -1908,6 +2073,7 @@ class PermutationEngine:
                 self._counts_to_active, monitor, self.rebucket,
                 progress=progress, checkpoint_path=checkpoint_path,
                 checkpoint_every=checkpoint_every, profile=profile,
+                telemetry=telemetry,
             )
         finally:
             self.rebucket(range(self.n_modules))

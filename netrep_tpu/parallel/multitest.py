@@ -451,21 +451,25 @@ class MultiTestEngine:
     def run_null(self, n_perm: int, key=0, progress=None,
                  nulls_init=None, start_perm: int = 0,
                  checkpoint_path: str | None = None,
-                 checkpoint_every: int = 8192, profile=None):
+                 checkpoint_every: int = 8192, profile=None,
+                 telemetry=None):
         """(T, n_perm, n_modules, 7) null array + completed count; same
         chunked/interruptible/reproducible/resumable/checkpointable contract
         as the base engine (key derivation and chunk rounding are shared
         helpers on :class:`PermutationEngine` so the two paths cannot
         drift)."""
-        from .engine import run_checkpointed_chunks
+        from .engine import _telemetry_profile, run_checkpointed_chunks
 
+        # resolve before building the write closure so an auto-created
+        # NullProfile is the instance `write` records transfer bytes to
+        telemetry, profile = _telemetry_profile(telemetry, profile)
         return run_checkpointed_chunks(
             self._base, n_perm, key, self._chunk_fn(),
             (self.T, n_perm, self.n_modules, N_STATS),
             self._null_write(profile),
             progress=progress, nulls_init=nulls_init, start_perm=start_perm,
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
-            perm_axis=1, profile=profile,
+            perm_axis=1, profile=profile, telemetry=telemetry,
             # the test-side matrices live on this wrapper (the base engine is
             # discovery-only), so their content digest rides fingerprint_extra
             fingerprint_extra=self._fingerprint_extra(),
@@ -475,7 +479,7 @@ class MultiTestEngine:
                           alternative: str = "greater", rule=None,
                           progress=None,
                           checkpoint_path: str | None = None,
-                          checkpoint_every: int = 8192):
+                          checkpoint_every: int = 8192, telemetry=None):
         """Sequential early-stopping variant of :meth:`run_null`
         (:meth:`PermutationEngine.run_null_adaptive` semantics). A module
         retires only when its decision is settled in EVERY test dataset:
@@ -505,6 +509,7 @@ class MultiTestEngine:
                 progress=progress, checkpoint_path=checkpoint_path,
                 checkpoint_every=checkpoint_every, perm_axis=1,
                 fingerprint_extra=self._fingerprint_extra(),
+                telemetry=telemetry,
             )
         finally:
             self.rebucket(range(self.n_modules))
@@ -654,7 +659,8 @@ class MultiTestEngine:
     def run_null_streaming(self, n_perm: int, observed, key=0,
                            progress=None,
                            checkpoint_path: str | None = None,
-                           checkpoint_every: int = 8192, profile=None):
+                           checkpoint_every: int = 8192, profile=None,
+                           telemetry=None):
         """Streaming-mode (``store_nulls=False``) variant of
         :meth:`run_null` — the superchunk executor over the shared
         permutation draw, tallying every (dataset, module, statistic) cell
@@ -677,6 +683,7 @@ class MultiTestEngine:
             progress=progress, checkpoint_path=checkpoint_path,
             checkpoint_every=checkpoint_every,
             fingerprint_extra=self._fingerprint_extra(), profile=profile,
+            telemetry=telemetry,
         )
 
     def run_null_adaptive_streaming(self, n_perm: int, observed, key=0,
@@ -684,7 +691,7 @@ class MultiTestEngine:
                                     progress=None,
                                     checkpoint_path: str | None = None,
                                     checkpoint_every: int = 8192,
-                                    profile=None):
+                                    profile=None, telemetry=None):
         """Streaming-mode variant of :meth:`run_null_adaptive`: the
         monitor folds device-computed (dataset × statistic) counts
         directly, with retirement decisions bit-identical to the
@@ -708,7 +715,7 @@ class MultiTestEngine:
                 progress=progress, checkpoint_path=checkpoint_path,
                 checkpoint_every=checkpoint_every,
                 fingerprint_extra=self._fingerprint_extra(),
-                profile=profile,
+                profile=profile, telemetry=telemetry,
             )
         finally:
             self.rebucket(range(self.n_modules))

@@ -38,6 +38,15 @@ _KEEP = 8
 _FORMAT = 1
 
 
+def _telemetry():
+    """Ambient telemetry bus: cache hits/misses and recorded perms/s ride
+    it when a run has one active (ISSUE 3 — nothing previously recorded
+    whether a run used a measured or heuristic setting)."""
+    from .telemetry import current
+
+    return current()
+
+
 def default_path() -> str:
     """Autotune store beside the persistent compile cache: the repo-local
     ``.jax_cache/<cpu-fingerprint>/autotune.json``."""
@@ -90,6 +99,10 @@ class AutotuneCache:
         unwritable cache dir silently skips — tuning is never load-bearing)."""
         if not perms_per_sec > 0:
             return
+        tel = _telemetry()
+        if tel is not None:
+            tel.emit("autotune_record", key=key, setting=int(setting),
+                     perms_per_sec=float(perms_per_sec))
         entries = self._load()
         samples = entries.setdefault(key, {}).setdefault(str(int(setting)), [])
         samples.append(round(float(perms_per_sec), 3))
@@ -141,7 +154,20 @@ def resolve_perm_batch(config, key: str, heuristic: int):
         # sweeps populate the cache with real alternatives
         return heuristic, cache
     best = cache.best_setting(key)
+    _emit_lookup("perm_batch", key, best, heuristic)
     return (best if best is not None and best > 0 else heuristic), cache
+
+
+def _emit_lookup(kind: str, key: str, best, fallback) -> None:
+    """One ``autotune_hit``/``autotune_miss`` event per cache consult."""
+    tel = _telemetry()
+    if tel is None:
+        return
+    if best is not None and best > 0:
+        tel.emit("autotune_hit", kind=kind, key=key, setting=int(best))
+    else:
+        tel.emit("autotune_miss", kind=kind, key=key,
+                 fallback=int(fallback))
 
 
 #: static fallback for the streaming executor's superchunk when nothing has
@@ -175,4 +201,5 @@ def resolve_superchunk(config, key: str, default: int = DEFAULT_SUPERCHUNK):
         # resolve_perm_batch)
         return max(1, int(explicit)), cache
     best = cache.best_setting(key)
+    _emit_lookup("superchunk", key, best, default)
     return (best if best is not None and best > 0 else default), cache

@@ -23,9 +23,21 @@ their device work under their own wall-clock budget (the driver does).
 
 from __future__ import annotations
 
+import logging
 import os
 import subprocess
 import sys
+import time
+
+logger = logging.getLogger("netrep_tpu")
+
+
+def _telemetry():
+    """Ambient telemetry bus (probe/fallback decisions ride it when one is
+    active — ISSUE 3: the round-5 CPU fallback was *unannounced*)."""
+    from .telemetry import current
+
+    return current()
 
 
 def tunnel_expected() -> bool:
@@ -49,6 +61,13 @@ def honor_explicit_platform():
     try:
         return jax.devices()
     except RuntimeError:
+        tel = _telemetry()
+        if tel is not None:
+            tel.emit("backend_fallback", reason="explicit_unavailable",
+                     wanted=want, forced="cpu")
+        logger.warning(
+            "explicit platform %r unavailable; falling back to CPU", want
+        )
         jax.config.update("jax_platforms", "cpu")
         return jax.devices()
 
@@ -113,15 +132,24 @@ def probe_default_backend(timeout: float) -> str:
 
     Returns ``"ok"`` (responsive), ``"error"`` (fast nonzero exit — e.g.
     plugin registration failure; the in-process call would *error*, not
-    hang), or ``"timeout"`` (hung-dead tunnel)."""
+    hang), or ``"timeout"`` (hung-dead tunnel). The outcome and probe
+    duration are emitted as a ``backend_probe`` telemetry event when a bus
+    is active — dead-tunnel probes ate 120 s of the round-5 measurement
+    windows without leaving a machine-readable trace."""
+    t0 = time.perf_counter()
     try:
         rc = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
             timeout=timeout, capture_output=True,
         ).returncode
+        outcome = "ok" if rc == 0 else "error"
     except subprocess.TimeoutExpired:
-        return "timeout"
-    return "ok" if rc == 0 else "error"
+        outcome = "timeout"
+    tel = _telemetry()
+    if tel is not None:
+        tel.emit("backend_probe", outcome=outcome,
+                 s=time.perf_counter() - t0, timeout_s=float(timeout))
+    return outcome
 
 
 def resolve_backend_or_cpu(probe_timeout: float | None = None) -> None:
@@ -143,5 +171,17 @@ def resolve_backend_or_cpu(probe_timeout: float | None = None) -> None:
             probe_timeout = 90.0
     if honor_explicit_platform() is not None:
         return
-    if tunnel_expected() and probe_default_backend(probe_timeout) != "ok":
-        jax.config.update("jax_platforms", "cpu")
+    if tunnel_expected():
+        outcome = probe_default_backend(probe_timeout)
+        if outcome != "ok":
+            # announce the fallback (ISSUE 3: the round-5 CPU drop was
+            # silent) — once via the logger, structurally via telemetry
+            tel = _telemetry()
+            if tel is not None:
+                tel.emit("backend_fallback", reason=f"probe_{outcome}",
+                         forced="cpu", probe_timeout_s=float(probe_timeout))
+            logger.warning(
+                "TPU tunnel probe result %r (budget %.0fs); forcing the "
+                "CPU platform for this process", outcome, probe_timeout,
+            )
+            jax.config.update("jax_platforms", "cpu")

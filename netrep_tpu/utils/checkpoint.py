@@ -28,6 +28,14 @@ import numpy as np
 _FORMAT_VERSION = 3
 
 
+def _telemetry():
+    """Ambient telemetry bus (save/resume events ride it when a run has
+    one active — ISSUE 3); None otherwise, at the cost of one check."""
+    from .telemetry import current
+
+    return current()
+
+
 def content_digest(arrays) -> str:
     """Cheap content digest of problem matrices: shapes plus a strided
     sample of up to 4096 elements per array. Catches "same module layout,
@@ -107,6 +115,14 @@ def save_null_checkpoint(
         fingerprint=fingerprint,
         **extras,
     )
+    tel = _telemetry()
+    if tel is not None:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        tel.emit("checkpoint_saved", path=path, completed=int(completed),
+                 bytes=int(size))
 
 
 def load_null_checkpoint(path: str) -> dict | None:
@@ -162,6 +178,13 @@ def validate_identity(
             "resuming would splice two different null distributions — use the "
             "original seed or delete the checkpoint"
         )
+    tel = _telemetry()
+    if tel is not None:
+        # identity validated on BOTH resume paths (materialized and
+        # streaming) — this is the one shared site, so the resume event
+        # can never be emitted for a refused checkpoint
+        tel.emit("checkpoint_resumed", path=path,
+                 completed=int(ckpt["completed"]))
 
 
 def validate_resume(

@@ -106,6 +106,7 @@ class PairTimer:
         self.observed_s: float | None = None
         self.null_s: float | None = None
         self._t0: float | None = None
+        self._null_start: float | None = None
 
     def time_observed(self, fn: Callable):
         t0 = time.perf_counter()
@@ -126,7 +127,11 @@ class PairTimer:
         return cb
 
     def finish_null(self, completed: int) -> dict:
-        self.null_s = time.perf_counter() - self._null_start
+        # wrap_progress may never have run (zero-chunk or failed null
+        # path): report null_s as unmeasured rather than crashing on the
+        # unset start mark
+        if self._null_start is not None:
+            self.null_s = time.perf_counter() - self._null_start
         return self.as_dict(completed)
 
     def as_dict(self, completed: int) -> dict:
@@ -208,9 +213,9 @@ def _device_op_durations(trace_dir: str) -> dict[str, float]:
                              recursive=True))
     if not paths:
         return {}
-    pd_ = jax.profiler.ProfileData.from_serialized_xspace(
-        open(paths[-1], "rb").read()
-    )
+    with open(paths[-1], "rb") as f:
+        raw = f.read()
+    pd_ = jax.profiler.ProfileData.from_serialized_xspace(raw)
     per_op: dict[str, float] = {}
     for plane in pd_.planes:
         if "tpu" not in plane.name.lower() and "gpu" not in plane.name.lower():

@@ -1,0 +1,568 @@
+"""Unified run telemetry: structured event bus + metrics registry (ISSUE 3).
+
+The rebuild runs hour-scale permutation nulls on flaky tunneled TPU
+backends whose dominant failures are *silent* — a dead axon tunnel hangs
+``jax.devices()`` mid-run, a probe race drops the run onto CPU unannounced
+— and until now instrumentation was scattered across ``NullProfile``,
+``PairTimer``, the progress printer, and the autotune cache with no common
+schema. This module is the one structured record of what a run did:
+
+- :class:`Telemetry` — a run-scoped event bus with a crash-safe
+  append-only JSONL sink (one flushed line per event; a crash loses at
+  most the in-flight line) and an in-memory :class:`MetricsRegistry`
+  folded from the same events, so the live view and an offline
+  aggregation of the file can never disagree.
+- :class:`MetricsRegistry` — counters, gauges, and histogram summaries
+  derived deterministically from the event stream (see
+  :meth:`MetricsRegistry.fold`), with a human summary table
+  (:meth:`~MetricsRegistry.render_summary`) and a Prometheus-style text
+  exposition (:meth:`~MetricsRegistry.render_prometheus`) for the
+  ``benchmarks/tpu_watch.sh`` loop.
+- :class:`StallWatchdog` — a monotonic-clock heartbeat armed per null
+  run: when no chunk completes within ``factor``× the *measured*
+  steady-state chunk time it emits a ``stall_suspected`` event and warns
+  once via the ``netrep_tpu`` logger — the exact dead-tunnel failure mode
+  ``utils/backend.py`` documents (the dial hangs instead of erroring).
+- ambient activation (:meth:`Telemetry.activate` / :func:`current`) so
+  leaf modules (checkpoint, backend, autotune, distributed) can emit
+  without threading a handle through every signature.
+
+Event schema (version :data:`SCHEMA_VERSION`), one JSON object per line::
+
+    {"v": 1, "t": <unix seconds>, "m": <monotonic seconds>,
+     "run": "<run id>", "ev": "<event name>", "data": {...}}
+
+Exactly these six keys, in this order (:data:`EVENT_KEYS`) — pinned by the
+schema-stability test so downstream parsers (``summarize_watch.py``,
+dashboards) never break silently. ``data`` values are JSON scalars/lists;
+numeric fields fold into the registry by one rule (``fold``).
+
+Telemetry is OFF by default. When disabled the hot loops pay a single
+``None`` check per run (not per chunk) and results are bit-identical —
+telemetry only ever observes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from typing import Callable, Iterable, Iterator
+
+logger = logging.getLogger("netrep_tpu")
+
+#: version of the event line shape; bump when keys or their meaning change
+SCHEMA_VERSION = 1
+
+#: exact top-level keys of every event line, in serialization order
+EVENT_KEYS = ("v", "t", "m", "run", "ev", "data")
+
+#: numeric data fields that accumulate (counters); every other numeric
+#: field is a gauge (last value wins) unless it times something (``s`` /
+#: ``*_s`` suffix → histogram). One rule, shared by the live registry and
+#: the offline aggregator, so the two views cannot drift.
+_SUM_FIELDS = frozenset({
+    "dispatches", "host_bytes", "perms", "take", "bytes", "n_retired",
+})
+
+
+def _is_number(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+class MetricsRegistry:
+    """Counters / gauges / histogram summaries folded from an event
+    stream. ``histograms`` keeps ``[n, total, min, max]`` per name —
+    enough for mean/extremes without unbounded storage."""
+
+    def __init__(self):
+        self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}
+        self.histograms: dict[str, list[float]] = {}
+        self.n_events = 0
+        self.runs: set[str] = set()
+        self.t_first: float | None = None
+        self.t_last: float | None = None
+
+    # -- folding -----------------------------------------------------------
+
+    def count(self, name: str, n: float = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def gauge(self, name: str, v: float) -> None:
+        self.gauges[name] = v
+
+    def observe(self, name: str, v: float) -> None:
+        h = self.histograms.get(name)
+        if h is None:
+            self.histograms[name] = [1, float(v), float(v), float(v)]
+        else:
+            h[0] += 1
+            h[1] += float(v)
+            h[2] = min(h[2], float(v))
+            h[3] = max(h[3], float(v))
+
+    def fold(self, ev: str, data: dict, t: float | None = None,
+             run: str | None = None) -> None:
+        """THE aggregation rule: event count → ``<ev>.count`` counter;
+        numeric fields → ``<ev>.<field>`` histogram (``s``/``*_s``),
+        counter (:data:`_SUM_FIELDS`), or gauge (everything else)."""
+        self.n_events += 1
+        if run:
+            self.runs.add(run)
+        if t is not None:
+            self.t_first = t if self.t_first is None else self.t_first
+            self.t_last = t
+        self.count(f"{ev}.count")
+        for k, v in data.items():
+            if not _is_number(v):
+                continue
+            name = f"{ev}.{k}"
+            if k == "s" or k.endswith("_s"):
+                self.observe(name, v)
+            elif k in _SUM_FIELDS:
+                self.count(name, v)
+            else:
+                self.gauge(name, v)
+
+    # -- views -------------------------------------------------------------
+
+    def as_dict(self) -> dict:
+        return {
+            "n_events": self.n_events,
+            "runs": sorted(self.runs),
+            "span_s": (
+                self.t_last - self.t_first
+                if self.t_first is not None else None
+            ),
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "histograms": {
+                k: {"n": h[0], "total": h[1], "min": h[2], "max": h[3],
+                    "mean": h[1] / h[0]}
+                for k, h in self.histograms.items()
+            },
+        }
+
+    def render_summary(self) -> str:
+        """Human summary table of the aggregated run(s)."""
+        out = []
+        span = (
+            f", span {self.t_last - self.t_first:.1f}s"
+            if self.t_first is not None else ""
+        )
+        runs = ", ".join(sorted(self.runs)) or "-"
+        out.append(f"telemetry: {self.n_events} events, run(s) {runs}{span}")
+        if self.counters:
+            out.append("counters:")
+            w = max(len(k) for k in self.counters)
+            for k in sorted(self.counters):
+                v = self.counters[k]
+                out.append(f"  {k:<{w}}  {v:g}")
+        if self.gauges:
+            out.append("gauges:")
+            w = max(len(k) for k in self.gauges)
+            for k in sorted(self.gauges):
+                out.append(f"  {k:<{w}}  {self.gauges[k]:g}")
+        if self.histograms:
+            out.append("timings:")
+            w = max(len(k) for k in self.histograms)
+            out.append(
+                f"  {'':<{w}}  {'n':>6} {'total_s':>10} {'mean_s':>10} "
+                f"{'min_s':>10} {'max_s':>10}"
+            )
+            for k in sorted(self.histograms):
+                n, tot, lo, hi = self.histograms[k]
+                out.append(
+                    f"  {k:<{w}}  {n:>6} {tot:>10.3f} {tot / n:>10.3f} "
+                    f"{lo:>10.3f} {hi:>10.3f}"
+                )
+        return "\n".join(out)
+
+    def render_prometheus(self, prefix: str = "netrep") -> str:
+        """Prometheus text exposition of the registry — the scrape surface
+        of the ``tpu_watch.sh`` loop (regenerated after each step)."""
+
+        def san(name: str) -> str:
+            return "".join(
+                c if c.isalnum() or c == "_" else "_" for c in name
+            )
+
+        lines = []
+        for k in sorted(self.counters):
+            n = f"{prefix}_{san(k)}_total"
+            lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n} {self.counters[k]:g}")
+        for k in sorted(self.gauges):
+            n = f"{prefix}_{san(k)}"
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {self.gauges[k]:g}")
+        for k in sorted(self.histograms):
+            cnt, tot, lo, hi = self.histograms[k]
+            n = f"{prefix}_{san(k)}"
+            lines.append(f"# TYPE {n} summary")
+            lines.append(f"{n}_count {cnt:g}")
+            lines.append(f"{n}_sum {tot:g}")
+            lines.append(f"{n}_min {lo:g}")
+            lines.append(f"{n}_max {hi:g}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+class Telemetry:
+    """Run-scoped event bus: JSONL sink + live :class:`MetricsRegistry`.
+
+    Parameters
+    ----------
+    path : JSONL sink path (append-only; parent dirs created), or None for
+        an in-memory-only bus (registry still folds — used by tests and
+        short-lived tooling).
+    run_id : identity stamped on every event; defaults to a fresh 8-hex id.
+    clock / wall : injectable monotonic / wall clocks (fake-clock tests).
+    stall_factor / watchdog_poll_s : defaults the null loops hand to the
+        :class:`StallWatchdog` they arm per run.
+
+    Thread-safe: the watchdog thread and the main loop share the sink and
+    registry under one lock. Emit failures (full disk, revoked path) warn
+    once and disable the sink — telemetry must never turn a working run
+    into a failing one.
+    """
+
+    def __init__(
+        self,
+        path: str | os.PathLike | None = None,
+        run_id: str | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        wall: Callable[[], float] = time.time,
+        stall_factor: float = 10.0,
+        watchdog_poll_s: float = 5.0,
+    ):
+        self.path = os.fspath(path) if path is not None else None
+        self.run_id = run_id or uuid.uuid4().hex[:8]
+        self.clock = clock
+        self.wall = wall
+        self.stall_factor = float(stall_factor)
+        self.watchdog_poll_s = float(watchdog_poll_s)
+        self.metrics = MetricsRegistry()
+        self._lock = threading.Lock()
+        self._subscribers: list[Callable[[dict], None]] = []
+        self._fh = None
+        self._sink_dead = False
+        if self.path is not None:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            self._fh = open(self.path, "a", encoding="utf-8")
+
+    # -- bus ---------------------------------------------------------------
+
+    def subscribe(self, fn: Callable[[dict], None]) -> None:
+        """Register an in-process observer called with each event dict."""
+        self._subscribers.append(fn)
+
+    def emit(self, ev: str, **data) -> dict:
+        """Append one event to the sink (flushed — crash loses at most the
+        in-flight line), fold it into the registry, notify subscribers."""
+        record = {
+            "v": SCHEMA_VERSION,
+            "t": self.wall(),
+            "m": self.clock(),
+            "run": self.run_id,
+            "ev": str(ev),
+            "data": data,
+        }
+        with self._lock:
+            self.metrics.fold(record["ev"], data, t=record["t"],
+                              run=self.run_id)
+            if self._fh is not None and not self._sink_dead:
+                try:
+                    self._fh.write(
+                        json.dumps(record, default=_json_default) + "\n"
+                    )
+                    self._fh.flush()
+                except (OSError, ValueError):
+                    self._sink_dead = True
+                    logger.warning(
+                        "telemetry sink %r failed; further events are "
+                        "registry-only", self.path,
+                    )
+        for fn in self._subscribers:
+            try:
+                fn(record)
+            except Exception:  # observers must never break the run
+                logger.warning("telemetry subscriber raised", exc_info=True)
+        return record
+
+    @contextlib.contextmanager
+    def span(self, ev: str, **data):
+        """Timed span: measures the block's duration on the monotonic
+        clock and emits ``ev`` with an ``s`` field on exit (also on error,
+        with ``error`` naming the exception type)."""
+        t0 = self.clock()
+        try:
+            yield self
+        except BaseException as e:
+            self.emit(ev, s=self.clock() - t0, error=type(e).__name__,
+                      **data)
+            raise
+        else:
+            self.emit(ev, s=self.clock() - t0, **data)
+
+    # -- ambient activation ------------------------------------------------
+
+    @contextlib.contextmanager
+    def activate(self):
+        """Make this bus the ambient telemetry (:func:`current`) for the
+        dynamic extent — how leaf modules (checkpoint/backend/autotune/
+        distributed) emit without signature threading."""
+        _ACTIVE.append(self)
+        try:
+            yield self
+        finally:
+            _ACTIVE.remove(self)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+def _json_default(v):
+    """Tolerant serialization: numpy scalars/arrays ride events as plain
+    JSON numbers/lists without this module importing numpy."""
+    for attr in ("item",):  # numpy scalar
+        if hasattr(v, attr) and not hasattr(v, "__len__"):
+            return v.item()
+    if hasattr(v, "tolist"):
+        return v.tolist()
+    return str(v)
+
+
+#: ambient telemetry stack (innermost active bus wins)
+_ACTIVE: list[Telemetry] = []
+
+
+def current() -> Telemetry | None:
+    """The ambient :class:`Telemetry`, or None when telemetry is off."""
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+def resolve(explicit: Telemetry | None) -> Telemetry | None:
+    """Explicit handle if given, else the ambient bus — resolved ONCE per
+    run by the null loops, so the disabled hot path pays one check."""
+    return explicit if explicit is not None else current()
+
+
+#: default sink when ``telemetry=True`` is passed to the public API
+DEFAULT_SINK = "netrep_telemetry.jsonl"
+
+
+def resolve_arg(arg) -> tuple[Telemetry | None, bool]:
+    """``telemetry=`` public-API argument → ``(bus, owned)``: None/False =
+    off; True = the default sink in the CWD; a path = JSONL there; an
+    existing :class:`Telemetry` passes through un-owned (the caller closes
+    it). ``owned`` tells the API layer to close the bus it created."""
+    if arg is None or arg is False:
+        return None, False
+    if isinstance(arg, Telemetry):
+        return arg, False
+    if arg is True:
+        return Telemetry(os.path.join(os.getcwd(), DEFAULT_SINK)), True
+    return Telemetry(arg), True
+
+
+# ---------------------------------------------------------------------------
+# Stall watchdog
+# ---------------------------------------------------------------------------
+
+
+class StallWatchdog:
+    """Monotonic-clock heartbeat for one null run.
+
+    The null loops :meth:`beat` once per landed chunk; the watchdog
+    measures the steady-state chunk time (median inter-beat interval,
+    FIRST interval excluded — it absorbs jit compilation) and, when no
+    chunk lands within ``factor``× that time, emits one
+    ``stall_suspected`` event and warns once via the ``netrep_tpu``
+    logger. This catches the documented dead-tunnel failure mode: device
+    calls block in gRPC with no deadline, so the Python loop can't notice
+    — but this daemon thread can.
+
+    ``poll_interval <= 0`` disables the thread; :meth:`poll` can then be
+    driven manually (fake-clock tests). Until ``min_intervals`` steady
+    intervals are measured the watchdog stays silent — it never guesses a
+    baseline.
+    """
+
+    def __init__(
+        self,
+        telemetry: Telemetry,
+        factor: float = 10.0,
+        min_intervals: int = 2,
+        poll_interval: float = 5.0,
+        clock: Callable[[], float] | None = None,
+    ):
+        self.telemetry = telemetry
+        self.factor = float(factor)
+        self.min_intervals = int(min_intervals)
+        self.poll_interval = float(poll_interval)
+        self.clock = clock if clock is not None else telemetry.clock
+        self._lock = threading.Lock()
+        self._last: float | None = None
+        self._beats = 0
+        self._intervals: list[float] = []
+        self._fired = False
+        self._warned = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def arm(self) -> None:
+        """Start the heartbeat clock (call when the run's first dispatch
+        is issued)."""
+        with self._lock:
+            self._last = self.clock()
+
+    def beat(self) -> None:
+        """One chunk landed: record the interval and reset the stall."""
+        now = self.clock()
+        with self._lock:
+            if self._last is not None and self._beats >= 1:
+                # the interval ending at beat 1 absorbed the first chunk's
+                # compile — steady state starts at beat 2
+                self._intervals.append(now - self._last)
+            self._beats += 1
+            self._last = now
+            self._fired = False
+
+    def steady_s(self) -> float | None:
+        """Median steady-state chunk time, or None before enough beats."""
+        with self._lock:
+            iv = list(self._intervals)
+        if len(iv) < self.min_intervals:
+            return None
+        return sorted(iv)[len(iv) // 2]
+
+    def poll(self) -> bool:
+        """Check the heartbeat; emit/warn when stalled. Returns whether a
+        stall was (newly) flagged."""
+        steady = self.steady_s()
+        with self._lock:
+            if self._last is None or self._fired or steady is None:
+                return False
+            elapsed = self.clock() - self._last
+            if elapsed <= self.factor * steady:
+                return False
+            self._fired = True
+            warn = not self._warned
+            self._warned = True
+            beats = self._beats
+        self.telemetry.emit(
+            "stall_suspected", elapsed_s=elapsed, steady_chunk_s=steady,
+            factor=self.factor, chunks_done=beats,
+        )
+        if warn:
+            logger.warning(
+                "no chunk completed in %.1fs (> %.0fx the %.2fs "
+                "steady-state chunk time) — the backend may be stalled "
+                "(dead TPU tunnel?); the run will continue if it recovers",
+                elapsed, self.factor, steady,
+            )
+        return True
+
+    # -- thread ------------------------------------------------------------
+
+    def start(self) -> None:
+        if self.poll_interval <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="netrep-stall-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.poll()
+            except Exception:  # pragma: no cover - must never kill the run
+                logger.warning("stall watchdog poll raised", exc_info=True)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def __enter__(self) -> "StallWatchdog":
+        self.arm()
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def arm_watchdog(telemetry: Telemetry | None) -> StallWatchdog | None:
+    """Per-null-run watchdog construction shared by the loops: None when
+    telemetry is off (the disabled hot path stays a ``None`` check)."""
+    if telemetry is None:
+        return None
+    wd = StallWatchdog(
+        telemetry, factor=telemetry.stall_factor,
+        poll_interval=telemetry.watchdog_poll_s,
+    )
+    wd.arm()
+    wd.start()
+    return wd
+
+
+# ---------------------------------------------------------------------------
+# Offline aggregation (the `python -m netrep_tpu telemetry` report)
+# ---------------------------------------------------------------------------
+
+
+def is_event(row: dict) -> bool:
+    """Whether a parsed JSON object is a telemetry event line (the check
+    ``summarize_watch.py`` shares so mixed logs split cleanly)."""
+    return (
+        isinstance(row, dict)
+        and row.get("v") == SCHEMA_VERSION
+        and isinstance(row.get("ev"), str)
+        and isinstance(row.get("data"), dict)
+    )
+
+
+def read_events(path: str) -> Iterator[dict]:
+    """Stream the event lines of a JSONL file, skipping anything that is
+    not a schema-matching event (the sink may share a file with other
+    JSONL rows — bench metric lines, watcher headers)."""
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if is_event(row):
+                yield row
+
+
+def aggregate_events(events: Iterable[dict]) -> MetricsRegistry:
+    """Fold an event stream into a fresh registry — by construction the
+    same numbers the emitting process's live registry held."""
+    reg = MetricsRegistry()
+    for e in events:
+        reg.fold(e["ev"], e["data"], t=e.get("t"), run=e.get("run"))
+    return reg
+
+
+def aggregate_file(path: str) -> MetricsRegistry:
+    """Aggregate a telemetry JSONL into a registry (offline CLI report)."""
+    return aggregate_events(read_events(path))

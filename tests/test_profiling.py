@@ -90,3 +90,52 @@ def test_profile_dataless_run(toy_pair_module, tmp_path):
     # data-less run: timings still collected
     assert res.profile["null_s"] > 0
     assert np.isfinite(res.profile["chunk_ms"]).all()
+
+
+def test_pair_timer_finish_null_without_wrap_progress():
+    """Zero-chunk / failed null path: wrap_progress never ran, so the
+    null start mark is unset — finish_null must report unmeasured, not
+    crash (ISSUE 3 satellite)."""
+    from netrep_tpu.utils.profiling import PairTimer
+
+    t = PairTimer(None)
+    t.time_observed(lambda: 1)
+    d = t.finish_null(0)
+    assert d["null_s"] is None
+    assert d["perms_per_sec"] is None
+    assert d["completed"] == 0
+
+
+def test_trace_time_split_classification(monkeypatch):
+    """Op-name classification on a synthetic per-op duration table: the
+    transfer patterns win over scan patterns, scan patterns over 'other',
+    and the fractions come out of the bucket sums."""
+    from netrep_tpu.utils import profiling
+
+    monkeypatch.setattr(profiling, "_device_op_durations", lambda d: {
+        "copy-start": 2e6,          # transfer (copy)
+        "dynamic-slice": 1e6,       # other
+        "while": 3e6,               # scan body (lax.scan lowers to while)
+        "loop_body_fusion": 4e6,    # scan body ('body')
+        "outfeed.1": 5e6,           # transfer
+        "fusion": 6e6,              # other
+    })
+    split = profiling.trace_time_split("ignored")
+    assert split["transfer_ms"] == pytest.approx(7.0)
+    assert split["scan_body_ms"] == pytest.approx(7.0)
+    assert split["other_ms"] == pytest.approx(7.0)
+    assert split["total_ms"] == pytest.approx(21.0)
+    assert split["transfer_frac"] == pytest.approx(7.0 / 21.0)
+
+
+def test_trace_time_split_zero_total(monkeypatch):
+    """Empty trace (host-only plane): all buckets zero and the fraction
+    is defined as 0.0, not NaN/ZeroDivisionError."""
+    from netrep_tpu.utils import profiling
+
+    monkeypatch.setattr(profiling, "_device_op_durations", lambda d: {})
+    split = profiling.trace_time_split("ignored")
+    assert split == {
+        "scan_body_ms": 0.0, "transfer_ms": 0.0, "other_ms": 0.0,
+        "total_ms": 0.0, "transfer_frac": 0.0,
+    }

@@ -81,3 +81,44 @@ def test_cli_sections_account_for_every_parseable_row(tmp_path):
     assert "other parseable" in out and "bf16 drift" in out
     assert "TFRT_CPU_0" not in out                     # CPU row never shown
     assert "dropped 2" in proc.stderr                  # fallback + CPU
+
+
+def _tel_event(ev, **data):
+    return {"v": 1, "t": 1.0, "m": 1.0, "run": "r1", "ev": ev, "data": data}
+
+
+def test_telemetry_rows_classified_and_split():
+    """Telemetry event lines (netrep_tpu.utils.telemetry JSONL) classify
+    as their own kind — never as unknown-provenance measurement rows —
+    and aggregate into a per-phase time split."""
+    ev = _tel_event("chunk", s=0.5, dispatches=2)
+    assert classify(ev) == "telemetry"
+    # near-misses stay on the old rules: wrong version / no data dict
+    assert classify({"v": 2, "ev": "chunk", "data": {}}) == "unknown"
+    assert classify({"v": 1, "ev": "chunk"}) == "unknown"
+    split = summarize_watch.telemetry_split([
+        _tel_event("chunk", s=0.5), _tel_event("chunk", s=1.5),
+        _tel_event("observed", s=2.0),
+        _tel_event("module_retired", module=3),   # no duration: excluded
+    ])
+    assert split == {"chunk": [2, 2.0], "observed": [1, 2.0]}
+
+
+def test_cli_prints_telemetry_split(tmp_path):
+    rows = [
+        {"metric": "north", "value": 27.1, "unit": "s",
+         "device": "TPU v5 lite"},
+        _tel_event("superchunk", s=1.25, perms=512, dispatches=2),
+        _tel_event("observed", s=0.75),
+    ]
+    log = tmp_path / "watch.jsonl"
+    log.write_text("\n".join(json.dumps(r) for r in rows))
+    proc = subprocess.run(
+        [sys.executable, "benchmarks/summarize_watch.py", str(log)],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "telemetry per-phase time split" in proc.stdout
+    assert "superchunk: 1.250s" in proc.stdout
+    assert "observed: 0.750s" in proc.stdout
+    assert "north" in proc.stdout                     # result row intact

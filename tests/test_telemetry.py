@@ -1,0 +1,357 @@
+"""Unified run telemetry (ISSUE 3): event-schema stability, registry
+folding, NullProfile parity of the aggregated JSONL, bit-identical
+disabled runs, the stall watchdog's fake-clock semantics, retirement /
+checkpoint events, the CLI report, and the logging-hygiene guard."""
+
+import glob
+import json
+import logging
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from netrep_tpu.data import make_mixed_pair
+from netrep_tpu.ops.sequential import StopMonitor, StopRule
+from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+from netrep_tpu.utils.config import EngineConfig
+from netrep_tpu.utils.profiling import NullProfile
+from netrep_tpu.utils.telemetry import (
+    EVENT_KEYS, SCHEMA_VERSION, MetricsRegistry, StallWatchdog, Telemetry,
+    aggregate_file, current, read_events,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = EngineConfig(chunk_size=32, summary_method="eigh", superchunk=2,
+                   autotune=False)
+N_PERM = 96
+
+
+@pytest.fixture(scope="module")
+def mixed():
+    return make_mixed_pair(200, 4, n_samples=24, seed=7)
+
+
+def _engine(mixed, config=CFG):
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    return PermutationEngine(
+        dc, dn, dd, tc, tn, td, specs, mixed["pool"], config=config
+    )
+
+
+# ---------------------------------------------------------------------------
+# schema stability (golden event shape, versioned constant)
+# ---------------------------------------------------------------------------
+
+def test_event_schema_golden(tmp_path):
+    """Every emitted line has EXACTLY the six schema keys, in order, with
+    the pinned version — downstream parsers (summarize_watch, dashboards)
+    key on this shape, so a drift must fail CI, not them."""
+    assert SCHEMA_VERSION == 1  # bump deliberately, with this test
+    assert EVENT_KEYS == ("v", "t", "m", "run", "ev", "data")
+    path = tmp_path / "ev.jsonl"
+    tel = Telemetry(path, run_id="golden")
+    tel.emit("chunk", done=32, total=96, take=32, s=0.5, dispatches=2,
+             host_bytes=1024)
+    tel.emit("stall_suspected", elapsed_s=99.0, steady_chunk_s=1.0,
+             factor=10.0, chunks_done=3)
+    tel.emit("checkpoint_saved", path="x.npz", completed=64, bytes=100)
+    tel.emit("numpy_payload", arr=np.arange(3), scalar=np.int64(7))
+    tel.close()
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(lines) == 4
+    for row in lines:
+        assert tuple(row.keys()) == EVENT_KEYS
+        assert row["v"] == SCHEMA_VERSION
+        assert row["run"] == "golden"
+        assert isinstance(row["ev"], str)
+        assert isinstance(row["data"], dict)
+        assert isinstance(row["t"], float) and isinstance(row["m"], float)
+    # numpy values serialize as plain JSON numbers/lists
+    assert lines[3]["data"] == {"arr": [0, 1, 2], "scalar": 7}
+
+
+def test_registry_fold_rules_and_renders():
+    reg = MetricsRegistry()
+    reg.fold("chunk", {"s": 1.0, "dispatches": 2, "done": 32}, t=10.0,
+             run="r1")
+    reg.fold("chunk", {"s": 3.0, "dispatches": 2, "done": 64}, t=12.0,
+             run="r1")
+    assert reg.counters["chunk.count"] == 2
+    assert reg.counters["chunk.dispatches"] == 4      # sum field
+    assert reg.gauges["chunk.done"] == 64             # last value
+    assert reg.histograms["chunk.s"] == [2, 4.0, 1.0, 3.0]
+    assert reg.runs == {"r1"} and reg.n_events == 2
+    table = reg.render_summary()
+    assert "chunk.dispatches" in table and "chunk.s" in table
+    prom = reg.render_prometheus()
+    assert "# TYPE netrep_chunk_dispatches_total counter" in prom
+    assert "netrep_chunk_s_sum 4" in prom
+    assert "# TYPE netrep_chunk_done gauge" in prom
+
+
+# ---------------------------------------------------------------------------
+# acceptance: streaming run's JSONL reproduces NullProfile exactly;
+# disabled telemetry is bit-identical
+# ---------------------------------------------------------------------------
+
+def test_streaming_telemetry_reproduces_nullprofile(mixed, tmp_path):
+    eng = _engine(mixed)
+    observed = np.asarray(eng.observed())
+    path = tmp_path / "stream.jsonl"
+    tel = Telemetry(path, run_id="stream")
+    prof = NullProfile()
+    ck = str(tmp_path / "ck.npz")
+    with tel.activate():  # ambient: checkpoint events must fire too
+        sc = eng.run_null_streaming(
+            N_PERM, observed, key=0, profile=prof, checkpoint_path=ck,
+            checkpoint_every=32,
+        )
+    tel.close()
+    assert sc.completed == N_PERM
+    reg = aggregate_file(str(path))
+    # the emitted event stream carries NullProfile's accounting exactly
+    assert reg.counters["superchunk.dispatches"] == prof.dispatches
+    assert reg.counters["superchunk.host_bytes"] == prof.host_bytes
+    assert reg.counters["superchunk.perms"] == N_PERM
+    assert reg.counters["null_run_end.dispatches"] == prof.dispatches
+    assert reg.counters["null_run_end.host_bytes"] == prof.host_bytes
+    assert reg.counters["checkpoint_saved.count"] >= 1
+    # aggregated == live registry (one fold rule, two views)
+    assert reg.counters["superchunk.dispatches"] == \
+        tel.metrics.counters["superchunk.dispatches"]
+
+    # resume-complete run on the same checkpoint: the shared identity
+    # validation emits the resume event
+    tel2 = Telemetry(tmp_path / "resume.jsonl", run_id="resume")
+    with tel2.activate():
+        sc2 = eng.run_null_streaming(
+            N_PERM, observed, key=0, checkpoint_path=ck,
+        )
+    tel2.close()
+    assert sc2.completed == N_PERM
+    assert (sc2.hi == sc.hi).all()
+    reg2 = aggregate_file(str(tmp_path / "resume.jsonl"))
+    assert reg2.counters["checkpoint_resumed.count"] == 1
+    assert reg2.gauges["checkpoint_resumed.completed"] == N_PERM
+
+
+def test_disabled_telemetry_bit_identical(mixed, tmp_path):
+    eng = _engine(mixed)
+    observed = np.asarray(eng.observed())
+    tel = Telemetry(tmp_path / "on.jsonl")
+    nulls_on, done_on = eng.run_null(N_PERM, key=0, telemetry=tel)
+    sc_on = eng.run_null_streaming(N_PERM, observed, key=0, telemetry=tel)
+    tel.close()
+    eng_off = _engine(mixed)
+    nulls_off, done_off = eng_off.run_null(N_PERM, key=0)
+    sc_off = eng_off.run_null_streaming(N_PERM, observed, key=0)
+    assert done_on == done_off
+    np.testing.assert_array_equal(np.asarray(nulls_on),
+                                  np.asarray(nulls_off))
+    assert (sc_on.hi == sc_off.hi).all() and (sc_on.lo == sc_off.lo).all()
+    assert (sc_on.eff == sc_off.eff).all()
+    assert current() is None  # no ambient bus leaked out of the runs
+
+
+def test_materialized_chunk_events_match_profile(mixed, tmp_path):
+    eng = _engine(mixed)
+    path = tmp_path / "mat.jsonl"
+    tel = Telemetry(path)
+    prof = NullProfile()
+    nulls, done = eng.run_null(N_PERM, key=0, telemetry=tel, profile=prof)
+    tel.close()
+    assert done == N_PERM
+    reg = aggregate_file(str(path))
+    assert reg.counters["chunk.count"] == N_PERM // CFG.chunk_size
+    assert reg.counters["chunk.take"] == N_PERM
+    assert reg.counters["chunk.dispatches"] == prof.dispatches
+    assert reg.counters["chunk.host_bytes"] == prof.host_bytes
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog (fake clock — no sleeping, no thread)
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_watchdog_fires_on_stall_and_stays_silent_otherwise(caplog):
+    clock = FakeClock()
+    tel = Telemetry(clock=clock)  # registry-only bus
+    wd = StallWatchdog(tel, factor=5.0, poll_interval=0, clock=clock)
+    wd.arm()
+    clock.t = 10.0
+    wd.beat()                       # first chunk: includes compile
+    for _ in range(4):              # steady state: 1 s / chunk
+        clock.t += 1.0
+        wd.beat()
+    assert wd.steady_s() == 1.0     # compile interval excluded
+    clock.t += 2.0                  # 2 s < 5x steady: normal jitter
+    assert not wd.poll()
+    assert "stall_suspected.count" not in tel.metrics.counters
+    with caplog.at_level(logging.WARNING, logger="netrep_tpu"):
+        clock.t += 10.0             # 12 s > 5x steady: stall
+        assert wd.poll()
+        assert wd.poll() is False   # one event per stall episode
+    assert tel.metrics.counters["stall_suspected.count"] == 1
+    assert tel.metrics.gauges["stall_suspected.chunks_done"] == 5
+    warns = [r for r in caplog.records if "stalled" in r.getMessage()]
+    assert len(warns) == 1          # warns ONCE via the netrep_tpu logger
+    clock.t += 1.0
+    wd.beat()                       # recovery re-arms the watchdog
+    clock.t += 50.0
+    assert wd.poll()                # a second stall fires again
+    assert tel.metrics.counters["stall_suspected.count"] == 2
+
+
+def test_watchdog_silent_before_steady_state_measured():
+    clock = FakeClock()
+    tel = Telemetry(clock=clock)
+    wd = StallWatchdog(tel, factor=2.0, min_intervals=2, poll_interval=0,
+                       clock=clock)
+    wd.arm()
+    clock.t = 1000.0                # huge gap, but no baseline yet
+    assert not wd.poll()
+    wd.beat()
+    clock.t += 1.0
+    wd.beat()                       # only ONE steady interval so far
+    clock.t += 1000.0
+    assert not wd.poll()            # still below min_intervals
+
+
+# ---------------------------------------------------------------------------
+# retirement events (StopMonitor owns the tallies, so it emits)
+# ---------------------------------------------------------------------------
+
+def test_stop_monitor_emits_module_retired():
+    # both modules clearly null (nulls always exceed the observed 0): the
+    # Besag-Clifford h rule decides each at the min_perms floor
+    rule = StopRule(h=4, alpha=0.05, min_perms=8)
+    obs = np.zeros((2, 3))
+    events = []
+    tel = Telemetry(run_id="ret")
+    tel.subscribe(events.append)
+    mon = StopMonitor(obs, "greater", rule)
+    mon.telemetry = tel
+    newly = mon.update(np.full((8, 2, 3), 1.0), 8)
+    assert newly.size == 2 and not mon.any_active()
+    retired = [e for e in events if e["ev"] == "module_retired"]
+    assert len(retired) == 2
+    assert tel.metrics.counters["module_retired.count"] == 2
+    for e in retired:
+        assert e["data"]["n_perm_used"] == 8
+        assert e["data"]["hi"] == [8, 8, 8]
+        assert len(e["data"]["lo"]) == 3
+    # no bus attached: identical decisions, zero emission machinery
+    mon2 = StopMonitor(obs, "greater", rule)
+    assert mon2.update(np.full((8, 2, 3), 1.0), 8).size == 2
+
+
+# ---------------------------------------------------------------------------
+# public API threading (module_preservation telemetry= + profile pointer)
+# ---------------------------------------------------------------------------
+
+def test_module_preservation_telemetry(toy_pair_module, tmp_path):
+    pytest.importorskip("pandas")
+    from netrep_tpu import module_preservation
+    from netrep_tpu.data import pair_frames
+
+    d, t = pair_frames(toy_pair_module)
+    path = str(tmp_path / "run.jsonl")
+    res = module_preservation(
+        network={"d": d["network"], "t": t["network"]},
+        correlation={"d": d["correlation"], "t": t["correlation"]},
+        data={"d": d["data"], "t": t["data"]},
+        module_assignments=dict(toy_pair_module["labels"]),
+        discovery="d", test="t", n_perm=64, seed=0,
+        config=EngineConfig(chunk_size=32), telemetry=path,
+    )
+    assert res.profile is not None
+    assert res.profile["telemetry"] == path
+    reg = aggregate_file(path)
+    for ev in ("run_start", "pair_start", "observed", "chunk",
+               "null_run_end", "pair_end", "run_end"):
+        assert reg.counters.get(f"{ev}.count", 0) >= 1, ev
+    assert reg.counters["chunk.take"] == 64
+    assert current() is None  # ambient bus deactivated and closed
+
+
+# ---------------------------------------------------------------------------
+# CLI report
+# ---------------------------------------------------------------------------
+
+def test_cli_telemetry_report(tmp_path):
+    path = tmp_path / "cli.jsonl"
+    tel = Telemetry(path, run_id="cli")
+    tel.emit("chunk", done=10, total=10, take=10, s=0.25, dispatches=2,
+             host_bytes=64)
+    tel.close()
+    # a non-event line interleaved (bench metric row): must be skipped
+    with open(path, "a") as f:
+        f.write('{"metric": "north", "value": 1.0}\nnot json\n')
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    run = lambda *a: subprocess.run(
+        [sys.executable, "-m", "netrep_tpu", "telemetry", str(path), *a],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    proc = run()
+    assert proc.returncode == 0, proc.stderr
+    assert "chunk.dispatches" in proc.stdout and "cli" in proc.stdout
+    prom = run("--prom")
+    assert prom.returncode == 0
+    assert "# TYPE netrep_chunk_dispatches_total counter" in prom.stdout
+    js = run("--json")
+    row = json.loads(js.stdout)
+    assert row["counters"]["chunk.host_bytes"] == 64
+    missing = subprocess.run(
+        [sys.executable, "-m", "netrep_tpu", "telemetry",
+         str(tmp_path / "nope.jsonl")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert missing.returncode == 1
+
+
+def test_read_events_skips_foreign_lines(tmp_path):
+    path = tmp_path / "mixed.jsonl"
+    tel = Telemetry(path, run_id="r")
+    tel.emit("a", s=1.0)
+    tel.close()
+    with open(path, "a") as f:
+        f.write('{"v": 99, "ev": "a", "data": {}}\n')   # wrong version
+        f.write('{"metric": "row"}\n--- header ---\n')
+    assert len(list(read_events(str(path)))) == 1
+
+
+# ---------------------------------------------------------------------------
+# hygiene: one logger namespace, no import-time basicConfig
+# ---------------------------------------------------------------------------
+
+def test_logging_hygiene_across_package():
+    """Every module logs via the `netrep_tpu` logger namespace (so one
+    handler/config governs the whole package) and nothing calls
+    logging.basicConfig at import time (a library must never hijack the
+    host application's root logger)."""
+    files = glob.glob(os.path.join(REPO, "netrep_tpu", "**", "*.py"),
+                      recursive=True)
+    assert files
+    get_logger = re.compile(r"logging\.getLogger\(([^)]*)\)")
+    for path in files:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        assert "basicConfig" not in src, f"{path} touches basicConfig"
+        for m in get_logger.finditer(src):
+            assert m.group(1) in ('"netrep_tpu"', "'netrep_tpu'"), (
+                f"{path} logs outside the netrep_tpu namespace: "
+                f"{m.group(0)}"
+            )
