@@ -32,6 +32,7 @@ __all__ = [
     "make_mesh",
     "selftest",
     "properties_table",
+    "FaultPolicy",
 ]
 
 #: the plot suite (reference exports plotModule + per-panel functions at
@@ -87,6 +88,10 @@ def __getattr__(name):
         from .utils.selftest import selftest
 
         return selftest
+    if name == "FaultPolicy":
+        from .utils.config import FaultPolicy
+
+        return FaultPolicy
     if name in _PLOT_EXPORTS:
         try:
             from . import plot

@@ -10,8 +10,12 @@ backend) needs the equivalent one-liner. Commands:
 - ``version`` — print the package version.
 - ``telemetry <run.jsonl>`` — aggregate a telemetry event log (ISSUE 3;
   written by ``module_preservation(telemetry=...)`` or ``bench.py
-  --telemetry``) into the human summary table offline; ``--prom`` emits
-  the Prometheus text exposition instead, ``--json`` the raw registry.
+  --telemetry``) into the human summary table offline; the table leads
+  with a "recovery" section whenever the run retried, abandoned,
+  degraded, or had faults injected (ISSUE 4). ``--prom`` emits the
+  Prometheus text exposition instead, ``--json`` the raw registry, and
+  ``--recovery`` a chronological timeline of the recovery events alone
+  (what did this run survive, in what order).
   Runs without touching any backend — safe on a box whose tunnel is dead.
 """
 
@@ -50,6 +54,10 @@ def main(argv=None) -> int:
                     help="Prometheus text exposition instead of the table")
     tl.add_argument("--json", action="store_true",
                     help="aggregated registry as one JSON line")
+    tl.add_argument("--recovery", action="store_true",
+                    help="chronological timeline of recovery events "
+                         "(retries, abandoned chunks, CPU degradation, "
+                         "injected faults)")
     args = ap.parse_args(argv)
     if args.cmd is None:
         # bare invocation = selftest with its own argparse defaults (ONE
@@ -60,8 +68,19 @@ def main(argv=None) -> int:
     if args.cmd == "telemetry":
         # pure-offline aggregation: must not resolve a backend (this is
         # the report you run precisely when the tunnel is dead)
-        from netrep_tpu.utils.telemetry import aggregate_file
+        from netrep_tpu.utils.telemetry import aggregate_file, render_recovery
 
+        if args.recovery:
+            try:
+                timeline = render_recovery(args.path)
+            except OSError as e:
+                print(f"cannot read {args.path!r}: {e}", file=sys.stderr)
+                return 1
+            if not timeline:
+                print(f"no recovery events in {args.path!r}")
+                return 0
+            print(timeline)
+            return 0
         try:
             reg = aggregate_file(args.path)
         except OSError as e:

@@ -27,6 +27,7 @@ from ..ops import pvalues as pv
 from ..parallel.engine import ModuleSpec, PermutationEngine
 from ..utils import telemetry as tm
 from ..utils.config import EngineConfig
+from ..utils.faults import DeviceLostError, resolve_runtime
 from ..utils.profiling import PairTimer, device_trace, resolve_profile_dir
 from . import dataset as ds
 from .results import PreservationResult, shape_results
@@ -148,6 +149,7 @@ def module_preservation(
     adaptive_rule=None,
     store_nulls: bool = True,
     telemetry=None,
+    fault_policy=None,
 ):
     """Permutation test of network module preservation across datasets.
 
@@ -222,6 +224,28 @@ def module_preservation(
       ``python -m netrep_tpu telemetry <run.jsonl>``. Off by default;
       disabled runs are bit-identical and pay only a ``None`` check.
       ``result.profile`` gains a ``"telemetry"`` pointer to the sink path.
+    - ``fault_policy`` — fault-tolerant null execution (ISSUE 4;
+      :mod:`netrep_tpu.utils.faults`): ``True`` or a
+      :class:`~netrep_tpu.utils.config.FaultPolicy` wraps every null
+      chunk dispatch in a recovery ladder — *transient* backend failures
+      (gRPC deadline, dropped tunnel) re-dispatch with exponential
+      backoff and deterministic jitter (exact by construction: chunk *i*
+      regenerates identical ``fold_in`` keys), hung dispatches are
+      abandoned after an emergency checkpoint (``hang_timeout_s``, or
+      the telemetry stall watchdog escalated from warn to act), and a
+      lost device degrades the run to CPU mid-flight: completed work is
+      failure-saved, the engine is rebuilt on the CPU platform
+      (:func:`netrep_tpu.utils.backend.degrade_to_cpu`), and the null
+      resumes bit-identically from the checkpoint. Without a
+      ``checkpoint_dir`` a run-scoped temporary directory holds the
+      emergency checkpoints (removed on success). Every recovery
+      decision emits telemetry (``retry_attempt``, ``chunk_abandoned``,
+      ``degraded_to_cpu``, ``fault_injected``, ...) when a bus is
+      active. The deterministic fault-injection harness
+      (``FaultPolicy(plan=...)`` or the ``NETREP_FAULT_PLAN`` env var,
+      which also activates a default policy) drives CI/bench drills.
+      Off (None, env unset) the null loops are bit-identical to
+      previous releases.
 
     Returns
     -------
@@ -258,6 +282,17 @@ def module_preservation(
     else:
         engine_cls = PermutationEngine
     config = config or EngineConfig()
+
+    ft = resolve_runtime(fault_policy)
+    emergency_dir = None
+    if ft is not None and checkpoint_dir is None:
+        # the failure-save hook and the CPU-degradation resume need the
+        # checkpoints to land somewhere even when the caller didn't ask
+        # for any: a run-scoped tempdir, removed after a clean finish
+        import tempfile
+
+        emergency_dir = tempfile.mkdtemp(prefix="netrep_ckpt_")
+        checkpoint_dir = emergency_dir
 
     def ckpt_path(d_name, t_name):
         if checkpoint_dir is None:
@@ -304,6 +339,7 @@ def module_preservation(
             "run_start", pairs=sum(len(v) for v in by_disc.values()),
             null=null, alternative=alternative, adaptive=bool(adaptive),
             store_nulls=bool(store_nulls), backend=backend, seed=int(seed),
+            fault_policy=ft is not None,
         )
     try:
         out = _run_pairs(
@@ -311,7 +347,7 @@ def module_preservation(
             alternative, n_perm, auto_n_perm, engine_cls, config, mesh,
             vmap_tests, backend, seed, progress, ckpt_path, checkpoint_every,
             verbose, simplify, results, trace_dir, profiling,
-            adaptive, adaptive_rule, store_nulls, tel,
+            adaptive, adaptive_rule, store_nulls, tel, ft,
         )
         if tel is not None:
             tel.emit("run_end", pairs_done=sum(len(v) for v in results.values()))
@@ -322,6 +358,10 @@ def module_preservation(
             if tel_owned:
                 tel.close()
         trace_cm.__exit__(None, None, None)
+        if emergency_dir is not None:
+            import shutil
+
+            shutil.rmtree(emergency_dir, ignore_errors=True)
 
 
 def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
@@ -329,7 +369,7 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                vmap_tests, backend, seed, progress, ckpt_path,
                checkpoint_every, verbose, simplify, results, trace_dir,
                profiling, adaptive=False, adaptive_rule=None,
-               store_nulls=True, tel=None):
+               store_nulls=True, tel=None, ft=None):
     """Pair-loop body of :func:`module_preservation` (split out so the
     profiler trace context can bracket it without deep nesting)."""
 
@@ -365,26 +405,55 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                 sc = engine.run_null_adaptive_streaming(
                     np_this, observed, key=seed, alternative=alternative,
                     rule=adaptive_rule, progress=prog, checkpoint_path=ck,
-                    checkpoint_every=checkpoint_every,
+                    checkpoint_every=checkpoint_every, fault_policy=ft,
                 )
                 return None, sc, sc.completed, not sc.finished
             sc = engine.run_null_streaming(
                 np_this, observed, key=seed, progress=prog,
                 checkpoint_path=ck, checkpoint_every=checkpoint_every,
+                fault_policy=ft,
             )
             return None, sc, sc.completed, sc.completed < np_this
         if adaptive:
             nulls, completed, finished = engine.run_null_adaptive(
                 np_this, observed, key=seed, alternative=alternative,
                 rule=adaptive_rule, progress=prog, checkpoint_path=ck,
-                checkpoint_every=checkpoint_every,
+                checkpoint_every=checkpoint_every, fault_policy=ft,
             )
             return nulls, None, completed, not finished
         nulls, completed = engine.run_null(
             np_this, key=seed, progress=prog, checkpoint_path=ck,
-            checkpoint_every=checkpoint_every,
+            checkpoint_every=checkpoint_every, fault_policy=ft,
         )
         return nulls, None, completed, completed < np_this
+
+    def run_pair_null_guarded(build_engine, engine, np_this, observed, prog,
+                              ck, d_name, t_name):
+        """:func:`run_pair_null` plus the last rung of the fault ladder
+        (ISSUE 4): on a device-loss-class failure — whose loop already
+        failure-saved every completed permutation to ``ck`` — force the
+        CPU platform, rebuild the engine from the original host inputs
+        (mesh dropped: its devices are gone), and resume from the
+        checkpoint. Bit-identical to an unfaulted run: per-permutation
+        keys depend only on (seed, index), and the shared injector on
+        ``ft`` never re-fires a consumed fault on the resumed
+        dispatches. A second device loss propagates — CPU cannot be
+        lost, so it means something else is wrong."""
+        try:
+            return run_pair_null(engine, np_this, observed, prog, ck)
+        except DeviceLostError as e:
+            if ck is None:  # no checkpoint, nothing to resume from
+                raise
+            from ..utils import backend as be
+
+            cause = e.__cause__ if e.__cause__ is not None else e
+            be.degrade_to_cpu(
+                getattr(e, "reason", "device_lost"),
+                discovery=str(d_name), test=str(t_name),
+                error=type(cause).__name__,
+            )
+            return run_pair_null(build_engine(None), np_this, observed,
+                                 prog, ck)
 
     def pair_progress():
         # verbose=True with no user callback gets the reference-style
@@ -438,13 +507,24 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                     test="+".join(map(str, t_names)), vmapped=True,
                     n_modules=len(labels), n_perm=int(np_this),
                 )
-            engine = MultiTestEngine(
-                disc_ds.correlation, disc_ds.network, disc_ds.data,
-                np.stack([datasets[t].correlation for t in t_names]),
-                np.stack([datasets[t].network for t in t_names]),
-                [datasets[t].data for t in t_names] if with_data else None,
-                mod_specs, pool, config=config, mesh=mesh,
-            )
+            def build_engine(m=mesh, _t_names=t_names, _specs=mod_specs,
+                             _pool=pool, _with_data=with_data):
+                cfg = config
+                if m is None and cfg.matrix_sharding == "row":
+                    # degraded CPU rebuild: no mesh left to row-shard over
+                    cfg = dataclasses.replace(
+                        cfg, matrix_sharding="replicated"
+                    )
+                return MultiTestEngine(
+                    disc_ds.correlation, disc_ds.network, disc_ds.data,
+                    np.stack([datasets[t].correlation for t in _t_names]),
+                    np.stack([datasets[t].network for t in _t_names]),
+                    [datasets[t].data for t in _t_names]
+                    if _with_data else None,
+                    _specs, _pool, config=cfg, mesh=m,
+                )
+
+            engine = build_engine()
             timer = PairTimer(trace_dir) if profiling else None
             with observed_span(d_name, "+".join(map(str, t_names)),
                                len(labels)):
@@ -452,11 +532,12 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                     timer.time_observed(engine.observed) if timer
                     else engine.observed()
                 )
-            nulls, stream, completed, interrupted = run_pair_null(
-                engine, np_this, observed,
+            nulls, stream, completed, interrupted = run_pair_null_guarded(
+                build_engine, engine, np_this, observed,
                 (timer.wrap_progress(pair_progress())
                  if timer else pair_progress()),
                 ckpt_path(d_name, "+".join(t_names)),
+                d_name, "+".join(map(str, t_names)),
             )
             prof_dict = attach_telemetry(
                 timer.finish_null(completed) if timer else None
@@ -514,22 +595,32 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                     vmapped=False, n_modules=len(labels),
                     n_perm=int(np_this),
                 )
-            engine = engine_cls(
-                disc_ds.correlation, disc_ds.network, disc_ds.data,
-                test_ds.correlation, test_ds.network, test_ds.data,
-                mod_specs, pool, config=config, mesh=mesh,
-            )
+            def build_engine(m=mesh, _test_ds=test_ds, _specs=mod_specs,
+                             _pool=pool):
+                cfg = config
+                if m is None and cfg.matrix_sharding == "row":
+                    # degraded CPU rebuild: no mesh left to row-shard over
+                    cfg = dataclasses.replace(
+                        cfg, matrix_sharding="replicated"
+                    )
+                return engine_cls(
+                    disc_ds.correlation, disc_ds.network, disc_ds.data,
+                    _test_ds.correlation, _test_ds.network, _test_ds.data,
+                    _specs, _pool, config=cfg, mesh=m,
+                )
+
+            engine = build_engine()
             timer = PairTimer(trace_dir) if profiling else None
             with observed_span(d_name, t_name, len(labels)):
                 observed = (
                     timer.time_observed(engine.observed) if timer
                     else engine.observed()
                 )
-            nulls, stream, completed, was_interrupted = run_pair_null(
-                engine, np_this, observed,
+            nulls, stream, completed, was_interrupted = run_pair_null_guarded(
+                build_engine, engine, np_this, observed,
                 (timer.wrap_progress(pair_progress())
                  if timer else pair_progress()),
-                ckpt_path(d_name, t_name),
+                ckpt_path(d_name, t_name), d_name, t_name,
             )
             if tel is not None:
                 tel.emit(

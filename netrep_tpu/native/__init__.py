@@ -293,6 +293,7 @@ class NativePermutationEngine:
         progress: Callable[[int, int], None] | None = None,
         checkpoint_path: str | None = None,
         checkpoint_every: int = 8192,
+        fault_policy=None,
     ) -> tuple[np.ndarray, int]:
         # reuse the single chunked/interruptible/checkpointable loop shared
         # with the JAX engines (engine.run_checkpointed_chunks) so the
@@ -315,5 +316,5 @@ class NativePermutationEngine:
             self, n_perm, key, fn,
             (n_perm, self.core.n_mod, oracle.N_STATS), write,
             progress=progress, checkpoint_path=checkpoint_path,
-            checkpoint_every=checkpoint_every,
+            checkpoint_every=checkpoint_every, fault_policy=fault_policy,
         )

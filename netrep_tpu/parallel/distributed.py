@@ -40,7 +40,15 @@ ENV_VARS = {
 
 def is_initialized() -> bool:
     """Whether the multi-host runtime is up (single-process runs: False)."""
-    return jax.distributed.is_initialized()
+    fn = getattr(jax.distributed, "is_initialized", None)
+    if fn is not None:
+        return bool(fn())
+    # older jax without the public predicate: consult the global state the
+    # initialize() call populates
+    state = getattr(
+        getattr(jax._src, "distributed", None), "global_state", None
+    )
+    return getattr(state, "client", None) is not None
 
 
 def initialize(
@@ -100,6 +108,17 @@ def initialize(
                 type(exc).__name__, exc, *sorted(ENV_VARS.values()),
                 exc_info=logger.isEnabledFor(logging.DEBUG),
             )
+            from ..utils.telemetry import current as _tel
+
+            tel = _tel()
+            if tel is not None:
+                # the crash-safe JSONL keeps the "other hosts will hang"
+                # precondition diagnosable offline — the warning above
+                # scrolls away, the event does not
+                tel.emit(
+                    "distributed_autodetect_failed",
+                    error=type(exc).__name__, detail=str(exc)[:200],
+                )
         else:
             logger.info(
                 "joined coordination service: process %d/%d, %d local "

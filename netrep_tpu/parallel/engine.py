@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import stats as jstats
 from ..ops.oracle import N_STATS
+from ..utils import faults as flt
 from ..utils import telemetry as tm
 from ..utils.config import EngineConfig
 
@@ -84,6 +85,7 @@ def run_checkpointed_chunks(
     fingerprint_extra: bytes = b"",
     profile=None,
     telemetry=None,
+    fault_policy=None,
 ) -> tuple[np.ndarray, int]:
     """The single chunked/interruptible/checkpointable null loop shared by
     :class:`PermutationEngine` and ``MultiTestEngine`` (one implementation so
@@ -103,9 +105,22 @@ def run_checkpointed_chunks(
     ambient bus when None) gets per-chunk events with the profile's
     dispatch/host-byte deltas folded in, a run start/end envelope, and a
     stall watchdog armed for the run.
+
+    ``fault_policy`` (a :class:`~netrep_tpu.utils.config.FaultPolicy` /
+    :class:`~netrep_tpu.utils.faults.FaultRuntime`, or None for the
+    bit-identical default path) wraps every chunk dispatch in the
+    retry/abandon/degrade ladder of :mod:`netrep_tpu.utils.faults` —
+    transient failures re-dispatch the same ``fold_in`` keys after
+    backoff, hung dispatches are abandoned after an emergency checkpoint,
+    and device-loss failures raise
+    :class:`~netrep_tpu.utils.faults.DeviceLostError` past the
+    failure-save hook below. With a policy active the dispatch is also
+    blocked-until-ready inside the retry scope, trading the
+    double-buffer overlap for a retryable failure envelope.
     """
     key = _resolve_key(base, key)
     telemetry, profile = _telemetry_profile(telemetry, profile)
+    ft = flt.resolve_runtime(fault_policy)
 
     save = None
     loaded = None
@@ -141,7 +156,21 @@ def run_checkpointed_chunks(
     # throughput between the first and last marks (first chunk's compile
     # excluded) feeds the persistent autotune cache (utils/autotune.py)
     t_marks: list[tuple[int, float]] = []
-    wd = tm.arm_watchdog(telemetry)
+
+    def rescue():
+        # emergency checkpoint of completed work — called from the fault
+        # runtime (abandon path) or the watchdog thread (warn→act); only
+        # committed state is touched, so it is safe while the loop thread
+        # hangs inside a dispatch
+        if save is not None and completed > last_saved:
+            save(nulls, completed)
+
+    if ft is not None:
+        action, act_factor = ft.watchdog_escalation(rescue)
+        wd = tm.arm_watchdog(telemetry, action=action,
+                             action_factor=act_factor)
+    else:
+        wd = tm.arm_watchdog(telemetry)
     prev_t = t_run0 = time.perf_counter()
     d0, b0 = prev_d, prev_b = _profile_totals(profile)
     if telemetry is not None:
@@ -153,7 +182,14 @@ def run_checkpointed_chunks(
             if dispatched < n_perm:
                 take = min(C, n_perm - dispatched)
                 keys = base.perm_keys(key, dispatched, take if dynamic else C)
-                nxt = (fn(keys), dispatched, take)
+                if ft is None:
+                    outs = fn(keys)
+                else:
+                    outs = ft.run_dispatch(
+                        lambda: fn(keys), start=dispatched, take=take,
+                        telemetry=telemetry, rescue=rescue,
+                    )
+                nxt = (outs, dispatched, take)
                 dispatched += take
                 if profile is not None:
                     profile.record_dispatch(2)  # key derivation + chunk
@@ -192,6 +228,24 @@ def run_checkpointed_chunks(
                 completed = at + take_p
             except KeyboardInterrupt:
                 pass
+    except BaseException:
+        # failure-save hook (ISSUE 4): a crash or an unrecoverable fault
+        # (incl. DeviceLostError headed for the CPU-degradation ladder)
+        # must never lose completed permutations. The pending chunk's
+        # compute finished before the failing dispatch — flush it too if
+        # its transfer still succeeds (a truly dead device fails here;
+        # the committed prefix is kept either way).
+        if pending is not None:
+            try:
+                outs, at, take_p = pending
+                write(nulls, outs, at, take_p)
+                completed = at + take_p
+            except Exception:
+                pass
+        if save is not None and completed > last_saved:
+            save(nulls, completed)
+            last_saved = completed
+        raise
     finally:
         if wd is not None:
             wd.stop()
@@ -394,6 +448,7 @@ def run_stream_superchunks(
     fingerprint_extra: bytes = b"",
     profile=None,
     telemetry=None,
+    fault_policy=None,
 ) -> StreamCounts:
     """Fixed-``n_perm`` streaming loop shared by :class:`PermutationEngine`
     and ``MultiTestEngine``: dispatch one scan-fused superchunk of
@@ -418,9 +473,16 @@ def run_stream_superchunks(
     ``telemetry`` gets one ``superchunk`` event per fused dispatch (the
     dispatch/host-byte counters :class:`NullProfile` folds) plus the run
     envelope and a stall watchdog, exactly like the materialized loop.
+
+    ``fault_policy`` applies the same retry/abandon/degrade ladder as
+    :func:`run_checkpointed_chunks`; a retried superchunk first rebuilds
+    the (donated, hence possibly consumed) device tally carry from the
+    last committed host tallies, so a failed fused dispatch re-folds from
+    exactly the state an unfaulted run had at that boundary.
     """
     key = _resolve_key(base, key)
     telemetry, profile = _telemetry_profile(telemetry, profile)
+    ft = flt.resolve_runtime(fault_policy)
     K, C = int(superchunk), int(chunk_size)
     completed = 0
     host0 = None
@@ -455,7 +517,26 @@ def run_stream_superchunks(
     hi = lo = eff = None
     last_saved = completed
     t_marks: list[tuple[int, float]] = []
-    wd = tm.arm_watchdog(telemetry)
+
+    def rescue():
+        # emergency checkpoint of the last committed superchunk's tallies
+        # (safe from the watchdog thread: only committed host state)
+        if save is not None and hi is not None and completed > last_saved:
+            save(hi, lo, eff, completed)
+
+    def reset():
+        # a failed fused dispatch may have consumed the donated carry:
+        # rebuild it from the last committed host tallies (bit-identical
+        # to the carry an unfaulted run held at this boundary)
+        nonlocal tallies
+        tallies = init_tallies((hi, lo, eff) if hi is not None else host0)
+
+    if ft is not None:
+        action, act_factor = ft.watchdog_escalation(rescue)
+        wd = tm.arm_watchdog(telemetry, action=action,
+                             action_factor=act_factor)
+    else:
+        wd = tm.arm_watchdog(telemetry)
     prev_t = t_run0 = time.perf_counter()
     d0, b0 = _profile_totals(profile)
     if telemetry is not None:
@@ -476,7 +557,16 @@ def run_stream_superchunks(
             ).astype(np.int32)
             # fold + counter commit in one statement (clean-Ctrl-C
             # contract: a consistent partial result at any interrupt)
-            tallies, completed = fn(tallies, keys, valid), completed + take
+            if ft is None:
+                tallies, completed = fn(tallies, keys, valid), completed + take
+            else:
+                # the lambda reads `tallies` at call time, so a retry after
+                # `reset` folds into the rebuilt carry
+                tallies, completed = ft.run_dispatch(
+                    lambda: fn(tallies, keys, valid), start=completed,
+                    take=take, telemetry=telemetry, rescue=rescue,
+                    reset=reset, label="superchunk",
+                ), completed + take
             hi, lo, eff = pull_tallies(tallies)
             t_marks.append((completed, time.perf_counter()))
             if profile is not None:
@@ -500,6 +590,12 @@ def run_stream_superchunks(
                 last_saved = completed
     except KeyboardInterrupt:
         pass
+    except BaseException:
+        # failure-save hook (ISSUE 4): committed tallies survive any crash
+        if save is not None and hi is not None and completed > last_saved:
+            save(hi, lo, eff, completed)
+            last_saved = completed
+        raise
     finally:
         if wd is not None:
             wd.stop()
@@ -540,6 +636,7 @@ def run_adaptive_stream_chunks(
     fingerprint_extra: bytes = b"",
     profile=None,
     telemetry=None,
+    fault_policy=None,
 ) -> tuple:
     """Adaptive (sequential early-stopping) streaming loop: one chunk per
     dispatch — decisions must land at CHUNK boundaries exactly as the
@@ -560,10 +657,14 @@ def run_adaptive_stream_chunks(
     ``eff``) in ``x_``-prefixed extras; there is no written-but-unfolded
     gap to re-fold on resume — counts and monitor commit in one statement.
 
-    Returns ``(monitor, completed, finished)``.
+    Returns ``(monitor, completed, finished)``. ``fault_policy`` wraps
+    each count dispatch in the retry/abandon/degrade ladder (no carry to
+    rebuild here — counts and monitor commit in one statement, so a retry
+    simply re-dispatches the chunk).
     """
     key = _resolve_key(base, key)
     telemetry, profile = _telemetry_profile(telemetry, profile)
+    ft = flt.resolve_runtime(fault_policy)
     # retirement events come from the monitor itself (per-module tallies
     # live there); the loop only provides the bus
     monitor.telemetry = telemetry
@@ -594,7 +695,19 @@ def run_adaptive_stream_chunks(
     C = base.effective_chunk()
     last_saved = completed
     finished = True
-    wd = tm.arm_watchdog(telemetry)
+
+    def rescue():
+        # the monitor folds counts atomically at chunk boundaries, so its
+        # state is always consistent from the watchdog thread's view
+        if save is not None and completed > last_saved:
+            save(completed)
+
+    if ft is not None:
+        action, act_factor = ft.watchdog_escalation(rescue)
+        wd = tm.arm_watchdog(telemetry, action=action,
+                             action_factor=act_factor)
+    else:
+        wd = tm.arm_watchdog(telemetry)
     prev_t = t_run0 = time.perf_counter()
     d0, b0 = _profile_totals(profile)
     if telemetry is not None:
@@ -607,7 +720,13 @@ def run_adaptive_stream_chunks(
             pos = monitor.active_positions()
             take = min(C, n_perm - completed)
             keys = base.perm_keys(key, completed, C)
-            outs = fn(keys, np.int32(take))
+            if ft is None:
+                outs = fn(keys, np.int32(take))
+            else:
+                outs = ft.run_dispatch(
+                    lambda: fn(keys, np.int32(take)), start=completed,
+                    take=take, telemetry=telemetry, rescue=rescue,
+                )
             hi_a, lo_a, eff_a = counts_to_active(outs, pos)
             if profile is not None:
                 profile.record_dispatch(2)
@@ -641,6 +760,13 @@ def run_adaptive_stream_chunks(
         # the checkpoint below resumes exactly
         finished = False
         completed = monitor.folded
+    except BaseException:
+        # failure-save hook (ISSUE 4): folded chunks survive any crash
+        completed = monitor.folded
+        if save is not None and completed > last_saved:
+            save(completed)
+            last_saved = completed
+        raise
     finally:
         if wd is not None:
             wd.stop()
@@ -657,6 +783,11 @@ def run_adaptive_stream_chunks(
     return monitor, completed, finished
 
 
+#: one-shot flag for the unknown-sharding downgrade below — the benign
+#: case repeats every chunk of a run, so warn/emit once per process
+_UNKNOWN_SHARDING_SEEN = False
+
+
 def _trim_tail_shards(out, take: int, axis: int = 0):
     """Multi-host tail chunks only: drop whole trailing perm-axis shards
     of a chunk output before the cross-host allgather, so the final
@@ -667,11 +798,27 @@ def _trim_tail_shards(out, take: int, axis: int = 0):
     documented eager-op-avoidance on tunneled single-host backends (each
     eager device op costs ~1 s there; the host-side ``[:take]`` slice in
     ``write`` stays the single-host policy)."""
+    global _UNKNOWN_SHARDING_SEEN
     if take >= out.shape[axis] or getattr(out, "is_fully_addressable", True):
         return out
     try:
         rows = out.sharding.shard_shape(out.shape)[axis]
-    except Exception:  # unknown sharding object: transfer as before
+    except (AttributeError, TypeError, ValueError) as e:
+        # a sharding object that doesn't speak shard_shape: transfer the
+        # full chunk as before, but say so once — while a genuine backend
+        # failure (RuntimeError/XlaRuntimeError) now PROPAGATES instead of
+        # being silently swallowed as "transfer as before"
+        if not _UNKNOWN_SHARDING_SEEN:
+            _UNKNOWN_SHARDING_SEEN = True
+            logger.warning(
+                "tail-shard trim skipped: %s sharding does not expose "
+                "shard_shape (%s: %s); transferring the full tail chunk",
+                type(getattr(out, "sharding", None)).__name__,
+                type(e).__name__, e,
+            )
+            tel = tm.current()
+            if tel is not None:
+                tel.emit("tail_trim_skipped", error=type(e).__name__)
         return out
     if not rows or rows <= 0:
         return out
@@ -722,6 +869,7 @@ def run_adaptive_chunks(
     perm_axis: int = 0,
     fingerprint_extra: bytes = b"",
     telemetry=None,
+    fault_policy=None,
 ) -> tuple[np.ndarray, int, bool]:
     """Adaptive scheduling layer around the shared chunked null loop: after
     each chunk a host-side :class:`~netrep_tpu.ops.sequential.StopMonitor`
@@ -757,9 +905,14 @@ def run_adaptive_chunks(
     chunk *k+1*'s module set is known, so the dispatch chain is inherently
     synchronous. The throughput cost is bounded by the device→host copy of
     chunks that shrink as modules retire.
+
+    ``fault_policy`` wraps each chunk dispatch in the retry/abandon/
+    degrade ladder; decisions are unaffected (tallies fold only from
+    committed chunks, and a retried chunk regenerates identical keys).
     """
     key = _resolve_key(base, key)
     telemetry = tm.resolve(telemetry)
+    ft = flt.resolve_runtime(fault_policy)
     monitor.telemetry = telemetry
     nulls = np.full(alloc_shape, np.nan)
     completed = 0
@@ -800,7 +953,19 @@ def run_adaptive_chunks(
     dynamic = getattr(base, "dynamic_chunk", False)
     last_saved = completed
     finished = True
-    wd = tm.arm_watchdog(telemetry)
+
+    def rescue():
+        # completed counts only fully-written-and-folded chunks, so the
+        # watchdog thread checkpoints a consistent prefix
+        if save is not None and completed > last_saved:
+            save(nulls, completed)
+
+    if ft is not None:
+        action, act_factor = ft.watchdog_escalation(rescue)
+        wd = tm.arm_watchdog(telemetry, action=action,
+                             action_factor=act_factor)
+    else:
+        wd = tm.arm_watchdog(telemetry)
     prev_t = t_run0 = time.perf_counter()
     if telemetry is not None:
         telemetry.emit(
@@ -812,7 +977,13 @@ def run_adaptive_chunks(
             pos = monitor.active_positions()
             take = min(C, n_perm - completed)
             keys = base.perm_keys(key, completed, take if dynamic else C)
-            outs = fn(keys)
+            if ft is None:
+                outs = fn(keys)
+            else:
+                outs = ft.run_dispatch(
+                    lambda: fn(keys), start=completed, take=take,
+                    telemetry=telemetry, rescue=rescue,
+                )
             write(nulls, outs, completed, take)
             completed += take
             newly = monitor.update(
@@ -839,6 +1010,12 @@ def run_adaptive_chunks(
         # chunk-boundary abort: tallies were only ever folded for fully
         # written chunks, so the checkpoint below resumes exactly
         finished = False
+    except BaseException:
+        # failure-save hook (ISSUE 4): written chunks survive any crash
+        if save is not None and completed > last_saved:
+            save(nulls, completed)
+            last_saved = completed
+        raise
     finally:
         if wd is not None:
             wd.stop()
@@ -1675,6 +1852,7 @@ class PermutationEngine:
         checkpoint_every: int = 8192,
         profile=None,
         telemetry=None,
+        fault_policy=None,
     ) -> tuple[np.ndarray, int]:
         """Compute the permutation null distribution.
 
@@ -1706,6 +1884,15 @@ class PermutationEngine:
             events, run envelope, stall watchdog. Off (None, no ambient
             bus) costs one ``None`` check per run and results are
             bit-identical.
+        fault_policy : optional
+            :class:`~netrep_tpu.utils.config.FaultPolicy` (or a shared
+            :class:`~netrep_tpu.utils.faults.FaultRuntime`): transient
+            dispatch failures retry with backoff (exact — chunk *i*
+            regenerates identical keys), hung dispatches are abandoned
+            after an emergency checkpoint, device loss raises
+            :class:`~netrep_tpu.utils.faults.DeviceLostError` for the
+            caller's CPU-degradation ladder. None (default) is
+            bit-identical to previous releases.
 
         Returns
         -------
@@ -1729,7 +1916,7 @@ class PermutationEngine:
             (n_perm, self.n_modules, N_STATS), self._null_write(profile),
             progress=progress, nulls_init=nulls_init, start_perm=start_perm,
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
-            profile=profile, telemetry=telemetry,
+            profile=profile, telemetry=telemetry, fault_policy=fault_policy,
         )
 
     def _null_write(self, profile=None) -> Callable:
@@ -1769,6 +1956,7 @@ class PermutationEngine:
         checkpoint_path: str | None = None,
         checkpoint_every: int = 8192,
         telemetry=None,
+        fault_policy=None,
     ) -> tuple[np.ndarray, int, bool]:
         """Sequential early-stopping variant of :meth:`run_null`
         (:func:`run_adaptive_chunks`): ``n_perm`` becomes a *ceiling* —
@@ -1807,6 +1995,7 @@ class PermutationEngine:
                 slice_vals, monitor, self.rebucket,
                 progress=progress, checkpoint_path=checkpoint_path,
                 checkpoint_every=checkpoint_every, telemetry=telemetry,
+                fault_policy=fault_policy,
             )
         finally:
             # leave the engine reusable at full strength (e.g. a fixed-n
@@ -1994,6 +2183,7 @@ class PermutationEngine:
         checkpoint_every: int = 8192,
         profile=None,
         telemetry=None,
+        fault_policy=None,
     ) -> StreamCounts:
         """Streaming-mode (``store_nulls=False``) variant of
         :meth:`run_null` — the superchunk executor: K consecutive chunks
@@ -2029,7 +2219,7 @@ class PermutationEngine:
             self._stream_tallies_init, self._stream_tallies_pull,
             progress=progress, checkpoint_path=checkpoint_path,
             checkpoint_every=checkpoint_every, profile=profile,
-            telemetry=telemetry,
+            telemetry=telemetry, fault_policy=fault_policy,
         )
 
     def run_null_adaptive_streaming(
@@ -2044,6 +2234,7 @@ class PermutationEngine:
         checkpoint_every: int = 8192,
         profile=None,
         telemetry=None,
+        fault_policy=None,
     ) -> StreamCounts:
         """Streaming-mode variant of :meth:`run_null_adaptive`: the
         :class:`~netrep_tpu.ops.sequential.StopMonitor` folds
@@ -2073,7 +2264,7 @@ class PermutationEngine:
                 self._counts_to_active, monitor, self.rebucket,
                 progress=progress, checkpoint_path=checkpoint_path,
                 checkpoint_every=checkpoint_every, profile=profile,
-                telemetry=telemetry,
+                telemetry=telemetry, fault_policy=fault_policy,
             )
         finally:
             self.rebucket(range(self.n_modules))

@@ -452,7 +452,7 @@ class MultiTestEngine:
                  nulls_init=None, start_perm: int = 0,
                  checkpoint_path: str | None = None,
                  checkpoint_every: int = 8192, profile=None,
-                 telemetry=None):
+                 telemetry=None, fault_policy=None):
         """(T, n_perm, n_modules, 7) null array + completed count; same
         chunked/interruptible/reproducible/resumable/checkpointable contract
         as the base engine (key derivation and chunk rounding are shared
@@ -470,6 +470,7 @@ class MultiTestEngine:
             progress=progress, nulls_init=nulls_init, start_perm=start_perm,
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
             perm_axis=1, profile=profile, telemetry=telemetry,
+            fault_policy=fault_policy,
             # the test-side matrices live on this wrapper (the base engine is
             # discovery-only), so their content digest rides fingerprint_extra
             fingerprint_extra=self._fingerprint_extra(),
@@ -479,7 +480,8 @@ class MultiTestEngine:
                           alternative: str = "greater", rule=None,
                           progress=None,
                           checkpoint_path: str | None = None,
-                          checkpoint_every: int = 8192, telemetry=None):
+                          checkpoint_every: int = 8192, telemetry=None,
+                          fault_policy=None):
         """Sequential early-stopping variant of :meth:`run_null`
         (:meth:`PermutationEngine.run_null_adaptive` semantics). A module
         retires only when its decision is settled in EVERY test dataset:
@@ -509,7 +511,7 @@ class MultiTestEngine:
                 progress=progress, checkpoint_path=checkpoint_path,
                 checkpoint_every=checkpoint_every, perm_axis=1,
                 fingerprint_extra=self._fingerprint_extra(),
-                telemetry=telemetry,
+                telemetry=telemetry, fault_policy=fault_policy,
             )
         finally:
             self.rebucket(range(self.n_modules))
@@ -660,7 +662,7 @@ class MultiTestEngine:
                            progress=None,
                            checkpoint_path: str | None = None,
                            checkpoint_every: int = 8192, profile=None,
-                           telemetry=None):
+                           telemetry=None, fault_policy=None):
         """Streaming-mode (``store_nulls=False``) variant of
         :meth:`run_null` — the superchunk executor over the shared
         permutation draw, tallying every (dataset, module, statistic) cell
@@ -683,7 +685,7 @@ class MultiTestEngine:
             progress=progress, checkpoint_path=checkpoint_path,
             checkpoint_every=checkpoint_every,
             fingerprint_extra=self._fingerprint_extra(), profile=profile,
-            telemetry=telemetry,
+            telemetry=telemetry, fault_policy=fault_policy,
         )
 
     def run_null_adaptive_streaming(self, n_perm: int, observed, key=0,
@@ -691,7 +693,8 @@ class MultiTestEngine:
                                     progress=None,
                                     checkpoint_path: str | None = None,
                                     checkpoint_every: int = 8192,
-                                    profile=None, telemetry=None):
+                                    profile=None, telemetry=None,
+                                    fault_policy=None):
         """Streaming-mode variant of :meth:`run_null_adaptive`: the
         monitor folds device-computed (dataset × statistic) counts
         directly, with retirement decisions bit-identical to the
@@ -716,6 +719,7 @@ class MultiTestEngine:
                 checkpoint_every=checkpoint_every,
                 fingerprint_extra=self._fingerprint_extra(),
                 profile=profile, telemetry=telemetry,
+                fault_policy=fault_policy,
             )
         finally:
             self.rebucket(range(self.n_modules))

@@ -152,6 +152,27 @@ def probe_default_backend(timeout: float) -> str:
     return outcome
 
 
+def degrade_to_cpu(reason: str, **context) -> None:
+    """Mid-run CPU degradation (ISSUE 4, the last rung of the fault
+    ladder): force the CPU platform via the live config (rule 1 above —
+    the env var alone would not redirect an already-started process) and
+    announce it, structurally (one ``degraded_to_cpu`` event carrying
+    ``reason`` + caller context) and via the logger. Callers rebuild
+    their engines afterwards and resume from the failure-saved
+    checkpoint; per-permutation keys depend only on ``(key, index)``, so
+    the resumed CPU run continues the same null stream."""
+    import jax
+
+    tel = _telemetry()
+    if tel is not None:
+        tel.emit("degraded_to_cpu", reason=reason, **context)
+    logger.warning(
+        "degrading to the CPU platform (%s); engines will be rebuilt on "
+        "CPU and resumed from checkpoint", reason,
+    )
+    jax.config.update("jax_platforms", "cpu")
+
+
 def resolve_backend_or_cpu(probe_timeout: float | None = None) -> None:
     """Make the next ``jax.devices()`` call hang-safe: honor an explicit
     non-TPU platform, keep a probed-live tunnel, and force the CPU platform

@@ -12,6 +12,93 @@ import dataclasses
 
 
 @dataclasses.dataclass(frozen=True)
+class FaultPolicy:
+    """Recovery knobs for fault-tolerant null execution (ISSUE 4;
+    :mod:`netrep_tpu.utils.faults`), surfaced as
+    ``module_preservation(fault_policy=...)``. ``None`` (the default)
+    keeps every null loop bit-identical to previous releases.
+
+    Attributes
+    ----------
+    max_retries : re-dispatch attempts per chunk for *transient* failures
+        (gRPC deadline, dropped tunnel — see
+        :func:`netrep_tpu.utils.faults.classify_error`). Retries are exact
+        by construction: chunk *i* regenerates identical ``fold_in`` keys.
+    backoff_base_s / backoff_factor / backoff_max_s : exponential backoff
+        between attempts — ``base * factor**(attempt-1)`` capped at
+        ``max``.
+    backoff_jitter : +- fraction of the delay, derived deterministically
+        from ``(chunk start, attempt)`` — reproducible schedules, no
+        hidden RNG state.
+    degrade_to_cpu : a *device-loss*-class failure (or repeated hang
+        abandonment) saves an emergency checkpoint, forces the CPU
+        platform, rebuilds the engine, and resumes bit-identically
+        mid-run; False propagates the error after the checkpoint instead.
+    hang_timeout_s : per-dispatch wall-clock budget; a dispatch exceeding
+        it is abandoned (the worker thread is walked away from), completed
+        work checkpointed, and the chunk re-dispatched. Set it well above
+        the WORST-case dispatch time — the first chunk's jit compile
+        included — or healthy dispatches get abandoned too; for
+        steady-state hang detection prefer ``watchdog_action``, whose
+        threshold is measured with the compile interval excluded. None
+        relies on the watchdog escalation alone.
+    watchdog_action : escalate the telemetry stall watchdog from warn to
+        act — when a stall outlasts ``stall_action_factor`` × the measured
+        steady chunk time, the watchdog thread checkpoints completed work
+        and abandons the hung dispatch. Needs telemetry on (the watchdog
+        is armed per null run only then).
+    stall_action_factor : the act threshold, as a multiple of the steady
+        chunk time (the warn threshold defaults to 10×; act defaults to
+        30× — warn early, act late).
+    max_abandons : hung-dispatch abandonments tolerated per run before the
+        backend is presumed dead and the device-loss ladder applies.
+    plan : deterministic fault-injection plan (a spec string such as
+        ``"transient@128;device_lost@64"`` or a tuple of
+        :class:`~netrep_tpu.utils.faults.FaultSpec`) — the test/CI harness
+        that proves every recovery path; also settable via the
+        ``NETREP_FAULT_PLAN`` env var. None injects nothing.
+    """
+
+    max_retries: int = 3
+    backoff_base_s: float = 0.25
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 30.0
+    backoff_jitter: float = 0.25
+    degrade_to_cpu: bool = True
+    hang_timeout_s: float | None = None
+    watchdog_action: bool = True
+    stall_action_factor: float = 30.0
+    max_abandons: int = 2
+    plan: object = None
+
+    def __post_init__(self):
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {self.max_retries!r}")
+        if self.max_abandons < 0:
+            raise ValueError(f"max_abandons must be >= 0, got {self.max_abandons!r}")
+        for name in ("backoff_base_s", "backoff_max_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0, got {getattr(self, name)!r}")
+        if self.backoff_factor < 1.0:
+            raise ValueError(
+                f"backoff_factor must be >= 1, got {self.backoff_factor!r}"
+            )
+        if not 0.0 <= self.backoff_jitter < 1.0:
+            raise ValueError(
+                f"backoff_jitter must be in [0, 1), got {self.backoff_jitter!r}"
+            )
+        if self.hang_timeout_s is not None and self.hang_timeout_s <= 0:
+            raise ValueError(
+                f"hang_timeout_s must be > 0 or None, got {self.hang_timeout_s!r}"
+            )
+        if self.stall_action_factor <= 0:
+            raise ValueError(
+                "stall_action_factor must be > 0, got "
+                f"{self.stall_action_factor!r}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class EngineConfig:
     """Tuning knobs for the permutation engine (SURVEY.md §5).
 

@@ -1,0 +1,584 @@
+"""Fault-tolerant null execution (ISSUE 4).
+
+The reference run is all-or-nothing and the north-star backends
+(tunneled/preemptible TPU) make failures the common case: gRPC deadlines,
+dropped tunnels, hung dispatches, lost devices. PRs 1-3 built the
+primitives that make recovery *provably exact* — the ``fold_in(key, i)``
+per-permutation RNG contract (re-dispatching chunk *i* regenerates
+identical keys), ``resume == uninterrupted`` checkpoints, and the
+telemetry stall watchdog. This module turns those primitives into a
+recovery ladder around every null loop:
+
+1. **Retry with backoff** — a dispatch failure classified *transient*
+   (:func:`classify_error`) is re-dispatched after exponential backoff
+   with deterministic jitter, up to ``FaultPolicy.max_retries`` times.
+   Exact by construction: the retried chunk draws the same permutations.
+2. **Abandon a hung dispatch** — with ``hang_timeout_s`` set (or the
+   stall watchdog's warn→act escalation wired), dispatches run on a
+   worker thread; a dispatch that neither returns nor errors is
+   *abandoned* (``chunk_abandoned`` event), completed work is
+   checkpointed, and the chunk is re-dispatched. More than
+   ``max_abandons`` abandonments escalates to the device-loss ladder.
+3. **Degrade to CPU** — a *device-loss*-class failure raises
+   :class:`DeviceLostError` past the loop's failure-save hook (which
+   checkpoints all completed permutations first); the API layer
+   (``models/preservation.py``) then forces the CPU platform
+   (:func:`netrep_tpu.utils.backend.degrade_to_cpu`), rebuilds the
+   engine, and resumes from the checkpoint — bit-identically, because
+   per-permutation keys depend only on ``(key, index)``.
+
+Everything is driven by :class:`FaultPolicy`
+(:mod:`netrep_tpu.utils.config`), surfaced as
+``module_preservation(fault_policy=...)``. Disabled (the default), the
+loops pay one ``None`` check per run and are bit-identical to previous
+releases.
+
+**Fault injection.** Every recovery path is tested, not trusted: a
+:class:`FaultInjector` raises chosen error classes at chosen permutation
+boundaries from a deterministic plan. Plans are compact strings —
+``"transient@128"`` (fail the dispatch covering permutation 128 once),
+``"transient@128x3"`` (three successive attempts), ``"device_lost@64"``,
+``"hang@192"``, ``"interrupt@96"``, ``"fatal@32"`` — joined with ``;``,
+set via ``FaultPolicy(plan=...)`` or the ``NETREP_FAULT_PLAN`` env var
+(which also *activates* a default policy, for bench/CI runs). Injection
+state lives on the :class:`FaultRuntime`, which survives engine rebuilds
+within one ``module_preservation`` call — so an injected device loss
+fires once, not again on the degraded resume.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+from .config import FaultPolicy
+
+logger = logging.getLogger("netrep_tpu")
+
+__all__ = [
+    "FaultPolicy",
+    "FaultRuntime",
+    "FaultInjector",
+    "FaultSpec",
+    "DeviceLostError",
+    "DispatchAbandonedError",
+    "InjectedTransientError",
+    "InjectedDeviceLost",
+    "InjectedFatalError",
+    "classify_error",
+    "parse_plan",
+    "backoff_delay",
+    "resolve_runtime",
+]
+
+#: env var holding a fault plan; when set it also ACTIVATES a default
+#: FaultPolicy for runs that passed fault_policy=None (bench/CI injection)
+PLAN_ENV = "NETREP_FAULT_PLAN"
+
+
+# ---------------------------------------------------------------------------
+# Error taxonomy
+# ---------------------------------------------------------------------------
+
+
+class InjectedTransientError(RuntimeError):
+    """Injected stand-in for a retryable backend failure (gRPC deadline,
+    dropped tunnel packet) — classified ``transient``."""
+
+
+class InjectedDeviceLost(RuntimeError):
+    """Injected stand-in for a lost/preempted device — classified
+    ``device_lost``."""
+
+
+class InjectedFatalError(RuntimeError):
+    """Injected stand-in for a genuine bug-class failure — never retried."""
+
+
+class DispatchAbandonedError(RuntimeError):
+    """A hung dispatch was abandoned (timeout or watchdog escalation);
+    classified ``transient`` so the normal retry ladder re-dispatches."""
+
+
+class DeviceLostError(RuntimeError):
+    """Raised to the API layer when the run should degrade to CPU: a
+    device-loss-class failure (``reason='device_lost'``), transient
+    retries exhausted (``'retries_exhausted'`` — a backend that fails
+    every re-dispatch is as gone as a lost device), or too many hung
+    dispatches (``'abandons_exhausted'``). The loop's failure-save hook
+    has already checkpointed every completed permutation when this
+    propagates."""
+
+    def __init__(self, msg: str, reason: str = "device_lost"):
+        super().__init__(msg)
+        self.reason = reason
+
+
+#: lowercase substrings of ``"TypeName: message"`` that mark a failure as
+#: retryable — the gRPC/tunnel vocabulary of the axon backend's transport
+#: errors (utils/backend.py documents the failure modes)
+_TRANSIENT_MARKERS = (
+    "deadline exceeded",
+    "deadline_exceeded",
+    "unavailable",
+    "connection reset",
+    "connection refused",
+    "connection closed",
+    "broken pipe",
+    "socket closed",
+    "stream removed",
+    "transport closed",
+    "too many pings",
+    "recvmsg",
+    "temporarily",
+)
+
+#: markers of a lost/preempted device — not retryable in place; the
+#: degradation ladder (emergency checkpoint → CPU rebuild → resume) applies
+_DEVICE_LOSS_MARKERS = (
+    "device lost",
+    "lost device",
+    "device is lost",
+    "device failure",
+    "device disconnected",
+    "chip has been lost",
+    "preempted",
+    "halted",
+)
+
+
+def classify_error(exc: BaseException) -> str:
+    """``'transient'`` (retry in place), ``'device_lost'`` (degradation
+    ladder), or ``'fatal'`` (propagate — the default, so genuine bugs are
+    never silently retried). Classification keys on exception type first
+    (injected faults, connection errors), then on the lowercased
+    ``"TypeName: message"`` text, because JAX surfaces backend failures as
+    generic ``XlaRuntimeError``/``RuntimeError`` with a status-code
+    message."""
+    if isinstance(exc, (InjectedTransientError, DispatchAbandonedError)):
+        return "transient"
+    if isinstance(exc, InjectedDeviceLost):
+        return "device_lost"
+    if isinstance(exc, InjectedFatalError):
+        return "fatal"
+    text = f"{type(exc).__name__}: {exc}".lower()
+    if any(m in text for m in _DEVICE_LOSS_MARKERS):
+        return "device_lost"
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return "transient"
+    if any(m in text for m in _TRANSIENT_MARKERS):
+        return "transient"
+    return "fatal"
+
+
+# ---------------------------------------------------------------------------
+# Fault plans (deterministic injection harness)
+# ---------------------------------------------------------------------------
+
+_KINDS = ("transient", "device_lost", "fatal", "hang", "interrupt")
+
+_RAISERS = {
+    "transient": lambda spec: InjectedTransientError(
+        f"injected transient fault at permutation {spec.at_perm}"
+    ),
+    "device_lost": lambda spec: InjectedDeviceLost(
+        f"injected device loss at permutation {spec.at_perm}"
+    ),
+    "fatal": lambda spec: InjectedFatalError(
+        f"injected fatal fault at permutation {spec.at_perm}"
+    ),
+}
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One planned fault: raise ``kind`` on the dispatch whose permutation
+    range ``[start, start+take)`` covers ``at_perm``, ``times`` successive
+    attempts in a row."""
+
+    kind: str
+    at_perm: int
+    times: int = 1
+
+
+def parse_plan(spec) -> tuple[FaultSpec, ...]:
+    """Parse a plan — a spec string (``"kind@perm[xN]"`` entries joined by
+    ``;`` or ``,``), an iterable of :class:`FaultSpec`, or None/"" (empty
+    plan). Raises ``ValueError`` on malformed entries so a typo'd CI env
+    var fails loudly instead of silently injecting nothing."""
+    if not spec:
+        return ()
+    if not isinstance(spec, str):
+        out = tuple(spec)
+        for s in out:
+            if not isinstance(s, FaultSpec):
+                raise ValueError(f"not a FaultSpec: {s!r}")
+        return out
+    out = []
+    for entry in spec.replace(",", ";").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        try:
+            kind, at = entry.split("@", 1)
+            times = 1
+            if "x" in at:
+                at, times_s = at.split("x", 1)
+                times = int(times_s)
+            fs = FaultSpec(kind.strip(), int(at), times)
+        except ValueError as e:
+            raise ValueError(
+                f"malformed fault-plan entry {entry!r} (want "
+                f"'kind@perm' or 'kind@permxN'): {e}"
+            ) from None
+        if fs.kind not in _KINDS:
+            raise ValueError(
+                f"unknown fault kind {fs.kind!r} in plan entry {entry!r}; "
+                f"one of {_KINDS}"
+            )
+        if fs.at_perm < 0 or fs.times < 1:
+            raise ValueError(f"bad fault-plan entry {entry!r}")
+        out.append(fs)
+    return tuple(out)
+
+
+class FaultInjector:
+    """Stateful consumer of a fault plan: :meth:`poll` returns the next
+    unconsumed spec covering the dispatch's permutation range (and
+    decrements its remaining count), or None. State is per-injector, so a
+    runtime shared across an engine rebuild (CPU degradation) never
+    re-fires a consumed fault on the resumed dispatches."""
+
+    def __init__(self, specs: tuple[FaultSpec, ...]):
+        self.specs = tuple(specs)
+        self._remaining = [s.times for s in self.specs]
+
+    def poll(self, start: int, take: int) -> FaultSpec | None:
+        for i, s in enumerate(self.specs):
+            if self._remaining[i] > 0 and start <= s.at_perm < start + take:
+                self._remaining[i] -= 1
+                return s
+        return None
+
+    @property
+    def pending(self) -> int:
+        return sum(self._remaining)
+
+
+# ---------------------------------------------------------------------------
+# Retry / abandon / degradation runtime
+# ---------------------------------------------------------------------------
+
+
+def backoff_delay(policy: FaultPolicy, start: int, attempt: int) -> float:
+    """Exponential backoff with *deterministic* jitter: the jitter factor
+    hashes ``(start, attempt)``, so a rerun of the same faulted run sleeps
+    the same schedule (no hidden RNG state, reproducible bench traces)."""
+    d = min(
+        policy.backoff_max_s,
+        policy.backoff_base_s * policy.backoff_factor ** (attempt - 1),
+    )
+    if policy.backoff_jitter:
+        h = int.from_bytes(
+            hashlib.blake2b(
+                f"{start}:{attempt}".encode(), digest_size=8
+            ).digest(),
+            "big",
+        )
+        d *= 1.0 + policy.backoff_jitter * (h / float(2 ** 64) * 2.0 - 1.0)
+    return max(0.0, d)
+
+
+def _block_ready(outs):
+    """Force dispatch completion inside the retry scope: JAX dispatch is
+    async, so without this a transport failure would surface later at the
+    host transfer, outside the per-chunk retry envelope. Tolerant of
+    non-JAX leaves (the native backend's numpy outputs)."""
+    import jax
+
+    return jax.block_until_ready(outs)
+
+
+class FaultRuntime:
+    """One run's (or one ``module_preservation`` call's) fault-tolerance
+    state: the policy, the injector, and the abandon machinery. The null
+    loops accept it (or a :class:`FaultPolicy`/True) via ``fault_policy=``
+    and wrap every chunk dispatch in :meth:`run_dispatch`."""
+
+    #: worker-thread completion poll period (abandonable dispatches)
+    _poll_s = 0.02
+
+    def __init__(self, policy: FaultPolicy,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.policy = policy
+        spec = policy.plan if policy.plan else os.environ.get(PLAN_ENV)
+        specs = parse_plan(spec)
+        self.injector = FaultInjector(specs) if specs else None
+        if (any(s.kind == "hang" for s in specs)
+                and policy.hang_timeout_s is None):
+            raise ValueError(
+                "a 'hang' fault plan needs fault_policy.hang_timeout_s so "
+                "the abandoned dispatch can be detected deterministically"
+            )
+        self._sleep = sleep
+        self._abandon = threading.Event()
+        self._abandons = 0
+        self._wd_wired = False
+        self._hang_release = threading.Event()  # never set: injected hang
+
+    # -- watchdog escalation (warn → act) ----------------------------------
+
+    def watchdog_escalation(self, rescue: Callable[[], None] | None):
+        """``(action, action_factor)`` for
+        :func:`netrep_tpu.utils.telemetry.arm_watchdog`: when a stall
+        outlasts ``stall_action_factor`` × the steady chunk time, the
+        watchdog THREAD checkpoints completed work (``rescue``) and flags
+        the in-flight dispatch for abandonment — the loop thread is
+        blocked inside the dispatch and cannot act itself. ``(None,
+        None)`` when the policy keeps the watchdog warn-only."""
+        if not self.policy.watchdog_action:
+            return None, None
+        self._wd_wired = True
+
+        def action():
+            try:
+                if rescue is not None:
+                    rescue()
+            except Exception:
+                logger.warning(
+                    "emergency checkpoint from the stall watchdog failed",
+                    exc_info=True,
+                )
+            self._abandon.set()
+
+        return action, self.policy.stall_action_factor
+
+    # -- dispatch wrapper ---------------------------------------------------
+
+    def run_dispatch(
+        self,
+        call: Callable[[], object],
+        *,
+        start: int,
+        take: int,
+        telemetry=None,
+        rescue: Callable[[], None] | None = None,
+        reset: Callable[[], None] | None = None,
+        label: str = "chunk",
+    ):
+        """Evaluate ``call()`` (blocked until ready) under the recovery
+        ladder. ``start``/``take`` name the dispatch's permutation range —
+        the retry identity (re-dispatch regenerates the same ``fold_in``
+        keys) and the injection coordinate. ``rescue()`` checkpoints
+        completed work before an abandonment; ``reset()`` restores loop
+        state consumed by a failed attempt (the streaming loop's donated
+        tally carry). Raises :class:`DeviceLostError` for the degradation
+        ladder, re-raises fatal errors, and passes ``KeyboardInterrupt``
+        through untouched (the loops' clean-interrupt contract)."""
+        pol = self.policy
+        attempt = 0
+        while True:
+            hang = False
+            err = None
+            fault = (
+                self.injector.poll(start, take)
+                if self.injector is not None else None
+            )
+            if fault is not None:
+                if telemetry is not None:
+                    telemetry.emit(
+                        "fault_injected", kind=fault.kind,
+                        at_perm=int(fault.at_perm), start=int(start),
+                        take=int(take), label=label,
+                    )
+                logger.warning(
+                    "fault injected: %s at permutation %d (%s dispatch "
+                    "at %d)", fault.kind, fault.at_perm, label, start,
+                )
+                if fault.kind == "interrupt":
+                    raise KeyboardInterrupt
+                if fault.kind == "hang":
+                    hang = True
+                else:
+                    err = _RAISERS[fault.kind](fault)
+            try:
+                if err is not None:
+                    raise err
+                target = (
+                    (lambda: self._hang_release.wait()) if hang
+                    else (lambda: _block_ready(call()))
+                )
+                if hang or pol.hang_timeout_s is not None or self._wd_wired:
+                    return self._call_abandonable(
+                        target, telemetry=telemetry, start=start, take=take,
+                        rescue=rescue, label=label,
+                    )
+                return target()
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:
+                kind = classify_error(e)
+                if kind == "device_lost":
+                    if telemetry is not None:
+                        telemetry.emit(
+                            "device_lost", start=int(start), take=int(take),
+                            error=type(e).__name__, label=label,
+                        )
+                    logger.warning(
+                        "device-loss-class failure during %s dispatch at "
+                        "permutation %d: %s: %s", label, start,
+                        type(e).__name__, e,
+                    )
+                    if not pol.degrade_to_cpu:
+                        raise
+                    raise DeviceLostError(
+                        f"device lost during {label} dispatch at "
+                        f"permutation {start}; completed work is "
+                        "checkpointed — degrade to CPU and resume"
+                    ) from e
+                if kind != "transient":
+                    raise
+                if attempt >= pol.max_retries:
+                    # retries exhausted: a backend that fails every
+                    # re-dispatch is as dead as a lost device — hand the
+                    # run to the degradation ladder instead of crashing
+                    # with the last transient error
+                    if not pol.degrade_to_cpu:
+                        raise
+                    if telemetry is not None:
+                        telemetry.emit(
+                            "device_lost", start=int(start), take=int(take),
+                            error=type(e).__name__, label=label,
+                            retries=attempt,
+                        )
+                    logger.warning(
+                        "transient retries exhausted (%d) for %s dispatch "
+                        "at permutation %d; backend presumed dead", attempt,
+                        label, start,
+                    )
+                    raise DeviceLostError(
+                        f"transient retries exhausted ({attempt}) for "
+                        f"{label} dispatch at permutation {start}; "
+                        "completed work is checkpointed — degrade to CPU "
+                        "and resume",
+                        reason="retries_exhausted",
+                    ) from e
+                attempt += 1
+                delay = backoff_delay(pol, start, attempt)
+                if telemetry is not None:
+                    telemetry.emit(
+                        "retry_attempt", start=int(start), take=int(take),
+                        attempt=attempt, max_retries=pol.max_retries,
+                        delay_s=float(delay), error=type(e).__name__,
+                        label=label,
+                    )
+                logger.warning(
+                    "transient %s during %s dispatch at permutation %d; "
+                    "retry %d/%d in %.2gs", type(e).__name__, label, start,
+                    attempt, pol.max_retries, delay,
+                )
+                if delay > 0:
+                    self._sleep(delay)
+                if reset is not None:
+                    reset()
+
+    def _call_abandonable(self, target, *, telemetry, start, take, rescue,
+                          label):
+        """Run ``target`` on a daemon worker thread so a dispatch hung in
+        a no-deadline gRPC call can be walked away from: on
+        ``hang_timeout_s`` elapsing or the watchdog's abandon flag, emit
+        ``chunk_abandoned``, checkpoint completed work, and raise
+        :class:`DispatchAbandonedError` (transient → the retry ladder
+        re-dispatches). The abandoned thread is leaked deliberately — it
+        is blocked in native code and cannot be interrupted; a later
+        completion is discarded."""
+        box: dict = {}
+        done = threading.Event()
+
+        def worker():
+            try:
+                box["out"] = target()
+            except BaseException as e:  # delivered to the loop thread below
+                box["err"] = e
+            finally:
+                done.set()
+
+        self._abandon.clear()
+        t0 = time.monotonic()
+        threading.Thread(
+            target=worker, name="netrep-ft-dispatch", daemon=True
+        ).start()
+        deadline = self.policy.hang_timeout_s
+        while not done.wait(self._poll_s):
+            waited = time.monotonic() - t0
+            timed_out = deadline is not None and waited > deadline
+            if not (self._abandon.is_set() or timed_out):
+                continue
+            by = "watchdog" if self._abandon.is_set() else "timeout"
+            self._abandons += 1
+            if telemetry is not None:
+                telemetry.emit(
+                    "chunk_abandoned", start=int(start), take=int(take),
+                    waited_s=float(waited), by=by,
+                    abandons=self._abandons, label=label,
+                )
+            logger.warning(
+                "abandoning hung %s dispatch at permutation %d after "
+                "%.2gs (%s); completed work is checkpointed and the "
+                "chunk will be re-dispatched", label, start, waited, by,
+            )
+            if by == "timeout" and rescue is not None:
+                # the watchdog path already checkpointed from its thread
+                try:
+                    rescue()
+                except Exception:
+                    logger.warning(
+                        "emergency checkpoint on abandon failed",
+                        exc_info=True,
+                    )
+            if self._abandons > self.policy.max_abandons:
+                # repeated hangs = the backend is gone, not slow: hand the
+                # run to the degradation ladder instead of spinning
+                msg = (
+                    f"{label} dispatch abandoned {self._abandons} times "
+                    f"(max_abandons={self.policy.max_abandons}); backend "
+                    "presumed dead"
+                )
+                if self.policy.degrade_to_cpu:
+                    raise DeviceLostError(msg, reason="abandons_exhausted")
+                raise RuntimeError(msg)
+            raise DispatchAbandonedError(
+                f"{label} dispatch at permutation {start} abandoned "
+                f"after {waited:.2g}s ({by})"
+            )
+        if "err" in box:
+            raise box["err"]
+        return box["out"]
+
+
+def resolve_runtime(arg) -> FaultRuntime | None:
+    """``fault_policy=`` argument → runtime: None/False = off (unless
+    ``NETREP_FAULT_PLAN`` is set, which activates a default policy so CI
+    and bench can inject faults into any run); True = default policy; a
+    :class:`FaultPolicy` builds a fresh runtime; an existing
+    :class:`FaultRuntime` passes through — how ``module_preservation``
+    shares one injector across a mid-run engine rebuild."""
+    if isinstance(arg, FaultRuntime):
+        return arg
+    if arg is None or arg is False:
+        if not os.environ.get(PLAN_ENV):
+            return None
+        return FaultRuntime(FaultPolicy())
+    if arg is True:
+        return FaultRuntime(FaultPolicy())
+    if isinstance(arg, FaultPolicy):
+        return FaultRuntime(arg)
+    raise TypeError(
+        "fault_policy must be None/False, True, a FaultPolicy, or a "
+        f"FaultRuntime; got {type(arg).__name__}"
+    )

@@ -69,6 +69,22 @@ _SUM_FIELDS = frozenset({
     "dispatches", "host_bytes", "perms", "take", "bytes", "n_retired",
 })
 
+#: recovery-path event names (ISSUE 4 fault tolerance + the backends'
+#: fallback/stall events) — the set the CLI report surfaces as a dedicated
+#: "recovery" section and ``--recovery`` renders as a timeline. Names are
+#: pinned by tests/test_telemetry.py: downstream dashboards key on them.
+RECOVERY_EVENTS = (
+    "fault_injected",
+    "retry_attempt",
+    "chunk_abandoned",
+    "stall_suspected",
+    "stall_recovered",
+    "device_lost",
+    "degraded_to_cpu",
+    "backend_fallback",
+    "distributed_autodetect_failed",
+)
+
 
 def _is_number(v) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool)
@@ -157,6 +173,18 @@ class MetricsRegistry:
         )
         runs = ", ".join(sorted(self.runs)) or "-"
         out.append(f"telemetry: {self.n_events} events, run(s) {runs}{span}")
+        rec = {
+            ev: self.counters[f"{ev}.count"]
+            for ev in RECOVERY_EVENTS if f"{ev}.count" in self.counters
+        }
+        if rec:
+            # surface the recovery story first: a run that retried/degraded
+            # its way to completion should say so before the raw counters
+            out.append("recovery:")
+            w = max(len(k) for k in rec)
+            for k in RECOVERY_EVENTS:
+                if k in rec:
+                    out.append(f"  {k:<{w}}  {rec[k]:g}")
         if self.counters:
             out.append("counters:")
             w = max(len(k) for k in self.counters)
@@ -398,6 +426,17 @@ class StallWatchdog:
     driven manually (fake-clock tests). Until ``min_intervals`` steady
     intervals are measured the watchdog stays silent — it never guesses a
     baseline.
+
+    A chunk landing after a fired stall emits ``stall_recovered`` (with
+    the stalled-for duration) and RE-ARMS the warning, so a second stall
+    in the same run warns again instead of staying silent after a
+    one-shot warning.
+
+    Warn → act escalation (ISSUE 4): with ``action`` set, a stall that
+    outlasts ``action_factor`` × the steady chunk time invokes
+    ``action()`` ONCE per stall episode from the watchdog thread — the
+    fault runtime uses this to checkpoint completed work and abandon the
+    hung dispatch (the loop thread is blocked inside it and cannot act).
     """
 
     def __init__(
@@ -407,18 +446,25 @@ class StallWatchdog:
         min_intervals: int = 2,
         poll_interval: float = 5.0,
         clock: Callable[[], float] | None = None,
+        action: Callable[[], None] | None = None,
+        action_factor: float | None = None,
     ):
         self.telemetry = telemetry
         self.factor = float(factor)
         self.min_intervals = int(min_intervals)
         self.poll_interval = float(poll_interval)
         self.clock = clock if clock is not None else telemetry.clock
+        self.action = action
+        self.action_factor = (
+            float(action_factor) if action_factor is not None else None
+        )
         self._lock = threading.Lock()
         self._last: float | None = None
         self._beats = 0
         self._intervals: list[float] = []
         self._fired = False
         self._warned = False
+        self._acted = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -429,16 +475,33 @@ class StallWatchdog:
             self._last = self.clock()
 
     def beat(self) -> None:
-        """One chunk landed: record the interval and reset the stall."""
+        """One chunk landed: record the interval and reset the stall. A
+        beat that ends a fired stall episode emits ``stall_recovered``
+        and re-arms the one-per-episode warning and action."""
         now = self.clock()
         with self._lock:
+            stalled_s = (
+                now - self._last
+                if self._fired and self._last is not None else None
+            )
             if self._last is not None and self._beats >= 1:
                 # the interval ending at beat 1 absorbed the first chunk's
                 # compile — steady state starts at beat 2
                 self._intervals.append(now - self._last)
             self._beats += 1
+            beats = self._beats
             self._last = now
             self._fired = False
+            self._warned = False
+            self._acted = False
+        if stalled_s is not None:
+            self.telemetry.emit(
+                "stall_recovered", stalled_s=stalled_s, chunks_done=beats,
+            )
+            logger.warning(
+                "backend recovered: a chunk landed after a %.1fs stall; "
+                "the run continues", stalled_s,
+            )
 
     def steady_s(self) -> float | None:
         """Median steady-state chunk time, or None before enough beats."""
@@ -449,23 +512,32 @@ class StallWatchdog:
         return sorted(iv)[len(iv) // 2]
 
     def poll(self) -> bool:
-        """Check the heartbeat; emit/warn when stalled. Returns whether a
-        stall was (newly) flagged."""
+        """Check the heartbeat; emit/warn when stalled, escalate to the
+        ``action`` when the stall outlasts ``action_factor`` × steady.
+        Returns whether a stall was (newly) flagged."""
         steady = self.steady_s()
+        act = None
         with self._lock:
-            if self._last is None or self._fired or steady is None:
+            if self._last is None or steady is None:
                 return False
             elapsed = self.clock() - self._last
             if elapsed <= self.factor * steady:
                 return False
+            newly = not self._fired
             self._fired = True
             warn = not self._warned
             self._warned = True
             beats = self._beats
-        self.telemetry.emit(
-            "stall_suspected", elapsed_s=elapsed, steady_chunk_s=steady,
-            factor=self.factor, chunks_done=beats,
-        )
+            if (self.action is not None and self.action_factor is not None
+                    and elapsed > self.action_factor * steady
+                    and not self._acted):
+                self._acted = True
+                act = self.action
+        if newly:
+            self.telemetry.emit(
+                "stall_suspected", elapsed_s=elapsed, steady_chunk_s=steady,
+                factor=self.factor, chunks_done=beats,
+            )
         if warn:
             logger.warning(
                 "no chunk completed in %.1fs (> %.0fx the %.2fs "
@@ -473,7 +545,17 @@ class StallWatchdog:
                 "(dead TPU tunnel?); the run will continue if it recovers",
                 elapsed, self.factor, steady,
             )
-        return True
+        if act is not None:
+            logger.warning(
+                "stall escalation: no chunk in %.1fs (> %.0fx steady) — "
+                "checkpointing completed work and abandoning the hung "
+                "dispatch", elapsed, self.action_factor,
+            )
+            try:
+                act()
+            except Exception:  # the action must never kill the watchdog
+                logger.warning("stall watchdog action raised", exc_info=True)
+        return newly
 
     # -- thread ------------------------------------------------------------
 
@@ -507,14 +589,21 @@ class StallWatchdog:
         self.stop()
 
 
-def arm_watchdog(telemetry: Telemetry | None) -> StallWatchdog | None:
+def arm_watchdog(
+    telemetry: Telemetry | None,
+    action: Callable[[], None] | None = None,
+    action_factor: float | None = None,
+) -> StallWatchdog | None:
     """Per-null-run watchdog construction shared by the loops: None when
-    telemetry is off (the disabled hot path stays a ``None`` check)."""
+    telemetry is off (the disabled hot path stays a ``None`` check).
+    ``action``/``action_factor`` wire the fault runtime's warn→act
+    escalation (ISSUE 4) when a fault policy is active."""
     if telemetry is None:
         return None
     wd = StallWatchdog(
         telemetry, factor=telemetry.stall_factor,
         poll_interval=telemetry.watchdog_poll_s,
+        action=action, action_factor=action_factor,
     )
     wd.arm()
     wd.start()
@@ -566,3 +655,22 @@ def aggregate_events(events: Iterable[dict]) -> MetricsRegistry:
 def aggregate_file(path: str) -> MetricsRegistry:
     """Aggregate a telemetry JSONL into a registry (offline CLI report)."""
     return aggregate_events(read_events(path))
+
+
+def render_recovery(path: str) -> str:
+    """Chronological timeline of a run's recovery decisions (the
+    ``python -m netrep_tpu telemetry --recovery`` view): every
+    :data:`RECOVERY_EVENTS` line with its offset from the first event in
+    the file, so "what did the run survive, and in what order" reads
+    straight off one screen. Empty string when the run never recovered
+    from anything."""
+    lines = []
+    t0 = None
+    for e in read_events(path):
+        if t0 is None:
+            t0 = e["t"]
+        if e["ev"] not in RECOVERY_EVENTS:
+            continue
+        data = " ".join(f"{k}={v}" for k, v in e["data"].items())
+        lines.append(f"+{e['t'] - t0:9.2f}s  {e['ev']:<24} {data}")
+    return "\n".join(lines)

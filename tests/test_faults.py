@@ -1,0 +1,583 @@
+"""Fault-tolerant null execution (ISSUE 4): error taxonomy, deterministic
+fault-injection plans, retry/backoff, hung-dispatch abandonment, watchdog
+warn→act escalation, mid-run CPU degradation, interrupt-resume via the
+fault harness, and the bit-identical-when-disabled guarantee.
+
+Everything runs on CPU with injected faults — fast, deterministic, tier-1.
+The acceptance contract: for each of the four null-loop modes, a run with
+injected transient failures (and a device-loss → CPU degradation run)
+completes with results bit-identical to an unfaulted run at the same
+seed, zero permutations lost, and the recovery sequence visible in the
+telemetry JSONL.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from netrep_tpu.data import make_mixed_pair
+from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+from netrep_tpu.utils import checkpoint as ckpt
+from netrep_tpu.utils.config import EngineConfig, FaultPolicy
+from netrep_tpu.utils.faults import (
+    DeviceLostError, DispatchAbandonedError, FaultRuntime, FaultSpec,
+    InjectedDeviceLost, InjectedFatalError, InjectedTransientError,
+    backoff_delay, classify_error, parse_plan, resolve_runtime,
+)
+from netrep_tpu.utils.telemetry import StallWatchdog, Telemetry, aggregate_file
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = EngineConfig(chunk_size=16, summary_method="eigh", superchunk=2,
+                   autotune=False)
+N_PERM = 64
+
+MODES = ("fixed", "adaptive", "stream", "adaptive_stream")
+
+
+@pytest.fixture(scope="module")
+def mixed():
+    return make_mixed_pair(120, 3, n_samples=16, seed=7)
+
+
+@pytest.fixture(scope="module")
+def eng(mixed):
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    return PermutationEngine(
+        dc, dn, dd, tc, tn, td, specs, mixed["pool"], config=CFG
+    )
+
+
+@pytest.fixture(scope="module")
+def observed(eng):
+    return np.asarray(eng.observed())
+
+
+def _run(eng, mode, observed, **kw):
+    """One null run in the given loop mode; returns (kind, result,
+    completed, finished) with kind 'mat' (null array) or 'sc'
+    (StreamCounts)."""
+    if mode == "fixed":
+        nulls, done = eng.run_null(N_PERM, key=0, **kw)
+        return "mat", nulls, done, done == N_PERM
+    if mode == "adaptive":
+        nulls, done, fin = eng.run_null_adaptive(
+            N_PERM, observed, key=0, **kw
+        )
+        return "mat", nulls, done, fin
+    if mode == "stream":
+        sc = eng.run_null_streaming(N_PERM, observed, key=0, **kw)
+        return "sc", sc, sc.completed, sc.completed == N_PERM
+    sc = eng.run_null_adaptive_streaming(N_PERM, observed, key=0, **kw)
+    return "sc", sc, sc.completed, sc.finished
+
+
+def _assert_same(kind, a, b):
+    if kind == "mat":
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    else:
+        assert (a.hi == b.hi).all() and (a.lo == b.lo).all()
+        assert (a.eff == b.eff).all()
+        if a.n_perm_used is not None:
+            np.testing.assert_array_equal(a.n_perm_used, b.n_perm_used)
+
+
+@pytest.fixture(scope="module")
+def baselines(eng, observed):
+    """Unfaulted reference result per loop mode (the parity oracle)."""
+    return {m: _run(eng, m, observed) for m in MODES}
+
+
+# ---------------------------------------------------------------------------
+# taxonomy / plans / backoff (pure units)
+# ---------------------------------------------------------------------------
+
+def test_classify_error():
+    assert classify_error(InjectedTransientError("x")) == "transient"
+    assert classify_error(DispatchAbandonedError("x")) == "transient"
+    assert classify_error(InjectedDeviceLost("x")) == "device_lost"
+    assert classify_error(InjectedFatalError("x")) == "fatal"
+    assert classify_error(ConnectionResetError("peer")) == "transient"
+    assert classify_error(TimeoutError("t")) == "transient"
+    # message-based classification of generic backend errors
+    assert classify_error(RuntimeError("DEADLINE_EXCEEDED: rpc")) == "transient"
+    assert classify_error(RuntimeError("UNAVAILABLE: socket closed")) == "transient"
+    assert classify_error(RuntimeError("device lost: chip 3")) == "device_lost"
+    assert classify_error(RuntimeError("TPU worker preempted")) == "device_lost"
+    # genuine bugs are never retried
+    assert classify_error(ValueError("shapes differ")) == "fatal"
+    assert classify_error(ZeroDivisionError()) == "fatal"
+
+
+def test_parse_plan():
+    plan = parse_plan("transient@8; device_lost@32x2,hang@64")
+    assert plan == (
+        FaultSpec("transient", 8), FaultSpec("device_lost", 32, 2),
+        FaultSpec("hang", 64),
+    )
+    assert parse_plan(None) == () and parse_plan("") == ()
+    assert parse_plan(plan) == plan  # FaultSpec tuples pass through
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        parse_plan("flaky@3")
+    with pytest.raises(ValueError, match="malformed"):
+        parse_plan("transient")
+
+
+def test_injector_consumes_times():
+    from netrep_tpu.utils.faults import FaultInjector
+
+    inj = FaultInjector(parse_plan("transient@8x2"))
+    assert inj.poll(0, 16).kind == "transient"
+    assert inj.poll(0, 16).kind == "transient"
+    assert inj.poll(0, 16) is None          # consumed
+    assert inj.poll(16, 16) is None         # out of range
+    assert inj.pending == 0
+
+
+def test_backoff_deterministic_and_bounded():
+    pol = FaultPolicy(backoff_base_s=0.5, backoff_factor=2.0,
+                      backoff_max_s=3.0, backoff_jitter=0.25)
+    d1 = backoff_delay(pol, 128, 1)
+    assert d1 == backoff_delay(pol, 128, 1)       # deterministic
+    assert d1 != backoff_delay(pol, 128, 2)       # varies by attempt
+    assert d1 != backoff_delay(pol, 256, 1)       # varies by chunk
+    for attempt in range(1, 8):
+        d = backoff_delay(pol, 0, attempt)
+        assert 0.0 <= d <= 3.0 * 1.25             # capped (+jitter)
+    # no jitter: the pure exponential schedule
+    flat = FaultPolicy(backoff_base_s=1.0, backoff_jitter=0.0,
+                       backoff_max_s=8.0)
+    assert [backoff_delay(flat, 0, a) for a in (1, 2, 3, 4, 5)] == \
+        [1.0, 2.0, 4.0, 8.0, 8.0]
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="max_retries"):
+        FaultPolicy(max_retries=-1)
+    with pytest.raises(ValueError, match="backoff_factor"):
+        FaultPolicy(backoff_factor=0.5)
+    with pytest.raises(ValueError, match="hang_timeout_s"):
+        FaultPolicy(hang_timeout_s=0.0)
+    with pytest.raises(ValueError, match="'hang' fault plan"):
+        FaultRuntime(FaultPolicy(plan="hang@0"))  # needs hang_timeout_s
+    with pytest.raises(TypeError, match="fault_policy"):
+        resolve_runtime(object())
+
+
+# ---------------------------------------------------------------------------
+# dispatch wrapper (no engine: plain callables)
+# ---------------------------------------------------------------------------
+
+def _runtime(**kw):
+    kw.setdefault("backoff_base_s", 0.0)
+    kw.setdefault("backoff_jitter", 0.0)
+    return FaultRuntime(FaultPolicy(**kw))
+
+
+def test_run_dispatch_retries_then_succeeds():
+    ft = _runtime(max_retries=3)
+    tel = Telemetry(run_id="rt")
+    calls = []
+
+    def call():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionResetError("flaky")
+        return "ok"
+
+    assert ft.run_dispatch(call, start=0, take=16, telemetry=tel) == "ok"
+    assert len(calls) == 3
+    assert tel.metrics.counters["retry_attempt.count"] == 2
+
+
+def test_run_dispatch_exhausted_retries_escalate_to_degradation():
+    """A backend that fails every re-dispatch is as dead as a lost
+    device: exhausted transient retries hand the run to the degradation
+    ladder (reason='retries_exhausted') instead of crashing with the
+    last transient error — unless degradation is disabled."""
+    ft = _runtime(max_retries=2)
+    calls = []
+
+    def call():
+        calls.append(1)
+        raise ConnectionResetError("always")
+
+    with pytest.raises(DeviceLostError) as ei:
+        ft.run_dispatch(call, start=0, take=16)
+    assert ei.value.reason == "retries_exhausted"
+    assert len(calls) == 3  # initial + 2 retries
+    ft2 = _runtime(max_retries=2, degrade_to_cpu=False)
+    with pytest.raises(ConnectionResetError):
+        ft2.run_dispatch(call, start=0, take=16)
+
+
+def test_run_dispatch_fatal_not_retried():
+    ft = _runtime(max_retries=5)
+    calls = []
+
+    def call():
+        calls.append(1)
+        raise ValueError("bug")
+
+    with pytest.raises(ValueError):
+        ft.run_dispatch(call, start=0, take=16)
+    assert len(calls) == 1
+
+
+def test_run_dispatch_device_lost_wraps_or_propagates():
+    ft = _runtime()
+    with pytest.raises(DeviceLostError):
+        ft.run_dispatch(lambda: (_ for _ in ()).throw(
+            InjectedDeviceLost("gone")), start=0, take=16)
+    # degradation disabled: the original error surfaces
+    ft2 = _runtime(degrade_to_cpu=False)
+    with pytest.raises(InjectedDeviceLost):
+        ft2.run_dispatch(lambda: (_ for _ in ()).throw(
+            InjectedDeviceLost("gone")), start=0, take=16)
+
+
+def test_run_dispatch_hang_abandons_and_redispatches():
+    ft = _runtime(plan="hang@0", hang_timeout_s=0.05)
+    tel = Telemetry(run_id="hang")
+    rescued = []
+    out = ft.run_dispatch(lambda: "real", start=0, take=16, telemetry=tel,
+                          rescue=lambda: rescued.append(1))
+    assert out == "real"
+    assert rescued == [1]  # completed work checkpointed before re-dispatch
+    assert tel.metrics.counters["chunk_abandoned.count"] == 1
+    assert tel.metrics.counters["fault_injected.count"] == 1
+
+
+def test_repeated_abandons_escalate_to_device_loss():
+    ft = _runtime(plan="hang@0x5", hang_timeout_s=0.05, max_abandons=1)
+    with pytest.raises(DeviceLostError, match="presumed dead") as ei:
+        ft.run_dispatch(lambda: "x", start=0, take=16)
+    assert ei.value.reason == "abandons_exhausted"
+
+
+def test_watchdog_escalation_fires_action_once_per_episode():
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = Clock()
+    tel = Telemetry(clock=clock)
+    acted = []
+    wd = StallWatchdog(tel, factor=5.0, poll_interval=0, clock=clock,
+                       action=lambda: acted.append(1), action_factor=20.0)
+    wd.arm()
+    wd.beat()
+    for _ in range(3):
+        clock.t += 1.0
+        wd.beat()                   # steady state: 1 s / chunk
+    clock.t += 10.0                 # > 5x steady: warn, < 20x: no action
+    assert wd.poll() and acted == []
+    clock.t += 15.0                 # now > 20x steady: act
+    assert not wd.poll()            # same episode: no new stall event
+    assert acted == [1]
+    assert wd.poll() is False and acted == [1]  # once per episode
+    clock.t += 1.0
+    wd.beat()                       # recovery re-arms the action
+    assert tel.metrics.counters["stall_recovered.count"] == 1
+    clock.t += 50.0
+    assert wd.poll() and acted == [1, 1]
+
+
+# ---------------------------------------------------------------------------
+# acceptance: four loop modes × injected transient faults → bit-identical,
+# zero permutations lost, recovery sequence in the JSONL
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", MODES)
+def test_transient_faults_bit_identical(eng, observed, baselines, mode,
+                                        tmp_path):
+    kind, base, base_done, _ = baselines[mode]
+    pol = FaultPolicy(plan="transient@8;transient@40x2",
+                      backoff_base_s=0.0, backoff_jitter=0.0)
+    path = tmp_path / f"{mode}.jsonl"
+    tel = Telemetry(path, run_id=mode)
+    kind_f, res, done, finished = _run(
+        eng, mode, observed, telemetry=tel, fault_policy=pol
+    )
+    tel.close()
+    assert finished and done == base_done  # zero permutations lost
+    _assert_same(kind, base, res)
+    reg = aggregate_file(str(path))
+    assert reg.counters["fault_injected.count"] == 3
+    assert reg.counters["retry_attempt.count"] == 3
+    # the recovery sequence is readable off the JSONL in order
+    evs = [e["ev"] for e in map(json.loads, open(path))]
+    assert evs.index("fault_injected") < evs.index("retry_attempt")
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_policy_on_unfaulted_bit_identical(eng, observed, baselines, mode):
+    """The disabled⇒bit-identical guarantee, both ways: fault_policy=None
+    IS the baseline path, and an armed-but-unfaulted policy must not
+    perturb results either (same guarantee style as adaptive=False)."""
+    kind, base, base_done, _ = baselines[mode]
+    kind_f, res, done, _ = _run(
+        eng, mode, observed,
+        fault_policy=FaultPolicy(backoff_base_s=0.0),
+    )
+    assert done == base_done
+    _assert_same(kind, base, res)
+
+
+def test_hang_abandon_in_real_null_loop(eng, observed, baselines, tmp_path):
+    """A hung chunk dispatch mid-run is abandoned and re-dispatched; the
+    completed null is bit-identical and the emergency checkpoint fired."""
+    kind, base, base_done, _ = baselines["fixed"]
+    # the budget must exceed a real dispatch's wall time (compute included)
+    # or healthy chunks get "abandoned" too; only the injected hang waits
+    # the full budget out
+    pol = FaultPolicy(plan="hang@32", hang_timeout_s=3.0,
+                      backoff_base_s=0.0, backoff_jitter=0.0)
+    path = tmp_path / "hang.jsonl"
+    tel = Telemetry(path, run_id="hang")
+    ck = str(tmp_path / "hang_ck.npz")
+    nulls, done = eng.run_null(
+        N_PERM, key=0, telemetry=tel, fault_policy=pol,
+        checkpoint_path=ck, checkpoint_every=16,
+    )
+    tel.close()
+    assert done == N_PERM
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(nulls))
+    reg = aggregate_file(str(path))
+    assert reg.counters["chunk_abandoned.count"] == 1
+    # pinned event keys (golden shapes of the new recovery events)
+    by_ev = {}
+    for e in map(json.loads, open(path)):
+        by_ev.setdefault(e["ev"], e["data"])
+    assert set(by_ev["fault_injected"]) == {
+        "kind", "at_perm", "start", "take", "label"}
+    assert set(by_ev["chunk_abandoned"]) == {
+        "start", "take", "waited_s", "by", "abandons", "label"}
+    assert set(by_ev["retry_attempt"]) == {
+        "start", "take", "attempt", "max_retries", "delay_s", "error",
+        "label"}
+
+
+# ---------------------------------------------------------------------------
+# interrupt mid-chunk via the harness: valid resumable checkpoint in all
+# four modes, resumed run bit-identical to uninterrupted
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", MODES)
+def test_interrupt_leaves_resumable_checkpoint(eng, observed, baselines,
+                                               mode, tmp_path):
+    kind, base, base_done, _ = baselines[mode]
+    ck = str(tmp_path / f"int_{mode}.npz")
+    pol = FaultPolicy(plan="interrupt@32", backoff_base_s=0.0)
+    kind_p, part, done, finished = _run(
+        eng, mode, observed, fault_policy=pol,
+        checkpoint_path=ck, checkpoint_every=16,
+    )
+    assert not finished and 0 < done < base_done
+    saved = ckpt.load_null_checkpoint(ck)
+    assert saved is not None and 0 < saved["completed"] <= done
+    # resume (no plan) must equal the uninterrupted run exactly
+    kind_r, res, done_r, finished_r = _run(
+        eng, mode, observed, fault_policy=FaultPolicy(backoff_base_s=0.0),
+        checkpoint_path=ck, checkpoint_every=16,
+    )
+    assert finished_r and done_r == base_done
+    _assert_same(kind, base, res)
+
+
+# ---------------------------------------------------------------------------
+# device loss → emergency checkpoint → CPU degradation → exact resume
+# ---------------------------------------------------------------------------
+
+def test_device_loss_checkpoints_pending_work(eng, tmp_path):
+    """Engine level: the failure-save hook flushes the pending chunk and
+    the committed prefix before DeviceLostError propagates — no computed
+    permutation is lost."""
+    ck = str(tmp_path / "loss.npz")
+    with pytest.raises(DeviceLostError):
+        eng.run_null(
+            N_PERM, key=0, checkpoint_path=ck, checkpoint_every=N_PERM,
+            fault_policy=FaultPolicy(plan="device_lost@32",
+                                     backoff_base_s=0.0),
+        )
+    saved = ckpt.load_null_checkpoint(ck)
+    # chunks [0,16) and [16,32) committed (the pending chunk was flushed);
+    # the failing dispatch started at 32
+    assert saved["completed"] == 32
+
+
+def test_device_loss_stream_resume_bit_identical(eng, observed, baselines,
+                                                 tmp_path):
+    kind, base, *_ = baselines["stream"]
+    ck = str(tmp_path / "loss_stream.npz")
+    with pytest.raises(DeviceLostError):
+        eng.run_null_streaming(
+            N_PERM, observed, key=0, checkpoint_path=ck,
+            checkpoint_every=16,
+            fault_policy=FaultPolicy(plan="device_lost@48",
+                                     backoff_base_s=0.0),
+        )
+    saved = ckpt.load_null_checkpoint(ck)
+    assert 0 < saved["completed"] < N_PERM
+    sc = eng.run_null_streaming(N_PERM, observed, key=0, checkpoint_path=ck)
+    _assert_same("sc", base, sc)
+
+
+def test_device_loss_degrades_to_cpu_via_module_preservation(
+        toy_pair_module, tmp_path):
+    """The full degradation ladder through the public API: injected device
+    loss → failure-save → degraded_to_cpu → engine rebuild → resume →
+    bit-identical result, recovery sequence in the JSONL, emergency
+    checkpoint dir cleaned up."""
+    pytest.importorskip("pandas")
+    from netrep_tpu import module_preservation
+    from netrep_tpu.data import pair_frames
+
+    d, t = pair_frames(toy_pair_module)
+    kw = dict(
+        network={"d": d["network"], "t": t["network"]},
+        correlation={"d": d["correlation"], "t": t["correlation"]},
+        data={"d": d["data"], "t": t["data"]},
+        module_assignments=dict(toy_pair_module["labels"]),
+        discovery="d", test="t", n_perm=64, seed=0,
+        config=EngineConfig(chunk_size=16),
+    )
+    base = module_preservation(**kw)
+    path = str(tmp_path / "degrade.jsonl")
+    res = module_preservation(
+        **kw, telemetry=path,
+        fault_policy=FaultPolicy(plan="transient@8;device_lost@32",
+                                 backoff_base_s=0.0, backoff_jitter=0.0),
+    )
+    assert res.completed == 64
+    np.testing.assert_array_equal(base.nulls, res.nulls)
+    np.testing.assert_array_equal(base.p_values, res.p_values)
+    reg = aggregate_file(path)
+    for ev, n in (("fault_injected", 2), ("retry_attempt", 1),
+                  ("device_lost", 1), ("degraded_to_cpu", 1),
+                  ("checkpoint_resumed", 1)):
+        assert reg.counters.get(f"{ev}.count", 0) == n, ev
+    assert reg.counters["checkpoint_saved.count"] >= 1
+    # recovery order is readable off the JSONL
+    evs = [e["ev"] for e in map(json.loads, open(path))]
+    assert evs.index("device_lost") < evs.index("degraded_to_cpu")
+    assert evs.index("degraded_to_cpu") < evs.index("checkpoint_resumed")
+    # the emergency checkpoint dir (no checkpoint_dir was passed) is gone
+    ck_paths = [
+        e["data"]["path"] for e in map(json.loads, open(path))
+        if e["ev"] == "checkpoint_saved"
+    ]
+    assert ck_paths and not any(os.path.exists(p) for p in ck_paths)
+
+
+# ---------------------------------------------------------------------------
+# env toggle + satellites
+# ---------------------------------------------------------------------------
+
+def test_env_plan_activates_injection(eng, baselines, monkeypatch, tmp_path):
+    """NETREP_FAULT_PLAN alone (no fault_policy argument) injects and
+    recovers — the bench/CI drill switch."""
+    kind, base, *_ = baselines["fixed"]
+    monkeypatch.setenv("NETREP_FAULT_PLAN", "transient@8")
+    path = tmp_path / "env.jsonl"
+    tel = Telemetry(path, run_id="env")
+    nulls, done = eng.run_null(N_PERM, key=0, telemetry=tel)
+    tel.close()
+    assert done == N_PERM
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(nulls))
+    reg = aggregate_file(str(path))
+    assert reg.counters["fault_injected.count"] == 1
+    assert reg.counters["retry_attempt.count"] == 1
+
+
+def test_trim_tail_shards_narrowed_except(monkeypatch, caplog):
+    """Satellite: unknown-sharding objects downgrade with ONE warning;
+    genuine backend failures inside shard_shape now propagate."""
+    import logging
+
+    from netrep_tpu.parallel import engine as eng_mod
+
+    class NoShardShape:
+        pass
+
+    class FakeOut:
+        shape = (8, 3)
+        ndim = 2
+        is_fully_addressable = False
+        sharding = NoShardShape()
+
+    monkeypatch.setattr(eng_mod, "_UNKNOWN_SHARDING_SEEN", False)
+    out = FakeOut()
+    with caplog.at_level(logging.WARNING, logger="netrep_tpu"):
+        assert eng_mod._trim_tail_shards(out, 4) is out
+        assert eng_mod._trim_tail_shards(out, 4) is out
+    warns = [r for r in caplog.records if "trim skipped" in r.getMessage()]
+    assert len(warns) == 1  # once per process, not per chunk
+
+    class DeadSharding:
+        def shard_shape(self, shape):
+            raise RuntimeError("backend connection dropped")
+
+    class DeadOut(FakeOut):
+        sharding = DeadSharding()
+
+    with pytest.raises(RuntimeError, match="connection dropped"):
+        eng_mod._trim_tail_shards(DeadOut(), 4)
+
+
+def test_distributed_autodetect_failure_emits_event(monkeypatch):
+    """Satellite: the auto-detect join failure leaves a machine-readable
+    event (the "other hosts will hang" precondition) beside the warning."""
+    import jax
+
+    from netrep_tpu.parallel import distributed
+
+    monkeypatch.setattr(distributed, "is_initialized", lambda: False)
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: (_ for _ in ()).throw(RuntimeError("no coordinator")),
+    )
+    tel = Telemetry(run_id="dist")
+    with tel.activate():
+        out = distributed.initialize()
+    assert out["process_count"] >= 1
+    assert tel.metrics.counters["distributed_autodetect_failed.count"] == 1
+
+
+def test_cli_recovery_timeline(tmp_path):
+    path = tmp_path / "rec.jsonl"
+    tel = Telemetry(path, run_id="cli")
+    tel.emit("chunk", done=16, total=64, take=16, s=0.1)
+    tel.emit("fault_injected", kind="transient", at_perm=8, start=0,
+             take=16, label="chunk")
+    tel.emit("retry_attempt", start=0, take=16, attempt=1, max_retries=3,
+             delay_s=0.0, error="InjectedTransientError", label="chunk")
+    tel.emit("degraded_to_cpu", reason="device_lost")
+    tel.close()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "netrep_tpu", "telemetry", str(path),
+         "--recovery"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    lines = proc.stdout.strip().splitlines()
+    assert len(lines) == 3  # the chunk event is not a recovery event
+    assert "fault_injected" in lines[0]
+    assert "retry_attempt" in lines[1]
+    assert "degraded_to_cpu" in lines[2]
+    # summary table leads with the recovery section
+    table = subprocess.run(
+        [sys.executable, "-m", "netrep_tpu", "telemetry", str(path)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert table.returncode == 0
+    assert "recovery:" in table.stdout
+    assert table.stdout.index("recovery:") < table.stdout.index("counters:")

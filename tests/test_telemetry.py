@@ -207,12 +207,22 @@ def test_watchdog_fires_on_stall_and_stays_silent_otherwise(caplog):
     assert tel.metrics.counters["stall_suspected.count"] == 1
     assert tel.metrics.gauges["stall_suspected.chunks_done"] == 5
     warns = [r for r in caplog.records if "stalled" in r.getMessage()]
-    assert len(warns) == 1          # warns ONCE via the netrep_tpu logger
+    assert len(warns) == 1          # warns ONCE per stall episode
+    events = []
+    tel.subscribe(events.append)
     clock.t += 1.0
-    wd.beat()                       # recovery re-arms the watchdog
-    clock.t += 50.0
-    assert wd.poll()                # a second stall fires again
+    wd.beat()                       # recovery: emits stall_recovered + re-arms
+    assert tel.metrics.counters["stall_recovered.count"] == 1
+    # the event carries how long the run was stalled (keys pinned)
+    rec = [e for e in events if e["ev"] == "stall_recovered"]
+    assert set(rec[0]["data"]) == {"stalled_s", "chunks_done"}
+    assert rec[0]["data"]["stalled_s"] > 10.0
+    with caplog.at_level(logging.WARNING, logger="netrep_tpu"):
+        clock.t += 50.0
+        assert wd.poll()            # a second stall fires again
     assert tel.metrics.counters["stall_suspected.count"] == 2
+    warns = [r for r in caplog.records if "stalled" in r.getMessage()]
+    assert len(warns) == 2          # re-armed: the second stall warns too
 
 
 def test_watchdog_silent_before_steady_state_measured():
@@ -228,6 +238,25 @@ def test_watchdog_silent_before_steady_state_measured():
     wd.beat()                       # only ONE steady interval so far
     clock.t += 1000.0
     assert not wd.poll()            # still below min_intervals
+
+
+def test_recovery_event_names_pinned():
+    """ISSUE 4 hygiene: the recovery-path event names are schema surface —
+    the CLI recovery section/timeline and downstream dashboards key on
+    them, so a rename must fail CI here, deliberately."""
+    from netrep_tpu.utils.telemetry import RECOVERY_EVENTS
+
+    assert RECOVERY_EVENTS == (
+        "fault_injected",
+        "retry_attempt",
+        "chunk_abandoned",
+        "stall_suspected",
+        "stall_recovered",
+        "device_lost",
+        "degraded_to_cpu",
+        "backend_fallback",
+        "distributed_autodetect_failed",
+    )
 
 
 # ---------------------------------------------------------------------------
