@@ -67,6 +67,13 @@ TELEMETRY_PATH = None
 #: the live ambient-activation context manager (held for the process
 #: lifetime; see the --telemetry block in main())
 _TEL_CM = None
+#: structured backend-probe record (ISSUE 5 satellite): duration + outcome
+#: of ensure_backend's tunnel probe, attached to EVERY metric row — the
+#: round-5 120 s silent probe hang was visible only in a prose warning
+PROBE_INFO = {}
+#: why this process is not on the TPU ("probe_timeout" / "forced_env"),
+#: attached to every metric row beside the existing tpu_fallback marker
+FALLBACK_REASON = None
 
 
 def ensure_backend(probe_timeout: float | None = None):
@@ -94,14 +101,33 @@ def ensure_backend(probe_timeout: float | None = None):
 
     enable_persistent_cache()
 
+    global TPU_FALLBACK, FALLBACK_REASON
+    t_probe0 = time.perf_counter()
+
+    def _record_probe(outcome: str):
+        """Structured probe record (ISSUE 5 satellite): duration + outcome
+        land on every metric row via emit(), and the bench path emits its
+        own ``backend_probe`` event so a telemetry log shows the probe
+        cost even when the resolution path skipped the subprocess dial."""
+        PROBE_INFO.clear()
+        PROBE_INFO["probe_outcome"] = outcome
+        PROBE_INFO["probe_s"] = round(time.perf_counter() - t_probe0, 3)
+        from netrep_tpu.utils.telemetry import current as _tel_current
+
+        tel = _tel_current()
+        if tel is not None:
+            tel.emit("backend_probe", outcome=outcome,
+                     s=time.perf_counter() - t_probe0, source="bench")
+
     if os.environ.get("NETREP_FORCE_TPU_FALLBACK"):
         # set by run_shielded's second attempt after the TPU child hung:
         # behave exactly like a probe-detected dead tunnel (reduced-count
         # projected rows / explicit skip rows, tpu_fallback markers)
         jax.config.update("jax_platforms", "cpu")
         os.environ["JAX_PLATFORMS"] = "cpu"
-        global TPU_FALLBACK
         TPU_FALLBACK = True
+        FALLBACK_REASON = "forced_env"
+        _record_probe("forced_fallback")
         return jax.devices()
 
     if probe_timeout is None:
@@ -116,12 +142,14 @@ def ensure_backend(probe_timeout: float | None = None):
     # get_backend hook from dialing the tunnel.
     devs = honor_explicit_platform()
     if devs is not None:
+        _record_probe("explicit_platform")
         return devs
     if tunnel_expected():
         # only a TIMEOUT means the tunnel is hung-dead; a fast "error" probe
         # (e.g. plugin registration RuntimeError) falls through to the
         # auto-backend fallback below, as before
-        if probe_default_backend(probe_timeout) == "timeout":
+        outcome = probe_default_backend(probe_timeout)
+        if outcome == "timeout":
             # Round-2 aborted here (rc=1) and the round's driver-visible
             # perf record was an error line. Fall back to CPU instead: the
             # caller reduces the permutation count and the emitted row
@@ -138,11 +166,17 @@ def ensure_backend(probe_timeout: float | None = None):
             jax.config.update("jax_platforms", "cpu")
             os.environ["JAX_PLATFORMS"] = "cpu"
             TPU_FALLBACK = True
+            FALLBACK_REASON = "probe_timeout"
+            _record_probe("timeout")
             return jax.devices()
+        _record_probe(outcome)
+    else:
+        _record_probe("no_tunnel")
     try:
         return jax.devices()
     except RuntimeError:
         jax.config.update("jax_platforms", "")
+        FALLBACK_REASON = FALLBACK_REASON or "registration_error"
         return jax.devices()
 
 
@@ -243,8 +277,28 @@ def timed_null(engine, n_perm, chunk, **kw):
 
 
 def emit(payload):
-    if TELEMETRY_PATH and isinstance(payload, dict):
-        payload.setdefault("telemetry", TELEMETRY_PATH)
+    import os
+
+    if isinstance(payload, dict):
+        if TELEMETRY_PATH:
+            payload.setdefault("telemetry", TELEMETRY_PATH)
+        # structured probe/fallback provenance on EVERY metric row
+        # (ISSUE 5 satellite): the round-5 120 s silent probe hang and
+        # the unexplained CPU rows become machine-readable fields
+        for k, v in PROBE_INFO.items():
+            payload.setdefault(k, v)
+        if FALLBACK_REASON is not None:
+            payload.setdefault("fallback_reason", FALLBACK_REASON)
+        if os.environ.get("NETREP_PERF_LEDGER"):
+            # feed the perf-regression ledger (best-effort, never fails
+            # the bench): one throughput fingerprint per measured row
+            from netrep_tpu.utils import perfledger
+
+            entry = perfledger.entry_from_bench_row(payload)
+            if entry is not None:
+                perfledger.append_entry(
+                    entry, os.environ["NETREP_PERF_LEDGER"]
+                )
     print(json.dumps(payload))
     return 0
 

@@ -31,6 +31,12 @@ import sys
 #: stays standalone-runnable without the package on sys.path)
 TELEMETRY_SCHEMA = 1
 
+#: perf-ledger entry version this summarizer understands (mirrors
+#: netrep_tpu.utils.perfledger.ENTRY_VERSION, literal for the same
+#: standalone reason) — ledger entries drive the "perf trend" section,
+#: replacing the old habit of re-parsing raw bench tails by hand
+PERF_LEDGER_SCHEMA = 1
+
 
 def rows_from(path: str) -> list[dict]:
     rows = []
@@ -58,6 +64,12 @@ def classify(row: dict) -> str:
         # structured telemetry event (netrep_tpu.utils.telemetry): not a
         # measurement row — aggregated into the per-phase split instead
         return "telemetry"
+    if (row.get("perf_v") == PERF_LEDGER_SCHEMA
+            and isinstance(row.get("fingerprint"), str)
+            and isinstance(row.get("perms_per_sec"), (int, float))):
+        # perf-ledger entry (netrep_tpu.utils.perfledger): feeds the
+        # "perf trend" section, never the BASELINE result table
+        return "ledger"
     if row.get("tpu_fallback") or "error" in row or "warning" in row:
         return "dropped"
     if row.get("cached"):
@@ -102,8 +114,39 @@ def telemetry_split(rows: list[dict]) -> dict:
     return per
 
 
+def _median(vals: list[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def perf_trend(entries: list[dict]) -> list[str]:
+    """Per-fingerprint throughput trend lines from perf-ledger entries
+    (ISSUE 5): entry count, median, newest, newest/median ratio — the
+    cross-round perf story in four numbers per config, sourced from the
+    ledger instead of re-parsing raw bench tails."""
+    groups: dict[str, list[float]] = {}
+    order: list[str] = []
+    for e in entries:
+        fp = e["fingerprint"]
+        if fp not in groups:
+            groups[fp] = []
+            order.append(fp)
+        groups[fp].append(float(e["perms_per_sec"]))
+    lines = []
+    for fp in order:
+        vals = groups[fp]
+        med = _median(vals)
+        ratio = vals[-1] / med if med > 0 else float("nan")
+        flag = "  <-- REGRESSED" if ratio < 0.6 else ""
+        lines.append(f"{fp}: n={len(vals)} median={med:g} "
+                     f"newest={vals[-1]:g} newest/median={ratio:.3f}{flag}")
+    return lines
+
+
 def main(paths: list[str]) -> int:
     results, unknown, other, dropped, telemetry = [], [], [], 0, []
+    ledger = []
     for p in paths:
         for r in rows_from(p):
             kind = classify(r)
@@ -117,6 +160,13 @@ def main(paths: list[str]) -> int:
                 results.append((p, r))
             elif kind == "telemetry":
                 telemetry.append(r)
+            elif kind == "ledger":
+                ledger.append(r)
+    if ledger:
+        print(f"## perf trend ({len(ledger)} ledger entries)")
+        for line in perf_trend(ledger):
+            print(line)
+        print()
     if telemetry:
         split = telemetry_split(telemetry)
         print(f"## telemetry per-phase time split ({len(telemetry)} events)")

@@ -22,6 +22,15 @@ STATE=${2:-/tmp/tpu_watch_state}
 TELEMETRY=${TELEMETRY:-${LOG%.jsonl}_telemetry.jsonl}
 PROM=${PROM:-${TELEMETRY%.jsonl}.prom}
 export NETREP_TELEMETRY="$TELEMETRY"
+# Perf-regression ledger (ISSUE 5): every bench step and telemetry-enabled
+# engine run appends a throughput fingerprint to $PERF_LEDGER, and after
+# each step `perf --check` compares the newest entry against the robust
+# median of its matching history — a regressed step is flagged in the log
+# the moment it lands, not five rounds later. Best-effort like $PROM: a
+# check failure warns, it never marks a step failed (the measurement is
+# real; the regression is for a human or CI to act on).
+PERF_LEDGER=${PERF_LEDGER:-${LOG%.jsonl}_perf_ledger.jsonl}
+export NETREP_PERF_LEDGER="$PERF_LEDGER"
 # 45/45 defaults (was 60/150): windows run ~5-7 min, so a dead-tunnel
 # probe cycle must stay well under a window or most of it is lost before
 # the queue even starts (BASELINE.md measurement-session note). A live
@@ -175,6 +184,16 @@ while :; do
       if [ -s "$TELEMETRY" ]; then
         timeout 60 python -m netrep_tpu telemetry "$TELEMETRY" --prom \
           >"$PROM.tmp" 2>/dev/null && mv "$PROM.tmp" "$PROM" || rm -f "$PROM.tmp"
+      fi
+      # per-step perf regression gate (ISSUE 5): the newest ledger entry
+      # vs the robust median of its fingerprint's history; exit 2 =
+      # regression — logged loudly but never fails the step (the
+      # measurement itself is real and already appended)
+      if [ -s "$PERF_LEDGER" ]; then
+        if ! perf_out=$(timeout 60 python -m netrep_tpu perf "$PERF_LEDGER" --check 2>/dev/null); then
+          echo "--- PERF REGRESSION after $key ---" | tee -a "$LOG"
+          echo "$perf_out" | tee -a "$LOG"
+        fi
       fi
       # bench.py exits 0 on its own probe-race CPU-fallback rows, and the
       # benchmark scripts that share bench.ensure_backend print its stderr

@@ -12,11 +12,21 @@ backend) needs the equivalent one-liner. Commands:
   written by ``module_preservation(telemetry=...)`` or ``bench.py
   --telemetry``) into the human summary table offline; the table leads
   with a "recovery" section whenever the run retried, abandoned,
-  degraded, or had faults injected (ISSUE 4). ``--prom`` emits the
-  Prometheus text exposition instead, ``--json`` the raw registry, and
-  ``--recovery`` a chronological timeline of the recovery events alone
-  (what did this run survive, in what order).
+  degraded, or had faults injected (ISSUE 4), and ends with the
+  compile/dispatch/transfer/host time split of any null runs in the log
+  (ISSUE 5). ``--prom`` emits the Prometheus text exposition instead,
+  ``--json`` the raw registry, ``--recovery`` a chronological timeline
+  of the recovery events alone (what did this run survive, in what
+  order), and ``--trace out.json`` exports the span tree as
+  Chrome/Perfetto trace-event JSON (open in Perfetto/chrome://tracing).
   Runs without touching any backend — safe on a box whose tunnel is dead.
+- ``perf [<ledger>]`` — the throughput-regression ledger (ISSUE 5;
+  :mod:`netrep_tpu.utils.perfledger`): prints the per-fingerprint trend,
+  ``--check`` compares the newest entry against the robust median of its
+  matching history and exits 2 on regression (the ``tpu_watch.sh``
+  per-step gate), ``--ingest BENCH_r0*.json`` seeds the ledger from the
+  driver-bench trajectory files. The ledger path defaults from
+  ``NETREP_PERF_LEDGER``. Also backend-free.
 """
 
 from __future__ import annotations
@@ -58,6 +68,28 @@ def main(argv=None) -> int:
                     help="chronological timeline of recovery events "
                          "(retries, abandoned chunks, CPU degradation, "
                          "injected faults)")
+    tl.add_argument("--trace", metavar="OUT",
+                    help="export the span tree as Chrome/Perfetto "
+                         "trace-event JSON to OUT")
+    pf = sub.add_parser(
+        "perf", help="per-run throughput ledger: trend / regression check"
+    )
+    pf.add_argument("ledger", nargs="?", default=None,
+                    help="ledger JSONL (default: $NETREP_PERF_LEDGER or "
+                         "./netrep_perf_ledger.jsonl)")
+    pf.add_argument("--check", action="store_true",
+                    help="compare the newest entry against the robust "
+                         "median of matching prior entries; exit 2 on "
+                         "regression beyond --threshold")
+    pf.add_argument("--threshold", type=float, default=None,
+                    help="fail when newest/median < 1 - THRESHOLD "
+                         "(default 0.4)")
+    pf.add_argument("--window", type=int, default=None,
+                    help="median over at most this many most-recent "
+                         "matching entries (default 8)")
+    pf.add_argument("--ingest", nargs="+", metavar="BENCH_JSON",
+                    help="append entries converted from driver "
+                         "BENCH_r0*.json files before any other action")
     args = ap.parse_args(argv)
     if args.cmd is None:
         # bare invocation = selftest with its own argparse defaults (ONE
@@ -65,11 +97,57 @@ def main(argv=None) -> int:
         # flags belong after `selftest`)
         args = ap.parse_args(["selftest", *(argv or [])])
 
+    if args.cmd == "perf":
+        # backend-free like the telemetry report: the regression gate must
+        # run on a box whose tunnel is dead
+        from netrep_tpu.utils import perfledger
+
+        ledger = args.ledger or perfledger.default_path()
+        if args.ingest:
+            n = perfledger.ingest_bench_files(args.ingest, ledger)
+            print(f"ingested {n} entr{'y' if n == 1 else 'ies'} into "
+                  f"{ledger}")
+        if args.check:
+            try:
+                ok, report = perfledger.check(
+                    ledger,
+                    threshold=(
+                        args.threshold if args.threshold is not None
+                        else perfledger.DEFAULT_THRESHOLD
+                    ),
+                    window=(
+                        args.window if args.window is not None
+                        else perfledger.DEFAULT_WINDOW
+                    ),
+                )
+            except OSError as e:
+                print(f"cannot read {ledger!r}: {e}", file=sys.stderr)
+                return 1
+            print(report)
+            return 0 if ok else 2
+        if not args.ingest:
+            try:
+                print(perfledger.trend(ledger))
+            except OSError as e:
+                print(f"cannot read {ledger!r}: {e}", file=sys.stderr)
+                return 1
+        return 0
+
     if args.cmd == "telemetry":
         # pure-offline aggregation: must not resolve a backend (this is
         # the report you run precisely when the tunnel is dead)
         from netrep_tpu.utils.telemetry import aggregate_file, render_recovery
 
+        if args.trace:
+            from netrep_tpu.utils.trace import write_perfetto
+
+            try:
+                n = write_perfetto(args.path, args.trace)
+            except OSError as e:
+                print(f"cannot read {args.path!r}: {e}", file=sys.stderr)
+                return 1
+            print(f"wrote {n} trace events to {args.trace}")
+            return 0
         if args.recovery:
             try:
                 timeline = render_recovery(args.path)
@@ -95,6 +173,12 @@ def main(argv=None) -> int:
             print(json.dumps(reg.as_dict()))
         else:
             print(reg.render_summary())
+            from netrep_tpu.utils.trace import render_time_split
+
+            split = render_time_split(args.path)
+            if split:
+                print()
+                print(split)
         return 0
 
     import netrep_tpu

@@ -69,6 +69,66 @@ def _profile_totals(profile) -> tuple[int, int]:
     )
 
 
+def _mem_probe(telemetry):
+    """Per-run device-memory gauge probe (None when telemetry is off or
+    the backend exposes no memory accounting) — resolved once per run so
+    the per-chunk cost is a dict build, not a capability probe."""
+    if telemetry is None:
+        return None
+    from ..utils.profiling import make_memory_probe
+
+    return make_memory_probe()
+
+
+def _engine_fingerprint_key(base) -> str:
+    """The engine's autotune/compile-cache fingerprint (backend × gather
+    mode × bucket signature × chunk) — the key compile_span events and
+    perf-ledger entries are grouped by; '' for engines without one (the
+    native C++ tier)."""
+    key_fn = getattr(base, "autotune_key", None)
+    if not callable(key_fn):
+        return ""
+    try:
+        return str(key_fn())
+    except Exception:
+        return ""
+
+
+def _finish_run_accounting(base, telemetry, run_sid, t_marks, t_run0,
+                           start_perm, n_perm, mode) -> None:
+    """End-of-run compile estimate + perf-ledger feed (ISSUE 5), emitted
+    only when telemetry is on and at least two chunks landed.
+
+    The null loops have always distinguished the first (compile-absorbing)
+    interval from steady state for the autotune cache; this promotes the
+    distinction into an explicit ``compile_span`` event: the steady-state
+    rate over marks 0→last prices the first chunk's *compute*, and the
+    first interval's surplus over that price is the jit-compile estimate,
+    keyed by the engine's autotune/compile-cache fingerprint. The same
+    numbers feed the append-only perf ledger
+    (:mod:`netrep_tpu.utils.perfledger`) when ``NETREP_PERF_LEDGER``
+    names one — every telemetry-enabled run leaves a throughput
+    fingerprint CI can regression-check."""
+    if telemetry is None or len(t_marks) < 2:
+        return
+    (c0, t0), (c1, t1) = t_marks[0], t_marks[-1]
+    if t1 <= t0 or c1 <= c0:
+        return
+    rate = (c1 - c0) / (t1 - t0)
+    first_s = t_marks[0][1] - t_run0
+    compile_s = max(0.0, first_s - (t_marks[0][0] - start_perm) / rate)
+    fp = _engine_fingerprint_key(base)
+    telemetry.emit("compile_span", parent=run_sid, s=compile_s, key=fp,
+                   mode=mode)
+    from ..utils import perfledger
+
+    perfledger.maybe_record_run(
+        run_id=telemetry.run_id, fingerprint=fp, mode=mode,
+        perms_per_sec=rate, compile_s=compile_s, n_perm=int(n_perm),
+        backend=jax.default_backend(),
+    )
+
+
 def run_checkpointed_chunks(
     base: "PermutationEngine",
     n_perm: int,
@@ -173,28 +233,54 @@ def run_checkpointed_chunks(
         wd = tm.arm_watchdog(telemetry)
     prev_t = t_run0 = time.perf_counter()
     d0, b0 = prev_d, prev_b = _profile_totals(profile)
+    run_sid = None
+    mem = None
     if telemetry is not None:
-        telemetry.emit("null_run_start", mode="materialized",
-                       n_perm=int(n_perm), start_perm=int(start_perm))
+        run_sid = telemetry.begin_span(
+            "null_run_start", mode="materialized", n_perm=int(n_perm),
+            start_perm=int(start_perm),
+        )
+        mem = _mem_probe(telemetry)
     try:
         while dispatched < n_perm or pending is not None:
             nxt = None
             if dispatched < n_perm:
                 take = min(C, n_perm - dispatched)
-                keys = base.perm_keys(key, dispatched, take if dynamic else C)
-                if ft is None:
-                    outs = fn(keys)
-                else:
-                    outs = ft.run_dispatch(
+
+                def _dispatch():
+                    keys = base.perm_keys(
+                        key, dispatched, take if dynamic else C
+                    )
+                    if ft is None:
+                        return fn(keys)
+                    return ft.run_dispatch(
                         lambda: fn(keys), start=dispatched, take=take,
                         telemetry=telemetry, rescue=rescue,
                     )
-                nxt = (outs, dispatched, take)
+
+                if telemetry is None:
+                    sid_c = None
+                    outs = _dispatch()
+                else:
+                    # the chunk's span id is allocated at DISPATCH time and
+                    # pushed for the dispatch's extent, so retry/fault/
+                    # stall events fired inside nest under this chunk
+                    sid_c = telemetry.new_span_id()
+                    t_d0 = time.perf_counter()
+                    with telemetry.pushed(sid_c):
+                        outs = _dispatch()
+                    telemetry.emit(
+                        "dispatch", parent=sid_c,
+                        s=time.perf_counter() - t_d0,
+                        start=int(dispatched), take=int(take),
+                    )
+                nxt = (outs, dispatched, take, sid_c)
                 dispatched += take
                 if profile is not None:
                     profile.record_dispatch(2)  # key derivation + chunk
             if pending is not None:
-                outs, at, take_p = pending
+                outs, at, take_p, sid_p = pending
+                t_w0 = time.perf_counter() if telemetry is not None else 0.0
                 write(nulls, outs, at, take_p)
                 completed = at + take_p
                 t_marks.append((completed, time.perf_counter()))
@@ -205,6 +291,8 @@ def run_checkpointed_chunks(
                         "chunk", done=int(completed), total=int(n_perm),
                         take=int(take_p), s=now - prev_t,
                         dispatches=d - prev_d, host_bytes=b - prev_b,
+                        transfer_s=now - t_w0, span=sid_p, parent=run_sid,
+                        **(mem() if mem is not None else {}),
                     )
                     prev_t, prev_d, prev_b = now, d, b
                     wd.beat()
@@ -223,7 +311,7 @@ def run_checkpointed_chunks(
         # flush abandons the pending chunk instead.
         if pending is not None:
             try:
-                outs, at, take_p = pending
+                outs, at, take_p, _sid = pending
                 write(nulls, outs, at, take_p)
                 completed = at + take_p
             except KeyboardInterrupt:
@@ -237,7 +325,7 @@ def run_checkpointed_chunks(
         # the committed prefix is kept either way).
         if pending is not None:
             try:
-                outs, at, take_p = pending
+                outs, at, take_p, _sid = pending
                 write(nulls, outs, at, take_p)
                 completed = at + take_p
             except Exception:
@@ -253,9 +341,12 @@ def run_checkpointed_chunks(
         save(nulls, completed)
     if telemetry is not None:
         d, b = _profile_totals(profile)
-        telemetry.emit(
-            "null_run_end", mode="materialized", completed=int(completed),
-            n_perm=int(n_perm), s=time.perf_counter() - t_run0,
+        _finish_run_accounting(base, telemetry, run_sid, t_marks, t_run0,
+                               start_perm, n_perm, "materialized")
+        telemetry.end_span(
+            run_sid, "null_run_end", mode="materialized",
+            completed=int(completed), n_perm=int(n_perm),
+            s=time.perf_counter() - t_run0,
             dispatches=d - d0, host_bytes=b - b0,
         )
     record = getattr(base, "record_chunk_throughput", None)
@@ -539,11 +630,15 @@ def run_stream_superchunks(
         wd = tm.arm_watchdog(telemetry)
     prev_t = t_run0 = time.perf_counter()
     d0, b0 = _profile_totals(profile)
+    start0 = completed
+    run_sid = None
+    mem = None
     if telemetry is not None:
-        telemetry.emit(
+        run_sid = telemetry.begin_span(
             "null_run_start", mode="streaming", n_perm=int(n_perm),
             start_perm=int(completed), superchunk=K, chunk=C,
         )
+        mem = _mem_probe(telemetry)
     try:
         while completed < n_perm:
             take = min(K * C, n_perm - completed)
@@ -555,18 +650,36 @@ def run_stream_superchunks(
             valid = np.clip(
                 n_perm - completed - np.arange(K, dtype=np.int64) * C, 0, C
             ).astype(np.int32)
-            # fold + counter commit in one statement (clean-Ctrl-C
-            # contract: a consistent partial result at any interrupt)
-            if ft is None:
-                tallies, completed = fn(tallies, keys, valid), completed + take
+            if telemetry is not None:
+                sid_c = telemetry.new_span_id()
+                t_d0 = time.perf_counter()
+                span_cm = telemetry.pushed(sid_c)
             else:
-                # the lambda reads `tallies` at call time, so a retry after
-                # `reset` folds into the rebuilt carry
-                tallies, completed = ft.run_dispatch(
-                    lambda: fn(tallies, keys, valid), start=completed,
-                    take=take, telemetry=telemetry, rescue=rescue,
-                    reset=reset, label="superchunk",
-                ), completed + take
+                sid_c = None
+                span_cm = contextlib.nullcontext()
+            # fold + counter commit in one statement (clean-Ctrl-C
+            # contract: a consistent partial result at any interrupt);
+            # retries/faults fired inside nest under this superchunk span
+            with span_cm:
+                if ft is None:
+                    tallies, completed = (
+                        fn(tallies, keys, valid), completed + take
+                    )
+                else:
+                    # the lambda reads `tallies` at call time, so a retry
+                    # after `reset` folds into the rebuilt carry
+                    tallies, completed = ft.run_dispatch(
+                        lambda: fn(tallies, keys, valid), start=completed,
+                        take=take, telemetry=telemetry, rescue=rescue,
+                        reset=reset, label="superchunk",
+                    ), completed + take
+            if telemetry is not None:
+                telemetry.emit(
+                    "dispatch", parent=sid_c,
+                    s=time.perf_counter() - t_d0,
+                    start=int(completed - take), take=int(take),
+                )
+                t_p0 = time.perf_counter()
             hi, lo, eff = pull_tallies(tallies)
             t_marks.append((completed, time.perf_counter()))
             if profile is not None:
@@ -580,6 +693,8 @@ def run_stream_superchunks(
                     "superchunk", done=int(completed), total=int(n_perm),
                     perms=int(take), s=now - prev_t, dispatches=2,
                     host_bytes=int(hi.nbytes + lo.nbytes + eff.nbytes),
+                    transfer_s=now - t_p0, span=sid_c, parent=run_sid,
+                    **(mem() if mem is not None else {}),
                 )
                 prev_t = now
                 wd.beat()
@@ -614,9 +729,12 @@ def run_stream_superchunks(
             record((c1 - c0) / (t1 - t0))
     if telemetry is not None:
         d, b = _profile_totals(profile)
-        telemetry.emit(
-            "null_run_end", mode="streaming", completed=int(completed),
-            n_perm=int(n_perm), s=time.perf_counter() - t_run0,
+        _finish_run_accounting(base, telemetry, run_sid, t_marks, t_run0,
+                               start0, n_perm, "streaming")
+        telemetry.end_span(
+            run_sid, "null_run_end", mode="streaming",
+            completed=int(completed), n_perm=int(n_perm),
+            s=time.perf_counter() - t_run0,
             dispatches=d - d0, host_bytes=b - b0,
         )
     return StreamCounts(hi=hi, lo=lo, eff=eff, completed=completed)
@@ -710,24 +828,48 @@ def run_adaptive_stream_chunks(
         wd = tm.arm_watchdog(telemetry)
     prev_t = t_run0 = time.perf_counter()
     d0, b0 = _profile_totals(profile)
+    start0 = completed
+    t_marks: list[tuple[int, float]] = []
+    run_sid = None
+    mem = None
     if telemetry is not None:
-        telemetry.emit(
+        run_sid = telemetry.begin_span(
             "null_run_start", mode="adaptive-streaming", n_perm=int(n_perm),
             start_perm=int(completed), chunk=C,
         )
+        mem = _mem_probe(telemetry)
     try:
         while completed < n_perm and monitor.any_active():
             pos = monitor.active_positions()
             take = min(C, n_perm - completed)
-            keys = base.perm_keys(key, completed, C)
-            if ft is None:
-                outs = fn(keys, np.int32(take))
-            else:
-                outs = ft.run_dispatch(
+
+            def _dispatch():
+                keys = base.perm_keys(key, completed, C)
+                if ft is None:
+                    return fn(keys, np.int32(take))
+                return ft.run_dispatch(
                     lambda: fn(keys, np.int32(take)), start=completed,
                     take=take, telemetry=telemetry, rescue=rescue,
                 )
+
+            if telemetry is None:
+                sid_c = None
+                outs = _dispatch()
+            else:
+                sid_c = telemetry.new_span_id()
+                t_d0 = time.perf_counter()
+                with telemetry.pushed(sid_c):
+                    outs = _dispatch()
+                telemetry.emit(
+                    "dispatch", parent=sid_c,
+                    s=time.perf_counter() - t_d0,
+                    start=int(completed), take=int(take),
+                )
+                t_p0 = time.perf_counter()
             hi_a, lo_a, eff_a = counts_to_active(outs, pos)
+            pull_s = (
+                time.perf_counter() - t_p0 if telemetry is not None else 0.0
+            )
             if profile is not None:
                 profile.record_dispatch(2)
                 profile.record_transfer(
@@ -737,6 +879,7 @@ def run_adaptive_stream_chunks(
             completed = monitor.folded
             if telemetry is not None:
                 now = time.perf_counter()
+                t_marks.append((completed, now))
                 telemetry.emit(
                     "chunk", done=int(completed), total=int(n_perm),
                     take=int(take), s=now - prev_t, dispatches=2,
@@ -744,6 +887,8 @@ def run_adaptive_stream_chunks(
                         hi_a.nbytes + lo_a.nbytes + eff_a.nbytes
                     ),
                     active_modules=int(monitor.active.sum()),
+                    transfer_s=pull_s, span=sid_c, parent=run_sid,
+                    **(mem() if mem is not None else {}),
                 )
                 prev_t = now
                 wd.beat()
@@ -774,8 +919,10 @@ def run_adaptive_stream_chunks(
         save(completed)
     if telemetry is not None:
         d, b = _profile_totals(profile)
-        telemetry.emit(
-            "null_run_end", mode="adaptive-streaming",
+        _finish_run_accounting(base, telemetry, run_sid, t_marks, t_run0,
+                               start0, n_perm, "adaptive-streaming")
+        telemetry.end_span(
+            run_sid, "null_run_end", mode="adaptive-streaming",
             completed=int(completed), n_perm=int(n_perm),
             s=time.perf_counter() - t_run0, dispatches=d - d0,
             host_bytes=b - b0, perms_evaluated=int(monitor.total_evaluated()),
@@ -967,34 +1114,61 @@ def run_adaptive_chunks(
     else:
         wd = tm.arm_watchdog(telemetry)
     prev_t = t_run0 = time.perf_counter()
+    start0 = completed
+    t_marks: list[tuple[int, float]] = []
+    run_sid = None
+    mem = None
     if telemetry is not None:
-        telemetry.emit(
+        run_sid = telemetry.begin_span(
             "null_run_start", mode="adaptive", n_perm=int(n_perm),
             start_perm=int(completed), chunk=C,
         )
+        mem = _mem_probe(telemetry)
     try:
         while completed < n_perm and monitor.any_active():
             pos = monitor.active_positions()
             take = min(C, n_perm - completed)
-            keys = base.perm_keys(key, completed, take if dynamic else C)
-            if ft is None:
-                outs = fn(keys)
-            else:
-                outs = ft.run_dispatch(
+
+            def _dispatch():
+                keys = base.perm_keys(key, completed, take if dynamic else C)
+                if ft is None:
+                    return fn(keys)
+                return ft.run_dispatch(
                     lambda: fn(keys), start=completed, take=take,
                     telemetry=telemetry, rescue=rescue,
                 )
+
+            if telemetry is None:
+                sid_c = None
+                outs = _dispatch()
+            else:
+                sid_c = telemetry.new_span_id()
+                t_d0 = time.perf_counter()
+                with telemetry.pushed(sid_c):
+                    outs = _dispatch()
+                telemetry.emit(
+                    "dispatch", parent=sid_c,
+                    s=time.perf_counter() - t_d0,
+                    start=int(completed), take=int(take),
+                )
+                t_w0 = time.perf_counter()
             write(nulls, outs, completed, take)
+            write_s = (
+                time.perf_counter() - t_w0 if telemetry is not None else 0.0
+            )
             completed += take
             newly = monitor.update(
                 slice_vals(nulls, completed - take, take, pos), take
             )
             if telemetry is not None:
                 now = time.perf_counter()
+                t_marks.append((completed, now))
                 telemetry.emit(
                     "chunk", done=int(completed), total=int(n_perm),
                     take=int(take), s=now - prev_t,
                     active_modules=int(monitor.active.sum()),
+                    transfer_s=write_s, span=sid_c, parent=run_sid,
+                    **(mem() if mem is not None else {}),
                 )
                 prev_t = now
                 wd.beat()
@@ -1022,8 +1196,11 @@ def run_adaptive_chunks(
     if save is not None and completed > last_saved:
         save(nulls, completed)
     if telemetry is not None:
-        telemetry.emit(
-            "null_run_end", mode="adaptive", completed=int(completed),
+        _finish_run_accounting(base, telemetry, run_sid, t_marks, t_run0,
+                               start0, n_perm, "adaptive")
+        telemetry.end_span(
+            run_sid, "null_run_end", mode="adaptive",
+            completed=int(completed),
             n_perm=int(n_perm), s=time.perf_counter() - t_run0,
             perms_evaluated=int(monitor.total_evaluated()),
         )

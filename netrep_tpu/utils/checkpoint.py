@@ -14,11 +14,15 @@ different problem (wrong dataset pair, module set, or pool).
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
+import logging
 import os
 import tempfile
 
 import numpy as np
+
+logger = logging.getLogger("netrep_tpu")
 
 # v2: fingerprint gained the sampled content digest — v1 checkpoints get a
 # clear version error instead of a misleading "different problem" mismatch.
@@ -154,6 +158,30 @@ def load_null_checkpoint(path: str) -> dict | None:
         }
 
 
+#: active degraded-rebuild acceptance scopes (ISSUE 5, closing the PR 4
+#: known gap): within one, a FINGERPRINT mismatch is tolerated with a
+#: ``fingerprint_degraded_accept`` event + warning instead of a refusal —
+#: a device-loss → CPU rebuild legitimately changes the fingerprint (a
+#: row-sharded engine's matrices are padded/sharded; the replicated
+#: rebuild's are not) while the problem and RNG stream are unchanged.
+#: Key/seed mismatches still ALWAYS raise: splicing two null streams is
+#: never right, degraded or not.
+_DEGRADED_ACCEPT: list[str] = []
+
+
+@contextlib.contextmanager
+def accept_degraded_fingerprint(reason: str = "degraded_rebuild"):
+    """Scope in which :func:`validate_identity` tolerates a fingerprint
+    mismatch (see :data:`_DEGRADED_ACCEPT`). Entered by
+    ``models/preservation.py`` around the post-``degrade_to_cpu`` resume
+    only — the acceptance is per-rebuild, never process-global."""
+    _DEGRADED_ACCEPT.append(str(reason))
+    try:
+        yield
+    finally:
+        _DEGRADED_ACCEPT.pop()
+
+
 def validate_identity(
     ckpt: dict,
     key_data: np.ndarray,
@@ -163,13 +191,31 @@ def validate_identity(
     """Problem/seed identity checks shared by the materialized and
     streaming-counts resume paths (the streaming path has no null array to
     reshape, so :func:`validate_resume` splits in two): raises with a
-    specific message on any mismatch."""
+    specific message on any mismatch — except a fingerprint mismatch
+    inside an :func:`accept_degraded_fingerprint` scope, which is accepted
+    explicitly (event + warning) because the degraded CPU rebuild changed
+    the engine's matrix layout, not the problem."""
     fp = ckpt["fingerprint"]
     if fp.shape != fingerprint.shape or not np.array_equal(fp, fingerprint):
-        raise ValueError(
-            f"checkpoint {path!r} was written for a different problem "
-            "(module set, sizes, pool, data presence, or store_nulls mode "
-            "differ); refusing to resume — delete the file or point elsewhere"
+        if not _DEGRADED_ACCEPT:
+            raise ValueError(
+                f"checkpoint {path!r} was written for a different problem "
+                "(module set, sizes, pool, data presence, or store_nulls "
+                "mode differ); refusing to resume — delete the file or "
+                "point elsewhere"
+            )
+        reason = _DEGRADED_ACCEPT[-1]
+        tel = _telemetry()
+        if tel is not None:
+            tel.emit(
+                "fingerprint_degraded_accept", path=path, reason=reason,
+                completed=int(ckpt["completed"]),
+            )
+        logger.warning(
+            "checkpoint %r fingerprint mismatches the rebuilt engine "
+            "(expected after a %s rebuild: matrix sharding/padding "
+            "changed, the problem did not); accepting the resume — the "
+            "PRNG key/seed is still verified below", path, reason,
         )
     kd = np.asarray(ckpt["key_data"])
     if kd.shape != np.asarray(key_data).shape or not np.array_equal(kd, key_data):

@@ -1,0 +1,353 @@
+"""Append-only per-run throughput ledger + regression check (ISSUE 5).
+
+Five PRs of BENCH_r0*.json trajectory accumulated with nothing consuming
+it — a 129→10 perms/s CPU-fallback collapse and a 120 s silent probe hang
+sat in prose no tool would ever flag. This module is the consumer: every
+measured run appends one JSON line (a *throughput fingerprint* — backend,
+problem-shape key, mode, perms/s, compile estimate), and
+``python -m netrep_tpu perf <ledger> --check`` compares the newest entry
+against the robust median of its *matching* history (same fingerprint)
+and exits non-zero when it regressed beyond the threshold — a CI gate,
+not a prose warning.
+
+Writers:
+
+- the engine null loops (:func:`maybe_record_run`), for any
+  telemetry-enabled run, when ``NETREP_PERF_LEDGER`` names a path;
+- ``bench.py`` (every metric row carrying ``perms_per_sec``);
+- ``benchmarks/tpu_watch.sh`` (exports ``NETREP_PERF_LEDGER`` and runs
+  the check after each step);
+- ``perf --ingest BENCH_r0*.json`` — converts the repo's driver-bench
+  history so five PRs of trajectory become the initial baseline.
+
+Entries are one JSON object per line, keyed ``perf_v`` (so a ledger can
+share a file with telemetry events or bench rows without ambiguity):
+
+    {"perf_v": 1, "t": <unix s>, "source": "run"|"bench"|"ingest",
+     "round": <int|None>, "run": <run id|None>, "fingerprint": <str>,
+     "backend": <str>, "mode": <str|None>, "perms_per_sec": <float>,
+     "compile_s": <float|None>, "n_perm": <int|None>, "metric": <str|None>}
+
+``fingerprint`` is the grouping identity: the engine's autotune/compile
+-cache key for run entries, a normalized (metric, backend-class, chunk,
+dtype) tuple for bench rows — entries only ever compare against history
+of the same fingerprint, so a CPU-fallback row can never be judged
+against TPU history. Appends are best-effort (an unwritable ledger warns
+once and never fails the run), reads are tolerant (foreign lines are
+skipped).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+logger = logging.getLogger("netrep_tpu")
+
+#: entry-line format version (bump deliberately, with the golden test)
+ENTRY_VERSION = 1
+
+#: env var naming the ledger path — set by tpu_watch.sh; any
+#: telemetry-enabled run and every bench row appends when it is set
+LEDGER_ENV = "NETREP_PERF_LEDGER"
+
+#: default regression threshold: newest/median < (1 - threshold) fails.
+#: 0.4 tolerates the measured box-contention drift of the CPU-fallback
+#: rows (752→982 s across rounds with no code change) while still
+#: catching a 2× regression outright.
+DEFAULT_THRESHOLD = 0.4
+
+#: how many most-recent matching entries the median is taken over
+DEFAULT_WINDOW = 8
+
+_APPEND_WARNED = False
+
+
+def default_path() -> str:
+    """Ledger path resolution shared by the CLI and the writers: the
+    ``NETREP_PERF_LEDGER`` env var, else ``netrep_perf_ledger.jsonl`` in
+    the CWD."""
+    return os.environ.get(LEDGER_ENV) or os.path.join(
+        os.getcwd(), "netrep_perf_ledger.jsonl"
+    )
+
+
+def make_entry(
+    fingerprint: str,
+    perms_per_sec: float,
+    source: str,
+    backend: str = "",
+    mode: str | None = None,
+    compile_s: float | None = None,
+    n_perm: int | None = None,
+    run_id: str | None = None,
+    round_n: int | None = None,
+    metric: str | None = None,
+    t: float | None = None,
+) -> dict:
+    """One ledger line, in pinned key order (golden-shape test)."""
+    return {
+        "perf_v": ENTRY_VERSION,
+        "t": float(t) if t is not None else time.time(),
+        "source": str(source),
+        "round": int(round_n) if round_n is not None else None,
+        "run": run_id,
+        "fingerprint": str(fingerprint),
+        "backend": str(backend),
+        "mode": mode,
+        "perms_per_sec": round(float(perms_per_sec), 4),
+        "compile_s": (
+            round(float(compile_s), 4) if compile_s is not None else None
+        ),
+        "n_perm": int(n_perm) if n_perm is not None else None,
+        "metric": metric,
+    }
+
+
+def append_entry(entry: dict, path: str | None = None) -> bool:
+    """Append one entry (flushed line). Best-effort: an unwritable ledger
+    warns once per process and returns False — recording a measurement
+    must never fail the run that produced it."""
+    global _APPEND_WARNED
+    path = path or default_path()
+    try:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(entry) + "\n")
+        return True
+    except OSError as e:
+        if not _APPEND_WARNED:
+            _APPEND_WARNED = True
+            logger.warning("perf ledger %r not writable (%s: %s); "
+                           "throughput entries are dropped", path,
+                           type(e).__name__, e)
+        return False
+
+
+def read_entries(path: str) -> list[dict]:
+    """All ledger entries in file order, skipping foreign/corrupt lines
+    (the ledger may share a file with bench rows or telemetry events)."""
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if (isinstance(row, dict) and row.get("perf_v") == ENTRY_VERSION
+                    and isinstance(row.get("fingerprint"), str)
+                    and isinstance(row.get("perms_per_sec"), (int, float))):
+                out.append(row)
+    return out
+
+
+def maybe_record_run(
+    fingerprint: str,
+    perms_per_sec: float,
+    mode: str,
+    backend: str,
+    compile_s: float | None = None,
+    n_perm: int | None = None,
+    run_id: str | None = None,
+) -> bool:
+    """Engine-loop hook: append a run entry when ``NETREP_PERF_LEDGER``
+    names a ledger; silently a no-op otherwise (the env-gated contract —
+    telemetry-on runs pay one getenv)."""
+    path = os.environ.get(LEDGER_ENV)
+    if not path or not perms_per_sec > 0:
+        return False
+    return append_entry(
+        make_entry(fingerprint, perms_per_sec, "run", backend=backend,
+                   mode=mode, compile_s=compile_s, n_perm=n_perm,
+                   run_id=run_id),
+        path,
+    )
+
+
+# ---------------------------------------------------------------------------
+# bench-row conversion + BENCH_r0*.json ingestion
+# ---------------------------------------------------------------------------
+
+
+def _backend_class(device: str) -> str:
+    d = device.lower()
+    if "tpu" in d:
+        return "tpu"
+    if "cpu" in d:
+        return "cpu"
+    if "gpu" in d or "cuda" in d:
+        return "gpu"
+    return device or "unknown"
+
+
+def bench_fingerprint(row: dict) -> str | None:
+    """Grouping identity of a bench metric row: the metric label up to its
+    parenthesized config note / fallback suffix, plus backend class,
+    chunk, and dtype — so r01's TPU north row and r05's CPU-fallback north
+    row form two histories that never compare against each other."""
+    metric = row.get("metric")
+    if not isinstance(metric, str) or not metric:
+        return None
+    base = metric.split(" [", 1)[0].split(" (", 1)[0].strip()
+    parts = [f"bench|{base}", _backend_class(str(row.get("device", "")))]
+    if row.get("chunk") is not None:
+        parts.append(f"chunk:{row['chunk']}")
+    if row.get("dtype"):
+        parts.append(f"dtype:{row['dtype']}")
+    return "|".join(parts)
+
+
+def entry_from_bench_row(row: dict, source: str = "bench",
+                         round_n: int | None = None,
+                         t: float | None = None) -> dict | None:
+    """Bench metric row → ledger entry, or None for rows without a
+    throughput number (warning/error/skip rows)."""
+    pps = row.get("perms_per_sec")
+    if not isinstance(pps, (int, float)) or not pps > 0:
+        return None
+    fp = bench_fingerprint(row)
+    if fp is None:
+        return None
+    return make_entry(
+        fp, pps, source, backend=_backend_class(str(row.get("device", ""))),
+        mode="bench", run_id=row.get("telemetry"),
+        metric=str(row.get("metric"))[:160], round_n=round_n, t=t,
+    )
+
+
+def ingest_bench_files(paths, ledger_path: str) -> int:
+    """Convert driver BENCH_r0*.json files (``{"n", "cmd", "tail",
+    "parsed"}``) into ledger entries, ordered by round then line order —
+    every JSON line found in ``tail`` plus the ``parsed`` row, de-duped.
+    Returns the number of entries appended."""
+    files = []
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            logger.warning("skipping %r: %s", p, e)
+            continue
+        files.append((doc.get("n") if isinstance(doc, dict) else None,
+                      p, doc))
+    files.sort(key=lambda x: (x[0] is None, x[0] if x[0] is not None else 0,
+                              x[1]))
+    n_added = 0
+    for round_n, _p, doc in files:
+        if not isinstance(doc, dict):
+            continue
+        rows, seen = [], set()
+        for line in str(doc.get("tail", "")).splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(row, dict):
+                rows.append(row)
+                seen.add(json.dumps(row, sort_keys=True))
+        parsed = doc.get("parsed")
+        if (isinstance(parsed, dict)
+                and json.dumps(parsed, sort_keys=True) not in seen):
+            rows.append(parsed)
+        for row in rows:
+            # synthetic, strictly ordered timestamps: the driver files
+            # carry no wall time, but check() keys on append order anyway
+            entry = entry_from_bench_row(
+                row, source="ingest", round_n=round_n,
+                t=float(round_n or 0),
+            )
+            if entry is not None and append_entry(entry, ledger_path):
+                n_added += 1
+    return n_added
+
+
+# ---------------------------------------------------------------------------
+# trend + regression check
+# ---------------------------------------------------------------------------
+
+
+def _median(vals: list[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def check(path: str, threshold: float = DEFAULT_THRESHOLD,
+          window: int = DEFAULT_WINDOW) -> tuple[bool, str]:
+    """Compare the ledger's NEWEST entry against the robust median of the
+    prior entries sharing its fingerprint (most recent ``window`` of
+    them). Returns ``(ok, report)``:
+
+    - no entries → ok (nothing to judge);
+    - no matching history → ok, noted (first measurement of this
+      fingerprint — a baseline, not a regression);
+    - ratio newest/median < 1 - threshold → **not ok** (the CLI exits
+      non-zero; ``tpu_watch.sh`` surfaces it after each step).
+    """
+    entries = read_entries(path)
+    if not entries:
+        return True, f"perf ledger {path!r}: no entries"
+    newest = entries[-1]
+    fp = newest["fingerprint"]
+    priors = [e for e in entries[:-1] if e["fingerprint"] == fp]
+    priors = priors[-int(window):]
+    head = (
+        f"newest: {newest['perms_per_sec']:g} perms/s "
+        f"[{newest.get('source')}] {fp}"
+    )
+    if not priors:
+        return True, (
+            f"{head}\nno prior entries with this fingerprint — recorded "
+            "as the baseline"
+        )
+    med = _median([float(e["perms_per_sec"]) for e in priors])
+    ratio = float(newest["perms_per_sec"]) / med if med > 0 else 1.0
+    body = (
+        f"{head}\nhistory: {len(priors)} matching entr"
+        f"{'y' if len(priors) == 1 else 'ies'}, median {med:g} perms/s "
+        f"→ ratio {ratio:.3f} (fail below {1.0 - threshold:.2f})"
+    )
+    if ratio < 1.0 - threshold:
+        return False, (
+            f"{body}\nPERF REGRESSION: the newest entry is "
+            f"{(1.0 - ratio) * 100.0:.0f}% below its history's median"
+        )
+    return True, f"{body}\nOK"
+
+
+def trend(path: str) -> str:
+    """Per-fingerprint trend table of the whole ledger (the no-``--check``
+    CLI view): entry count, median, newest, and newest/median ratio."""
+    entries = read_entries(path)
+    if not entries:
+        return f"perf ledger {path!r}: no entries"
+    groups: dict[str, list[dict]] = {}
+    order: list[str] = []
+    for e in entries:
+        fp = e["fingerprint"]
+        if fp not in groups:
+            groups[fp] = []
+            order.append(fp)
+        groups[fp].append(e)
+    lines = [f"perf ledger {path!r}: {len(entries)} entries, "
+             f"{len(order)} fingerprint(s)"]
+    for fp in order:
+        g = groups[fp]
+        vals = [float(e["perms_per_sec"]) for e in g]
+        med = _median(vals)
+        last = vals[-1]
+        ratio = last / med if med > 0 else float("nan")
+        lines.append(
+            f"  {fp}\n    n={len(g)}  median={med:g}  newest={last:g}  "
+            f"newest/median={ratio:.3f}"
+        )
+    return "\n".join(lines)

@@ -156,6 +156,55 @@ class PairTimer:
         }
 
 
+def make_memory_probe():
+    """Per-chunk device-memory gauge factory (ISSUE 5 compile & memory
+    accounting): returns a zero-arg callable yielding telemetry fields —
+    ``mem_bytes_in_use``/``mem_peak_bytes`` from ``device.memory_stats()``
+    where the backend implements it, else ``mem_live_buffer_bytes`` summed
+    over ``jax.live_arrays()`` — or None when neither works. Guarded like
+    the backend probes: memory accounting must never turn a working run
+    into a failing one, and the probe decision is made ONCE per run so the
+    per-chunk cost is one dict build."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+    except Exception:
+        return None
+
+    def stats_probe():
+        out = {}
+        try:
+            ms = dev.memory_stats()
+        except Exception:
+            return out
+        if not isinstance(ms, dict):
+            return out
+        if "bytes_in_use" in ms:
+            out["mem_bytes_in_use"] = int(ms["bytes_in_use"])
+        if "peak_bytes_in_use" in ms:
+            out["mem_peak_bytes"] = int(ms["peak_bytes_in_use"])
+        return out
+
+    def live_probe():
+        try:
+            import jax
+
+            return {
+                "mem_live_buffer_bytes": int(sum(
+                    int(getattr(a, "nbytes", 0)) for a in jax.live_arrays()
+                ))
+            }
+        except Exception:
+            return {}
+
+    if stats_probe():
+        return stats_probe
+    if live_probe():
+        return live_probe
+    return None
+
+
 def resolve_profile_dir(profile) -> str | None:
     """``profile=`` argument → trace directory (None = profiling off)."""
     if profile is None or profile is False:
@@ -203,10 +252,23 @@ def trace_time_split(trace_dir: str) -> dict:
     return split
 
 
+#: one-shot flag for the xplane-parse downgrade below (the benign case
+#: repeats for every trace in a session; genuine information is one line)
+_XPLANE_UNSUPPORTED_WARNED = False
+
+
 def _device_op_durations(trace_dir: str) -> dict[str, float]:
     """Per-op total duration (ns) over accelerator planes of the newest
     xplane in ``trace_dir`` — the shared parse behind
-    :func:`summarize_trace` and :func:`trace_time_split`."""
+    :func:`summarize_trace` and :func:`trace_time_split`.
+
+    The xplane reader API moves between jax releases
+    (``jax.profiler.ProfileData`` is absent in some installed versions,
+    and its attribute layout has shifted) — a missing/incompatible reader
+    degrades to an empty-but-valid op table with ONE warning instead of
+    raising, so ``profile=`` keeps collecting wall-clock timings on every
+    jax this package imports under."""
+    global _XPLANE_UNSUPPORTED_WARNED
     import jax
 
     paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
@@ -215,15 +277,26 @@ def _device_op_durations(trace_dir: str) -> dict[str, float]:
         return {}
     with open(paths[-1], "rb") as f:
         raw = f.read()
-    pd_ = jax.profiler.ProfileData.from_serialized_xspace(raw)
     per_op: dict[str, float] = {}
-    for plane in pd_.planes:
-        if "tpu" not in plane.name.lower() and "gpu" not in plane.name.lower():
-            continue
-        for line in plane.lines:
-            for ev in line.events:
-                base = re.sub(r"[.\d]+$", "", ev.name)
-                per_op[base] = per_op.get(base, 0.0) + ev.duration_ns
+    try:
+        pd_ = jax.profiler.ProfileData.from_serialized_xspace(raw)
+        for plane in pd_.planes:
+            if ("tpu" not in plane.name.lower()
+                    and "gpu" not in plane.name.lower()):
+                continue
+            for line in plane.lines:
+                for ev in line.events:
+                    base = re.sub(r"[.\d]+$", "", ev.name)
+                    per_op[base] = per_op.get(base, 0.0) + ev.duration_ns
+    except (AttributeError, TypeError, ValueError) as e:
+        if not _XPLANE_UNSUPPORTED_WARNED:
+            _XPLANE_UNSUPPORTED_WARNED = True
+            logger.warning(
+                "installed jax cannot parse xplane traces (%s: %s); "
+                "per-op device tables will be empty — wall-clock timings "
+                "are unaffected", type(e).__name__, e,
+            )
+        return {}
     return per_op
 
 

@@ -37,6 +37,27 @@ schema-stability test so downstream parsers (``summarize_watch.py``,
 dashboards) never break silently. ``data`` values are JSON scalars/lists;
 numeric fields fold into the registry by one rule (``fold``).
 
+Hierarchical spans (ISSUE 5) ride the same schema as *additive* ``data``
+fields — the six top-level keys never change:
+
+- ``data["span"]`` — the event belongs to span ``span`` (a run-unique
+  deterministic id ``s<n>``); a begin event carries it without ``s``, the
+  closing event carries it with the measured ``s`` duration.
+- ``data["parent"]`` — the id of the enclosing span. :meth:`Telemetry.emit`
+  attaches it automatically from the ambient span stack when the caller
+  passes neither ``span`` nor ``parent``, so leaf events (retries, stalls,
+  checkpoint saves) land under whatever span was open when they fired.
+
+The stack is maintained by :meth:`Telemetry.span` (context-manager spans),
+:meth:`Telemetry.begin_span`/:meth:`Telemetry.end_span` (loop-shaped spans
+whose begin and end are separate events, e.g. ``null_run_start`` /
+``null_run_end``), and :meth:`Telemetry.pushed` (adopt an externally
+allocated id for a dynamic extent — how chunk dispatches parent their
+retries). Span ids are a per-bus counter, so a deterministic run produces
+a deterministic tree. ``netrep_tpu/utils/trace.py`` rebuilds the tree
+offline and exports Chrome/Perfetto trace JSON
+(``python -m netrep_tpu telemetry run.jsonl --trace out.json``).
+
 Telemetry is OFF by default. When disabled the hot loops pay a single
 ``None`` check per run (not per chunk) and results are bit-identical —
 telemetry only ever observes.
@@ -81,6 +102,7 @@ RECOVERY_EVENTS = (
     "stall_recovered",
     "device_lost",
     "degraded_to_cpu",
+    "fingerprint_degraded_accept",
     "backend_fallback",
     "distributed_autodetect_failed",
 )
@@ -279,6 +301,13 @@ class Telemetry:
         self._subscribers: list[Callable[[dict], None]] = []
         self._fh = None
         self._sink_dead = False
+        # hierarchical spans (ISSUE 5): deterministic per-bus id counter +
+        # the ambient span stack leaf events auto-parent against. The stack
+        # is shared across threads on purpose — the watchdog thread's
+        # stall events belong to whatever span the loop thread has open.
+        self._span_seq = 0
+        self._span_stack: list[str] = []
+        self._span_t0: dict[str, float] = {}
         if self.path is not None:
             d = os.path.dirname(os.path.abspath(self.path))
             os.makedirs(d, exist_ok=True)
@@ -292,7 +321,17 @@ class Telemetry:
 
     def emit(self, ev: str, **data) -> dict:
         """Append one event to the sink (flushed — crash loses at most the
-        in-flight line), fold it into the registry, notify subscribers."""
+        in-flight line), fold it into the registry, notify subscribers.
+
+        When the caller passes neither ``span`` nor ``parent`` and a span
+        is open on the ambient stack, ``data["parent"]`` is attached
+        automatically — point events always land under the span that was
+        live when they fired (acceptance: every chunk/dispatch/retry event
+        owned by exactly one parent span)."""
+        if "span" not in data and "parent" not in data:
+            parent = self.current_span()
+            if parent is not None:
+                data["parent"] = parent
         record = {
             "v": SCHEMA_VERSION,
             "t": self.wall(),
@@ -323,20 +362,93 @@ class Telemetry:
                 logger.warning("telemetry subscriber raised", exc_info=True)
         return record
 
+    # -- hierarchical spans (ISSUE 5) --------------------------------------
+
+    def new_span_id(self) -> str:
+        """Allocate a run-unique, deterministic span id (``s<n>``): a
+        counter, not a UUID, so the same run produces the same tree —
+        pinned by the fault-harness determinism test."""
+        with self._lock:
+            self._span_seq += 1
+            return f"s{self._span_seq}"
+
+    def current_span(self) -> str | None:
+        """Innermost open span id, or None outside any span."""
+        with self._lock:
+            return self._span_stack[-1] if self._span_stack else None
+
+    def _push_span(self, span_id: str) -> None:
+        with self._lock:
+            self._span_stack.append(span_id)
+
+    def _pop_span(self, span_id: str) -> None:
+        with self._lock:
+            for i in range(len(self._span_stack) - 1, -1, -1):
+                if self._span_stack[i] == span_id:
+                    del self._span_stack[i]
+                    break
+
+    @contextlib.contextmanager
+    def pushed(self, span_id: str):
+        """Make an externally allocated span id the ambient parent for the
+        block — how a chunk dispatch adopts its chunk's span so retry /
+        fault / stall events emitted inside nest under that chunk."""
+        self._push_span(span_id)
+        try:
+            yield span_id
+        finally:
+            self._pop_span(span_id)
+
     @contextlib.contextmanager
     def span(self, ev: str, **data):
         """Timed span: measures the block's duration on the monotonic
         clock and emits ``ev`` with an ``s`` field on exit (also on error,
-        with ``error`` naming the exception type)."""
+        with ``error`` naming the exception type). The single closing
+        event carries the span's id and parent, and events emitted inside
+        the block auto-parent to it."""
+        sid = self.new_span_id()
+        parent = self.current_span()
+        if parent is not None:
+            data.setdefault("parent", parent)
+        self._push_span(sid)
         t0 = self.clock()
         try:
             yield self
         except BaseException as e:
-            self.emit(ev, s=self.clock() - t0, error=type(e).__name__,
-                      **data)
+            self._pop_span(sid)
+            self.emit(ev, s=self.clock() - t0, span=sid,
+                      error=type(e).__name__, **data)
             raise
         else:
-            self.emit(ev, s=self.clock() - t0, **data)
+            self._pop_span(sid)
+            self.emit(ev, s=self.clock() - t0, span=sid, **data)
+
+    def begin_span(self, ev: str, **data) -> str:
+        """Open a span whose begin and end are *separate events* (the loop
+        shape: ``null_run_start`` … ``null_run_end``): emits ``ev`` now
+        carrying the new span id (+ parent), pushes the id on the ambient
+        stack, and returns it for :meth:`end_span`."""
+        sid = self.new_span_id()
+        parent = self.current_span()
+        if parent is not None:
+            data.setdefault("parent", parent)
+        self.emit(ev, span=sid, **data)
+        with self._lock:
+            self._span_stack.append(sid)
+            self._span_t0[sid] = self.clock()
+        return sid
+
+    def end_span(self, span_id: str, ev: str, **data) -> dict:
+        """Close a :meth:`begin_span` span: pops it and emits the closing
+        ``ev`` with the same span id. ``s`` defaults to the span's measured
+        duration on this bus's clock; callers with their own timing (the
+        null loops use ``perf_counter``) pass ``s=`` explicitly."""
+        self._pop_span(span_id)
+        with self._lock:
+            t0 = self._span_t0.pop(span_id, None)
+        if "s" not in data and t0 is not None:
+            data["s"] = self.clock() - t0
+        return self.emit(ev, span=span_id, **data)
 
     # -- ambient activation ------------------------------------------------
 

@@ -1,0 +1,294 @@
+"""Offline span-tree reconstruction + Chrome/Perfetto export (ISSUE 5).
+
+The telemetry JSONL is a flat event stream; the span fields
+(:mod:`netrep_tpu.utils.telemetry` — additive ``data["span"]`` /
+``data["parent"]``) give it causal structure. This module rebuilds the
+tree offline and renders it two ways, touching no backend (usable on a
+box whose tunnel is dead, like the rest of the ``telemetry`` CLI):
+
+- :func:`render_perfetto` — Chrome trace-event JSON
+  (``python -m netrep_tpu telemetry run.jsonl --trace out.json``): open
+  it in Perfetto / ``chrome://tracing``. Spans are complete (``"X"``)
+  events with µs ``ts``/``dur``; one ``pid`` per run id; ``tid`` is the
+  span's tree depth, so overlapping levels (a double-buffered dispatch
+  issued inside the previous chunk's window) land on separate rows
+  instead of mis-nesting.
+- :func:`time_split` — the compile / dispatch / transfer / host wall-time
+  attribution of each null run, defined to sum to the run span exactly:
+  ``dispatch`` is the measured in-dispatch host time minus the estimated
+  ``compile_span`` carve-out, ``transfer`` the measured device→host pull
+  time, and ``host`` the remainder (python loop, monitor folds,
+  checkpoint writes).
+
+Span pairing rule (one rule, shared with the emitters): all events
+carrying the same ``data["span"]`` id form one span; the last of them
+with a numeric ``s`` closes it (``t_start = t - s``), the others are
+begin/annotation markers. A timed event with ``parent`` but no ``span``
+(e.g. per-chunk ``dispatch``) is a leaf span of its own; an untimed one
+is an instant attached to its parent.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable
+
+from .telemetry import read_events
+
+#: events whose duration is an end-of-run estimate, not an in-place
+#: measurement: the exporter renders them at their PARENT span's start
+#: (compile happens first), since their emit time is the run's end
+_AT_PARENT_START = frozenset({"compile_span"})
+
+_META_KEYS = ("span", "parent", "s")
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def build_span_tree(events: Iterable[dict]) -> tuple[dict, list]:
+    """Fold an event stream into ``(spans, instants)``.
+
+    ``spans`` maps span id → node dict with keys ``id``, ``name``,
+    ``parent`` (id or None), ``t_start``/``t_end`` (wall seconds),
+    ``dur_s``, ``run``, ``args`` (merged non-meta data fields),
+    ``children`` (ids, file order), ``depth`` (1-based; roots are 1).
+    Timed leaf events without an id get synthetic ``e<n>`` ids.
+    ``instants`` is a list of ``{"name", "t", "parent", "run", "args"}``
+    for untimed point events. Unknown parent references are kept verbatim
+    (the node simply becomes a root) — a crashed run must still render.
+    """
+    groups: dict[str, list[dict]] = {}
+    order: list[str] = []
+    leaves: list[dict] = []
+    instants: list[dict] = []
+    for i, e in enumerate(events):
+        d = e.get("data") or {}
+        sid = d.get("span")
+        if isinstance(sid, str) and sid:
+            if sid not in groups:
+                groups[sid] = []
+                order.append(sid)
+            groups[sid].append(e)
+        elif _is_num(d.get("s")):
+            leaves.append((f"e{i}", e))
+        else:
+            instants.append({
+                "name": e["ev"],
+                "t": e.get("t"),
+                "parent": d.get("parent"),
+                "run": e.get("run"),
+                "args": {k: v for k, v in d.items() if k not in _META_KEYS},
+            })
+
+    spans: dict[str, dict] = {}
+    for sid in order:
+        evs = groups[sid]
+        closing = None
+        for e in evs:
+            if _is_num((e.get("data") or {}).get("s")):
+                closing = e
+        name = (closing or evs[0])["ev"]
+        for suffix in ("_end", "_start"):
+            if name.endswith(suffix):
+                name = name[: -len(suffix)]
+        parent = None
+        for e in evs:
+            p = (e.get("data") or {}).get("parent")
+            if p is not None:
+                parent = p
+                break
+        args: dict = {}
+        for e in evs:
+            for k, v in (e.get("data") or {}).items():
+                if k not in _META_KEYS:
+                    args.setdefault(k, v)
+        if closing is not None:
+            dur = float(closing["data"]["s"])
+            t_end = float(closing.get("t") or 0.0)
+            t_start = t_end - dur
+        else:  # begin-only span (crashed / still running): zero width
+            dur = 0.0
+            t_start = t_end = float(evs[0].get("t") or 0.0)
+        spans[sid] = {
+            "id": sid, "name": name, "parent": parent,
+            "t_start": t_start, "t_end": t_end, "dur_s": dur,
+            "run": (closing or evs[0]).get("run"),
+            "args": args, "children": [],
+        }
+    for eid, e in leaves:
+        d = e["data"]
+        dur = float(d["s"])
+        t_end = float(e.get("t") or 0.0)
+        spans[eid] = {
+            "id": eid, "name": e["ev"], "parent": d.get("parent"),
+            "t_start": t_end - dur, "t_end": t_end, "dur_s": dur,
+            "run": e.get("run"),
+            "args": {k: v for k, v in d.items() if k not in _META_KEYS},
+            "children": [],
+        }
+    for sid, node in spans.items():
+        p = node["parent"]
+        if p in spans:
+            spans[p]["children"].append(sid)
+
+    def depth(sid, seen=()):
+        node = spans[sid]
+        if "depth" in node:
+            return node["depth"]
+        p = node["parent"]
+        d = 1 if (p not in spans or p in seen) else depth(p, seen + (sid,)) + 1
+        node["depth"] = d
+        return d
+
+    for sid in spans:
+        depth(sid)
+    return spans, instants
+
+
+def build_span_tree_file(path: str) -> tuple[dict, list]:
+    return build_span_tree(read_events(path))
+
+
+# ---------------------------------------------------------------------------
+# Perfetto / Chrome trace-event export
+# ---------------------------------------------------------------------------
+
+
+def render_perfetto(events: Iterable[dict]) -> dict:
+    """Chrome trace-event JSON (``{"traceEvents": [...]}``) from the span
+    tree. Deterministic: stable key order per event (name, ph, ts, dur,
+    pid, tid, args), µs integer timestamps relative to the earliest event,
+    pids assigned per run id in first-appearance order, tid = span depth.
+    Instant events ride as thread-scoped ``"i"`` marks on their parent's
+    row. Purely offline — no backend is touched."""
+    events = list(events)
+    spans, instants = build_span_tree(events)
+    runs: list[str] = []
+    for e in events:
+        r = e.get("run")
+        if r is not None and r not in runs:
+            runs.append(r)
+    pid_of = {r: i + 1 for i, r in enumerate(runs)}
+    ts = [n["t_start"] for n in spans.values()]
+    ts += [i["t"] for i in instants if i["t"] is not None]
+    ts += [float(e["t"]) for e in events if e.get("t") is not None]
+    t_base = min(ts) if ts else 0.0
+
+    def us(t: float) -> int:
+        return int(round((t - t_base) * 1e6))
+
+    out = []
+    for r in runs:
+        out.append({
+            "name": "process_name", "ph": "M", "pid": pid_of[r],
+            "args": {"name": f"run {r}"},
+        })
+    depths = sorted({
+        (pid_of.get(n["run"], 1), n["depth"]) for n in spans.values()
+    })
+    for pid, d in depths:
+        out.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": d,
+            "args": {"name": f"span depth {d}"},
+        })
+    rows = []
+    for sid, n in spans.items():
+        t0 = n["t_start"]
+        if n["name"] in _AT_PARENT_START and n["parent"] in spans:
+            t0 = spans[n["parent"]]["t_start"]
+        rows.append({
+            "name": n["name"], "ph": "X", "ts": us(t0),
+            "dur": int(round(n["dur_s"] * 1e6)),
+            "pid": pid_of.get(n["run"], 1), "tid": n["depth"],
+            "args": {**n["args"], "span": sid},
+        })
+    for i in instants:
+        parent_depth = (
+            spans[i["parent"]]["depth"] if i["parent"] in spans else 0
+        )
+        rows.append({
+            "name": i["name"], "ph": "i",
+            "ts": us(i["t"] if i["t"] is not None else t_base),
+            "pid": pid_of.get(i["run"], 1), "tid": parent_depth + 1,
+            "s": "t", "args": i["args"],
+        })
+    rows.sort(key=lambda r: (r["ts"], r["pid"], r["tid"], r["name"]))
+    return {"traceEvents": out + rows, "displayTimeUnit": "ms"}
+
+
+def write_perfetto(path: str, out_path: str) -> int:
+    """File → file export; returns the number of trace events written."""
+    trace = render_perfetto(read_events(path))
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(trace, f)
+        f.write("\n")
+    return len(trace["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# compile / dispatch / transfer / host time split
+# ---------------------------------------------------------------------------
+
+
+def time_split(events: Iterable[dict]) -> dict | None:
+    """Wall-time attribution over every null run in the stream, defined so
+    the four components sum to the run-span total *exactly*:
+
+    - ``compile_s``  — the loops' end-of-run first-interval estimate
+      (``compile_span`` events), clamped into the measured dispatch time
+      it is a carve-out of;
+    - ``dispatch_s`` — measured host time inside chunk/superchunk
+      dispatches (key derivation + program launch; on synchronous
+      backends this includes device compute), minus the compile carve-out;
+    - ``transfer_s`` — measured device→host pull time (chunk writes /
+      tally pulls; on async backends this includes the device drain);
+    - ``host_s``     — the remainder: python loop, monitor folds,
+      checkpoint writes, progress callbacks.
+
+    Returns None when the stream has no closed null run."""
+    total = dispatch_raw = transfer = compile_raw = 0.0
+    n_runs = 0
+    for e in events:
+        d = e.get("data") or {}
+        if e["ev"] == "null_run_end" and _is_num(d.get("s")):
+            total += float(d["s"])
+            n_runs += 1
+        elif e["ev"] == "dispatch" and _is_num(d.get("s")):
+            dispatch_raw += float(d["s"])
+        elif e["ev"] == "compile_span" and _is_num(d.get("s")):
+            compile_raw += float(d["s"])
+        if _is_num(d.get("transfer_s")):
+            transfer += float(d["transfer_s"])
+    if not n_runs:
+        return None
+    compile_s = min(compile_raw, dispatch_raw)
+    host = max(total - dispatch_raw - transfer, 0.0)
+    return {
+        "n_runs": n_runs,
+        "total_s": total,
+        "compile_s": compile_s,
+        "dispatch_s": dispatch_raw - compile_s,
+        "transfer_s": transfer,
+        "host_s": host,
+    }
+
+
+def render_time_split(path: str) -> str:
+    """Human rendering of :func:`time_split` for the ``telemetry`` CLI
+    report; empty string when the log holds no closed null run."""
+    split = time_split(read_events(path))
+    if split is None:
+        return ""
+    total = split["total_s"] or 1.0
+    lines = [
+        f"time split over {split['n_runs']} null run(s) "
+        f"({split['total_s']:.3f}s total):"
+    ]
+    for k in ("compile_s", "dispatch_s", "transfer_s", "host_s"):
+        lines.append(
+            f"  {k[:-2]:<9} {split[k]:>10.3f}s  "
+            f"{100.0 * split[k] / total:5.1f}%"
+        )
+    return "\n".join(lines)

@@ -306,6 +306,44 @@ def test_bench_config_d_resumes_from_checkpoint():
 
 
 @pytest.mark.slow
+def test_bench_probe_fields_and_perf_ledger(tmp_path):
+    """ISSUE 5 satellites: every metric row carries the structured
+    backend-probe record (the round-5 120 s silent probe hang was prose
+    only), the bench path emits its own ``backend_probe`` telemetry
+    event, and a row with ``perms_per_sec`` feeds the perf ledger beside
+    the engine loop's own entry."""
+    ledger = str(tmp_path / "led.jsonl")
+    tel = str(tmp_path / "tel.jsonl")
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke", "--telemetry", tel],
+        cwd=REPO,
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "NETREP_PERF_LEDGER": ledger,
+            "JAX_COMPILATION_CACHE_DIR": os.path.join(
+                REPO, ".jax_cache", _fp()
+            ),
+        },
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["probe_outcome"] == "explicit_platform"
+    assert isinstance(row["probe_s"], float)
+    assert "fallback_reason" not in row  # CPU was explicit, not a fallback
+    from netrep_tpu.utils import perfledger
+
+    sources = {e["source"] for e in perfledger.read_entries(ledger)}
+    assert sources == {"run", "bench"}
+    ok, report = perfledger.check(ledger)
+    assert ok, report
+    probes = [json.loads(l) for l in open(tel)
+              if '"backend_probe"' in l]
+    assert any(p["data"].get("source") == "bench" for p in probes)
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("flags", CASES, ids=lambda f: " ".join(f) or "default")
 def test_bench_smoke_combination(flags):
     # --smoke clobbers --genes/--modules/--perms; cases that exercise the

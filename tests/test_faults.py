@@ -356,13 +356,16 @@ def test_hang_abandon_in_real_null_loop(eng, observed, baselines, tmp_path):
     by_ev = {}
     for e in map(json.loads, open(path)):
         by_ev.setdefault(e["ev"], e["data"])
+    # ISSUE 5: recovery events fired inside a chunk dispatch now carry a
+    # `parent` pointing at that chunk's span — additive, schema unchanged
     assert set(by_ev["fault_injected"]) == {
-        "kind", "at_perm", "start", "take", "label"}
+        "kind", "at_perm", "start", "take", "label", "parent"}
     assert set(by_ev["chunk_abandoned"]) == {
-        "start", "take", "waited_s", "by", "abandons", "label"}
+        "start", "take", "waited_s", "by", "abandons", "label", "parent"}
     assert set(by_ev["retry_attempt"]) == {
         "start", "take", "attempt", "max_retries", "delay_s", "error",
-        "label"}
+        "label", "parent"}
+    assert by_ev["fault_injected"]["parent"] == by_ev["retry_attempt"]["parent"]
 
 
 # ---------------------------------------------------------------------------
@@ -475,6 +478,75 @@ def test_device_loss_degrades_to_cpu_via_module_preservation(
         if e["ev"] == "checkpoint_saved"
     ]
     assert ck_paths and not any(os.path.exists(p) for p in ck_paths)
+
+
+def test_degraded_rebuild_accepts_fingerprint_mismatch(tmp_path, caplog):
+    """ISSUE 5, closing the PR 4 known gap: a row-sharded run whose device
+    dies mid-null degrades to a REPLICATED CPU rebuild whose padded-matrix
+    fingerprint no longer matches the checkpoint — the mismatch is now
+    accepted explicitly (``fingerprint_degraded_accept`` event + one
+    logger warning) and the resume still completes bit-identically.
+    Gene count 122 is deliberately not divisible by the 4 row shards, so
+    the sharded engine pads to 124 and the fingerprints genuinely differ."""
+    pytest.importorskip("jax")
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the conftest 8-device CPU mesh")
+    from netrep_tpu import module_preservation
+    from netrep_tpu.parallel import mesh as meshmod
+
+    mixed = make_mixed_pair(122, 3, n_samples=16, seed=7)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    assign = {f"node_{i}": "0" for i in range(dn.shape[0])}
+    for lab, idx in mixed["specs"]:
+        for i in idx:
+            assign[f"node_{i}"] = str(lab)
+    kw = dict(
+        network={"d": dn, "t": tn}, correlation={"d": dc, "t": tc},
+        data={"d": dd, "t": td}, module_assignments=assign,
+        discovery="d", test="t", n_perm=64, seed=0,
+    )
+    base = module_preservation(**kw, config=EngineConfig(chunk_size=16))
+    path = str(tmp_path / "degfp.jsonl")
+    res = module_preservation(
+        **kw, telemetry=path,
+        mesh=meshmod.make_mesh(n_perm_shards=2, n_row_shards=4),
+        config=EngineConfig(chunk_size=16, matrix_sharding="row"),
+        fault_policy=FaultPolicy(plan="device_lost@32", backoff_base_s=0.0,
+                                 backoff_jitter=0.0),
+    )
+    assert res.completed == 64
+    np.testing.assert_array_equal(base.nulls, res.nulls)
+    np.testing.assert_array_equal(base.p_values, res.p_values)
+    evs = [e["ev"] for e in map(json.loads, open(path))]
+    assert evs.count("fingerprint_degraded_accept") == 1
+    assert (evs.index("degraded_to_cpu")
+            < evs.index("fingerprint_degraded_accept")
+            < evs.index("checkpoint_resumed"))
+    acc = next(e for e in map(json.loads, open(path))
+               if e["ev"] == "fingerprint_degraded_accept")
+    assert acc["data"]["reason"] == "device_lost"
+    assert "accepting the resume" in caplog.text
+
+
+def test_fingerprint_mismatch_still_refuses_outside_degraded_scope(tmp_path):
+    """The acceptance is scoped to the degraded rebuild only: a plain
+    mismatch (no accept scope) still refuses to resume."""
+    from netrep_tpu.utils.checkpoint import (
+        accept_degraded_fingerprint, validate_identity,
+    )
+
+    ck = {"fingerprint": np.frombuffer(b"old", dtype=np.uint8),
+          "key_data": np.zeros(2, np.uint32), "completed": 8}
+    new_fp = np.frombuffer(b"new", dtype=np.uint8)
+    with pytest.raises(ValueError, match="different problem"):
+        validate_identity(ck, np.zeros(2, np.uint32), new_fp, "p")
+    with accept_degraded_fingerprint("device_lost"):
+        validate_identity(ck, np.zeros(2, np.uint32), new_fp, "p")
+    # the scope has exited: refusal is back
+    with pytest.raises(ValueError, match="different problem"):
+        validate_identity(ck, np.zeros(2, np.uint32), new_fp, "p")
 
 
 # ---------------------------------------------------------------------------

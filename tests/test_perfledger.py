@@ -1,0 +1,177 @@
+"""Perf-regression ledger (ISSUE 5): entry golden shape, append/read
+tolerance, the regression check (including the acceptance contract: the
+ingested BENCH_r01–r05 history passes, a synthetically degraded entry
+fails with a non-zero CLI exit), and the env-gated engine-loop feed.
+Backend-free throughout — the ledger must work on a box whose tunnel is
+dead."""
+
+import json
+import os
+
+import pytest
+
+from netrep_tpu.__main__ import main as cli_main
+from netrep_tpu.utils import perfledger as pl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_FILES = [os.path.join(REPO, f"BENCH_r0{i}.json") for i in range(1, 6)]
+
+
+def _entry(pps, fp="cpu|direct|caps:16x3|chunk:32", **kw):
+    kw.setdefault("t", 0.0)
+    return pl.make_entry(fp, pps, "run", backend="cpu", mode="materialized",
+                         **kw)
+
+
+# ---------------------------------------------------------------------------
+# entry shape + IO
+# ---------------------------------------------------------------------------
+
+def test_entry_golden_shape():
+    """Pinned key order + version of a ledger line — the parse surface of
+    summarize_watch.py and any downstream dashboard."""
+    e = pl.make_entry("fp", 123.4567899, "bench", backend="cpu",
+                      mode="bench", compile_s=1.23456, n_perm=100,
+                      run_id="r", round_n=3, metric="m", t=7.0)
+    assert list(e) == ["perf_v", "t", "source", "round", "run",
+                       "fingerprint", "backend", "mode", "perms_per_sec",
+                       "compile_s", "n_perm", "metric"]
+    assert e["perf_v"] == pl.ENTRY_VERSION == 1
+    assert e["perms_per_sec"] == 123.4568 and e["compile_s"] == 1.2346
+
+
+def test_append_read_skips_foreign_lines(tmp_path):
+    path = str(tmp_path / "led.jsonl")
+    with open(path, "w") as f:
+        f.write("# comment\n")
+        f.write(json.dumps({"metric": "bench row", "value": 1}) + "\n")
+        f.write(json.dumps({"v": 1, "ev": "chunk", "data": {}}) + "\n")
+        f.write("{broken json\n")
+    assert pl.append_entry(_entry(10.0), path)
+    rows = pl.read_entries(path)
+    assert len(rows) == 1 and rows[0]["perms_per_sec"] == 10.0
+
+
+def test_append_unwritable_warns_not_raises(tmp_path):
+    # a FILE in the directory position makes the path unwritable even for
+    # root (the suite runs as root, so permission bits alone don't block)
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")
+    assert pl.append_entry(_entry(1.0), str(blocker / "led.jsonl")) is False
+
+
+# ---------------------------------------------------------------------------
+# regression check
+# ---------------------------------------------------------------------------
+
+def test_check_empty_and_baseline(tmp_path):
+    path = str(tmp_path / "led.jsonl")
+    open(path, "w").close()
+    ok, rep = pl.check(path)
+    assert ok and "no entries" in rep
+    pl.append_entry(_entry(100.0), path)
+    ok, rep = pl.check(path)
+    assert ok and "baseline" in rep
+
+
+def test_check_flags_regression_and_respects_fingerprint(tmp_path):
+    path = str(tmp_path / "led.jsonl")
+    for v in (100.0, 110.0, 90.0, 105.0):
+        pl.append_entry(_entry(v), path)
+    ok, rep = pl.check(path)
+    assert ok
+    # a different fingerprint's slow entry is a new baseline, NOT judged
+    # against the fast history (a CPU-fallback row never compares to TPU)
+    pl.append_entry(_entry(5.0, fp="tpu|other"), path)
+    ok, rep = pl.check(path)
+    assert ok and "baseline" in rep
+    # same fingerprint, 2x regression -> fail
+    pl.append_entry(_entry(40.0), path)
+    ok, rep = pl.check(path)
+    assert not ok and "PERF REGRESSION" in rep
+    # threshold is honored
+    ok, _ = pl.check(path, threshold=0.7)
+    assert ok
+
+
+def test_trend_renders_all_fingerprints(tmp_path):
+    path = str(tmp_path / "led.jsonl")
+    pl.append_entry(_entry(100.0), path)
+    pl.append_entry(_entry(5.0, fp="tpu|other"), path)
+    out = pl.trend(path)
+    assert "2 entries, 2 fingerprint(s)" in out
+    assert "tpu|other" in out
+
+
+# ---------------------------------------------------------------------------
+# engine-loop feed (env-gated)
+# ---------------------------------------------------------------------------
+
+def test_maybe_record_run_env_gated(tmp_path, monkeypatch):
+    monkeypatch.delenv(pl.LEDGER_ENV, raising=False)
+    assert not pl.maybe_record_run("fp", 10.0, "materialized", "cpu")
+    path = str(tmp_path / "led.jsonl")
+    monkeypatch.setenv(pl.LEDGER_ENV, path)
+    assert pl.maybe_record_run("fp", 10.0, "materialized", "cpu",
+                               compile_s=0.5, n_perm=64, run_id="r1")
+    (row,) = pl.read_entries(path)
+    assert row["source"] == "run" and row["run"] == "r1"
+    # zero/negative throughput is never recorded
+    assert not pl.maybe_record_run("fp", 0.0, "materialized", "cpu")
+
+
+def test_bench_row_fingerprint_splits_backend_class():
+    tpu = pl.bench_fingerprint({
+        "metric": "wall-clock for 10000-perm null (north-star)",
+        "device": "TPU_0(process=0)", "chunk": 256, "dtype": "float32"})
+    cpu = pl.bench_fingerprint({
+        "metric": "wall-clock for 10000-perm null [CPU fallback: dead]",
+        "device": "TFRT_CPU_0", "chunk": 256, "dtype": "float32"})
+    assert tpu != cpu
+    # the config-note/fallback suffix is stripped: same base metric
+    assert tpu.split("|")[1] == cpu.split("|")[1]
+    assert pl.entry_from_bench_row({"metric": "x", "warning": "w"}) is None
+
+
+# ---------------------------------------------------------------------------
+# BENCH_r0* ingestion + CLI (the acceptance contract)
+# ---------------------------------------------------------------------------
+
+def test_ingest_bench_history_then_check_passes(tmp_path, capsys):
+    """`perf --check` passes on the ingested BENCH_r01–r05 trajectory and
+    exits 2 on a synthetically degraded entry — five PRs of history become
+    a CI gate."""
+    path = str(tmp_path / "led.jsonl")
+    n = pl.ingest_bench_files(BENCH_FILES, path)
+    assert n >= 4  # r01 TPU row + the CPU-fallback rows of r02..r05
+    rows = pl.read_entries(path)
+    assert all(r["source"] == "ingest" for r in rows)
+    assert [r["round"] for r in rows] == sorted(r["round"] for r in rows)
+    # distinct histories: the r01 TPU row must not share a fingerprint
+    # with the CPU-fallback rows
+    assert len({r["fingerprint"] for r in rows}) >= 2
+    assert cli_main(["perf", path, "--check"]) == 0
+    # synthetically degraded entry: 10x below the CPU history's median
+    med = sorted(float(r["perms_per_sec"]) for r in rows
+                 if r["backend"] == "cpu")[0]
+    pl.append_entry(_entry(med / 10.0,
+                           fp=[r for r in rows
+                               if r["backend"] == "cpu"][-1]["fingerprint"]),
+                    path)
+    assert cli_main(["perf", path, "--check"]) == 2
+    out = capsys.readouterr().out
+    assert "PERF REGRESSION" in out
+
+
+def test_cli_ingest_and_trend(tmp_path, capsys):
+    path = str(tmp_path / "led.jsonl")
+    assert cli_main(["perf", path, "--ingest", BENCH_FILES[0],
+                     BENCH_FILES[4]]) == 0
+    out = capsys.readouterr().out
+    assert "ingested" in out
+    assert cli_main(["perf", path]) == 0
+    assert "fingerprint(s)" in capsys.readouterr().out
+
+
+def test_cli_missing_ledger_errors(tmp_path, capsys):
+    assert cli_main(["perf", str(tmp_path / "absent.jsonl")]) == 1

@@ -254,6 +254,7 @@ def test_recovery_event_names_pinned():
         "stall_recovered",
         "device_lost",
         "degraded_to_cpu",
+        "fingerprint_degraded_accept",
         "backend_fallback",
         "distributed_autodetect_failed",
     )

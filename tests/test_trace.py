@@ -1,0 +1,230 @@
+"""Hierarchical trace spans + Perfetto export (ISSUE 5): span-tree
+reconstruction, golden-shape Chrome trace JSON, the compile/dispatch/
+transfer/host time split, and span-tree determinism under the
+fault-injection harness. Everything runs on CPU (the exporter itself is
+pure-offline and touches no backend at all)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from netrep_tpu.data import make_mixed_pair
+from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+from netrep_tpu.utils.config import EngineConfig, FaultPolicy
+from netrep_tpu.utils.telemetry import Telemetry
+from netrep_tpu.utils.trace import (
+    build_span_tree, render_perfetto, time_split, write_perfetto,
+)
+
+
+def _ev(t, ev, run="r1", **data):
+    return {"v": 1, "t": t, "m": t - 100.0, "run": run, "ev": ev,
+            "data": data}
+
+
+#: hand-written stream covering every exporter branch: a begin/end span
+#: pair (null_run), a closed child span (chunk), a timed leaf without a
+#: span id (dispatch), an instant (retry_attempt), and an end-of-run
+#: compile_span estimate that must render at its PARENT's start
+SYNTH = [
+    _ev(100.0, "null_run_start", span="s1", mode="materialized"),
+    _ev(100.5, "dispatch", parent="s2", s=0.4, start=0, take=16),
+    _ev(100.6, "retry_attempt", parent="s2", attempt=1),
+    _ev(100.7, "chunk", span="s2", parent="s1", s=0.6, take=16),
+    _ev(101.0, "compile_span", parent="s1", s=0.3, key="k1"),
+    _ev(101.0, "null_run_end", span="s1", s=1.0, completed=16),
+]
+
+
+# ---------------------------------------------------------------------------
+# span-tree reconstruction
+# ---------------------------------------------------------------------------
+
+def test_build_span_tree_pairs_and_nests():
+    spans, instants = build_span_tree(SYNTH)
+    # s1 closed by null_run_end, s2 by chunk, dispatch + compile_span are
+    # synthetic-id leaves; retry_attempt is the lone instant
+    assert set(spans) == {"s1", "s2", "e1", "e4"}
+    s1, s2 = spans["s1"], spans["s2"]
+    assert s1["name"] == "null_run" and s1["parent"] is None
+    assert s1["t_start"] == pytest.approx(100.0) and s1["dur_s"] == 1.0
+    assert s2["name"] == "chunk" and s2["parent"] == "s1"
+    assert s2["t_start"] == pytest.approx(100.1)  # 100.7 - 0.6
+    assert spans["e1"]["name"] == "dispatch"
+    assert spans["e1"]["parent"] == "s2"
+    assert s1["children"] == ["s2", "e4"] and s2["children"] == ["e1"]
+    assert (s1["depth"], s2["depth"], spans["e1"]["depth"]) == (1, 2, 3)
+    assert len(instants) == 1
+    assert instants[0]["name"] == "retry_attempt"
+    assert instants[0]["parent"] == "s2"
+
+
+def test_begin_only_span_renders_zero_width():
+    """A crashed run's unclosed span must still render (zero width at its
+    begin time), never raise."""
+    spans, _ = build_span_tree([SYNTH[0]])
+    assert spans["s1"]["dur_s"] == 0.0
+    assert spans["s1"]["t_start"] == spans["s1"]["t_end"] == 100.0
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export: golden shape
+# ---------------------------------------------------------------------------
+
+def test_perfetto_golden_shape():
+    """Pinned export contract (ISSUE 5 acceptance): stable per-event key
+    order, µs integer ts/dur relative to the earliest event, pid = run in
+    first-appearance order, tid = span depth, compile_span at parent
+    start, instants on the parent's child row."""
+    doc = render_perfetto(SYNTH)
+    assert list(doc) == ["traceEvents", "displayTimeUnit"]
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert meta[0] == {"name": "process_name", "ph": "M", "pid": 1,
+                      "args": {"name": "run r1"}}
+    assert {(m["pid"], m["tid"]) for m in meta if m["name"] == "thread_name"
+            } == {(1, 1), (1, 2), (1, 3)}
+    xs = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert set(xs) == {"null_run", "chunk", "dispatch", "compile_span"}
+    for e in xs.values():
+        # pinned key order — the golden shape downstream viewers rely on
+        assert list(e) == ["name", "ph", "ts", "dur", "pid", "tid", "args"]
+        assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
+    assert xs["null_run"] == {
+        "name": "null_run", "ph": "X", "ts": 0, "dur": 1_000_000,
+        "pid": 1, "tid": 1, "args": {"mode": "materialized",
+                                     "completed": 16, "span": "s1"},
+    }
+    assert xs["chunk"]["ts"] == 100_000 and xs["chunk"]["dur"] == 600_000
+    assert xs["chunk"]["tid"] == 2
+    assert xs["dispatch"]["ts"] == 100_000  # 100.5 - 0.4s, in µs
+    assert xs["dispatch"]["tid"] == 3
+    # the compile estimate is emitted at run END but renders at the run
+    # span's START (compile happens first)
+    assert xs["compile_span"]["ts"] == 0
+    assert xs["compile_span"]["dur"] == 300_000
+    inst = [e for e in evs if e["ph"] == "i"]
+    assert len(inst) == 1 and inst[0]["name"] == "retry_attempt"
+    assert inst[0]["tid"] == 3  # parent chunk's depth + 1
+
+
+def test_write_perfetto_round_trips(tmp_path):
+    src = tmp_path / "run.jsonl"
+    with open(src, "w") as f:
+        for e in SYNTH:
+            f.write(json.dumps(e) + "\n")
+    out = tmp_path / "trace.json"
+    n = write_perfetto(str(src), str(out))
+    doc = json.load(open(out))
+    assert n == len(doc["traceEvents"]) > 0
+
+
+# ---------------------------------------------------------------------------
+# time split
+# ---------------------------------------------------------------------------
+
+def test_time_split_sums_to_total():
+    split = time_split(SYNTH)
+    assert split["n_runs"] == 1 and split["total_s"] == 1.0
+    # compile (0.3) is a carve-out of the measured dispatch time (0.4)
+    assert split["compile_s"] == pytest.approx(0.3)
+    assert split["dispatch_s"] == pytest.approx(0.1)
+    total = (split["compile_s"] + split["dispatch_s"] + split["transfer_s"]
+             + split["host_s"])
+    assert total == pytest.approx(split["total_s"], rel=1e-9)
+
+
+def test_time_split_none_without_runs():
+    assert time_split([SYNTH[1]]) is None
+
+
+# ---------------------------------------------------------------------------
+# real-run round trip + determinism under the fault harness
+# ---------------------------------------------------------------------------
+
+CFG = EngineConfig(chunk_size=16, summary_method="eigh", autotune=False)
+N_PERM = 64
+
+
+@pytest.fixture(scope="module")
+def eng():
+    mixed = make_mixed_pair(120, 3, n_samples=16, seed=7)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    return PermutationEngine(
+        dc, dn, dd, tc, tn, td, specs, mixed["pool"], config=CFG
+    )
+
+
+def _tree_shape(path):
+    """Structure of a run's span tree, stripped of timing: (ev, span,
+    parent) triples in emit order — the determinism invariant."""
+    out = []
+    for e in map(json.loads, open(path)):
+        d = e["data"]
+        out.append((e["ev"], d.get("span"), d.get("parent")))
+    return out
+
+
+def test_real_run_round_trip(eng, tmp_path):
+    """Acceptance: a telemetry-enabled CPU run round-trips JSONL → span
+    tree → Perfetto with every chunk/dispatch event owned by exactly one
+    parent span, and the time split sums to the run total within 1%."""
+    path = tmp_path / "run.jsonl"
+    tel = Telemetry(path, run_id="rt")
+    nulls, done = eng.run_null(N_PERM, key=0, telemetry=tel)
+    tel.close()
+    assert done == N_PERM
+    events = [e for e in map(json.loads, open(path))]
+    spans, instants = build_span_tree(events)
+    roots = [s for s in spans.values() if s["parent"] not in spans]
+    assert [r["name"] for r in roots] == ["null_run"]
+    for e in events:
+        if e["ev"] in ("chunk", "dispatch", "retry_attempt"):
+            p = e["data"].get("parent")
+            assert p in spans, f"{e['ev']} not owned by a known span"
+    # 64 perms / 16 chunk = 4 chunks, each with its own dispatch leaf
+    assert sum(1 for s in spans.values() if s["name"] == "chunk") == 4
+    assert sum(1 for s in spans.values() if s["name"] == "dispatch") == 4
+    assert sum(1 for s in spans.values() if s["name"] == "compile_span") == 1
+    split = time_split(events)
+    parts = (split["compile_s"] + split["dispatch_s"] + split["transfer_s"]
+             + split["host_s"])
+    assert abs(parts - split["total_s"]) <= 0.01 * split["total_s"]
+    out = tmp_path / "trace.json"
+    assert write_perfetto(str(path), str(out)) == len(
+        json.load(open(out))["traceEvents"])
+
+
+def test_span_tree_deterministic_under_faults(eng, tmp_path):
+    """Two identical runs under the same injected-fault plan produce the
+    SAME span tree — ids are a per-bus counter, not UUIDs — and retries
+    nest under their chunk's span."""
+    shapes = []
+    for i in range(2):
+        path = tmp_path / f"fault{i}.jsonl"
+        tel = Telemetry(path, run_id="det")
+        pol = FaultPolicy(plan="transient@16x2;transient@48",
+                          backoff_base_s=0.0, backoff_jitter=0.0)
+        nulls, done = eng.run_null(
+            N_PERM, key=0, telemetry=tel, fault_policy=pol
+        )
+        tel.close()
+        assert done == N_PERM
+        shapes.append(_tree_shape(path))
+    assert shapes[0] == shapes[1]
+    # every retry/fault event nests under the chunk span that owned the
+    # dispatch it fired in
+    events = [e for e in map(json.loads, open(tmp_path / "fault0.jsonl"))]
+    spans, _ = build_span_tree(events)
+    chunk_span_of = {}  # dispatch start -> chunk span id
+    for e in events:
+        if e["ev"] == "dispatch":
+            chunk_span_of[e["data"]["start"]] = e["data"]["parent"]
+    n_checked = 0
+    for e in events:
+        if e["ev"] in ("fault_injected", "retry_attempt"):
+            assert e["data"]["parent"] == chunk_span_of[e["data"]["start"]]
+            n_checked += 1
+    assert n_checked == 6  # 3 faults + 3 retries
