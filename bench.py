@@ -909,6 +909,158 @@ def bench_superchunk(args):
     })
 
 
+def bench_multichip_child(args):
+    """One multichip scaling point (spawned by :func:`bench_multichip`):
+    build an ``--devices``-wide permutation mesh and measure a real null
+    on it. On CPU-class backends the devices are the virtual host
+    platform (``--xla_force_host_platform_device_count``, set here BEFORE
+    jax initializes); on a live accelerator backend the first N real
+    devices. The metric label carries the mesh size (``multichip xN``),
+    so the perf ledger's bench fingerprint splits per mesh size and
+    ``perf --check`` never compares a 1-device history against a 4-device
+    one."""
+    import os
+
+    n = args.devices
+    resolve(args, 1000, 8, 2048)
+    use_cpu = (
+        "axon" not in os.environ.get("JAX_PLATFORMS", "")
+        or os.environ.get("NETREP_MULTICHIP_CPU")
+    )
+    if use_cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        ensure_backend()
+        import jax
+    devs = jax.devices()[:n]
+    if len(devs) < n:
+        return emit({
+            "metric": f"multichip x{n}",
+            "error": f"only {len(devs)} device(s) available",
+            "n_devices": n,
+        })
+
+    from netrep_tpu.parallel.engine import PermutationEngine
+    from netrep_tpu.parallel.mesh import make_mesh
+    from netrep_tpu.utils.config import EngineConfig
+
+    (d_data, d_corr, d_net), (t_data, t_corr, t_net) = build_problem(
+        args.genes, args.modules, args.samples
+    )
+    specs = make_specs_auto(args.genes, args.modules)
+    pool = np.arange(args.genes, dtype=np.int32)
+    # chunk must divide by the perm axis; keep the per-device share equal
+    # across mesh sizes so the rows measure scaling, not chunk effects
+    chunk = max(args.chunk, n) // n * n
+    cfg = EngineConfig(chunk_size=chunk, summary_method="power",
+                       power_iters=40, dtype=args.dtype, autotune=False)
+    mesh = (
+        make_mesh(n_perm_shards=n, n_row_shards=1, devices=devs)
+        if n > 1 else None  # the 1-device baseline is the plain engine
+    )
+    engine = PermutationEngine(
+        d_corr, d_net, d_data, t_corr, t_net, t_data, specs, pool,
+        config=cfg, mesh=mesh,
+    )
+    elapsed = timed_null(engine, args.perms, chunk)
+    return emit({
+        "metric": f"multichip x{n}",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "n_devices": n,
+        "perms_per_sec": round(args.perms / elapsed, 2),
+        "genes": args.genes, "modules": args.modules,
+        "n_perm": args.perms, "chunk": chunk, "dtype": args.dtype,
+        "device": str(devs[0]),
+    })
+
+
+def bench_multichip(args):
+    """Real 1→N-device scaling rows (ISSUE 6 satellite — replaces the
+    MULTICHIP_r0*.json stub trajectory): one child process per mesh size
+    (the device count must be fixed before jax initializes, so every
+    point needs a fresh process), each emitting a measured ``multichip
+    xN`` row; this parent relays the rows verbatim (children already fed
+    the perf ledger — re-emitting would double-append) and closes with
+    one ``multichip scaling`` summary row carrying perms/s and parallel
+    efficiency vs the 1-device baseline."""
+    import os
+    import subprocess
+
+    max_n = args.max_devices
+    if max_n is None:
+        max_n = int(os.environ.get("NETREP_MULTICHIP_MAX", "4"))
+    counts = [1]
+    while counts[-1] * 2 <= max_n:
+        counts.append(counts[-1] * 2)
+    rows = []
+    for n in counts:
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--config", "multichip", "--devices", str(n)]
+        for flag, val in (("--genes", args.genes), ("--modules", args.modules),
+                          ("--perms", args.perms), ("--samples", args.samples)):
+            if val is not None:
+                cmd += [flag, str(val)]
+        cmd += ["--chunk", str(args.chunk), "--dtype", args.dtype]
+        if args.smoke:
+            cmd += ["--smoke"]
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=1800,
+                env={**os.environ, "NETREP_BENCH_NO_SUBPROC": "1"},
+            )
+        except subprocess.TimeoutExpired:
+            rows.append({"metric": f"multichip x{n}", "n_devices": n,
+                         "error": "timed out"})
+            print(json.dumps(rows[-1]))
+            continue
+        row = None
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    cand = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if cand.get("metric", "").startswith("multichip"):
+                    row = cand
+        if row is None:
+            row = {"metric": f"multichip x{n}", "n_devices": n,
+                   "error": (proc.stderr or "no row emitted")[-400:]}
+        rows.append(row)
+        print(json.dumps(row))  # relay, don't re-emit (ledger already fed)
+    base_pps = next(
+        (r.get("perms_per_sec") for r in rows
+         if r.get("n_devices") == 1 and r.get("perms_per_sec")), None
+    )
+    scaling = []
+    for r in rows:
+        pps = r.get("perms_per_sec")
+        scaling.append({
+            "n_devices": r.get("n_devices"),
+            "perms_per_sec": pps,
+            "efficiency": (
+                round(pps / (base_pps * r["n_devices"]), 3)
+                if pps and base_pps else None
+            ),
+            **({"error": r["error"]} if "error" in r else {}),
+        })
+    # summary row carries no top-level perms_per_sec → no ledger entry
+    # (each point already appended under its own per-mesh-size fingerprint)
+    return emit({
+        "metric": f"multichip scaling 1..{counts[-1]} devices",
+        "rows": scaling,
+        "device_counts": counts,
+    })
+
+
 def run_shielded(args):
     """Round-2's failure mode, second line of defense: a tunnel death
     MID-RUN leaves device calls blocked in gRPC with no deadline — the
@@ -998,7 +1150,16 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="north",
                     choices=["north", "A", "B", "C", "D", "E", "oracle",
-                             "native", "sharded", "adaptive", "superchunk"])
+                             "native", "sharded", "adaptive", "superchunk",
+                             "multichip"])
+    ap.add_argument("--devices", type=int, default=None,
+                    help="multichip child marker: measure ONE scaling "
+                         "point on this many devices (the parent spawns "
+                         "one child per mesh size)")
+    ap.add_argument("--max-devices", type=int, default=None,
+                    help="multichip: largest mesh size to measure "
+                         "(default $NETREP_MULTICHIP_MAX or 4; points are "
+                         "powers of two)")
     ap.add_argument("--genes", type=int, default=None)
     ap.add_argument("--modules", type=int, default=None)
     ap.add_argument("--perms", type=int, default=None)
@@ -1073,6 +1234,13 @@ def main():
         _TEL_CM.__enter__()
         atexit.register(_tel.close)
 
+    if args.config == "multichip":
+        # the child measures; the parent only spawns and relays — device
+        # counts must be fixed before jax initializes, so neither path
+        # goes through ensure_backend() here (the child decides itself)
+        if args.devices is not None:
+            return bench_multichip_child(args)
+        return bench_multichip(args)
     if args.config == "sharded":
         # dispatch BEFORE ensure_backend(): libtpu is exclusive per process,
         # so the parent must not acquire the chip the child needs

@@ -107,8 +107,41 @@ probe() {
   timeout "$PROBE_TIMEOUT" python -c "import jax; jax.devices()" >/dev/null 2>&1
 }
 
+# Elastic chaos drill (ISSUE 6): once per watch cycle, a CPU-only
+# deterministic drill proves the whole recovery ladder still works —
+# partial device loss → mesh shrink → capacity restored → mesh grow-back
+# — bit-identically, and logs the recovery timeline. Runs regardless of
+# tunnel state (it never touches the TPU) so a dead tunnel window still
+# produces a useful robustness signal. ELASTIC_DRILL=0 disables;
+# ELASTIC_PLAN overrides the injected plan.
+# Default 'auto': on in production, off under the QUEUE_FILE test hook
+# (the state-machine tests run with second-scale timeouts); set
+# ELASTIC_DRILL=1/0 to force either way.
+ELASTIC_DRILL=${ELASTIC_DRILL:-auto}
+ELASTIC_PLAN=${ELASTIC_PLAN:-device_lost_partial@24;capacity_restored@40}
+elastic_drill() {
+  case "$ELASTIC_DRILL" in
+    0) return 0 ;;
+    auto) [ -n "${QUEUE_FILE:-}" ] && return 0 ;;
+  esac
+  echo "--- elastic drill ($(date -u +%FT%TZ)) ---" | tee -a "$LOG"
+  : > "$TELEMETRY.elastic"   # fresh timeline per cycle
+  if timeout 600 env JAX_PLATFORMS=cpu \
+       XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+       NETREP_FAULT_PLAN="$ELASTIC_PLAN" \
+       python -m netrep_tpu chaos --telemetry "$TELEMETRY.elastic" \
+       >>"$LOG" 2>&1; then
+    # the timeline of what the drill survived, via the offline CLI
+    timeout 60 python -m netrep_tpu telemetry "$TELEMETRY.elastic" \
+      --recovery 2>/dev/null | tee -a "$LOG" >/dev/null
+  else
+    echo "--- ELASTIC DRILL FAILED (recovery ladder regressed?) ---" | tee -a "$LOG"
+  fi
+}
+
 echo "== watcher start $(date -u +%FT%TZ) (log=$LOG state=$STATE) ==" | tee -a "$LOG"
 while :; do
+  elastic_drill
   # drained first: with a cutoff set, an empty queue would otherwise be
   # reported as "no step can finish before cutoff" (review r5 — the test
   # harness caught the misleading exit line)

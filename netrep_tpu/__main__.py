@@ -43,6 +43,97 @@ def _positive(value: str) -> int:
     return n
 
 
+def _chaos(args) -> int:
+    """The ``chaos`` subcommand: a deterministic elastic-recovery drill
+    (ISSUE 6). Injects the fault plan into a toy preservation run on a
+    small permutation mesh, verifies the recovered result is BIT-IDENTICAL
+    to the unfaulted baseline, and prints the recovery timeline — the
+    one-liner ``tpu_watch.sh`` runs every cycle and CI can gate on. Exit
+    codes: 0 drill passed, 1 parity failed or the run did not recover."""
+    import os
+    import tempfile
+
+    plan = args.plan or os.environ.get("NETREP_FAULT_PLAN") or (
+        "device_lost_partial@24;capacity_restored@40"
+    )
+    # the baseline below must run UNFAULTED: the env var would otherwise
+    # activate injection for it too (resolve_runtime's env activation)
+    os.environ.pop("NETREP_FAULT_PLAN", None)
+
+    from netrep_tpu.utils.backend import resolve_backend_or_cpu
+
+    resolve_backend_or_cpu()
+    import numpy as np
+
+    import jax
+
+    from netrep_tpu import module_preservation
+    from netrep_tpu.data import make_mixed_pair
+    from netrep_tpu.parallel.mesh import make_mesh
+    from netrep_tpu.utils.config import EngineConfig, FaultPolicy
+    from netrep_tpu.utils.telemetry import render_recovery
+
+    n_dev = args.devices or min(4, len(jax.devices()))
+    mixed = make_mixed_pair(120, 3, n_samples=16, seed=7)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    assign = {f"node_{i}": "0" for i in range(dn.shape[0])}
+    for lab, idx in mixed["specs"]:
+        for i in idx:
+            assign[f"node_{i}"] = str(lab)
+    kw = dict(
+        network={"d": dn, "t": tn}, correlation={"d": dc, "t": tc},
+        data={"d": dd, "t": td}, module_assignments=assign,
+        discovery="d", test="t", n_perm=args.n_perm, seed=0,
+        config=EngineConfig(chunk_size=16, superchunk=2, autotune=False),
+    )
+    base = module_preservation(**kw)
+    tel_path = args.telemetry
+    tmp = None
+    if tel_path is None:
+        fd, tmp = tempfile.mkstemp(suffix=".jsonl", prefix="netrep_chaos_")
+        os.close(fd)
+        tel_path = tmp
+    try:
+        res = module_preservation(
+            **kw, telemetry=tel_path,
+            mesh=make_mesh(n_perm_shards=n_dev, n_row_shards=1)
+            if n_dev > 1 else None,
+            fault_policy=FaultPolicy(plan=plan, backoff_base_s=0.0,
+                                     backoff_jitter=0.0),
+        )
+        recovered = int(res.completed) == int(args.n_perm)
+        identical = (
+            np.array_equal(np.asarray(base.p_values),
+                           np.asarray(res.p_values))
+            and (base.nulls is None
+                 or np.array_equal(base.nulls, res.nulls))
+        )
+        timeline = render_recovery(tel_path)
+        summary = {
+            "plan": plan, "devices": n_dev, "n_perm": int(args.n_perm),
+            "recovered": recovered, "bit_identical": identical,
+            "ok": recovered and identical,
+        }
+        if args.json:
+            print(json.dumps(summary))
+        else:
+            print(f"chaos drill: plan={plan!r} on {n_dev} device(s)")
+            if timeline:
+                print(timeline)
+            print(
+                "chaos drill "
+                + ("PASSED" if summary["ok"] else "FAILED")
+                + f": recovered={recovered} bit_identical={identical}"
+            )
+        return 0 if summary["ok"] else 1
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m netrep_tpu")
     sub = ap.add_subparsers(dest="cmd")
@@ -90,6 +181,25 @@ def main(argv=None) -> int:
     pf.add_argument("--ingest", nargs="+", metavar="BENCH_JSON",
                     help="append entries converted from driver "
                          "BENCH_r0*.json files before any other action")
+    ch = sub.add_parser(
+        "chaos",
+        help="deterministic elastic-recovery drill (ISSUE 6): run a toy "
+             "preservation null on a small mesh with an injected fault "
+             "plan, verify the recovered result is bit-identical to the "
+             "unfaulted run, and print the recovery timeline",
+    )
+    ch.add_argument("--plan", default=None,
+                    help="fault plan (default: $NETREP_FAULT_PLAN, else "
+                         "'device_lost_partial@24;capacity_restored@40')")
+    ch.add_argument("--devices", type=_positive, default=None,
+                    help="mesh size for the drill (default: min(4, "
+                         "available devices))")
+    ch.add_argument("--n-perm", type=_positive, default=64)
+    ch.add_argument("--telemetry", default=None, metavar="PATH",
+                    help="write the drill's event log here (default: a "
+                         "temp file, removed after the run)")
+    ch.add_argument("--json", action="store_true",
+                    help="print the summary dict as one JSON line")
     args = ap.parse_args(argv)
     if args.cmd is None:
         # bare invocation = selftest with its own argparse defaults (ONE
@@ -180,6 +290,9 @@ def main(argv=None) -> int:
                 print()
                 print(split)
         return 0
+
+    if args.cmd == "chaos":
+        return _chaos(args)
 
     import netrep_tpu
 

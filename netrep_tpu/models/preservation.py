@@ -27,7 +27,9 @@ from ..ops import pvalues as pv
 from ..parallel.engine import ModuleSpec, PermutationEngine
 from ..utils import telemetry as tm
 from ..utils.config import EngineConfig
-from ..utils.faults import DeviceLostError, resolve_runtime
+from ..utils.faults import (
+    CapacityRestoredError, DeviceLostError, resolve_runtime,
+)
 from ..utils.profiling import PairTimer, device_trace, resolve_profile_dir
 from . import dataset as ds
 from .results import PreservationResult, shape_results
@@ -233,10 +235,14 @@ def module_preservation(
       regenerates identical ``fold_in`` keys), hung dispatches are
       abandoned after an emergency checkpoint (``hang_timeout_s``, or
       the telemetry stall watchdog escalated from warn to act), and a
-      lost device degrades the run to CPU mid-flight: completed work is
-      failure-saved, the engine is rebuilt on the CPU platform
-      (:func:`netrep_tpu.utils.backend.degrade_to_cpu`), and the null
-      resumes bit-identically from the checkpoint. Without a
+      lost device climbs the elastic ladder (ISSUE 6): completed work
+      is failure-saved, then the mesh is rebuilt over the SURVIVING
+      devices and the null resumes on it bit-identically — growing back
+      to the original mesh at the next chunk boundary once capacity
+      returns — and only a total loss forces the CPU platform
+      (:func:`netrep_tpu.utils.backend.degrade_to_cpu`). Checkpoint
+      writes ride a background writer while a policy is active
+      (``async_checkpoint``), so saves never stall the device. Without a
       ``checkpoint_dir`` a run-scoped temporary directory holds the
       emergency checkpoints (removed on success). Every recovery
       decision emits telemetry (``retry_attempt``, ``chunk_abandoned``,
@@ -435,39 +441,115 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
 
     def run_pair_null_guarded(build_engine, engine, np_this, observed, prog,
                               ck, d_name, t_name):
-        """:func:`run_pair_null` plus the last rung of the fault ladder
-        (ISSUE 4): on a device-loss-class failure — whose loop already
-        failure-saved every completed permutation to ``ck`` — force the
-        CPU platform, rebuild the engine from the original host inputs
-        (mesh dropped: its devices are gone), and resume from the
-        checkpoint. Bit-identical to an unfaulted run: per-permutation
-        keys depend only on (seed, index), and the shared injector on
-        ``ft`` never re-fires a consumed fault on the resumed
-        dispatches. A second device loss propagates — CPU cannot be
-        lost, so it means something else is wrong."""
-        try:
-            return run_pair_null(engine, np_this, observed, prog, ck)
-        except DeviceLostError as e:
-            if ck is None:  # no checkpoint, nothing to resume from
-                raise
-            from ..utils import backend as be
-            from ..utils import checkpoint as ckpt_mod
+        """:func:`run_pair_null` under the elastic recovery ladder
+        (ISSUE 4 + ISSUE 6). On a device-loss-class failure — whose loop
+        already failure-saved every completed permutation to ``ck`` —
+        the ladder climbs down one rung at a time:
 
-            reason = getattr(e, "reason", "device_lost")
-            cause = e.__cause__ if e.__cause__ is not None else e
-            be.degrade_to_cpu(
-                reason,
-                discovery=str(d_name), test=str(t_name),
-                error=type(cause).__name__,
-            )
-            # the replicated CPU rebuild of a row-sharded engine changes
-            # the checkpoint fingerprint (matrix padding/sharding) while
-            # the problem and RNG stream are unchanged — accept the
-            # mismatch explicitly for THIS resume (ISSUE 5, closing the
-            # PR 4 known gap); key/seed mismatches still refuse
-            with ckpt_mod.accept_degraded_fingerprint(reason):
-                return run_pair_null(build_engine(None), np_this, observed,
-                                     prog, ck)
+        1. *shrink*: survivors remain
+           (:func:`netrep_tpu.utils.backend.enumerate_survivors`) —
+           rebuild a smaller mesh over them
+           (:func:`netrep_tpu.parallel.mesh.shrink_mesh` preserves as
+           much row sharding as still divides), release the superseded
+           engine's device arrays *before* the replacement allocates,
+           and resume from the checkpoint;
+        2. *grow back*: the loop raised
+           :class:`~netrep_tpu.utils.faults.CapacityRestoredError` at a
+           chunk boundary (committed + checkpointed) — rebuild the
+           ORIGINAL mesh and resume;
+        3. *CPU*, the final rung, only when zero devices survive (or the
+           elastic rebuild budget is spent): force the CPU platform and
+           resume replicated.
+
+        Every resume is bit-identical to an unfaulted run: per-permutation
+        keys depend only on (seed, index), the checkpoint fingerprint is
+        mesh-shape-independent (host-input digest), and the shared
+        injector on ``ft`` never re-fires a consumed fault on resumed
+        dispatches. A device loss after the CPU rung propagates — CPU
+        cannot be lost, so it means something else is wrong."""
+        from ..parallel import mesh as meshmod
+        from ..parallel.distributed import filter_addressable
+        from ..utils import backend as be
+        from ..utils import checkpoint as ckpt_mod
+
+        cur_mesh = mesh
+        full_spec = meshmod.mesh_spec(mesh)
+
+        def rebuild(new_mesh):
+            nonlocal engine, cur_mesh
+            # free the superseded engine's HBM before the replacement
+            # allocates (ISSUE 6 satellite: GC-timing must not decide
+            # whether both device footprints coexist)
+            rel = getattr(engine, "release", None)
+            if rel is not None:
+                rel()
+            engine = build_engine(new_mesh)
+            cur_mesh = new_mesh
+            if ft is not None:
+                ft.mesh_rebuilds += 1
+
+        while True:
+            try:
+                return run_pair_null(engine, np_this, observed, prog, ck)
+            except CapacityRestoredError:
+                # rung 4 (grow back): committed work is checkpointed; the
+                # original capacity is available again
+                have = (
+                    set(cur_mesh.devices.flat) if cur_mesh is not None
+                    else set()
+                )
+                restored = [d for d in full_spec[0] if d not in have]
+                grown = meshmod.mesh_from_spec(full_spec)
+                be.announce_mesh_grown(
+                    list(grown.devices.flat), restored,
+                    discovery=str(d_name), test=str(t_name),
+                )
+                rebuild(grown)
+                if ft is not None:
+                    ft.mesh_shrunk = False
+            except DeviceLostError as e:
+                if ck is None:  # no checkpoint, nothing to resume from
+                    raise
+                reason = getattr(e, "reason", "device_lost")
+                cause = e.__cause__ if e.__cause__ is not None else e
+                survivors, lost = be.enumerate_survivors(cur_mesh, e)
+                survivors = filter_addressable(survivors)
+                budget_ok = ft is None or (
+                    ft.mesh_rebuilds < ft.policy.max_mesh_rebuilds
+                )
+                if survivors and budget_ok:
+                    # rung 3 (shrink): resume on the survivor mesh instead
+                    # of falling off the CPU cliff
+                    be.announce_mesh_shrunk(
+                        reason, survivors, lost,
+                        discovery=str(d_name), test=str(t_name),
+                        error=type(cause).__name__,
+                    )
+                    rebuild(meshmod.shrink_mesh(survivors, like=cur_mesh))
+                    if ft is not None:
+                        ft.mesh_shrunk = True
+                    continue
+                # rung 5 (final): zero accelerators survive — CPU
+                freed = lost if lost else (
+                    list(cur_mesh.devices.flat) if cur_mesh is not None
+                    else []
+                )
+                be.degrade_to_cpu(
+                    reason,
+                    discovery=str(d_name), test=str(t_name),
+                    error=type(cause).__name__,
+                    freed=be.device_inventory(freed),
+                )
+                rel = getattr(engine, "release", None)
+                if rel is not None:
+                    rel()
+                # the mesh-shape-independent fingerprint makes this resume
+                # validate cleanly; the acceptance scope stays as a belt
+                # for engines whose fingerprint is still layout-sensitive
+                # (key/seed mismatches always refuse either way)
+                with ckpt_mod.accept_degraded_fingerprint(reason):
+                    return run_pair_null(build_engine(None), np_this,
+                                         observed, prog, ck)
 
     def pair_progress():
         # verbose=True with no user callback gets the reference-style

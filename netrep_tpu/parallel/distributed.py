@@ -144,6 +144,23 @@ def initialize(
     }
 
 
+def filter_addressable(devices) -> list:
+    """Keep only devices the runtime can still enumerate — the multi-host
+    guard of the elastic shrink rung (ISSUE 6): after a HOST loss, the
+    dead host's devices may still appear in a survivor candidate list
+    derived from the old mesh, but ``jax.devices()`` no longer returns
+    them; building the shrunken mesh over a phantom device would fail at
+    its first collective instead of here. Single-process (and the CPU
+    drill harness): an identity filter — every mesh device is live.
+    Returns ``[]`` when the runtime itself can no longer enumerate
+    devices (the whole client is gone; the caller takes the CPU rung)."""
+    try:
+        alive = set(jax.devices())
+    except RuntimeError:
+        return []
+    return [d for d in devices if d in alive]
+
+
 def to_global(x, sharding):
     """Place a host-local array onto ``sharding``. Single-process (fully
     addressable): a plain ``device_put``. Multi-host: ``device_put`` rejects

@@ -184,6 +184,7 @@ def run_checkpointed_chunks(
 
     save = None
     loaded = None
+    writer = None
     if checkpoint_path is not None:
         from ..utils import checkpoint as ckpt
 
@@ -193,9 +194,16 @@ def run_checkpointed_chunks(
             nulls_init, start_perm = ckpt.validate_resume(
                 loaded, n_perm, kd, fp, checkpoint_path, perm_axis=perm_axis
             )
+        if ft is not None and ft.policy.async_checkpoint:
+            # periodic saves ride a background writer so the loop never
+            # stalls between dispatches on serialization (ISSUE 6);
+            # rescue/failure paths flush it, finally closes it — after
+            # which save() degrades to the synchronous path
+            writer = ckpt.AsyncCheckpointWriter(telemetry)
 
         def save(nulls, done):
-            ckpt.save_null_checkpoint(checkpoint_path, nulls, done, kd, fp)
+            ckpt.save_null_checkpoint(checkpoint_path, nulls, done, kd, fp,
+                                      writer=writer)
 
     C = base.effective_chunk()
     # JAX engines keep the full chunk shape on the tail (fixed shapes hit the
@@ -221,9 +229,12 @@ def run_checkpointed_chunks(
         # emergency checkpoint of completed work — called from the fault
         # runtime (abandon path) or the watchdog thread (warn→act); only
         # committed state is touched, so it is safe while the loop thread
-        # hangs inside a dispatch
+        # hangs inside a dispatch. Flushed: an emergency save must be on
+        # disk, not queued, when the abandon/degrade decision lands.
         if save is not None and completed > last_saved:
             save(nulls, completed)
+            if writer is not None:
+                writer.flush()
 
     if ft is not None:
         action, act_factor = ft.watchdog_escalation(rescue)
@@ -243,6 +254,12 @@ def run_checkpointed_chunks(
         mem = _mem_probe(telemetry)
     try:
         while dispatched < n_perm or pending is not None:
+            if ft is not None and save is not None:
+                # elastic grow-back (ISSUE 6): capacity returned — stop at
+                # this chunk boundary; the failure-save hook below
+                # checkpoints (pending chunk flushed first) and the API
+                # layer rebuilds the grown mesh and resumes
+                ft.check_grow()
             nxt = None
             if dispatched < n_perm:
                 take = min(C, n_perm - dispatched)
@@ -337,6 +354,10 @@ def run_checkpointed_chunks(
     finally:
         if wd is not None:
             wd.stop()
+        if writer is not None:
+            # drains the queue (failure-saves included) BEFORE any raised
+            # error reaches the resume logic; later saves run synchronously
+            writer.close()
     if save is not None and completed > last_saved:
         save(nulls, completed)
     if telemetry is not None:
@@ -578,6 +599,7 @@ def run_stream_superchunks(
     completed = 0
     host0 = None
     save = None
+    writer = None
     if checkpoint_path is not None:
         from ..utils import checkpoint as ckpt
 
@@ -597,11 +619,14 @@ def run_stream_superchunks(
             completed = min(int(loaded["completed"]), n_perm)
             host0 = (extras["stream_hi"], extras["stream_lo"],
                      extras["stream_eff"])
+        if ft is not None and ft.policy.async_checkpoint:
+            writer = ckpt.AsyncCheckpointWriter(telemetry)
 
         def save(hi, lo, eff, done):
             ckpt.save_null_checkpoint(
                 checkpoint_path, np.zeros((0,)), done, kd, fp,
                 extra={"stream_hi": hi, "stream_lo": lo, "stream_eff": eff},
+                writer=writer,
             )
 
     tallies = init_tallies(host0)
@@ -614,6 +639,8 @@ def run_stream_superchunks(
         # (safe from the watchdog thread: only committed host state)
         if save is not None and hi is not None and completed > last_saved:
             save(hi, lo, eff, completed)
+            if writer is not None:
+                writer.flush()
 
     def reset():
         # a failed fused dispatch may have consumed the donated carry:
@@ -641,6 +668,11 @@ def run_stream_superchunks(
         mem = _mem_probe(telemetry)
     try:
         while completed < n_perm:
+            if ft is not None and save is not None:
+                # elastic grow-back at the superchunk boundary (ISSUE 6):
+                # committed tallies are failure-saved below, the API layer
+                # rebuilds the grown mesh and resumes
+                ft.check_grow()
             take = min(K * C, n_perm - completed)
             keys = base.perm_keys2d(key, completed, K, C)
             # per-chunk valid counts: the tail superchunk keeps the
@@ -714,6 +746,8 @@ def run_stream_superchunks(
     finally:
         if wd is not None:
             wd.stop()
+        if writer is not None:
+            writer.close()
     if hi is None:
         # resumed-already-complete, or interrupted before the first
         # superchunk landed: report the carry as initialized
@@ -788,6 +822,7 @@ def run_adaptive_stream_chunks(
     monitor.telemetry = telemetry
     completed = 0
     save = None
+    writer = None
     if checkpoint_path is not None:
         from ..utils import checkpoint as ckpt
 
@@ -799,11 +834,16 @@ def run_adaptive_stream_chunks(
             ckpt.validate_identity(loaded, kd, fp, checkpoint_path)
             monitor.restore_state(loaded.get("extras") or {})
             completed = min(int(loaded["completed"]), n_perm)
+        if ft is not None and ft.policy.async_checkpoint:
+            writer = ckpt.AsyncCheckpointWriter(telemetry)
 
         def save(done):
+            # monitor state is read (and snapshotted by the writer path)
+            # on THIS thread at submit time — the background write never
+            # races the monitor's in-place tally folds
             ckpt.save_null_checkpoint(
                 checkpoint_path, np.zeros((0,)), done, kd, fp,
-                extra=monitor.state_arrays(),
+                extra=monitor.state_arrays(), writer=writer,
             )
 
     pos = monitor.active_positions()
@@ -819,6 +859,8 @@ def run_adaptive_stream_chunks(
         # state is always consistent from the watchdog thread's view
         if save is not None and completed > last_saved:
             save(completed)
+            if writer is not None:
+                writer.flush()
 
     if ft is not None:
         action, act_factor = ft.watchdog_escalation(rescue)
@@ -840,6 +882,9 @@ def run_adaptive_stream_chunks(
         mem = _mem_probe(telemetry)
     try:
         while completed < n_perm and monitor.any_active():
+            if ft is not None and save is not None:
+                # elastic grow-back at the chunk boundary (ISSUE 6)
+                ft.check_grow()
             pos = monitor.active_positions()
             take = min(C, n_perm - completed)
 
@@ -915,6 +960,8 @@ def run_adaptive_stream_chunks(
     finally:
         if wd is not None:
             wd.stop()
+        if writer is not None:
+            writer.close()
     if save is not None and completed > last_saved:
         save(completed)
     if telemetry is not None:
@@ -1064,6 +1111,7 @@ def run_adaptive_chunks(
     nulls = np.full(alloc_shape, np.nan)
     completed = 0
     save = None
+    writer = None
     if checkpoint_path is not None:
         from ..utils import checkpoint as ckpt
 
@@ -1086,10 +1134,13 @@ def run_adaptive_chunks(
                         gap,
                     )
 
+        if ft is not None and ft.policy.async_checkpoint:
+            writer = ckpt.AsyncCheckpointWriter(telemetry)
+
         def save(nulls, done):
             ckpt.save_null_checkpoint(
                 checkpoint_path, nulls, done, kd, fp,
-                extra=monitor.state_arrays(),
+                extra=monitor.state_arrays(), writer=writer,
             )
 
     pos = monitor.active_positions()
@@ -1106,6 +1157,8 @@ def run_adaptive_chunks(
         # watchdog thread checkpoints a consistent prefix
         if save is not None and completed > last_saved:
             save(nulls, completed)
+            if writer is not None:
+                writer.flush()
 
     if ft is not None:
         action, act_factor = ft.watchdog_escalation(rescue)
@@ -1126,6 +1179,9 @@ def run_adaptive_chunks(
         mem = _mem_probe(telemetry)
     try:
         while completed < n_perm and monitor.any_active():
+            if ft is not None and save is not None:
+                # elastic grow-back at the chunk boundary (ISSUE 6)
+                ft.check_grow()
             pos = monitor.active_positions()
             take = min(C, n_perm - completed)
 
@@ -1193,6 +1249,8 @@ def run_adaptive_chunks(
     finally:
         if wd is not None:
             wd.stop()
+        if writer is not None:
+            writer.close()
     if save is not None and completed > last_saved:
         save(nulls, completed)
     if telemetry is not None:
@@ -1418,6 +1476,21 @@ class PermutationEngine:
             discovery_only or test_data is not None
         )
         self.n_modules = len(self.modules)
+
+        # Mesh-shape-independent checkpoint identity (ISSUE 6): digest the
+        # ORIGINAL host inputs before any padding / sharding / transpose,
+        # so the same problem fingerprints identically on every mesh shape
+        # — a checkpoint written on an N-device mesh resumes on N−1
+        # devices, 1 device, or the replicated CPU rebuild without the
+        # fingerprint-acceptance escape hatch.
+        from ..utils.checkpoint import content_digest
+
+        # raw arrays, not np.asarray: content_digest samples on device and
+        # pulling a genome-scale device matrix to the host here would cost
+        # a full transfer per engine build
+        self._fingerprint_digest = content_digest(
+            [disc_corr, disc_net, disc_data, test_corr, test_net, test_data]
+        )
 
         self.row_sharded = (
             mesh is not None and config.matrix_sharding == "row"
@@ -1686,6 +1759,27 @@ class PermutationEngine:
         self._stream_super_cached = None
         self._stream_count_cached = None
 
+    def release(self) -> None:
+        """Drop every device-array reference and cached jitted program this
+        engine holds (ISSUE 6 satellite): a superseded engine — mesh
+        shrink, grow-back, CPU degradation — must free its HBM *before*
+        the replacement engine allocates, not whenever GC gets around to
+        it; on a 16 GiB chip the old matrices plus the new ones may not
+        coexist. The engine is unusable afterwards; build a new one."""
+        self.buckets = []
+        self._buckets_full = []
+        self._test_corr = self._test_net = self._test_dataT = None
+        self._pool_dev = None
+        self._chunk_fn_cached = None
+        self._observed_fn = None
+        self._stream_super_cached = None
+        self._stream_count_cached = None
+        self._autotune_record = None
+        self._stream_autotune_record = None
+        self._gather_perm = None
+        self._gather_rep = None
+        self.mesh = None
+
     def autotune_key(self, extra: str = "") -> str:
         """Problem-shape key for the persistent throughput cache: backend ×
         gather mode × per-bucket (cap, module count) signature × chunk."""
@@ -1722,17 +1816,13 @@ class PermutationEngine:
     # Observed pass (SURVEY.md §3.1 "observed pass")
     # ------------------------------------------------------------------
 
-    def fingerprint_arrays(self):
-        """Problem matrices sampled into the checkpoint fingerprint
-        (:func:`netrep_tpu.utils.checkpoint.content_digest`): test-side
-        device matrices plus the bucketed discovery properties, so a
-        completed checkpoint is never silently reused against changed data."""
-        arrays = [self._test_corr, self._test_net, self._test_dataT]
-        for b in self.buckets:
-            arrays.extend(
-                f for f in b.disc if f is not None and hasattr(f, "reshape")
-            )
-        return arrays
+    def fingerprint_digest(self) -> str:
+        """Content digest of the ORIGINAL host inputs, computed once at
+        construction (:func:`netrep_tpu.utils.checkpoint.content_digest`)
+        — a completed checkpoint is never silently reused against changed
+        data, while the digest stays independent of mesh shape, matrix
+        sharding, and padding (the elastic-resume contract, ISSUE 6)."""
+        return self._fingerprint_digest
 
     # -- shared chunk/key contract (single source of truth for the
     #    reproducibility guarantee; also used by MultiTestEngine) ----------

@@ -57,3 +57,48 @@ def make_mesh(
         )
     grid = np.array(devices[:need]).reshape(n_perm_shards, n_row_shards)
     return Mesh(grid, (PERM_AXIS, ROW_AXIS))
+
+
+def mesh_spec(mesh: Mesh | None):
+    """``(devices, n_perm_shards, n_row_shards)`` of a mesh, or None —
+    the lightweight record the elastic ladder keeps of the ORIGINAL
+    capacity so the grow-back rung can rebuild it after the superseded
+    :class:`Mesh` object (and the engine arrays sharded over it) have
+    been dropped. Device handles are cheap; the arrays are not."""
+    if mesh is None:
+        return None
+    return (
+        tuple(mesh.devices.flat),
+        int(mesh.shape.get(PERM_AXIS, mesh.devices.size)),
+        int(mesh.shape.get(ROW_AXIS, 1)),
+    )
+
+
+def mesh_from_spec(spec) -> Mesh | None:
+    """Rebuild a mesh from :func:`mesh_spec` — the grow-back rung."""
+    if spec is None:
+        return None
+    devices, n_perm, n_row = spec
+    return make_mesh(
+        n_perm_shards=n_perm, n_row_shards=n_row, devices=list(devices)
+    )
+
+
+def shrink_mesh(devices, like: Mesh) -> Mesh:
+    """Rebuild a ``(perm, row)`` mesh over the surviving device subset
+    (elastic shrink rung, ISSUE 6), preserving as much of the old mesh's
+    row-sharding as still divides the survivor count: the row axis gets
+    the largest common divisor of (survivors, old row size) — so a
+    row-sharded engine keeps row sharding whenever it can, and collapses
+    to ``row=1`` (replicated matrices) only when it must. Everything
+    else rides the permutation axis, the embarrassingly parallel one."""
+    n = len(devices)
+    if n < 1:
+        raise ValueError("shrink_mesh needs at least one surviving device")
+    old_row = int(like.shape.get(ROW_AXIS, 1))
+    row = max(
+        f for f in range(1, old_row + 1) if n % f == 0 and old_row % f == 0
+    )
+    return make_mesh(
+        n_perm_shards=n // row, n_row_shards=row, devices=list(devices)
+    )

@@ -65,6 +65,14 @@ class MultiTestEngine:
     ):
         test_corrs = np.asarray(test_corrs)
         self.T = test_corrs.shape[0]
+        # Mesh-shape-independent test-side checkpoint identity (ISSUE 6):
+        # digest the host inputs before padding/sharding/transpose — see
+        # PermutationEngine.fingerprint_digest for the contract
+        self._host_test_digest = ckpt_digest(
+            [np.asarray(test_corrs), np.asarray(test_nets)]
+            + ([] if test_datas is None
+               else [np.asarray(d) for d in test_datas])
+        )
         # Base engine: discovery-side buckets + pool validation only — no
         # throwaway test-side device transfer (the test side lives here).
         # With matrix_sharding='row' it also builds the sharded gatherers
@@ -146,6 +154,16 @@ class MultiTestEngine:
         #: jitted streaming programs keyed by (adaptive, observed bytes) —
         #: see PermutationEngine._stream_super_fn; cleared by rebucket
         self._stream_cached: dict = {}
+
+    def release(self) -> None:
+        """Drop device arrays and cached programs (see
+        :meth:`PermutationEngine.release`) — base engine included."""
+        self._base.release()
+        self._tc = self._tn = self._td = None
+        self._chunk_cached = None
+        self._obs_fn_cached = None
+        self._stream_cached = {}
+        self.mesh = None
 
     # -- kernel composition ------------------------------------------------
 
@@ -404,15 +422,10 @@ class MultiTestEngine:
         return chunk, chunk_args, False
 
     def _fingerprint_extra(self) -> bytes:
-        """Checkpoint identity of the test side (_tc/_tn/_td are per-dataset
-        lists when row-sharded or ragged, single stacked arrays otherwise)."""
-        as_list = lambda x: (
-            list(x) if isinstance(x, list) else [x]
-        )
-        digest = ckpt_digest(
-            as_list(self._tc) + as_list(self._tn) + as_list(self._td)
-        )
-        return f"|T:{self.T}|td:{digest}".encode()
+        """Checkpoint identity of the test side — digested from the HOST
+        inputs at construction, so it is identical on every mesh shape
+        and sharding mode (the elastic-resume contract, ISSUE 6)."""
+        return f"|T:{self.T}|td:{self._host_test_digest}".encode()
 
     def _null_write(self, profile=None) -> Callable:
         """Chunk→null scatter shared by the fixed and adaptive loops (reads

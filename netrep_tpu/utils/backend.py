@@ -152,12 +152,95 @@ def probe_default_backend(timeout: float) -> str:
     return outcome
 
 
+def device_inventory(devices) -> list[str]:
+    """Compact ``platform:id`` strings for a device list — the inventory
+    the elastic-ladder events (``mesh_shrunk``/``mesh_grown``/
+    ``degraded_to_cpu``) carry so an offline reader can see exactly which
+    devices were freed and which survived each rebuild."""
+    out = []
+    for d in devices or ():
+        plat = getattr(d, "platform", None) or type(d).__name__
+        out.append(f"{plat}:{getattr(d, 'id', '?')}")
+    return out
+
+
+def enumerate_survivors(mesh, error=None) -> tuple[list, list]:
+    """``(survivors, lost)`` device lists after a device-loss-class
+    failure on ``mesh`` — the decision input of the elastic ladder
+    (ISSUE 6): any survivor ⇒ shrink the mesh onto them; none ⇒ the CPU
+    rung.
+
+    Attribution comes from the error chain: a failure whose cause carries
+    an ``n_lost`` attribute (the injected partial loss; a coordination
+    layer annotating real losses can use the same contract) loses that
+    many devices off the front of the mesh's device list — ``n_lost=None``
+    means half, the deterministic drill default. An UNATTRIBUTED device
+    loss presumes the whole client is gone (a dead TPU runtime takes
+    every device it owns with it), which keeps the pre-elastic behavior:
+    straight to CPU. No liveness probing happens here — on tunneled
+    backends a probe of a half-dead client can hang, and the ladder must
+    decide quickly."""
+    if mesh is None:
+        return [], []
+    devices = [d for d in mesh.devices.flat]
+    e = error
+    while e is not None:
+        if hasattr(e, "n_lost"):
+            n_lost = e.n_lost
+            if n_lost is None:
+                n_lost = (len(devices) + 1) // 2
+            n_lost = min(max(1, int(n_lost)), len(devices))
+            return devices[n_lost:], devices[:n_lost]
+        e = e.__cause__
+    return [], devices
+
+
+def announce_mesh_shrunk(reason: str, surviving, freed, **context) -> None:
+    """Structurally announce a mesh-shrink rebuild (the rung ABOVE CPU
+    degradation): one ``mesh_shrunk`` event carrying the freed and
+    surviving device inventories plus caller context, and one logger
+    warning. The caller rebuilds its engine over the survivors and
+    resumes from the failure-saved checkpoint — bit-identical, because
+    per-permutation keys depend only on ``(key, index)``."""
+    tel = _telemetry()
+    if tel is not None:
+        tel.emit(
+            "mesh_shrunk", reason=reason,
+            surviving=device_inventory(surviving),
+            freed=device_inventory(freed),
+            n_surviving=len(surviving), n_freed=len(freed), **context,
+        )
+    logger.warning(
+        "mesh shrunk (%s): %d device(s) lost, rebuilding over the %d "
+        "survivor(s) and resuming from checkpoint", reason, len(freed),
+        len(surviving),
+    )
+
+
+def announce_mesh_grown(surviving, restored, **context) -> None:
+    """Structurally announce a mesh grow-back (capacity returned): one
+    ``mesh_grown`` event with the restored inventory, one logger info."""
+    tel = _telemetry()
+    if tel is not None:
+        tel.emit(
+            "mesh_grown", surviving=device_inventory(surviving),
+            restored=device_inventory(restored),
+            n_devices=len(surviving), **context,
+        )
+    logger.warning(
+        "mesh grown back to %d device(s) (%d restored); resuming from "
+        "checkpoint", len(surviving), len(restored),
+    )
+
+
 def degrade_to_cpu(reason: str, **context) -> None:
-    """Mid-run CPU degradation (ISSUE 4, the last rung of the fault
-    ladder): force the CPU platform via the live config (rule 1 above —
+    """Mid-run CPU degradation (ISSUE 4; since ISSUE 6 the FINAL rung of
+    the elastic fault ladder, taken only when zero accelerator devices
+    survive): force the CPU platform via the live config (rule 1 above —
     the env var alone would not redirect an already-started process) and
     announce it, structurally (one ``degraded_to_cpu`` event carrying
-    ``reason`` + caller context) and via the logger. Callers rebuild
+    ``reason`` + caller context, including the freed device inventory
+    when the caller supplies one) and via the logger. Callers rebuild
     their engines afterwards and resume from the failure-saved
     checkpoint; per-permutation keys depend only on ``(key, index)``, so
     the resumed CPU run continues the same null stream."""

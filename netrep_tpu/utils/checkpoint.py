@@ -19,6 +19,8 @@ import hashlib
 import logging
 import os
 import tempfile
+import threading
+import time
 
 import numpy as np
 
@@ -29,7 +31,12 @@ logger = logging.getLogger("netrep_tpu")
 # v3: round-2 hot-path changes (multiple-of-32 bucket capacities, transposed
 # data-matrix fingerprint arrays) alter the fingerprint for identical inputs;
 # the bump turns the resulting mismatch into a clear version error.
-_FORMAT_VERSION = 3
+# v4 (ISSUE 6): the fingerprint content digest moved from the engine's
+# DEVICE arrays (padded/sharded per mesh shape) to the original HOST
+# inputs, so a checkpoint written on an N-device mesh validates unchanged
+# on any other mesh shape — including the replicated CPU rebuild — and the
+# elastic shrink/grow resume needs no fingerprint-acceptance escape hatch.
+_FORMAT_VERSION = 4
 
 
 def _telemetry():
@@ -64,15 +71,24 @@ def content_digest(arrays) -> str:
 def engine_fingerprint(engine) -> np.ndarray:
     """Structural + sampled-content fingerprint of a
     :class:`PermutationEngine` problem: module labels/sizes, pool, data
-    presence, and (when the engine exposes ``fingerprint_arrays()``) a
-    strided-sample digest of the underlying matrices."""
+    presence, and a strided-sample content digest of the underlying
+    matrices. Engines exposing ``fingerprint_digest()`` supply a digest
+    of their original HOST inputs, computed once at construction — by
+    design independent of mesh shape, matrix sharding, and padding, so
+    the elastic ladder (ISSUE 6) can resume one checkpoint across any
+    rebuild of the same problem. ``fingerprint_arrays()`` (the native and
+    sparse engines, whose arrays never reshard) is digested directly."""
     parts = [str(_FORMAT_VERSION), str(int(engine.has_data))]
     for m in engine.modules:
         parts.append(f"{m.label}:{m.size}")
     parts.append(f"pool:{engine.pool.size}:{int(np.sum(engine.pool)) & 0xFFFFFFFF}")
-    arrays = getattr(engine, "fingerprint_arrays", None)
-    if arrays is not None:
-        parts.append("digest:" + content_digest(arrays()))
+    digest = getattr(engine, "fingerprint_digest", None)
+    if digest is not None:
+        parts.append("digest:" + str(digest()))
+    else:
+        arrays = getattr(engine, "fingerprint_arrays", None)
+        if arrays is not None:
+            parts.append("digest:" + content_digest(arrays()))
     return np.frombuffer("|".join(parts).encode(), dtype=np.uint8)
 
 
@@ -100,16 +116,36 @@ def save_null_checkpoint(
     key_data: np.ndarray,
     fingerprint: np.ndarray,
     extra: dict | None = None,
+    writer: "AsyncCheckpointWriter | None" = None,
 ) -> None:
     """Atomically persist a (possibly partial) null array (see
     :func:`atomic_savez`). ``extra`` maps names to arrays of auxiliary
     loop state — the adaptive engine stores its sequential-stopping
     tallies and retired set here (``x_``-prefixed keys, so plain resumes
     of old checkpoints are unaffected and old builds simply ignore them).
+
+    ``writer`` (ISSUE 6): an :class:`AsyncCheckpointWriter` takes the
+    write off the loop thread — the arrays are SNAPSHOTTED here (the
+    loop mutates ``nulls`` and the monitor tallies in place, so the
+    background serialization must not read live buffers) and the actual
+    ``atomic_savez`` happens on the writer's thread. A closed writer
+    degrades to the synchronous path, so the loops' final saves after
+    ``writer.close()`` stay durable without special-casing.
     """
     extras = {
         f"x_{k}": np.asarray(v) for k, v in (extra or {}).items()
     }
+    if writer is not None and writer.submit(
+        lambda n=np.array(nulls), e={k: np.array(v) for k, v in extras.items()}:
+        _save_sync(path, n, completed, key_data, fingerprint, e)
+    ):
+        return
+    _save_sync(path, np.asarray(nulls), completed, key_data, fingerprint,
+               extras)
+
+
+def _save_sync(path, nulls, completed, key_data, fingerprint, extras):
+    """The actual checkpoint write — loop thread or writer thread."""
     atomic_savez(
         path,
         version=np.int64(_FORMAT_VERSION),
@@ -127,6 +163,112 @@ def save_null_checkpoint(
             size = 0
         tel.emit("checkpoint_saved", path=path, completed=int(completed),
                  bytes=int(size))
+
+
+class AsyncCheckpointWriter:
+    """Background checkpoint writer (ISSUE 6): a daemon thread drains a
+    bounded LATEST-WINS queue of depth one — a newer snapshot of the same
+    run supersedes a still-queued older one (only the newest checkpoint
+    matters; writing both would just double the disk traffic), every
+    write is still an atomic rename, and :meth:`flush` blocks until the
+    queue is empty so failure-saves and emergency rescues stay durable
+    before their error propagates. The elastic null loops use it so a
+    periodic save never stalls the device between dispatches.
+
+    Contract with the loops: periodic saves ``submit`` and return
+    immediately; ``rescue()`` hooks and the run's ``finally`` call
+    :meth:`flush`/:meth:`close` — after :meth:`close` further submits are
+    refused (``submit`` returns False) and
+    :func:`save_null_checkpoint` falls back to the synchronous path, so
+    the post-loop completion save needs no special case. A failed
+    background write warns (the loop must survive a full disk exactly
+    like the telemetry sink does) and the next save tries again.
+    """
+
+    def __init__(self, telemetry=None):
+        self.telemetry = telemetry
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending = None
+        self._busy = False
+        self._closed = False
+        self._writes = 0
+        self._superseded = 0
+        self._thread: threading.Thread | None = None
+
+    def submit(self, fn) -> bool:
+        """Queue one checkpoint write (a zero-arg callable over already-
+        snapshotted arrays). Returns False when the writer is closed —
+        the caller performs the write synchronously instead."""
+        with self._cond:
+            if self._closed:
+                return False
+            if self._pending is not None:
+                self._superseded += 1  # latest wins
+            self._pending = fn
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, name="netrep-ckpt-writer", daemon=True
+                )
+                self._thread.start()
+            self._cond.notify_all()
+        return True
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while self._pending is None and not self._closed:
+                    self._cond.wait()
+                fn = self._pending
+                self._pending = None
+                if fn is None:  # closed with nothing queued
+                    return
+                self._busy = True
+            try:
+                fn()
+                with self._lock:
+                    self._writes += 1
+            except Exception:
+                logger.warning(
+                    "async checkpoint write failed; the next save will "
+                    "retry", exc_info=True,
+                )
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    def flush(self) -> float:
+        """Block until the queue is drained and no write is in flight;
+        returns the seconds waited. Called by emergency rescues and the
+        failure-save paths — a checkpoint an error handler just saved
+        must be ON DISK before the error reaches the resume logic."""
+        t0 = time.monotonic()
+        with self._cond:
+            while self._pending is not None or self._busy:
+                self._cond.wait(timeout=0.1)
+        return time.monotonic() - t0
+
+    def close(self) -> None:
+        """Flush, stop the thread, and emit one ``checkpoint_async_flush``
+        event summarizing the writer's life (writes performed, superseded
+        queue entries, final flush wait) — the pinned telemetry record
+        that the async path was active and drained cleanly."""
+        waited = self.flush()
+        with self._cond:
+            already = self._closed
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        if already:
+            return
+        tel = self.telemetry if self.telemetry is not None else _telemetry()
+        if tel is not None:
+            tel.emit(
+                "checkpoint_async_flush", writes=self._writes,
+                superseded=self._superseded, waited_s=float(waited),
+            )
 
 
 def load_null_checkpoint(path: str) -> dict | None:

@@ -31,9 +31,24 @@ class FaultPolicy:
         from ``(chunk start, attempt)`` — reproducible schedules, no
         hidden RNG state.
     degrade_to_cpu : a *device-loss*-class failure (or repeated hang
-        abandonment) saves an emergency checkpoint, forces the CPU
-        platform, rebuilds the engine, and resumes bit-identically
-        mid-run; False propagates the error after the checkpoint instead.
+        abandonment) saves an emergency checkpoint and hands the run to
+        the elastic ladder: the mesh is rebuilt from the surviving
+        devices when any survive (ISSUE 6), and only a total loss forces
+        the CPU platform; either way the engine is rebuilt and resumes
+        bit-identically mid-run. False propagates the error after the
+        checkpoint instead.
+    max_mesh_rebuilds : elastic mesh rebuilds (shrink + grow-back)
+        tolerated per run; once spent, a further device loss skips the
+        elastic rungs and takes the final CPU rung directly — a mesh
+        that keeps losing devices is a sick pod, not a recoverable one.
+    async_checkpoint : write checkpoints from a background thread
+        (bounded latest-wins queue, still atomic renames —
+        :class:`netrep_tpu.utils.checkpoint.AsyncCheckpointWriter`) so
+        the null loop never stalls the device on saves; the queue is
+        flushed on failure-saves, emergency rescues, and run exit, so
+        no completed permutation is ever lost to the deferral. Applies
+        only while a fault policy is active (the policy owns the
+        durability contract); False keeps every save synchronous.
     hang_timeout_s : per-dispatch wall-clock budget; a dispatch exceeding
         it is abandoned (the worker thread is walked away from), completed
         work checkpointed, and the chunk re-dispatched. Set it well above
@@ -69,6 +84,8 @@ class FaultPolicy:
     watchdog_action: bool = True
     stall_action_factor: float = 30.0
     max_abandons: int = 2
+    max_mesh_rebuilds: int = 8
+    async_checkpoint: bool = True
     plan: object = None
 
     def __post_init__(self):
@@ -76,6 +93,11 @@ class FaultPolicy:
             raise ValueError(f"max_retries must be >= 0, got {self.max_retries!r}")
         if self.max_abandons < 0:
             raise ValueError(f"max_abandons must be >= 0, got {self.max_abandons!r}")
+        if self.max_mesh_rebuilds < 0:
+            raise ValueError(
+                "max_mesh_rebuilds must be >= 0, got "
+                f"{self.max_mesh_rebuilds!r}"
+            )
         for name in ("backoff_base_s", "backoff_max_s"):
             if getattr(self, name) < 0:
                 raise ValueError(f"{name} must be >= 0, got {getattr(self, name)!r}")

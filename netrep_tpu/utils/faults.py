@@ -19,10 +19,24 @@ recovery ladder around every null loop:
    *abandoned* (``chunk_abandoned`` event), completed work is
    checkpointed, and the chunk is re-dispatched. More than
    ``max_abandons`` abandonments escalates to the device-loss ladder.
-3. **Degrade to CPU** — a *device-loss*-class failure raises
-   :class:`DeviceLostError` past the loop's failure-save hook (which
-   checkpoints all completed permutations first); the API layer
-   (``models/preservation.py``) then forces the CPU platform
+3. **Shrink the mesh** (ISSUE 6) — a device-loss-class failure that left
+   *survivors* (:func:`netrep_tpu.utils.backend.enumerate_survivors`)
+   rebuilds a smaller mesh from the surviving devices and resumes from
+   the checkpoint on it, instead of falling off the CPU cliff. Exact by
+   the same contract: per-permutation keys depend only on ``(key,
+   index)``, so the re-bucketed permutation slices draw identical
+   permutations on any mesh shape.
+4. **Grow the mesh back** — when capacity returns (the injected
+   ``capacity_restored`` plan kind, or an external monitor calling
+   :meth:`FaultRuntime.request_grow`), the null loop raises
+   :class:`CapacityRestoredError` at the next chunk/superchunk boundary
+   — after committing and checkpointing — and the API layer rebuilds
+   the engine over the restored full device set and resumes.
+5. **Degrade to CPU** — the FINAL rung, taken only when zero
+   accelerator devices survive: :class:`DeviceLostError` propagates
+   past the loop's failure-save hook (which checkpoints all completed
+   permutations first); the API layer (``models/preservation.py``) then
+   forces the CPU platform
    (:func:`netrep_tpu.utils.backend.degrade_to_cpu`), rebuilds the
    engine, and resumes from the checkpoint — bit-identically, because
    per-permutation keys depend only on ``(key, index)``.
@@ -38,6 +52,9 @@ releases.
 boundaries from a deterministic plan. Plans are compact strings —
 ``"transient@128"`` (fail the dispatch covering permutation 128 once),
 ``"transient@128x3"`` (three successive attempts), ``"device_lost@64"``,
+``"device_lost_partial@64"`` (half the mesh's devices die; survivors
+remain — the mesh-shrink rung), ``"capacity_restored@96"`` (the lost
+capacity comes back; the loop grows the mesh at the next boundary),
 ``"hang@192"``, ``"interrupt@96"``, ``"fatal@32"`` — joined with ``;``,
 set via ``FaultPolicy(plan=...)`` or the ``NETREP_FAULT_PLAN`` env var
 (which also *activates* a default policy, for bench/CI runs). Injection
@@ -65,10 +82,12 @@ __all__ = [
     "FaultRuntime",
     "FaultInjector",
     "FaultSpec",
+    "CapacityRestoredError",
     "DeviceLostError",
     "DispatchAbandonedError",
     "InjectedTransientError",
     "InjectedDeviceLost",
+    "InjectedPartialDeviceLost",
     "InjectedFatalError",
     "classify_error",
     "parse_plan",
@@ -94,6 +113,28 @@ class InjectedTransientError(RuntimeError):
 class InjectedDeviceLost(RuntimeError):
     """Injected stand-in for a lost/preempted device — classified
     ``device_lost``."""
+
+
+class InjectedPartialDeviceLost(InjectedDeviceLost):
+    """Injected PARTIAL device loss: some of the mesh's devices die but
+    survivors remain — the mesh-shrink rung's stand-in. ``n_lost`` is the
+    number of lost devices, or None for "half the current mesh" (the
+    deterministic drill default;
+    :func:`netrep_tpu.utils.backend.enumerate_survivors` resolves it
+    against the actual mesh)."""
+
+    def __init__(self, msg: str, n_lost: int | None = None):
+        super().__init__(msg)
+        self.n_lost = n_lost
+
+
+class CapacityRestoredError(Exception):
+    """Control-flow signal, not a failure: lost device capacity is back,
+    and the null loop should stop at the next chunk/superchunk boundary —
+    after committing and checkpointing — so the API layer can rebuild the
+    engine over the restored mesh and resume. Raised only by
+    :meth:`FaultRuntime.check_grow` on runs that have a checkpoint to
+    resume from."""
 
 
 class InjectedFatalError(RuntimeError):
@@ -180,7 +221,8 @@ def classify_error(exc: BaseException) -> str:
 # Fault plans (deterministic injection harness)
 # ---------------------------------------------------------------------------
 
-_KINDS = ("transient", "device_lost", "fatal", "hang", "interrupt")
+_KINDS = ("transient", "device_lost", "device_lost_partial",
+          "capacity_restored", "fatal", "hang", "interrupt")
 
 _RAISERS = {
     "transient": lambda spec: InjectedTransientError(
@@ -188,6 +230,9 @@ _RAISERS = {
     ),
     "device_lost": lambda spec: InjectedDeviceLost(
         f"injected device loss at permutation {spec.at_perm}"
+    ),
+    "device_lost_partial": lambda spec: InjectedPartialDeviceLost(
+        f"injected partial device loss at permutation {spec.at_perm}"
     ),
     "fatal": lambda spec: InjectedFatalError(
         f"injected fatal fault at permutation {spec.at_perm}"
@@ -330,6 +375,41 @@ class FaultRuntime:
         self._abandons = 0
         self._wd_wired = False
         self._hang_release = threading.Event()  # never set: injected hang
+        # -- elastic mesh state (ISSUE 6), shared across engine rebuilds --
+        #: the API layer set this after a mesh-shrink rebuild; check_grow
+        #: only ever fires while it is True (growing a never-shrunk mesh
+        #: is meaningless, so a stray capacity signal is consumed silently)
+        self.mesh_shrunk = False
+        #: elastic rebuilds (shrink + grow) performed so far this run —
+        #: the API layer caps it at policy.max_mesh_rebuilds
+        self.mesh_rebuilds = 0
+        self._grow = threading.Event()
+
+    # -- elastic capacity signal (ISSUE 6) ---------------------------------
+
+    def request_grow(self) -> None:
+        """Signal that lost device capacity is back. Thread-safe — an
+        external capacity monitor may call it at any time; the injected
+        ``capacity_restored`` plan kind routes through it too. The loop
+        acts at its next chunk boundary (:meth:`check_grow`)."""
+        self._grow.set()
+
+    def check_grow(self) -> None:
+        """Called by the null loops at each chunk/superchunk boundary
+        (committed state only, checkpoint writable): raise
+        :class:`CapacityRestoredError` when a grow signal is pending AND
+        the mesh was previously shrunk. A signal with nothing to grow
+        back to is consumed silently — capacity news on a healthy mesh
+        is not actionable."""
+        if not self._grow.is_set():
+            return
+        self._grow.clear()
+        if not self.mesh_shrunk:
+            return
+        raise CapacityRestoredError(
+            "device capacity restored; rebuild the mesh at this chunk "
+            "boundary and resume from checkpoint"
+        )
 
     # -- watchdog escalation (warn → act) ----------------------------------
 
@@ -389,6 +469,26 @@ class FaultRuntime:
                 self.injector.poll(start, take)
                 if self.injector is not None else None
             )
+            if fault is not None and fault.kind == "capacity_restored":
+                # not a failure: set the grow signal (acted on by the loop
+                # at the NEXT chunk boundary, after this dispatch commits)
+                # and keep dispatching; a second spec may cover this range
+                if telemetry is not None:
+                    telemetry.emit(
+                        "fault_injected", kind=fault.kind,
+                        at_perm=int(fault.at_perm), start=int(start),
+                        take=int(take), label=label,
+                    )
+                logger.warning(
+                    "capacity restored (injected) at permutation %d; the "
+                    "mesh grows back at the next %s boundary",
+                    fault.at_perm, label,
+                )
+                self.request_grow()
+                fault = (
+                    self.injector.poll(start, take)
+                    if self.injector is not None else None
+                )
             if fault is not None:
                 if telemetry is not None:
                     telemetry.emit(
@@ -439,7 +539,8 @@ class FaultRuntime:
                     raise DeviceLostError(
                         f"device lost during {label} dispatch at "
                         f"permutation {start}; completed work is "
-                        "checkpointed — degrade to CPU and resume"
+                        "checkpointed — shrink onto the survivors (or "
+                        "degrade to CPU) and resume"
                     ) from e
                 if kind != "transient":
                     raise

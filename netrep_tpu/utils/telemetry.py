@@ -101,7 +101,10 @@ RECOVERY_EVENTS = (
     "stall_suspected",
     "stall_recovered",
     "device_lost",
+    "mesh_shrunk",
+    "mesh_grown",
     "degraded_to_cpu",
+    "checkpoint_async_flush",
     "fingerprint_degraded_accept",
     "backend_fallback",
     "distributed_autodetect_failed",

@@ -359,3 +359,64 @@ def test_bench_smoke_combination(flags):
         pytest.skip("no C++ toolchain on this machine")
     assert "metric" in row and "error" not in row, row
     assert row.get("value", 0) > 0 or "perms_per_sec_by_threads" in row, row
+
+
+def test_multichip_ledger_fingerprints_split_per_mesh_size():
+    """ISSUE 6 satellite: multichip rows carry the mesh size in the
+    metric label, so the perf ledger groups each mesh size into its own
+    history — `perf --check` can never judge a 1-device rate against a
+    4-device one."""
+    from netrep_tpu.utils import perfledger
+
+    rows = [
+        {"metric": f"multichip x{n}", "n_devices": n,
+         "perms_per_sec": 100.0 * n, "device": "TFRT_CPU_0",
+         "chunk": 128, "dtype": "float32"}
+        for n in (1, 2, 4)
+    ]
+    fps = [perfledger.bench_fingerprint(r) for r in rows]
+    assert len(set(fps)) == 3, fps
+    entries = [perfledger.entry_from_bench_row(r) for r in rows]
+    assert all(e is not None for e in entries)
+    assert len({e["fingerprint"] for e in entries}) == 3
+    # the scaling summary row (no top-level perms_per_sec) never lands
+    # in the ledger — each point already did, under its own fingerprint
+    assert perfledger.entry_from_bench_row(
+        {"metric": "multichip scaling 1..4 devices",
+         "rows": [{"n_devices": 1, "perms_per_sec": 100.0}]}
+    ) is None
+
+
+@pytest.mark.slow
+def test_bench_multichip_emits_real_scaling_rows(tmp_path):
+    """ISSUE 6 satellite, end to end: `bench.py --config multichip`
+    produces measured (non-stub) per-mesh-size rows plus one scaling
+    summary with efficiency vs the 1-device baseline."""
+    ledger = str(tmp_path / "ledger.jsonl")
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--config", "multichip", "--smoke"],
+        cwd=REPO,
+        env={
+            **os.environ, "JAX_PLATFORMS": "cpu",
+            "NETREP_MULTICHIP_MAX": "2",
+            "NETREP_PERF_LEDGER": ledger,
+            "JAX_COMPILATION_CACHE_DIR": os.path.join(
+                REPO, ".jax_cache", _fp()
+            ),
+        },
+        capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rows = [json.loads(l) for l in proc.stdout.splitlines()
+            if l.strip().startswith("{")]
+    points = [r for r in rows if r.get("n_devices")]
+    assert {r["n_devices"] for r in points} == {1, 2}
+    for r in points:
+        assert r["perms_per_sec"] > 0 and r["value"] > 0, r
+    summary = rows[-1]
+    assert summary["metric"].startswith("multichip scaling")
+    eff = {s["n_devices"]: s["efficiency"] for s in summary["rows"]}
+    assert eff[1] == 1.0 and eff[2] is not None
+    # children fed the ledger once per mesh size, split fingerprints
+    fps = {json.loads(l)["fingerprint"] for l in open(ledger)}
+    assert len(fps) == 2, fps

@@ -101,3 +101,32 @@ def test_cli_hang_safe_under_dead_tunnel(selftest_proc):
     row = json.loads(selftest_proc.stdout.strip().splitlines()[-1])
     assert row["ok"]
     assert "cpu" in row["backend"].lower() or "cpu" in row["device"].lower()
+
+
+def test_chaos_drill_cli(tmp_path):
+    """``python -m netrep_tpu chaos`` (ISSUE 6): the one-line elastic
+    drill — injected partial loss + capacity restore on a virtual
+    4-device mesh — recovers, proves bit-parity, prints the recovery
+    timeline, and exits 0. The exact command tpu_watch.sh runs per
+    cycle."""
+    tel = str(tmp_path / "chaos.jsonl")
+    env = {
+        **ENV,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "NETREP_FAULT_PLAN": "device_lost_partial@24;capacity_restored@40",
+    }
+    proc = _run("chaos", "--telemetry", tel, "--json", env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["ok"] and summary["recovered"] and summary["bit_identical"]
+    evs = [json.loads(l)["ev"] for l in open(tel)]
+    assert "mesh_shrunk" in evs and "mesh_grown" in evs
+    assert "degraded_to_cpu" not in evs  # survivors existed
+
+
+def test_chaos_drill_cli_fails_loudly_on_unrecovered(tmp_path):
+    """A fatal-fault plan cannot be recovered from — the drill must exit
+    nonzero (the watch loop logs it as a ladder regression) rather than
+    report success."""
+    proc = _run("chaos", "--plan", "fatal@24", "--devices", "1", "--json")
+    assert proc.returncode != 0

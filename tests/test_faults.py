@@ -480,14 +480,14 @@ def test_device_loss_degrades_to_cpu_via_module_preservation(
     assert ck_paths and not any(os.path.exists(p) for p in ck_paths)
 
 
-def test_degraded_rebuild_accepts_fingerprint_mismatch(tmp_path, caplog):
-    """ISSUE 5, closing the PR 4 known gap: a row-sharded run whose device
-    dies mid-null degrades to a REPLICATED CPU rebuild whose padded-matrix
-    fingerprint no longer matches the checkpoint — the mismatch is now
-    accepted explicitly (``fingerprint_degraded_accept`` event + one
-    logger warning) and the resume still completes bit-identically.
-    Gene count 122 is deliberately not divisible by the 4 row shards, so
-    the sharded engine pads to 124 and the fingerprints genuinely differ."""
+def test_degraded_rebuild_fingerprint_stable_across_layouts(tmp_path, caplog):
+    """ISSUE 6: the checkpoint fingerprint digests the original HOST
+    inputs, so a row-sharded run whose devices ALL die mid-null resumes
+    on the replicated CPU rebuild with NO fingerprint mismatch — the
+    ``accept_degraded_fingerprint`` seam (PR 5) is no longer needed for
+    layout-only changes. Gene count 122 is deliberately not divisible by
+    the 4 row shards (the sharded engine pads to 124), exactly the case
+    that used to mismatch."""
     pytest.importorskip("jax")
     import jax
 
@@ -513,6 +513,8 @@ def test_degraded_rebuild_accepts_fingerprint_mismatch(tmp_path, caplog):
         **kw, telemetry=path,
         mesh=meshmod.make_mesh(n_perm_shards=2, n_row_shards=4),
         config=EngineConfig(chunk_size=16, matrix_sharding="row"),
+        # a FULL (unattributed) device loss: zero survivors, so the
+        # ladder goes straight to the final CPU rung
         fault_policy=FaultPolicy(plan="device_lost@32", backoff_base_s=0.0,
                                  backoff_jitter=0.0),
     )
@@ -520,14 +522,15 @@ def test_degraded_rebuild_accepts_fingerprint_mismatch(tmp_path, caplog):
     np.testing.assert_array_equal(base.nulls, res.nulls)
     np.testing.assert_array_equal(base.p_values, res.p_values)
     evs = [e["ev"] for e in map(json.loads, open(path))]
-    assert evs.count("fingerprint_degraded_accept") == 1
-    assert (evs.index("degraded_to_cpu")
-            < evs.index("fingerprint_degraded_accept")
-            < evs.index("checkpoint_resumed"))
-    acc = next(e for e in map(json.loads, open(path))
-               if e["ev"] == "fingerprint_degraded_accept")
-    assert acc["data"]["reason"] == "device_lost"
-    assert "accepting the resume" in caplog.text
+    # the layout change no longer trips the fingerprint check at all
+    assert evs.count("fingerprint_degraded_accept") == 0
+    assert "accepting the resume" not in caplog.text
+    assert evs.count("mesh_shrunk") == 0  # unattributed loss: CPU rung
+    assert evs.index("degraded_to_cpu") < evs.index("checkpoint_resumed")
+    # freed-inventory satellite: the degraded event names the devices freed
+    deg = next(e for e in map(json.loads, open(path))
+               if e["ev"] == "degraded_to_cpu")
+    assert len(deg["data"]["freed"]) == 8
 
 
 def test_fingerprint_mismatch_still_refuses_outside_degraded_scope(tmp_path):
@@ -653,3 +656,386 @@ def test_cli_recovery_timeline(tmp_path):
     assert table.returncode == 0
     assert "recovery:" in table.stdout
     assert table.stdout.index("recovery:") < table.stdout.index("counters:")
+
+
+# ---------------------------------------------------------------------------
+# elastic mesh execution (ISSUE 6): shrink onto survivors, grow back when
+# capacity returns, CPU only when nothing survives — all four loop modes,
+# bit-identical to the unfaulted run
+# ---------------------------------------------------------------------------
+
+#: module_preservation flags per loop mode (mirrors MODES at engine level)
+MP_MODES = {
+    "fixed": {},
+    "adaptive": {"adaptive": True},
+    "stream": {"store_nulls": False},
+    "adaptive_stream": {"adaptive": True, "store_nulls": False},
+}
+
+
+@pytest.fixture(scope="module")
+def mp_kw(mixed):
+    """module_preservation kwargs over the shared mixed pair (numpy inputs;
+    no pandas dependency)."""
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    assign = {f"node_{i}": "0" for i in range(dn.shape[0])}
+    for lab, idx in mixed["specs"]:
+        for i in idx:
+            assign[f"node_{i}"] = str(lab)
+    return dict(
+        network={"d": dn, "t": tn}, correlation={"d": dc, "t": tc},
+        data={"d": dd, "t": td}, module_assignments=assign,
+        discovery="d", test="t", n_perm=N_PERM, seed=0,
+        config=EngineConfig(chunk_size=16, superchunk=2, autotune=False),
+    )
+
+
+@pytest.fixture(scope="module")
+def mp_baselines(mp_kw):
+    from netrep_tpu import module_preservation
+
+    return {m: module_preservation(**mp_kw, **flags)
+            for m, flags in MP_MODES.items()}
+
+
+def _assert_same_result(a, b):
+    np.testing.assert_array_equal(np.asarray(a.p_values),
+                                  np.asarray(b.p_values))
+    if a.nulls is not None or b.nulls is not None:
+        np.testing.assert_array_equal(np.asarray(a.nulls),
+                                      np.asarray(b.nulls))
+    if a.counts_hi is not None or b.counts_hi is not None:
+        np.testing.assert_array_equal(a.counts_hi, b.counts_hi)
+        np.testing.assert_array_equal(a.counts_lo, b.counts_lo)
+        np.testing.assert_array_equal(a.counts_eff, b.counts_eff)
+    if a.n_perm_used is not None:
+        np.testing.assert_array_equal(a.n_perm_used, b.n_perm_used)
+
+
+def _perm_mesh(n):
+    from netrep_tpu.parallel import mesh as meshmod
+
+    return meshmod.make_mesh(n_perm_shards=n, n_row_shards=1)
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_elastic_shrink_then_grow_bit_identical(mp_kw, mp_baselines, mode,
+                                                tmp_path):
+    """THE acceptance drill: injected partial device loss on a 4-device
+    mesh re-buckets onto the 2-device survivor mesh, capacity restored
+    grows it back at the next boundary, and the final counts/p-values
+    are bit-identical to the uninterrupted (no-mesh) run — in every
+    loop mode. CPU degradation must NOT fire: survivors existed."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the conftest multi-device CPU platform")
+    from netrep_tpu import module_preservation
+
+    path = str(tmp_path / f"elastic_{mode}.jsonl")
+    # loss at 8 (the first dispatch), restore polled on the re-dispatched
+    # range after the shrink — leaves at least one boundary in EVERY mode
+    # (the streaming superchunk covers 32 perms per dispatch) for the
+    # grow-back to act on
+    res = module_preservation(
+        **mp_kw, **MP_MODES[mode], mesh=_perm_mesh(4), telemetry=path,
+        fault_policy=FaultPolicy(
+            plan="device_lost_partial@8;capacity_restored@24",
+            backoff_base_s=0.0, backoff_jitter=0.0,
+        ),
+    )
+    _assert_same_result(mp_baselines[mode], res)
+    evs = [e["ev"] for e in map(json.loads, open(path))]
+    assert evs.count("mesh_shrunk") == 1
+    assert evs.count("mesh_grown") == 1
+    assert evs.count("degraded_to_cpu") == 0
+    assert (evs.index("device_lost") < evs.index("mesh_shrunk")
+            < evs.index("mesh_grown"))
+    # the shrink event carries the freed + surviving device inventories
+    shrunk = next(e["data"] for e in map(json.loads, open(path))
+                  if e["ev"] == "mesh_shrunk")
+    assert shrunk["n_freed"] == 2 and shrunk["n_surviving"] == 2
+    assert len(shrunk["freed"]) == 2 and len(shrunk["surviving"]) == 2
+    # async checkpointing was active (fault policy default) and drained
+    assert "checkpoint_async_flush" in evs
+
+
+def test_cpu_rung_only_when_no_survivors(mp_kw, mp_baselines, tmp_path):
+    """Two partial losses in sequence: 2-device mesh → shrink to 1 →
+    the second loss leaves zero survivors → ONLY then the CPU rung.
+    Result stays bit-identical throughout the whole ladder."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the conftest multi-device CPU platform")
+    from netrep_tpu import module_preservation
+
+    path = str(tmp_path / "cpu_rung.jsonl")
+    res = module_preservation(
+        **mp_kw, mesh=_perm_mesh(2), telemetry=path,
+        fault_policy=FaultPolicy(
+            plan="device_lost_partial@16;device_lost_partial@40",
+            backoff_base_s=0.0, backoff_jitter=0.0,
+        ),
+    )
+    _assert_same_result(mp_baselines["fixed"], res)
+    evs = [e["ev"] for e in map(json.loads, open(path))]
+    assert evs.count("mesh_shrunk") == 1
+    assert evs.count("degraded_to_cpu") == 1
+    assert evs.index("mesh_shrunk") < evs.index("degraded_to_cpu")
+    deg = next(e["data"] for e in map(json.loads, open(path))
+               if e["ev"] == "degraded_to_cpu")
+    assert len(deg["freed"]) == 1  # the last surviving device, now gone
+
+
+def test_mesh_rebuild_budget_skips_to_cpu(mp_kw, mp_baselines, tmp_path):
+    """max_mesh_rebuilds=0: survivors exist but the elastic budget is
+    spent — the ladder takes the CPU rung directly (and still resumes
+    bit-identically)."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the conftest multi-device CPU platform")
+    from netrep_tpu import module_preservation
+
+    path = str(tmp_path / "budget.jsonl")
+    res = module_preservation(
+        **mp_kw, mesh=_perm_mesh(4), telemetry=path,
+        fault_policy=FaultPolicy(
+            plan="device_lost_partial@24", max_mesh_rebuilds=0,
+            backoff_base_s=0.0, backoff_jitter=0.0,
+        ),
+    )
+    _assert_same_result(mp_baselines["fixed"], res)
+    evs = [e["ev"] for e in map(json.loads, open(path))]
+    assert evs.count("mesh_shrunk") == 0
+    assert evs.count("degraded_to_cpu") == 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoint identity across mesh shapes (ISSUE 6 satellite): one problem,
+# one fingerprint — N devices, N−1, 1, replicated or row-sharded
+# ---------------------------------------------------------------------------
+
+def _mesh_engine(mixed, n_dev):
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    mesh = _perm_mesh(n_dev) if n_dev and n_dev > 1 else None
+    return PermutationEngine(
+        dc, dn, dd, tc, tn, td, specs, mixed["pool"], config=CFG, mesh=mesh
+    )
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("resume_dev", [2, 1])
+def test_checkpoint_resumes_across_mesh_shapes(mixed, observed, baselines,
+                                               mode, resume_dev, tmp_path):
+    """A checkpoint written mid-run on a 4-device mesh resumes
+    bit-identically on a 2-device mesh and on a single device, in all
+    four loop modes — no accept_degraded_fingerprint seam involved
+    (the fingerprint digests host inputs, not device layouts)."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the conftest multi-device CPU platform")
+    kind, base, base_done, _ = baselines[mode]
+    ck = str(tmp_path / f"mesh_{mode}_{resume_dev}.npz")
+    writer_eng = _mesh_engine(mixed, 4)
+    pol = FaultPolicy(plan="interrupt@32", backoff_base_s=0.0)
+    _run(writer_eng, mode, observed, fault_policy=pol,
+         checkpoint_path=ck, checkpoint_every=16)
+    saved = ckpt.load_null_checkpoint(ck)
+    assert saved is not None and 0 < saved["completed"] < N_PERM
+    resume_eng = _mesh_engine(mixed, resume_dev)
+    kind_r, res, done_r, finished_r = _run(
+        resume_eng, mode, observed, checkpoint_path=ck,
+        checkpoint_every=16,
+    )
+    assert finished_r and done_r == base_done
+    _assert_same(kind, base, res)
+
+
+def test_checkpoint_resumes_on_n_minus_one_devices(mixed, observed,
+                                                   baselines, tmp_path):
+    """The literal N−1 case (4 → 3 devices; chunk 16 rounds to an
+    effective 15 on the 3-shard mesh, so the resumed chunk boundaries
+    genuinely differ) — the fixed null is still bit-identical because
+    per-permutation keys depend only on (key, index)."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the conftest multi-device CPU platform")
+    kind, base, base_done, _ = baselines["fixed"]
+    ck = str(tmp_path / "mesh_nm1.npz")
+    _run(_mesh_engine(mixed, 4), "fixed", observed,
+         fault_policy=FaultPolicy(plan="interrupt@32", backoff_base_s=0.0),
+         checkpoint_path=ck, checkpoint_every=16)
+    kind_r, res, done_r, finished_r = _run(
+        _mesh_engine(mixed, 3), "fixed", observed, checkpoint_path=ck,
+    )
+    assert finished_r and done_r == base_done
+    _assert_same(kind, base, res)
+
+
+# ---------------------------------------------------------------------------
+# async checkpoint writer (ISSUE 6): background saves, latest-wins queue,
+# flush durability, no completed permutation lost under interrupt
+# ---------------------------------------------------------------------------
+
+def test_async_writer_latest_wins_and_flush():
+    import threading
+    import time as _time
+
+    from netrep_tpu.utils.checkpoint import AsyncCheckpointWriter
+    from netrep_tpu.utils.telemetry import Telemetry
+
+    tel = Telemetry(run_id="aw")
+    w = AsyncCheckpointWriter(tel)
+    wrote = []
+    gate = threading.Event()
+
+    def slow():
+        gate.wait(5.0)
+        wrote.append("slow")
+
+    assert w.submit(slow)
+    _time.sleep(0.05)          # let the worker pick `slow` up (now busy)
+    assert w.submit(lambda: wrote.append("a"))
+    assert w.submit(lambda: wrote.append("b"))   # supersedes "a"
+    gate.set()
+    w.flush()
+    assert wrote == ["slow", "b"]                # latest wins, "a" dropped
+    w.close()
+    assert not w.submit(lambda: wrote.append("late"))  # closed → sync path
+    assert tel.metrics.counters["checkpoint_async_flush.count"] == 1
+    assert tel.metrics.gauges["checkpoint_async_flush.superseded"] == 1
+
+
+def test_async_checkpoint_never_loses_completed_perms(eng, observed,
+                                                      baselines, tmp_path):
+    """Acceptance: with async checkpointing active, an injected interrupt
+    mid-run still leaves every completed permutation on disk (the writer
+    is flushed before the loop returns), and the resume is
+    bit-identical."""
+    kind, base, base_done, _ = baselines["fixed"]
+    ck = str(tmp_path / "async_int.npz")
+    path = tmp_path / "async_int.jsonl"
+    tel = Telemetry(path, run_id="async")
+    pol = FaultPolicy(plan="interrupt@40", backoff_base_s=0.0,
+                      async_checkpoint=True)
+    nulls, done = eng.run_null(
+        N_PERM, key=0, telemetry=tel, fault_policy=pol,
+        checkpoint_path=ck, checkpoint_every=16,
+    )
+    tel.close()
+    saved = ckpt.load_null_checkpoint(ck)
+    # zero loss: everything the loop committed is on disk
+    assert saved["completed"] == done > 0
+    reg = aggregate_file(str(path))
+    assert reg.counters["checkpoint_async_flush.count"] >= 1
+    res, done_r = eng.run_null(N_PERM, key=0, checkpoint_path=ck)
+    assert done_r == base_done
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(res))
+
+
+def test_async_checkpoint_off_stays_synchronous(eng, tmp_path):
+    """async_checkpoint=False: no writer thread, no flush event — every
+    save is the plain synchronous path."""
+    path = tmp_path / "sync.jsonl"
+    tel = Telemetry(path, run_id="sync")
+    with tel.activate():  # checkpoint_saved rides the ambient bus
+        nulls, done = eng.run_null(
+            N_PERM, key=0, telemetry=tel,
+            fault_policy=FaultPolicy(backoff_base_s=0.0,
+                                     async_checkpoint=False),
+            checkpoint_path=str(tmp_path / "sync.npz"), checkpoint_every=16,
+        )
+    tel.close()
+    assert done == N_PERM
+    reg = aggregate_file(str(path))
+    assert "checkpoint_async_flush.count" not in reg.counters
+    assert reg.counters["checkpoint_saved.count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# chaos-drill matrix (ISSUE 6 satellite): one NETREP_FAULT_PLAN per ladder
+# rung, through the public API, CPU-only, tier-1
+# ---------------------------------------------------------------------------
+
+LADDER_PLANS = {
+    "retry": ("transient@8", ("retry_attempt",), 1),
+    "shrink": ("device_lost_partial@24", ("mesh_shrunk",), 4),
+    "grow": ("device_lost_partial@24;capacity_restored@40",
+             ("mesh_shrunk", "mesh_grown"), 4),
+    "cpu": ("device_lost@24", ("degraded_to_cpu",), 4),
+}
+
+
+@pytest.mark.parametrize("rung", sorted(LADDER_PLANS))
+def test_chaos_matrix_env_plan_per_rung(mp_kw, mp_baselines, rung,
+                                        monkeypatch, tmp_path):
+    """NETREP_FAULT_PLAN alone drills every ladder rung through
+    module_preservation (the CI chaos matrix): the env var activates a
+    default policy, the run recovers, and the result is bit-identical."""
+    import jax
+
+    plan, want_events, need_dev = LADDER_PLANS[rung]
+    if len(jax.devices()) < need_dev:
+        pytest.skip("needs the conftest multi-device CPU platform")
+    from netrep_tpu import module_preservation
+
+    monkeypatch.setenv("NETREP_FAULT_PLAN", plan)
+    path = str(tmp_path / f"chaos_{rung}.jsonl")
+    res = module_preservation(
+        **mp_kw, telemetry=path,
+        mesh=_perm_mesh(need_dev) if need_dev > 1 else None,
+    )
+    _assert_same_result(mp_baselines["fixed"], res)
+    evs = [e["ev"] for e in map(json.loads, open(path))]
+    for ev in want_events:
+        assert ev in evs, (rung, ev, [e for e in evs if "mesh" in e])
+
+
+def test_elastic_shrink_preserves_row_sharding(mp_baselines, tmp_path):
+    """A row-sharded (2-perm × 4-row) mesh losing half its devices
+    shrinks to a mesh that KEEPS the 4-way row sharding
+    (shrink_mesh picks the largest still-dividing row factor) and
+    resumes bit-identically — the large-n engine does not silently fall
+    back to replicated matrices while survivors can still hold shards."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the conftest 8-device CPU mesh")
+    from netrep_tpu import module_preservation
+    from netrep_tpu.parallel import mesh as meshmod
+
+    mixed = make_mixed_pair(120, 3, n_samples=16, seed=7)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    assign = {f"node_{i}": "0" for i in range(dn.shape[0])}
+    for lab, idx in mixed["specs"]:
+        for i in idx:
+            assign[f"node_{i}"] = str(lab)
+    path = str(tmp_path / "rowshrink.jsonl")
+    res = module_preservation(
+        network={"d": dn, "t": tn}, correlation={"d": dc, "t": tc},
+        data={"d": dd, "t": td}, module_assignments=assign,
+        discovery="d", test="t", n_perm=N_PERM, seed=0,
+        config=EngineConfig(chunk_size=16, matrix_sharding="row",
+                            autotune=False),
+        mesh=meshmod.make_mesh(n_perm_shards=2, n_row_shards=4),
+        telemetry=path,
+        fault_policy=FaultPolicy(plan="device_lost_partial@24",
+                                 backoff_base_s=0.0, backoff_jitter=0.0),
+    )
+    base = mp_baselines["fixed"]
+    np.testing.assert_array_equal(np.asarray(base.p_values),
+                                  np.asarray(res.p_values))
+    np.testing.assert_array_equal(base.nulls, res.nulls)
+    shrunk = next(e["data"] for e in map(json.loads, open(path))
+                  if e["ev"] == "mesh_shrunk")
+    assert shrunk["n_surviving"] == 4
+    evs = [e["ev"] for e in map(json.loads, open(path))]
+    assert "degraded_to_cpu" not in evs
+    # no fingerprint escape hatch involved: padding changed (none here,
+    # 120 % 4 == 0) but more importantly the digest is layout-free
+    assert "fingerprint_degraded_accept" not in evs
