@@ -32,6 +32,11 @@ BASELINE.md):
                      the fixed-n chunk loop on the same problem/key — one
                      row with both wall-clocks, dispatches issued, and
                      device→host bytes (counts parity asserted first)
+    --config serve   `netrep serve` load generator (benchmarks/serve_load.py):
+                     closed-/open-loop mixed multi-tenant traffic against the
+                     in-process server — p50/p99 latency, aggregate perms/s,
+                     cross-request pack statistics, warm-pool compile_span
+                     proof, and throughput vs the serial direct-call baseline
     --config oracle  pure-NumPy oracle (the reference-style CPU loop) on the
                      north-star problem shape at a reduced permutation count
                      (default 50) — the per-config "oracle-CPU" baseline row;
@@ -1151,7 +1156,7 @@ def main():
     ap.add_argument("--config", default="north",
                     choices=["north", "A", "B", "C", "D", "E", "oracle",
                              "native", "sharded", "adaptive", "superchunk",
-                             "multichip"])
+                             "multichip", "serve"])
     ap.add_argument("--devices", type=int, default=None,
                     help="multichip child marker: measure ONE scaling "
                          "point on this many devices (the parent spawns "
@@ -1198,7 +1203,7 @@ def main():
     from netrep_tpu.utils.backend import tunnel_expected
 
     if (args.config in ("north", "A", "B", "C", "D", "E", "sharded",
-                        "adaptive", "superchunk")
+                        "adaptive", "superchunk", "serve")
             and tunnel_expected()
             and not os.environ.get("NETREP_BENCH_NO_SUBPROC")):
         # every config that may touch the tunnel backend (A runs the JAX
@@ -1251,6 +1256,22 @@ def main():
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "benchmarks", "microbench_sharded_gather.py"),
         ])
+    if args.config == "serve":
+        # the serve load generator (ISSUE 7): closed-/open-loop mixed
+        # multi-tenant traffic against the in-process server — p50/p99
+        # latency, aggregate perms/s, pack statistics, warm-pool compile
+        # proof, and the >= 2x-vs-serial acceptance row. Delegated like
+        # `sharded` (it resolves its own backend and owns its shapes).
+        import subprocess
+
+        cmd = [
+            sys.executable,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "benchmarks", "serve_load.py"),
+        ]
+        if args.smoke:
+            cmd.append("--smoke")
+        return subprocess.call(cmd)
     if args.config == "native":
         # self-contained CPU config (forces cpu platform itself)
         return bench_native(args)

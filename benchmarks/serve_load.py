@@ -1,0 +1,431 @@
+"""Load generator for `netrep serve` (ISSUE 7).
+
+Drives the in-process client with mixed multi-tenant traffic — small and
+large networks, mixed permutation budgets, a slice of adaptive requests,
+two tenants sharing identical registered data (so cross-TENANT packs
+form) — in two arrival disciplines:
+
+- **closed loop**: one worker per tenant submits its requests
+  back-to-back, waiting for each result (concurrency = tenant count);
+  measured from a cold server, so the first same-shape request pays the
+  compile and every later one must hit the warm pool;
+- **open loop**: every request is submitted asynchronously on a fixed
+  arrival schedule against the now-warm server — the steady-state
+  latency picture.
+
+Each mode emits ONE bench-style JSON row: wall-clock, aggregate perms/s,
+p50/p99 latency, pack statistics, pool hit counts, and the
+``compile_span`` cold/warm split read back from the run's telemetry (the
+PR 5 proof metric: warm ≈ 0). ``vs_baseline`` divides the serial
+one-request-at-a-time baseline's wall-clock (direct
+``module_preservation()`` per request — the pre-serve workflow) by the
+served wall-clock; the ISSUE 7 acceptance asks ≥ 2× on CPU for the
+closed loop. Before any number is emitted, one served request is
+asserted bit-identical to its direct call — a fast-but-wrong row is
+impossible.
+
+Rows feed the perf-regression ledger when ``NETREP_PERF_LEDGER`` is set
+(``source="serve"`` entries; the engine runs inside the server also
+append their own ``packed:<G>``-fingerprinted entries).
+
+``--drill`` runs the daemon lifecycle check instead: boot
+``python -m netrep_tpu serve --socket ...`` as a subprocess, serve one
+request over the socket, SIGTERM it, and assert the graceful-drain
+contract (exit 0 + a final ``{"serve": "drained"}`` line) — the
+``tpu_watch.sh`` SERVE_DRILL cycle.
+
+Usage: python benchmarks/serve_load.py [--smoke] [--mode both|closed|open]
+                                       [--requests N] [--rate R] [--drill]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def emit(row: dict) -> None:
+    if os.environ.get("NETREP_PERF_LEDGER"):
+        from netrep_tpu.utils import perfledger
+
+        entry = perfledger.entry_from_bench_row(row, source="serve")
+        if entry is not None:
+            perfledger.append_entry(entry,
+                                    os.environ["NETREP_PERF_LEDGER"])
+    print(json.dumps(row), flush=True)
+
+
+def build_workload(args):
+    """(tenant registrations, request list). Tenants alpha+beta share the
+    SAME fixture data (cross-tenant packs must form); gamma brings the
+    large network. Mixed n_perm and a slice of adaptive requests exercise
+    ceiling and rule retirement inside shared dispatches."""
+    from netrep_tpu.data import make_mixed_pair
+
+    def fixture(genes, modules, seed):
+        mixed = make_mixed_pair(genes, modules, n_samples=args.samples,
+                                seed=seed)
+        assign = {f"node_{i}": "0" for i in range(genes)}
+        for lab, idx in mixed["specs"]:
+            for i in idx:
+                assign[f"node_{i}"] = str(lab)
+        return mixed, assign
+
+    small = fixture(args.genes_small, args.modules_small, 7)
+    large = fixture(args.genes_large, args.modules_large, 11)
+    tenants = {
+        "alpha": {"weight": 2, "fixture": small},
+        "beta": {"weight": 1, "fixture": small},   # same data as alpha
+        "gamma": {"weight": 1, "fixture": large},
+    }
+    requests = []
+    budgets = (args.n_perm_lo, args.n_perm_hi)
+    for ti, name in enumerate(tenants):
+        for i in range(args.requests):
+            requests.append({
+                "tenant": name,
+                "n_perm": budgets[i % len(budgets)],
+                "seed": 1000 * ti + i,
+                "adaptive": (i % 3 == 2),
+            })
+    return tenants, requests
+
+
+def make_server(args, tenants, tel_path):
+    from netrep_tpu.serve import InProcessClient, PreservationServer, ServeConfig
+    from netrep_tpu.utils.config import EngineConfig
+
+    srv = PreservationServer(ServeConfig(
+        engine=EngineConfig(chunk_size=args.chunk, autotune=False),
+        max_pack=args.max_pack, pool_size=args.pool_size,
+        pack_window_s=0.1, telemetry=tel_path,
+    ))
+    client = InProcessClient(srv)
+    for name, spec in tenants.items():
+        client.register_tenant(name, spec["weight"])
+        mixed, assign = spec["fixture"]
+        (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+        client.register_dataset(name, "d", network=dn, correlation=dc,
+                                data=dd, assignments=assign)
+        client.register_dataset(name, "t", network=tn, correlation=tc,
+                                data=td)
+    return srv, client
+
+
+def run_serial_baseline(args, tenants, requests):
+    """The pre-serve workflow: one direct ``module_preservation()`` call
+    per request, one at a time — every call builds (and compiles) a fresh
+    engine. Returns (wall_s, total_perms, one direct result for the
+    parity gate)."""
+    from netrep_tpu import module_preservation
+    from netrep_tpu.utils.config import EngineConfig
+
+    cfg = EngineConfig(chunk_size=args.chunk, autotune=False)
+    total_perms = 0
+    first = None
+    t0 = time.perf_counter()
+    for r in requests:
+        mixed, assign = tenants[r["tenant"]]["fixture"]
+        (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+        res = module_preservation(
+            network={"d": dn, "t": tn}, correlation={"d": dc, "t": tc},
+            data={"d": dd, "t": td}, module_assignments=assign,
+            discovery="d", test="t", n_perm=r["n_perm"], seed=r["seed"],
+            adaptive=r["adaptive"], config=cfg,
+        )
+        total_perms += int(res.completed)
+        if first is None:
+            first = res
+    return time.perf_counter() - t0, total_perms, first
+
+
+def run_closed_loop(client, requests):
+    """Per-tenant submit-wait-submit workers; returns (wall_s, results,
+    latencies)."""
+    by_tenant: dict[str, list] = {}
+    for r in requests:
+        by_tenant.setdefault(r["tenant"], []).append(r)
+    results, lats = [], []
+    lock = threading.Lock()
+    errors = []
+
+    def worker(items):
+        for r in items:
+            try:
+                res = client.analyze(
+                    r["tenant"], "d", "t", n_perm=r["n_perm"],
+                    seed=r["seed"], adaptive=r["adaptive"], timeout=1200,
+                )
+            except Exception as e:  # surfaced after join
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                return
+            with lock:
+                results.append((r, res))
+                lats.append(res["latency_s"])
+
+    threads = [
+        threading.Thread(target=worker, args=(items,), daemon=True)
+        for items in by_tenant.values()
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise RuntimeError("closed-loop worker failed: " + errors[0])
+    return wall, results, lats
+
+
+def run_open_loop(client, requests, rate: float):
+    """Fixed-rate asynchronous arrivals; returns (wall_s, results,
+    latencies)."""
+    handles = []
+    gap = 1.0 / rate if rate > 0 else 0.0
+    t0 = time.perf_counter()
+    for r in requests:
+        handles.append((r, client.submit(
+            r["tenant"], "d", "t", n_perm=r["n_perm"], seed=r["seed"],
+            adaptive=r["adaptive"],
+        )))
+        if gap:
+            time.sleep(gap)
+    results, lats = [], []
+    for r, h in handles:
+        res = client.result(h, timeout=1200)
+        results.append((r, res))
+        lats.append(res["latency_s"])
+    return time.perf_counter() - t0, results, lats
+
+
+def compile_split(tel_path):
+    """(cold_total_s, warm_max_s) over the run's ``compile_span`` events:
+    first event per fingerprint is the cold compile, every later one must
+    be ~0 on a warm pool."""
+    cold, warm = 0.0, 0.0
+    seen = set()
+    try:
+        with open(tel_path, encoding="utf-8") as f:
+            for line in f:
+                if '"compile_span"' not in line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if e.get("ev") != "compile_span":
+                    continue
+                key = e["data"].get("key")
+                s = float(e["data"].get("s", 0.0))
+                if key in seen:
+                    warm = max(warm, s)
+                else:
+                    seen.add(key)
+                    cold += s
+    except OSError:
+        pass
+    return cold, warm
+
+
+def row_from(mode, args, wall, results, lats, serial_s, srv, tel_path,
+             device):
+    st = srv.stats()
+    total_perms = sum(int(res["completed"]) for _r, res in results)
+    packs = max(1, st["packs"])
+    cold, warm = compile_split(tel_path)
+    return {
+        "metric": (
+            f"serve load {mode} ({len(st['tenants'])} tenants x "
+            f"{args.requests} req, mixed n_perm "
+            f"{args.n_perm_lo}/{args.n_perm_hi}, chunk {args.chunk})"
+        ),
+        "value": round(wall, 3),
+        "unit": "s",
+        # acceptance: packed+warm serving vs the serial direct-call
+        # workflow on the SAME request list — >= 2x on CPU for closed loop
+        "vs_baseline": round(serial_s / wall, 3),
+        "serial_s": round(serial_s, 3),
+        "perms_per_sec": round(total_perms / wall, 2),
+        "requests": len(results),
+        "p50_ms": round(1000 * float(np.percentile(lats, 50)), 1),
+        "p99_ms": round(1000 * float(np.percentile(lats, 99)), 1),
+        "packs": st["packs"],
+        "mean_pack_size": round(
+            sum(res["pack_size"] for _r, res in results) / len(results), 2
+        ),
+        "pool_hits": st["pool"]["hits"],
+        "pool_misses": st["pool"]["misses"],
+        "compile_span_cold_s": round(cold, 3),
+        "compile_span_warm_max_s": round(warm, 4),
+        "telemetry": tel_path,
+        "device": device,
+        "chunk": args.chunk,
+    }
+
+
+def run_drill(args) -> int:
+    """Daemon lifecycle drill: boot the socket daemon, serve one request,
+    SIGTERM, assert graceful drain (exit 0 + drained line)."""
+    import signal
+    import subprocess
+
+    sock = os.path.join(tempfile.mkdtemp(prefix="netrep_serve_"),
+                        "serve.sock")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "netrep_tpu", "serve", "--socket", sock,
+         "--chunk", str(args.chunk)],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, env={**os.environ, "JAX_PLATFORMS":
+                        os.environ.get("JAX_PLATFORMS", "cpu") or "cpu"},
+    )
+    try:
+        deadline = time.monotonic() + 120
+        while not os.path.exists(sock):
+            if time.monotonic() > deadline or proc.poll() is not None:
+                print(json.dumps({
+                    "metric": "serve drill", "error":
+                    "daemon never opened its socket",
+                }))
+                return 1
+            time.sleep(0.2)
+        from netrep_tpu.serve.client import SocketClient
+
+        client = SocketClient(sock, timeout=600)
+        client.ping()
+        client.register_fixture("drill", genes=args.genes_small,
+                                modules=args.modules_small, seed=7)
+        res = client.analyze("drill", "fx_d", "fx_t",
+                             n_perm=args.n_perm_lo, seed=1)
+        ok_served = res["completed"] == args.n_perm_lo
+        client.close()
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=args.drain_wait)
+        drained = any(
+            '"serve": "drained"' in line for line in out.splitlines()
+        )
+        ok = proc.returncode == 0 and drained and ok_served
+        print(json.dumps({
+            "metric": "serve drill (daemon boot -> analyze -> SIGTERM "
+                      "drain)",
+            "value": 1 if ok else 0,
+            "unit": "ok",
+            "served_ok": ok_served,
+            "drained": drained,
+            "returncode": proc.returncode,
+        }))
+        if not ok:
+            sys.stderr.write(err[-2000:] + "\n")
+        return 0 if ok else 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes for CI")
+    ap.add_argument("--mode", default="both",
+                    choices=["both", "closed", "open"])
+    ap.add_argument("--requests", type=int, default=None,
+                    help="requests per tenant (default 6; smoke 3)")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="open-loop arrival rate, req/s (default 4)")
+    ap.add_argument("--chunk", type=int, default=None)
+    ap.add_argument("--max-pack", type=int, default=4)
+    ap.add_argument("--pool-size", type=int, default=8)
+    ap.add_argument("--samples", type=int, default=24)
+    ap.add_argument("--genes-small", type=int, default=None)
+    ap.add_argument("--genes-large", type=int, default=None)
+    ap.add_argument("--modules-small", type=int, default=None)
+    ap.add_argument("--modules-large", type=int, default=None)
+    ap.add_argument("--n-perm-lo", type=int, default=None)
+    ap.add_argument("--n-perm-hi", type=int, default=None)
+    ap.add_argument("--telemetry", default=None)
+    ap.add_argument("--drill", action="store_true",
+                    help="daemon SIGTERM-drain drill instead of the load "
+                         "run")
+    ap.add_argument("--drain-wait", type=float, default=120.0)
+    args = ap.parse_args()
+
+    small_defaults = (
+        dict(requests=3, chunk=32, genes_small=100, genes_large=160,
+             modules_small=3, modules_large=4, n_perm_lo=64, n_perm_hi=128,
+             rate=4.0)
+        if args.smoke else
+        dict(requests=6, chunk=64, genes_small=300, genes_large=600,
+             modules_small=6, modules_large=10, n_perm_lo=512,
+             n_perm_hi=1024, rate=2.0)
+    )
+    for k, v in small_defaults.items():
+        if getattr(args, k) is None:
+            setattr(args, k, v)
+
+    from netrep_tpu.utils.backend import (
+        enable_persistent_cache, resolve_backend_or_cpu,
+    )
+
+    enable_persistent_cache()
+    resolve_backend_or_cpu()
+    import jax
+
+    if args.drill:
+        return run_drill(args)
+
+    device = str(jax.devices()[0])
+    tenants, requests = build_workload(args)
+
+    serial_s, _serial_perms, first_direct = run_serial_baseline(
+        args, tenants, requests
+    )
+
+    tel_path = args.telemetry or os.path.join(
+        tempfile.mkdtemp(prefix="netrep_serve_load_"), "serve.jsonl"
+    )
+    srv, client = make_server(args, tenants, tel_path)
+    rc = 0
+    try:
+        if args.mode in ("both", "closed"):
+            wall, results, lats = run_closed_loop(client, requests)
+            # parity gate before any number is emitted: the first request
+            # of the list, served vs direct (same seed) — bit-identical
+            r0 = requests[0]
+            served0 = next(
+                res for r, res in results
+                if r["tenant"] == r0["tenant"] and r["seed"] == r0["seed"]
+            )
+            assert np.array_equal(
+                served0["p_values"], np.asarray(first_direct.p_values)
+            ), "served/direct p-value mismatch"
+            emit(row_from("closed loop", args, wall, results, lats,
+                          serial_s, srv, tel_path, device))
+        if args.mode in ("both", "open"):
+            # one unreported warm-up pass: open-loop arrivals queue deeper
+            # than the closed loop and mint larger pack compositions —
+            # steady state (what the row claims) starts once those few
+            # canonical shapes are compiled into the warm pool
+            run_open_loop(client, requests, args.rate)
+            wall, results, lats = run_open_loop(client, requests,
+                                               args.rate)
+            emit(row_from("open loop (steady state)", args, wall, results,
+                          lats, serial_s, srv, tel_path, device))
+    finally:
+        srv.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -139,9 +139,44 @@ elastic_drill() {
   fi
 }
 
+# Serve drill (ISSUE 7, opt-in: SERVE_DRILL=auto or 1): once per watch
+# cycle, run the `netrep serve` load generator on CPU (closed-/open-loop
+# mixed-tenant traffic against the in-process server, rows into
+# $PERF_LEDGER), gate it with `perf --check`, then boot the real
+# unix-socket daemon and assert the clean-SIGTERM-drain contract
+# (serve_load.py --drill: exit 0 + a final {"serve": "drained"} line).
+# Default off — the serve path never touches the TPU, so it only earns
+# cycle time when a serving deployment is being watched.
+SERVE_DRILL=${SERVE_DRILL:-0}
+serve_drill() {
+  case "$SERVE_DRILL" in
+    auto|1) ;;
+    *) return 0 ;;
+  esac
+  # the state-machine tests run with second-scale timeouts; 'auto' stays
+  # off under the QUEUE_FILE hook like the elastic drill
+  [ "$SERVE_DRILL" = auto ] && [ -n "${QUEUE_FILE:-}" ] && return 0
+  echo "--- serve drill ($(date -u +%FT%TZ)) ---" | tee -a "$LOG"
+  if ! timeout 900 env JAX_PLATFORMS=cpu NETREP_BENCH_NO_SUBPROC=1 \
+       python benchmarks/serve_load.py --smoke >>"$LOG" 2>&1; then
+    echo "--- SERVE LOAD FAILED (packing/pool/scheduler regressed?) ---" | tee -a "$LOG"
+  fi
+  if [ -s "$PERF_LEDGER" ]; then
+    if ! perf_out=$(timeout 60 python -m netrep_tpu perf "$PERF_LEDGER" --check 2>/dev/null); then
+      echo "--- PERF REGRESSION after serve drill ---" | tee -a "$LOG"
+      echo "$perf_out" | tee -a "$LOG"
+    fi
+  fi
+  if ! timeout 600 env JAX_PLATFORMS=cpu python benchmarks/serve_load.py \
+       --smoke --drill >>"$LOG" 2>&1; then
+    echo "--- SERVE DRILL FAILED (daemon SIGTERM drain regressed?) ---" | tee -a "$LOG"
+  fi
+}
+
 echo "== watcher start $(date -u +%FT%TZ) (log=$LOG state=$STATE) ==" | tee -a "$LOG"
 while :; do
   elastic_drill
+  serve_drill
   # drained first: with a cutoff set, an empty queue would otherwise be
   # reported as "no step can finish before cutoff" (review r5 — the test
   # harness caught the misleading exit line)

@@ -20,6 +20,12 @@ backend) needs the equivalent one-liner. Commands:
   order), and ``--trace out.json`` exports the span tree as
   Chrome/Perfetto trace-event JSON (open in Perfetto/chrome://tracing).
   Runs without touching any backend — safe on a box whose tunnel is dead.
+- ``serve`` — the always-on multi-tenant preservation service (ISSUE 7;
+  :mod:`netrep_tpu.serve`): a unix-socket (or stdio) daemon with a job
+  queue that packs concurrent requests into shared device dispatches on
+  warm compiled-engine pools, per-tenant fairness and admission control,
+  Prometheus metrics via the ``metrics`` op, and graceful SIGTERM drain.
+  The ``telemetry`` report gains a per-tenant section for its logs.
 - ``perf [<ledger>]`` — the throughput-regression ledger (ISSUE 5;
   :mod:`netrep_tpu.utils.perfledger`): prints the per-fingerprint trend,
   ``--check`` compares the newest entry against the robust median of its
@@ -181,6 +187,36 @@ def main(argv=None) -> int:
     pf.add_argument("--ingest", nargs="+", metavar="BENCH_JSON",
                     help="append entries converted from driver "
                          "BENCH_r0*.json files before any other action")
+    sv = sub.add_parser(
+        "serve",
+        help="always-on multi-tenant preservation service (ISSUE 7): "
+             "tenants register datasets once, then submit many analyses; "
+             "concurrent requests are packed into shared device "
+             "dispatches on warm compiled-engine pools. SIGTERM drains "
+             "gracefully.",
+    )
+    sv.add_argument("--socket", default=None, metavar="PATH",
+                    help="serve line-delimited JSON ops on this unix "
+                         "socket (default: stdin/stdout)")
+    sv.add_argument("--telemetry", default=None, metavar="PATH",
+                    help="append serving telemetry (request spans, pack "
+                         "events, engine runs) to this JSONL (default: "
+                         "$NETREP_TELEMETRY)")
+    sv.add_argument("--max-queue", type=_positive, default=64,
+                    help="per-tenant queue bound (admission control)")
+    sv.add_argument("--max-pack", type=_positive, default=4,
+                    help="max requests per shared dispatch pack")
+    sv.add_argument("--pool-size", type=int, default=8,
+                    help="warm compiled-engine pool size (LRU)")
+    sv.add_argument("--chunk", type=_positive, default=64,
+                    help="EngineConfig.chunk_size for served runs")
+    sv.add_argument("--n-perm", type=_positive, default=None,
+                    help="default permutation budget for requests that "
+                         "omit n_perm (default: the library's Bonferroni "
+                         "auto rule)")
+    sv.add_argument("--drain-timeout", type=float, default=120.0,
+                    help="max seconds to finish queued work on "
+                         "SIGTERM/shutdown before exiting anyway")
     ch = sub.add_parser(
         "chaos",
         help="deterministic elastic-recovery drill (ISSUE 6): run a toy "
@@ -283,13 +319,34 @@ def main(argv=None) -> int:
             print(json.dumps(reg.as_dict()))
         else:
             print(reg.render_summary())
+            from netrep_tpu.utils.telemetry import render_tenants
             from netrep_tpu.utils.trace import render_time_split
 
             split = render_time_split(args.path)
             if split:
                 print()
                 print(split)
+            # per-tenant serving section (ISSUE 7): present only for logs
+            # written by `netrep serve` / the load generator
+            tenants = render_tenants(args.path)
+            if tenants:
+                print()
+                print(tenants)
         return 0
+
+    if args.cmd == "serve":
+        # the daemon resolves its backend hang-safely like selftest below
+        # (a dead tunnel must drop the service to CPU, not hang the boot)
+        from netrep_tpu.utils.backend import resolve_backend_or_cpu
+
+        resolve_backend_or_cpu()
+        if args.telemetry is None:
+            import os
+
+            args.telemetry = os.environ.get("NETREP_TELEMETRY") or None
+        from netrep_tpu.serve.server import serve_daemon
+
+        return serve_daemon(args)
 
     if args.cmd == "chaos":
         return _chaos(args)

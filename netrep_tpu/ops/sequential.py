@@ -318,6 +318,25 @@ class StopMonitor:
         self._emit_retired(newly)
         return newly
 
+    def force_retire(self, positions=None) -> np.ndarray:
+        """Administratively retire modules (LOCAL positions; default: every
+        still-active module) regardless of their statistical state — the
+        serving layer's per-request retirement view (ISSUE 7): a packed
+        request whose permutation budget (or latency SLO) is spent leaves
+        the shared dispatch through the same retirement path a
+        Besag–Clifford decision takes, so the engine's re-bucketing needs
+        no second exit mechanism. Tallies and ``n_used`` are left as
+        folded — the sequential Phipson–Smyth p-values at the retirement
+        point stay exact. Returns the positions actually retired (already-
+        retired ones are skipped)."""
+        pos = (
+            self.active_positions() if positions is None
+            else np.asarray(positions, dtype=np.int64).ravel()
+        )
+        pos = pos[self.active[pos]]
+        self.active[pos] = False
+        return pos
+
     def _emit_retired(self, newly: np.ndarray) -> None:
         """Telemetry for each freshly-retired module: its per-cell
         exceedance tallies and permutation count at the decision point —

@@ -1272,6 +1272,21 @@ def _perm_keys_jit(key: jax.Array, start: jax.Array, count: int) -> jax.Array:
     )
 
 
+@partial(jax.jit, static_argnums=(2,))
+def _perm_keys_grouped_jit(keys_g: jax.Array, start: jax.Array, count: int):
+    """(C, G) per-permutation keys for one PACKED chunk (ISSUE 7): column
+    g holds group g's solo-run keys ``fold_in(key_g, start + i)`` — each
+    packed request keeps its own RNG stream, so its permutations are
+    exactly the ones its stand-alone run draws at the same indices. Row
+    layout (perm axis leading) matches what ``lax.map`` consumes in the
+    packed chunk body, so no eager transpose of a typed-key array is ever
+    needed."""
+    idx = start + jnp.arange(count, dtype=jnp.uint32)
+    return jax.vmap(
+        lambda i: jax.vmap(lambda kg: jax.random.fold_in(kg, i))(keys_g)
+    )(idx)
+
+
 @partial(jax.jit, static_argnums=(2, 3))
 def _perm_keys2d_jit(key: jax.Array, start: jax.Array, k: int, c: int):
     """(K, C) per-permutation keys for one superchunk — the same
@@ -1414,6 +1429,23 @@ def fused_scan(keys, B: int, batch_body):
     )
     _, outs = jax.lax.scan(batch_body, None, kp.reshape(Cp // B, B))
     return outs, Cp
+
+
+def _idx_blocks_grouped(perms, cap: int, slices, groups) -> jnp.ndarray:
+    """Grouped variant of :func:`_idx_blocks` for PACKED chunks (ISSUE 7):
+    ``perms`` is ``(G, P)`` — one drawn permutation per key group (=
+    packed request) — and module k slices ``[off, off + size)`` out of
+    ITS group's permutation (``groups[k]``, a static int). Offsets are
+    request-local, so every packed module sees exactly the index sets its
+    stand-alone run gathers; slices from *different* groups may overlap —
+    the requests are independent analyses sharing one dispatch, not one
+    disjoint label shuffle. Result ``(K, cap)``, padded slots masked
+    downstream like :func:`_idx_blocks`."""
+    cols = []
+    for (off, size), g in zip(slices, groups):
+        idx = perms[g, off: off + size]
+        cols.append(jnp.pad(idx, [(0, cap - size)]))
+    return jnp.stack(cols, axis=-2)
 
 
 def _idx_blocks(perm, cap: int, slices) -> jnp.ndarray:
@@ -1599,19 +1631,14 @@ class PermutationEngine:
             )
         self.total_take = int(np.sum(sizes))
         self.pool = np.asarray(pool, dtype=np.int32)
-        if self.total_take > self.pool.size:
-            raise ValueError(
-                f"module sizes (total {self.total_take}) exceed the null "
-                f"candidate pool ({self.pool.size}); use null='all' or drop "
-                "modules"
-            )
+        self._check_pool()
         self._pool_dev = jnp.asarray(self.pool)
 
         # --- bucket construction: jit once per module-size bucket [B:5] ---
         # Discovery submatrices are gathered on device (jnp.take) so large
         # discovery matrices never need a host round-trip (Config D scale,
         # SURVEY.md §6). Discovery inputs may be numpy or jax arrays.
-        offsets = np.concatenate([[0], np.cumsum(sizes)])
+        offsets = self._slice_offsets(sizes)
         by_cap: dict[int, list[int]] = {}
         for k, m in enumerate(self.modules):
             by_cap.setdefault(config.rounded_cap(m.size), []).append(k)
@@ -1717,6 +1744,28 @@ class PermutationEngine:
         self._stream_super_cached: tuple | None = None
         self._stream_count_cached: tuple | None = None
 
+    def _check_pool(self) -> None:
+        """Permutation-pool oversubscription check. The packed serve engine
+        (ISSUE 7) overrides it with a per-request check: packed requests'
+        slices legitimately overlap (each request re-slices the drawn
+        permutation from offset 0, as its stand-alone run would), so the
+        UNION of their module sizes may exceed the pool while every
+        individual request stays valid."""
+        if self.total_take > self.pool.size:
+            raise ValueError(
+                f"module sizes (total {self.total_take}) exceed the null "
+                f"candidate pool ({self.pool.size}); use null='all' or drop "
+                "modules"
+            )
+
+    def _slice_offsets(self, sizes) -> np.ndarray:
+        """Per-module offsets into the drawn permutation — cumulative module
+        sizes, the reference's disjoint label-shuffle semantics. Indexable
+        by global module position. The packed serve engine (ISSUE 7)
+        overrides this with request-local offsets so every packed module
+        slices exactly where its stand-alone run would."""
+        return np.concatenate([[0], np.cumsum(sizes)])
+
     def rebucket(self, active) -> None:
         """Rebuild the bucket list for the module subset ``active`` (global
         positions) — the adaptive engine's retirement path: later chunks
@@ -1736,6 +1785,13 @@ class PermutationEngine:
         bad = keep - set(range(self.n_modules))
         if bad:
             raise ValueError(f"unknown module positions: {sorted(bad)}")
+        if keep == set(range(self.n_modules)) and sum(
+            len(b.module_pos) for b in self.buckets
+        ) == self.n_modules:
+            # already at full strength: a no-op restore must not discard
+            # the cached jitted programs — the serve warm pool (ISSUE 7)
+            # relies on a retirement-free run leaving the engine compiled
+            return
         new = []
         for b in self._buckets_full:
             sel = [i for i, p in enumerate(b.module_pos) if p in keep]
@@ -2251,6 +2307,47 @@ class PermutationEngine:
             ),
             alternative, rule or StopRule(),
         )
+        return self.run_null_monitored(
+            n_perm, key, monitor, progress=progress,
+            checkpoint_path=checkpoint_path,
+            checkpoint_every=checkpoint_every, telemetry=telemetry,
+            fault_policy=fault_policy,
+        )
+
+    def run_null_monitored(
+        self,
+        n_perm: int,
+        key,
+        monitor,
+        progress: Callable[[int, int], None] | None = None,
+        checkpoint_path: str | None = None,
+        checkpoint_every: int = 8192,
+        telemetry=None,
+        fault_policy=None,
+    ) -> tuple[np.ndarray, int, bool]:
+        """Chunked null under a CALLER-SUPPLIED retirement monitor — the
+        packed-run entry point (ISSUE 7). ``monitor`` implements the
+        :class:`~netrep_tpu.ops.sequential.StopMonitor` update surface
+        (``update``/``active_positions``/``any_active``/``active``/
+        ``folded``/``total_evaluated``; plus ``state_arrays``/
+        ``restore_state`` when checkpointing): after each chunk it folds
+        the chunk's values for the active modules and returns the global
+        positions to retire — which then *drop out* of later dispatches
+        via the same retirement re-bucketing the adaptive engine uses.
+
+        The serve scheduler's pack monitor
+        (:class:`netrep_tpu.serve.packer.PackMonitor`) rides this to run
+        MANY requests' modules in shared module-size-bucket dispatches:
+        each request's modules retire at its own ``n_perm`` ceiling (and
+        by its own stop rule when adaptive), so cheap requests exit the
+        shared dispatch after a few hundred permutations instead of the
+        pack's maximum. The engine is restored to full strength on exit,
+        keeping warm-pool instances reusable."""
+        if self.discovery_only:
+            raise RuntimeError(
+                "engine was built discovery_only; test-side passes live in "
+                "the wrapping engine"
+            )
 
         def slice_vals(nulls, done, take, pos):
             return nulls[done: done + take][:, pos, :]
@@ -2266,7 +2363,8 @@ class PermutationEngine:
             )
         finally:
             # leave the engine reusable at full strength (e.g. a fixed-n
-            # run after an adaptive one on the same instance)
+            # run after an adaptive one on the same instance, or the next
+            # pack on a warm-pool engine)
             self.rebucket(range(self.n_modules))
 
     # ------------------------------------------------------------------

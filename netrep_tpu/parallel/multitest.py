@@ -503,13 +503,32 @@ class MultiTestEngine:
         statistic) cell is tallied independently and the shared permutation
         draw still serves all T cohorts of the surviving modules."""
         from ..ops.sequential import StopMonitor, StopRule
-        from .engine import run_adaptive_chunks
 
         obs = np.asarray(observed, dtype=np.float64)
         monitor = StopMonitor(
             np.moveaxis(obs, 0, 1).reshape(self.n_modules, -1),
             alternative, rule or StopRule(),
         )
+        return self.run_null_monitored(
+            n_perm, key, monitor, progress=progress,
+            checkpoint_path=checkpoint_path,
+            checkpoint_every=checkpoint_every, telemetry=telemetry,
+            fault_policy=fault_policy,
+        )
+
+    def run_null_monitored(self, n_perm: int, key, monitor, progress=None,
+                           checkpoint_path: str | None = None,
+                           checkpoint_every: int = 8192, telemetry=None,
+                           fault_policy=None):
+        """T-axis packed-run entry point (ISSUE 7) — the multi-test twin of
+        :meth:`PermutationEngine.run_null_monitored`: a chunked null under
+        a caller-supplied retirement monitor whose cell axis folds the T
+        datasets in as ``(n_modules, T*7)``. The serve scheduler drives
+        multi-test requests through this with its ceiling/SLO monitor, so
+        a request analyzing one discovery against several cohorts rides
+        ONE shared permutation draw per chunk (the vmap_tests contract)
+        while still exiting early through retirement re-bucketing."""
+        from .engine import run_adaptive_chunks
 
         def slice_vals(nulls, done, take, pos):
             block = nulls[:, done: done + take][:, :, pos, :]

@@ -1,0 +1,40 @@
+"""`netrep serve` — always-on multi-tenant preservation service (ISSUE 7).
+
+Turns the batch library into a request/response workload: tenants
+register datasets once, then submit many preservation analyses; the
+scheduler packs concurrent requests into shared module-size-bucket
+dispatches on warm pooled engines, with admission control, weighted
+round-robin fairness, SLO retirement, fault isolation, and a full
+telemetry/Prometheus ops surface. Served results are bit-identical to
+stand-alone ``module_preservation()`` calls with the same seed.
+
+Surface::
+
+    from netrep_tpu.serve import (
+        PreservationServer, ServeConfig, InProcessClient,
+    )
+
+Daemon: ``python -m netrep_tpu serve --socket /tmp/netrep.sock``.
+"""
+
+from .client import InProcessClient, SocketClient
+from .packer import PackedEngine, PackMonitor, RequestPlan, run_pack
+from .pool import ProgramPool
+from .scheduler import (
+    PreservationServer, QueueFull, Request, ServeConfig, ServeError,
+)
+
+__all__ = [
+    "PreservationServer",
+    "ServeConfig",
+    "ServeError",
+    "QueueFull",
+    "Request",
+    "InProcessClient",
+    "SocketClient",
+    "ProgramPool",
+    "PackedEngine",
+    "PackMonitor",
+    "RequestPlan",
+    "run_pack",
+]

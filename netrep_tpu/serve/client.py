@@ -1,0 +1,116 @@
+"""Clients for `netrep serve` (ISSUE 7).
+
+- :class:`InProcessClient` — wraps a live
+  :class:`~netrep_tpu.serve.scheduler.PreservationServer` directly: zero
+  transport, numpy in/out. This is what the tier-1 tests and the load
+  generator drive (the serve test surface is CPU-only and socket-free by
+  design).
+- :class:`SocketClient` — line-delimited JSON over the daemon's unix
+  socket (:mod:`netrep_tpu.serve.server`); arrays travel as nested
+  lists, responses come back with arrays re-materialized as numpy.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+
+from .protocol import decode_arrays, encode_arrays
+
+
+class InProcessClient:
+    """Direct (same-process) client — the canonical programmatic surface::
+
+        from netrep_tpu.serve import PreservationServer, InProcessClient
+        client = InProcessClient(PreservationServer())
+        client.register_dataset("acme", "d", network=..., correlation=...,
+                                data=..., assignments=labels)
+        client.register_dataset("acme", "t", network=..., correlation=...,
+                                data=...)
+        res = client.analyze("acme", "d", "t", n_perm=2000, seed=1)
+        res["p_values"]   # bit-identical to module_preservation(...)
+    """
+
+    def __init__(self, server):
+        self.server = server
+
+    def register_tenant(self, name: str, weight: int = 1) -> None:
+        self.server.register_tenant(name, weight)
+
+    def register_dataset(self, tenant: str, name: str, **kw) -> str:
+        return self.server.register_dataset(tenant, name, **kw)
+
+    def register_fixture(self, tenant: str, prefix: str = "fx", **kw) -> dict:
+        return self.server.register_fixture(tenant, prefix, **kw)
+
+    def submit(self, tenant: str, discovery: str, test, **kw):
+        """Non-blocking submit; returns the request handle for
+        :meth:`result`."""
+        return self.server.submit(tenant, discovery, test, **kw)
+
+    def result(self, handle, timeout: float | None = None) -> dict:
+        return self.server.wait(handle, timeout=timeout)
+
+    def analyze(self, tenant: str, discovery: str, test, *,
+                timeout: float | None = None, **kw) -> dict:
+        return self.server.analyze(tenant, discovery, test,
+                                   timeout=timeout, **kw)
+
+    def metrics(self) -> str:
+        return self.server.metrics_text()
+
+    def stats(self) -> dict:
+        return self.server.stats()
+
+
+class SocketClient:
+    """Line-delimited JSON client for the unix-socket daemon
+    (``python -m netrep_tpu serve --socket PATH``)."""
+
+    def __init__(self, path: str, timeout: float = 120.0):
+        self.path = path
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        self._sock.connect(path)
+        self._rfile = self._sock.makefile("r", encoding="utf-8")
+
+    def request(self, op: str, **kw) -> dict:
+        payload = encode_arrays({"op": op, **kw})
+        self._sock.sendall((json.dumps(payload) + "\n").encode("utf-8"))
+        line = self._rfile.readline()
+        if not line:
+            raise ConnectionError("serve daemon closed the connection")
+        resp = json.loads(line)
+        if not resp.get("ok", False):
+            raise RuntimeError(resp.get("error", "serve daemon error"))
+        return decode_arrays(resp)
+
+    def ping(self) -> dict:
+        return self.request("ping")
+
+    def register_fixture(self, tenant: str, prefix: str = "fx", **kw) -> dict:
+        return self.request("register_fixture", tenant=tenant,
+                            prefix=prefix, **kw)["fixture"]
+
+    def register_dataset(self, tenant: str, name: str, **kw) -> str:
+        return self.request("register", tenant=tenant, name=name,
+                            **kw)["digest"]
+
+    def analyze(self, tenant: str, discovery: str, test, **kw) -> dict:
+        return self.request("analyze", tenant=tenant, discovery=discovery,
+                            test=test, **kw)["result"]
+
+    def metrics(self) -> str:
+        return self.request("metrics")["text"]
+
+    def stats(self) -> dict:
+        return self.request("stats")["stats"]
+
+    def shutdown(self) -> dict:
+        return self.request("shutdown")
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+        finally:
+            self._sock.close()

@@ -1,0 +1,462 @@
+"""Cross-request dispatch packing (ISSUE 7).
+
+The serving workload is many small preservation requests against datasets
+registered once per tenant: same matrices, different module sets, seeds,
+permutation budgets. Run one at a time, each request pays a full jit
+compile and a full chain of per-chunk dispatch overheads for a few dozen
+modules of actual work. This module turns N compatible requests into ONE
+engine run:
+
+- :class:`PackedEngine` — a :class:`~netrep_tpu.parallel.engine
+  .PermutationEngine` whose module list is the UNION of the packed
+  requests' modules, re-bucketed into shared module-size buckets, with
+  two per-request identities preserved exactly:
+
+  * **slice offsets** are request-local (``_slice_offsets`` override):
+    module k of request r slices the drawn permutation at the offset its
+    stand-alone run would use, so slices of different requests may
+    overlap — the requests are independent analyses sharing a dispatch,
+    not one disjoint label shuffle;
+  * **RNG streams** are per request (*key groups*): the chunk draws one
+    permutation per group from ``fold_in(key_r, i)``
+    (:func:`~netrep_tpu.parallel.engine._perm_keys_grouped_jit`), so a
+    packed module sees bit-for-bit the index sets its stand-alone run
+    gathers at the same permutation indices.
+
+  Together these make a served result BIT-IDENTICAL to the direct
+  ``module_preservation()`` call with the same seed (pinned in
+  tests/test_serve.py), while the pack shares compiled programs, device
+  matrices, and per-chunk dispatch overhead across requests.
+
+- :class:`PackMonitor` — the retirement controller handed to
+  :meth:`~netrep_tpu.parallel.engine.PermutationEngine
+  .run_null_monitored`: each request's modules retire at its own
+  ``n_perm`` ceiling (and, when the request is adaptive, by its own
+  per-request :class:`~netrep_tpu.ops.sequential.StopMonitor` at the
+  same chunk boundaries its stand-alone run decides at), exiting the
+  shared dispatch via the engine's existing retirement re-bucketing —
+  adaptive early-stopping as the latency-SLO mechanism.
+
+- :func:`run_pack` — observed pass + monitored null + per-request result
+  extraction (exact Phipson–Smyth p-values per request at its own
+  permutation count and total permutation space).
+
+v1 scope: replicated matrices, no mesh, gather modes ``direct``/``mxu``
+(the serve tier-1 surface is CPU). Row-sharded and fused packs raise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from functools import partial
+
+import numpy as np
+
+import jax
+
+from ..ops import pvalues as pv
+from ..ops import stats as jstats
+from ..ops.oracle import N_STATS
+from ..ops.sequential import StopMonitor, StopRule
+from ..parallel.engine import (
+    ModuleSpec, PermutationEngine, _idx_blocks_grouped,
+    _perm_keys_grouped_jit,
+)
+from ..utils.config import EngineConfig
+
+
+@dataclasses.dataclass
+class RequestPlan:
+    """One request's stand-alone-run identity inside a pack: the module
+    specs (in the order ``module_preservation`` would keep them), the
+    permutation pool, budget, seed, and p-value conventions. ``base`` is
+    the request's global module offset in the pack, assigned by
+    :func:`assign_bases`."""
+
+    labels: list
+    specs: list[ModuleSpec]
+    counts: dict
+    pool: np.ndarray
+    n_perm: int
+    seed: int
+    alternative: str = "greater"
+    adaptive: bool = False
+    rule: object | None = None
+    base: int = 0
+
+    @property
+    def k(self) -> int:
+        return len(self.specs)
+
+    @property
+    def sizes(self) -> list[int]:
+        return [m.size for m in self.specs]
+
+    _sig: str | None = dataclasses.field(default=None, repr=False,
+                                         compare=False)
+
+    def signature(self) -> str:
+        """Structural identity of this plan for the warm program pool:
+        module labels/sizes/index content (seed, n_perm, alternative are
+        run-time data — two plans differing only there share compiled
+        programs). Memoized — the scheduler consults it per pack."""
+        if self._sig is not None:
+            return self._sig
+        h = hashlib.blake2b(digest_size=8)
+        for m in self.specs:
+            h.update(str(m.label).encode() + b"|")
+            h.update(np.ascontiguousarray(m.disc_idx, dtype=np.int64))
+            h.update(np.ascontiguousarray(m.test_idx, dtype=np.int64))
+        h.update(np.ascontiguousarray(self.pool, dtype=np.int64))
+        self._sig = h.hexdigest()
+        return self._sig
+
+
+def assign_bases(plans: list[RequestPlan]) -> int:
+    """Assign each plan its contiguous global module offset in the pack;
+    returns the union module count."""
+    base = 0
+    for p in plans:
+        p.base = base
+        base += p.k
+    return base
+
+
+class PackedEngine(PermutationEngine):
+    """Permutation engine over the UNION of several requests' modules with
+    per-request slice offsets and RNG key groups (module docstring).
+
+    ``request_modules`` is one ``[ModuleSpec, ...]`` list per packed
+    request, in :func:`assign_bases` order; ``key=`` arguments to the run
+    methods are then the same-length list of per-request seeds (or typed
+    keys). All requests must share the (discovery, test) matrices and the
+    permutation pool — the scheduler's pack key guarantees it.
+    """
+
+    def __init__(self, disc_corr, disc_net, disc_data, test_corr, test_net,
+                 test_data, request_modules, pool,
+                 config: EngineConfig = EngineConfig(), mesh=None):
+        if mesh is not None or config.matrix_sharding == "row":
+            raise ValueError(
+                "packed serve engines run replicated and mesh-free (v1); "
+                "drop the mesh / matrix_sharding='row'"
+            )
+        mods, offs, groups = [], [], []
+        pool_size = int(np.asarray(pool).size)
+        for g, specs in enumerate(request_modules):
+            off = 0
+            for m in specs:
+                mods.append(m)
+                offs.append(off)
+                groups.append(g)
+                off += m.size
+            # the per-REQUEST oversubscription check `_check_pool` waives
+            if off > pool_size:
+                raise ValueError(
+                    f"packed request {g}: module sizes (total {off}) exceed "
+                    f"the null candidate pool ({pool_size})"
+                )
+        if not mods:
+            raise ValueError("a pack needs at least one module")
+        self._packed_offsets = np.asarray(offs, dtype=np.int64)
+        self._module_group = np.asarray(groups, dtype=np.int64)
+        self.n_groups = len(request_modules)
+        super().__init__(disc_corr, disc_net, disc_data, test_corr, test_net,
+                         test_data, mods, pool, config=config, mesh=None)
+        if self.gather_mode == "fused":
+            raise ValueError(
+                "gather_mode='fused' is not supported by the packed engine "
+                "(v1); use 'direct'/'mxu'/'auto'"
+            )
+        #: jitted chunk programs keyed by the CURRENT bucket signature —
+        #: retirement re-bucketing produces a handful of shrunken
+        #: signatures per pack shape, and a warm-pool engine must reuse
+        #: their compiled programs across packs instead of re-tracing a
+        #: fresh closure every run (jit caches by function identity)
+        self._packed_fn_cache: dict = {}
+
+    # -- per-request identity hooks (see PermutationEngine) ----------------
+
+    def _check_pool(self) -> None:
+        # per-request totals were checked in __init__; the union of
+        # overlapping request-local slices may legitimately exceed the pool
+        return
+
+    def _slice_offsets(self, sizes) -> np.ndarray:
+        return self._packed_offsets
+
+    # -- key groups --------------------------------------------------------
+
+    def prepare_key(self, key):
+        """``key`` is the per-request seed list (ints or typed keys), in
+        group order — stacked into a (G,) typed key array."""
+        ks = [
+            jax.random.key(int(s)) if isinstance(s, (int, np.integer))
+            else s
+            for s in key
+        ]
+        if len(ks) != self.n_groups:
+            raise ValueError(
+                f"packed run needs {self.n_groups} per-request keys, "
+                f"got {len(ks)}"
+            )
+        import jax.numpy as jnp
+
+        return jnp.stack(ks)
+
+    def key_data(self, key):
+        return np.asarray(jax.random.key_data(key))
+
+    def perm_keys(self, key, start: int, count: int):
+        """(count, G) per-permutation keys — column g carries group g's
+        solo-run ``fold_in`` stream (perm axis leading for ``lax.map``)."""
+        import jax.numpy as jnp
+
+        return _perm_keys_grouped_jit(key, jnp.uint32(start), int(count))
+
+    # -- fingerprints ------------------------------------------------------
+
+    def autotune_key(self, extra: str = "") -> str:
+        """Serve-path compile/throughput fingerprint: the base problem-
+        shape key plus the pack's group count, so packed-run compile_span
+        events and perf-ledger entries never share a history with the
+        stand-alone engine of the same bucket signature."""
+        tag = f"packed:{self.n_groups}"
+        return super().autotune_key(
+            extra=f"{tag}|{extra}" if extra else tag
+        )
+
+    # -- chunk program -----------------------------------------------------
+
+    def chunk_body(self):
+        """Packed chunk program — the replicated branch of
+        :meth:`PermutationEngine.chunk_body` with per-permutation work
+        generalized from one drawn permutation to one PER KEY GROUP:
+        ``keys`` is ``(C, G)``; each permutation index draws G pool
+        shuffles and every bucket gathers each module's slice from its
+        group's shuffle (:func:`_idx_blocks_grouped`). Kernels, padding,
+        and batching are the base engine's — per-module numerics are
+        bit-identical to the stand-alone chunk program."""
+        cfg = self.config
+        caps_slices_groups = [
+            (b.cap, tuple(b.slices),
+             tuple(int(self._module_group[p]) for p in b.module_pos))
+            for b in self.buckets
+        ]
+        from ..utils.autotune import resolve_perm_batch
+
+        heuristic = cfg.resolved_perm_batch(
+            self.gather_mode, jax.default_backend(), self.effective_chunk(),
+            bytes_per_perm=self._mxu_bytes_per_perm(
+                int(self._test_corr.shape[-1]),
+                None if self._test_dataT is None
+                else int(self._test_dataT.shape[-1]),
+            ),
+        )
+        at_key = self.autotune_key()
+        perm_batch, at_cache = resolve_perm_batch(cfg, at_key, heuristic)
+        self._autotune_record = (
+            (at_cache, at_key, perm_batch) if at_cache is not None else None
+        )
+        kernel = partial(
+            jstats.gather_and_stats_mxu if self.gather_mode == "mxu"
+            else jstats.gather_and_stats,
+            n_iter=cfg.power_iters,
+            summary_method=cfg.summary_method,
+            net_beta=self.net_beta,
+        )
+
+        def chunk(keys, pool, tc, tn, td, discs):
+            # keys: (C, G) typed PRNG keys — row i holds every group's key
+            # for permutation index i
+            def per_perm(keys_row):
+                perms = jax.vmap(
+                    lambda k: jax.random.permutation(k, pool)
+                )(keys_row)  # (G, P)
+                outs_p = []
+                for (cap, slices, groups), disc in zip(
+                        caps_slices_groups, discs):
+                    idx_b = _idx_blocks_grouped(perms, cap, slices, groups)
+                    over_mods = jax.vmap(
+                        kernel, in_axes=(0, 0, None, None, None)
+                    )
+                    outs_p.append(over_mods(disc, idx_b, tc, tn, td))
+                return outs_p
+
+            return jax.lax.map(per_perm, keys, batch_size=perm_batch)
+
+        return chunk
+
+    def _chunk_fn(self):
+        # memoize jitted programs per bucket signature (not just "latest"):
+        # each retirement re-bucketing of a repeated pack shape then hits a
+        # warm program instead of re-tracing a fresh closure
+        sig = tuple(
+            (b.cap, tuple(b.slices), tuple(b.module_pos))
+            for b in self.buckets
+        )
+        fn = self._packed_fn_cache.get(sig)
+        if fn is None:
+            fn = self._build_chunk_fn()
+            self._packed_fn_cache[sig] = fn
+        return fn
+
+    def release(self) -> None:
+        self._packed_fn_cache = {}
+        super().release()
+
+
+class PackMonitor:
+    """Retirement controller for a packed run — the
+    :class:`~netrep_tpu.ops.sequential.StopMonitor`-shaped object
+    :meth:`~netrep_tpu.parallel.engine.PermutationEngine
+    .run_null_monitored` folds each chunk into.
+
+    Per request it applies, at every chunk boundary and in stand-alone-run
+    order:
+
+    1. **stop rule** (adaptive requests only): a child
+       :class:`StopMonitor` over the request's modules, fed exactly the
+       rows its stand-alone run would fold (the final chunk before the
+       request's ceiling is truncated to ``n_perm_r - folded``, matching
+       the solo loop's partial tail chunk) — decisions are bit-identical;
+    2. **ceiling**: once the pack's fold reaches the request's ``n_perm``,
+       its remaining modules are force-retired
+       (:meth:`StopMonitor.force_retire`) and leave the shared dispatch.
+
+    The pack keeps running while any request still owes permutations;
+    ``n_used`` records each module's per-request permutation count for
+    the sequential p-values.
+    """
+
+    def __init__(self, plans: list[RequestPlan], observed: np.ndarray):
+        self.plans = plans
+        self.observed = np.asarray(observed, dtype=np.float64)
+        self.n_modules = sum(p.k for p in plans)
+        if self.observed.shape[0] != self.n_modules:
+            raise ValueError(
+                f"observed has {self.observed.shape[0]} modules, plans "
+                f"describe {self.n_modules}"
+            )
+        self.active = np.ones(self.n_modules, dtype=bool)
+        self.n_used = np.zeros(self.n_modules, dtype=np.int64)
+        self.folded = 0
+        self.telemetry = None
+        self.children: list[StopMonitor | None] = []
+        for p in plans:
+            if p.adaptive:
+                self.children.append(StopMonitor(
+                    self.observed[p.base: p.base + p.k],
+                    p.alternative, p.rule or StopRule(),
+                ))
+            else:
+                self.children.append(None)
+
+    # -- StopMonitor surface ----------------------------------------------
+
+    def active_positions(self) -> np.ndarray:
+        return np.flatnonzero(self.active)
+
+    def any_active(self) -> bool:
+        return bool(self.active.any())
+
+    def total_evaluated(self) -> int:
+        return int(self.n_used.sum())
+
+    def update(self, vals: np.ndarray, take: int) -> np.ndarray:
+        """Fold one chunk (``vals``: ``(take, n_active, cells)`` in
+        :meth:`active_positions` order); returns the global positions
+        retired by this chunk — rule decisions and ceiling exits both."""
+        pos = self.active_positions()
+        vals = np.asarray(vals, dtype=np.float64)
+        done0 = self.folded
+        newly: list[np.ndarray] = []
+        for p, child in zip(self.plans, self.children):
+            cols = np.flatnonzero((pos >= p.base) & (pos < p.base + p.k))
+            if not cols.size:
+                continue
+            gpos = pos[cols]
+            # rows this request still owes — the solo run's own final
+            # partial chunk when the ceiling lands mid-chunk
+            rows = int(min(take, max(0, p.n_perm - done0)))
+            if rows > 0:
+                if child is not None:
+                    child.telemetry = self.telemetry
+                    retired = child.update(vals[:rows, cols, :], rows)
+                    self.n_used[p.base: p.base + p.k] = child.n_used
+                    if retired.size:
+                        g = p.base + retired
+                        self.active[g] = False
+                        newly.append(g)
+                else:
+                    self.n_used[gpos] += rows
+            if done0 + take >= p.n_perm:
+                # budget spent at this boundary: the request's surviving
+                # modules exit the shared dispatch (SLO/ceiling retirement)
+                if child is not None:
+                    ceiling = p.base + child.force_retire()
+                else:
+                    ceiling = gpos
+                still = ceiling[self.active[ceiling]]
+                if still.size:
+                    self.active[still] = False
+                    newly.append(still)
+        self.folded = done0 + int(take)
+        if newly:
+            return np.concatenate(newly)
+        return np.empty(0, dtype=np.int64)
+
+
+def run_pack(engine: PackedEngine, plans: list[RequestPlan],
+             telemetry=None, fault_policy=None, progress=None) -> list[dict]:
+    """Execute one pack: shared observed pass, monitored null over the
+    union buckets, then per-request result extraction. Returns one result
+    dict per plan (same order) with the exact numbers the stand-alone
+    ``module_preservation()`` call produces for that request's seed."""
+    observed = np.asarray(engine.observed(), dtype=np.float64)
+    monitor = PackMonitor(plans, observed)
+    n_perm_max = max(p.n_perm for p in plans)
+    seeds = [p.seed for p in plans]
+    nulls, completed, finished = engine.run_null_monitored(
+        n_perm_max, seeds, monitor, progress=progress,
+        telemetry=telemetry, fault_policy=fault_policy,
+    )
+    out = []
+    for p in plans:
+        obs_r = observed[p.base: p.base + p.k]
+        nulls_r = nulls[: p.n_perm, p.base: p.base + p.k, :]
+        total_space = pv.total_permutations(p.pool.size, p.sizes)
+        completed_r = min(int(completed), p.n_perm)
+        if p.adaptive:
+            p_values, n_used = pv.sequential_pvalues(
+                obs_r, nulls_r, p.alternative, total_nperm=total_space
+            )
+            p_type = "sequential"
+        else:
+            p_values = pv.permutation_pvalues(
+                obs_r, nulls_r, p.alternative, total_nperm=total_space
+            )
+            n_used = None
+            p_type = "fixed"
+        hi, lo, eff = pv.tail_counts(obs_r, nulls_r)
+        n_present = np.array([p.counts[lab][0] for lab in p.labels])
+        tot = np.array([p.counts[lab][1] for lab in p.labels])
+        out.append({
+            "module_labels": list(p.labels),
+            "observed": obs_r,
+            "p_values": p_values,
+            "counts_hi": hi, "counts_lo": lo, "counts_eff": eff,
+            "n_perm": int(p.n_perm),
+            "completed": completed_r,
+            "n_perm_used": n_used,
+            "p_type": p_type,
+            "alternative": p.alternative,
+            "seed": int(p.seed),
+            "n_vars_present": n_present,
+            "prop_vars_present": n_present / tot,
+            "total_size": tot,
+            "total_space": total_space,
+            "finished": bool(finished),
+        })
+    return out

@@ -1,0 +1,95 @@
+"""Warm compiled-program pool (ISSUE 7).
+
+A fresh engine instance re-traces and re-jits its chunk programs even when
+an identical problem shape ran a second ago (jit caches by function
+identity, and every engine builds fresh closures), so a naive service
+pays the compile tax on every request. The pool keeps ENGINE INSTANCES —
+device matrices, bucket structure, and their cached jitted programs —
+keyed by the pack's structural signature
+(:meth:`~netrep_tpu.serve.packer.RequestPlan.signature` per member plus
+the dataset-pair digest and engine-config identity). Steady-state
+requests with a repeated shape then hit a warm engine and pay zero
+compile — the proof metric is the PR 5 ``compile_span`` event dropping to
+~0 after the first same-fingerprint request (asserted by the load
+generator and tests/test_serve.py).
+
+Eviction is LRU with :meth:`~netrep_tpu.parallel.engine
+.PermutationEngine.release` on the way out, so a bounded pool never
+accumulates HBM: the superseded engine's device arrays are freed before
+the next build allocates (the ISSUE 6 release contract).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+
+class ProgramPool:
+    """LRU pool of warm engines. Thread-safe; builders run under the lock
+    (the scheduler has one worker, so contention is registration-only)."""
+
+    def __init__(self, max_size: int = 8):
+        self.max_size = int(max_size)
+        self._lru: "collections.OrderedDict[tuple, object]" = (
+            collections.OrderedDict()
+        )
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def get(self, key, builder):
+        """Return ``(engine, hit)`` — the pooled engine for ``key``, or a
+        fresh ``builder()`` result (cached unless the pool is disabled
+        with ``max_size=0``). Evicts least-recently-used engines above
+        ``max_size``, releasing their device arrays first."""
+        with self._lock:
+            eng = self._lru.pop(key, None)
+            hit = eng is not None
+            if eng is None:
+                self.misses += 1
+                eng = builder()
+            else:
+                self.hits += 1
+            if self.max_size > 0:
+                self._lru[key] = eng
+                while len(self._lru) > self.max_size:
+                    _, old = self._lru.popitem(last=False)
+                    self.evictions += 1
+                    rel = getattr(old, "release", None)
+                    if rel is not None:
+                        rel()
+            return eng, hit
+
+    def discard(self, key) -> None:
+        """Drop (and release) one pooled engine — the scheduler evicts an
+        engine whose run just failed rather than reuse suspect device
+        state."""
+        with self._lock:
+            old = self._lru.pop(key, None)
+        if old is not None:
+            rel = getattr(old, "release", None)
+            if rel is not None:
+                rel()
+
+    def clear(self) -> None:
+        """Release every pooled engine (service drain/shutdown)."""
+        with self._lock:
+            while self._lru:
+                _, old = self._lru.popitem(last=False)
+                rel = getattr(old, "release", None)
+                if rel is not None:
+                    rel()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "size": len(self._lru),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }

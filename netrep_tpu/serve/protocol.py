@@ -1,0 +1,66 @@
+"""Wire protocol of the `netrep serve` daemon (ISSUE 7).
+
+One JSON object per line in both directions; no HTTP framework. Requests
+carry an ``op`` discriminator; responses always carry ``ok`` (and
+``error`` when false). Arrays travel as nested lists — the client
+re-materializes the result keys in :data:`ARRAY_KEYS` as numpy.
+
+Ops (see :func:`netrep_tpu.serve.server.dispatch_op` for the executable
+definition)::
+
+    ping               liveness
+    register_fixture   server-side deterministic fixture registration
+                       (tenant, prefix, genes, modules, n_samples, seed)
+    register           dataset registration with inline matrices
+                       (tenant, name, correlation, network, data?,
+                        assignments?)
+    analyze            blocking preservation request (tenant, discovery,
+                       test | [tests...], modules?, n_perm?, seed,
+                       alternative?, adaptive?, deadline_s?, timeout?)
+    metrics            Prometheus text exposition (the /metrics surface)
+    stats              queue/pool/tenant counters as JSON
+    shutdown           initiate the graceful drain (same path as SIGTERM)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: result keys the wire protocol round-trips as arrays
+ARRAY_KEYS = (
+    "observed", "p_values", "counts_hi", "counts_lo", "counts_eff",
+    "n_perm_used", "n_vars_present", "prop_vars_present", "total_size",
+)
+
+
+def encode_arrays(obj):
+    """JSON-serializable deep copy: numpy arrays → nested lists, numpy
+    scalars → Python scalars."""
+    if isinstance(obj, dict):
+        return {k: encode_arrays(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [encode_arrays(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    return obj
+
+
+def decode_arrays(obj):
+    """Inverse of :func:`encode_arrays` for result payloads: the
+    :data:`ARRAY_KEYS` fields (including inside per-test sub-results)
+    come back as numpy arrays."""
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            if k in ARRAY_KEYS and v is not None:
+                out[k] = np.asarray(v)
+            elif k == "tests" and isinstance(v, list):
+                out[k] = [decode_arrays(t) for t in v]
+            else:
+                out[k] = v
+        return out
+    return obj

@@ -1,0 +1,821 @@
+"""`netrep serve` core: job queue + scheduler + multi-tenant state (ISSUE 7).
+
+The always-on service the ROADMAP's "millions of users" north star needs:
+tenants register datasets ONCE, then submit many preservation requests
+against them; the scheduler re-buckets modules from different queued
+requests into shared module-size-bucket dispatches
+(:mod:`netrep_tpu.serve.packer`), runs them on warm pooled engines
+(:mod:`netrep_tpu.serve.pool`), and returns per-request results
+bit-identical to stand-alone ``module_preservation()`` calls.
+
+Scheduling policy:
+
+- **admission control**: a bounded per-tenant queue; a submit over the
+  bound is rejected immediately (``request_rejected`` event +
+  :class:`QueueFull`) — backpressure, not unbounded latency;
+- **weighted round-robin across tenants**: each tenant appears
+  ``weight`` times in the scheduling ring, so a heavy tenant cannot
+  starve a light one;
+- **oldest-deadline-first within a tenant**: requests carry a deadline
+  (submit time + ``slo_s`` unless given explicitly); the tenant's most
+  urgent request seeds each pack;
+- **opportunistic packing**: the seed request's pack key (dataset-pair
+  digest + engine-config identity) pulls compatible requests from EVERY
+  tenant's queue — cross-request, cross-tenant shared dispatches — up to
+  ``max_pack``;
+- **SLO mechanism**: each packed request retires at its own ``n_perm``
+  ceiling (and by its own stop rule when adaptive) via the engine's
+  retirement re-bucketing, so a cheap request never waits for the pack's
+  deepest member (:class:`~netrep_tpu.serve.packer.PackMonitor`);
+- **fault isolation**: every pack runs under the PR 4/6 fault ladder
+  (``fault_policy``); a failed pack is split and its members re-queued
+  solo, so one tenant's poisoned request (or a device loss mid-pack)
+  fails alone — the queue and the other tenants' work survive.
+
+The whole ops surface is the telemetry bus: a server-lifetime
+``serve_start``/``serve_end`` span, per-request
+``request_received``/``request_done`` spans (latency = span duration),
+``request_packed``/``request_rejected`` point events with per-tenant
+labels, and Prometheus exposition (:meth:`PreservationServer
+.metrics_text`) with per-tenant labeled series.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Sequence
+
+import numpy as np
+
+from ..models import dataset as ds
+from ..models.preservation import _overlap_setup
+from ..ops import pvalues as pv
+from ..utils import telemetry as tm
+from ..utils.checkpoint import content_digest
+from ..utils.config import EngineConfig
+from ..utils.faults import resolve_runtime
+from .packer import PackedEngine, PackMonitor, RequestPlan, assign_bases, run_pack
+from .pool import ProgramPool
+
+
+class ServeError(RuntimeError):
+    """A request failed (validation, execution, or unknown tenant/dataset)."""
+
+
+class QueueFull(ServeError):
+    """Admission control rejected the request: the tenant's queue is at
+    its bound — back off and retry (the service sheds load instead of
+    growing unbounded latency)."""
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Service knobs (transport-independent).
+
+    ``engine`` is the ONE :class:`EngineConfig` every served run uses —
+    pack compatibility requires a shared chunk size and kernel
+    configuration, and bit-parity with a direct call requires the caller
+    to use the same config. ``autotune=False`` by default so the serving
+    path is deterministic run-to-run.
+    """
+
+    max_queue: int = 64
+    max_pack: int = 4
+    pool_size: int = 8
+    #: batching window: a pack below ``max_pack`` waits this long for
+    #: compatible stragglers before dispatching — tiny against a request's
+    #: service time, decisive for pack formation under concurrent arrivals
+    #: (0 = dispatch immediately; the load generator uses ~0.1 s)
+    pack_window_s: float = 0.0
+    engine: EngineConfig = dataclasses.field(
+        default_factory=lambda: EngineConfig(chunk_size=64, autotune=False)
+    )
+    default_n_perm: int | None = None
+    null: str = "overlap"
+    background_label: str = "0"
+    slo_s: float = 60.0
+    fault_policy: object = None
+    telemetry: object = None
+
+
+@dataclasses.dataclass
+class Request:
+    """One queued analyze request (in-process handle; the transports wrap
+    it). ``done`` fires when ``result`` or ``error`` is set."""
+
+    id: str
+    tenant: str
+    discovery: str
+    test: object           # str, or list[str] for the multi-test path
+    seed: int
+    adaptive: bool
+    plan: object           # RequestPlan (single) or _MultiPlan
+    pack_key: object       # None = never packed (multi-test / solo-only)
+    deadline: float
+    submitted_m: float
+    seq: int
+    sid: str | None = None          # telemetry span id
+    solo_only: bool = False
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
+    result: dict | None = None
+    error: str | None = None
+
+
+@dataclasses.dataclass
+class _MultiPlan:
+    """Plan of a multi-test request (one discovery vs T cohorts sharing a
+    node universe) — served through the MultiTestEngine T-axis."""
+
+    plan: RequestPlan               # specs/pool/budget (shared across T)
+    test_names: list[str]
+
+
+class _Tenant:
+    def __init__(self, name: str, weight: int):
+        self.name = name
+        self.weight = max(1, int(weight))
+        self.datasets: dict[str, _Dataset] = {}
+        self.pending: list[Request] = []
+        self.counters = {
+            "received": 0, "done": 0, "failed": 0, "rejected": 0,
+        }
+
+
+class _Dataset:
+    def __init__(self, name: str, dataset, assignments, digest: str):
+        self.name = name
+        self.ds = dataset              # models.dataset.Dataset
+        self.assignments = assignments  # normalized {node: label} or None
+        self.digest = digest
+
+
+class PreservationServer:
+    """The in-process serving core — what the unix-socket daemon wraps and
+    what :class:`netrep_tpu.serve.client.InProcessClient` (and the tier-1
+    tests) drive directly."""
+
+    def __init__(self, config: ServeConfig | None = None, start: bool = True):
+        self.config = config or ServeConfig()
+        self.tel, self._tel_owned = tm.resolve_arg(self.config.telemetry)
+        self._fault = resolve_runtime(self.config.fault_policy)
+        self._work = threading.Condition()
+        self._tenants: dict[str, _Tenant] = {}
+        self._tenant_order: list[str] = []
+        self._rr: list[str] = []       # weighted ring (name x weight)
+        self._rr_pos = 0
+        self._seq = 0
+        self._pack_seq = 0
+        self._inflight = 0
+        self._accepting = True
+        self._stop = False
+        self._started_m = time.monotonic()
+        self.pool = ProgramPool(self.config.pool_size)
+        self._engine_cfg_id = repr(self.config.engine)
+        self._serve_sid = None
+        if self.tel is not None:
+            self._serve_sid = self.tel.begin_span(
+                "serve_start", max_queue=self.config.max_queue,
+                max_pack=self.config.max_pack,
+                pool_size=self.config.pool_size,
+            )
+        self._worker: threading.Thread | None = None
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._worker is not None:
+            return
+        self._worker = threading.Thread(
+            target=self._loop, name="netrep-serve-worker", daemon=True
+        )
+        self._worker.start()
+
+    def close(self, drain: bool = True, timeout: float | None = None) -> None:
+        """Graceful shutdown: stop accepting, optionally finish every
+        queued request (the SIGTERM drain protocol), stop the worker,
+        release pooled engines, close the telemetry span/bus."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._work:
+            self._accepting = False
+            self._work.notify_all()
+        if drain and self._worker is not None:
+            with self._work:
+                while (self._inflight or self._any_pending_locked()):
+                    if deadline is not None and time.monotonic() > deadline:
+                        break
+                    self._work.wait(0.25)
+        with self._work:
+            self._stop = True
+            self._work.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=10.0)
+            self._worker = None
+        self.pool.clear()
+        if self.tel is not None:
+            done = sum(t.counters["done"] for t in self._tenants.values())
+            fail = sum(t.counters["failed"] for t in self._tenants.values())
+            dropped = sum(len(t.pending) for t in self._tenants.values())
+            self.tel.end_span(
+                self._serve_sid, "serve_end", drained=bool(drain),
+                requests_done=done, requests_failed=fail,
+                requests_dropped=dropped,
+                s=time.monotonic() - self._started_m,
+                **self.pool.stats(),
+            )
+            if self._tel_owned:
+                self.tel.close()
+
+    # -- registration ------------------------------------------------------
+
+    def register_tenant(self, name: str, weight: int = 1) -> None:
+        with self._work:
+            if name in self._tenants:
+                self._tenants[name].weight = max(1, int(weight))
+            else:
+                self._tenants[name] = _Tenant(name, weight)
+                self._tenant_order.append(name)
+            self._rr = [
+                n for n in self._tenant_order
+                for _ in range(self._tenants[n].weight)
+            ]
+            self._rr_pos %= max(1, len(self._rr))
+
+    def register_dataset(self, tenant: str, name: str, *, network,
+                         correlation, data=None, assignments=None) -> str:
+        """Register one named dataset for ``tenant`` (creating the tenant
+        at weight 1 if needed); returns the dataset's content digest —
+        the identity the cross-request pack key is built from, so two
+        tenants registering identical data can share dispatches."""
+        if tenant not in self._tenants:
+            self.register_tenant(tenant)
+        built = ds.build_datasets(
+            network={name: network},
+            data=None if data is None else {name: data},
+            correlation={name: correlation},
+        )
+        dataset = built[name]
+        norm = None
+        if assignments is not None:
+            norm = ds.normalize_module_assignments(
+                assignments, built, [name]
+            )[name]
+        digest = content_digest(
+            [dataset.correlation, dataset.network, dataset.data]
+        )
+        with self._work:
+            self._tenants[tenant].datasets[name] = _Dataset(
+                name, dataset, norm, digest
+            )
+        return digest
+
+    def register_fixture(self, tenant: str, prefix: str = "fx", *,
+                         genes: int = 120, modules: int = 3,
+                         n_samples: int = 16, seed: int = 7) -> dict:
+        """Generate and register a deterministic mixed discovery/test pair
+        (:func:`netrep_tpu.data.make_mixed_pair`) — the daemon drill and
+        load generator register fixtures by PARAMETERS instead of
+        shipping matrices over the wire."""
+        from ..data import make_mixed_pair
+
+        mixed = make_mixed_pair(genes, modules, n_samples=n_samples,
+                                seed=seed)
+        (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+        assign = {f"node_{i}": "0" for i in range(dn.shape[0])}
+        for lab, idx in mixed["specs"]:
+            for i in idx:
+                assign[f"node_{i}"] = str(lab)
+        d_name, t_name = f"{prefix}_d", f"{prefix}_t"
+        self.register_dataset(tenant, d_name, network=dn, correlation=dc,
+                              data=dd, assignments=assign)
+        self.register_dataset(tenant, t_name, network=tn, correlation=tc,
+                              data=td)
+        return {"discovery": d_name, "test": t_name,
+                "labels": [str(lab) for lab, _ in mixed["specs"]]}
+
+    # -- submission --------------------------------------------------------
+
+    def _dataset(self, tenant: str, name: str) -> _Dataset:
+        ten = self._tenants.get(tenant)
+        if ten is None:
+            raise ServeError(f"unknown tenant {tenant!r}")
+        d = ten.datasets.get(name)
+        if d is None:
+            raise ServeError(
+                f"tenant {tenant!r} has no dataset {name!r}; register it "
+                "first"
+            )
+        return d
+
+    def _auto_n_perm(self, labels, with_data: bool) -> int:
+        # the library's Bonferroni auto rule (models/preservation.py) —
+        # mirrored so a served request defaults exactly like a direct call
+        n_stats_eff = 7 if with_data else 3
+        return max(1000, pv.required_perms(
+            0.05, n_tests=len(labels) * n_stats_eff
+        ))
+
+    def _build_plan(self, disc: _Dataset, test: _Dataset, modules,
+                    n_perm, seed, alternative, adaptive, rule) -> RequestPlan:
+        if disc.assignments is None:
+            raise ServeError(
+                f"dataset {disc.name!r} was registered without module "
+                "assignments and cannot serve as a discovery dataset"
+            )
+        labels, mod_specs, counts, pool = _overlap_setup(
+            disc.ds, test.ds, disc.assignments, modules,
+            self.config.background_label, self.config.null,
+        )
+        with_data = disc.ds.data is not None and test.ds.data is not None
+        np_this = (
+            int(n_perm) if n_perm is not None
+            else self.config.default_n_perm
+            or self._auto_n_perm(labels, with_data)
+        )
+        return RequestPlan(
+            labels=labels, specs=mod_specs, counts=counts, pool=pool,
+            n_perm=np_this, seed=int(seed), alternative=alternative,
+            adaptive=bool(adaptive), rule=rule,
+        )
+
+    def submit(self, tenant: str, discovery: str, test,
+               modules: Sequence | None = None, n_perm: int | None = None,
+               seed: int = 0, alternative: str = "greater",
+               adaptive: bool = False, rule=None,
+               deadline_s: float | None = None) -> Request:
+        """Validate, admit, and enqueue one analyze request; returns the
+        request handle (``wait`` for the result). ``test`` may be a list
+        of test-dataset names sharing a node universe — the request then
+        rides the MultiTestEngine T-axis and returns per-test results."""
+        if alternative not in ("greater", "less", "two.sided"):
+            raise ServeError(f"bad alternative {alternative!r}")
+        disc = self._dataset(tenant, discovery)
+        multi = isinstance(test, (list, tuple))
+        if multi and len(test) == 1:
+            test, multi = test[0], False
+        if multi:
+            tests = [self._dataset(tenant, t) for t in test]
+            names0 = tests[0].ds.node_names
+            if any(t.ds.node_names != names0 for t in tests[1:]):
+                raise ServeError(
+                    "multi-test requests need test datasets with an "
+                    "identical node universe (the vmap_tests contract)"
+                )
+            if len({t.ds.data is not None for t in tests}) != 1:
+                raise ServeError(
+                    "multi-test requests need test datasets agreeing on "
+                    "data presence"
+                )
+            plan = _MultiPlan(
+                plan=self._build_plan(disc, tests[0], modules, n_perm,
+                                      seed, alternative, adaptive, rule),
+                test_names=[t.name for t in tests],
+            )
+            pack_key = None   # a multi-test request is its own pack
+        else:
+            tds = self._dataset(tenant, test)
+            plan = self._build_plan(disc, tds, modules, n_perm, seed,
+                                    alternative, adaptive, rule)
+            # compatibility identity: same matrices + same engine config
+            # => same pool, same kernels, one shared dispatch stream
+            pack_key = (disc.digest, tds.digest, self.config.null,
+                        self._engine_cfg_id)
+        now = time.monotonic()
+        with self._work:
+            ten = self._tenants[tenant]
+            if not self._accepting:
+                ten.counters["rejected"] += 1
+                if self.tel is not None:
+                    self.tel.emit("request_rejected", tenant=tenant,
+                                  reason="draining")
+                raise ServeError("server is draining; not accepting work")
+            if len(ten.pending) >= self.config.max_queue:
+                ten.counters["rejected"] += 1
+                if self.tel is not None:
+                    self.tel.emit(
+                        "request_rejected", tenant=tenant,
+                        reason="queue_full",
+                        queue_depth=len(ten.pending),
+                    )
+                raise QueueFull(
+                    f"tenant {tenant!r} queue is full "
+                    f"({self.config.max_queue}); retry later"
+                )
+            self._seq += 1
+            req = Request(
+                id=f"r{self._seq}", tenant=tenant, discovery=discovery,
+                test=list(test) if multi else test, seed=int(seed),
+                adaptive=bool(adaptive), plan=plan, pack_key=pack_key,
+                deadline=now + (
+                    deadline_s if deadline_s is not None
+                    else self.config.slo_s
+                ),
+                submitted_m=now, seq=self._seq,
+            )
+            ten.counters["received"] += 1
+            if self.tel is not None:
+                req.sid = self.tel.new_span_id()
+                self.tel.emit(
+                    "request_received", span=req.sid,
+                    parent=self._serve_sid, tenant=tenant,
+                    discovery=discovery,
+                    test="+".join(req.test) if multi else test,
+                    n_perm=int(
+                        plan.plan.n_perm if multi else plan.n_perm
+                    ),
+                    seed=int(seed), adaptive=bool(adaptive),
+                    queue_depth=len(ten.pending) + 1,
+                )
+            ten.pending.append(req)
+            self._work.notify_all()
+        return req
+
+    def wait(self, req: Request, timeout: float | None = None) -> dict:
+        if not req.done.wait(timeout):
+            raise TimeoutError(f"request {req.id} did not finish in time")
+        if req.error is not None:
+            raise ServeError(f"request {req.id}: {req.error}")
+        return req.result
+
+    def analyze(self, tenant: str, discovery: str, test, *,
+                timeout: float | None = None, **kw) -> dict:
+        """Blocking submit + wait (the one-call client surface)."""
+        return self.wait(
+            self.submit(tenant, discovery, test, **kw), timeout=timeout
+        )
+
+    # -- scheduling --------------------------------------------------------
+
+    def _any_pending_locked(self) -> bool:
+        return any(t.pending for t in self._tenants.values())
+
+    def _take_pack_locked(self) -> list[Request] | None:
+        """Pick the next batch under the lock: WRR across tenants picks
+        the seed tenant, oldest-deadline-first picks its seed request, and
+        the seed's pack key pulls compatible requests from every tenant's
+        queue (seed tenant first) up to ``max_pack``."""
+        if not self._rr or not self._any_pending_locked():
+            return None
+        n = len(self._rr)
+        ten = None
+        for step in range(n):
+            cand = self._tenants[self._rr[(self._rr_pos + step) % n]]
+            if cand.pending:
+                ten = cand
+                self._rr_pos = (self._rr_pos + step + 1) % n
+                break
+        if ten is None:
+            return None
+        seed_req = min(ten.pending, key=lambda r: (r.deadline, r.seq))
+        ten.pending.remove(seed_req)
+        batch = [seed_req]
+        self._fill_pack_locked(batch, ten.name)
+        return batch
+
+    def _fill_pack_locked(self, batch: list[Request],
+                          seed_tenant: str) -> None:
+        """Pull compatible requests from every tenant's queue (seed tenant
+        first) into ``batch``, up to ``max_pack``."""
+        seed_req = batch[0]
+        if (seed_req.pack_key is None or seed_req.solo_only
+                or self.config.max_pack <= 1):
+            return
+        order = [seed_tenant] + [
+            t for t in self._tenant_order if t != seed_tenant
+        ]
+        for name in order:
+            if len(batch) >= self.config.max_pack:
+                break
+            t = self._tenants[name]
+            matches = sorted(
+                (r for r in t.pending
+                 if r.pack_key == seed_req.pack_key and not r.solo_only),
+                key=lambda r: (r.deadline, r.seq),
+            )
+            for r in matches:
+                if len(batch) >= self.config.max_pack:
+                    break
+                t.pending.remove(r)
+                batch.append(r)
+
+    def _trim_pack_locked(self, batch: list[Request]) -> None:
+        """Canonicalize the pack size to the largest power of two that
+        fits, re-queueing the tail (original deadlines kept — they seed
+        the very next pack). Arbitrary sizes would mint a fresh engine
+        structure per composition (the warm pool keys on it); powers of
+        two bound the composition space to log(max_pack) shapes per base
+        signature, so steady-state traffic converges onto warm compiled
+        programs instead of compiling every batch-size it happens to
+        draw."""
+        if len(batch) < 2:
+            return
+        size = 1
+        while size * 2 <= len(batch):
+            size *= 2
+        for r in batch[size:]:
+            self._tenants[r.tenant].pending.append(r)
+        del batch[size:]
+
+    def _loop(self) -> None:
+        while True:
+            with self._work:
+                batch = self._take_pack_locked()
+                while batch is None and not self._stop:
+                    self._work.wait(0.25)
+                    batch = self._take_pack_locked()
+                if batch is None:
+                    return
+                if (self.config.pack_window_s > 0
+                        and len(batch) < self.config.max_pack
+                        and batch[0].pack_key is not None
+                        and not batch[0].solo_only and not self._stop):
+                    # batching window: let concurrent arrivals coalesce
+                    # into the shared dispatch before it launches —
+                    # milliseconds of queue wait against a service time
+                    # of seconds, and the difference between N singleton
+                    # compiles and one shared pack
+                    self._work.wait(self.config.pack_window_s)
+                    self._fill_pack_locked(batch, batch[0].tenant)
+                self._trim_pack_locked(batch)
+                self._inflight = len(batch)
+            try:
+                self._execute(batch)
+            except Exception:   # defensive: the worker must never die
+                import logging
+
+                logging.getLogger("netrep_tpu").warning(
+                    "serve worker: unhandled batch failure", exc_info=True
+                )
+                for r in batch:
+                    if not r.done.is_set():
+                        r.error = r.error or "internal server error"
+                        r.done.set()
+            finally:
+                with self._work:
+                    self._inflight = 0
+                    self._work.notify_all()
+
+    # -- execution ---------------------------------------------------------
+
+    def _finish(self, req: Request, result: dict | None, error: str | None,
+                pack_id: str, pack_size: int, pool_hit: bool) -> None:
+        ten = self._tenants[req.tenant]
+        now = time.monotonic()
+        if error is None:
+            req.result = dict(
+                result,
+                request_id=req.id, tenant=req.tenant,
+                discovery=req.discovery, test=req.test,
+                latency_s=now - req.submitted_m,
+                pack_id=pack_id, pack_size=pack_size, pool_hit=pool_hit,
+            )
+            ten.counters["done"] += 1
+        else:
+            req.error = error
+            ten.counters["failed"] += 1
+        if self.tel is not None:
+            data = dict(
+                tenant=req.tenant, s=now - req.submitted_m,
+                pack=pack_id, pack_size=pack_size, ok=error is None,
+            )
+            if error is None:
+                data["perms"] = int(result.get("completed", 0))
+            else:
+                data["error"] = error
+            self.tel.emit("request_done", span=req.sid, **data)
+        req.done.set()
+
+    def _requeue_solo(self, batch: list[Request]) -> None:
+        """A failed pack is split: every member re-queues solo-only (front
+        of its tenant's queue, original deadline), so one poisoned
+        request — or a device fault mid-pack — fails alone on its retry
+        instead of taking its pack-mates down."""
+        with self._work:
+            for r in batch:
+                r.solo_only = True
+                self._tenants[r.tenant].pending.append(r)
+            self._work.notify_all()
+        if self.tel is not None:
+            for r in batch:
+                self.tel.emit("request_requeued", tenant=r.tenant,
+                              reason="pack_failed", parent=r.sid)
+
+    def _execute(self, batch: list[Request]) -> None:
+        self._pack_seq += 1
+        pack_id = f"p{self._pack_seq}"
+        multi = isinstance(batch[0].plan, _MultiPlan)
+        # canonical member order → stable pool signatures across packs
+        if not multi:
+            batch = sorted(batch, key=lambda r: (r.plan.signature(), r.seq))
+        tel_cm = self.tel.activate() if self.tel is not None else None
+        if tel_cm is not None:
+            tel_cm.__enter__()
+        try:
+            if multi:
+                self._execute_multi(batch[0], pack_id)
+            else:
+                self._execute_pack(batch, pack_id)
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            if len(batch) > 1:
+                self._requeue_solo(batch)
+            else:
+                self._finish(batch[0], None, err, pack_id, len(batch),
+                             False)
+        finally:
+            if tel_cm is not None:
+                tel_cm.__exit__(None, None, None)
+
+    def _pool_key(self, kind: str, digests: tuple, plans) -> tuple:
+        return (kind, digests, self._engine_cfg_id,
+                tuple(p.signature() for p in plans))
+
+    def _emit_pool(self, hit: bool, pack_id: str, n: int) -> None:
+        if self.tel is not None:
+            self.tel.emit(
+                "serve_pool_hit" if hit else "serve_pool_miss",
+                pack=pack_id, n_requests=n, **self.pool.stats(),
+            )
+
+    def _execute_pack(self, batch: list[Request], pack_id: str) -> None:
+        plans = [r.plan for r in batch]
+        assign_bases(plans)
+        disc = self._dataset(batch[0].tenant, batch[0].discovery)
+        test = self._dataset(batch[0].tenant, batch[0].test)
+        key = self._pool_key("packed", (disc.digest, test.digest), plans)
+
+        def build():
+            return PackedEngine(
+                disc.ds.correlation, disc.ds.network, disc.ds.data,
+                test.ds.correlation, test.ds.network, test.ds.data,
+                [p.specs for p in plans], plans[0].pool,
+                config=self.config.engine,
+            )
+
+        engine, hit = self.pool.get(key, build)
+        self._emit_pool(hit, pack_id, len(batch))
+        if self.tel is not None:
+            for r in batch:
+                self.tel.emit(
+                    "request_packed", parent=r.sid, tenant=r.tenant,
+                    pack=pack_id, n_requests=len(batch), pool_hit=hit,
+                    queued_s=time.monotonic() - r.submitted_m,
+                )
+        try:
+            if self.tel is not None:
+                with self.tel.span("pack", pack=pack_id,
+                                   n_requests=len(batch),
+                                   tenants=sorted({r.tenant
+                                                   for r in batch})):
+                    results = run_pack(engine, plans, telemetry=self.tel,
+                                       fault_policy=self._fault)
+            else:
+                results = run_pack(engine, plans, fault_policy=self._fault)
+        except Exception:
+            # a failed run may leave the engine's device state suspect —
+            # drop it from the warm pool before the error propagates
+            self.pool.discard(key)
+            raise
+        for r, res in zip(batch, results):
+            self._finish(r, res, None, pack_id, len(batch), hit)
+
+    def _execute_multi(self, req: Request, pack_id: str) -> None:
+        from ..parallel.multitest import MultiTestEngine
+
+        mp: _MultiPlan = req.plan
+        plan = mp.plan
+        plan.base = 0
+        disc = self._dataset(req.tenant, req.discovery)
+        tests = [self._dataset(req.tenant, t) for t in mp.test_names]
+        key = self._pool_key(
+            "multi", (disc.digest,) + tuple(t.digest for t in tests),
+            [plan],
+        )
+
+        def build():
+            with_data = (disc.ds.data is not None
+                         and tests[0].ds.data is not None)
+            return MultiTestEngine(
+                disc.ds.correlation, disc.ds.network, disc.ds.data,
+                np.stack([t.ds.correlation for t in tests]),
+                np.stack([t.ds.network for t in tests]),
+                [t.ds.data for t in tests] if with_data else None,
+                plan.specs, plan.pool, config=self.config.engine,
+            )
+
+        engine, hit = self.pool.get(key, build)
+        self._emit_pool(hit, pack_id, 1)
+        if self.tel is not None:
+            self.tel.emit(
+                "request_packed", parent=req.sid, tenant=req.tenant,
+                pack=pack_id, n_requests=1, pool_hit=hit, taxis=len(tests),
+                queued_s=time.monotonic() - req.submitted_m,
+            )
+        T = len(tests)
+        try:
+            observed = np.asarray(engine.observed(), dtype=np.float64)
+            # fold the T axis into the monitor's cell axis — the
+            # MultiTestEngine adaptive convention (a module retires only
+            # when settled in every cohort); the ceiling monitor rides the
+            # same shape for fixed-n requests
+            obs_cells = np.moveaxis(observed, 0, 1).reshape(plan.k, -1)
+            monitor = PackMonitor([plan], obs_cells)
+            nulls, completed, finished = engine.run_null_monitored(
+                plan.n_perm, plan.seed, monitor, telemetry=self.tel,
+                fault_policy=self._fault,
+            )
+        except Exception:
+            self.pool.discard(key)
+            raise
+        total_space = pv.total_permutations(plan.pool.size, plan.sizes)
+        per_test = []
+        for ti in range(T):
+            obs_t = observed[ti]
+            nulls_t = nulls[ti][: plan.n_perm]
+            if plan.adaptive:
+                p_values, n_used = pv.sequential_pvalues(
+                    obs_t, nulls_t, plan.alternative,
+                    total_nperm=total_space,
+                )
+            else:
+                p_values = pv.permutation_pvalues(
+                    obs_t, nulls_t, plan.alternative,
+                    total_nperm=total_space,
+                )
+                n_used = None
+            hi, lo, eff = pv.tail_counts(obs_t, nulls_t)
+            per_test.append({
+                "test": mp.test_names[ti],
+                "observed": obs_t, "p_values": p_values,
+                "counts_hi": hi, "counts_lo": lo, "counts_eff": eff,
+                "n_perm_used": n_used,
+            })
+        result = {
+            "module_labels": list(plan.labels),
+            "tests": per_test,
+            "n_perm": int(plan.n_perm),
+            "completed": min(int(completed), plan.n_perm),
+            "p_type": "sequential" if plan.adaptive else "fixed",
+            "alternative": plan.alternative,
+            "seed": int(plan.seed),
+            "total_space": total_space,
+            "finished": bool(finished),
+        }
+        self._finish(req, result, None, pack_id, 1, hit)
+
+    # -- ops surface -------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._work:
+            return {
+                "tenants": {
+                    n: {
+                        "weight": t.weight,
+                        "queue_depth": len(t.pending),
+                        **t.counters,
+                    }
+                    for n, t in self._tenants.items()
+                },
+                "inflight": self._inflight,
+                "accepting": self._accepting,
+                "pool": self.pool.stats(),
+                "packs": self._pack_seq,
+            }
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition: the telemetry registry (when a bus
+        is attached) plus per-tenant labeled serving series — the
+        `/metrics`-style scrape surface the daemon exposes."""
+        parts = []
+        if self.tel is not None:
+            parts.append(self.tel.metrics.render_prometheus())
+        lines = []
+        st = self.stats()
+        lines.append("# TYPE netrep_serve_requests_total counter")
+        for name, t in sorted(st["tenants"].items()):
+            for outcome in ("received", "done", "failed", "rejected"):
+                lines.append(
+                    f'netrep_serve_requests_total{{tenant="{name}",'
+                    f'outcome="{outcome}"}} {t[outcome]}'
+                )
+        lines.append("# TYPE netrep_serve_queue_depth gauge")
+        for name, t in sorted(st["tenants"].items()):
+            lines.append(
+                f'netrep_serve_queue_depth{{tenant="{name}"}} '
+                f'{t["queue_depth"]}'
+            )
+        lines.append("# TYPE netrep_serve_pool_hits_total counter")
+        lines.append(f'netrep_serve_pool_hits_total {st["pool"]["hits"]}')
+        lines.append("# TYPE netrep_serve_pool_misses_total counter")
+        lines.append(
+            f'netrep_serve_pool_misses_total {st["pool"]["misses"]}'
+        )
+        lines.append("# TYPE netrep_serve_packs_total counter")
+        lines.append(f'netrep_serve_packs_total {st["packs"]}')
+        parts.append("\n".join(lines) + "\n")
+        return "".join(parts)

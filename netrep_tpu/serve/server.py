@@ -1,0 +1,199 @@
+"""`python -m netrep_tpu serve` — the always-on daemon (ISSUE 7).
+
+Transport is deliberately minimal: a unix-domain socket (or stdin/stdout)
+speaking one JSON object per line — no HTTP framework dependency. Each
+connection is handled on its own thread; every op gets exactly one JSON
+response line. The in-process scheduler
+(:class:`~netrep_tpu.serve.scheduler.PreservationServer`) does all the
+work; this module adds the wire, the `/metrics`-style scrape surface, and
+the drain protocol:
+
+**SIGTERM/SIGINT → graceful drain**: the listener stops accepting, every
+queued and in-flight request finishes (bounded by ``--drain-timeout``),
+pooled engines release their device arrays, the telemetry
+``serve_start``/``serve_end`` span closes, and the process exits 0 with a
+final ``{"serve": "drained", ...}`` line — the contract the
+``tpu_watch.sh`` serve drill asserts.
+
+Ops::
+
+    {"op": "ping"}
+    {"op": "register_fixture", "tenant": "a", "prefix": "fx",
+     "genes": 120, "modules": 3, "seed": 7}
+    {"op": "register", "tenant": "a", "name": "d",
+     "correlation": [[...]], "network": [[...]], "data": [[...]],
+     "assignments": {"node_0": "1", ...}}
+    {"op": "analyze", "tenant": "a", "discovery": "d", "test": "t",
+     "n_perm": 2000, "seed": 1, "adaptive": false}
+    {"op": "metrics"}   → Prometheus text exposition
+    {"op": "stats"}
+    {"op": "shutdown"}  → initiates the same drain as SIGTERM
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+
+import numpy as np
+
+from .protocol import encode_arrays
+from .scheduler import PreservationServer, ServeConfig, ServeError
+
+
+def dispatch_op(server: PreservationServer, op: dict,
+                stop: threading.Event) -> dict:
+    """Execute one wire op against the in-process server; returns the
+    response dict (``ok`` always present). Shared by the socket and stdio
+    transports."""
+    try:
+        kind = op.get("op")
+        if kind == "ping":
+            return {"ok": True, "pong": True}
+        if kind == "register_fixture":
+            kw = {k: int(op[k]) for k in ("genes", "modules", "n_samples",
+                                          "seed") if k in op}
+            fixture = server.register_fixture(
+                str(op["tenant"]), str(op.get("prefix", "fx")), **kw
+            )
+            return {"ok": True, "fixture": fixture}
+        if kind == "register":
+            data = op.get("data")
+            digest = server.register_dataset(
+                str(op["tenant"]), str(op["name"]),
+                network=np.asarray(op["network"], dtype=np.float64),
+                correlation=np.asarray(op["correlation"],
+                                       dtype=np.float64),
+                data=None if data is None
+                else np.asarray(data, dtype=np.float64),
+                assignments=op.get("assignments"),
+            )
+            return {"ok": True, "digest": digest}
+        if kind == "analyze":
+            kw = {}
+            for k in ("modules", "n_perm", "seed", "alternative",
+                      "adaptive", "deadline_s"):
+                if k in op and op[k] is not None:
+                    kw[k] = op[k]
+            result = server.analyze(
+                str(op["tenant"]), str(op["discovery"]), op["test"],
+                timeout=float(op.get("timeout", 600.0)), **kw,
+            )
+            return {"ok": True, "result": encode_arrays(result)}
+        if kind == "metrics":
+            return {"ok": True, "text": server.metrics_text()}
+        if kind == "stats":
+            return {"ok": True, "stats": server.stats()}
+        if kind == "shutdown":
+            stop.set()
+            return {"ok": True, "draining": True}
+        return {"ok": False, "error": f"unknown op {kind!r}"}
+    except (ServeError, TimeoutError, KeyError, TypeError,
+            ValueError) as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
+def _handle_conn(server: PreservationServer, conn: socket.socket,
+                 stop: threading.Event) -> None:
+    with conn:
+        rfile = conn.makefile("r", encoding="utf-8")
+        for line in rfile:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                op = json.loads(line)
+            except json.JSONDecodeError as e:
+                resp = {"ok": False, "error": f"bad JSON: {e}"}
+            else:
+                resp = dispatch_op(server, op, stop)
+            try:
+                conn.sendall((json.dumps(resp) + "\n").encode("utf-8"))
+            except OSError:
+                return
+            if stop.is_set():
+                return
+
+
+def serve_daemon(args) -> int:
+    """CLI entry (``python -m netrep_tpu serve``); see the module
+    docstring. Returns the process exit code."""
+    from ..utils.config import EngineConfig
+
+    cfg = ServeConfig(
+        max_queue=args.max_queue,
+        max_pack=args.max_pack,
+        pool_size=args.pool_size,
+        engine=EngineConfig(chunk_size=args.chunk, autotune=False),
+        default_n_perm=args.n_perm,
+        telemetry=args.telemetry,
+        fault_policy=True if os.environ.get("NETREP_FAULT_PLAN") else None,
+    )
+    server = PreservationServer(cfg)
+    stop = threading.Event()
+
+    def _drain_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _drain_signal)
+    signal.signal(signal.SIGINT, _drain_signal)
+
+    if args.socket:
+        path = args.socket
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        listener.bind(path)
+        listener.listen(16)
+        listener.settimeout(0.25)
+        print(json.dumps({"serve": "ready", "socket": path,
+                          "pid": os.getpid()}), flush=True)
+        try:
+            while not stop.is_set():
+                try:
+                    conn, _ = listener.accept()
+                except socket.timeout:
+                    continue
+                threading.Thread(
+                    target=_handle_conn, args=(server, conn, stop),
+                    daemon=True,
+                ).start()
+        finally:
+            listener.close()
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    else:
+        # stdio mode: one JSON op per stdin line, one response per stdout
+        # line; EOF drains. Useful for subprocess embedding and debugging.
+        print(json.dumps({"serve": "ready", "stdio": True,
+                          "pid": os.getpid()}), flush=True)
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                op = json.loads(line)
+            except json.JSONDecodeError as e:
+                resp = {"ok": False, "error": f"bad JSON: {e}"}
+            else:
+                resp = dispatch_op(server, op, stop)
+            print(json.dumps(resp), flush=True)
+            if stop.is_set():
+                break
+
+    # graceful drain: queued + in-flight work finishes, engines release,
+    # the serve span closes — then one final parseable line
+    server.close(drain=True, timeout=args.drain_timeout)
+    st = server.stats()
+    done = sum(t["done"] for t in st["tenants"].values())
+    print(json.dumps({"serve": "drained", "requests_done": done,
+                      "packs": st["packs"]}), flush=True)
+    return 0

@@ -300,14 +300,21 @@ def load_null_checkpoint(path: str) -> dict | None:
         }
 
 
-#: active degraded-rebuild acceptance scopes (ISSUE 5, closing the PR 4
-#: known gap): within one, a FINGERPRINT mismatch is tolerated with a
-#: ``fingerprint_degraded_accept`` event + warning instead of a refusal —
-#: a device-loss → CPU rebuild legitimately changes the fingerprint (a
-#: row-sharded engine's matrices are padded/sharded; the replicated
-#: rebuild's are not) while the problem and RNG stream are unchanged.
-#: Key/seed mismatches still ALWAYS raise: splicing two null streams is
-#: never right, degraded or not.
+#: active degraded-rebuild acceptance scopes. SCOPE NOTE (ISSUE 7 closes
+#: the long-lived known-gap comment here): the condition this scope was
+#: added for — a device-loss → CPU rebuild changing the fingerprint
+#: because row-sharded matrices were padded/sharded into the digest — no
+#: longer occurs on the built-in engines: format v4 (ISSUE 6) digests
+#: the ORIGINAL host inputs at construction, so fingerprints are
+#: mesh-shape-independent and an elastic/CPU rebuild validates cleanly
+#: (PR 5's acceptance test now pins ZERO ``fingerprint_degraded_accept``
+#: events on that path). The scope stays as a BELT for engines whose
+#: identity is still layout-sensitive (third-party engines exposing only
+#: ``fingerprint_arrays()`` over device buffers). Within a scope a
+#: FINGERPRINT mismatch is tolerated with a ``fingerprint_degraded_accept``
+#: event + warning instead of a refusal; key/seed mismatches still ALWAYS
+#: raise — splicing two null streams is never right, degraded or not
+#: (pinned in tests/test_checkpoint.py).
 _DEGRADED_ACCEPT: list[str] = []
 
 

@@ -205,9 +205,19 @@ def bench_fingerprint(row: dict) -> str | None:
 
 def entry_from_bench_row(row: dict, source: str = "bench",
                          round_n: int | None = None,
-                         t: float | None = None) -> dict | None:
+                         t: float | None = None,
+                         mode: str = "bench") -> dict | None:
     """Bench metric row → ledger entry, or None for rows without a
-    throughput number (warning/error/skip rows)."""
+    throughput number (warning/error/skip rows). ``source``/``mode``
+    default to the bench path; the serve load generator passes
+    ``source="serve"`` so serving-path rows form their own provenance
+    class in the ledger. Serve-path fingerprints split two ways (ISSUE
+    7): the load generator's rows through THIS function (metric label
+    carries the traffic shape), and the engine-run entries from packed
+    serve runs via :func:`maybe_record_run` — whose fingerprint is the
+    packed engine's ``autotune_key`` carrying a ``packed:<G>`` extra, so
+    packed-dispatch throughput never shares a regression history with
+    the stand-alone engine of the same bucket signature."""
     pps = row.get("perms_per_sec")
     if not isinstance(pps, (int, float)) or not pps > 0:
         return None
@@ -216,7 +226,7 @@ def entry_from_bench_row(row: dict, source: str = "bench",
         return None
     return make_entry(
         fp, pps, source, backend=_backend_class(str(row.get("device", ""))),
-        mode="bench", run_id=row.get("telemetry"),
+        mode=mode, run_id=row.get("telemetry"),
         metric=str(row.get("metric"))[:160], round_n=round_n, t=t,
     )
 

@@ -110,6 +110,22 @@ RECOVERY_EVENTS = (
     "distributed_autodetect_failed",
 )
 
+#: serving-path event names (ISSUE 7 `netrep serve`) — the per-request
+#: lifecycle the scheduler emits, each carrying a ``tenant`` label in
+#: ``data`` (ADDITIVE fields only; schema v1 unchanged). Names are pinned
+#: by tests/test_telemetry.py beside :data:`RECOVERY_EVENTS`: the CLI's
+#: per-tenant section and serving dashboards key on them.
+#: ``request_received`` opens the request span (``data["span"]``) and
+#: ``request_done`` closes it with the request's total latency as ``s``,
+#: so the trace tree shows queue wait + execution per request nested
+#: under the server-lifetime ``serve_start``/``serve_end`` span.
+SERVE_EVENTS = (
+    "request_received",
+    "request_packed",
+    "request_done",
+    "request_rejected",
+)
+
 
 def _is_number(v) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool)
@@ -770,6 +786,74 @@ def aggregate_events(events: Iterable[dict]) -> MetricsRegistry:
 def aggregate_file(path: str) -> MetricsRegistry:
     """Aggregate a telemetry JSONL into a registry (offline CLI report)."""
     return aggregate_events(read_events(path))
+
+
+def tenant_summary(events: Iterable[dict]) -> dict[str, dict]:
+    """Per-tenant aggregation of the serving events (:data:`SERVE_EVENTS`):
+    request counts per outcome, latency stats from ``request_done.s``, and
+    permutations served — the offline twin of the server's live per-tenant
+    counters, derived from the same event stream so the two views cannot
+    disagree."""
+    out: dict[str, dict] = {}
+    for e in events:
+        ev = e.get("ev")
+        if ev not in SERVE_EVENTS:
+            continue
+        data = e.get("data", {})
+        tenant = data.get("tenant")
+        if tenant is None:
+            continue
+        row = out.setdefault(str(tenant), {
+            "received": 0, "packed": 0, "done": 0, "failed": 0,
+            "rejected": 0, "perms": 0,
+            "latency": [0, 0.0, float("inf"), 0.0],  # n, total, min, max
+        })
+        if ev == "request_received":
+            row["received"] += 1
+        elif ev == "request_packed":
+            row["packed"] += 1
+        elif ev == "request_rejected":
+            row["rejected"] += 1
+        elif ev == "request_done":
+            if data.get("ok", True):
+                row["done"] += 1
+            else:
+                row["failed"] += 1
+            row["perms"] += int(data.get("perms", 0) or 0)
+            s = data.get("s")
+            if _is_number(s):
+                lat = row["latency"]
+                lat[0] += 1
+                lat[1] += float(s)
+                lat[2] = min(lat[2], float(s))
+                lat[3] = max(lat[3], float(s))
+    return out
+
+
+def render_tenants(path: str) -> str:
+    """Per-tenant serving section of the CLI report (`python -m netrep_tpu
+    telemetry <run.jsonl>`): one row per tenant with outcome counts and
+    latency stats. Empty string for logs without serving events."""
+    rows = tenant_summary(read_events(path))
+    if not rows:
+        return ""
+    out = ["tenants:"]
+    w = max(len(t) for t in rows)
+    out.append(
+        f"  {'':<{w}}  {'recv':>5} {'done':>5} {'fail':>5} {'rej':>5} "
+        f"{'perms':>8} {'mean_s':>8} {'max_s':>8}"
+    )
+    for t in sorted(rows):
+        r = rows[t]
+        n, tot, _lo, hi = r["latency"]
+        mean = tot / n if n else float("nan")
+        hi = hi if n else float("nan")
+        out.append(
+            f"  {t:<{w}}  {r['received']:>5} {r['done']:>5} "
+            f"{r['failed']:>5} {r['rejected']:>5} {r['perms']:>8} "
+            f"{mean:>8.3f} {hi:>8.3f}"
+        )
+    return "\n".join(out)
 
 
 def render_recovery(path: str) -> str:

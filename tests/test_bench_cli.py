@@ -47,6 +47,10 @@ CASES = [
     # the bench, so this smoke case also guards the superchunk dispatch
     # path end-to-end
     ["--config", "superchunk"],
+    # serve load generator (ISSUE 7): served/direct bit-parity is asserted
+    # inside the bench before any row is emitted, so this smoke case also
+    # guards the packing + warm-pool + scheduler path end-to-end
+    ["--config", "serve"],
 ]
 
 

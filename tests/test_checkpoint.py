@@ -132,6 +132,40 @@ def test_module_preservation_checkpoint_dir(tmp_path, rng, toy_pair):
     np.testing.assert_array_equal(res1.p_values, res2.p_values)
 
 
+def test_accept_degraded_fingerprint_scope(tmp_path, rng):
+    """ISSUE 7 satellite, pinning the (now belt-only) degraded-acceptance
+    scope: since format v4 made fingerprints mesh-shape-independent the
+    scope's original trigger is gone, but its CONTRACT must hold for the
+    legacy/third-party engines it still covers — inside the scope a
+    fingerprint mismatch is accepted, while a PRNG key/seed mismatch
+    STILL refuses (splicing two null streams is never right, degraded or
+    not)."""
+    eng = _engine(rng)
+    path = str(tmp_path / "null.npz")
+    eng.run_null(16, key=3, checkpoint_path=path)
+    loaded = ck.load_null_checkpoint(path)
+    kd = loaded["key_data"]
+    fp = loaded["fingerprint"]
+    other_fp = np.frombuffer(b"some-other-problem", dtype=np.uint8)
+    other_kd = np.asarray(kd) + 1
+
+    # outside any scope: fingerprint mismatch refuses
+    with pytest.raises(ValueError, match="different problem"):
+        ck.validate_identity(loaded, kd, other_fp, path)
+    # inside the scope: fingerprint mismatch is accepted explicitly...
+    with ck.accept_degraded_fingerprint("test_rebuild"):
+        ck.validate_identity(loaded, kd, other_fp, path)
+        # ...but a key/seed mismatch still ALWAYS raises — even when the
+        # fingerprint matches exactly
+        with pytest.raises(ValueError, match="different PRNG key"):
+            ck.validate_identity(loaded, other_kd, fp, path)
+        with pytest.raises(ValueError, match="different PRNG key"):
+            ck.validate_identity(loaded, other_kd, other_fp, path)
+    # the scope is dynamic, not sticky
+    with pytest.raises(ValueError, match="different problem"):
+        ck.validate_identity(loaded, kd, other_fp, path)
+
+
 def test_foreign_npz_is_not_a_checkpoint(tmp_path):
     """A saved PreservationResult (or any foreign .npz) fed to the resume
     path raises an informative error, not a KeyError."""

@@ -1,0 +1,358 @@
+"""`netrep serve` tests (ISSUE 7) — CPU-only, socket-free (in-process
+client), tiny fixtures: bit-parity of served results vs direct
+``module_preservation()`` calls in fixed-n and adaptive modes, cross-request
+(and cross-tenant) dispatch packing, warm-pool compile amortization,
+admission control, weighted round-robin fairness, graceful drain, and
+pack-level fault isolation."""
+
+import json
+
+import numpy as np
+import pytest
+
+from netrep_tpu import module_preservation
+from netrep_tpu.data import make_mixed_pair
+from netrep_tpu.ops import pvalues as pv
+from netrep_tpu.serve import (
+    InProcessClient, PreservationServer, QueueFull, ServeConfig, ServeError,
+)
+from netrep_tpu.utils.config import EngineConfig, FaultPolicy
+
+#: the ONE engine config served runs and their direct-call twins share —
+#: bit-parity is only defined against the same kernels and chunking
+CFG = EngineConfig(chunk_size=16, autotune=False)
+
+
+@pytest.fixture(scope="module")
+def fx():
+    """Deterministic fixture pair + the direct-call input dict."""
+    mixed = make_mixed_pair(100, 3, n_samples=16, seed=7)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    assign = {f"node_{i}": "0" for i in range(dn.shape[0])}
+    for lab, idx in mixed["specs"]:
+        for i in idx:
+            assign[f"node_{i}"] = str(lab)
+    direct_kw = dict(
+        network={"d": dn, "t": tn}, correlation={"d": dc, "t": tc},
+        data={"d": dd, "t": td}, module_assignments=assign,
+        discovery="d", test="t", config=CFG,
+    )
+    return dict(dn=dn, dc=dc, dd=dd, tn=tn, tc=tc, td=td, assign=assign,
+                direct_kw=direct_kw)
+
+
+def make_server(fx, tmp_path, *, tenants=("a",), start=True, **cfg_kw):
+    cfg_kw.setdefault("engine", CFG)
+    cfg_kw.setdefault("telemetry", str(tmp_path / "serve_tel.jsonl"))
+    srv = PreservationServer(ServeConfig(**cfg_kw), start=start)
+    client = InProcessClient(srv)
+    for t in tenants:
+        client.register_dataset(t, "d", network=fx["dn"],
+                                correlation=fx["dc"], data=fx["dd"],
+                                assignments=fx["assign"])
+        client.register_dataset(t, "t", network=fx["tn"],
+                                correlation=fx["tc"], data=fx["td"])
+    return srv, client
+
+
+def read_events(path):
+    return [json.loads(l) for l in open(path, encoding="utf-8")]
+
+
+# ---------------------------------------------------------------------------
+# bit-parity (the ISSUE 7 satellite): served == direct, fixed and adaptive
+# ---------------------------------------------------------------------------
+
+def test_served_request_bit_identical_fixed(fx, tmp_path):
+    srv, client = make_server(fx, tmp_path)
+    try:
+        res = client.analyze("a", "d", "t", n_perm=64, seed=3, timeout=600)
+    finally:
+        srv.close()
+    direct = module_preservation(**fx["direct_kw"], n_perm=64, seed=3)
+    np.testing.assert_array_equal(res["observed"], direct.observed)
+    np.testing.assert_array_equal(res["p_values"],
+                                  np.asarray(direct.p_values))
+    assert res["p_type"] == "fixed" and res["completed"] == 64
+    # counts parity: the served tallies equal tail_counts of the direct
+    # run's materialized null
+    hi, lo, eff = pv.tail_counts(
+        direct.observed, np.asarray(direct.nulls)[:direct.completed]
+    )
+    np.testing.assert_array_equal(res["counts_hi"], hi)
+    np.testing.assert_array_equal(res["counts_lo"], lo)
+    np.testing.assert_array_equal(res["counts_eff"], eff)
+    assert res["module_labels"] == list(direct.module_labels)
+
+
+def test_served_request_bit_identical_adaptive(fx, tmp_path):
+    srv, client = make_server(fx, tmp_path)
+    try:
+        res = client.analyze("a", "d", "t", n_perm=96, seed=5,
+                             adaptive=True, timeout=600)
+    finally:
+        srv.close()
+    direct = module_preservation(**fx["direct_kw"], n_perm=96, seed=5,
+                                 adaptive=True)
+    np.testing.assert_array_equal(res["p_values"],
+                                  np.asarray(direct.p_values))
+    np.testing.assert_array_equal(res["n_perm_used"],
+                                  np.asarray(direct.n_perm_used))
+    assert res["p_type"] == "sequential"
+
+
+# ---------------------------------------------------------------------------
+# cross-request packing (the tentpole): shared dispatches, per-request RNG
+# ---------------------------------------------------------------------------
+
+def test_concurrent_requests_share_one_pack_bit_identically(fx, tmp_path):
+    """Three queued requests — different seeds, different budgets, one
+    adaptive — run as ONE pack (shared module-size-bucket dispatches) and
+    each result is bit-identical to its direct call."""
+    srv, client = make_server(fx, tmp_path, start=False)
+    h1 = client.submit("a", "d", "t", n_perm=64, seed=3)
+    h2 = client.submit("a", "d", "t", n_perm=32, seed=11)
+    h3 = client.submit("a", "d", "t", n_perm=64, seed=5, adaptive=True)
+    srv.start()
+    try:
+        r1 = client.result(h1, timeout=600)
+        r2 = client.result(h2, timeout=600)
+        r3 = client.result(h3, timeout=600)
+    finally:
+        srv.close()
+    # pack sizes are canonicalized to powers of two: 3 queued -> 2 + 1
+    assert sorted([r1["pack_size"], r2["pack_size"], r3["pack_size"]],
+                  reverse=True) == [2, 2, 1]
+    assert len({r1["pack_id"], r2["pack_id"], r3["pack_id"]}) == 2
+    for res, kw in (
+        (r1, dict(n_perm=64, seed=3)),
+        (r2, dict(n_perm=32, seed=11)),
+        (r3, dict(n_perm=64, seed=5, adaptive=True)),
+    ):
+        direct = module_preservation(**fx["direct_kw"], **kw)
+        np.testing.assert_array_equal(res["observed"], direct.observed)
+        np.testing.assert_array_equal(res["p_values"],
+                                      np.asarray(direct.p_values))
+
+
+def test_cross_tenant_packing(fx, tmp_path):
+    """Two tenants registering identical data land in one shared dispatch
+    (the pack key is the dataset-pair content digest, not the tenant)."""
+    srv, client = make_server(fx, tmp_path, tenants=("a", "b"),
+                              start=False)
+    ha = client.submit("a", "d", "t", n_perm=32, seed=1)
+    hb = client.submit("b", "d", "t", n_perm=32, seed=2)
+    srv.start()
+    try:
+        ra = client.result(ha, timeout=600)
+        rb = client.result(hb, timeout=600)
+    finally:
+        srv.close()
+    assert ra["pack_id"] == rb["pack_id"] and ra["pack_size"] == 2
+    ev = read_events(str(tmp_path / "serve_tel.jsonl"))
+    packed = [e for e in ev if e["ev"] == "request_packed"]
+    assert {e["data"]["tenant"] for e in packed} == {"a", "b"}
+    assert len({e["data"]["pack"] for e in packed}) == 1
+
+
+def test_multi_test_request_matches_vmap_tests(fx, tmp_path):
+    """A request with a LIST of test datasets rides the MultiTestEngine
+    T-axis and returns per-test results bit-identical to the direct
+    vmap_tests=True call."""
+    m2 = make_mixed_pair(100, 3, n_samples=16, seed=9)
+    (t2d, t2c, t2n) = m2["test"]
+    srv, client = make_server(fx, tmp_path)
+    client.register_dataset("a", "t2", network=t2n, correlation=t2c,
+                            data=t2d)
+    try:
+        res = client.analyze("a", "d", ["t", "t2"], n_perm=48, seed=4,
+                             timeout=600)
+    finally:
+        srv.close()
+    direct = module_preservation(
+        network={"d": fx["dn"], "t": fx["tn"], "t2": t2n},
+        correlation={"d": fx["dc"], "t": fx["tc"], "t2": t2c},
+        data={"d": fx["dd"], "t": fx["td"], "t2": t2d},
+        module_assignments=fx["assign"], discovery="d",
+        test=["t", "t2"], n_perm=48, seed=4, config=CFG,
+        vmap_tests=True, simplify=False,
+    )
+    assert [t["test"] for t in res["tests"]] == ["t", "t2"]
+    for t in res["tests"]:
+        dr = direct["d"][t["test"]]
+        np.testing.assert_array_equal(t["observed"], dr.observed)
+        np.testing.assert_array_equal(t["p_values"],
+                                      np.asarray(dr.p_values))
+
+
+# ---------------------------------------------------------------------------
+# warm program pool: steady-state requests never pay compile
+# ---------------------------------------------------------------------------
+
+def test_warm_pool_second_request_pays_no_compile(fx, tmp_path):
+    tel = str(tmp_path / "serve_tel.jsonl")
+    srv, client = make_server(fx, tmp_path)
+    try:
+        r1 = client.analyze("a", "d", "t", n_perm=48, seed=1, timeout=600)
+        r2 = client.analyze("a", "d", "t", n_perm=48, seed=2, timeout=600)
+    finally:
+        srv.close()
+    assert r1["pool_hit"] is False and r2["pool_hit"] is True
+    spans = [e["data"] for e in read_events(tel)
+             if e["ev"] == "compile_span" and "packed" in e["data"]["key"]]
+    assert len(spans) >= 2
+    cold, warm = spans[0]["s"], spans[-1]["s"]
+    # the PR 5 proof metric: the warm-pool request's compile estimate
+    # collapses (engine + jitted programs reused, zero re-trace)
+    assert warm < max(0.5 * cold, 0.05), (cold, warm)
+    ev_names = {e["ev"] for e in read_events(tel)}
+    assert {"serve_pool_miss", "serve_pool_hit"} <= ev_names
+
+
+# ---------------------------------------------------------------------------
+# admission control + fairness + drain
+# ---------------------------------------------------------------------------
+
+def test_admission_control_rejects_over_bound(fx, tmp_path):
+    srv, client = make_server(fx, tmp_path, start=False, max_queue=2)
+    client.submit("a", "d", "t", n_perm=32, seed=1)
+    client.submit("a", "d", "t", n_perm=32, seed=2)
+    with pytest.raises(QueueFull):
+        client.submit("a", "d", "t", n_perm=32, seed=3)
+    ev = read_events(str(tmp_path / "serve_tel.jsonl"))
+    rej = [e for e in ev if e["ev"] == "request_rejected"]
+    assert rej and rej[0]["data"]["reason"] == "queue_full"
+    assert rej[0]["data"]["tenant"] == "a"
+    srv.close(drain=False)
+
+
+def test_weighted_round_robin_order(fx, tmp_path):
+    """weight(a)=2, weight(b)=1, packing off: dispatch order follows the
+    weighted ring a,a,b,a,a,b."""
+    srv, client = make_server(fx, tmp_path, tenants=(), start=False,
+                              max_pack=1)
+    client.register_tenant("a", weight=2)
+    client.register_tenant("b", weight=1)
+    for t in ("a", "b"):
+        client.register_dataset(t, "d", network=fx["dn"],
+                                correlation=fx["dc"], data=fx["dd"],
+                                assignments=fx["assign"])
+        client.register_dataset(t, "t", network=fx["tn"],
+                                correlation=fx["tc"], data=fx["td"])
+    handles = []
+    for i in range(4):
+        handles.append(client.submit("a", "d", "t", n_perm=32, seed=i))
+    for i in range(2):
+        handles.append(client.submit("b", "d", "t", n_perm=32,
+                                     seed=100 + i))
+    srv.start()
+    try:
+        for h in handles:
+            client.result(h, timeout=600)
+    finally:
+        srv.close()
+    ev = read_events(str(tmp_path / "serve_tel.jsonl"))
+    order = [e["data"]["tenant"] for e in ev
+             if e["ev"] == "request_packed"]
+    assert order == ["a", "a", "b", "a", "a", "b"]
+
+
+def test_graceful_drain_finishes_queued_work(fx, tmp_path):
+    srv, client = make_server(fx, tmp_path, start=False)
+    h1 = client.submit("a", "d", "t", n_perm=32, seed=1)
+    h2 = client.submit("a", "d", "t", n_perm=32, seed=2)
+    srv.start()
+    srv.close(drain=True)   # must finish both queued requests first
+    assert client.result(h1, timeout=1)["completed"] == 32
+    assert client.result(h2, timeout=1)["completed"] == 32
+    ev = read_events(str(tmp_path / "serve_tel.jsonl"))
+    end = [e for e in ev if e["ev"] == "serve_end"]
+    assert end and end[0]["data"]["drained"] is True
+    assert end[0]["data"]["requests_done"] == 2
+    # draining servers refuse new work explicitly
+    with pytest.raises(ServeError, match="draining"):
+        client.submit("a", "d", "t", n_perm=32, seed=3)
+
+
+# ---------------------------------------------------------------------------
+# fault ladder around shared dispatches
+# ---------------------------------------------------------------------------
+
+def test_transient_fault_in_pack_recovers_bit_identically(fx, tmp_path):
+    srv, client = make_server(
+        fx, tmp_path,
+        fault_policy=FaultPolicy(plan="transient@8", backoff_base_s=0.0,
+                                 backoff_jitter=0.0),
+    )
+    try:
+        res = client.analyze("a", "d", "t", n_perm=64, seed=3, timeout=600)
+    finally:
+        srv.close()
+    direct = module_preservation(**fx["direct_kw"], n_perm=64, seed=3)
+    np.testing.assert_array_equal(res["p_values"],
+                                  np.asarray(direct.p_values))
+    ev = read_events(str(tmp_path / "serve_tel.jsonl"))
+    names = [e["ev"] for e in ev]
+    assert "fault_injected" in names and "retry_attempt" in names
+
+
+def test_failed_pack_is_isolated_per_request(fx, tmp_path):
+    """An unrecoverable fault inside a shared dispatch must not take the
+    pack-mates down with it: the pack splits, each member retries solo,
+    the poisoned ones fail alone, and the server keeps serving."""
+    srv, client = make_server(
+        fx, tmp_path, tenants=("a", "b"), start=False,
+        # three fatal firings: the shared pack, then each solo retry —
+        # both requests are genuinely poisoned and fail individually
+        fault_policy=FaultPolicy(plan="fatal@8x3", backoff_base_s=0.0,
+                                 backoff_jitter=0.0),
+    )
+    ha = client.submit("a", "d", "t", n_perm=32, seed=1)
+    hb = client.submit("b", "d", "t", n_perm=32, seed=2)
+    srv.start()
+    with pytest.raises(ServeError):
+        client.result(ha, timeout=600)
+    with pytest.raises(ServeError):
+        client.result(hb, timeout=600)
+    # the plan is exhausted; the SERVER is alive and the next request of
+    # either tenant succeeds — one pack's death never drains the service
+    res = client.analyze("b", "d", "t", n_perm=32, seed=9, timeout=600)
+    direct = module_preservation(**fx["direct_kw"], n_perm=32, seed=9)
+    np.testing.assert_array_equal(res["p_values"],
+                                  np.asarray(direct.p_values))
+    st = srv.stats()
+    srv.close()
+    assert st["tenants"]["a"]["failed"] == 1
+    assert st["tenants"]["b"]["failed"] == 1
+    assert st["tenants"]["b"]["done"] == 1
+    ev = read_events(str(tmp_path / "serve_tel.jsonl"))
+    assert any(e["ev"] == "request_requeued" for e in ev)
+
+
+# ---------------------------------------------------------------------------
+# ops surface
+# ---------------------------------------------------------------------------
+
+def test_metrics_exposition_and_stats(fx, tmp_path):
+    srv, client = make_server(fx, tmp_path)
+    try:
+        client.analyze("a", "d", "t", n_perm=32, seed=1, timeout=600)
+        text = client.metrics()
+        st = client.stats()
+    finally:
+        srv.close()
+    assert 'netrep_serve_requests_total{tenant="a",outcome="done"} 1' in text
+    assert 'netrep_serve_queue_depth{tenant="a"} 0' in text
+    assert "netrep_serve_packs_total" in text
+    # the engine-run registry rides the same exposition (shared bus)
+    assert "netrep_chunk_count_total" in text
+    assert st["tenants"]["a"]["done"] == 1 and st["packs"] >= 1
+
+
+def test_unknown_tenant_and_dataset_fail_fast(fx, tmp_path):
+    srv, client = make_server(fx, tmp_path, start=False)
+    with pytest.raises(ServeError, match="unknown tenant"):
+        client.submit("ghost", "d", "t", n_perm=16)
+    with pytest.raises(ServeError, match="no dataset"):
+        client.submit("a", "d", "nope", n_perm=16)
+    srv.close(drain=False)

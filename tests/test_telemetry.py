@@ -263,6 +263,67 @@ def test_recovery_event_names_pinned():
     )
 
 
+def test_serve_event_names_pinned():
+    """ISSUE 7 hygiene: the serving-path request-lifecycle event names are
+    schema surface — the CLI per-tenant section and serving dashboards
+    key on them (each event carries a ``tenant`` data label; the schema's
+    six top-level keys are unchanged)."""
+    from netrep_tpu.utils.telemetry import SERVE_EVENTS
+
+    assert SERVE_EVENTS == (
+        "request_received",
+        "request_packed",
+        "request_done",
+        "request_rejected",
+    )
+
+
+def test_tenant_summary_folds_serve_events():
+    """The per-tenant offline aggregation (`telemetry` CLI section) counts
+    request outcomes, latency stats, and served permutations per tenant
+    from the event stream alone."""
+    from netrep_tpu.utils.telemetry import render_tenants, tenant_summary
+
+    def ev(name, **data):
+        return {"v": 1, "t": 0.0, "m": 0.0, "run": "x", "ev": name,
+                "data": data}
+
+    events = [
+        ev("request_received", tenant="a"),
+        ev("request_packed", tenant="a", pack="p1"),
+        ev("request_done", tenant="a", ok=True, s=0.5, perms=128),
+        ev("request_received", tenant="b"),
+        ev("request_rejected", tenant="b", reason="queue_full"),
+        ev("request_done", tenant="b", ok=False, s=1.5, error="Boom"),
+        ev("chunk", done=3),           # non-serve events are ignored
+        ev("request_done", s=0.1),     # no tenant label: skipped
+    ]
+    rows = tenant_summary(events)
+    assert rows["a"] == {
+        "received": 1, "packed": 1, "done": 1, "failed": 0, "rejected": 0,
+        "perms": 128, "latency": [1, 0.5, 0.5, 0.5],
+    }
+    assert rows["b"]["rejected"] == 1 and rows["b"]["failed"] == 1
+    # the rendered section names both tenants (smoke the CLI surface)
+    import json
+
+    path = None
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as f:
+        path = f.name
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    try:
+        text = render_tenants(path)
+        assert "tenants:" in text and "a" in text and "b" in text
+    finally:
+        import os
+
+        os.unlink(path)
+
+
 # ---------------------------------------------------------------------------
 # retirement events (StopMonitor owns the tallies, so it emits)
 # ---------------------------------------------------------------------------
