@@ -914,6 +914,135 @@ def bench_superchunk(args):
     })
 
 
+def bench_pallas(args):
+    """Fused-statistics mega-kernel row (ISSUE 8, ``stat_mode='fused'``):
+    the Pallas gather+stats+tally kernel driving the streaming executor vs
+    the XLA composition on the SAME problem and key.
+
+    Counts parity is asserted in-bench BEFORE any row is emitted — at a
+    small shape on every backend (exact on CPU interpret; bounded count
+    deviation on MXU-truncating backends, where the kernel's one-hot
+    selection rounds like every fused/mxu gather), so a fast-but-wrong
+    row is impossible. The headline row is the north-star shape
+    (10k-perm / 20k-gene / 50-module) — the <60 s target — and only a
+    live TPU produces it: on the CPU fallback the kernel runs the Pallas
+    interpreter, whose timing says nothing about Mosaic, so the row is an
+    explicit parity-only fallback (labeled, ``tpu_fallback`` marker) at a
+    reduced shape instead of an hours-long non-measurement. Metric labels
+    carry the ``fused-stats`` prefix so perf-ledger fingerprints never
+    mix stat_mode paths."""
+    import jax
+
+    from netrep_tpu.data import make_mixed_pair
+    from netrep_tpu.ops import pvalues as pv
+    from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+    from netrep_tpu.utils.config import EngineConfig
+
+    resolve(args, 20_000, 50, 10_000)
+    on_cpu = jax.default_backend() == "cpu"
+
+    def make_engine(mixed, stat_mode, chunk):
+        (dd, dc, dn) = mixed["discovery"]
+        (td, tc, tn) = mixed["test"]
+        specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+        cfg = EngineConfig(
+            chunk_size=chunk, summary_method="power", power_iters=40,
+            dtype=args.dtype, superchunk=8, autotune=False,
+            stat_mode=stat_mode,
+        )
+        return PermutationEngine(
+            dc, dn, dd, tc, tn, td, specs, mixed["pool"], config=cfg
+        )
+
+    # ---- parity gate (every backend, before any row) --------------------
+    gate = make_mixed_pair(320, 6, n_samples=32, seed=7)
+    g_perms = 192
+    e_f = make_engine(gate, "fused", 32)
+    obs_g = np.asarray(e_f.observed())
+    nulls_g, done_g = e_f.run_null(g_perms, key=0)
+    sc_f = e_f.run_null_streaming(g_perms, obs_g, key=0)
+    hi_m, lo_m, eff_m = pv.tail_counts(obs_g, np.asarray(nulls_g)[:done_g])
+    assert (sc_f.hi == hi_m).all() and (sc_f.lo == lo_m).all() and \
+        (sc_f.eff == eff_m).all(), \
+        "fused streaming tallies != kernel's own materialized counts"
+    sc_x = make_engine(gate, "xla", 32).run_null_streaming(
+        g_perms, obs_g, key=0
+    )
+    dev = max(
+        int(np.abs(sc_f.hi - sc_x.hi).max()),
+        int(np.abs(sc_f.lo - sc_x.lo).max()),
+    )
+    tol = 0 if on_cpu else max(2, g_perms // 50)
+    assert dev <= tol, (
+        f"fused vs xla count deviation {dev} exceeds {tol} at the parity "
+        "gate — the mega-kernel is not computing the engine's statistics"
+    )
+
+    # ---- timed row ------------------------------------------------------
+    if on_cpu:
+        # interpreter timing is not a Mosaic measurement: a reduced-shape
+        # mechanism row keeps the smoke case and the fallback honest
+        genes, modules, perms, chunk = 800, 8, 256, 64
+        if args.smoke:
+            genes, modules, perms, chunk = 400, 6, 96, 32
+    else:
+        genes, modules, perms, chunk = (
+            args.genes, args.modules, args.perms, args.chunk
+        )
+    mixed = make_mixed_pair(genes, modules, n_samples=args.samples, seed=7)
+    stream_f = make_engine(mixed, "fused", chunk)
+    observed = np.asarray(stream_f.observed())
+    warm = 8 * chunk
+    _ = stream_f.run_null_streaming(warm, observed, key=99)  # compile
+    t0 = time.perf_counter()
+    sc = stream_f.run_null_streaming(perms, observed, key=0)
+    fused_s = time.perf_counter() - t0
+    assert sc.completed == perms
+
+    stream_x = make_engine(mixed, "xla", chunk)
+    _ = stream_x.run_null_streaming(warm, observed, key=99)
+    t0 = time.perf_counter()
+    sc_ref = stream_x.run_null_streaming(perms, observed, key=0)
+    xla_s = time.perf_counter() - t0
+    dev2 = max(
+        int(np.abs(sc.hi - sc_ref.hi).max()),
+        int(np.abs(sc.lo - sc_ref.lo).max()),
+    )
+    assert dev2 <= (0 if on_cpu else max(2, perms // 50)), (
+        f"fused vs xla count deviation {dev2} at the timed shape"
+    )
+
+    row = {
+        "metric": (
+            f"fused-stats mega-kernel {perms}-perm null, {genes} genes / "
+            f"{modules} modules (stat_mode=fused streaming vs xla, "
+            f"chunk {chunk})"
+        ),
+        "value": round(fused_s, 3),
+        "unit": "s",
+        "vs_baseline": round(TARGET_SECONDS / fused_s, 4),
+        "xla_s": round(xla_s, 3),
+        "fused_vs_xla_x": round(xla_s / fused_s, 3),
+        "perms_per_sec": round(perms / fused_s, 2),
+        "xla_perms_per_sec": round(perms / xla_s, 2),
+        "counts_parity": True,  # asserted above, both shapes
+        "count_dev_gate": dev, "count_dev_timed": dev2,
+        "device": str(jax.devices()[0]),
+        "dtype": args.dtype,
+        "chunk": chunk,
+    }
+    if on_cpu:
+        row["tpu_fallback"] = TPU_FALLBACK
+        row["metric"] += (
+            " [CPU Pallas interpreter: parity/mechanism row, reduced "
+            "shape — kernel timing is only decision-grade on TPU]"
+        )
+        # an interpreter wall-clock must never be read against the <60 s
+        # target (it is not a device measurement)
+        row["vs_baseline"] = None
+    return emit(row)
+
+
 def bench_multichip_child(args):
     """One multichip scaling point (spawned by :func:`bench_multichip`):
     build an ``--devices``-wide permutation mesh and measure a real null
@@ -1156,7 +1285,7 @@ def main():
     ap.add_argument("--config", default="north",
                     choices=["north", "A", "B", "C", "D", "E", "oracle",
                              "native", "sharded", "adaptive", "superchunk",
-                             "multichip", "serve"])
+                             "multichip", "serve", "pallas"])
     ap.add_argument("--devices", type=int, default=None,
                     help="multichip child marker: measure ONE scaling "
                          "point on this many devices (the parent spawns "
@@ -1203,7 +1332,7 @@ def main():
     from netrep_tpu.utils.backend import tunnel_expected
 
     if (args.config in ("north", "A", "B", "C", "D", "E", "sharded",
-                        "adaptive", "superchunk", "serve")
+                        "adaptive", "superchunk", "serve", "pallas")
             and tunnel_expected()
             and not os.environ.get("NETREP_BENCH_NO_SUBPROC")):
         # every config that may touch the tunnel backend (A runs the JAX
@@ -1299,6 +1428,7 @@ def main():
         "north": bench_north, "A": bench_a, "B": bench_b,
         "C": bench_c, "D": bench_d, "E": bench_e, "oracle": bench_oracle,
         "adaptive": bench_adaptive, "superchunk": bench_superchunk,
+        "pallas": bench_pallas,
     }[args.config](args)
 
 

@@ -12,6 +12,7 @@ Usage: python benchmarks/microbench_parts.py [--cap C] [--K K] [--batch B]
 from __future__ import annotations
 
 import argparse
+import functools
 import os
 import sys
 import time
@@ -230,6 +231,103 @@ def dispatch_overhead(n: int, cap: int, K: int, B: int, reps: int,
           f"{per_serial-per_fused:6.2f} ms/chunk saved")
 
 
+def stats_dispatch_overhead(n: int, cap: int, K: int, B: int, reps: int,
+                            samples: int = 64):
+    """Fused-STATS dispatch comparison (ISSUE 8 satellite): the SAME
+    per-chunk null computation — per-module submatrix gather, the seven
+    preservation statistics, and the (hi, lo, eff) exceedance fold —
+    issued as the XLA composition (mxu gather → stats kernels → count
+    reduction, the stat_mode='xla' streaming chunk) vs ONE
+    ``ops/fused_stats`` mega-kernel dispatch whose tally fold happens in
+    VMEM (stat_mode='fused'). This is the PR-2/PR-5 yardstick applied to
+    the statistics path: dispatch_overhead above isolates host-round-trip
+    amortization; this isolates the HBM round-trips BETWEEN the gather,
+    statistic, and fold stages that the kernel removes. Judged per
+    backend; on CPU the kernel runs the Pallas interpreter, so only the
+    TPU rows are decision-grade (labelled). Counts parity is asserted
+    before any timing prints."""
+    from netrep_tpu.ops import stats as jstats
+    from netrep_tpu.ops.fused_stats import fused_stats_counts
+
+    on_cpu = jax.default_backend() == "cpu"
+    key = jax.random.key(11)
+    M = jax.random.normal(key, (n, n), dtype=jnp.float32)
+    dataT = jax.random.normal(jax.random.key(12), (n, samples),
+                              dtype=jnp.float32)
+    rng = np.random.default_rng(13)
+    didx = jnp.asarray(np.stack([
+        rng.choice(n, cap, replace=False).astype(np.int32) for _ in range(K)
+    ]))
+    mask = jnp.ones((K, cap), jnp.float32)
+    sub = lambda mat, ix: mat[ix[:, None], ix[None, :]]
+    corr_b = jax.vmap(lambda ix: sub(M, ix))(didx)
+    net_b = jstats.derived_net(corr_b, 2.0)
+    data_b = jax.vmap(lambda ix: jnp.take(dataT.T, ix, axis=1))(didx)
+    disc = jstats.make_disc_props(corr_b, net_b, data_b, mask)
+    obs = jnp.zeros((K, 7), jnp.float32)
+    pv = jnp.ones((B,), jnp.int32)
+
+    def make_idx(seed):
+        return jax.random.randint(jax.random.key(seed), (B, K, cap), 0, n,
+                                  dtype=jnp.int32)
+
+    n_var = max(1, reps) + DEFAULT_WARMUP + 1
+    idxs = [make_idx(500 + v) for v in range(n_var)]
+
+    kernel = functools.partial(
+        jstats.gather_and_stats_mxu, n_iter=60, summary_method="power",
+        net_beta=2.0,
+    )
+
+    @jax.jit
+    def xla_chunk(ix, pvm):
+        def per_perm(ixp):
+            return jax.vmap(kernel, in_axes=(0, 0, None, None, None))(
+                disc, ixp, M, None, dataT
+            )
+        vals = jax.lax.map(per_perm, ix)
+        sel = (pvm > 0)[:, None, None]
+        hi = jnp.sum((vals >= obs[None]) & sel, axis=0, dtype=jnp.int32)
+        lo = jnp.sum((vals <= obs[None]) & sel, axis=0, dtype=jnp.int32)
+        eff = jnp.sum(~jnp.isnan(vals) & sel, axis=0, dtype=jnp.int32)
+        return hi, lo, eff
+
+    @jax.jit
+    def fused_chunk(ix, pvm):
+        _v, hi, lo, eff = fused_stats_counts(
+            M, None, dataT, disc, ix, pvm, obs, net_beta=2.0, n_iter=60,
+            interpret=on_cpu,
+        )
+        return hi, lo, eff
+
+    try:
+        # counts-parity gate before any timing row: fast-but-wrong numbers
+        # must never reach the decision log (same policy as fused_parity)
+        hx = [np.asarray(a) for a in xla_chunk(idxs[0], pv)]
+        hf = [np.asarray(a) for a in fused_chunk(idxs[0], pv)]
+        mism = sum(int((a != b).sum()) for a, b in zip(hx, hf))
+        tag = "interpret/CPU — parity row only" if on_cpu else "Mosaic"
+        print(f"fused_stats counts parity ({tag}): "
+              f"{mism} mismatched cells of {3 * K * 7}", flush=True)
+        assert mism == 0 or not on_cpu, "fused_stats parity FAILED on CPU"
+        # idxs[0] executed in the parity gate above: rotate it to the END
+        # of both variant lists (warmup slots) so no TIMED rep repeats a
+        # prior execution the tunnel could short-circuit
+        rolled = [(i, pv) for i in idxs[1:]] + [(idxs[0], pv)]
+        t_x = bench(xla_chunk, idxs[0], pv, reps=reps, variants=rolled)
+        t_f = bench(fused_chunk, idxs[0], pv, reps=reps, variants=rolled)
+        print(f"stats dispatch fused_stats [{tag}]: "
+              f"xla gather+stats+fold {t_x*1e3:8.2f} ms/chunk  "
+              f"mega-kernel {t_f*1e3:8.2f} ms/chunk  "
+              f"speedup {t_x/t_f:5.2f}x", flush=True)
+    except AssertionError:
+        raise
+    except Exception as e:
+        print(f"fused_stats overhead: SKIPPED ({type(e).__name__}: {e})")
+        return False
+    return True
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--genes", type=int, default=20_000)
@@ -372,6 +470,10 @@ def main():
     # 1-vs-K dispatch amortization: the superchunk executor's win, pinned
     # per backend (ISSUE 2 — dispatch-overhead microbench)
     dispatch_overhead(n, cap, K, B, args.reps)
+
+    # XLA gather→stats→fold composition vs the ops/fused_stats mega-kernel
+    # at the same chunk shape (ISSUE 8 — the stat_mode decision row)
+    stats_dispatch_overhead(n, cap, K, B, args.reps)
 
     # correctness check of selection variants vs true gather
     sub_true = np.asarray(M)[np.asarray(idx)[0, 0][:, None], np.asarray(idx)[0, 0][None, :]]

@@ -73,6 +73,7 @@ QUEUE=(
   "north_bf16_dnet 900 python bench.py --dtype bfloat16 --derived-net"
   "north_fused 900  python bench.py --gather-mode fused"
   "north_fused_bf16_dnet 900 python bench.py --gather-mode fused --dtype bfloat16 --derived-net"
+  "north_pallas 900 python bench.py --config pallas"
   "north_g8    900  python bench.py --cap-granularity 8"
   "bf16_drift  1200 python benchmarks/bf16_drift.py"
   "configB     900  python bench.py --config B"
@@ -224,14 +225,24 @@ while :; do
       # does not stop a fused step from running after a parity failure).
       # "parity PASS" is written only by a real success; a bare "parity"
       # line without it means the gate failed twice and was retired.
+      # north_pallas (the fused-STATS mega-kernel, ISSUE 8) rides the same
+      # gate: its kernel shares the gather kernel's DMA/select machinery,
+      # so a gather-parity retirement retires it too; its own counts
+      # parity is additionally asserted in-bench before any row.
       case "$key" in
-        tune|north_fused*)
+        tune|north_fused*|north_pallas)
           if ! grep -qx "parity PASS" "$STATE"; then
             if grep -qx "parity MOSAICFAIL" "$STATE"; then
               # only a REAL kernel failure (assertion/compile error on the
               # chip, marked below) retires the fused grid — transient
               # tunnel flaps leave the gate pending and the steps deferred
               echo "--- $key skipped permanently: fused parity gate FAILED on Mosaic ---" | tee -a "$LOG"
+              echo "$key" >>"$STATE"
+            elif grep -qx "parity SKIPRETIRE" "$STATE"; then
+              # distinct retirement class (ADVICE r5): the kernel twice
+              # REFUSED to compile with the tunnel alive — no wrong
+              # numbers were ever produced, Mosaic just cannot build it
+              echo "--- $key skipped permanently: fused parity SKIPPED twice (Mosaic compile-refusal, not wrong numbers) ---" | tee -a "$LOG"
               echo "$key" >>"$STATE"
             else
               echo "--- $key deferred: fused parity gate not yet passed ---" | tee -a "$LOG"
@@ -281,12 +292,17 @@ while :; do
       # strike, retry next window.
       mosaicfail=0
       skipstrike=0
+      skipretire=0
       if [ "$key" = parity ] && [ "$rc" -ne 0 ] && [ "$fellback" -eq 0 ]; then
         if grep -q 'pallas fused parity FAILED' "$step_out" && probe; then
           mosaicfail=1
         elif grep -q 'pallas fused gather: SKIPPED' "$step_out" && probe; then
           if grep -qx "parity SKIP1" "$STATE"; then
-            mosaicfail=1
+            # second SKIPPED with the tunnel alive: retire, but as its OWN
+            # class (ADVICE r5) — a compile-refusal is not the definitive
+            # wrong-numbers verdict the FAILED path records, and the two
+            # must not share a log line or a state marker
+            skipretire=1
           else
             echo "parity SKIP1" >>"$STATE"
             echo "--- parity SKIPPED with tunnel alive; one more strike retires the fused grid ---" | tee -a "$LOG"
@@ -317,6 +333,10 @@ while :; do
         echo "--- parity FAILED on real Mosaic; retiring fused steps ---" | tee -a "$LOG"
         echo "parity" >>"$STATE"
         echo "parity MOSAICFAIL" >>"$STATE"
+      elif [ "$skipretire" -eq 1 ]; then
+        echo "--- parity SKIPPED twice with tunnel alive; retiring fused grid (Mosaic compile-refusal, not wrong numbers) ---" | tee -a "$LOG"
+        echo "parity" >>"$STATE"
+        echo "parity SKIPRETIRE" >>"$STATE"
       elif [ "$skipstrike" -eq 1 ]; then
         # strike already recorded and logged above; skip the generic
         # handler so the same event is not re-probed (45 s of a short

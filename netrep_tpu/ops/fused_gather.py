@@ -69,6 +69,95 @@ _DMA_WINDOW = 16
 _VMEM_BUDGET = 8 * 1024 * 1024
 
 
+def run_dma_window(copy, count: int, owned=None) -> None:
+    """Issue ``count`` row DMAs through the rolling ``_DMA_WINDOW``-slot
+    semaphore window — the shared row-DMA machinery of this module's gather
+    kernel and the fused-statistics kernel (:mod:`netrep_tpu.ops.fused_stats`).
+    ``copy(a)`` builds the async copy for slot ``a``; ``owned(a)`` (optional)
+    predicates slots whose DMA is skipped entirely (negative row ids in the
+    row-sharded gather). Copy ``a`` rides semaphore ``a % _DMA_WINDOW`` after
+    waiting out that slot's previous user; the tail drain waits only
+    ``[count - _DMA_WINDOW, count)`` (earlier copies were waited during the
+    start loop — widening it would double-wait)."""
+    if owned is None:
+        def owned(a):  # noqa: E306 — every slot owned (replicated kernels)
+            return jnp.bool_(True)
+
+    def start(a, _):
+        # index clamp: the guard predicate is ANDed with a >= window, but
+        # the operand itself must never read SMEM out of bounds
+        prev = jnp.maximum(a - _DMA_WINDOW, 0)
+
+        @pl.when((a >= _DMA_WINDOW) & owned(prev))
+        def _wait_prev():
+            copy(prev).wait()
+
+        @pl.when(owned(a))
+        def _go():
+            copy(a).start()
+        return _
+
+    def drain(a, _):
+        @pl.when(owned(a))
+        def _go():
+            copy(a).wait()
+        return _
+
+    jax.lax.fori_loop(0, count, start, None, unroll=8)
+    jax.lax.fori_loop(max(0, count - _DMA_WINDOW), count, drain, None,
+                      unroll=8)
+
+
+def select_columns(rows_buf, cols, n_cols: int, n_tiles: int, *,
+                   exact: bool, own=None) -> jnp.ndarray:
+    """In-VMEM one-hot column select of ``cols`` from a DMA'd row buffer —
+    the shared select stage of the gather and fused-statistics kernels.
+    ``rows_buf`` is an (rb, n_tiles·_COL_TILE) VMEM block; returns the
+    (rb, len(cols)) f32 selection, accumulated tile by tile on the MXU.
+    ``own`` (optional, (rb,)) zeroes un-owned rows with a SELECT before the
+    dot (never a multiply: un-owned slots skipped their DMA, so the buffer
+    holds uninitialized VMEM — 0·NaN would poison the dot and, sharded,
+    the psum). ``exact`` applies the hi/lo bf16 split restoring ~f32-exact
+    selection on TPU MXUs (see :func:`gather_submatrix_fused`)."""
+    rb = rows_buf.shape[0]
+    acc = jnp.zeros((rb, cols.shape[0]), jnp.float32)
+    for t in range(n_tiles):
+        c0 = t * _COL_TILE
+        tile = rows_buf[:, c0: c0 + _COL_TILE]
+        if (t + 1) * _COL_TILE > n_cols:
+            # final tile spills past n_cols: the buffer tail is
+            # uninitialized VMEM — zero it so 0·garbage (potential NaN)
+            # cannot reach the accumulator through the dot
+            in_range = (
+                c0 + jax.lax.broadcasted_iota(jnp.int32, tile.shape, 1)
+                < n_cols
+            )
+            tile = jnp.where(in_range, tile, 0)
+        if own is not None:
+            tile = jnp.where(own[:, None] != 0, tile, jnp.zeros_like(tile))
+        col_ids = c0 + jax.lax.broadcasted_iota(
+            jnp.int32, (_COL_TILE, cols.shape[0]), 0
+        )
+        onehot = (col_ids == cols[None, :]).astype(tile.dtype)
+        if exact and tile.dtype == jnp.float32:
+            # hi/lo split: TPU MXU truncates f32 dot operands to bf16, so a
+            # single dot rounds the selected VALUES (~4e-3 rel). Splitting
+            # x = bf16(x) + bf16(x - bf16(x)) and summing two dots restores
+            # ~f32-exact selection for 2x the (non-dominant) FLOPs at the
+            # same one-pass HBM traffic — vs ~10x cost for gather_mode=
+            # 'direct', the only previous exact-on-TPU option.
+            hi = tile.astype(jnp.bfloat16)
+            lo = (tile - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+            oh16 = onehot.astype(jnp.bfloat16)
+            acc += jax.lax.dot(hi, oh16, preferred_element_type=jnp.float32)
+            acc += jax.lax.dot(lo, oh16, preferred_element_type=jnp.float32)
+        else:
+            acc += jax.lax.dot(
+                tile, onehot, preferred_element_type=jnp.float32
+            )
+    return acc
+
+
 def _row_block(cap: int, n_cols: int, itemsize: int) -> int:
     """Row-block size for a fused-gather launch after the VMEM guard.
     Two-step choice: (1) the largest sublane-aligned block that fits the
@@ -136,68 +225,12 @@ def _kernel(rowidx_smem, M_ref, colidx_ref, own_ref, out_ref, rows_buf, sems,
 
     # rolling window: start copy a after waiting out the previous user of
     # its semaphore slot (copy a - _DMA_WINDOW), then drain the tail
-    def start(a, _):
-        # index clamp: the guard predicate is ANDed with a >= window, but
-        # the operand itself must never read SMEM out of bounds
-        prev = jnp.maximum(a - _DMA_WINDOW, 0)
-
-        @pl.when((a >= _DMA_WINDOW) & owned(prev))
-        def _wait_prev():
-            row_copy(prev).wait()
-
-        @pl.when(owned(a))
-        def _go():
-            row_copy(a).start()
-        return _
-
-    def drain(a, _):
-        @pl.when(owned(a))
-        def _go():
-            row_copy(a).wait()
-        return _
-
-    jax.lax.fori_loop(0, rb, start, None, unroll=8)
-    jax.lax.fori_loop(max(0, rb - _DMA_WINDOW), rb, drain, None, unroll=8)
+    run_dma_window(row_copy, rb, owned=owned)
 
     cols = colidx_ref[0, :]                    # (cap,) int32
     own = own_ref[0, :]                        # (rb,) 0/1 for THIS block
-    acc = jnp.zeros((rb, cols.shape[0]), jnp.float32)
-    for t in range(n_tiles):
-        c0 = t * _COL_TILE
-        tile = rows_buf[:, c0: c0 + _COL_TILE]
-        if (t + 1) * _COL_TILE > n_cols:
-            # final tile spills past n_cols: the buffer tail is
-            # uninitialized VMEM — zero it so 0·garbage (potential NaN)
-            # cannot reach the accumulator through the dot
-            in_range = (
-                c0 + jax.lax.broadcasted_iota(jnp.int32, tile.shape, 1)
-                < n_cols
-            )
-            tile = jnp.where(in_range, tile, 0)
-        # zero un-owned rows with a SELECT (never multiply: un-owned slots
-        # skipped their DMA, so the buffer holds uninitialized/stale VMEM —
-        # 0·NaN would poison the dot and, sharded, the psum)
-        tile = jnp.where(own[:, None] != 0, tile, jnp.zeros_like(tile))
-        col_ids = c0 + jax.lax.broadcasted_iota(
-            jnp.int32, (_COL_TILE, cols.shape[0]), 0
-        )
-        onehot = (col_ids == cols[None, :]).astype(tile.dtype)
-        if exact and tile.dtype == jnp.float32:
-            # hi/lo split: TPU MXU truncates f32 dot operands to bf16, so a
-            # single dot rounds the selected VALUES (~4e-3 rel). Splitting
-            # x = bf16(x) + bf16(x - bf16(x)) and summing two dots restores
-            # ~f32-exact selection for 2x the (non-dominant) FLOPs at the
-            # same one-pass HBM traffic — vs ~10x cost for gather_mode=
-            # 'direct', the only previous exact-on-TPU option.
-            hi = tile.astype(jnp.bfloat16)
-            lo = (tile - hi.astype(jnp.float32)).astype(jnp.bfloat16)
-            oh16 = onehot.astype(jnp.bfloat16)
-            acc += jax.lax.dot(hi, oh16, preferred_element_type=jnp.float32)
-            acc += jax.lax.dot(lo, oh16, preferred_element_type=jnp.float32)
-        else:
-            acc += jax.lax.dot(
-                tile, onehot, preferred_element_type=jnp.float32
-            )
+    acc = select_columns(rows_buf, cols, n_cols, n_tiles, exact=exact,
+                         own=own)
     out_ref[0] = acc.astype(out_ref.dtype)
 
 

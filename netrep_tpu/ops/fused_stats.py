@@ -1,0 +1,489 @@
+"""Pallas mega-kernel: gather + the seven preservation statistics + tally
+accumulation fused in VMEM (ISSUE 8; ROADMAP item 1).
+
+Why a mega-kernel (BENCH_r01–r05 roofline trajectory): with
+``gather_mode='fused'`` the submatrix *extraction* already runs as one HBM
+pass (:mod:`netrep_tpu.ops.fused_gather`), but the seven statistics and the
+streaming tally fold stay XLA-composed — the gathered ``(cap, cap)`` blocks
+round-trip HBM between the gather, each statistic pass (XLA re-reads the
+block ~3–5× across the Gram/Pearson/degree kernels), and the exceedance
+comparison. On a bandwidth-bound loop those passes are the remaining
+distance to the <60 s north-star. This kernel instead, per permutation and
+module:
+
+1. DMAs the module's ``cap`` rows HBM→VMEM in ``rb``-row blocks (the
+   row-DMA machinery of :func:`netrep_tpu.ops.fused_gather.run_dma_window`,
+   shared — not copied);
+2. column-selects each block against the module's index set on the MXU
+   (:func:`netrep_tpu.ops.fused_gather.select_columns`, shared) into a
+   VMEM-resident ``(cap, cap)`` submatrix — plus the stored network's rows
+   when the engine is not in derived-network mode, and the module's data
+   rows from the transposed data matrix;
+3. computes all seven preservation statistics (avg.weight, coherence via
+   the fixed-count power iteration, cor.cor, cor.degree, cor.contrib,
+   avg.cor, avg.contrib) entirely in VMEM by calling the SAME
+   :func:`netrep_tpu.ops.stats.module_stats_masked` the XLA paths run —
+   one formula site, so the kernel can never compute different statistics
+   than the engine;
+4. writes the ``(7,)`` statistics row back (the materialized-null
+   contract) and — in counts mode — compares against the observed
+   statistics and accumulates ``(hi, lo, eff)`` int32 tallies in a VMEM
+   accumulator that is written to HBM once per grid sweep: O(modules·7)
+   counts leave the chip per kernel call, the PR-2 streaming-tally carry
+   contract.
+
+Total HBM traffic per permutation: ``Σ cap·n`` read once (+ ``cap·s`` data
+rows) and O(K·7) written — versus the XLA composition's several passes
+over the gathered blocks plus the full ``(C, K, 7)`` statistics transfer.
+
+Parity contract (pinned in tests/test_fused_stats.py, interpret mode on
+CPU tier-1):
+
+- **within stat_mode='fused'**: the counts-mode tallies equal
+  ``tail_counts`` of the values-mode null bit-for-bit — both outputs come
+  from the same in-kernel statistics registers, the exact analogue of the
+  PR-2 streaming↔materialized contract;
+- **against the XLA path**: statistics agree at float-rounding level
+  (~1e-7 — the same drift class as re-partitioning ``lax.map``, which the
+  autotune cache has always documented), and the resulting counts,
+  p-values, and adaptive retirement decisions are pinned EQUAL on the CI
+  fixtures. On TPU the one-hot selection carries MXU bf16 rounding like
+  every fused/mxu gather (``fused_exact`` restores ~f32-exact selection);
+  device agreement is held to ``selftest`` tolerance, not bit equality.
+
+CPU/testing: ``interpret=True`` runs the kernel in the Pallas interpreter
+(the tier-1 parity surface); the engine selects the compiled path only on
+TPU-like backends (``EngineConfig.stat_mode``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import stats as jstats
+from .fused_gather import (
+    _COL_TILE, _DMA_WINDOW, _ROW_BLOCK, _VMEM_BUDGET, run_dma_window,
+    select_columns,
+)
+from .oracle import N_STATS
+
+#: floor for the rows-buffer budget after the stats kernel's extra VMEM
+#: residents (submatrices, data rows, discovery blocks) are subtracted
+#: from the shared gather budget — below this even an 8-row block cannot
+#: stream usefully and the caller should use stat_mode='xla'.
+_MIN_ROWS_BUDGET = 1 << 20
+
+
+def _stats_scratch_bytes(cap: int, capp: int, s_pad: int, itemsize: int,
+                         has_net: bool, has_data: bool) -> int:
+    """Non-rows-buffer VMEM the kernel holds resident per grid step: the
+    selected submatrices, the data-row block, and the per-module discovery
+    blocks (corr + sign_corr dominate)."""
+    subs = capp * cap * 4 * (2 if has_net else 1)
+    # derived-net mode still materializes sub_net from sub_corr in registers
+    subs = max(subs, capp * cap * 4 + cap * cap * 4)
+    data = capp * s_pad * itemsize if has_data else 0
+    disc = 2 * cap * cap * 4 + 4 * cap * 4
+    return subs + data + disc
+
+
+def resolve_row_block(cap: int, n_cols: int, itemsize: int,
+                      s_pad: int = 0, has_net: bool = False,
+                      has_data: bool = False,
+                      override: int | None = None) -> int:
+    """Row-block size for one fused-stats launch: the gather kernel's
+    :func:`~netrep_tpu.ops.fused_gather._row_block` policy applied to the
+    budget REMAINING after this kernel's extra VMEM residents. ``override``
+    (the autotune cache's best-measured block,
+    :func:`netrep_tpu.utils.autotune.resolve_fused_rowblock`) is honored
+    after sublane alignment and the same budget guard."""
+    extra = _stats_scratch_bytes(cap, -(-cap // 8) * 8, s_pad, itemsize,
+                                 has_net, has_data)
+    budget = _VMEM_BUDGET - extra
+    if budget < _MIN_ROWS_BUDGET:
+        raise ValueError(
+            f"fused-stats scratch needs {extra / 2**20:.1f} MiB of VMEM "
+            f"before any row buffer (cap {cap}, {n_cols} cols); use "
+            "stat_mode='xla' at this scale"
+        )
+    n_col_tiles = -(-n_cols // _COL_TILE)
+    row_bytes = n_col_tiles * _COL_TILE * itemsize
+    fit = max(8, budget // row_bytes // 8 * 8)
+    cap8 = -(-cap // 8) * 8
+    limit = min(cap8, _ROW_BLOCK, fit)
+    if limit * row_bytes > budget:
+        raise ValueError(
+            f"fused-stats row buffer needs {limit * row_bytes / 2**20:.1f} "
+            f"MiB at the smallest block ({limit} rows x {n_cols} cols); "
+            "use stat_mode='xla' (or bfloat16 storage) at this scale"
+        )
+    if override is not None and override >= 8:
+        return min(max(8, override // 8 * 8), limit)
+    # same minimal-padding policy as the gather kernel's _row_block: fix the
+    # step count at the largest fitting block, then take the smallest
+    # aligned block covering cap in that many steps
+    k = -(-cap // limit)
+    rows_per_step = -(-cap // k)
+    return min(limit, (rows_per_step + 7) // 8 * 8)
+
+
+def _kernel(idx_s, pvalid_s, refs, *, n: int, s: int, cap: int, capp: int,
+            rb: int, n_tiles: int, n_iter: int, summary_method: str,
+            net_beta, has_net: bool, has_data: bool, counts: bool,
+            exact: bool):
+    """One grid step = one (permutation, module) cell; see module docstring.
+
+    Refs (order fixed by :func:`_call`): ``M_ref`` (n, n) HBM correlation;
+    ``N_ref`` (n, n) HBM network (stored-net mode only); ``D_ref`` (n, s)
+    HBM transposed data (data mode only); the six DiscProps fields as
+    per-module VMEM blocks; ``obs_ref`` (1, 7) (counts mode only);
+    ``vals_ref`` (1, 1, 7) out; ``hi/lo/eff`` (K, 7) int32 VMEM
+    accumulators (counts mode only — constant index map keeps them
+    resident across the whole grid sweep, written back once);
+    ``subc_buf``/``subn_buf`` (capp, cap) selected submatrices;
+    ``rows_buf`` (rb, tiles·_COL_TILE) DMA target; ``dbuf`` (capp, s_pad)
+    data rows; ``sems`` DMA semaphores.
+    """
+    it = iter(refs)
+    M_ref = next(it)
+    N_ref = next(it) if has_net else None
+    D_ref = next(it) if has_data else None
+    dcorr, dsign, ddeg, dcon, dsgn, dmask = (next(it) for _ in range(6))
+    obs_ref = next(it) if counts else None
+    vals_ref = next(it)
+    if counts:
+        hi_ref, lo_ref, eff_ref = next(it), next(it), next(it)
+    subc_buf = next(it)
+    subn_buf = next(it) if has_net else None
+    rows_buf = next(it)
+    dbuf = next(it) if has_data else None
+    sems = next(it)
+
+    b = pl.program_id(0)
+    k = pl.program_id(1)
+    n_rblocks = capp // rb
+    cols = idx_s[b, pl.ds(k * cap, cap)]       # (cap,) int32 module indices
+
+    def dma_rows(src_ref, dst_buf, row_of, count, width):
+        def copy(a):
+            return pltpu.make_async_copy(
+                src_ref.at[pl.ds(row_of(a), 1), :],
+                dst_buf.at[pl.ds(a, 1), pl.ds(0, width)],
+                sems.at[a % _DMA_WINDOW],
+            )
+        run_dma_window(copy, count)
+
+    def src_row(a):
+        # overflow slots of the final row block re-fetch the last real row
+        # (their select output lands in submatrix rows >= cap, never read);
+        # sentinel/padded module slots carry index 0 like the XLA paths'
+        # _idx_blocks padding — junk either way, masked out by the stats
+        return jnp.clip(idx_s[b, k * cap + jnp.minimum(a, cap - 1)],
+                        0, n - 1)
+
+    # correlation rows: DMA rb at a time, select into the resident submatrix
+    for r in range(n_rblocks):
+        dma_rows(M_ref, rows_buf,
+                 lambda a, r=r: src_row(r * rb + a), rb, n)
+        subc_buf[pl.ds(r * rb, rb), :] = select_columns(
+            rows_buf, cols, n, n_tiles, exact=exact
+        )
+    if has_net:
+        for r in range(n_rblocks):
+            dma_rows(N_ref, rows_buf,
+                     lambda a, r=r: src_row(r * rb + a), rb, n)
+            subn_buf[pl.ds(r * rb, rb), :] = select_columns(
+                rows_buf, cols, n, n_tiles, exact=exact
+            )
+    if has_data:
+        # data rows are a straight copy (no select): the per-module slice of
+        # the TRANSPOSED data matrix is exactly take(tdT, idx) — bit-exact
+        # on every backend, unlike the matmul-selected matrices
+        dma_rows(D_ref, dbuf, src_row, cap, s)
+
+    sub_c = subc_buf[0:cap, :][None]                       # (1, cap, cap)
+    sub_n = (
+        subn_buf[0:cap, :][None] if has_net
+        else jstats.derived_net(sub_c, net_beta)
+    )
+    mask1 = dmask[...]                                     # (1, cap)
+    disc1 = jstats.DiscProps(
+        corr=dcorr[...], sign_corr=dsign[...], degree=ddeg[...],
+        contrib=dcon[...], sign_contrib=dsgn[...], mask=mask1,
+    )
+    if has_data:
+        zdata = jnp.swapaxes(dbuf[0:cap, 0:s], 0, 1)[None]  # (1, s, cap)
+        zdata = jstats.standardize_masked(zdata, mask1)
+    else:
+        zdata = None
+    stats = jstats.module_stats_masked(
+        disc1, sub_c, sub_n, zdata, n_iter=n_iter,
+        summary_method=summary_method,
+    )                                                      # (1, 7)
+    vals_ref[0, 0, :] = stats[0]
+
+    if counts:
+        @pl.when((b == 0) & (k == 0))
+        def _init():
+            hi_ref[...] = jnp.zeros_like(hi_ref)
+            lo_ref[...] = jnp.zeros_like(lo_ref)
+            eff_ref[...] = jnp.zeros_like(eff_ref)
+
+        # identical comparison semantics to the XLA fold
+        # (engine.make_count_buckets): f32 >= / <= on the very registers the
+        # values output writes, NaN comparing False on both tails, the
+        # perm-validity flag excluding padded tail draws
+        ob = obs_ref[0]                                    # (7,)
+        v = pvalid_s[b, 0] > 0
+        hi_ref[pl.ds(k, 1), :] += ((stats >= ob[None]) & v).astype(jnp.int32)
+        lo_ref[pl.ds(k, 1), :] += ((stats <= ob[None]) & v).astype(jnp.int32)
+        eff_ref[pl.ds(k, 1), :] += (
+            (~jnp.isnan(stats)) & v
+        ).astype(jnp.int32)
+
+
+def _call(tc, tn, tdT, disc, idx, pvalid, obs, *, net_beta, n_iter,
+          summary_method, interpret, exact, counts, row_block=None):
+    """Build and invoke the pallas_call for one (B, K, cap) batch."""
+    B, K, cap = idx.shape
+    n = tc.shape[-1]
+    has_net = tn is not None
+    has_data = tdT is not None
+    s = int(tdT.shape[-1]) if has_data else 0
+    s_pad = -(-max(s, 1) // 128) * 128
+    rb = resolve_row_block(
+        cap, n, tc.dtype.itemsize, s_pad=s_pad, has_net=has_net,
+        has_data=has_data, override=row_block,
+    )
+    capp = -(-cap // rb) * rb
+    n_tiles = -(-n // _COL_TILE)
+    kern = functools.partial(
+        lambda idx_s, pvalid_s, *refs, **kw: _kernel(
+            idx_s, pvalid_s, refs, **kw
+        ),
+        n=n, s=s, cap=cap, capp=capp, rb=rb, n_tiles=n_tiles,
+        n_iter=n_iter, summary_method=summary_method, net_beta=net_beta,
+        has_net=has_net, has_data=has_data, counts=counts, exact=exact,
+    )
+    blk_mm = pl.BlockSpec((1, cap, cap), lambda b, k, *_: (k, 0, 0))
+    blk_m = pl.BlockSpec((1, cap), lambda b, k, *_: (k, 0))
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)]        # corr in HBM
+    operands = [tc]
+    if has_net:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        operands.append(tn)
+    if has_data:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        operands.append(tdT)
+    in_specs += [blk_mm, blk_mm, blk_m, blk_m, blk_m, blk_m]
+    operands += [disc.corr, disc.sign_corr, disc.degree, disc.contrib,
+                 disc.sign_contrib, disc.mask]
+    if counts:
+        in_specs.append(
+            pl.BlockSpec((1, N_STATS), lambda b, k, *_: (k, 0))
+        )
+        operands.append(obs)
+    out_specs = [pl.BlockSpec((1, 1, N_STATS), lambda b, k, *_: (b, k, 0))]
+    out_shape = [jax.ShapeDtypeStruct((B, K, N_STATS), jnp.float32)]
+    if counts:
+        # tallies as full blocks with a CONSTANT index map: the accumulator
+        # stays VMEM-resident across the whole (B, K) sweep and is flushed
+        # to HBM once — the O(modules·7) output contract
+        out_specs += [
+            pl.BlockSpec((K, N_STATS), lambda b, k, *_: (0, 0))
+            for _ in range(3)
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct((K, N_STATS), jnp.int32) for _ in range(3)
+        ]
+    scratch = [pltpu.VMEM((capp, cap), jnp.float32)]
+    if has_net:
+        scratch.append(pltpu.VMEM((capp, cap), jnp.float32))
+    scratch.append(pltpu.VMEM((rb, n_tiles * _COL_TILE), tc.dtype))
+    if has_data:
+        scratch.append(pltpu.VMEM((capp, s_pad), tdT.dtype))
+    scratch.append(
+        pltpu.SemaphoreType.DMA((min(max(rb, cap), _DMA_WINDOW),))
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, K),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    row_bytes = cap * n * tc.dtype.itemsize * (2 if has_net else 1)
+    outs = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            # select matmuls + the seven statistics' Gram/power-iteration
+            # flops (Gram s·cap² + n_iter·cap² matvecs, per module)
+            flops=2 * B * K * (
+                capp * n_tiles * _COL_TILE * cap * (2 if has_net else 1)
+                + s * cap * cap + n_iter * cap * cap
+            ),
+            bytes_accessed=B * K * (row_bytes + cap * max(s, 0) * 4)
+            + B * K * N_STATS * 4,
+            transcendentals=B * K * cap * 2,
+        ),
+    )(
+        idx.reshape(B, K * cap).astype(jnp.int32),
+        pvalid.astype(jnp.int32).reshape(B, 1),
+        *operands,
+    )
+    return outs
+
+
+def fused_stats_values(tc, tn, tdT, disc, idx, *, net_beta=None,
+                       n_iter=60, summary_method="power",
+                       interpret=False, exact=False, row_block=None):
+    """Materialized-mode entry point: the seven statistics for one
+    ``(B, K, cap)`` index batch, gathered and computed in VMEM. Returns
+    ``(B, K, 7)`` f32 — the same per-chunk contract as the XLA chunk body,
+    so the materialized null loops consume it unchanged. ``tn`` None means
+    derived-network mode (``net_beta``); ``tdT`` None the data-less
+    variant (data statistics NaN)."""
+    (vals,) = _call(
+        tc, tn, tdT, disc, idx,
+        jnp.ones((idx.shape[0],), jnp.int32), None,
+        net_beta=net_beta, n_iter=n_iter, summary_method=summary_method,
+        interpret=interpret, exact=exact, counts=False, row_block=row_block,
+    )
+    return vals
+
+
+def fused_stats_counts(tc, tn, tdT, disc, idx, pvalid, obs, *,
+                       net_beta=None, n_iter=60, summary_method="power",
+                       interpret=False, exact=False, row_block=None):
+    """Streaming-mode entry point: gather + statistics + tally fold in one
+    kernel sweep. ``pvalid`` (B,) gates each permutation's contribution
+    (the tail-chunk validity mask); ``obs`` (K, 7) f32 are the observed
+    statistics the in-VMEM comparison runs against. Returns
+    ``(values, hi, lo, eff)`` — values ``(B, K, 7)`` f32 (the registers the
+    counts were compared from; callers may discard them, they cost only
+    O(B·K·7) HBM) and int32 ``(K, 7)`` tally deltas satisfying
+    ``hi == sum((values >= obs) & pvalid)`` etc. bit-for-bit."""
+    return _call(
+        tc, tn, tdT, disc, idx, pvalid, obs,
+        net_beta=net_beta, n_iter=n_iter, summary_method=summary_method,
+        interpret=interpret, exact=exact, counts=True, row_block=row_block,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Ring exchange (row-sharded path)
+# ---------------------------------------------------------------------------
+
+def ring_shift_collective(block, axis_name: str, n_shards: int):
+    """Rotate each shard's row block to its right neighbor — the default
+    ring-exchange step of the row-sharded fused-stats path. Implemented as
+    ``jax.lax.ppermute``, which XLA lowers to a collective-permute: on TPU
+    ICI that IS a neighbor DMA (each chip talks only to its ring
+    neighbor), and on the CPU test mesh it is an exact, interpretable
+    stand-in — one algorithm, testable in tier-1."""
+    return jax.lax.ppermute(
+        block, axis_name,
+        perm=[(j, (j + 1) % n_shards) for j in range(n_shards)],
+    )
+
+
+def _ring_dma_kernel(x_ref, out_ref, send_sem, recv_sem, *, neighbor_of):
+    """In-kernel neighbor DMA (SNIPPETS [1]–[3] right-permute pattern):
+    push this shard's whole block to the right neighbor's output buffer
+    with one ``pltpu.make_async_remote_copy``."""
+    copy = pltpu.make_async_remote_copy(
+        src_ref=x_ref,
+        dst_ref=out_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=neighbor_of(),
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    copy.start()
+    copy.wait()
+
+
+def ring_shift_dma(block, axis_name: str, n_shards: int,
+                   mesh_axis_names: tuple):
+    """Experimental in-kernel ring step: the SNIPPETS [1]–[3]
+    ``make_async_remote_copy`` right-permute, for real-TPU runs where the
+    exchange should ride explicit per-neighbor DMA instead of the XLA
+    collective (enable with ``NETREP_RING_DMA=1``; the collective path is
+    the default and the only one CI can execute). ``mesh_axis_names`` is
+    the full mesh axis order — the remote device id names coordinates on
+    every mesh axis, keeping the copy inside the ring's row column."""
+    def neighbor_of():
+        right = jax.lax.rem(
+            jax.lax.axis_index(axis_name) + 1, jnp.int32(n_shards)
+        )
+        return tuple(
+            right if name == axis_name else jax.lax.axis_index(name)
+            for name in mesh_axis_names
+        )
+
+    return pl.pallas_call(
+        functools.partial(_ring_dma_kernel, neighbor_of=neighbor_of),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct(block.shape, block.dtype),
+        scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+        compiler_params=pltpu.TPUCompilerParams(collective_id=0),
+    )(block)
+
+
+def ring_gather_all(mats, idx_list, axis_name: str, n_shards: int,
+                    rows_per: int, *, interpret=False, exact=False,
+                    use_dma=False, mesh_axis_names=()):
+    """Assemble full ``(…, cap, cap)`` submatrices from row-sharded
+    matrices by streaming row blocks around the ring: at step t this shard
+    holds the block originally owned by shard ``(me − t) mod R``, adds its
+    additive contribution for EVERY bucket's index set (the per-shard
+    Pallas gather kernel,
+    :func:`netrep_tpu.ops.fused_gather.gather_submatrix_fused_local` — DMA
+    only the rows the resident block owns), and passes the block to the
+    right neighbor. After R steps every submatrix entry received exactly
+    one nonzero contribution — bit-exact assembly, like the psum it
+    replaces, but via R−1 neighbor exchanges instead of an all-reduce, and
+    with the row axis now carrying its own permutation shard (the caller
+    splits the chunk over BOTH mesh axes, so the row axis multiplies
+    permutation parallelism instead of duplicating it). One ring sweep
+    serves ALL buckets and ALL matrices (corr [+ stored net]) — each block
+    is exchanged R−1 times per chunk total, not per gather.
+
+    ``mats``: list of ``(rows_per, n)`` local blocks (one ring per
+    matrix, rotated in lockstep); ``idx_list``: one ``(…, cap)`` GLOBAL
+    index batch per bucket. Returns ``subs[mat][bucket]``."""
+    from .fused_gather import gather_submatrix_fused_local
+
+    me = jax.lax.axis_index(axis_name)
+    subs = [
+        [jnp.zeros(idx.shape + (idx.shape[-1],), jnp.float32)
+         for idx in idx_list]
+        for _ in mats
+    ]
+    blocks = list(mats)
+    for t in range(n_shards):
+        row_start = (
+            jax.lax.rem(me - t + n_shards, jnp.int32(n_shards)) * rows_per
+        )
+        for mi, blk in enumerate(blocks):
+            for bi, idx in enumerate(idx_list):
+                subs[mi][bi] = subs[mi][bi] + gather_submatrix_fused_local(
+                    blk, idx, row_start, interpret=interpret, exact=exact,
+                )
+        if t < n_shards - 1:
+            blocks = [
+                ring_shift_dma(b, axis_name, n_shards, mesh_axis_names)
+                if use_dma
+                else ring_shift_collective(b, axis_name, n_shards)
+                for b in blocks
+            ]
+    return subs

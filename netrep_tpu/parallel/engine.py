@@ -490,18 +490,35 @@ def make_count_buckets(perm_axis: int):
     return count_buckets
 
 
+def shard_chunk_offset(axis_name, local_count: int):
+    """Global permutation-column offset of THIS shard's chunk slice inside
+    ``shard_map`` — shared by the count fold's validity mask and the fused
+    counter. ``axis_name`` may be one mesh axis (the perm-sharded fused
+    gather path) or a tuple (the ring path, where the chunk splits over
+    perm × row): the combined shard index follows the same major-to-minor
+    order ``P((a0, a1))`` splits an array axis by."""
+    names = (
+        axis_name if isinstance(axis_name, (tuple, list)) else (axis_name,)
+    )
+    idx = jnp.int32(0)
+    for nm in names:
+        idx = idx * jax.lax.psum(jnp.int32(1), nm) + jax.lax.axis_index(nm)
+    return idx * local_count
+
+
 def chunk_count_deltas(chunk, count_buckets, axis_name, keys_c, valid_c,
                        chunk_ops, obs):
     """Evaluate one chunk and reduce it to per-bucket ``(hi, lo, eff)``
     count deltas on device — the shared body of the fixed superchunk scan
     and the adaptive per-chunk count dispatch. ``axis_name`` is set only
-    under ``shard_map`` (the fused replicated-matrices path): the validity
-    mask then offsets by the shard's column position and the per-shard
-    partial counts ``psum`` into full-chunk counts."""
+    under ``shard_map`` (the fused replicated-matrices path, or — as an
+    axis tuple — the ring-exchange row-sharded path): the validity mask
+    then offsets by the shard's column position and the per-shard partial
+    counts ``psum`` into full-chunk counts."""
     outs = chunk(keys_c, *chunk_ops)
     col = jnp.arange(keys_c.shape[0], dtype=jnp.int32)
     if axis_name is not None:
-        col = col + jax.lax.axis_index(axis_name) * keys_c.shape[0]
+        col = col + shard_chunk_offset(axis_name, keys_c.shape[0])
     mask = col < valid_c
     deltas = count_buckets(outs, obs, mask)
     if axis_name is not None:
@@ -509,7 +526,8 @@ def chunk_count_deltas(chunk, count_buckets, axis_name, keys_c, valid_c,
     return deltas
 
 
-def build_stream_super(chunk, count_buckets, axis_name=None):
+def build_stream_super(chunk, count_buckets, axis_name=None,
+                       count_chunk=None):
     """The superchunk program: ``jax.lax.scan`` over K consecutive
     permutation chunks in ONE device dispatch, the carry holding the
     running per-(module, statistic) tallies — K× fewer host round-trips
@@ -518,6 +536,13 @@ def build_stream_super(chunk, count_buckets, axis_name=None):
     time). Callers jit with ``donate_argnums=(0,)`` so the carry is
     updated in place instead of doubling the tally footprint.
 
+    The per-chunk count computation defaults to
+    :func:`chunk_count_deltas` over ``(chunk, count_buckets, axis_name)``;
+    ``count_chunk(keys_c, valid_c, chunk_ops, obs) -> deltas`` overrides
+    it — the fused-statistics mega-kernel supplies a counter whose tally
+    fold happens in VMEM (ISSUE 8) instead of an XLA reduction, while the
+    scan/carry contract here stays byte-identical.
+
     Signature of the returned function:
     ``super_fn(tallies, keys, valid, chunk_ops, obs) -> tallies`` with
     ``keys`` ``(K, C)`` per-permutation PRNG keys and ``valid`` ``(K,)``
@@ -525,14 +550,17 @@ def build_stream_super(chunk, count_buckets, axis_name=None):
     compiled ``(K, C)`` shape — trailing chunks simply carry ``valid=0``,
     so one program serves the whole run).
     """
+    if count_chunk is None:
+        def count_chunk(keys_c, valid_c, chunk_ops, obs):
+            return chunk_count_deltas(
+                chunk, count_buckets, axis_name, keys_c, valid_c,
+                chunk_ops, obs,
+            )
 
     def super_fn(tallies, keys, valid, chunk_ops, obs):
         def body(carry, xs):
             keys_c, valid_c = xs
-            deltas = chunk_count_deltas(
-                chunk, count_buckets, axis_name, keys_c, valid_c,
-                chunk_ops, obs,
-            )
+            deltas = count_chunk(keys_c, valid_c, chunk_ops, obs)
             new = [
                 tuple(t + d for t, d in zip(ts, ds))
                 for ts, ds in zip(carry, deltas)
@@ -1413,6 +1441,31 @@ def make_fused_gather(cfg: EngineConfig):
     return partial(_gsf, interpret=on_cpu, exact=exact)
 
 
+def make_fused_stats(cfg: EngineConfig):
+    """Backend-gated partials of the fused-statistics mega-kernel
+    (:mod:`netrep_tpu.ops.fused_stats`), mirroring :func:`make_fused_gather`
+    — CPU runs the Pallas interpreter (the tier-1 parity surface) and
+    ``fused_exact`` applies off-CPU only (plain dots are already exact
+    there), with ``'always'`` forcing the hi/lo split for CI coverage.
+    Returns ``(values_fn, counts_fn)`` with the kernel statics
+    (power-iteration count, summary method, interpret/exact gates) bound;
+    call sites supply matrices, indices, net_beta, and row_block."""
+    from ..ops.fused_stats import fused_stats_counts, fused_stats_values
+
+    on_cpu = jax.default_backend() == "cpu"
+    exact = bool(cfg.fused_exact) and (
+        cfg.fused_exact == "always" or not on_cpu
+    )
+    kw = dict(
+        n_iter=cfg.power_iters, summary_method=cfg.summary_method,
+        interpret=on_cpu, exact=exact,
+    )
+    return (
+        partial(fused_stats_values, **kw),
+        partial(fused_stats_counts, **kw),
+    )
+
+
 def fused_scan(keys, B: int, batch_body):
     """Pad the chunk's key array up to whole ``B``-batches (padded
     permutations are computed and discarded — a divisor search would
@@ -1540,6 +1593,29 @@ class PermutationEngine:
         # r1 item 3 lifted the old row_sharded → 'direct' force): 'mxu' on
         # accelerators, 'direct' on CPU, per EngineConfig.gather_mode.
         self.gather_mode = config.resolved_gather_mode(jax.default_backend())
+        # Statistics execution mode (ISSUE 8): 'fused' routes null chunks
+        # through the Pallas mega-kernel (gather + seven statistics [+ tally
+        # fold] in VMEM, ops/fused_stats.py); resolved BEFORE effective_chunk
+        # is first consulted — the row-sharded ring path rounds the chunk
+        # over BOTH mesh axes.
+        self.stat_mode = config.resolved_stat_mode(jax.default_backend())
+        #: fused-stats row-block override from the persistent autotune cache
+        #: (None = the kernel's minimal-padding heuristic); the streaming
+        #: loop records measured perms/s back against the applied block
+        self._fused_rowblock = None
+        self._fused_rb_record = None
+        if self.stat_mode == "fused" and config.autotune:
+            from ..utils.autotune import make_key, resolve_fused_rowblock
+
+            rb_key = make_key(
+                jax.default_backend(), "fused-stats",
+                ",".join(str(config.rounded_cap(m.size)) for m in modules),
+                config.chunk_size, "rowblock",
+            )
+            rb, rb_cache = resolve_fused_rowblock(config, rb_key)
+            self._fused_rowblock = rb
+            if rb_cache is not None:
+                self._fused_rb_record = (rb_cache, rb_key)
         # Derived-network mode: never store/gather the n×n network on device
         # (EngineConfig.network_from_correlation) — submatrices come from
         # |gathered corr|**β. Sample-check the claim against the supplied
@@ -1832,20 +1908,28 @@ class PermutationEngine:
         self._stream_count_cached = None
         self._autotune_record = None
         self._stream_autotune_record = None
+        self._fused_rb_record = None
         self._gather_perm = None
         self._gather_rep = None
         self.mesh = None
 
     def autotune_key(self, extra: str = "") -> str:
         """Problem-shape key for the persistent throughput cache: backend ×
-        gather mode × per-bucket (cap, module count) signature × chunk."""
+        gather mode × per-bucket (cap, module count) signature × chunk.
+        The fused-stats mode suffixes the mode component so its
+        compile-span, perf-ledger, and throughput histories never mix
+        with the XLA composition's (ISSUE 8)."""
         from ..utils.autotune import make_key
 
         caps = ",".join(
             f"{b.cap}x{len(b.module_pos)}" for b in self.buckets
         )
+        mode = (
+            f"{self.gather_mode}+fusedstats" if self.stat_mode == "fused"
+            else self.gather_mode
+        )
         return make_key(
-            jax.default_backend(), self.gather_mode, caps,
+            jax.default_backend(), mode, caps,
             self.effective_chunk(), extra,
         )
 
@@ -1863,10 +1947,35 @@ class PermutationEngine:
         (:func:`run_stream_superchunks`) — persists the measurement for the
         (key, superchunk) this run resolved, so the next streaming run with
         the same problem shape reuses the best-measured fused dispatch
-        depth (:func:`netrep_tpu.utils.autotune.resolve_superchunk`)."""
+        depth (:func:`netrep_tpu.utils.autotune.resolve_superchunk`). On
+        the fused-stats path the same rate is also recorded against the
+        mega-kernel's applied row block, converging the DMA/select grid
+        per problem shape (ISSUE 8 autotune satellite)."""
         if self._stream_autotune_record is not None:
             cache, key, k = self._stream_autotune_record
             cache.record(key, k, perms_per_sec)
+        if self._fused_rb_record is not None and self.stat_mode == "fused":
+            cache, key = self._fused_rb_record
+            rb = self._fused_rowblock
+            if rb is None:
+                # record the heuristic block actually applied to the
+                # dominant (largest-cap) bucket so sweeps have a baseline
+                from ..ops.fused_stats import resolve_row_block
+
+                try:
+                    cap = max(b.cap for b in self.buckets)
+                    ref = self._test_corr
+                    n_cols = int(ref.shape[-1]) if ref is not None else 0
+                    if n_cols:
+                        rb = resolve_row_block(
+                            cap, n_cols, jnp.dtype(self.config.dtype).itemsize,
+                            s_pad=128, has_net=self._test_net is not None,
+                            has_data=self._test_dataT is not None,
+                        )
+                except (ValueError, AttributeError):
+                    rb = None
+            if rb:
+                cache.record(key, int(rb), perms_per_sec)
 
     # ------------------------------------------------------------------
     # Observed pass (SURVEY.md §3.1 "observed pass")
@@ -1884,12 +1993,37 @@ class PermutationEngine:
     #    reproducibility guarantee; also used by MultiTestEngine) ----------
 
     def effective_chunk(self) -> int:
-        """Chunk size, rounded to a multiple of the mesh's permutation axis."""
+        """Chunk size, rounded to a multiple of the mesh's permutation axis
+        — or of the FULL mesh (perm × row) on the ring-exchange path, where
+        the row axis carries its own permutation shard (ISSUE 8: each row
+        shard evaluates 1/R of the chunk while the matrix blocks stream
+        around the ring)."""
         C = self.config.chunk_size
         if self.mesh is not None:
             ax = self.mesh.shape[self.config.mesh_axis]
+            if self._stat_fused_ring():
+                from .mesh import ROW_AXIS
+
+                ax *= self.mesh.shape.get(ROW_AXIS, 1)
             C = max(ax, (C // ax) * ax)
         return C
+
+    def _stat_fused_ring(self) -> bool:
+        """Whether null chunks run the ring-exchange row-sharded fused-stats
+        path: the chunk splits over BOTH mesh axes and the row-sharded
+        matrices ring-rotate between neighbors instead of psum-assembling
+        every gather (ISSUE 8)."""
+        return self.stat_mode == "fused" and self.row_sharded
+
+    def _stat_fused_rep(self) -> bool:
+        """Fused-stats over replicated matrices on a perm-axis mesh: XLA
+        cannot auto-partition a pallas_call, so the chunk/streaming
+        programs run under shard_map (same rule as the fused GATHER
+        mode's ``_stream_fused_rep``)."""
+        return (
+            self.stat_mode == "fused" and not self.row_sharded
+            and self.mesh is not None
+        )
 
     @staticmethod
     def perm_keys(key: jax.Array, start: int, count: int) -> jax.Array:
@@ -1992,6 +2126,8 @@ class PermutationEngine:
                 "engine was built discovery_only and has no test matrices; "
                 "the wrapping engine owns the chunk program"
             )
+        if self.stat_mode == "fused":
+            return self._fused_stats_chunk_body()
         cfg = self.config
         # only static structure may be closed over (see chunk_args)
         caps_slices = [(b.cap, tuple(b.slices)) for b in self.buckets]
@@ -2104,6 +2240,185 @@ class PermutationEngine:
 
         return chunk
 
+    def _fused_stats_chunk_body(self) -> Callable:
+        """Unjitted chunk program for ``stat_mode='fused'`` (ISSUE 8): per
+        permutation sub-batch, each bucket's index blocks go straight into
+        the Pallas mega-kernel — one HBM pass gathers the module rows and
+        the seven statistics are computed in VMEM
+        (:func:`netrep_tpu.ops.fused_stats.fused_stats_values`). On the
+        row-sharded path the body instead runs INSIDE ``shard_map`` over
+        (perm × row): the chunk splits over both axes and each shard
+        assembles full submatrices by streaming the matrix row blocks
+        around the neighbor ring
+        (:func:`netrep_tpu.ops.fused_stats.ring_gather_all` — the exchange
+        that replaces the per-gather psum collective), then computes the
+        statistics on its local permutation slice. Returns per-bucket
+        ``(C[, _loc], K, 7)`` arrays — the same contract as the XLA chunk
+        body, so every null loop consumes it unchanged."""
+        import os
+
+        cfg = self.config
+        caps_slices = [(b.cap, tuple(b.slices)) for b in self.buckets]
+        net_beta = self.net_beta
+        from ..utils.autotune import resolve_perm_batch
+
+        at_key = self.autotune_key()
+        heuristic = cfg.resolved_perm_batch(
+            "fused", jax.default_backend(), self.effective_chunk()
+        )
+        perm_batch, at_cache = resolve_perm_batch(cfg, at_key, heuristic)
+        self._autotune_record = (
+            (at_cache, at_key, perm_batch) if at_cache is not None else None
+        )
+
+        if self._stat_fused_ring():
+            from ..ops.fused_stats import ring_gather_all
+            from .mesh import ROW_AXIS
+
+            R = self.mesh.shape[ROW_AXIS]
+            on_cpu = jax.default_backend() == "cpu"
+            exact = bool(cfg.fused_exact) and (
+                cfg.fused_exact == "always" or not on_cpu
+            )
+            use_dma = (
+                not on_cpu and os.environ.get("NETREP_RING_DMA") == "1"
+            )
+            axis_names = tuple(self.mesh.axis_names)
+
+            def chunk(keys, pool, tc, tn, td, discs):
+                # keys: THIS shard's local slice of the chunk (the caller
+                # shards the chunk over perm × row, so the row axis carries
+                # its own permutation share — R× more perm parallelism from
+                # the same mesh, paid for by streaming the matrix once
+                # around the ring per chunk)
+                perm = jax.vmap(
+                    lambda k: jax.random.permutation(k, pool)
+                )(keys)
+                idx_list = [
+                    _idx_blocks(perm, cap, slices)
+                    for cap, slices in caps_slices
+                ]
+                mats = [tc] + ([] if tn is None else [tn])
+                subs = ring_gather_all(
+                    mats, idx_list, ROW_AXIS, R, tc.shape[0],
+                    interpret=on_cpu, exact=exact, use_dma=use_dma,
+                    mesh_axis_names=axis_names,
+                )
+                outs = []
+                for i, ((cap, slices), disc) in enumerate(
+                        zip(caps_slices, discs)):
+                    sub_c = subs[0][i]
+                    sub_n = (
+                        subs[1][i] if tn is not None
+                        else jstats.derived_net(sub_c, net_beta)
+                    )
+                    zd = (
+                        jstats.gather_zdata(td, idx_list[i], disc.mask)
+                        if td is not None else None
+                    )
+                    outs.append(jstats.module_stats_masked(
+                        disc, sub_c, sub_n, zd, n_iter=cfg.power_iters,
+                        summary_method=cfg.summary_method,
+                    ))
+                return outs
+
+            return chunk
+
+        vals_fn, _ = make_fused_stats(cfg)
+        rb = self._fused_rowblock
+
+        def chunk(keys, pool, tc, tn, td, discs):
+            def batch_body(_, keys_b):
+                perm = jax.vmap(
+                    lambda k: jax.random.permutation(k, pool)
+                )(keys_b)
+                outs_b = []
+                for (cap, slices), disc in zip(caps_slices, discs):
+                    idx_b = _idx_blocks(perm, cap, slices)  # (B, K, cap)
+                    outs_b.append(vals_fn(
+                        tc, tn, td, disc, idx_b, net_beta=net_beta,
+                        row_block=rb,
+                    ))
+                return None, outs_b
+
+            C = keys.shape[0]
+            outs, _ = fused_scan(keys, perm_batch, batch_body)
+            return [o.reshape((-1,) + o.shape[2:])[:C] for o in outs]
+
+        return chunk
+
+    def _fused_count_chunk(self, axis_name) -> Callable:
+        """Counter for the fused-stats streaming paths (ISSUE 8): one
+        ``count_chunk(keys_c, valid_c, chunk_ops, obs) -> deltas`` whose
+        tally fold happens INSIDE the mega-kernel's VMEM accumulator —
+        only O(modules·7) int32 counts per kernel sweep reach HBM, and the
+        superchunk scan / adaptive dispatch add them into the carry
+        exactly as the XLA counter's deltas. ``axis_name`` (under
+        shard_map on a perm-axis mesh) offsets the validity mask by the
+        shard's chunk slice and psums the per-shard deltas. The counts
+        compare the very registers the values output writes, so streaming
+        tallies equal ``tail_counts`` of the fused materialized null
+        bit-for-bit (pinned in tests/test_fused_stats.py)."""
+        cfg = self.config
+        caps_slices = [(b.cap, tuple(b.slices)) for b in self.buckets]
+        sizes_k = [len(b.module_pos) for b in self.buckets]
+        net_beta = self.net_beta
+        _, counts_fn = make_fused_stats(cfg)
+        rb = self._fused_rowblock
+        perm_batch = cfg.resolved_perm_batch(
+            "fused", jax.default_backend(), self.effective_chunk()
+        )
+
+        def count_chunk(keys_c, valid_c, chunk_ops, obs_b):
+            pool, tc, tn, td, discs = chunk_ops
+            C = keys_c.shape[0]
+            B = min(perm_batch, C)
+            nb = -(-C // B)
+            Cp = nb * B
+            keys_p = (
+                jnp.concatenate([keys_c, keys_c[-1:].repeat(Cp - C, axis=0)])
+                if Cp != C else keys_c
+            )
+            pos = jnp.arange(Cp, dtype=jnp.int32)
+            col0 = (
+                shard_chunk_offset(axis_name, C)
+                if axis_name is not None else 0
+            )
+            # two gates: padded scan-tail perms (pos >= C — repeats of the
+            # last key) and the run's tail-chunk validity mask
+            pvalid = ((pos < C) & ((pos + col0) < valid_c)).astype(jnp.int32)
+            init = [
+                tuple(jnp.zeros((k, N_STATS), jnp.int32) for _ in range(3))
+                for k in sizes_k
+            ]
+
+            def body(carry, xs):
+                keys_b, pv_b = xs
+                perm = jax.vmap(
+                    lambda kk: jax.random.permutation(kk, pool)
+                )(keys_b)
+                new = []
+                for (cap, slices), disc, ob, ts in zip(
+                        caps_slices, discs, obs_b, carry):
+                    idx_b = _idx_blocks(perm, cap, slices)
+                    _v, hi, lo, eff = counts_fn(
+                        tc, tn, td, disc, idx_b, pv_b, ob,
+                        net_beta=net_beta, row_block=rb,
+                    )
+                    new.append((ts[0] + hi, ts[1] + lo, ts[2] + eff))
+                return new, None
+
+            deltas, _ = jax.lax.scan(
+                body, init,
+                (keys_p.reshape(nb, B, *keys_p.shape[1:]),
+                 pvalid.reshape(nb, B)),
+            )
+            if axis_name is not None:
+                deltas = jax.lax.psum(deltas, axis_name)
+            return deltas
+
+        return count_chunk
+
     def _build_chunk_fn(self) -> Callable:
         """Jit the chunk body (operands as arguments, :meth:`chunk_args`),
         sharding the per-permutation key array (and outputs) along the
@@ -2120,7 +2435,32 @@ class PermutationEngine:
                 NamedSharding(self.mesh, P(cfg.mesh_axis))
                 for _ in self.buckets
             ]
-            if self.gather_mode == "fused" and not self.row_sharded:
+            if self._stat_fused_ring():
+                # Ring-exchange path (ISSUE 8): the chunk splits over BOTH
+                # mesh axes — each (perm, row) shard evaluates its own
+                # permutation slice against ring-streamed matrix blocks —
+                # so keys and outputs shard over the combined axes and the
+                # row-sharded matrices enter with their storage specs
+                # (ring_chunk_specs — the single spec contract shared with
+                # the streaming builders).
+                from .sharded import _NO_CHECK_KW, _shard_map, ring_chunk_specs
+
+                spec_c, op_specs = ring_chunk_specs(cfg.mesh_axis)
+                keys_sharding = NamedSharding(self.mesh, spec_c)
+                out_shardings = [
+                    NamedSharding(self.mesh, spec_c) for _ in self.buckets
+                ]
+                smapped = _shard_map(
+                    chunk,
+                    mesh=self.mesh,
+                    # (keys, pool, tc, tn, td, discs)
+                    in_specs=(spec_c,) + op_specs,
+                    out_specs=spec_c,
+                    **_NO_CHECK_KW,
+                )
+                jitted = jax.jit(smapped, out_shardings=out_shardings)
+            elif (self.gather_mode == "fused" or self._stat_fused_rep()) \
+                    and not self.row_sharded:
                 # Replicated matrices + perm-axis mesh: XLA's automatic
                 # partitioner cannot split a pallas_call, so the whole chunk
                 # runs under shard_map instead — each device evaluates its
@@ -2419,37 +2759,102 @@ class PermutationEngine:
             )
         return self._stream_count_cached[1]
 
+    def _stream_program_parts(self, adaptive: bool):
+        """Mode-resolved pieces shared by :meth:`_build_stream_super` and
+        :meth:`_build_stream_count_fn` (ISSUE 8 refactor — the three
+        statistics paths must compose with the mesh identically in both
+        streaming loops):
+
+        - ``count_chunk(keys_c, valid_c, chunk_ops, obs) -> deltas``;
+        - the keys PartitionSpec (1-D for the adaptive per-chunk program,
+          2-D ``(K, C)`` for the superchunk scan);
+        - the shard_map in_specs for the chunk operands (None when the
+          program needs no explicit shard_map).
+
+        stat_mode='xla': the chunk program + XLA count fold (shard_map
+        only on the fused-GATHER replicated path, as before).
+        stat_mode='fused' replicated: the mega-kernel counter (tallies
+        fold in VMEM); shard_map over the perm axis when a mesh is
+        present. stat_mode='fused' row-sharded: the ring body under
+        shard_map over (perm × row), keys split over both axes, matrices
+        entering with their row-sharded storage specs."""
+        cfg = self.config
+        key_axes: object = cfg.mesh_axis
+        op_specs = None
+        if self._stat_fused_ring():
+            from .mesh import ROW_AXIS
+            from .sharded import ring_chunk_specs
+
+            chunk = self.chunk_body()
+            axis = (cfg.mesh_axis, ROW_AXIS)
+            count_buckets = make_count_buckets(0)
+
+            def count_chunk(keys_c, valid_c, chunk_ops, obs_b):
+                return chunk_count_deltas(
+                    chunk, count_buckets, axis, keys_c, valid_c,
+                    chunk_ops, obs_b,
+                )
+
+            key_axes = axis
+            _, op_specs = ring_chunk_specs(cfg.mesh_axis)
+        elif self.stat_mode == "fused":
+            axis = cfg.mesh_axis if self.mesh is not None else None
+            count_chunk = self._fused_count_chunk(axis)
+            if self.mesh is not None:
+                op_specs = (P(), P(), P(), P(), P())
+        else:
+            chunk = self.chunk_body()
+            fused_rep = self._stream_fused_rep()
+            axis = cfg.mesh_axis if fused_rep else None
+            count_buckets = make_count_buckets(0)
+
+            def count_chunk(keys_c, valid_c, chunk_ops, obs_b):
+                return chunk_count_deltas(
+                    chunk, count_buckets, axis, keys_c, valid_c,
+                    chunk_ops, obs_b,
+                )
+
+            if fused_rep:
+                op_specs = (P(), P(), P(), P(), P())
+        keys_spec = P(key_axes) if adaptive else P(None, key_axes)
+        return count_chunk, keys_spec, op_specs
+
     def _build_stream_super(self, observed) -> Callable:
         """Jit the superchunk program (scan-fused chunks + donated tally
         carry) with the same mesh composition rules as
         :meth:`_build_chunk_fn`; returns ``fn(tallies, keys, valid)``."""
-        chunk = self.chunk_body()
         args = self.chunk_args()
         obs = self._obs_buckets(observed)
-        cfg = self.config
-        fused_rep = self._stream_fused_rep()
-        axis = cfg.mesh_axis if fused_rep else None
-        super_fn = build_stream_super(chunk, make_count_buckets(0), axis)
+        count_chunk, keys_spec, op_specs = self._stream_program_parts(
+            adaptive=False
+        )
+        super_fn = build_stream_super(None, None, count_chunk=count_chunk)
+        # donate the carry only on the XLA path: the fused counter's
+        # tallies are O(K·7) int32 (nothing to save), and donating inputs
+        # into a program whose body inlines interpret-mode pallas state
+        # machinery proved alias-unsafe on XLA:CPU (intermittent
+        # wrong-counts/aborts in the resume test)
+        donate = () if self.stat_mode == "fused" else (0,)
         if self.mesh is not None:
             from .distributed import to_global
 
-            ksh = NamedSharding(self.mesh, P(None, cfg.mesh_axis))
-            if fused_rep:
+            ksh = NamedSharding(self.mesh, keys_spec)
+            if op_specs is not None:
                 from .sharded import _NO_CHECK_KW, _shard_map
 
                 super_fn = _shard_map(
                     super_fn,
                     mesh=self.mesh,
-                    in_specs=(P(), P(None, cfg.mesh_axis), P(), P(), P()),
+                    in_specs=(P(), keys_spec, P(), op_specs, P()),
                     out_specs=P(),
                     **_NO_CHECK_KW,
                 )
-            jitted = jax.jit(super_fn, donate_argnums=(0,))
+            jitted = jax.jit(super_fn, donate_argnums=donate)
             args, obs = _globalize_replicated(self.mesh, (args, obs))
             return lambda tallies, keys, valid: jitted(
                 tallies, to_global(keys, ksh), valid, args, obs
             )
-        jitted = jax.jit(super_fn, donate_argnums=(0,))
+        jitted = jax.jit(super_fn, donate_argnums=donate)
         return lambda tallies, keys, valid: jitted(
             tallies, keys, valid, args, obs
         )
@@ -2461,30 +2866,26 @@ class PermutationEngine:
         returns ``fn(keys, valid) -> [per-bucket (hi, lo, eff)]``. Reads
         ``self.buckets`` at build time: re-invoked after each retirement
         re-bucketing."""
-        chunk = self.chunk_body()
         args = self.chunk_args()
         obs = self._obs_buckets(observed)
-        cfg = self.config
-        fused_rep = self._stream_fused_rep()
-        axis = cfg.mesh_axis if fused_rep else None
-        count_buckets = make_count_buckets(0)
+        count_chunk, keys_spec, op_specs = self._stream_program_parts(
+            adaptive=True
+        )
 
         def count_fn(keys, valid, chunk_ops, obs_b):
-            return chunk_count_deltas(
-                chunk, count_buckets, axis, keys, valid, chunk_ops, obs_b
-            )
+            return count_chunk(keys, valid, chunk_ops, obs_b)
 
         if self.mesh is not None:
             from .distributed import to_global
 
-            ksh = NamedSharding(self.mesh, P(cfg.mesh_axis))
-            if fused_rep:
+            ksh = NamedSharding(self.mesh, keys_spec)
+            if op_specs is not None:
                 from .sharded import _NO_CHECK_KW, _shard_map
 
                 count_fn = _shard_map(
                     count_fn,
                     mesh=self.mesh,
-                    in_specs=(P(cfg.mesh_axis), P(), P(), P()),
+                    in_specs=(keys_spec, P(), op_specs, P()),
                     out_specs=P(),
                     **_NO_CHECK_KW,
                 )

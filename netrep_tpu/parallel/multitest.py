@@ -147,6 +147,23 @@ class MultiTestEngine:
                 self._td = [jnp.asarray(np.asarray(d).T, dtype) for d in test_datas]
         self.config = config
         self.mesh = mesh
+        # Statistics execution mode (ISSUE 8): the T-axis fused path loops
+        # the cohorts over the shared index blocks, each cohort's rows
+        # gathered+reduced by the mega-kernel. The ring-exchange row-sharded
+        # composition is single-test only — 'auto' falls back to the XLA
+        # composition there, an explicit 'fused' refuses loudly.
+        self.stat_mode = self._base.stat_mode
+        if self.stat_mode == "fused" and self.row_sharded:
+            if config.stat_mode == "fused":
+                raise ValueError(
+                    "stat_mode='fused' with matrix_sharding='row' is not "
+                    "supported on the multi-test engine; use the single-"
+                    "test engine's ring path or stat_mode='xla'"
+                )
+            self.stat_mode = "xla"
+            # keep the base engine's chunk rounding consistent (its ring
+            # predicate would otherwise round the chunk over both axes)
+            self._base.stat_mode = "xla"
         self.modules = self._base.modules
         self.n_modules = self._base.n_modules
         self._chunk_cached: Callable | None = None
@@ -296,6 +313,164 @@ class MultiTestEngine:
 
         return chunk
 
+    def _fused_perm_batch(self) -> tuple:
+        """Resolved perm batch + autotune record for the fused-STATS
+        T-axis chunk (mirrors :meth:`_fused_chunk_body`'s resolution; the
+        key carries T and the fused-stats mode suffix via the base
+        engine's :meth:`~netrep_tpu.parallel.engine.PermutationEngine.
+        autotune_key`)."""
+        from ..utils.autotune import resolve_perm_batch
+
+        base = self._base
+        cfg = self.config
+        pb = cfg.resolved_perm_batch(
+            "fused", jax.default_backend(), base.effective_chunk()
+        )
+        at_key = base.autotune_key(extra=f"T{self.T}")
+        perm_batch, at_cache = resolve_perm_batch(
+            cfg, at_key, max(1, pb // self.T)
+        )
+        base._autotune_record = (
+            (at_cache, at_key, perm_batch) if at_cache is not None else None
+        )
+        return perm_batch
+
+    def _fused_stats_chunk_body(self) -> Callable:
+        """Unjitted fused-STATS chunk for the multi-test path (ISSUE 8):
+        per perm sub-batch the T cohorts loop over the SHARED index
+        blocks, each cohort's module rows gathered, reduced to the seven
+        statistics, and written back by ONE mega-kernel sweep per
+        (cohort, bucket) (:func:`netrep_tpu.ops.fused_stats.
+        fused_stats_values`). Output layout matches every other multi-test
+        chunk: per-bucket ``(T, C, K, 7)``."""
+        from .engine import _idx_blocks, fused_scan, make_fused_stats
+
+        cfg = self.config
+        base = self._base
+        T = self.T
+        td_absent = self._td is None
+        tn_absent = self._tn is None
+        net_beta = self.net_beta
+        caps_slices = [(b.cap, tuple(b.slices)) for b in base.buckets]
+        vals_fn, _ = make_fused_stats(cfg)
+        rb = base._fused_rowblock
+        perm_batch = self._fused_perm_batch()
+
+        def chunk(keys, pool, tc, tn, td, discs):
+            C = keys.shape[0]
+
+            def batch_body(_, keys_b):
+                perm = jax.vmap(
+                    lambda k: jax.random.permutation(k, pool)
+                )(keys_b)
+                outs_b = []
+                for (cap, slices), disc in zip(caps_slices, discs):
+                    idx_b = _idx_blocks(perm, cap, slices)  # (B, K, cap)
+                    per_t = [
+                        vals_fn(
+                            tc[t], None if tn_absent else tn[t],
+                            None if td_absent else td[t], disc, idx_b,
+                            net_beta=net_beta, row_block=rb,
+                        )
+                        for t in range(T)
+                    ]
+                    outs_b.append(jnp.stack(per_t))  # (T, B, K, 7)
+                return None, outs_b
+
+            outs, Cp = fused_scan(keys, perm_batch, batch_body)
+            return [
+                o.swapaxes(0, 1).reshape(T, Cp, *o.shape[3:])[:, :C]
+                for o in outs
+            ]
+
+        return chunk
+
+    def _fused_count_chunk(self, axis_name) -> Callable:
+        """Fused-STATS counter for the multi-test streaming paths: the
+        T-axis twin of :meth:`~netrep_tpu.parallel.engine.
+        PermutationEngine._fused_count_chunk` — per (cohort, bucket) the
+        mega-kernel folds ``(hi, lo, eff)`` in VMEM and the per-batch
+        ``(K, 7)`` deltas stack into the ``(T, K, 7)`` tally layout the
+        multi-test carry holds."""
+        from .engine import (
+            _idx_blocks, make_fused_stats, shard_chunk_offset,
+        )
+        from ..ops.oracle import N_STATS
+
+        cfg = self.config
+        base = self._base
+        T = self.T
+        td_absent = self._td is None
+        tn_absent = self._tn is None
+        net_beta = self.net_beta
+        caps_slices = [(b.cap, tuple(b.slices)) for b in base.buckets]
+        sizes_k = [len(b.module_pos) for b in base.buckets]
+        _, counts_fn = make_fused_stats(cfg)
+        rb = base._fused_rowblock
+        perm_batch = self._fused_perm_batch()
+
+        def count_chunk(keys_c, valid_c, chunk_ops, obs_b):
+            pool, tc, tn, td, discs = chunk_ops
+            C = keys_c.shape[0]
+            B = min(perm_batch, C)
+            nb = -(-C // B)
+            Cp = nb * B
+            keys_p = (
+                jnp.concatenate(
+                    [keys_c, keys_c[-1:].repeat(Cp - C, axis=0)]
+                ) if Cp != C else keys_c
+            )
+            pos = jnp.arange(Cp, dtype=jnp.int32)
+            col0 = (
+                shard_chunk_offset(axis_name, C)
+                if axis_name is not None else 0
+            )
+            pvalid = (
+                (pos < C) & ((pos + col0) < valid_c)
+            ).astype(jnp.int32)
+            init = [
+                tuple(
+                    jnp.zeros((T, k, N_STATS), jnp.int32) for _ in range(3)
+                )
+                for k in sizes_k
+            ]
+
+            def body(carry, xs):
+                keys_b, pv_b = xs
+                perm = jax.vmap(
+                    lambda kk: jax.random.permutation(kk, pool)
+                )(keys_b)
+                new = []
+                for (cap, slices), disc, ob, ts in zip(
+                        caps_slices, discs, obs_b, carry):
+                    idx_b = _idx_blocks(perm, cap, slices)
+                    per_t = [
+                        counts_fn(
+                            tc[t], None if tn_absent else tn[t],
+                            None if td_absent else td[t], disc, idx_b,
+                            pv_b, ob[t], net_beta=net_beta, row_block=rb,
+                        )[1:]
+                        for t in range(T)
+                    ]
+                    hi_t = jnp.stack([p[0] for p in per_t])
+                    lo_t = jnp.stack([p[1] for p in per_t])
+                    eff_t = jnp.stack([p[2] for p in per_t])
+                    new.append(
+                        (ts[0] + hi_t, ts[1] + lo_t, ts[2] + eff_t)
+                    )
+                return new, None
+
+            deltas, _ = jax.lax.scan(
+                body, init,
+                (keys_p.reshape(nb, B, *keys_p.shape[1:]),
+                 pvalid.reshape(nb, B)),
+            )
+            if axis_name is not None:
+                deltas = jax.lax.psum(deltas, axis_name)
+            return deltas
+
+        return count_chunk
+
     def _finish_chunk(self, chunk, chunk_args, fused_rep: bool) -> Callable:
         """Jit (and, with a mesh, shard) a chunk body. ``fused_rep`` marks
         the fused replicated-matrices path, whose pallas_call XLA cannot
@@ -369,6 +544,13 @@ class MultiTestEngine:
         tn_absent = self._tn is None
         if row_sharded:
             from .sharded import gather_corr_net
+
+        if self.stat_mode == "fused":
+            # fused-stats chunk (ISSUE 8): replicated path only (the
+            # row-sharded composition downgraded in __init__); needs the
+            # whole-chunk shard_map treatment on a mesh, like the fused
+            # gather (pallas_call cannot be auto-partitioned)
+            return self._fused_stats_chunk_body(), chunk_args, True
 
         fused_rep = base.gather_mode == "fused" and not row_sharded
         if fused_rep:
@@ -591,24 +773,46 @@ class MultiTestEngine:
             make_count_buckets,
         )
 
-        chunk, args, fused_rep = self._chunk_parts()
-        obs = self._obs_buckets(observed)
         cfg = self.config
-        shard = fused_rep and self.mesh is not None
-        axis = cfg.mesh_axis if shard else None
-        count_buckets = make_count_buckets(1)
-        if adaptive:
-            def program(keys, valid, chunk_ops, obs_b):
-                return chunk_count_deltas(
-                    chunk, count_buckets, axis, keys, valid, chunk_ops,
-                    obs_b,
+        if self.stat_mode == "fused":
+            # mega-kernel counter: the tally fold happens in VMEM
+            # (ISSUE 8); the program still needs the whole-chunk shard_map
+            # on a mesh (pallas_call cannot be auto-partitioned) with the
+            # per-shard deltas psum'd inside the counter
+            _, args, _ = self._chunk_parts()
+            shard = self.mesh is not None
+            axis = cfg.mesh_axis if shard else None
+            count_chunk = self._fused_count_chunk(axis)
+            if adaptive:
+                program = count_chunk
+            else:
+                program = build_stream_super(
+                    None, None, count_chunk=count_chunk
                 )
+        else:
+            chunk, args, fused_rep = self._chunk_parts()
+            shard = fused_rep and self.mesh is not None
+            axis = cfg.mesh_axis if shard else None
+            count_buckets = make_count_buckets(1)
+            if adaptive:
+                def program(keys, valid, chunk_ops, obs_b):
+                    return chunk_count_deltas(
+                        chunk, count_buckets, axis, keys, valid, chunk_ops,
+                        obs_b,
+                    )
+            else:
+                program = build_stream_super(chunk, count_buckets, axis)
+        obs = self._obs_buckets(observed)
+        if adaptive:
             keys_spec = P(cfg.mesh_axis)
             donate = ()
         else:
-            program = build_stream_super(chunk, count_buckets, axis)
             keys_spec = P(None, cfg.mesh_axis)
-            donate = (0,)
+            # no carry donation on the fused path (see
+            # PermutationEngine._build_stream_super: tiny tallies, and
+            # donation into interpret-mode pallas state machinery proved
+            # alias-unsafe on XLA:CPU)
+            donate = () if self.stat_mode == "fused" else (0,)
         if self.mesh is not None:
             from .distributed import to_global
 

@@ -147,6 +147,23 @@ def gather_submatrix_local_mxu(
     )
 
 
+def ring_chunk_specs(mesh_axis: str):
+    """Shard_map spec contract of the ring-exchange fused-stats path
+    (ISSUE 8; :mod:`netrep_tpu.ops.fused_stats`): the chunk splits over
+    BOTH mesh axes — ``P((perm, row))`` on the permutation dimension, so
+    each (perm, row) shard owns its own permutation slice — while the
+    row-sharded matrices enter with their storage layout
+    (``P(ROW_AXIS, None)``) and everything else replicates. Returns
+    ``(combined_spec, op_specs)`` with ``op_specs`` matching the engines'
+    ``chunk_args()`` tuple ``(pool, corr, net, dataT, discs)``; ONE
+    definition shared by the materialized chunk builder and both
+    streaming builders, so the three programs cannot drift in how they
+    shard the ring."""
+    combined = P((mesh_axis, ROW_AXIS))
+    mat = P(ROW_AXIS, None)
+    return combined, (P(), mat, mat, P(), P())
+
+
 def gather_corr_net(gather, tc, tn, idx, net_beta):
     """Single dispatch point for derived-network mode over a sharded
     gatherer: with ``tn`` present, gather the (corr, net) submatrix pair;

@@ -164,6 +164,12 @@ class PackedEngine(PermutationEngine):
         self.n_groups = len(request_modules)
         super().__init__(disc_corr, disc_net, disc_data, test_corr, test_net,
                          test_data, mods, pool, config=config, mesh=None)
+        # packed chunks draw one pool shuffle PER KEY GROUP (the overridden
+        # chunk_body below); the fused-stats mega-kernel's chunk/counter
+        # builders draw the base engine's single-group stream and would
+        # silently break the per-request RNG contract — pin the packed
+        # engine to the XLA composition until the kernel learns key groups
+        self.stat_mode = "xla"
         if self.gather_mode == "fused":
             raise ValueError(
                 "gather_mode='fused' is not supported by the packed engine "

@@ -203,3 +203,22 @@ def resolve_superchunk(config, key: str, default: int = DEFAULT_SUPERCHUNK):
     best = cache.best_setting(key)
     _emit_lookup("superchunk", key, best, default)
     return (best if best is not None and best > 0 else default), cache
+
+
+def resolve_fused_rowblock(config, key: str):
+    """Autotuned row-block for the fused-statistics mega-kernel's DMA/
+    select grid (ISSUE 8; :func:`netrep_tpu.ops.fused_stats.
+    resolve_row_block` applies the returned override after sublane
+    alignment and the VMEM budget guard). Nothing measured yet → ``None``
+    (the kernel's minimal-padding heuristic runs unchanged). The streaming
+    loop records its measured perms/s against the resolved block via the
+    same ``record_stream_throughput`` callback that feeds the superchunk
+    entry, so row-block sweeps converge per problem shape exactly like
+    perm-batch and superchunk do. Returns ``(row_block_or_None,
+    cache_or_None)``."""
+    if not getattr(config, "autotune", False):
+        return None, None
+    cache = AutotuneCache()
+    best = cache.best_setting(key)
+    _emit_lookup("fused_rowblock", key, best, 0)
+    return (best if best is not None and best >= 8 else None), cache

@@ -254,6 +254,25 @@ class EngineConfig:
     mxu_batch_budget_bytes: int = 2 << 30
     autotune: bool = True
     superchunk: int | None = None
+    #: statistics execution mode (ISSUE 8): 'xla' composes the null chunk
+    #: from XLA ops (gather → seven statistic kernels → tally fold — the
+    #: path every PR so far measured); 'fused' runs the Pallas mega-kernel
+    #: (:mod:`netrep_tpu.ops.fused_stats`) that DMAs each module's rows
+    #: HBM→VMEM once, computes all seven statistics in VMEM, and (in
+    #: streaming mode) folds the (hi, lo, eff) exceedance tallies in a
+    #: VMEM accumulator — O(modules·7) counts per dispatch leave the chip
+    #: instead of the gathered blocks making several HBM round-trips.
+    #: 'auto' resolves per backend, mirroring gather_mode's structure:
+    #: TPU-like accelerators (tpu/axon) take the kernel when the summary
+    #: method is the kernel-supported fixed-count power iteration; CPU
+    #: (and any summary_method='eigh' run) stays on 'xla'. Explicit
+    #: 'fused' requires summary_method='power' (eigh does not lower to
+    #: Mosaic) and runs the Pallas interpreter on CPU — the tier-1 parity
+    #: surface. Values carry the same rounding class as any re-batching
+    #: (~1e-7 vs the XLA composition on CPU; MXU bf16 selection rounding
+    #: on TPU, ``fused_exact`` restoring ~f32-exact selection), and the
+    #: streaming↔materialized count contract is bit-exact within the mode.
+    stat_mode: str = "auto"
 
     def __post_init__(self):
         if self.network_from_correlation is not None:
@@ -286,6 +305,18 @@ class EngineConfig:
                 f"superchunk must be >= 1 or None (autotuned), got "
                 f"{self.superchunk!r}"
             )
+        if self.stat_mode not in ("auto", "xla", "fused"):
+            raise ValueError(
+                f"stat_mode must be 'auto', 'xla', or 'fused', got "
+                f"{self.stat_mode!r}"
+            )
+        if self.stat_mode == "fused" and self.summary_method != "power":
+            raise ValueError(
+                "stat_mode='fused' computes coherence with the fixed-count "
+                "power iteration inside the kernel; summary_method="
+                f"{self.summary_method!r} is not kernel-supported — use "
+                "summary_method='power' or stat_mode='xla'"
+            )
 
     def resolved_gather_mode(self, platform: str) -> str:
         if self.gather_mode == "auto":
@@ -301,6 +332,19 @@ class EngineConfig:
                 f"got {self.gather_mode!r}"
             )
         return self.gather_mode
+
+    def resolved_stat_mode(self, platform: str) -> str:
+        """Resolve ``stat_mode`` for a backend (see the attribute doc).
+        'auto' takes the fused mega-kernel only on TPU-like accelerators
+        AND only when the summary method is the kernel-supported power
+        iteration — mirroring ``resolved_gather_mode``'s structure; CPU
+        runs stay on the XLA composition (the kernel's interpret path is
+        for parity tests and explicit opt-in, not a CPU speedup)."""
+        if self.stat_mode == "auto":
+            if platform in ("tpu", "axon") and self.summary_method == "power":
+                return "fused"
+            return "xla"
+        return self.stat_mode
 
     def resolved_perm_batch(
         self,

@@ -51,6 +51,11 @@ CASES = [
     # inside the bench before any row is emitted, so this smoke case also
     # guards the packing + warm-pool + scheduler path end-to-end
     ["--config", "serve"],
+    # fused-statistics mega-kernel (ISSUE 8): counts parity vs the XLA
+    # composition is asserted in-bench (interpret mode on CPU) before any
+    # row, so this smoke case guards the stat_mode='fused' dispatch path
+    # end-to-end
+    ["--config", "pallas"],
 ]
 
 

@@ -1,0 +1,423 @@
+"""Pallas fused-statistics mega-kernel (ISSUE 8, ``stat_mode='fused'``) —
+interpret-mode parity on CPU tier-1.
+
+The parity contract (ops/fused_stats.py module docstring): within the mode,
+streaming tallies equal ``tail_counts`` of the kernel's own materialized
+null BIT-FOR-BIT (both outputs come from the same in-kernel registers —
+the PR-2 carry contract); against the XLA composition, values agree at
+float-rounding level (the re-batching drift class the autotune cache has
+always documented) and counts / p-values / retirement decisions are pinned
+EQUAL on these seeded fixtures. The mixed fixture spans multiple bucket
+capacities with padded tails, and the chunk/superchunk sizes leave partial
+tails so the validity-mask path runs in every assertion.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from netrep_tpu.data import make_mixed_pair
+from netrep_tpu.ops import pvalues as pv
+from netrep_tpu.ops import stats as jstats
+from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+from netrep_tpu.utils.config import EngineConfig
+
+# chunk 64 / superchunk 3 / N_PERM 160: partial tail chunk AND partial tail
+# superchunk — the masked-validity path runs in every parity assertion
+N_PERM = 160
+
+
+def _cfg(stat_mode="fused", **kw):
+    base = dict(chunk_size=64, summary_method="power", power_iters=12,
+                superchunk=3, autotune=False, stat_mode=stat_mode)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def mixed():
+    # module_size spread forces MULTIPLE bucket capacities (16/32/64) with
+    # padded tails — the kernel compiles and runs once per cap
+    return make_mixed_pair(400, 6, n_samples=40, module_size=(10, 40),
+                           seed=1)
+
+
+def _engine(mixed, config):
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    return PermutationEngine(
+        dc, dn, dd, tc, tn, td, specs, mixed["pool"], config=config
+    )
+
+
+@pytest.fixture(scope="module")
+def runs(mixed):
+    """Fused materialized + fused streaming + XLA materialized, same key —
+    shared by the parity assertions (each engine build compiles the
+    interpret-mode kernel once)."""
+    e_f = _engine(mixed, _cfg())
+    assert e_f.stat_mode == "fused"
+    assert len({b.cap for b in e_f.buckets}) >= 2  # multi-bucket coverage
+    observed = np.asarray(e_f.observed())
+    nulls_f, done_f = e_f.run_null(N_PERM, key=0)
+    stream_f = e_f.run_null_streaming(N_PERM, observed, key=0)
+    nulls_x, done_x = _engine(mixed, _cfg("xla")).run_null(N_PERM, key=0)
+    return dict(observed=observed, nulls_f=np.asarray(nulls_f),
+                done_f=done_f, stream=stream_f,
+                nulls_x=np.asarray(nulls_x), done_x=done_x)
+
+
+# ---------------------------------------------------------------------------
+# the carry contract: streaming counts == the kernel's own materialized null
+# ---------------------------------------------------------------------------
+
+def test_stream_counts_equal_own_materialized(runs):
+    """The robust bit contract: counts-mode tallies and values-mode
+    statistics come from the same in-kernel registers."""
+    sc = runs["stream"]
+    assert sc.completed == runs["done_f"] == N_PERM
+    hi, lo, eff = pv.tail_counts(runs["observed"],
+                                 runs["nulls_f"][: runs["done_f"]])
+    np.testing.assert_array_equal(sc.hi, hi)
+    np.testing.assert_array_equal(sc.lo, lo)
+    np.testing.assert_array_equal(sc.eff, eff)
+
+
+def test_values_match_xla_at_rounding_level(runs):
+    """Cross-path values drift only at float-rounding level (~1e-7 — the
+    lax.map re-batching class), and the fixture's counts are EQUAL."""
+    drift = np.nanmax(np.abs(runs["nulls_f"] - runs["nulls_x"]))
+    assert drift < 1e-5, drift
+    hi, lo, eff = pv.tail_counts(runs["observed"],
+                                 runs["nulls_x"][: runs["done_x"]])
+    sc = runs["stream"]
+    np.testing.assert_array_equal(sc.hi, hi)
+    np.testing.assert_array_equal(sc.lo, lo)
+    np.testing.assert_array_equal(sc.eff, eff)
+
+
+def test_pvalues_match_xla(runs):
+    sc = runs["stream"]
+    for alt in ("greater", "less", "two.sided"):
+        want = pv.permutation_pvalues(
+            runs["observed"], runs["nulls_x"][: runs["done_x"]], alt
+        )
+        got = pv.counts_pvalues(runs["observed"], sc.hi, sc.lo, sc.eff, alt)
+        np.testing.assert_array_equal(want, got)
+
+
+# ---------------------------------------------------------------------------
+# all four null-loop modes
+# ---------------------------------------------------------------------------
+
+def test_adaptive_modes_match_xla(mixed, runs):
+    """Adaptive materialized + adaptive streaming under stat_mode='fused'
+    reach the XLA run's retirement decisions, n_perm_used, and counts."""
+    observed = runs["observed"]
+    na, da, fin = _engine(mixed, _cfg()).run_null_adaptive(
+        480, observed, key=0
+    )
+    sca = _engine(mixed, _cfg()).run_null_adaptive_streaming(
+        480, observed, key=0
+    )
+    scx = _engine(mixed, _cfg("xla")).run_null_adaptive_streaming(
+        480, observed, key=0
+    )
+    assert sca.finished == fin
+    nulls_a = np.asarray(na)[:da]
+    np.testing.assert_array_equal(sca.n_perm_used, pv.effective_nperm(nulls_a))
+    np.testing.assert_array_equal(sca.n_perm_used, scx.n_perm_used)
+    hi, lo, eff = pv.tail_counts(observed, nulls_a)
+    np.testing.assert_array_equal(sca.hi, hi)
+    np.testing.assert_array_equal(sca.hi, scx.hi)
+    np.testing.assert_array_equal(sca.lo, scx.lo)
+    np.testing.assert_array_equal(sca.eff, scx.eff)
+
+
+def test_exact_hilo_path(mixed, runs):
+    """fused_exact='always' forces the hi/lo split select in interpret
+    mode (CI coverage of the exact engine path); on CPU the split is a
+    value-identical reformulation, so every count matches."""
+    e = _engine(mixed, _cfg(fused_exact="always"))
+    sc = e.run_null_streaming(N_PERM, runs["observed"], key=0)
+    np.testing.assert_array_equal(sc.hi, runs["stream"].hi)
+    np.testing.assert_array_equal(sc.lo, runs["stream"].lo)
+    np.testing.assert_array_equal(sc.eff, runs["stream"].eff)
+
+
+def test_checkpoint_resume_mid_run(mixed, runs, tmp_path):
+    """Mid-run checkpoint resume with stat_mode='fused' reproduces the
+    uninterrupted run exactly (satellite acceptance)."""
+    seen = []
+
+    def interrupt(done, total):
+        seen.append(done)
+        if len(seen) == 1:
+            raise KeyboardInterrupt
+
+    ck = str(tmp_path / "fused_stream.npz")
+    # superchunk=1: progress fires per chunk, so the interrupt lands
+    # mid-run (the fixture's superchunk 3 covers the whole run in one
+    # dispatch); counts are superchunk-invariant, so the reference holds
+    part = _engine(mixed, _cfg(superchunk=1)).run_null_streaming(
+        N_PERM, runs["observed"], key=0, progress=interrupt,
+        checkpoint_path=ck, checkpoint_every=64,
+    )
+    assert 0 < part.completed < N_PERM
+    fin = _engine(mixed, _cfg(superchunk=1)).run_null_streaming(
+        N_PERM, runs["observed"], key=0, checkpoint_path=ck,
+        checkpoint_every=64,
+    )
+    assert fin.completed == N_PERM
+    np.testing.assert_array_equal(fin.hi, runs["stream"].hi)
+    np.testing.assert_array_equal(fin.lo, runs["stream"].lo)
+    np.testing.assert_array_equal(fin.eff, runs["stream"].eff)
+
+
+# ---------------------------------------------------------------------------
+# mesh composition: perm-axis shard_map + the ring-exchange row-sharded path
+# ---------------------------------------------------------------------------
+
+def test_perm_mesh_parity(mixed, runs):
+    from netrep_tpu.parallel import mesh as meshmod
+
+    mesh = meshmod.make_mesh(n_perm_shards=4)
+    cfg = _cfg(chunk_size=32, superchunk=2)
+    eng = _engine_mesh(mixed, cfg, mesh)
+    nulls, done = eng.run_null(80, key=0)
+    sc = eng.run_null_streaming(80, runs["observed"], key=0)
+    hi, lo, eff = pv.tail_counts(runs["observed"], np.asarray(nulls)[:done])
+    np.testing.assert_array_equal(sc.hi, hi)
+    np.testing.assert_array_equal(sc.lo, lo)
+    np.testing.assert_array_equal(sc.eff, eff)
+
+
+def _engine_mesh(mixed, config, mesh, sharding=None):
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    if sharding is not None:
+        import dataclasses
+
+        config = dataclasses.replace(config, matrix_sharding=sharding)
+    return PermutationEngine(
+        dc, dn, dd, tc, tn, td, specs, mixed["pool"], config=config,
+        mesh=mesh,
+    )
+
+
+def test_ring_parity_row_sharded(mixed, runs):
+    """The ring-exchange path (row-sharded matrices, chunk split over
+    perm × row, neighbor collective-permute replacing the psum): counts
+    equal both the ring's own materialized null and the XLA row-sharded
+    streaming run."""
+    from netrep_tpu.parallel import mesh as meshmod
+
+    mesh = meshmod.make_mesh(n_perm_shards=2, n_row_shards=2)
+    cfg = _cfg(chunk_size=32, superchunk=2)
+    eng = _engine_mesh(mixed, cfg, mesh, sharding="row")
+    assert eng._stat_fused_ring()
+    # effective chunk rounds over BOTH axes (perm 2 × row 2)
+    assert eng.effective_chunk() % 4 == 0
+    nulls, done = eng.run_null(80, key=0)
+    sc = eng.run_null_streaming(80, runs["observed"], key=0)
+    hi, lo, eff = pv.tail_counts(runs["observed"], np.asarray(nulls)[:done])
+    np.testing.assert_array_equal(sc.hi, hi)
+    np.testing.assert_array_equal(sc.lo, lo)
+    np.testing.assert_array_equal(sc.eff, eff)
+    scx = _engine_mesh(mixed, _cfg("xla", chunk_size=32, superchunk=2),
+                       mesh, sharding="row").run_null_streaming(
+        80, runs["observed"], key=0
+    )
+    np.testing.assert_array_equal(sc.hi, scx.hi)
+    np.testing.assert_array_equal(sc.lo, scx.lo)
+    np.testing.assert_array_equal(sc.eff, scx.eff)
+
+
+# ---------------------------------------------------------------------------
+# multi-test engine
+# ---------------------------------------------------------------------------
+
+def test_multitest_fused_parity():
+    from netrep_tpu.parallel.multitest import MultiTestEngine
+
+    mixed = make_mixed_pair(160, 3, n_samples=24, seed=5)
+    (dd, dc, dn) = mixed["discovery"]
+    (td, tc, tn) = mixed["test"]
+    (td2, tc2, tn2) = make_mixed_pair(160, 3, n_samples=24, seed=6)["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+
+    def make(stat_mode):
+        cfg = _cfg(stat_mode, chunk_size=32, power_iters=10, superchunk=2)
+        return MultiTestEngine(
+            dc, dn, dd, np.stack([tc, tc2]), np.stack([tn, tn2]),
+            [td, td2], specs, mixed["pool"], config=cfg,
+        )
+
+    eng = make("fused")
+    assert eng.stat_mode == "fused"
+    obs = np.asarray(eng.observed())
+    nulls, done = eng.run_null(80, key=0)
+    pf = np.asarray(nulls)[:, :done].transpose(1, 0, 2, 3)
+    hi, lo, eff = pv.tail_counts(obs, pf)
+    sc = make("fused").run_null_streaming(80, obs, key=0)
+    np.testing.assert_array_equal(sc.hi, hi)
+    np.testing.assert_array_equal(sc.lo, lo)
+    np.testing.assert_array_equal(sc.eff, eff)
+    scx = make("xla").run_null_streaming(80, obs, key=0)
+    np.testing.assert_array_equal(sc.hi, scx.hi)
+    np.testing.assert_array_equal(sc.lo, scx.lo)
+    np.testing.assert_array_equal(sc.eff, scx.eff)
+
+
+# ---------------------------------------------------------------------------
+# kernel units / configuration surface
+# ---------------------------------------------------------------------------
+
+def test_kernel_counts_are_its_own_values():
+    """Unit-level form of the carry contract, including derived-net and
+    the data-less NaN pattern."""
+    from netrep_tpu.ops.fused_stats import (
+        fused_stats_counts, fused_stats_values,
+    )
+
+    rng = np.random.default_rng(0)
+    n, s, cap, K, B = 96, 16, 24, 2, 6
+    x = rng.standard_normal((s, n)).astype(np.float32)
+    tc = np.corrcoef(x, rowvar=False).astype(np.float32)
+    np.fill_diagonal(tc, 1.0)
+    tc_j = jnp.asarray(tc)
+    tdT = jnp.asarray(x.T)
+    mask = np.zeros((K, cap), np.float32)
+    didx = np.zeros((K, cap), np.int32)
+    for k, sz in enumerate((24, 17)):  # one padded-tail module
+        mask[k, :sz] = 1
+        didx[k, :sz] = rng.choice(n, sz, replace=False)
+    sub = jax.vmap(lambda ix: tc_j[ix[:, None], ix[None, :]])(
+        jnp.asarray(didx)
+    )
+    disc = jstats.make_disc_props(
+        sub, jstats.derived_net(sub, 2.0),
+        jax.vmap(lambda ix: jnp.take(jnp.asarray(x), ix, axis=1))(
+            jnp.asarray(didx)
+        ),
+        jnp.asarray(mask),
+    )
+    idx = rng.integers(0, n, size=(B, K, cap)).astype(np.int32)
+    obs = jnp.asarray(
+        rng.standard_normal((K, 7)).astype(np.float32) * 0.05
+    )
+    pvalid = jnp.asarray(np.array([1] * (B - 2) + [0] * 2, np.int32))
+    vals, hi, lo, eff = jax.jit(
+        lambda ix: fused_stats_counts(
+            tc_j, None, tdT, disc, ix, pvalid, obs, net_beta=2.0,
+            n_iter=10, interpret=True,
+        )
+    )(jnp.asarray(idx))
+    vals = np.asarray(vals)
+    sel = np.asarray(pvalid)[:, None, None] > 0
+    np.testing.assert_array_equal(
+        np.asarray(hi), ((vals >= np.asarray(obs)[None]) & sel).sum(0)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(lo), ((vals <= np.asarray(obs)[None]) & sel).sum(0)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(eff), ((~np.isnan(vals)) & sel).sum(0)
+    )
+    # same registers in values mode
+    v2 = np.asarray(jax.jit(
+        lambda ix: fused_stats_values(
+            tc_j, None, tdT, disc, ix, net_beta=2.0, n_iter=10,
+            interpret=True,
+        )
+    )(jnp.asarray(idx)))
+    np.testing.assert_array_equal(v2, vals)
+    # data-less variant: the four data statistics are NaN, the topology
+    # three finite (SURVEY.md §2.2)
+    v3 = np.asarray(jax.jit(
+        lambda ix: fused_stats_values(
+            tc_j, None, None, disc, ix, net_beta=2.0, n_iter=10,
+            interpret=True,
+        )
+    )(jnp.asarray(idx)))
+    assert np.isnan(v3[..., [1, 4, 5, 6]]).all()
+    assert np.isfinite(v3[..., [0, 2, 3]]).all()
+
+
+def test_config_surface():
+    from netrep_tpu.utils.autotune import resolve_fused_rowblock
+
+    with pytest.raises(ValueError, match="stat_mode"):
+        EngineConfig(stat_mode="mosaic")
+    with pytest.raises(ValueError, match="power iteration"):
+        EngineConfig(stat_mode="fused", summary_method="eigh")
+    assert EngineConfig().resolved_stat_mode("cpu") == "xla"
+    assert EngineConfig().resolved_stat_mode("tpu") == "fused"
+    assert EngineConfig().resolved_stat_mode("axon") == "fused"
+    assert EngineConfig(
+        summary_method="eigh"
+    ).resolved_stat_mode("tpu") == "xla"
+    assert EngineConfig(stat_mode="xla").resolved_stat_mode("tpu") == "xla"
+    # autotune=False → no lookup, no cache handle
+    rb, cache = resolve_fused_rowblock(EngineConfig(autotune=False), "k")
+    assert rb is None and cache is None
+
+
+def test_row_block_budget_guard():
+    from netrep_tpu.ops.fused_stats import resolve_row_block
+
+    rb = resolve_row_block(128, 20_000, 4, s_pad=128, has_net=False,
+                           has_data=True)
+    assert rb % 8 == 0 and 8 <= rb <= 128
+    # override honored after alignment + clamp
+    assert resolve_row_block(128, 1000, 4, override=24) == 24
+    assert resolve_row_block(128, 1000, 4, override=9) == 8
+    with pytest.raises(ValueError, match="stat_mode='xla'"):
+        resolve_row_block(128, 3_000_000, 4)
+
+
+def test_multitest_row_sharded_refuses_explicit_fused():
+    from netrep_tpu.parallel import mesh as meshmod
+    from netrep_tpu.parallel.multitest import MultiTestEngine
+
+    mixed = make_mixed_pair(160, 3, n_samples=24, seed=5)
+    (dd, dc, dn) = mixed["discovery"]
+    (td, tc, tn) = mixed["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    mesh = meshmod.make_mesh(n_perm_shards=2, n_row_shards=2)
+    cfg = _cfg(chunk_size=32, matrix_sharding="row")
+    with pytest.raises(ValueError, match="multi-test"):
+        MultiTestEngine(
+            dc, dn, dd, np.stack([tc]), np.stack([tn]), [td], specs,
+            mixed["pool"], config=cfg, mesh=mesh,
+        )
+
+
+def test_packed_engine_pinned_to_xla(toy_pair_module):
+    """The serve pack engine draws one pool shuffle per key GROUP; the
+    mega-kernel's single-group counter would break that RNG contract —
+    the packed engine pins itself to the XLA composition."""
+    from netrep_tpu.data import pair_frames
+    from netrep_tpu.serve.packer import PackedEngine
+
+    d, t = pair_frames(toy_pair_module)
+    labels = dict(toy_pair_module["labels"])
+    names = list(d["network"].columns)
+    by_label = {}
+    for nm, lab in labels.items():
+        by_label.setdefault(lab, []).append(names.index(nm))
+    specs = [
+        ModuleSpec(str(lab), np.asarray(ix, np.int32),
+                   np.asarray(ix, np.int32))
+        for lab, ix in sorted(by_label.items())
+    ]
+    eng = PackedEngine(
+        d["correlation"].to_numpy(), d["network"].to_numpy(),
+        d["data"].to_numpy(), t["correlation"].to_numpy(),
+        t["network"].to_numpy(), t["data"].to_numpy(),
+        [specs], np.arange(len(names), dtype=np.int32),
+        config=_cfg(),
+    )
+    assert eng.stat_mode == "xla"

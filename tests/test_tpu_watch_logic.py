@@ -78,8 +78,10 @@ def test_cpu_fallback_row_never_marked_done(tmp_path):
 
 def test_parity_skipped_strike_then_retire(tmp_path):
     # SKIPPED with a live reprobe: first occurrence records a strike and
-    # retries; the second retires the fused grid (MOSAICFAIL) and tune is
-    # then skipped permanently — the round-4 advisor's ambiguity resolved
+    # retries; the second retires the fused grid under its OWN marker
+    # (SKIPRETIRE — a compile-refusal, NOT the wrong-numbers MOSAICFAIL
+    # verdict; ADVICE r5) and tune is then skipped permanently with the
+    # compile-refusal message
     parity_cmd = "bash -c 'echo pallas fused gather: SKIPPED; exit 2'"
     proc, state, log = run_watch(
         tmp_path,
@@ -88,9 +90,11 @@ def test_parity_skipped_strike_then_retire(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "parity SKIP1" in state
-    assert "parity MOSAICFAIL" in state
+    assert "parity SKIPRETIRE" in state
+    assert "parity MOSAICFAIL" not in state  # distinct retirement class
     assert "one more strike retires" in log
-    assert "skipped permanently: fused parity gate FAILED" in log
+    assert "SKIPPED twice with tunnel alive; retiring fused grid" in log
+    assert "Mosaic compile-refusal, not wrong numbers" in log
     assert "tuned" not in log  # tune never executed
 
 
