@@ -1043,6 +1043,120 @@ def bench_pallas(args):
     return emit(row)
 
 
+def bench_atlas(args):
+    """Atlas tiled network plane (ISSUE 9): the tile-grid construction
+    pass (data columns → per-row top-k SparseAdjacency + global degree,
+    never materializing n×n) followed by the data-only permutation null
+    (``correlation=None, network=None`` — every k×k submatrix derived
+    from gathered data columns) on the SAME synthetic data.
+
+    On TPU the row is the synthetic 100k-gene / 50-module atlas shape —
+    the workload class the dense path cannot represent (a 100k×100k f32
+    pair is ~80 GB). On the CPU fallback it is an explicitly labeled
+    mechanism row at reduced n (full-size CPU tile passes are hours of
+    non-measurement). The metric label carries the ``atlas`` prefix so
+    perf-ledger fingerprints never mix with dense-path rows, and the row
+    reports the peak tile-pass device-memory gauge (PR 5 probes) beside
+    the n×n bytes the pass avoided allocating."""
+    import jax
+
+    from netrep_tpu.atlas import TiledNetwork, build_sparse_network
+    from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+    from netrep_tpu.utils.config import EngineConfig
+    from netrep_tpu.utils.profiling import make_memory_probe
+
+    resolve(args, 100_000, 50, 1000)
+    on_cpu = jax.default_backend() == "cpu"
+    top_k = 16
+    beta = 2.0
+    if on_cpu:
+        genes, modules, perms = 4000, 8, 256
+        if args.smoke:
+            genes, modules, perms = 600, 4, 64
+        samples = min(args.samples, 32)
+    else:
+        genes, modules, perms, samples = (
+            args.genes, args.modules, args.perms, args.samples
+        )
+    rng = np.random.default_rng(0)
+    lo, hi = (30, 200) if genes >= 10_000 else (8, 24)
+    sizes = np.exp(
+        rng.uniform(np.log(lo), np.log(hi), size=modules)
+    ).astype(int)
+    specs, pos = [], 0
+    for i, sz in enumerate(sizes):
+        idx = np.arange(pos, pos + sz, dtype=np.int32)
+        specs.append(ModuleSpec(str(i + 1), idx, idx))
+        pos += sz
+    assert pos <= genes, "module sizes exceed gene count"
+
+    def planted():
+        x = rng.standard_normal((samples, genes)).astype(np.float32)
+        for m in specs:
+            x[:, m.disc_idx] += 1.1 * rng.standard_normal(samples)[:, None]
+        return x
+
+    data_d, data_t = planted(), planted()
+    probe = make_memory_probe()
+    cfg = EngineConfig(autotune=False)
+
+    t0 = time.perf_counter()
+    build = build_sparse_network(
+        TiledNetwork.from_data(data_d, beta), top_k=top_k, config=cfg
+    )
+    tile_s = time.perf_counter() - t0
+    mem_tile = probe() if probe is not None else {}
+
+    null_cfg = EngineConfig(
+        chunk_size=args.chunk, power_iters=40, autotune=False,
+        network_from_correlation=beta,
+    )
+    engine = PermutationEngine(
+        None, None, data_d, None, None, data_t, specs,
+        np.arange(genes, dtype=np.int32), config=null_cfg,
+    )
+    null_s = timed_null(engine, perms, null_cfg.chunk_size)
+    mem_null = probe() if probe is not None else {}
+
+    nxn_bytes = int(genes) * int(genes) * 4
+    peak = mem_tile.get("mem_peak_bytes") or mem_tile.get(
+        "mem_live_buffer_bytes"
+    )
+    row = {
+        "metric": (
+            f"atlas tile pass + data-only null ({genes} genes, "
+            f"{modules} modules, top_k={top_k}, {perms} perms)"
+        ),
+        "value": round(tile_s + null_s, 3),
+        "unit": "s",
+        "vs_baseline": round(TARGET_SECONDS / (tile_s + null_s), 4),
+        "tile_pass_s": round(tile_s, 3),
+        "null_s": round(null_s, 3),
+        "perms_per_sec": round(perms / null_s, 2),
+        "genes_per_sec": round(genes / tile_s, 1),
+        "tile_edge": build.tile_edge,
+        "edges_selected": build.selected_edges,
+        "adjacency_nnz": build.adjacency.nnz,
+        # peak tile-pass device memory (PR 5 gauges) vs the n×n array the
+        # plane never allocates — the memory-bound contract, on the row
+        "tile_pass_mem": mem_tile,
+        "null_mem": mem_null,
+        "nxn_bytes_avoided": nxn_bytes,
+        "nxn_avoided": bool(peak is not None and peak < nxn_bytes)
+        if peak is not None else None,
+        "device": str(jax.devices()[0]),
+        "chunk": args.chunk,
+    }
+    if on_cpu:
+        row["tpu_fallback"] = TPU_FALLBACK
+        row["metric"] += (
+            " [CPU mechanism row, reduced n — the 100k-gene atlas shape "
+            "is only measured on TPU]"
+        )
+        row["vs_baseline"] = None
+    return emit(row)
+
+
 def bench_multichip_child(args):
     """One multichip scaling point (spawned by :func:`bench_multichip`):
     build an ``--devices``-wide permutation mesh and measure a real null
@@ -1285,7 +1399,7 @@ def main():
     ap.add_argument("--config", default="north",
                     choices=["north", "A", "B", "C", "D", "E", "oracle",
                              "native", "sharded", "adaptive", "superchunk",
-                             "multichip", "serve", "pallas"])
+                             "multichip", "serve", "pallas", "atlas"])
     ap.add_argument("--devices", type=int, default=None,
                     help="multichip child marker: measure ONE scaling "
                          "point on this many devices (the parent spawns "
@@ -1332,7 +1446,8 @@ def main():
     from netrep_tpu.utils.backend import tunnel_expected
 
     if (args.config in ("north", "A", "B", "C", "D", "E", "sharded",
-                        "adaptive", "superchunk", "serve", "pallas")
+                        "adaptive", "superchunk", "serve", "pallas",
+                        "atlas")
             and tunnel_expected()
             and not os.environ.get("NETREP_BENCH_NO_SUBPROC")):
         # every config that may touch the tunnel backend (A runs the JAX
@@ -1428,7 +1543,7 @@ def main():
         "north": bench_north, "A": bench_a, "B": bench_b,
         "C": bench_c, "D": bench_d, "E": bench_e, "oracle": bench_oracle,
         "adaptive": bench_adaptive, "superchunk": bench_superchunk,
-        "pallas": bench_pallas,
+        "pallas": bench_pallas, "atlas": bench_atlas,
     }[args.config](args)
 
 

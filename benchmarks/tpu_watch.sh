@@ -86,6 +86,16 @@ QUEUE=(
   "configD_dn  3600 python bench.py --config D --derived-net"
 )
 
+# Atlas tiled-network-plane step (ISSUE 9; opt-in: ATLAS_STEP=1): the
+# tile-grid construction pass + data-only null at the synthetic
+# 100k-gene shape — a real measurement only on TPU (the CPU fallback
+# emits the labeled reduced-n mechanism row, same policy as pallas).
+# Rides the existing gate pattern: ordinary queue step, tpu_fallback
+# detection, perf-ledger row under its own `atlas` fingerprint prefix.
+if [ "${ATLAS_STEP:-0}" = "1" ]; then
+  QUEUE+=("configAtlas 1800 python bench.py --config atlas")
+fi
+
 # Test hooks (tests/test_tpu_watch_logic.py): QUEUE_FILE replaces the
 # queue (one "<key> <timeout> <cmd...>" per line) and PROBE_CMD replaces
 # the tunnel dial, so the state machine — resume, fallback, parity

@@ -28,6 +28,9 @@ __all__ = [
     "SparseAdjacency",
     "sparse_module_preservation",
     "sparse_network_properties",
+    "TiledNetwork",
+    "build_sparse_network",
+    "atlas_module_preservation",
     "summarize_trace",
     "make_mesh",
     "selftest",
@@ -76,6 +79,14 @@ def __getattr__(name):
         from .models import sparse_api
 
         return getattr(sparse_api, name)
+    if name in ("TiledNetwork", "build_sparse_network"):
+        from . import atlas
+
+        return getattr(atlas, name)
+    if name == "atlas_module_preservation":
+        from .models.atlas_api import module_preservation
+
+        return module_preservation
     if name == "summarize_trace":
         from .utils.profiling import summarize_trace
 

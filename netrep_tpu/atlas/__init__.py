@@ -1,0 +1,25 @@
+"""Atlas-scale tiled network plane (ISSUE 9): data-only preservation at
+100k+ genes without ever materializing n×n.
+
+- :mod:`~netrep_tpu.atlas.tiles` — :class:`TiledNetwork`, the data +
+  soft-threshold-β spec whose correlation/adjacency exist only as
+  on-demand tiles;
+- :mod:`~netrep_tpu.atlas.builder` — the streaming construction pass
+  (tile grid → :class:`~netrep_tpu.ops.sparse.SparseAdjacency` edges +
+  global degree vectors; checkpointable, fault-covered, traced,
+  mesh-shardable, autotuned tile edge);
+- :mod:`~netrep_tpu.atlas.modules` — the data-only k×k module plane the
+  dense permutation engine runs on with ``correlation=None,
+  network=None`` (user surface:
+  :func:`netrep_tpu.models.atlas_api.module_preservation`).
+"""
+
+from .builder import AtlasBuild, build_sparse_network
+from .tiles import TiledNetwork, derived_net_np
+
+__all__ = [
+    "AtlasBuild",
+    "TiledNetwork",
+    "build_sparse_network",
+    "derived_net_np",
+]

@@ -1,0 +1,120 @@
+"""Atlas module plane — data-only k×k submatrices (ISSUE 9 tentpole).
+
+At atlas scale the dense n×n correlation/network pair cannot exist, but
+the seven preservation statistics only ever consume k×k module
+submatrices — and with standardized data columns in hand, the observed
+and every per-permutation correlation submatrix is ONE MXU matmul of the
+gathered ``(s, m)`` data slice (``zᵀz/(s-1)``, exact Pearson — the same
+identity the sparse engine's on-the-fly correlation uses), with the
+network submatrix derived elementwise on device
+(:func:`netrep_tpu.ops.stats.derived_net`, the PR 8 in-register mode
+extended into a full pipeline). The dense
+:class:`~netrep_tpu.parallel.engine.PermutationEngine` then runs with
+``correlation=None, network=None``: these kernels are its data-only
+chunk/observed unit of work.
+
+Degenerate-input semantics: inside the ENGINE hot path a zero-variance
+column standardizes to all-zero (the documented zero-variance guard of
+:func:`netrep_tpu.ops.stats.standardize_masked` — statistics stay
+finite, ``tests/test_degenerate_inputs.py``). The atlas *construction*
+plane (:mod:`netrep_tpu.atlas.tiles`) instead propagates NaN exactly
+like ``np.corrcoef`` and its validated spec rejects such columns up
+front, mirroring the dense surface's non-finite-correlation rejection.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import stats as jstats
+from ..ops.sparse import corr_from_zdata
+
+
+def data_only_gather_and_stats(
+    disc: jstats.DiscProps,
+    idx: jnp.ndarray,              # (..., m) int32 test-node ids (padded)
+    test_dataT: jnp.ndarray,       # (n, n_samples) TRANSPOSED test data
+    net_beta,
+    n_iter: int = 60,
+    summary_method: str = "power",
+) -> jnp.ndarray:
+    """Per-permutation unit of work of the data-only pipeline: gather the
+    module's data columns (a contiguous row gather of the transposed
+    layout), standardize, and derive BOTH test submatrices from the slice
+    — correlation as ``zᵀz/(s-1)`` (one MXU matmul) and network as the
+    soft-threshold construction ``net_beta`` names. Nothing ``O(n²)`` is
+    ever touched; the working set is ``O(m·s + m²)`` per instance.
+    Batching over permutations/modules is ``vmap`` of this function —
+    the same contract as :func:`netrep_tpu.ops.stats.gather_and_stats`.
+    """
+    w = disc.mask
+    zdata = jstats.gather_zdata(test_dataT, idx, w)        # (..., s, m)
+    corr = corr_from_zdata(zdata, test_dataT.shape[-1], w)
+    net = jstats.derived_net(corr, net_beta)
+    return jstats.module_stats_masked(
+        disc, corr, net, zdata, n_iter=n_iter,
+        summary_method=summary_method,
+    )
+
+
+@partial(jax.jit, static_argnames=("net_beta", "summary_method"))
+def make_disc_props_data_only(
+    dataT: jnp.ndarray,            # (n, n_samples) TRANSPOSED discovery data
+    idx_pad: jnp.ndarray,          # (K, cap) padded discovery ids
+    mask: jnp.ndarray,             # (K, cap)
+    net_beta,
+    summary_method: str = "eigh",
+) -> jstats.DiscProps:
+    """Discovery-side fixed properties for a bucket of modules with NO
+    stored matrices: the correlation submatrix comes from the gathered
+    data slice (``zᵀz/(s-1)``), the network derives elementwise
+    (``net_beta``), and the data statistics ride the same slice. Runs
+    once per pair, outside the hot loop — exact ``eigh`` summary by
+    default, like every discovery pass."""
+    w = jstats._f32(mask)
+    safe = jnp.where(mask > 0, idx_pad, 0)
+    sub = jnp.swapaxes(jnp.take(dataT, safe, axis=0), -1, -2)  # (K, s, cap)
+    z = jstats.standardize_masked(sub, w)
+    corr = corr_from_zdata(z, dataT.shape[-1], w)
+    net = jstats.derived_net(corr, net_beta)
+    return jstats.make_disc_props(corr, net, sub, mask,
+                                  summary_method=summary_method)
+
+
+def normalize_beta_static(net_beta):
+    """Normalize a ``β`` / ``(β, kind)`` spec into the hashable tuple the
+    jit-static threading needs (lists arrive from JSON payloads)."""
+    beta, kind = jstats.normalize_net_beta(
+        tuple(net_beta) if isinstance(net_beta, list) else net_beta
+    )
+    return (beta, kind)
+
+
+def dense_reference_stats(data_disc, data_test, specs, net_beta):
+    """Small-n oracle of the data-only plane (tests/bench parity rows):
+    materialize the n×n correlation the tile plane refuses to, derive the
+    network, and hand back the (correlation, network) pair per dataset —
+    the inputs a dense ``module_preservation`` reference run takes.
+    Float32 end to end so the parity comparison prices only the gather
+    path, not a precision mismatch."""
+    beta, kind = normalize_beta_static(net_beta)
+    out = []
+    for d in (data_disc, data_test):
+        d = np.asarray(d, np.float32)
+        z = np.asarray(jstats.standardize_masked(
+            jnp.asarray(d), jnp.ones(d.shape[1], jnp.float32)
+        ))
+        corr = np.array(jnp.clip(
+            jnp.matmul(z.T, z, preferred_element_type=jnp.float32)
+            / max(d.shape[0] - 1, 1), -1.0, 1.0,
+        ))
+        np.fill_diagonal(corr, 1.0)
+        net = np.array(jstats.derived_net(jnp.asarray(corr), (beta, kind)))
+        np.fill_diagonal(net, 0.0)
+        out.append((corr, net))
+    return out

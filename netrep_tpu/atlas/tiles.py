@@ -1,0 +1,192 @@
+"""`TiledNetwork` — the data-only network spec whose correlation/adjacency
+values exist ONLY as on-demand tiles (ISSUE 9 tentpole).
+
+At atlas scale (100k+ genes) a dense n×n float32 correlation/adjacency
+pair is ~80 GB — unrepresentable on any single device — while the data it
+derives from is O(n·samples) (a few tens of MB). This module holds that
+derivation as a *spec*: standardized data columns plus the soft-threshold
+``beta`` (the WGCNA construction, :func:`netrep_tpu.ops.stats
+.derived_net`), and computes any (I, J) tile of the correlation
+(``zᵀ[:, I] z[:, J]/(s-1)``) or adjacency (``|r|**β`` et al.) on demand —
+a single MXU matmul per tile, never anything O(n²).
+
+Two value planes, one spec:
+
+- **host reference plane** (:meth:`TiledNetwork.corr_tile`): float64, in
+  ``np.corrcoef``'s exact operation order (centered variables-as-rows
+  layout, GEMM, multiply by the reciprocal of ``s-1``, divide by the
+  GEMM-diagonal stddevs, clip) — including its degenerate-input
+  semantics: a zero-variance column yields 0/0 = **NaN across its whole
+  row and column, exactly where ``np.corrcoef`` puts them** (pinned
+  bit-for-bit on the NaN mask in ``tests/test_degenerate_inputs.py``;
+  finite values agree to float64 rounding — GEMM sub-blocking makes
+  full-bitwise value equality unattainable on ragged tail tiles).
+- **device plane** (:meth:`TiledNetwork.z32` + the builder's jitted tile
+  kernel): float32 standardized columns whose tile matmul feeds the
+  streaming construction pass (:mod:`netrep_tpu.atlas.builder`) and the
+  data-only permutation engine.
+
+Validation mirrors the dense surface's degenerate-input contract
+(``models/dataset.py`` rejects non-finite correlations): a zero-variance
+column would make every tile touching it NaN, so
+:meth:`TiledNetwork.from_data` rejects such columns up front with an
+informative error — ``allow_degenerate=True`` keeps them for callers
+pinning the NaN-propagation parity itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+from ..ops import stats as jstats
+
+
+def _normalize_beta(beta) -> tuple[float, str]:
+    beta_t = tuple(beta) if isinstance(beta, list) else beta
+    b, kind = jstats.normalize_net_beta(beta_t)
+    if not b > 0:
+        raise ValueError(f"soft-threshold power must be > 0, got {b!r}")
+    return b, kind
+
+
+def derived_net_np(r: np.ndarray, beta) -> np.ndarray:
+    """Host (numpy) twin of :func:`netrep_tpu.ops.stats.derived_net` — the
+    soft-threshold adjacency of a correlation tile. One formula site per
+    plane; parity between the two is pinned by tests/test_atlas.py."""
+    b, kind = _normalize_beta(beta)
+    if kind == "signed":
+        return np.clip((1.0 + r) * 0.5, 0.0, None) ** b
+    if kind == "signed-hybrid":
+        return np.clip(r, 0.0, None) ** b
+    return np.abs(r) ** b
+
+
+@dataclasses.dataclass(frozen=True)
+class TiledNetwork:
+    """Data-only network spec: centered data columns + soft-threshold β.
+
+    ``xc`` is the (n, s) float64 CENTERED data in ``np.cov``'s
+    variables-as-rows layout (the op-order anchor of the corrcoef-parity
+    contract); ``stddev`` the per-column ddof-1 standard deviations taken
+    from tile-GEMM diagonals. Build with :meth:`from_data`.
+    """
+
+    xc: np.ndarray                 # (n, s) float64 centered columns
+    stddev: np.ndarray             # (n,) float64 ddof-1 sd (0 = degenerate)
+    beta: tuple                    # normalized (β, kind)
+    node_names: list[str] | None = None
+
+    @property
+    def n(self) -> int:
+        return self.xc.shape[0]
+
+    @property
+    def n_samples(self) -> int:
+        return self.xc.shape[1]
+
+    @classmethod
+    def from_data(cls, data, beta, names: Sequence[str] | None = None,
+                  allow_degenerate: bool = False) -> "TiledNetwork":
+        """Validate and standardize a (n_samples, n) data matrix into a
+        tile spec. Rejections mirror the dense input layer's informative
+        errors: non-2-D / non-finite data, fewer than 2 samples, and —
+        unless ``allow_degenerate`` — zero-variance columns, whose
+        correlations are NaN (``np.corrcoef`` semantics; the dense
+        surface rejects the resulting non-finite correlation matrix the
+        same way)."""
+        arr = np.asarray(data, dtype=np.float64)
+        if arr.ndim != 2:
+            raise ValueError(
+                f"data must be a 2-dimensional (n_samples, n_nodes) "
+                f"matrix, got {arr.ndim} dimension(s)"
+            )
+        if arr.shape[0] < 2:
+            raise ValueError(
+                f"data needs at least 2 samples to correlate, got "
+                f"{arr.shape[0]}"
+            )
+        if not np.isfinite(arr).all():
+            raise ValueError(
+                "data contains non-finite values (NA/NaN/Inf are not "
+                "allowed)"
+            )
+        beta_n = _normalize_beta(beta)
+        s, n = arr.shape
+        X = np.ascontiguousarray(arr.T)              # (n, s) cov layout
+        X = X - np.average(X, axis=1)[:, None]
+        rcp = np.true_divide(1, s - 1)
+        # stddev from tile-GEMM diagonals — the same dot products the
+        # corrcoef path's diag(cov) takes, block by block
+        d = np.empty(n)
+        edge = 4096
+        for j0 in range(0, n, edge):
+            blk = X[j0: j0 + edge]
+            d[j0: j0 + edge] = np.einsum("is,is->i", blk, blk) * rcp
+        stddev = np.sqrt(d)
+        if not allow_degenerate and (stddev == 0).any():
+            bad = np.flatnonzero(stddev == 0)
+            raise ValueError(
+                f"data has {bad.size} zero-variance (constant) column(s), "
+                f"e.g. positions {bad[:3].tolist()}: their correlations "
+                "are NaN (np.corrcoef semantics) and the preservation "
+                "statistics are undefined — drop or jitter these nodes, "
+                "exactly as the dense surface's non-finite-correlation "
+                "check would demand"
+            )
+        if names is not None:
+            names = [str(nm) for nm in names]
+            if len(names) != n:
+                raise ValueError(
+                    f"names has {len(names)} entries but data has {n} "
+                    "columns"
+                )
+            if len(set(names)) != n:
+                raise ValueError("duplicate node names")
+        return cls(xc=X, stddev=stddev, beta=beta_n,
+                   node_names=list(names) if names is not None else None)
+
+    # -- host reference plane (float64, corrcoef op order) -----------------
+
+    def corr_tile(self, I, J) -> np.ndarray:
+        """The (I, J) correlation tile in ``np.corrcoef``'s exact op
+        order — NaN propagation from zero-variance columns included
+        (module docstring). ``I``/``J`` are index arrays or slices."""
+        rcp = np.true_divide(1, self.n_samples - 1)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            c = (self.xc[I] @ self.xc[J].T) * rcp
+            c /= self.stddev[I][:, None]
+            c /= self.stddev[J][None, :]
+        np.clip(c, -1, 1, out=c)
+        return c
+
+    def adjacency_tile(self, I, J) -> np.ndarray:
+        """The (I, J) soft-threshold adjacency tile ``derived_net(r, β)``
+        (diagonal untouched — consumers mask self-pairs, as every
+        statistic kernel does)."""
+        return derived_net_np(self.corr_tile(I, J), self.beta)
+
+    # -- device plane ------------------------------------------------------
+
+    def z32(self) -> np.ndarray:
+        """(n, s) float32 standardized columns for the device tile kernel:
+        ``z[i]·z[j] = r_ij`` exactly (each column scaled by
+        ``1/(sd·√(s-1))``). Degenerate columns (sd 0) become all-zero —
+        the engine-side zero-variance guard — so build specs through
+        :meth:`from_data`'s validation when NaN semantics are wanted."""
+        with np.errstate(divide="ignore", invalid="ignore"):
+            z = self.xc / (self.stddev * np.sqrt(self.n_samples - 1))[:, None]
+        return np.nan_to_num(z, nan=0.0, posinf=0.0, neginf=0.0).astype(
+            np.float32
+        )
+
+    def spec_digest(self) -> str:
+        """Content identity of this spec — data sample digest + the
+        derivation parameters (β, kind), so checkpoints (and serve pack
+        keys) can never mix two different derivations of the same data."""
+        from ..utils.checkpoint import content_digest
+
+        b, kind = self.beta
+        return f"{content_digest([self.xc])}|beta:{b:g}|{kind}"

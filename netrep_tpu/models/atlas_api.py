@@ -1,0 +1,60 @@
+"""``module_preservation(data_only=…)`` — the atlas-plane user surface
+(ISSUE 9 tentpole).
+
+The dense entry point requires materialized n×n correlation/network
+matrices per dataset; at atlas scale (100k+ genes) those are exactly what
+cannot exist. This surface takes ONLY data + the WGCNA soft-threshold
+spec and runs the same orchestrator (pair resolution, overlap handling,
+permutation null, exact p-values, result shaping) with every k×k
+submatrix derived on device from gathered data columns
+(:mod:`netrep_tpu.atlas.modules`) — the dense
+:class:`~netrep_tpu.parallel.engine.PermutationEngine` with
+``correlation=None, network=None``.
+
+Composes with everything the dense surface composes with: streaming
+tallies (``store_nulls=False``), adaptive early stopping, checkpoints,
+telemetry, fault policies, and permutation-axis meshes. For the
+*construction* side of the atlas plane (thresholded
+:class:`~netrep_tpu.ops.sparse.SparseAdjacency` networks out of the tile
+grid) see :func:`netrep_tpu.atlas.build_sparse_network`.
+"""
+
+from __future__ import annotations
+
+from . import preservation as _pres
+
+
+def module_preservation(
+    data,
+    module_assignments=None,
+    data_only=2.0,
+    **kwargs,
+):
+    """Data-only permutation test of module preservation.
+
+    Parameters
+    ----------
+    data : (n_samples, n) matrix, list, or dict of them — one per
+        dataset, exactly like the dense surface's ``data`` argument.
+        Zero-variance columns are rejected with the same posture as the
+        dense path's non-finite-correlation check (their derived
+        correlations are NaN — ``np.corrcoef`` semantics).
+    module_assignments, **kwargs : as for
+        :func:`netrep_tpu.models.preservation.module_preservation`
+        (``discovery``/``test``/``n_perm``/``adaptive``/``store_nulls``/
+        ``config``/``mesh``/…).
+    data_only : the derivation spec — soft-threshold power β for the
+        unsigned WGCNA adjacency ``|corr|**β`` (default 2.0), or a
+        ``(β, kind)`` pair with ``kind`` in ``('unsigned', 'signed',
+        'signed-hybrid')``.
+
+    Returns the usual ``PreservationResult`` shape.
+    """
+    return _pres.module_preservation(
+        network=None,
+        data=data,
+        correlation=None,
+        module_assignments=module_assignments,
+        data_only=data_only,
+        **kwargs,
+    )

@@ -29,16 +29,19 @@ class Dataset:
     Attributes
     ----------
     name : dataset label.
-    correlation : (n, n) correlation matrix.
-    network : (n, n) network (edge weight / adjacency) matrix.
+    correlation : (n, n) correlation matrix — or None for a DATA-ONLY
+        dataset (ISSUE 9, the atlas plane: correlation/network derive
+        from ``data`` on demand and are never materialized).
+    network : (n, n) network (edge weight / adjacency) matrix, or None
+        (data-only).
     data : (n_samples, n) data matrix or None (data-less variant).
     node_names : length-n node labels (column names).
     sample_names : sample labels for ``data`` (or None).
     """
 
     name: str
-    correlation: np.ndarray
-    network: np.ndarray
+    correlation: np.ndarray | None
+    network: np.ndarray | None
     data: np.ndarray | None
     node_names: list[str]
     sample_names: list[str] | None = None
@@ -93,6 +96,57 @@ def _normalize_collection(x, what: str) -> dict[str, object]:
     return {"1": x}
 
 
+def build_data_only_datasets(data) -> dict[str, Dataset]:
+    """Normalize DATA-ONLY inputs (ISSUE 9, the atlas plane): each dataset
+    is just a (n_samples, n) data matrix — its correlation and network
+    derive on demand and are never materialized, so the dense surface's
+    square/symmetric/[-1, 1] checks have no object to run on. What CAN be
+    validated is validated with the same informative-error posture:
+    2-D shape, finiteness, ≥2 samples, duplicate names — and
+    zero-variance (constant) columns are rejected up front, because their
+    derived correlations are NaN (``np.corrcoef`` semantics, pinned in
+    tests/test_degenerate_inputs.py) exactly as the dense path's
+    non-finite-correlation check would reject the materialized matrix.
+    """
+    datas = _normalize_collection(data, "data")
+    if not datas:
+        raise ValueError(
+            "data_only runs need data (matrix, list, or dict): the "
+            "correlation and network are derived from it"
+        )
+    out: dict[str, Dataset] = {}
+    for name, raw in datas.items():
+        dat, samp_names, names = _as_matrix(raw, "data", name)
+        if dat.shape[0] < 2:
+            raise ValueError(
+                f"data for dataset {name!r} needs at least 2 samples to "
+                f"correlate, got {dat.shape[0]}"
+            )
+        if not np.isfinite(dat).all():
+            raise ValueError(
+                f"data for dataset {name!r} contains non-finite values"
+            )
+        sd = np.std(dat, axis=0)
+        if (sd == 0).any():
+            bad = np.flatnonzero(sd == 0)
+            raise ValueError(
+                f"data for dataset {name!r} has {bad.size} zero-variance "
+                f"(constant) column(s), e.g. positions {bad[:3].tolist()}: "
+                "their derived correlations are NaN (np.corrcoef "
+                "semantics) — drop or jitter these nodes, exactly as the "
+                "dense surface's non-finite-correlation check would demand"
+            )
+        if names is None:
+            names = [f"node_{i}" for i in range(dat.shape[1])]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate node names in dataset {name!r}")
+        out[name] = Dataset(
+            name=name, correlation=None, network=None, data=dat,
+            node_names=list(names), sample_names=samp_names,
+        )
+    return out
+
+
 def build_datasets(
     network,
     data=None,
@@ -106,7 +160,8 @@ def build_datasets(
     data-dependent statistics, SURVEY.md §2.2). Checks performed per dataset:
     square + symmetric + finite correlation/network, correlation entries in
     [-1, 1], data/correlation/network node-name agreement and equal node
-    counts.
+    counts. (Data-only datasets — no matrices at all — go through
+    :func:`build_data_only_datasets` instead.)
     """
     nets = _normalize_collection(network, "network")
     if not nets:

@@ -152,10 +152,26 @@ def module_preservation(
     store_nulls: bool = True,
     telemetry=None,
     fault_policy=None,
+    data_only=None,
 ):
     """Permutation test of network module preservation across datasets.
 
     Parameters mirror the reference (SURVEY.md §2.1); TPU-specific additions:
+
+    - ``data_only`` — the atlas module plane (ISSUE 9): pass the
+      soft-threshold power β (or a ``(β, kind)`` pair,
+      :func:`netrep_tpu.ops.stats.derived_net`) and ONLY ``data``; the
+      correlation and network are never materialized — every observed and
+      per-permutation k×k submatrix derives from gathered data columns as
+      one MXU matmul (``zᵀz/(s-1)`` + the elementwise construction), so
+      the device footprint is O(n·samples) and 100k-gene atlas inputs fit
+      where a dense n×n pair (~80 GB) cannot. ``network``/``correlation``
+      must be omitted; requires the default ``backend='jax'``; all seven
+      statistics are computed. At dense-representable sizes the results
+      match a dense run on the materialized ``|corr|**β`` pair within
+      float32 rounding (pinned in tests/test_atlas.py). The thin named
+      wrapper :func:`netrep_tpu.models.atlas_api.module_preservation`
+      exposes the same path with ``data`` leading the signature.
 
     - ``seed`` — PRNG seed; same seed ⇒ identical nulls regardless of chunk
       size or device mesh (SURVEY.md §7 "RNG semantics").
@@ -277,6 +293,35 @@ def module_preservation(
             "tallies are folded on device inside the scan-fused dispatch); "
             "run the native backend with store_nulls=True"
         )
+    if data_only is not None:
+        # the atlas module plane (ISSUE 9): matrices derive from data
+        if network is not None or correlation is not None:
+            raise ValueError(
+                "data_only derives the correlation and network from data "
+                "— drop the network/correlation arguments (or drop "
+                "data_only to run on materialized matrices)"
+            )
+        if data is None:
+            raise ValueError("data_only runs need data")
+        if backend != "jax":
+            raise ValueError(
+                "data_only requires backend='jax' (the native C++ tier "
+                "slices materialized host matrices)"
+            )
+        cfg0 = config or EngineConfig()
+        if (cfg0.network_from_correlation is not None
+                and cfg0.network_from_correlation != data_only):
+            raise ValueError(
+                "config.network_from_correlation "
+                f"({cfg0.network_from_correlation!r}) disagrees with "
+                f"data_only ({data_only!r}); pass the derivation spec once"
+            )
+        config = dataclasses.replace(
+            cfg0, network_from_correlation=(
+                tuple(data_only) if isinstance(data_only, list)
+                else data_only
+            ),
+        )
     if backend == "native":
         # the threaded C++ permutation procedure (netrep_tpu/native) — the
         # CPU tier mirroring the reference's OpenMP PermutationProcedure
@@ -311,7 +356,10 @@ def module_preservation(
             checkpoint_dir, f"null_{safe(d_name)}__{safe(t_name)}.npz"
         )
 
-    datasets = ds.build_datasets(network, data=data, correlation=correlation)
+    datasets = (
+        ds.build_data_only_datasets(data) if data_only is not None
+        else ds.build_datasets(network, data=data, correlation=correlation)
+    )
     pairs = ds.resolve_pairs(datasets, discovery, test, self_preservation)
     disc_names = sorted({d for d, _ in pairs}, key=list(datasets).index)
     assign = ds.normalize_module_assignments(
@@ -568,6 +616,13 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
             vmap_tests
             and backend == "jax"
             and len(t_names) > 1
+            # data-only pairs (ISSUE 9) run sequentially: the multi-test
+            # engine stacks the T cohorts' matrices, which data-only
+            # datasets do not materialize
+            and disc_ds.correlation is not None
+            and all(
+                datasets[t].correlation is not None for t in t_names
+            )
             and all(
                 datasets[t].node_names == datasets[t_names[0]].node_names
                 for t in t_names
@@ -577,9 +632,10 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
         if vmap_tests and not can_vmap and len(t_names) > 1:
             logger.warning(
                 "vmap_tests requested but unavailable (requires the default "
-                "backend='jax'; test datasets %s must share a node universe "
-                "and agree on data presence); falling back to sequential "
-                "pairs (any matrix sharding is retained per pair)", t_names,
+                "backend='jax' and materialized matrices; test datasets %s "
+                "must share a node universe and agree on data presence); "
+                "falling back to sequential pairs (any matrix sharding is "
+                "retained per pair)", t_names,
             )
 
         if can_vmap:

@@ -1561,6 +1561,47 @@ class PermutationEngine:
             discovery_only or test_data is not None
         )
         self.n_modules = len(self.modules)
+        #: data-only mode (ISSUE 9, the atlas module plane): no stored
+        #: correlation/network at all — every k×k submatrix derives from
+        #: gathered data columns (zᵀz/(s-1) + the soft-threshold
+        #: construction config.network_from_correlation names), so the
+        #: engine's device footprint is O(n·s) instead of O(n²).
+        self.data_only = (
+            disc_corr is None and disc_net is None
+            and (discovery_only or (test_corr is None and test_net is None))
+        )
+        if self.data_only:
+            if config.network_from_correlation is None:
+                raise ValueError(
+                    "data-only engines (correlation=None, network=None) "
+                    "need the derivation spec: set EngineConfig."
+                    "network_from_correlation to the soft-threshold β "
+                    "(or (β, kind))"
+                )
+            if not self.has_data:
+                raise ValueError(
+                    "data-only engines need discovery AND test data "
+                    "matrices — with no matrices and no data there is "
+                    "nothing to test"
+                )
+            if config.matrix_sharding == "row":
+                raise ValueError(
+                    "matrix_sharding='row' shards the n×n matrices the "
+                    "data-only mode exists to never materialize; use "
+                    "'replicated' (the data matrix is O(n·samples))"
+                )
+            if config.gather_mode == "fused":
+                raise ValueError(
+                    "gather_mode='fused' DMAs stored matrix rows; the "
+                    "data-only mode derives submatrices from data columns "
+                    "— use gather_mode='auto'"
+                )
+            if config.stat_mode == "fused":
+                raise ValueError(
+                    "stat_mode='fused' is not yet taught the data-only "
+                    "derivation; use stat_mode='auto' (resolves to the "
+                    "XLA composition here)"
+                )
 
         # Mesh-shape-independent checkpoint identity (ISSUE 6): digest the
         # ORIGINAL host inputs before any padding / sharding / transpose,
@@ -1597,8 +1638,13 @@ class PermutationEngine:
         # through the Pallas mega-kernel (gather + seven statistics [+ tally
         # fold] in VMEM, ops/fused_stats.py); resolved BEFORE effective_chunk
         # is first consulted — the row-sharded ring path rounds the chunk
-        # over BOTH mesh axes.
-        self.stat_mode = config.resolved_stat_mode(jax.default_backend())
+        # over BOTH mesh axes. Data-only pins the XLA composition: the
+        # mega-kernel consumes stored matrix rows (explicit 'fused' was
+        # rejected above; 'auto' resolves here).
+        self.stat_mode = (
+            "xla" if self.data_only
+            else config.resolved_stat_mode(jax.default_backend())
+        )
         #: fused-stats row-block override from the persistent autotune cache
         #: (None = the kernel's minimal-padding heuristic); the streaming
         #: loop records measured perms/s back against the applied block
@@ -1621,7 +1667,7 @@ class PermutationEngine:
         # |gathered corr|**β. Sample-check the claim against the supplied
         # matrices first.
         self.net_beta = config.network_from_correlation
-        if self.net_beta is not None:
+        if self.net_beta is not None and not self.data_only:
             check_derived_network(
                 disc_corr, disc_net, self.net_beta, "discovery"
             )
@@ -1643,7 +1689,11 @@ class PermutationEngine:
                     else int(np.asarray(test_data).shape[0]),
                 ),
             )
-        if discovery_only:
+        if self.data_only and not discovery_only:
+            # no stored test matrices: the chunk/observed kernels derive
+            # both submatrices from the transposed data gathered below
+            self._test_corr = self._test_net = None
+        elif discovery_only:
             self._test_corr = self._test_net = None
             if self.row_sharded:
                 from .sharded import make_sharded_gatherer
@@ -1726,7 +1776,22 @@ class PermutationEngine:
         # captures — captured device arrays become compile-time constants:
         # 3.2 GB baked into the bucket-build executable at Config D scale).
         net_beta = self.net_beta
-        if self.row_sharded:
+        if self.data_only:
+            from ..atlas.modules import (
+                make_disc_props_data_only, normalize_beta_static,
+            )
+
+            beta_static = normalize_beta_static(net_beta)
+            # transposed ONCE, like the test side: per-module data slices
+            # are then contiguous row gathers (see _test_dataT below)
+            d_corr = d_net = None
+            d_dataT = jnp.asarray(np.asarray(disc_data).T, jnp.float32)
+
+            def _disc_bucket(dc, dn, dd, idx, mask, _dT=d_dataT):
+                return make_disc_props_data_only(
+                    _dT, idx, mask, net_beta=beta_static,
+                )
+        elif self.row_sharded:
             from .mesh import ROW_AXIS
             from .sharded import pad_square_to_multiple, shard_rows
 
@@ -1924,10 +1989,15 @@ class PermutationEngine:
         caps = ",".join(
             f"{b.cap}x{len(b.module_pos)}" for b in self.buckets
         )
-        mode = (
-            f"{self.gather_mode}+fusedstats" if self.stat_mode == "fused"
-            else self.gather_mode
-        )
+        if getattr(self, "data_only", False):
+            # the data-only derivation has its own cost profile — its
+            # throughput/compile histories must never mix with the
+            # stored-matrix gather modes' (ISSUE 9)
+            mode = "data-only"
+        elif self.stat_mode == "fused":
+            mode = f"{self.gather_mode}+fusedstats"
+        else:
+            mode = self.gather_mode
         return make_key(
             jax.default_backend(), mode, caps,
             self.effective_chunk(), extra,
@@ -2051,7 +2121,26 @@ class PermutationEngine:
                 "the wrapping engine"
             )
         if self._observed_fn is None:
-            if self.row_sharded:
+            if self.data_only:
+                from ..atlas.modules import (
+                    data_only_gather_and_stats, normalize_beta_static,
+                )
+
+                inner = jax.jit(
+                    jax.vmap(
+                        partial(
+                            data_only_gather_and_stats,
+                            net_beta=normalize_beta_static(self.net_beta),
+                            n_iter=self.config.power_iters,
+                            summary_method="eigh",  # observed: exact
+                        ),
+                        in_axes=(0, 0, None),
+                    )
+                )
+                self._observed_fn = (
+                    lambda disc, idx, _tc, _tn, tdT: inner(disc, idx, tdT)
+                )
+            elif self.row_sharded:
                 self._observed_fn = make_row_sharded_observed(
                     self._gather_rep, self.net_beta
                 )
@@ -2128,6 +2217,8 @@ class PermutationEngine:
             )
         if self.stat_mode == "fused":
             return self._fused_stats_chunk_body()
+        if self.data_only:
+            return self._data_only_chunk_body()
         cfg = self.config
         # only static structure may be closed over (see chunk_args)
         caps_slices = [(b.cap, tuple(b.slices)) for b in self.buckets]
@@ -2234,6 +2325,56 @@ class PermutationEngine:
                     idx_b = _idx_blocks(perm, cap, slices)  # (K, cap)
                     over_mods = jax.vmap(kernel, in_axes=(0, 0, None, None, None))
                     outs_p.append(over_mods(disc, idx_b, tc, tn, td))
+                return outs_p
+
+            return jax.lax.map(per_perm, keys, batch_size=perm_batch)
+
+        return chunk
+
+    def _data_only_chunk_body(self) -> Callable:
+        """Unjitted chunk program for the data-only mode (ISSUE 9, the
+        atlas module plane): per permutation, every bucket gathers ONLY
+        the (s, m) data slice and derives both test submatrices from it
+        (:func:`netrep_tpu.atlas.modules.data_only_gather_and_stats` —
+        ``zᵀz/(s-1)`` on the MXU + the elementwise soft-threshold
+        construction). Same output contract as the stored-matrix chunk
+        (per-bucket ``(C, K, 7)``), so every null loop — materialized,
+        streaming, adaptive, monitored — consumes it unchanged."""
+        from ..atlas.modules import (
+            data_only_gather_and_stats, normalize_beta_static,
+        )
+
+        cfg = self.config
+        caps_slices = [(b.cap, tuple(b.slices)) for b in self.buckets]
+        # the working set per permutation is (K, cap, s) slices + (K, cap,
+        # cap) submatrices — the 'direct' profile, no stored-matrix rows
+        heuristic = cfg.resolved_perm_batch(
+            "direct", jax.default_backend(), self.effective_chunk()
+        )
+        from ..utils.autotune import resolve_perm_batch
+
+        at_key = self.autotune_key()
+        perm_batch, at_cache = resolve_perm_batch(cfg, at_key, heuristic)
+        self._autotune_record = (
+            (at_cache, at_key, perm_batch) if at_cache is not None else None
+        )
+        kernel = partial(
+            data_only_gather_and_stats,
+            net_beta=normalize_beta_static(self.net_beta),
+            n_iter=cfg.power_iters,
+            summary_method=cfg.summary_method,
+        )
+
+        def chunk(keys: jax.Array, pool, tc, tn, td, discs) -> list[jax.Array]:
+            # tc/tn ride as None placeholders so the chunk signature (and
+            # every loop built on chunk_args) stays mode-independent
+            def per_perm(key):
+                perm = jax.random.permutation(key, pool)
+                outs_p = []
+                for (cap, slices), disc in zip(caps_slices, discs):
+                    idx_b = _idx_blocks(perm, cap, slices)  # (K, cap)
+                    over_mods = jax.vmap(kernel, in_axes=(0, 0, None))
+                    outs_p.append(over_mods(disc, idx_b, td))
                 return outs_p
 
             return jax.lax.map(per_perm, keys, batch_size=perm_batch)

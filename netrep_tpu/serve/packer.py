@@ -252,26 +252,49 @@ class PackedEngine(PermutationEngine):
         ]
         from ..utils.autotune import resolve_perm_batch
 
-        heuristic = cfg.resolved_perm_batch(
-            self.gather_mode, jax.default_backend(), self.effective_chunk(),
-            bytes_per_perm=self._mxu_bytes_per_perm(
-                int(self._test_corr.shape[-1]),
-                None if self._test_dataT is None
-                else int(self._test_dataT.shape[-1]),
-            ),
-        )
+        if self.data_only:
+            # atlas tenants (ISSUE 9): no stored matrices — submatrices
+            # derive from the gathered data columns, same kernel as the
+            # stand-alone data-only engine so packed results stay
+            # bit-identical to direct calls
+            from ..atlas.modules import (
+                data_only_gather_and_stats, normalize_beta_static,
+            )
+
+            heuristic = cfg.resolved_perm_batch(
+                "direct", jax.default_backend(), self.effective_chunk()
+            )
+            kernel = partial(
+                data_only_gather_and_stats,
+                net_beta=normalize_beta_static(self.net_beta),
+                n_iter=cfg.power_iters,
+                summary_method=cfg.summary_method,
+            )
+            kernel_axes = (0, 0, None)
+        else:
+            heuristic = cfg.resolved_perm_batch(
+                self.gather_mode, jax.default_backend(),
+                self.effective_chunk(),
+                bytes_per_perm=self._mxu_bytes_per_perm(
+                    int(self._test_corr.shape[-1]),
+                    None if self._test_dataT is None
+                    else int(self._test_dataT.shape[-1]),
+                ),
+            )
+            kernel = partial(
+                jstats.gather_and_stats_mxu if self.gather_mode == "mxu"
+                else jstats.gather_and_stats,
+                n_iter=cfg.power_iters,
+                summary_method=cfg.summary_method,
+                net_beta=self.net_beta,
+            )
+            kernel_axes = (0, 0, None, None, None)
         at_key = self.autotune_key()
         perm_batch, at_cache = resolve_perm_batch(cfg, at_key, heuristic)
         self._autotune_record = (
             (at_cache, at_key, perm_batch) if at_cache is not None else None
         )
-        kernel = partial(
-            jstats.gather_and_stats_mxu if self.gather_mode == "mxu"
-            else jstats.gather_and_stats,
-            n_iter=cfg.power_iters,
-            summary_method=cfg.summary_method,
-            net_beta=self.net_beta,
-        )
+        data_only = self.data_only
 
         def chunk(keys, pool, tc, tn, td, discs):
             # keys: (C, G) typed PRNG keys — row i holds every group's key
@@ -284,10 +307,11 @@ class PackedEngine(PermutationEngine):
                 for (cap, slices, groups), disc in zip(
                         caps_slices_groups, discs):
                     idx_b = _idx_blocks_grouped(perms, cap, slices, groups)
-                    over_mods = jax.vmap(
-                        kernel, in_axes=(0, 0, None, None, None)
+                    over_mods = jax.vmap(kernel, in_axes=kernel_axes)
+                    outs_p.append(
+                        over_mods(disc, idx_b, td) if data_only
+                        else over_mods(disc, idx_b, tc, tn, td)
                     )
-                    outs_p.append(over_mods(disc, idx_b, tc, tn, td))
                 return outs_p
 
             return jax.lax.map(per_perm, keys, batch_size=perm_batch)

@@ -13,7 +13,13 @@ definition)::
                        (tenant, prefix, genes, modules, n_samples, seed)
     register           dataset registration with inline matrices
                        (tenant, name, correlation, network, data?,
-                        assignments?)
+                        assignments?) — or the DATA-ONLY atlas payload
+                       (tenant, name, data, beta, assignments?): no
+                       matrices, the soft-threshold spec ``beta`` (β or
+                       [β, kind]) derives them on device, and the
+                       returned content_digest covers the derivation
+                       params so different derivations of the same data
+                       never share a pack (ISSUE 9)
     analyze            blocking preservation request (tenant, discovery,
                        test | [tests...], modules?, n_perm?, seed,
                        alternative?, adaptive?, deadline_s?, timeout?)

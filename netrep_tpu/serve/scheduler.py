@@ -146,11 +146,16 @@ class _Tenant:
 
 
 class _Dataset:
-    def __init__(self, name: str, dataset, assignments, digest: str):
+    def __init__(self, name: str, dataset, assignments, digest: str,
+                 beta=None):
         self.name = name
         self.ds = dataset              # models.dataset.Dataset
         self.assignments = assignments  # normalized {node: label} or None
         self.digest = digest
+        #: data-only derivation spec (ISSUE 9 atlas tenants): the
+        #: soft-threshold β / (β, kind) the engines derive submatrices
+        #: with; None = a dense registration with stored matrices
+        self.beta = beta
 
 
 class PreservationServer:
@@ -246,31 +251,63 @@ class PreservationServer:
             ]
             self._rr_pos %= max(1, len(self._rr))
 
-    def register_dataset(self, tenant: str, name: str, *, network,
-                         correlation, data=None, assignments=None) -> str:
+    def register_dataset(self, tenant: str, name: str, *, network=None,
+                         correlation=None, data=None, assignments=None,
+                         beta=None) -> str:
         """Register one named dataset for ``tenant`` (creating the tenant
         at weight 1 if needed); returns the dataset's content digest —
         the identity the cross-request pack key is built from, so two
-        tenants registering identical data can share dispatches."""
+        tenants registering identical data can share dispatches.
+
+        Two payload shapes (ISSUE 9): the dense one (``network`` +
+        ``correlation`` [+ ``data``]) and the DATA-ONLY one (``data`` +
+        ``beta`` — the soft-threshold derivation spec, no matrices),
+        which serves atlas tenants whose n×n pair cannot exist. The
+        data-only digest covers the derivation params (β, kind) beside
+        the data content, so two derivations of the same data never
+        share a pack or a warm pooled engine."""
         if tenant not in self._tenants:
             self.register_tenant(tenant)
-        built = ds.build_datasets(
-            network={name: network},
-            data=None if data is None else {name: data},
-            correlation={name: correlation},
-        )
-        dataset = built[name]
+        data_only = network is None and correlation is None
+        if data_only:
+            if beta is None or data is None:
+                raise ServeError(
+                    "a registration needs either network+correlation "
+                    "(dense) or data+beta (data-only atlas payload)"
+                )
+            from ..ops.stats import normalize_net_beta
+
+            beta = tuple(beta) if isinstance(beta, list) else beta
+            b, kind = normalize_net_beta(beta)   # fail fast on a bad spec
+            built = ds.build_data_only_datasets({name: data})
+            dataset = built[name]
+            digest = (
+                f"{content_digest([dataset.data])}|beta:{b:g}|{kind}"
+            )
+        else:
+            if beta is not None:
+                raise ServeError(
+                    "beta is the data-only derivation spec; a dense "
+                    "registration (network+correlation) must not pass it"
+                )
+            built = ds.build_datasets(
+                network={name: network},
+                data=None if data is None else {name: data},
+                correlation={name: correlation},
+            )
+            dataset = built[name]
+            digest = content_digest(
+                [dataset.correlation, dataset.network, dataset.data]
+            )
         norm = None
         if assignments is not None:
             norm = ds.normalize_module_assignments(
                 assignments, built, [name]
             )[name]
-        digest = content_digest(
-            [dataset.correlation, dataset.network, dataset.data]
-        )
         with self._work:
             self._tenants[tenant].datasets[name] = _Dataset(
-                name, dataset, norm, digest
+                name, dataset, norm, digest,
+                beta=beta if data_only else None,
             )
         return digest
 
@@ -360,6 +397,14 @@ class PreservationServer:
             test, multi = test[0], False
         if multi:
             tests = [self._dataset(tenant, t) for t in test]
+            if disc.beta is not None or any(
+                t.beta is not None for t in tests
+            ):
+                raise ServeError(
+                    "multi-test requests need materialized matrices (the "
+                    "vmap_tests contract stacks the T cohorts); data-only "
+                    "datasets are served pairwise"
+                )
             names0 = tests[0].ds.node_names
             if any(t.ds.node_names != names0 for t in tests[1:]):
                 raise ServeError(
@@ -379,6 +424,18 @@ class PreservationServer:
             pack_key = None   # a multi-test request is its own pack
         else:
             tds = self._dataset(tenant, test)
+            if (disc.beta is None) != (tds.beta is None):
+                raise ServeError(
+                    "cannot mix a data-only dataset with a dense one in "
+                    "one request: both sides must carry matrices, or both "
+                    "data+beta"
+                )
+            if disc.beta is not None and disc.beta != tds.beta:
+                raise ServeError(
+                    f"discovery and test were registered with different "
+                    f"derivation specs ({disc.beta!r} vs {tds.beta!r}); "
+                    "re-register one side"
+                )
             plan = self._build_plan(disc, tds, modules, n_perm, seed,
                                     alternative, adaptive, rule)
             # compatibility identity: same matrices + same engine config
@@ -650,11 +707,18 @@ class PreservationServer:
         key = self._pool_key("packed", (disc.digest, test.digest), plans)
 
         def build():
+            cfg = self.config.engine
+            if disc.beta is not None:
+                # data-only atlas pack (ISSUE 9): the engine derives every
+                # submatrix from data columns with the registered spec
+                cfg = dataclasses.replace(
+                    cfg, network_from_correlation=disc.beta
+                )
             return PackedEngine(
                 disc.ds.correlation, disc.ds.network, disc.ds.data,
                 test.ds.correlation, test.ds.network, test.ds.data,
                 [p.specs for p in plans], plans[0].pool,
-                config=self.config.engine,
+                config=cfg,
             )
 
         engine, hit = self.pool.get(key, build)

@@ -63,14 +63,23 @@ def dispatch_op(server: PreservationServer, op: dict,
             return {"ok": True, "fixture": fixture}
         if kind == "register":
             data = op.get("data")
+            network = op.get("network")
+            correlation = op.get("correlation")
+            beta = op.get("beta")
+            # data-only atlas payload (ISSUE 9): data + beta, no matrices
+            # — the scheduler validates the combination either way
+            if isinstance(beta, list):
+                beta = tuple(beta)
             digest = server.register_dataset(
                 str(op["tenant"]), str(op["name"]),
-                network=np.asarray(op["network"], dtype=np.float64),
-                correlation=np.asarray(op["correlation"],
-                                       dtype=np.float64),
+                network=None if network is None
+                else np.asarray(network, dtype=np.float64),
+                correlation=None if correlation is None
+                else np.asarray(correlation, dtype=np.float64),
                 data=None if data is None
                 else np.asarray(data, dtype=np.float64),
                 assignments=op.get("assignments"),
+                beta=beta,
             )
             return {"ok": True, "digest": digest}
         if kind == "analyze":

@@ -205,6 +205,38 @@ def resolve_superchunk(config, key: str, default: int = DEFAULT_SUPERCHUNK):
     return (best if best is not None and best > 0 else default), cache
 
 
+#: static fallback for the atlas tile pass's tile edge (ISSUE 9) when
+#: nothing has been measured yet: a 1024-row block keeps the per-dispatch
+#: working set (one (edge, n) correlation strip + its derived-net twin in
+#: f32) near ~1 GB at the 100k-gene atlas shape — comfortably inside one
+#: HBM beside the O(n·s) data columns — while each tile is still a
+#: (1024, s)×(s, 1024) MXU matmul deep enough to be compute-bound.
+DEFAULT_TILE_EDGE = 1024
+
+
+def resolve_tile_edge(config, key: str, explicit: int | None = None,
+                      default: int = DEFAULT_TILE_EDGE):
+    """Autotuned tile-edge resolution for the atlas tiled network plane
+    (:mod:`netrep_tpu.atlas.builder` — ISSUE 9, beside the superchunk
+    entry): an ``explicit`` edge is honored verbatim (its measured
+    throughput is still recorded, so edge sweeps feed the cache); else the
+    best-measured edge for ``key`` — gene columns/s per (backend,
+    atlas-tiles, problem shape, *edge*) — replaces the static default.
+    Returns ``(edge, cache_or_None)``; the tile pass records its measured
+    steady-state columns/s back to the handle. ``config.autotune=False``
+    disables both lookup and recording, exactly like the perm-batch and
+    superchunk resolutions."""
+    if not getattr(config, "autotune", False):
+        return (max(8, int(explicit)) if explicit is not None else default,
+                None)
+    cache = AutotuneCache()
+    if explicit is not None:
+        return max(8, int(explicit)), cache
+    best = cache.best_setting(key)
+    _emit_lookup("tile_edge", key, best, default)
+    return (best if best is not None and best >= 8 else default), cache
+
+
 def resolve_fused_rowblock(config, key: str):
     """Autotuned row-block for the fused-statistics mega-kernel's DMA/
     select grid (ISSUE 8; :func:`netrep_tpu.ops.fused_stats.

@@ -1,0 +1,384 @@
+"""Atlas tiled network plane (ISSUE 9): the construction pass over the
+tile grid (top-k / τ selection vs a dense reference, interrupt → resume
+round-trip through the ``x_atlas_*`` checkpoint extras, mesh-sharded
+bit-parity, telemetry span tree, autotuned tile edge) and the data-only
+module plane (``module_preservation(data_only=…)`` parity against the
+dense path on materialized ``|corr|**β`` matrices — counts bit-identical
+on CPU — plus the SparseAdjacency bridge onto the Config E engine)."""
+
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+import netrep_tpu
+from netrep_tpu.atlas import (
+    TiledNetwork, build_sparse_network, derived_net_np,
+)
+from netrep_tpu.atlas.modules import dense_reference_stats
+from netrep_tpu.data import make_mixed_pair
+from netrep_tpu.ops import pvalues as pv
+from netrep_tpu.ops.sparse import SparseAdjacency
+from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+from netrep_tpu.parallel.mesh import make_mesh
+from netrep_tpu.utils.config import EngineConfig
+
+CFG = EngineConfig(autotune=False)
+BETA = 2.0
+
+
+@pytest.fixture(scope="module")
+def atlas_data():
+    """Structured data with planted modules, ragged vs the tile edge the
+    tests use (n=300, edge=64 → a 44-column tail tile)."""
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((20, 300))
+    for k in range(4):
+        x[:, k * 22:(k + 1) * 22] += 1.2 * rng.standard_normal(20)[:, None]
+    return x
+
+
+def dense_reference(x, beta=BETA):
+    """(corr, net) the tile plane derives, materialized the dense way."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        r = np.corrcoef(x, rowvar=False)
+    np.fill_diagonal(r, 0.0)
+    return r, derived_net_np(r, beta)
+
+
+def test_topk_construction_matches_dense_reference(atlas_data):
+    x = atlas_data
+    n, k = x.shape[1], 6
+    build = build_sparse_network(
+        TiledNetwork.from_data(x, BETA), top_k=k, tile_edge=64, config=CFG
+    )
+    r, net = dense_reference(x)
+    rows, cols, vals = [], [], []
+    for i in range(n):
+        order = np.argsort(-np.abs(r[i]), kind="stable")[:k]
+        rows += [i] * k
+        cols += list(order)
+        vals += list(net[i, order])
+    ref = SparseAdjacency.from_coo(rows, cols, vals, n, symmetrize=True)
+    d_got, d_ref = build.adjacency.to_dense(), ref.to_dense()
+    assert ((d_got != 0) == (d_ref != 0)).all()
+    np.testing.assert_allclose(d_got, d_ref, atol=1e-6)
+    # the degree vector covers the FULL derived network, not just kept edges
+    np.testing.assert_allclose(build.degree, net.sum(axis=1), atol=1e-5)
+    assert build.n == n and build.selected_edges == n * k
+
+
+def test_tau_construction_matches_dense_reference(atlas_data):
+    x = atlas_data
+    n, tau = x.shape[1], 0.45
+    build = build_sparse_network(
+        TiledNetwork.from_data(x, BETA), tau=tau, tile_edge=64, config=CFG
+    )
+    r, net = dense_reference(x)
+    sel = np.abs(r) >= tau
+    ref_c = SparseAdjacency.from_coo(
+        *np.nonzero(sel), r[sel], n, symmetrize=True
+    )
+    np.testing.assert_allclose(
+        build.correlation.to_dense(), ref_c.to_dense(), atol=1e-6
+    )
+    assert build.adjacency.nnz == build.correlation.nnz
+
+
+def test_selection_mode_validation(atlas_data):
+    tn = TiledNetwork.from_data(atlas_data, BETA)
+    with pytest.raises(ValueError, match="exactly one"):
+        build_sparse_network(tn, config=CFG)
+    with pytest.raises(ValueError, match="exactly one"):
+        build_sparse_network(tn, top_k=4, tau=0.5, config=CFG)
+    with pytest.raises(ValueError, match="tau must be > 0"):
+        build_sparse_network(tn, tau=0.0, config=CFG)
+
+
+def test_interrupt_resume_equals_uninterrupted(atlas_data, tmp_path):
+    x = atlas_data
+    tn = TiledNetwork.from_data(x, BETA)
+    full = build_sparse_network(tn, top_k=5, tile_edge=64, config=CFG)
+    ck = str(tmp_path / "atlas.npz")
+
+    def interrupt(done, total):
+        if done == 2:
+            raise KeyboardInterrupt
+
+    with pytest.raises(KeyboardInterrupt):
+        build_sparse_network(
+            tn, top_k=5, tile_edge=64, config=CFG,
+            checkpoint_path=ck, checkpoint_every=1, progress=interrupt,
+        )
+    # the failure-save landed, with the pass state in x_atlas_* extras
+    with np.load(ck) as z:
+        extras = [key for key in z.files if key.startswith("x_atlas_")]
+        assert set(extras) == {"x_atlas_rows", "x_atlas_cols",
+                               "x_atlas_corr"}
+        assert int(z["completed"]) == 2
+    resumed = build_sparse_network(
+        tn, top_k=5, tile_edge=64, config=CFG,
+        checkpoint_path=ck, checkpoint_every=1,
+    )
+    # all extras round-trip: resumed == uninterrupted, bit for bit
+    assert np.array_equal(
+        resumed.adjacency.to_dense(), full.adjacency.to_dense()
+    )
+    assert np.array_equal(
+        resumed.correlation.to_dense(), full.correlation.to_dense()
+    )
+    assert np.array_equal(resumed.degree, full.degree)
+
+
+def test_checkpoint_refuses_different_derivation(atlas_data, tmp_path):
+    x = atlas_data
+    ck = str(tmp_path / "atlas.npz")
+
+    def interrupt(done, total):
+        if done == 1:
+            raise KeyboardInterrupt
+
+    with pytest.raises(KeyboardInterrupt):
+        build_sparse_network(
+            TiledNetwork.from_data(x, BETA), top_k=5, tile_edge=64,
+            config=CFG, checkpoint_path=ck, progress=interrupt,
+        )
+    # a different β (or threshold rule) is a different problem
+    with pytest.raises(ValueError, match="different problem"):
+        build_sparse_network(
+            TiledNetwork.from_data(x, 3.0), top_k=5, tile_edge=64,
+            config=CFG, checkpoint_path=ck,
+        )
+    with pytest.raises(ValueError, match="different problem"):
+        build_sparse_network(
+            TiledNetwork.from_data(x, BETA), tau=0.5, tile_edge=64,
+            config=CFG, checkpoint_path=ck,
+        )
+
+
+def test_mesh_sharded_tile_pass_bit_identical(atlas_data):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    x = atlas_data
+    tn = TiledNetwork.from_data(x, BETA)
+    mesh = make_mesh(n_perm_shards=2, n_row_shards=1,
+                     devices=jax.devices()[:2])
+    single = build_sparse_network(tn, top_k=5, tile_edge=64, config=CFG)
+    sharded = build_sparse_network(
+        tn, top_k=5, tile_edge=64, config=CFG, mesh=mesh
+    )
+    assert np.array_equal(
+        sharded.adjacency.to_dense(), single.adjacency.to_dense()
+    )
+    assert np.array_equal(
+        sharded.correlation.to_dense(), single.correlation.to_dense()
+    )
+    assert np.array_equal(sharded.degree, single.degree)
+
+
+def test_tile_pass_telemetry_spans(atlas_data, tmp_path):
+    sink = str(tmp_path / "tiles.jsonl")
+    build_sparse_network(
+        TiledNetwork.from_data(atlas_data, BETA), top_k=4, tile_edge=128,
+        config=CFG, telemetry=sink,
+    )
+    events = [json.loads(l) for l in open(sink, encoding="utf-8")]
+    by_ev = {}
+    for e in events:
+        by_ev.setdefault(e["ev"], []).append(e)
+    assert len(by_ev["tile_pass_start"]) == 1
+    assert len(by_ev["tile_pass_end"]) == 1
+    start = by_ev["tile_pass_start"][0]
+    tiles = by_ev["tile"]
+    assert len(tiles) == start["data"]["blocks"]
+    # per-block events nest under the pass span; the end event closes it
+    sid = start["data"]["span"]
+    assert all(t["data"]["parent"] == sid for t in tiles)
+    end = by_ev["tile_pass_end"][0]["data"]
+    assert end["span"] == sid and end["interrupted"] is False
+    assert end["blocks_done"] == start["data"]["blocks"]
+
+
+def test_tile_edge_autotune_records(atlas_data, tmp_path, monkeypatch):
+    from netrep_tpu.utils import autotune
+
+    monkeypatch.setattr(
+        autotune, "default_path", lambda: str(tmp_path / "at.json")
+    )
+    cfg = EngineConfig(autotune=True)
+    build = build_sparse_network(
+        TiledNetwork.from_data(atlas_data, BETA), top_k=4, tile_edge=64,
+        config=cfg,
+    )
+    key = autotune.make_key(
+        jax.default_backend(), "atlas-tiles",
+        f"n{atlas_data.shape[1]}s{atlas_data.shape[0]}", 0, "topk",
+    )
+    samples = autotune.AutotuneCache().throughput(key, build.tile_edge)
+    assert samples and samples[0] > 0
+    # the recorded edge now wins the resolution for the same problem shape
+    edge, _cache = autotune.resolve_tile_edge(cfg, key)
+    assert edge == build.tile_edge
+
+
+# ---------------------------------------------------------------------------
+# Data-only module plane (module_preservation(data_only=…))
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def pair():
+    mixed = make_mixed_pair(220, 4, n_samples=24, seed=7)
+    (dd, _dc, dn), (td, _tc, _tn) = mixed["discovery"], mixed["test"]
+    assign = {f"node_{i}": "0" for i in range(dn.shape[0])}
+    for lab, idx in mixed["specs"]:
+        for i in idx:
+            assign[f"node_{i}"] = str(lab)
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    return dict(dd=dd, td=td, assign=assign, specs=specs,
+                pool=mixed["pool"])
+
+
+ECFG = EngineConfig(chunk_size=32, power_iters=40, autotune=False)
+
+
+def test_data_only_parity_with_dense_path(pair):
+    """The acceptance pin: at n ≤ 512 the data-only run reproduces the
+    dense path (same derivation, materialized) — statistics within the
+    backend tolerance, exceedance counts and p-values bit-identical on
+    CPU."""
+    res = netrep_tpu.atlas_module_preservation(
+        {"d": pair["dd"], "t": pair["td"]},
+        module_assignments={"d": pair["assign"]}, data_only=BETA,
+        discovery="d", test="t", n_perm=192, seed=1, config=ECFG,
+    )
+    (rdc, rdn), (rtc, rtn) = dense_reference_stats(
+        pair["dd"], pair["td"], pair["specs"], BETA
+    )
+    ref = netrep_tpu.module_preservation(
+        network={"d": rdn, "t": rtn}, correlation={"d": rdc, "t": rtc},
+        data={"d": pair["dd"], "t": pair["td"]},
+        module_assignments={"d": pair["assign"]},
+        discovery="d", test="t", n_perm=192, seed=1, config=ECFG,
+    )
+    np.testing.assert_allclose(res.observed, ref.observed, atol=1e-5)
+    np.testing.assert_allclose(res.nulls, ref.nulls, atol=1e-5)
+    for got, want in zip(
+        pv.tail_counts(res.observed, res.nulls),
+        pv.tail_counts(ref.observed, ref.nulls),
+    ):
+        assert np.array_equal(got, want)
+    assert np.array_equal(res.p_values, ref.p_values)
+
+
+def test_data_only_streaming_and_adaptive(pair):
+    kw = dict(
+        module_assignments={"d": pair["assign"]}, data_only=BETA,
+        discovery="d", test="t", seed=1, config=ECFG,
+    )
+    data = {"d": pair["dd"], "t": pair["td"]}
+    base = netrep_tpu.atlas_module_preservation(data, n_perm=192, **kw)
+    stream = netrep_tpu.atlas_module_preservation(
+        data, n_perm=192, store_nulls=False, **kw
+    )
+    assert stream.nulls is None
+    assert np.array_equal(stream.p_values, base.p_values)
+    adaptive = netrep_tpu.atlas_module_preservation(
+        data, n_perm=256, adaptive=True, **kw
+    )
+    assert adaptive.p_type == "sequential"
+    assert np.isfinite(adaptive.p_values).all()
+
+
+def test_data_only_checkpoint_resume(pair, tmp_path):
+    kw = dict(
+        module_assignments={"d": pair["assign"]}, data_only=BETA,
+        discovery="d", test="t", seed=1, config=ECFG, n_perm=96,
+    )
+    data = {"d": pair["dd"], "t": pair["td"]}
+    base = netrep_tpu.atlas_module_preservation(data, **kw)
+    ckdir = str(tmp_path / "ck")
+    hit = {"n": 0}
+
+    def interrupt(done, total):
+        hit["n"] += 1
+        if done >= 32:
+            raise KeyboardInterrupt
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        partial = netrep_tpu.atlas_module_preservation(
+            data, checkpoint_dir=ckdir, checkpoint_every=32,
+            progress=interrupt, **kw,
+        )
+    assert partial.completed < 96
+    resumed = netrep_tpu.atlas_module_preservation(
+        data, checkpoint_dir=ckdir, checkpoint_every=32, **kw
+    )
+    assert resumed.completed == 96
+    assert np.array_equal(resumed.nulls, base.nulls)
+    assert np.array_equal(resumed.p_values, base.p_values)
+
+
+def test_data_only_engine_guards(pair):
+    dd, td = pair["dd"], pair["td"]
+    with pytest.raises(ValueError, match="network_from_correlation"):
+        PermutationEngine(
+            None, None, dd, None, None, td, pair["specs"], pair["pool"],
+            config=EngineConfig(autotune=False),
+        )
+    with pytest.raises(ValueError, match="nothing to test"):
+        PermutationEngine(
+            None, None, None, None, None, None, pair["specs"],
+            pair["pool"],
+            config=EngineConfig(network_from_correlation=BETA,
+                                autotune=False),
+        )
+    with pytest.raises(ValueError, match="fused"):
+        PermutationEngine(
+            None, None, dd, None, None, td, pair["specs"], pair["pool"],
+            config=EngineConfig(network_from_correlation=BETA,
+                                gather_mode="fused", autotune=False),
+        )
+    with pytest.raises(ValueError, match="drop the network/correlation"):
+        netrep_tpu.module_preservation(
+            network={"d": np.eye(3)}, data={"d": dd},
+            module_assignments={"d": pair["assign"]}, data_only=BETA,
+        )
+
+
+def test_data_only_rejects_degenerate_columns(pair):
+    bad = pair["dd"].copy()
+    bad[:, 7] = 1.25
+    with pytest.raises(ValueError, match="zero-variance"):
+        netrep_tpu.atlas_module_preservation(
+            {"d": bad, "t": pair["td"]},
+            module_assignments={"d": pair["assign"]}, data_only=BETA,
+            discovery="d", test="t", n_perm=8,
+        )
+
+
+def test_sparse_bridge_runs_config_e_engine(atlas_data):
+    """The construction pass's output drops straight onto the Config E
+    sparse engine: thresholded SparseAdjacency networks + the original
+    data columns — atlas inputs on the existing sparse surface."""
+    x = atlas_data
+    build = build_sparse_network(
+        TiledNetwork.from_data(x, BETA), top_k=6, tile_edge=64, config=CFG
+    )
+    assign = {f"node_{i}": "0" for i in range(x.shape[1])}
+    for k in range(4):
+        for i in range(k * 22, (k + 1) * 22):
+            assign[f"node_{i}"] = str(k + 1)
+    res = netrep_tpu.sparse_module_preservation(
+        build.adjacency, build.adjacency, assign,
+        discovery_data=x, test_data=x,
+        n_perm=64, seed=0, config=EngineConfig(chunk_size=32,
+                                               autotune=False),
+    )
+    assert np.isfinite(res.p_values).all()
+    assert res.observed.shape == (4, 7)

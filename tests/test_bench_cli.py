@@ -56,6 +56,11 @@ CASES = [
     # row, so this smoke case guards the stat_mode='fused' dispatch path
     # end-to-end
     ["--config", "pallas"],
+    # atlas tiled network plane (ISSUE 9): tile-grid construction +
+    # data-only null mechanism row — guards the TiledNetwork builder and
+    # the correlation=None/network=None engine path end-to-end (the
+    # opt-in ATLAS_STEP watcher step runs this config on TPU)
+    ["--config", "atlas"],
 ]
 
 

@@ -86,6 +86,51 @@ def test_small_modules_dropped_with_warning(problem, caplog):
     assert set(res.module_labels) == {"1", "2"}
 
 
+def test_atlas_tile_nan_propagation_matches_corrcoef(problem):
+    """ISSUE 9 satellite: the atlas plane's streaming standardization must
+    reproduce dense ``np.corrcoef`` degenerate-input behavior — a
+    zero-variance column makes every correlation touching it NaN, at
+    EXACTLY the positions corrcoef puts them (NaN mask pinned bit-for-bit
+    across a ragged tile grid; finite values agree to float64 rounding,
+    since corrcoef's full-matrix GEMM and a tile GEMM legitimately differ
+    in sub-block accumulation on tail tiles)."""
+    from netrep_tpu.atlas import TiledNetwork
+
+    x, *_ = problem
+    x = np.asarray(x, dtype=np.float64).copy()
+    x[:, 5] = 2.5   # constant gene
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ref = np.corrcoef(x, rowvar=False)
+    tn = TiledNetwork.from_data(x, 2.0, allow_degenerate=True)
+    n, edge = x.shape[1], 16   # 40 columns → ragged 8-wide tail tile
+    got = np.empty((n, n))
+    for i0 in range(0, n, edge):
+        I = np.arange(i0, min(i0 + edge, n))
+        for j0 in range(0, n, edge):
+            J = np.arange(j0, min(j0 + edge, n))
+            got[np.ix_(I, J)] = tn.corr_tile(I, J)
+    # NaN propagation bit-for-bit: same mask, whole row+column of gene 5
+    assert np.array_equal(np.isnan(got), np.isnan(ref))
+    assert np.isnan(got[5, :]).all() and np.isnan(got[:, 5]).all()
+    finite = ~np.isnan(ref)
+    np.testing.assert_allclose(got[finite], ref[finite], rtol=0, atol=1e-14)
+
+
+def test_atlas_spec_rejects_zero_variance_like_the_dense_path(problem):
+    """The validated spec mirrors the dense surface's rejection posture:
+    where build_datasets refuses the NaN-carrying materialized
+    correlation, TiledNetwork.from_data refuses the column that would
+    derive it — same failure, caught at the representation that exists."""
+    from netrep_tpu.atlas import TiledNetwork
+
+    x, *_ = problem
+    x = np.asarray(x, dtype=np.float64).copy()
+    x[:, 5] = 2.5
+    with pytest.raises(ValueError, match="zero-variance"):
+        TiledNetwork.from_data(x, 2.0)
+
+
 def test_all_modules_too_small_raises(problem):
     x, y, cy, nety, labels = problem
     labels = np.array(["0"] * 40, dtype=object)

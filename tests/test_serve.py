@@ -10,6 +10,7 @@ import json
 import numpy as np
 import pytest
 
+import netrep_tpu
 from netrep_tpu import module_preservation
 from netrep_tpu.data import make_mixed_pair
 from netrep_tpu.ops import pvalues as pv
@@ -347,6 +348,72 @@ def test_metrics_exposition_and_stats(fx, tmp_path):
     # the engine-run registry rides the same exposition (shared bus)
     assert "netrep_chunk_count_total" in text
     assert st["tenants"]["a"]["done"] == 1 and st["packs"] >= 1
+
+
+def test_data_only_register_and_analyze_parity(fx, tmp_path):
+    """ISSUE 9 satellite: `register_dataset` accepts the data-only atlas
+    payload (data + beta, no correlation/network); the served analysis is
+    bit-identical to the direct data-only call; the content digest covers
+    the derivation params, so a different β is a different identity."""
+    beta = 2.0
+    srv = PreservationServer(ServeConfig(
+        engine=CFG, telemetry=str(tmp_path / "tel.jsonl")
+    ))
+    client = InProcessClient(srv)
+    try:
+        d1 = client.register_dataset("a", "d", data=fx["dd"], beta=beta,
+                                     assignments=fx["assign"])
+        d2 = client.register_dataset("a", "t", data=fx["td"], beta=beta)
+        # derivation params ride the digest: same data, different β →
+        # different identity (never shares a pack / pooled engine)
+        d1b = client.register_dataset("a", "d3", data=fx["dd"],
+                                      beta=(3.0, "signed"),
+                                      assignments=fx["assign"])
+        assert d1.endswith("|beta:2|unsigned")
+        assert d1b.endswith("|beta:3|signed")
+        assert d1.split("|")[0] == d1b.split("|")[0]  # same data content
+        assert d1 != d2
+        res = client.analyze("a", "d", "t", n_perm=64, seed=3,
+                             timeout=600)
+    finally:
+        srv.close()
+    direct = netrep_tpu.atlas_module_preservation(
+        {"d": fx["dd"], "t": fx["td"]},
+        module_assignments={"d": fx["assign"]}, data_only=beta,
+        discovery="d", test="t", n_perm=64, seed=3, config=CFG,
+    )
+    np.testing.assert_array_equal(res["observed"], direct.observed)
+    np.testing.assert_array_equal(res["p_values"],
+                                  np.asarray(direct.p_values))
+    hi, lo, eff = pv.tail_counts(
+        direct.observed, np.asarray(direct.nulls)[:direct.completed]
+    )
+    np.testing.assert_array_equal(res["counts_hi"], hi)
+    np.testing.assert_array_equal(res["counts_lo"], lo)
+
+
+def test_data_only_register_validation(fx, tmp_path):
+    srv = PreservationServer(ServeConfig(engine=CFG), start=False)
+    client = InProcessClient(srv)
+    try:
+        with pytest.raises(ServeError, match="network\\+correlation"):
+            client.register_dataset("a", "d", data=fx["dd"])  # no beta
+        with pytest.raises(ServeError, match="must not pass"):
+            client.register_dataset("a", "d", network=fx["dn"],
+                                    correlation=fx["dc"], beta=2.0)
+        client.register_dataset("a", "d", data=fx["dd"], beta=2.0,
+                                assignments=fx["assign"])
+        client.register_dataset("a", "dense_t", network=fx["tn"],
+                                correlation=fx["tc"], data=fx["td"])
+        client.register_dataset("a", "t", data=fx["td"], beta=3.0)
+        # mixing a data-only side with a dense one — or two different
+        # derivations — fails fast at submit
+        with pytest.raises(ServeError, match="cannot mix"):
+            client.submit("a", "d", "dense_t", n_perm=16)
+        with pytest.raises(ServeError, match="different derivation"):
+            client.submit("a", "d", "t", n_perm=16)
+    finally:
+        srv.close(drain=False)
 
 
 def test_unknown_tenant_and_dataset_fail_fast(fx, tmp_path):
