@@ -34,8 +34,20 @@ request over the socket, SIGTERM it, and assert the graceful-drain
 contract (exit 0 + a final ``{"serve": "drained"}`` line) — the
 ``tpu_watch.sh`` SERVE_DRILL cycle.
 
+``--kill-recover`` (ISSUE 10) measures the crash-recovery story instead:
+a journaled in-process server is killed mid-pack (the ``crash`` fault
+plan — the SIGKILL stand-in), a fresh server boots with ``recover=True``,
+and the row reports **time-to-recovery** (boot + replay + finishing every
+request) plus the re-served/recomputed split — requests that finished
+before the kill are answered from their journaled ``done`` records, the
+rest resume/recompute bit-identically (parity asserted in-bench before
+the row is emitted). Rows carry the ``serve-recover`` metric label, so
+their perf-ledger fingerprints never mix with steady-state serving
+history.
+
 Usage: python benchmarks/serve_load.py [--smoke] [--mode both|closed|open]
                                        [--requests N] [--rate R] [--drill]
+                                       [--kill-recover]
 """
 
 from __future__ import annotations
@@ -281,11 +293,12 @@ def run_drill(args) -> int:
     import signal
     import subprocess
 
-    sock = os.path.join(tempfile.mkdtemp(prefix="netrep_serve_"),
-                        "serve.sock")
+    tmp = tempfile.mkdtemp(prefix="netrep_serve_")
+    sock = os.path.join(tmp, "serve.sock")
     proc = subprocess.Popen(
         [sys.executable, "-m", "netrep_tpu", "serve", "--socket", sock,
-         "--chunk", str(args.chunk)],
+         "--chunk", str(args.chunk),
+         "--journal", os.path.join(tmp, "journal.jsonl")],
         cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True, env={**os.environ, "JAX_PLATFORMS":
                         os.environ.get("JAX_PLATFORMS", "cpu") or "cpu"},
@@ -334,6 +347,122 @@ def run_drill(args) -> int:
             proc.wait()
 
 
+def run_kill_recover(args) -> int:
+    """Kill→recover scenario (ISSUE 10): journaled server, one request
+    completed before a mid-pack crash, the rest in flight or queued;
+    measure the recovered server's time to finish everything and the
+    re-served vs recomputed split."""
+    import time as _time
+
+    from netrep_tpu import module_preservation
+    from netrep_tpu.data import make_mixed_pair
+    from netrep_tpu.serve import (
+        InProcessClient, PreservationServer, ServeConfig,
+    )
+    from netrep_tpu.utils.config import EngineConfig, FaultPolicy
+
+    import jax
+
+    device = str(jax.devices()[0])
+    cfg = EngineConfig(chunk_size=args.chunk, autotune=False)
+    mixed = make_mixed_pair(args.genes_small, args.modules_small,
+                            n_samples=args.samples, seed=7)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    assign = {f"node_{i}": "0" for i in range(dn.shape[0])}
+    for lab, idx in mixed["specs"]:
+        for i in idx:
+            assign[f"node_{i}"] = str(lab)
+
+    def register(client):
+        client.register_dataset("alpha", "d", network=dn, correlation=dc,
+                                data=dd, assignments=assign)
+        client.register_dataset("alpha", "t", network=tn, correlation=tc,
+                                data=td)
+
+    tmp = tempfile.mkdtemp(prefix="netrep_kill_recover_")
+    jpath = os.path.join(tmp, "journal.jsonl")
+    # request 0 is SHORT (finishes below the crash perm: re-served from
+    # the journal); the rest span the crash point and die with the server
+    n_kill = 3 * args.chunk // 4
+    reqs = [{"key": "kr-0", "n_perm": args.chunk // 2, "seed": 100}] + [
+        {"key": f"kr-{i}", "n_perm": args.n_perm_lo, "seed": 100 + i}
+        for i in range(1, args.requests + 1)
+    ]
+
+    srv = PreservationServer(ServeConfig(
+        engine=cfg, journal=jpath, checkpoint_every=args.chunk,
+        telemetry=os.path.join(tmp, "tel_kill.jsonl"),
+        fault_policy=FaultPolicy(plan=f"crash@{n_kill}",
+                                 backoff_base_s=0.0, backoff_jitter=0.0),
+    ), start=False)
+    client = InProcessClient(srv)
+    register(client)
+    h0 = client.submit("alpha", "d", "t", n_perm=reqs[0]["n_perm"],
+                       seed=reqs[0]["seed"], idempotency_key=reqs[0]["key"])
+    srv.start()
+    client.result(h0, timeout=600)         # completed before the kill
+    for r in reqs[1:]:
+        client.submit("alpha", "d", "t", n_perm=r["n_perm"],
+                      seed=r["seed"], idempotency_key=r["key"])
+    deadline = _time.monotonic() + 600
+    while srv._worker.is_alive() and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+    if srv._worker.is_alive():
+        print(json.dumps({"metric": "serve-recover", "error":
+                          "injected crash never fired"}))
+        return 1
+    done_before = sum(
+        t["done"] for t in srv.stats()["tenants"].values()
+    )
+
+    t0 = _time.perf_counter()
+    srv2 = PreservationServer(ServeConfig(
+        engine=cfg, journal=jpath, recover=True,
+        checkpoint_every=args.chunk,
+        telemetry=os.path.join(tmp, "tel_recover.jsonl"),
+    ))
+    client2 = InProcessClient(srv2)
+    results = {
+        r["key"]: client2.analyze("alpha", "d", "t", n_perm=r["n_perm"],
+                                  seed=r["seed"],
+                                  idempotency_key=r["key"], timeout=1200)
+        for r in reqs
+    }
+    recovery_s = _time.perf_counter() - t0
+    st = srv2.stats()
+    srv2.close()
+    # parity gate before any number is emitted: recovered == direct
+    d = module_preservation(
+        network={"d": dn, "t": tn}, correlation={"d": dc, "t": tc},
+        data={"d": dd, "t": td}, module_assignments=assign,
+        discovery="d", test="t", n_perm=reqs[1]["n_perm"],
+        seed=reqs[1]["seed"], config=cfg,
+    )
+    assert np.array_equal(results[reqs[1]["key"]]["p_values"],
+                          np.asarray(d.p_values)), \
+        "recovered/direct p-value mismatch"
+    recomputed = len(reqs) - done_before
+    recomputed_perms = sum(
+        int(results[r["key"]]["completed"]) for r in reqs[1:]
+    )
+    emit({
+        "metric": (
+            f"serve-recover kill-recover ({len(reqs)} req, "
+            f"kill@{n_kill}, chunk {args.chunk})"
+        ),
+        "value": round(recovery_s, 3),
+        "unit": "s",
+        "time_to_recovery_s": round(recovery_s, 3),
+        "requests_reserved": done_before,
+        "requests_recomputed": recomputed,
+        "perms_per_sec": round(recomputed_perms / recovery_s, 2),
+        "packs": st["packs"],
+        "device": device,
+        "chunk": args.chunk,
+    })
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -358,6 +487,11 @@ def main() -> int:
     ap.add_argument("--drill", action="store_true",
                     help="daemon SIGTERM-drain drill instead of the load "
                          "run")
+    ap.add_argument("--kill-recover", action="store_true",
+                    help="kill→recover scenario instead of the load run: "
+                         "time-to-recovery + re-served/recomputed split "
+                         "after a mid-pack crash (rows labeled "
+                         "serve-recover in the perf ledger)")
     ap.add_argument("--drain-wait", type=float, default=120.0)
     args = ap.parse_args()
 
@@ -384,6 +518,8 @@ def main() -> int:
 
     if args.drill:
         return run_drill(args)
+    if args.kill_recover:
+        return run_kill_recover(args)
 
     device = str(jax.devices()[0])
     tenants, requests = build_workload(args)

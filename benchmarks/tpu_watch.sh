@@ -184,10 +184,44 @@ serve_drill() {
   fi
 }
 
+# Serve CRASH drill (ISSUE 10, opt-in: SERVE_CRASH_DRILL=auto or 1):
+# once per watch cycle, prove the crash-recovery contract end to end —
+# `chaos --serve` boots the real daemon, SIGKILLs it mid-pack at a
+# plan-injected permutation, restarts with --recover, and asserts every
+# journaled request completes bit-identically; then the kill-recover
+# load scenario measures time-to-recovery and the re-served/recomputed
+# split into $PERF_LEDGER under its own `serve-recover` label (never
+# mixed with steady-state serving fingerprints), gated by `perf --check`
+# loudly but non-fatally. CPU-only; off under the QUEUE_FILE test hook.
+SERVE_CRASH_DRILL=${SERVE_CRASH_DRILL:-0}
+serve_crash_drill() {
+  case "$SERVE_CRASH_DRILL" in
+    auto|1) ;;
+    *) return 0 ;;
+  esac
+  [ "$SERVE_CRASH_DRILL" = auto ] && [ -n "${QUEUE_FILE:-}" ] && return 0
+  echo "--- serve crash drill ($(date -u +%FT%TZ)) ---" | tee -a "$LOG"
+  if ! timeout 900 env JAX_PLATFORMS=cpu \
+       python -m netrep_tpu chaos --serve --json >>"$LOG" 2>&1; then
+    echo "--- SERVE CRASH DRILL FAILED (journal/recover parity regressed?) ---" | tee -a "$LOG"
+  fi
+  if ! timeout 600 env JAX_PLATFORMS=cpu python benchmarks/serve_load.py \
+       --smoke --kill-recover >>"$LOG" 2>&1; then
+    echo "--- SERVE KILL-RECOVER SCENARIO FAILED ---" | tee -a "$LOG"
+  fi
+  if [ -s "$PERF_LEDGER" ]; then
+    if ! perf_out=$(timeout 60 python -m netrep_tpu perf "$PERF_LEDGER" --check 2>/dev/null); then
+      echo "--- PERF REGRESSION after serve crash drill ---" | tee -a "$LOG"
+      echo "$perf_out" | tee -a "$LOG"
+    fi
+  fi
+}
+
 echo "== watcher start $(date -u +%FT%TZ) (log=$LOG state=$STATE) ==" | tee -a "$LOG"
 while :; do
   elastic_drill
   serve_drill
+  serve_crash_drill
   # drained first: with a cutoff set, an empty queue would otherwise be
   # reported as "no step can finish before cutoff" (review r5 — the test
   # harness caught the misleading exit line)

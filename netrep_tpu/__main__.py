@@ -140,6 +140,175 @@ def _chaos(args) -> int:
                 pass
 
 
+def _chaos_serve(args) -> int:
+    """``chaos --serve`` (ISSUE 10): the serving analogue of the elastic
+    drill. Boots the REAL daemon with a plan-injected ``SIGKILL``
+    mid-pack, lets concurrent journaled requests die with it, restarts
+    the daemon with ``--recover``, and asserts every request completes
+    with p-values BIT-IDENTICAL to direct (unkilled) calls — clients
+    retry under their original idempotency keys, so nothing recomputes
+    twice and nothing is lost. Exit 0 = drill passed."""
+    import os
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+    import time
+
+    plan = args.plan or os.environ.get("NETREP_FAULT_PLAN") or "sigkill@24"
+    # the baseline below must run unkilled/unfaulted
+    os.environ.pop("NETREP_FAULT_PLAN", None)
+
+    from netrep_tpu.utils.backend import resolve_backend_or_cpu
+
+    resolve_backend_or_cpu()
+    import numpy as np
+
+    from netrep_tpu import module_preservation
+    from netrep_tpu.data import make_mixed_pair
+    from netrep_tpu.utils.config import EngineConfig
+
+    genes, modules, n_samples, fseed = 100, 3, 16, 7
+    reqs = [{"seed": 100 + i, "n_perm": int(args.n_perm)}
+            for i in range(args.requests)]
+
+    # unkilled baseline: the PR 7 parity contract pins served == direct,
+    # so the direct call IS the uninterrupted server's answer
+    mixed = make_mixed_pair(genes, modules, n_samples=n_samples, seed=fseed)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    assign = {f"node_{i}": "0" for i in range(dn.shape[0])}
+    for lab, idx in mixed["specs"]:
+        for i in idx:
+            assign[f"node_{i}"] = str(lab)
+    cfg = EngineConfig(chunk_size=args.chunk, autotune=False)
+    baseline = {}
+    for r in reqs:
+        res = module_preservation(
+            network={"d": dn, "t": tn}, correlation={"d": dc, "t": tc},
+            data={"d": dd, "t": td}, module_assignments=assign,
+            discovery="d", test="t", n_perm=r["n_perm"], seed=r["seed"],
+            config=cfg,
+        )
+        baseline[r["seed"]] = np.asarray(res.p_values)
+
+    tmp = tempfile.mkdtemp(prefix="netrep_chaos_serve_")
+    sock = os.path.join(tmp, "serve.sock")
+    journal = os.path.join(tmp, "journal.jsonl")
+    env_base = {**os.environ, "JAX_PLATFORMS":
+                os.environ.get("JAX_PLATFORMS", "cpu") or "cpu"}
+
+    def boot(extra_env, recover):
+        cmd = [sys.executable, "-m", "netrep_tpu", "serve",
+               "--socket", sock, "--journal", journal,
+               "--chunk", str(args.chunk), "--checkpoint-every",
+               str(args.chunk)]
+        if recover:
+            cmd.append("--recover")
+        return subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env={**env_base, **extra_env},
+        )
+
+    def wait_socket(proc, budget=180.0):
+        deadline = time.monotonic() + budget
+        while not os.path.exists(sock):
+            if time.monotonic() > deadline or proc.poll() is not None:
+                return False
+            time.sleep(0.2)
+        return True
+
+    from netrep_tpu.serve.client import SocketClient
+
+    def drive(client_results):
+        """One thread per request, pinned idempotency keys — the sockets
+        die with the daemon; the retry happens against the recovered one."""
+        def worker(r):
+            c = None
+            try:
+                c = SocketClient(sock, timeout=600)
+                client_results[r["seed"]] = np.asarray(c.analyze(
+                    "drill", "fx_d", "fx_t", n_perm=r["n_perm"],
+                    seed=r["seed"], idempotency_key=f"drill-{r['seed']}",
+                )["p_values"])
+            except Exception:
+                pass  # expected for requests in flight at the kill
+            finally:
+                if c is not None:
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+
+        threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+                   for r in reqs]
+        for t in threads:
+            t.start()
+        return threads
+
+    summary = {"plan": plan, "requests": len(reqs),
+               "n_perm": int(args.n_perm)}
+    proc = proc2 = None
+    try:
+        proc = boot({"NETREP_FAULT_PLAN": plan}, recover=False)
+        if not wait_socket(proc):
+            print("chaos --serve: daemon never opened its socket",
+                  file=sys.stderr)
+            return 1
+        reg = SocketClient(sock, timeout=600)
+        reg.register_fixture("drill", genes=genes, modules=modules,
+                             n_samples=n_samples, seed=fseed)
+        reg.close()
+        threads = drive(results_a := {})
+        proc.wait(timeout=600)      # the injected SIGKILL fires mid-pack
+        for t in threads:
+            t.join(timeout=60)
+        summary["killed"] = proc.returncode == -signal.SIGKILL
+        summary["done_before_kill"] = len(results_a)
+
+        try:
+            os.unlink(sock)         # SIGKILL skipped the daemon's cleanup
+        except OSError:
+            pass
+        proc2 = boot({}, recover=True)
+        if not wait_socket(proc2):
+            print("chaos --serve: recovered daemon never opened its "
+                  "socket", file=sys.stderr)
+            return 1
+        threads = drive(results_b := {})
+        for t in threads:
+            t.join(timeout=600)
+
+        identical = all(
+            s in results_b and np.array_equal(results_b[s], baseline[s])
+            for s in baseline
+        ) and all(np.array_equal(results_a[s], baseline[s])
+                  for s in results_a)
+        summary["recovered"] = len(results_b) == len(reqs)
+        summary["bit_identical"] = bool(identical)
+        summary["ok"] = bool(summary["killed"] and summary["recovered"]
+                             and identical)
+        if args.json:
+            print(json.dumps(summary))
+        else:
+            print(f"serve chaos drill: plan={plan!r}, "
+                  f"{len(reqs)} requests @ {args.n_perm} perms")
+            print("serve chaos drill "
+                  + ("PASSED" if summary["ok"] else "FAILED")
+                  + f": killed={summary['killed']} "
+                    f"recovered={summary['recovered']} "
+                    f"bit_identical={summary['bit_identical']}")
+        return 0 if summary["ok"] else 1
+    finally:
+        for p in (proc, proc2):
+            if p is not None and p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m netrep_tpu")
     sub = ap.add_subparsers(dest="cmd")
@@ -214,9 +383,45 @@ def main(argv=None) -> int:
                     help="default permutation budget for requests that "
                          "omit n_perm (default: the library's Bonferroni "
                          "auto rule)")
-    sv.add_argument("--drain-timeout", type=float, default=120.0,
+    sv.add_argument("--drain-timeout", "--drain-timeout-s", type=float,
+                    default=120.0, dest="drain_timeout",
                     help="max seconds to finish queued work on "
-                         "SIGTERM/shutdown before exiting anyway")
+                         "SIGTERM/shutdown; past the bound the remainder "
+                         "is journaled as requeued-on-restart and the "
+                         "process exits cleanly (ISSUE 10)")
+    sv.add_argument("--journal", default="netrep_serve_journal.jsonl",
+                    metavar="PATH",
+                    help="write-ahead request journal (fsynced accepted/"
+                         "done records; the crash-recovery source). "
+                         "Default: ./netrep_serve_journal.jsonl")
+    sv.add_argument("--no-journal", action="store_true",
+                    help="disable the journal entirely (PR 7 behavior: "
+                         "no durability, no idempotency persistence)")
+    sv.add_argument("--recover", nargs="?", const=True, default=None,
+                    metavar="JOURNAL",
+                    help="replay the journal on boot: re-register "
+                         "datasets, answer duplicates from journaled "
+                         "results, re-queue unfinished requests in "
+                         "original order, resume partial packs from "
+                         "their checkpoints (bit-identical to an "
+                         "uninterrupted server)")
+    sv.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                    help="per-pack checkpoint directory (default: "
+                         "<journal>.ckpt when journaling)")
+    sv.add_argument("--checkpoint-every", type=_positive, default=4096,
+                    help="pack checkpoint cadence in permutations (how "
+                         "much re-compute a SIGKILL can cost)")
+    sv.add_argument("--brownout-enter-s", type=float, default=None,
+                    help="enter brownout load shedding when the "
+                         "estimated backlog drain time exceeds this "
+                         "(default: disabled)")
+    sv.add_argument("--brownout-exit-s", type=float, default=None,
+                    help="exit brownout below this estimate (default: "
+                         "half of --brownout-enter-s)")
+    sv.add_argument("--brownout-rate", type=float, default=None,
+                    help="assumed steady-state perms/s before the server "
+                         "has measured its own (else the perf ledger's "
+                         "serve history is consulted)")
     ch = sub.add_parser(
         "chaos",
         help="deterministic elastic-recovery drill (ISSUE 6): run a toy "
@@ -236,6 +441,16 @@ def main(argv=None) -> int:
                          "temp file, removed after the run)")
     ch.add_argument("--json", action="store_true",
                     help="print the summary dict as one JSON line")
+    ch.add_argument("--serve", action="store_true",
+                    help="serving chaos drill (ISSUE 10): boot the real "
+                         "daemon with a plan-injected SIGKILL mid-pack, "
+                         "restart it with --recover, and assert every "
+                         "journaled request completes bit-identically "
+                         "vs an unkilled baseline")
+    ch.add_argument("--requests", type=_positive, default=3,
+                    help="[--serve] concurrent requests in the drill")
+    ch.add_argument("--chunk", type=_positive, default=16,
+                    help="[--serve] served EngineConfig.chunk_size")
     args = ap.parse_args(argv)
     if args.cmd is None:
         # bare invocation = selftest with its own argparse defaults (ONE
@@ -349,6 +564,8 @@ def main(argv=None) -> int:
         return serve_daemon(args)
 
     if args.cmd == "chaos":
+        if args.serve:
+            return _chaos_serve(args)
         return _chaos(args)
 
     import netrep_tpu

@@ -17,7 +17,8 @@ Surface::
 Daemon: ``python -m netrep_tpu serve --socket /tmp/netrep.sock``.
 """
 
-from .client import InProcessClient, SocketClient
+from .client import InProcessClient, ServeRejected, SocketClient, retry_delay
+from .journal import RequestJournal
 from .packer import PackedEngine, PackMonitor, RequestPlan, run_pack
 from .pool import ProgramPool
 from .scheduler import (
@@ -29,7 +30,9 @@ __all__ = [
     "ServeConfig",
     "ServeError",
     "QueueFull",
+    "ServeRejected",
     "Request",
+    "RequestJournal",
     "InProcessClient",
     "SocketClient",
     "ProgramPool",
@@ -37,4 +40,5 @@ __all__ = [
     "PackMonitor",
     "RequestPlan",
     "run_pack",
+    "retry_delay",
 ]

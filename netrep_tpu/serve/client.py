@@ -8,14 +8,57 @@
 - :class:`SocketClient` — line-delimited JSON over the daemon's unix
   socket (:mod:`netrep_tpu.serve.server`); arrays travel as nested
   lists, responses come back with arrays re-materialized as numpy.
+
+Retry-with-backoff (ISSUE 10): both clients' ``analyze`` take
+``retries=N``. Every attempt of one logical request carries the SAME
+idempotency key (auto-generated when the caller passes none), so a retry
+after a ``QueueFull``/brownout rejection, a dropped connection, or a
+server restart can never recompute or double-run: the server attaches
+the retry to the in-flight request or answers from the journaled result.
+Backoff is exponential with DETERMINISTIC jitter — the
+:mod:`netrep_tpu.utils.faults` convention: the jitter factor hashes
+``(key, attempt)``, so a rerun of the same client schedule sleeps the
+same delays. A server-supplied ``retry_after_s`` hint (the brownout
+drain estimate) takes precedence over the computed delay.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import socket
+import time
+import uuid
 
 from .protocol import decode_arrays, encode_arrays
+
+
+class ServeRejected(RuntimeError):
+    """The daemon refused the request with a retryable rejection
+    (``QueueFull``/brownout): back off — ``retry_after_s`` is the
+    server's drain-time hint when it has one."""
+
+    def __init__(self, msg: str, retry_after_s: float | None = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+def retry_delay(attempt: int, token: str, base_s: float = 0.25,
+                factor: float = 2.0, max_s: float = 10.0,
+                jitter: float = 0.25) -> float:
+    """Exponential backoff with deterministic jitter, per the
+    ``utils/faults.py`` convention: the jitter hashes ``(token,
+    attempt)`` so identical retry schedules sleep identically (no hidden
+    RNG state — reproducible load-generator traces)."""
+    d = min(max_s, base_s * factor ** (max(1, attempt) - 1))
+    if jitter:
+        h = int.from_bytes(
+            hashlib.blake2b(f"{token}:{attempt}".encode(),
+                            digest_size=8).digest(),
+            "big",
+        )
+        d *= 1.0 + jitter * (h / float(2 ** 64) * 2.0 - 1.0)
+    return max(0.0, d)
 
 
 class InProcessClient:
@@ -52,9 +95,31 @@ class InProcessClient:
         return self.server.wait(handle, timeout=timeout)
 
     def analyze(self, tenant: str, discovery: str, test, *,
-                timeout: float | None = None, **kw) -> dict:
-        return self.server.analyze(tenant, discovery, test,
-                                   timeout=timeout, **kw)
+                timeout: float | None = None, retries: int = 0,
+                retry_base_s: float = 0.25, sleep=time.sleep,
+                **kw) -> dict:
+        """Blocking submit + wait. With ``retries`` > 0, an admission
+        rejection (``QueueFull``, incl. brownout shedding) is retried
+        with deterministic backoff under ONE idempotency key — the
+        server's ``retry_after_s`` hint, when present, wins over the
+        computed delay. Safe by construction: the key dedups every
+        attempt onto one computation."""
+        from .scheduler import QueueFull
+
+        key = kw.setdefault("idempotency_key", f"c-{uuid.uuid4().hex}")
+        attempt = 0
+        while True:
+            try:
+                return self.server.analyze(tenant, discovery, test,
+                                           timeout=timeout, **kw)
+            except QueueFull as e:
+                attempt += 1
+                if attempt > retries:
+                    raise
+                delay = retry_delay(attempt, key, base_s=retry_base_s)
+                if e.retry_after_s is not None:
+                    delay = max(delay, float(e.retry_after_s))
+                sleep(delay)
 
     def metrics(self) -> str:
         return self.server.metrics_text()
@@ -69,10 +134,24 @@ class SocketClient:
 
     def __init__(self, path: str, timeout: float = 120.0):
         self.path = path
+        self._timeout = timeout
+        self._connect()
+
+    def _connect(self) -> None:
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.settimeout(timeout)
-        self._sock.connect(path)
+        self._sock.settimeout(self._timeout)
+        self._sock.connect(self.path)
         self._rfile = self._sock.makefile("r", encoding="utf-8")
+
+    def reconnect(self) -> None:
+        """Drop and re-dial the socket — the retry path after the daemon
+        restarted (``serve --recover``); the idempotency key makes the
+        re-sent request safe."""
+        try:
+            self.close()
+        except OSError:
+            pass
+        self._connect()
 
     def request(self, op: str, **kw) -> dict:
         payload = encode_arrays({"op": op, **kw})
@@ -82,6 +161,11 @@ class SocketClient:
             raise ConnectionError("serve daemon closed the connection")
         resp = json.loads(line)
         if not resp.get("ok", False):
+            if resp.get("retryable"):
+                raise ServeRejected(
+                    resp.get("error", "serve daemon rejected the request"),
+                    retry_after_s=resp.get("retry_after_s"),
+                )
             raise RuntimeError(resp.get("error", "serve daemon error"))
         return decode_arrays(resp)
 
@@ -96,9 +180,35 @@ class SocketClient:
         return self.request("register", tenant=tenant, name=name,
                             **kw)["digest"]
 
-    def analyze(self, tenant: str, discovery: str, test, **kw) -> dict:
-        return self.request("analyze", tenant=tenant, discovery=discovery,
-                            test=test, **kw)["result"]
+    def analyze(self, tenant: str, discovery: str, test, *,
+                retries: int = 0, retry_base_s: float = 0.25,
+                sleep=time.sleep, **kw) -> dict:
+        """Blocking analyze over the socket. With ``retries`` > 0, a
+        retryable rejection (QueueFull/brownout — honoring the server's
+        ``retry_after_s`` hint) or a dropped/restarted daemon connection
+        is retried under ONE idempotency key: after a ``serve --recover``
+        boot the re-sent request is answered from the journal (or
+        attaches to its re-queued run) instead of recomputing."""
+        key = kw.setdefault("idempotency_key", f"c-{uuid.uuid4().hex}")
+        attempt = 0
+        while True:
+            try:
+                return self.request("analyze", tenant=tenant,
+                                    discovery=discovery, test=test,
+                                    **kw)["result"]
+            except (ServeRejected, ConnectionError, OSError) as e:
+                attempt += 1
+                if attempt > retries:
+                    raise
+                delay = retry_delay(attempt, key, base_s=retry_base_s)
+                if getattr(e, "retry_after_s", None) is not None:
+                    delay = max(delay, float(e.retry_after_s))
+                sleep(delay)
+                if not isinstance(e, ServeRejected):
+                    try:
+                        self.reconnect()
+                    except OSError:
+                        continue  # daemon still down — next attempt re-dials
 
     def metrics(self) -> str:
         return self.request("metrics")["text"]

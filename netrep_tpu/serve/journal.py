@@ -1,0 +1,198 @@
+"""Durable write-ahead request journal for `netrep serve` (ISSUE 10).
+
+PRs 4/6 made the *engine* survive anything short of losing the whole
+machine; this module extends the same durability contract up through the
+request/response layer. The serving daemon appends one fsynced JSON line
+per state transition, in the crash-safe style of
+:mod:`netrep_tpu.utils.telemetry` (append-only JSONL, a crash loses at
+most the in-flight line, torn final lines are tolerated on read):
+
+- ``accepted`` — written and **fsynced before the request is admitted**
+  to the queue: tenant, dataset names + content digests, the full
+  analyze params, the seed, and the client-supplied **idempotency key**
+  (auto-assigned when the client sends none). An accepted record with no
+  matching terminal record is, by definition, work the server still owes.
+- ``done`` / ``failed`` — the terminal record: the result digest and the
+  full wire-encoded result (``done``), or the error string (``failed``).
+  A ``done`` record is what a duplicate submission with the same
+  idempotency key is answered from after a restart — no recompute.
+- ``tenant`` / ``dataset`` — registrations, so ``--recover`` can rebuild
+  the server's dataset references without the clients re-uploading.
+  Fixture registrations journal their *parameters* (cheap, re-derivable);
+  inline registrations journal the encoded matrices (the wire payload).
+- ``drain_requeued`` — informational: a bounded SIGTERM drain ran out of
+  time and these accepted-but-unfinished keys exit the process as
+  journaled work, picked up by the next ``--recover`` boot.
+
+Recovery (:func:`scan` + ``PreservationServer`` replay) is deterministic:
+tenants and datasets re-register in journal order, completed results are
+loaded into the idempotency map, and every accepted-but-not-terminal
+request re-queues in original ``seq`` order — combined with the engine's
+mesh-shape-independent checkpoints and the serve layer's bit-identical
+packing, a ``SIGKILL`` mid-pack followed by ``serve --recover`` yields
+results bit-identical to an uninterrupted server.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+
+logger = logging.getLogger("netrep_tpu")
+
+#: journal line format version — every record carries it as ``jv`` (the
+#: discriminator that lets a journal share parsers with telemetry JSONL)
+JOURNAL_VERSION = 1
+
+#: record kinds with a terminal meaning for an accepted request
+TERMINAL_KINDS = ("done", "failed")
+
+
+def _json_default(v):
+    # numpy scalars/arrays ride journal records as plain JSON, same
+    # tolerance rule as the telemetry sink
+    if hasattr(v, "item") and not hasattr(v, "__len__"):
+        return v.item()
+    if hasattr(v, "tolist"):
+        return v.tolist()
+    return str(v)
+
+
+def result_digest(result: dict) -> str:
+    """Stable digest of a (wire-encoded) result payload — the ``done``
+    record's cheap identity, letting the recovery drill assert
+    "re-served == originally served" without diffing full arrays."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(json.dumps(result, sort_keys=True,
+                        default=_json_default).encode())
+    return h.hexdigest()
+
+
+class RequestJournal:
+    """Append-only fsynced journal writer.
+
+    Unlike the telemetry sink (flush-only — losing a trailing event is
+    acceptable), ``accepted`` records are the server's promise to the
+    client, so every append is ``flush`` + ``os.fsync``: when ``submit``
+    returns, the request survives a ``SIGKILL``. Thread-safe (the
+    scheduler appends under its own lock, the transports may not).
+    A dead sink (full disk, revoked path) raises — accepting work that
+    cannot be journaled would silently void the durability contract.
+    """
+
+    def __init__(self, path: str | os.PathLike):
+        self.path = os.fspath(path)
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        self._lock = threading.Lock()
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def append(self, kind: str, **fields) -> dict:
+        """Append one fsynced record; returns it."""
+        rec = {"jv": JOURNAL_VERSION, "t": time.time(),
+               "kind": str(kind), **fields}
+        line = json.dumps(rec, default=_json_default) + "\n"
+        with self._lock:
+            if self._fh is None:
+                raise OSError(f"journal {self.path!r} is closed")
+            self._fh.write(line)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        return rec
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+def read_records(path: str):
+    """Stream the journal's records, skipping anything that is not a
+    schema-matching line — in particular the torn final line a crash mid-
+    append leaves behind (same tolerance as the telemetry reader)."""
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn/corrupt line — tolerated by design
+            if (isinstance(rec, dict) and rec.get("jv") == JOURNAL_VERSION
+                    and isinstance(rec.get("kind"), str)):
+                yield rec
+
+
+def scan(path: str) -> dict:
+    """Fold a journal into the recovery state the server replays:
+
+    - ``tenants``: ``{name: weight}`` in first-seen order;
+    - ``datasets``: the dataset/fixture registration records, in order;
+    - ``results``: ``{idempotency_key: done record}`` — completed work a
+      duplicate submission is answered from without recomputing;
+    - ``failed``: ``{idempotency_key: failed record}`` — terminal, never
+      re-queued (a deadline miss must not resurrect on restart);
+    - ``pending``: accepted records with **no terminal record**, in
+      original ``seq`` order — the work the restarted server re-queues.
+    """
+    tenants: dict[str, int] = {}
+    datasets: list[dict] = []
+    accepted: dict[str, dict] = {}
+    results: dict[str, dict] = {}
+    failed: dict[str, dict] = {}
+    drain_requeued = 0
+    for rec in read_records(path):
+        kind = rec["kind"]
+        if kind == "tenant":
+            tenants[str(rec["tenant"])] = int(rec.get("weight", 1))
+        elif kind == "dataset":
+            tenants.setdefault(str(rec["tenant"]), 1)
+            datasets.append(rec)
+        elif kind == "accepted":
+            key = str(rec.get("key"))
+            accepted[key] = rec
+        elif kind == "done":
+            results[str(rec.get("key"))] = rec
+        elif kind == "failed":
+            failed[str(rec.get("key"))] = rec
+        elif kind == "drain_requeued":
+            drain_requeued += len(rec.get("keys", []))
+    pending = sorted(
+        (rec for key, rec in accepted.items()
+         if key not in results and key not in failed),
+        key=lambda r: int(r.get("seq", 0)),
+    )
+    return {
+        "tenants": tenants,
+        "datasets": datasets,
+        "accepted": accepted,
+        "results": results,
+        "failed": failed,
+        "pending": pending,
+        "n_accepted": len(accepted),
+        "n_drain_requeued": drain_requeued,
+    }
+
+
+def pack_checkpoint_path(ckpt_dir: str, cfg_id: str, members) -> str:
+    """Deterministic per-pack checkpoint path: a digest of the member
+    requests' durable identities (journal key, seed, n_perm, plan
+    signature) plus the engine-config identity. The same requests
+    re-queued by ``--recover`` re-form the same pack and find the same
+    checkpoint; any other composition hashes elsewhere and simply
+    recomputes (recovery parity never depends on the resume firing — the
+    checkpoint is a work-saving optimization, bit-identical either way)."""
+    h = hashlib.blake2b(digest_size=12)
+    h.update(cfg_id.encode())
+    for key, seed, n_perm, sig in sorted(members):
+        h.update(f"|{key}:{seed}:{n_perm}:{sig}".encode())
+    return os.path.join(ckpt_dir, f"pack_{h.hexdigest()}.npz")

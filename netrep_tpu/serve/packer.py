@@ -84,6 +84,12 @@ class RequestPlan:
     adaptive: bool = False
     rule: object | None = None
     base: int = 0
+    #: monotonic deadline (ISSUE 10): when set, the pack monitor cancels
+    #: this request's still-active modules at the first chunk boundary
+    #: past it (``StopMonitor.force_retire`` — the same retirement
+    #: re-bucketing exit a statistical decision takes, so pack survivors
+    #: are untouched); None = never expires (the PR 7 behavior)
+    deadline: float | None = None
 
     @property
     def k(self) -> int:
@@ -358,10 +364,27 @@ class PackMonitor:
     The pack keeps running while any request still owes permutations;
     ``n_used`` records each module's per-request permutation count for
     the sequential p-values.
+
+    Deadline enforcement (ISSUE 10): a plan with ``deadline`` set is
+    checked against ``clock()`` at every chunk boundary; once past it,
+    the request's still-active modules are force-retired (they stop
+    consuming dispatches; pack survivors are unaffected) and the plan's
+    index lands in :attr:`expired` with its deadline miss — the
+    scheduler cancels the request instead of returning a result.
+
+    Checkpointing (ISSUE 10): :meth:`state_arrays`/:meth:`restore_state`
+    ride the engine checkpoint's ``extra`` channel exactly like
+    :class:`StopMonitor` does for solo adaptive runs, so a ``SIGKILL``
+    mid-pack resumes from the last chunk boundary — per-request child
+    monitors are namespaced ``g<i>_*`` inside the pack's state.
     """
 
-    def __init__(self, plans: list[RequestPlan], observed: np.ndarray):
+    def __init__(self, plans: list[RequestPlan], observed: np.ndarray,
+                 clock=None):
+        import time as _time
+
         self.plans = plans
+        self.clock = clock if clock is not None else _time.monotonic
         self.observed = np.asarray(observed, dtype=np.float64)
         self.n_modules = sum(p.k for p in plans)
         if self.observed.shape[0] != self.n_modules:
@@ -373,6 +396,8 @@ class PackMonitor:
         self.n_used = np.zeros(self.n_modules, dtype=np.int64)
         self.folded = 0
         self.telemetry = None
+        #: plan index -> seconds past its deadline when it was cancelled
+        self.expired: dict[int, float] = {}
         self.children: list[StopMonitor | None] = []
         for p in plans:
             if p.adaptive:
@@ -433,27 +458,119 @@ class PackMonitor:
                     self.active[still] = False
                     newly.append(still)
         self.folded = done0 + int(take)
+        # deadline sweep (ISSUE 10): pack boundaries are the cancellation
+        # points — an expired request's surviving modules leave the shared
+        # dispatch through the same force_retire exit the ceiling uses,
+        # so the pack's other members are bit-identically unaffected
+        now = self.clock()
+        for gi, p in enumerate(self.plans):
+            if p.deadline is None or gi in self.expired or now <= p.deadline:
+                continue
+            span = np.arange(p.base, p.base + p.k)
+            still = span[self.active[span]]
+            if still.size:
+                self.active[still] = False
+                newly.append(still)
+                self.expired[gi] = now - p.deadline
         if newly:
             return np.concatenate(newly)
         return np.empty(0, dtype=np.int64)
 
+    # -- checkpoint state (ISSUE 10) ---------------------------------------
+
+    def state_arrays(self) -> dict[str, np.ndarray]:
+        """Checkpointable pack state — the union tallies plus each
+        adaptive child's own state under a ``g<i>_`` namespace (the
+        checkpoint ``extra`` channel, same contract as
+        :meth:`StopMonitor.state_arrays`)."""
+        exp = sorted(self.expired)
+        out = {
+            "pack_active": self.active,
+            "pack_n_used": self.n_used,
+            "pack_folded": np.int64(self.folded),
+            "pack_expired": np.asarray(exp, dtype=np.int64),
+            "pack_expired_miss": np.asarray(
+                [self.expired[g] for g in exp], dtype=np.float64
+            ),
+        }
+        for g, child in enumerate(self.children):
+            if child is not None:
+                for k, v in child.state_arrays().items():
+                    out[f"g{g}_{k}"] = v
+        return out
+
+    def restore_state(self, extras: dict) -> None:
+        """Restore from checkpoint extras (shape-checked); expired plans
+        STAY cancelled across the restart — a request whose deadline was
+        missed before the crash must not resurrect as a success."""
+        try:
+            active = extras["pack_active"]
+            n_used = extras["pack_n_used"]
+            folded = extras["pack_folded"]
+        except KeyError:
+            raise ValueError(
+                "checkpoint has no pack-monitor state (it was written by "
+                "a non-packed run); refusing to resume"
+            ) from None
+        if np.asarray(active).shape != self.active.shape:
+            raise ValueError(
+                "checkpoint pack state has a different module count; "
+                "refusing to resume"
+            )
+        self.active = np.asarray(active, dtype=bool)
+        self.n_used = np.asarray(n_used, dtype=np.int64)
+        self.folded = int(folded)
+        self.expired = {
+            int(g): float(m)
+            for g, m in zip(np.asarray(extras.get("pack_expired", []),
+                                       dtype=np.int64).ravel(),
+                            np.asarray(extras.get("pack_expired_miss", []),
+                                       dtype=np.float64).ravel())
+        }
+        for g, child in enumerate(self.children):
+            if child is None:
+                continue
+            prefix = f"g{g}_"
+            child.restore_state({
+                k[len(prefix):]: v for k, v in extras.items()
+                if k.startswith(prefix)
+            })
+
 
 def run_pack(engine: PackedEngine, plans: list[RequestPlan],
-             telemetry=None, fault_policy=None, progress=None) -> list[dict]:
+             telemetry=None, fault_policy=None, progress=None,
+             checkpoint_path=None, checkpoint_every: int = 8192,
+             clock=None) -> list[dict]:
     """Execute one pack: shared observed pass, monitored null over the
     union buckets, then per-request result extraction. Returns one result
     dict per plan (same order) with the exact numbers the stand-alone
-    ``module_preservation()`` call produces for that request's seed."""
+    ``module_preservation()`` call produces for that request's seed.
+
+    ``checkpoint_path`` (ISSUE 10) threads the pack through the engine's
+    chunk-boundary checkpoint machinery: a crash mid-pack resumes from
+    the last saved boundary bit-identically (the pack monitor's state
+    rides the checkpoint extras). A plan cancelled by its deadline comes
+    back with ``"expired"``/``"deadline_miss_s"`` set instead of being a
+    valid result — the scheduler fails it as a deadline miss."""
     observed = np.asarray(engine.observed(), dtype=np.float64)
-    monitor = PackMonitor(plans, observed)
+    monitor = PackMonitor(plans, observed, clock=clock)
     n_perm_max = max(p.n_perm for p in plans)
     seeds = [p.seed for p in plans]
     nulls, completed, finished = engine.run_null_monitored(
         n_perm_max, seeds, monitor, progress=progress,
         telemetry=telemetry, fault_policy=fault_policy,
+        checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
     )
     out = []
-    for p in plans:
+    for gi, p in enumerate(plans):
+        if gi in monitor.expired:
+            out.append({
+                "expired": True,
+                "deadline_miss_s": float(monitor.expired[gi]),
+                "n_perm": int(p.n_perm),
+                "completed": int(min(monitor.folded, p.n_perm)),
+            })
+            continue
         obs_r = observed[p.base: p.base + p.k]
         nulls_r = nulls[: p.n_perm, p.base: p.base + p.k, :]
         total_space = pv.total_permutations(p.pool.size, p.sizes)

@@ -22,7 +22,14 @@ definition)::
                        never share a pack (ISSUE 9)
     analyze            blocking preservation request (tenant, discovery,
                        test | [tests...], modules?, n_perm?, seed,
-                       alternative?, adaptive?, deadline_s?, timeout?)
+                       alternative?, adaptive?, deadline_s?, timeout?,
+                       idempotency_key?) — the idempotency key (ISSUE
+                       10) is the request's durable identity: a
+                       duplicate submission attaches to the in-flight
+                       run or is answered from the journaled result,
+                       never recomputed; ``deadline_s`` is ENFORCED
+                       (expired requests are cancelled at pack
+                       boundaries with ``request_expired``)
     metrics            Prometheus text exposition (the /metrics surface)
     stats              queue/pool/tenant counters as JSON
     shutdown           initiate the graceful drain (same path as SIGTERM)
@@ -57,8 +64,9 @@ def encode_arrays(obj):
 
 def decode_arrays(obj):
     """Inverse of :func:`encode_arrays` for result payloads: the
-    :data:`ARRAY_KEYS` fields (including inside per-test sub-results)
-    come back as numpy arrays."""
+    :data:`ARRAY_KEYS` fields (including inside nested payloads — the
+    wire response wraps the result one level down, and multi-test
+    results carry per-test sub-results) come back as numpy arrays."""
     if isinstance(obj, dict):
         out = {}
         for k, v in obj.items():
@@ -66,6 +74,8 @@ def decode_arrays(obj):
                 out[k] = np.asarray(v)
             elif k == "tests" and isinstance(v, list):
                 out[k] = [decode_arrays(t) for t in v]
+            elif isinstance(v, dict):
+                out[k] = decode_arrays(v)
             else:
                 out[k] = v
         return out

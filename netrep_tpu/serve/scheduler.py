@@ -32,6 +32,27 @@ Scheduling policy:
   solo, so one tenant's poisoned request (or a device loss mid-pack)
   fails alone — the queue and the other tenants' work survive.
 
+Crash-safe serving (ISSUE 10) extends the policy above with the
+durability the engine layer already has:
+
+- **write-ahead journal** (:mod:`netrep_tpu.serve.journal`): every
+  admission is an fsynced ``accepted`` record before it enters the
+  queue, every completion a ``done``/``failed`` record — ``--recover``
+  replays the journal, re-registers datasets, answers duplicates from
+  journaled results, and re-queues unfinished work in original order,
+  resuming partial packs from per-pack checkpoints bit-identically;
+- **idempotency keys**: a duplicate submission with a seen key attaches
+  to the in-flight request or returns the completed result
+  (``request_deduped``) — client retry-with-backoff is safe by
+  construction;
+- **deadline enforcement**: expired requests are cancelled at pack
+  boundaries via the same ``force_retire`` retirement re-bucketing a
+  statistical decision takes (``request_expired``; survivors unaffected);
+- **brownout load shedding**: past an estimated backlog drain time the
+  server sheds the newest requests of the lowest-weight tenants with a
+  ``retry_after_s`` hint (``serve_brownout_enter``/``exit``) instead of
+  hitting the ``QueueFull`` cliff.
+
 The whole ops surface is the telemetry bus: a server-lifetime
 ``serve_start``/``serve_end`` span, per-request
 ``request_received``/``request_done`` spans (latency = span duration),
@@ -43,11 +64,16 @@ labels, and Prometheus exposition (:meth:`PreservationServer
 from __future__ import annotations
 
 import dataclasses
+import logging
+import os
 import threading
 import time
+import uuid
 from typing import Sequence
 
 import numpy as np
+
+logger = logging.getLogger("netrep_tpu")
 
 from ..models import dataset as ds
 from ..models.preservation import _overlap_setup
@@ -55,7 +81,8 @@ from ..ops import pvalues as pv
 from ..utils import telemetry as tm
 from ..utils.checkpoint import content_digest
 from ..utils.config import EngineConfig
-from ..utils.faults import resolve_runtime
+from ..utils.faults import SimulatedCrash, resolve_runtime
+from . import journal as jnl
 from .packer import PackedEngine, PackMonitor, RequestPlan, assign_bases, run_pack
 from .pool import ProgramPool
 
@@ -66,8 +93,14 @@ class ServeError(RuntimeError):
 
 class QueueFull(ServeError):
     """Admission control rejected the request: the tenant's queue is at
-    its bound — back off and retry (the service sheds load instead of
-    growing unbounded latency)."""
+    its bound (or the service is in a brownout and shedding load) — back
+    off and retry. ``retry_after_s`` (ISSUE 10), when the server can
+    estimate its backlog drain time, is the client's hint for WHEN —
+    predictable shedding instead of a hard cliff."""
+
+    def __init__(self, msg: str, retry_after_s: float | None = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 @dataclasses.dataclass
@@ -98,6 +131,44 @@ class ServeConfig:
     slo_s: float = 60.0
     fault_policy: object = None
     telemetry: object = None
+    # -- crash-safe serving (ISSUE 10) ----------------------------------
+    #: write-ahead journal path; None = journaling off (behavior-identical
+    #: to PR 7 serving — no fsyncs, no dedup map persistence)
+    journal: str | None = None
+    #: replay ``journal`` on boot: re-register datasets, load completed
+    #: results into the idempotency map, re-queue accepted-but-unfinished
+    #: requests in original order (``serve --recover``)
+    recover: bool = False
+    #: per-pack checkpoint directory; default (None) derives
+    #: ``<journal>.ckpt`` when journaling is on, so a SIGKILL mid-pack
+    #: resumes from the last chunk boundary instead of recomputing
+    checkpoint_dir: str | None = None
+    #: chunk-boundary checkpoint cadence for packed runs (permutations)
+    checkpoint_every: int = 4096
+    #: enforce request deadlines (submit + slo_s, or the explicit
+    #: ``deadline_s``): expired requests are cancelled at pack boundaries
+    #: via retirement re-bucketing (``request_expired``); False restores
+    #: the PR 7 sort-key-only semantics
+    enforce_deadlines: bool = True
+    #: brownout admission control: when the estimated backlog drain time
+    #: exceeds this, the server sheds new load from the lowest-weight
+    #: tenants with a ``retry_after_s`` hint; None disables (PR 7
+    #: behavior). Exit at ``brownout_exit_s`` (default: half of enter —
+    #: hysteresis so the state cannot flap every submit)
+    brownout_enter_s: float | None = None
+    brownout_exit_s: float | None = None
+    #: assumed steady-state throughput (perms/s) before the server has
+    #: measured its own; falls back to the perf ledger's serve history,
+    #: else brownout stays off until a measurement exists
+    brownout_rate_pps: float | None = None
+    #: optional brownout degradation: cap admitted requests' n_perm at
+    #: this while browned out (EXPLICITLY changes results — an opt-in
+    #: graceful-degradation knob, off by default)
+    brownout_nperm_cap: int | None = None
+    #: completed requests kept in the in-memory idempotency map (oldest
+    #: evicted beyond this; in-flight requests never evict) — a duplicate
+    #: of an evicted key recomputes, deterministically, to the same result
+    idem_cache: int = 4096
 
 
 @dataclasses.dataclass
@@ -118,6 +189,10 @@ class Request:
     seq: int
     sid: str | None = None          # telemetry span id
     solo_only: bool = False
+    #: durable identity in the write-ahead journal (ISSUE 10): the
+    #: client-supplied idempotency key, or an auto-assigned one; stable
+    #: across restarts (recovery re-queues under the original key)
+    journal_key: str | None = None
     done: threading.Event = dataclasses.field(
         default_factory=threading.Event
     )
@@ -142,6 +217,7 @@ class _Tenant:
         self.pending: list[Request] = []
         self.counters = {
             "received": 0, "done": 0, "failed": 0, "rejected": 0,
+            "expired": 0, "deduped": 0,
         }
 
 
@@ -180,14 +256,35 @@ class PreservationServer:
         self._started_m = time.monotonic()
         self.pool = ProgramPool(self.config.pool_size)
         self._engine_cfg_id = repr(self.config.engine)
+        # -- crash-safe serving state (ISSUE 10) --------------------------
+        #: idempotency map: journal key -> Request (in-flight requests are
+        #: attached to; completed ones answer duplicates from their result)
+        self._idem: dict[str, Request] = {}
+        #: completed keys in retirement order (bounds the map's memory)
+        self._idem_done: list[str] = []
+        self._replaying = False
+        self._fixture_depth = 0
+        self._last_drain_requeued = 0
+        self._brownout = False
+        self._served_perms = 0.0     # measured steady-state rate inputs
+        self._busy_s = 0.0
+        self.journal: jnl.RequestJournal | None = None
+        self._ckpt_dir = self.config.checkpoint_dir
+        if self.config.journal:
+            if self._ckpt_dir is None:
+                self._ckpt_dir = self.config.journal + ".ckpt"
+            self.journal = jnl.RequestJournal(self.config.journal)
         self._serve_sid = None
         if self.tel is not None:
             self._serve_sid = self.tel.begin_span(
                 "serve_start", max_queue=self.config.max_queue,
                 max_pack=self.config.max_pack,
                 pool_size=self.config.pool_size,
+                journal=bool(self.journal),
             )
         self._worker: threading.Thread | None = None
+        if self.config.recover and self.config.journal:
+            self._recover()
         if start:
             self.start()
 
@@ -204,7 +301,14 @@ class PreservationServer:
     def close(self, drain: bool = True, timeout: float | None = None) -> None:
         """Graceful shutdown: stop accepting, optionally finish every
         queued request (the SIGTERM drain protocol), stop the worker,
-        release pooled engines, close the telemetry span/bus."""
+        release pooled engines, close the telemetry span/bus.
+
+        ``timeout`` bounds the drain (ISSUE 10): queued work that cannot
+        finish in time is NOT dropped silently — with a journal attached
+        its keys are recorded as ``drain_requeued`` (they are already
+        ``accepted``-but-unfinished, so the next ``--recover`` boot picks
+        them up) and each local waiter is unblocked with a distinctive
+        error naming the journaled restart path."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._work:
             self._accepting = False
@@ -217,24 +321,160 @@ class PreservationServer:
                     self._work.wait(0.25)
         with self._work:
             self._stop = True
+            remainder = [
+                r for t in self._tenants.values() for r in t.pending
+            ]
+            for t in self._tenants.values():
+                t.pending.clear()
             self._work.notify_all()
         if self._worker is not None:
             self._worker.join(timeout=10.0)
             self._worker = None
+        requeued = self._last_drain_requeued = len(remainder)
+        if remainder:
+            if self.journal is not None:
+                self.journal.append(
+                    "drain_requeued",
+                    keys=[r.journal_key for r in remainder],
+                )
+            for r in remainder:
+                r.error = (
+                    "drain timeout: request journaled as requeued-on-"
+                    "restart (serve --recover completes it)"
+                    if self.journal is not None
+                    else "drain timeout: request dropped (no journal)"
+                )
+                r.done.set()
         self.pool.clear()
         if self.tel is not None:
             done = sum(t.counters["done"] for t in self._tenants.values())
             fail = sum(t.counters["failed"] for t in self._tenants.values())
-            dropped = sum(len(t.pending) for t in self._tenants.values())
             self.tel.end_span(
                 self._serve_sid, "serve_end", drained=bool(drain),
                 requests_done=done, requests_failed=fail,
-                requests_dropped=dropped,
+                requests_requeued=requeued,
                 s=time.monotonic() - self._started_m,
                 **self.pool.stats(),
             )
             if self._tel_owned:
                 self.tel.close()
+        if self.journal is not None:
+            self.journal.close()
+
+    # -- restart recovery (ISSUE 10) ---------------------------------------
+
+    def _recover(self) -> None:
+        """Replay the write-ahead journal on boot (``serve --recover``):
+        re-register tenants and dataset references, load completed (and
+        terminally failed) requests into the idempotency map so
+        duplicates are answered without recomputing, and re-queue every
+        accepted-but-unfinished request in original ``seq`` order —
+        combined with the per-pack checkpoints, a killed server resumes
+        to results bit-identical to an uninterrupted one."""
+        from .protocol import decode_arrays
+
+        path = self.config.journal
+        if not os.path.exists(path):
+            return
+        state = jnl.scan(path)
+        self._replaying = True
+        try:
+            for name, weight in state["tenants"].items():
+                self.register_tenant(name, weight)
+            for rec in state["datasets"]:
+                pl = rec.get("payload") or {}
+                if rec.get("form") == "fixture":
+                    self.register_fixture(
+                        str(rec["tenant"]), str(pl.get("prefix", "fx")),
+                        genes=int(pl["genes"]), modules=int(pl["modules"]),
+                        n_samples=int(pl["n_samples"]),
+                        seed=int(pl["seed"]),
+                    )
+                else:
+                    beta = pl.get("beta")
+                    self.register_dataset(
+                        str(rec["tenant"]), str(rec["name"]),
+                        network=(np.asarray(pl["network"], dtype=np.float64)
+                                 if pl.get("network") is not None else None),
+                        correlation=(
+                            np.asarray(pl["correlation"], dtype=np.float64)
+                            if pl.get("correlation") is not None else None),
+                        data=(np.asarray(pl["data"], dtype=np.float64)
+                              if pl.get("data") is not None else None),
+                        assignments=pl.get("assignments"),
+                        beta=tuple(beta) if isinstance(beta, list) else beta,
+                    )
+            # terminal records -> idempotency map: a duplicate of a
+            # completed request gets the journaled result, of a failed
+            # one its error — never a recompute
+            for key, rec in state["results"].items():
+                acc = state["accepted"].get(key) or {}
+                req = self._terminal_request(key, rec, acc)
+                req.result = decode_arrays(rec.get("result") or {})
+                req.done.set()
+                self._idem[key] = req
+                self._retire_idem(req)
+            for key, rec in state["failed"].items():
+                acc = state["accepted"].get(key) or {}
+                req = self._terminal_request(key, rec, acc)
+                req.error = str(rec.get("error", "failed before restart"))
+                req.done.set()
+                self._idem[key] = req
+                self._retire_idem(req)
+            requeued = 0
+            for rec in state["pending"]:
+                params = rec.get("params") or {}
+                try:
+                    self.submit(
+                        str(rec["tenant"]), str(rec["discovery"]),
+                        rec["test"],
+                        modules=params.get("modules"),
+                        n_perm=params.get("n_perm"),
+                        seed=int(params.get("seed") or 0),
+                        alternative=params.get("alternative", "greater"),
+                        adaptive=bool(params.get("adaptive", False)),
+                        deadline_s=params.get("deadline_s"),
+                        idempotency_key=str(rec.get("key")),
+                    )
+                    requeued += 1
+                except ServeError as e:
+                    # an unreplayable request (e.g. its dataset record is
+                    # torn) must not resurrect on every boot: journal it
+                    # terminally failed and move on
+                    logger.warning("journal replay: request %s failed to "
+                                   "re-queue: %s", rec.get("id"), e)
+                    if self.journal is not None:
+                        self.journal.append(
+                            "failed", seq=rec.get("seq"), id=rec.get("id"),
+                            key=rec.get("key"), error=f"replay: {e}",
+                        )
+        finally:
+            self._replaying = False
+        if self.tel is not None:
+            self.tel.emit(
+                "journal_replayed", parent=self._serve_sid,
+                tenants=len(state["tenants"]),
+                datasets=len(state["datasets"]),
+                results=len(state["results"]),
+                failed=len(state["failed"]),
+                requeued=requeued,
+            )
+
+    @staticmethod
+    def _terminal_request(key: str, rec: dict, acc: dict) -> Request:
+        """A done-shaped Request rebuilt from journal records (no plan —
+        it never runs again; it only answers duplicate submissions)."""
+        params = acc.get("params") or {}
+        return Request(
+            id=str(rec.get("id") or acc.get("id") or key),
+            tenant=str(acc.get("tenant", "")),
+            discovery=str(acc.get("discovery", "")),
+            test=acc.get("test"),
+            seed=int(params.get("seed") or 0),
+            adaptive=bool(params.get("adaptive", False)),
+            plan=None, pack_key=None, deadline=0.0, submitted_m=0.0,
+            seq=int(acc.get("seq") or 0), journal_key=key,
+        )
 
     # -- registration ------------------------------------------------------
 
@@ -250,6 +490,9 @@ class PreservationServer:
                 for _ in range(self._tenants[n].weight)
             ]
             self._rr_pos %= max(1, len(self._rr))
+        if self.journal is not None and not self._replaying:
+            self.journal.append("tenant", tenant=name,
+                                weight=max(1, int(weight)))
 
     def register_dataset(self, tenant: str, name: str, *, network=None,
                          correlation=None, data=None, assignments=None,
@@ -309,6 +552,24 @@ class PreservationServer:
                 name, dataset, norm, digest,
                 beta=beta if data_only else None,
             )
+        if (self.journal is not None and not self._replaying
+                and not self._fixture_depth):
+            # the durable dataset reference recovery re-registers from:
+            # inline payloads journal their (encoded) matrices — the same
+            # bytes the wire carried in — so `serve --recover` needs no
+            # client re-upload (fixtures journal parameters instead, via
+            # register_fixture)
+            from .protocol import encode_arrays
+
+            self.journal.append(
+                "dataset", tenant=tenant, name=name, form="inline",
+                digest=digest,
+                payload=encode_arrays(dict(
+                    network=network, correlation=correlation, data=data,
+                    assignments=assignments,
+                    beta=list(beta) if isinstance(beta, tuple) else beta,
+                )),
+            )
         return digest
 
     def register_fixture(self, tenant: str, prefix: str = "fx", *,
@@ -328,10 +589,24 @@ class PreservationServer:
             for i in idx:
                 assign[f"node_{i}"] = str(lab)
         d_name, t_name = f"{prefix}_d", f"{prefix}_t"
-        self.register_dataset(tenant, d_name, network=dn, correlation=dc,
-                              data=dd, assignments=assign)
-        self.register_dataset(tenant, t_name, network=tn, correlation=tc,
-                              data=td)
+        # journal the fixture by PARAMETERS (re-derivable, cheap) rather
+        # than as two inline matrix payloads
+        if self.journal is not None and not self._replaying:
+            self.journal.append(
+                "dataset", tenant=tenant, name=prefix, form="fixture",
+                payload=dict(prefix=prefix, genes=int(genes),
+                             modules=int(modules), n_samples=int(n_samples),
+                             seed=int(seed)),
+            )
+        self._fixture_depth += 1
+        try:
+            self.register_dataset(tenant, d_name, network=dn,
+                                  correlation=dc, data=dd,
+                                  assignments=assign)
+            self.register_dataset(tenant, t_name, network=tn,
+                                  correlation=tc, data=td)
+        finally:
+            self._fixture_depth -= 1
         return {"discovery": d_name, "test": t_name,
                 "labels": [str(lab) for lab, _ in mixed["specs"]]}
 
@@ -380,17 +655,114 @@ class PreservationServer:
             adaptive=bool(adaptive), rule=rule,
         )
 
+    def _dedup_locked(self, key: str | None) -> Request | None:
+        """Idempotency lookup (caller holds the lock): a seen key returns
+        the original request — attaching to it while in flight, answering
+        from its stored/journaled result after completion — instead of
+        ever recomputing (the contract that makes client
+        retry-with-backoff safe by construction)."""
+        if key is None:
+            return None
+        req = self._idem.get(key)
+        if req is None:
+            return None
+        state = "completed" if req.done.is_set() else "inflight"
+        ten = self._tenants.get(req.tenant)
+        if ten is not None:
+            ten.counters["deduped"] += 1
+        if self.tel is not None:
+            self.tel.emit("request_deduped", tenant=req.tenant, key=key,
+                          state=state, parent=req.sid)
+        return req
+
+    # -- overload / brownout (ISSUE 10) ------------------------------------
+
+    def _req_nperm(self, req: Request) -> int:
+        p = req.plan
+        return int(p.plan.n_perm if isinstance(p, _MultiPlan) else p.n_perm)
+
+    def _rate_pps(self) -> float | None:
+        """Steady-state serving throughput estimate (perms/s): configured
+        assumption, else the server's own measured rate, else the perf
+        ledger's serve/run history (read once, cached) — None when
+        nothing is known (brownout then stays off: no guessing)."""
+        if self.config.brownout_rate_pps:
+            return float(self.config.brownout_rate_pps)
+        if self._busy_s > 0 and self._served_perms > 0:
+            return self._served_perms / self._busy_s
+        if not hasattr(self, "_ledger_rate"):
+            self._ledger_rate = None
+            try:
+                from ..utils import perfledger
+
+                path = perfledger.default_path()
+                entries = [
+                    float(e["perms_per_sec"])
+                    for e in perfledger.read_entries(path)
+                    if e.get("source") in ("serve", "run")
+                ][-8:]
+                if entries:
+                    self._ledger_rate = sorted(entries)[len(entries) // 2]
+            except OSError:
+                pass
+        return self._ledger_rate
+
+    def _drain_estimate_locked(self, extra_perms: int = 0) -> float | None:
+        rate = self._rate_pps()
+        if not rate or rate <= 0:
+            return None
+        backlog = extra_perms + sum(
+            self._req_nperm(r)
+            for t in self._tenants.values() for r in t.pending
+        )
+        return backlog / rate
+
+    def _update_brownout_locked(self, est: float | None) -> bool:
+        """Hysteresis state machine around the backlog drain estimate:
+        enter past ``brownout_enter_s``, exit below ``brownout_exit_s``
+        (default half of enter), one telemetry event per transition."""
+        cfg = self.config
+        if cfg.brownout_enter_s is None or est is None:
+            return self._brownout
+        exit_s = (cfg.brownout_exit_s if cfg.brownout_exit_s is not None
+                  else cfg.brownout_enter_s / 2.0)
+        depth = sum(len(t.pending) for t in self._tenants.values())
+        if not self._brownout and est > cfg.brownout_enter_s:
+            self._brownout = True
+            if self.tel is not None:
+                self.tel.emit("serve_brownout_enter",
+                              est_drain_s=float(est), queue_depth=depth,
+                              parent=self._serve_sid)
+        elif self._brownout and est < exit_s:
+            self._brownout = False
+            if self.tel is not None:
+                self.tel.emit("serve_brownout_exit",
+                              est_drain_s=float(est), queue_depth=depth,
+                              parent=self._serve_sid)
+        return self._brownout
+
     def submit(self, tenant: str, discovery: str, test,
                modules: Sequence | None = None, n_perm: int | None = None,
                seed: int = 0, alternative: str = "greater",
                adaptive: bool = False, rule=None,
-               deadline_s: float | None = None) -> Request:
+               deadline_s: float | None = None,
+               idempotency_key: str | None = None) -> Request:
         """Validate, admit, and enqueue one analyze request; returns the
         request handle (``wait`` for the result). ``test`` may be a list
         of test-dataset names sharing a node universe — the request then
-        rides the MultiTestEngine T-axis and returns per-test results."""
+        rides the MultiTestEngine T-axis and returns per-test results.
+
+        ``idempotency_key`` (ISSUE 10): a client-chosen durable identity.
+        A duplicate submission with a seen key never recomputes — it
+        attaches to the in-flight request or returns the completed
+        (journaled) result. With a journal attached, the ``accepted``
+        record is fsynced before this method returns."""
         if alternative not in ("greater", "less", "two.sided"):
             raise ServeError(f"bad alternative {alternative!r}")
+        with self._work:
+            dup = self._dedup_locked(idempotency_key)
+            if dup is not None:
+                return dup
         disc = self._dataset(tenant, discovery)
         multi = isinstance(test, (list, tuple))
         if multi and len(test) == 1:
@@ -444,6 +816,11 @@ class PreservationServer:
                         self._engine_cfg_id)
         now = time.monotonic()
         with self._work:
+            # authoritative dedup under the lock (a concurrent duplicate
+            # may have landed while the plan was being built)
+            dup = self._dedup_locked(idempotency_key)
+            if dup is not None:
+                return dup
             ten = self._tenants[tenant]
             if not self._accepting:
                 ten.counters["rejected"] += 1
@@ -451,19 +828,80 @@ class PreservationServer:
                     self.tel.emit("request_rejected", tenant=tenant,
                                   reason="draining")
                 raise ServeError("server is draining; not accepting work")
-            if len(ten.pending) >= self.config.max_queue:
+            plan_np = int(plan.plan.n_perm if multi else plan.n_perm)
+            est = self._drain_estimate_locked(extra_perms=plan_np)
+            brown = self._update_brownout_locked(est)
+            retry_after = round(est, 3) if est is not None else None
+            if brown and not self._replaying:
+                # predictable shedding: the NEWEST request of the
+                # lowest-weight tenants is refused first, with a drain-
+                # time hint — heavier tenants keep their priority
+                min_w = min(t.weight for t in self._tenants.values())
+                if ten.weight <= min_w:
+                    ten.counters["rejected"] += 1
+                    if self.tel is not None:
+                        self.tel.emit(
+                            "request_rejected", tenant=tenant,
+                            reason="brownout",
+                            queue_depth=len(ten.pending),
+                            retry_after_s=retry_after,
+                        )
+                    raise QueueFull(
+                        f"service is browned out (estimated backlog "
+                        f"drain {est:.1f}s); retry later",
+                        retry_after_s=retry_after,
+                    )
+            if (len(ten.pending) >= self.config.max_queue
+                    and not self._replaying):
+                # (replayed requests were admitted once — the journal's
+                # accepted records re-queue past the bound by design)
                 ten.counters["rejected"] += 1
                 if self.tel is not None:
                     self.tel.emit(
                         "request_rejected", tenant=tenant,
                         reason="queue_full",
                         queue_depth=len(ten.pending),
+                        retry_after_s=retry_after,
                     )
                 raise QueueFull(
                     f"tenant {tenant!r} queue is full "
-                    f"({self.config.max_queue}); retry later"
+                    f"({self.config.max_queue}); retry later",
+                    retry_after_s=retry_after,
                 )
+            if (brown and self.config.brownout_nperm_cap is not None
+                    and not self._replaying):
+                # opt-in graceful degradation: browned-out admissions run
+                # at a capped budget (documented to change results)
+                cap = int(self.config.brownout_nperm_cap)
+                if multi:
+                    plan.plan.n_perm = min(plan.plan.n_perm, cap)
+                else:
+                    plan.n_perm = min(plan.n_perm, cap)
             self._seq += 1
+            jkey = idempotency_key or f"auto-{uuid.uuid4().hex[:12]}"
+            if self.journal is not None and not self._replaying:
+                # the write-ahead promise, fsynced BEFORE admission: once
+                # submit returns, a SIGKILL cannot lose this request
+                self.journal.append(
+                    "accepted", seq=self._seq, id=f"r{self._seq}",
+                    key=jkey, tenant=tenant, discovery=discovery,
+                    test=list(test) if multi else test,
+                    digests=(
+                        [self._dataset(tenant, discovery).digest]
+                        + [self._dataset(tenant, t).digest
+                           for t in (test if multi else [test])]
+                    ),
+                    params=dict(
+                        modules=(list(modules) if modules is not None
+                                 else None),
+                        n_perm=(int(n_perm) if n_perm is not None
+                                else None),
+                        seed=int(seed), alternative=alternative,
+                        adaptive=bool(adaptive),
+                        deadline_s=(float(deadline_s)
+                                    if deadline_s is not None else None),
+                    ),
+                )
             req = Request(
                 id=f"r{self._seq}", tenant=tenant, discovery=discovery,
                 test=list(test) if multi else test, seed=int(seed),
@@ -472,8 +910,9 @@ class PreservationServer:
                     deadline_s if deadline_s is not None
                     else self.config.slo_s
                 ),
-                submitted_m=now, seq=self._seq,
+                submitted_m=now, seq=self._seq, journal_key=jkey,
             )
+            self._idem[jkey] = req
             ten.counters["received"] += 1
             if self.tel is not None:
                 req.sid = self.tel.new_span_id()
@@ -602,10 +1041,15 @@ class PreservationServer:
                 self._inflight = len(batch)
             try:
                 self._execute(batch)
+            except SimulatedCrash:
+                # the in-process SIGKILL stand-in (crash drills): the
+                # worker dies HERE exactly as the process would — waiters
+                # stay blocked, queued work stays queued; only the
+                # journal's accepted records and the pack checkpoints
+                # survive, for the next `--recover` boot to pick up
+                return
             except Exception:   # defensive: the worker must never die
-                import logging
-
-                logging.getLogger("netrep_tpu").warning(
+                logger.warning(
                     "serve worker: unhandled batch failure", exc_info=True
                 )
                 for r in batch:
@@ -635,6 +1079,25 @@ class PreservationServer:
         else:
             req.error = error
             ten.counters["failed"] += 1
+        if self.journal is not None and req.journal_key is not None:
+            # terminal journal record: done carries the full encoded
+            # result (what a post-restart duplicate is answered with) +
+            # its digest; failed carries the error — neither re-queues
+            # on the next --recover boot
+            from .protocol import encode_arrays
+
+            if error is None:
+                enc = encode_arrays(req.result)
+                self.journal.append(
+                    "done", seq=req.seq, id=req.id, key=req.journal_key,
+                    tenant=req.tenant, digest=jnl.result_digest(enc),
+                    result=enc,
+                )
+            else:
+                self.journal.append(
+                    "failed", seq=req.seq, id=req.id, key=req.journal_key,
+                    tenant=req.tenant, error=error,
+                )
         if self.tel is not None:
             data = dict(
                 tenant=req.tenant, s=now - req.submitted_m,
@@ -645,7 +1108,71 @@ class PreservationServer:
             else:
                 data["error"] = error
             self.tel.emit("request_done", span=req.sid, **data)
+        self._retire_idem(req)
         req.done.set()
+
+    def _retire_idem(self, req: Request) -> None:
+        """Bound the idempotency map: terminal requests stay answerable
+        up to ``idem_cache`` of them; beyond that the oldest evict (a
+        duplicate of an evicted key recomputes to the same result)."""
+        if req.journal_key is None:
+            return
+        with self._work:
+            self._idem_done.append(req.journal_key)
+            while len(self._idem_done) > self.config.idem_cache:
+                old = self._idem_done.pop(0)
+                stale = self._idem.get(old)
+                if stale is not None and stale.done.is_set():
+                    del self._idem[old]
+
+    def _expire(self, req: Request, miss_s: float, folded: int) -> None:
+        """Cancel a deadline-missed request (ISSUE 10): the ``expired``
+        counter, a terminal ``failed`` journal record (a deadline miss
+        must not resurrect on ``--recover``), the pinned
+        ``request_expired`` event with the miss, and the waiter's error."""
+        ten = self._tenants[req.tenant]
+        ten.counters["expired"] += 1
+        error = (f"deadline exceeded by {miss_s:.2f}s "
+                 f"(cancelled after {int(folded)} permutations)")
+        req.error = error
+        if self.journal is not None and req.journal_key is not None:
+            self.journal.append(
+                "failed", seq=req.seq, id=req.id, key=req.journal_key,
+                tenant=req.tenant, error=error,
+            )
+        if self.tel is not None:
+            self.tel.emit(
+                "request_expired", span=req.sid, tenant=req.tenant,
+                miss_s=float(miss_s), folded=int(folded),
+                s=time.monotonic() - req.submitted_m,
+            )
+        self._retire_idem(req)
+        req.done.set()
+
+    def _account_pack_locked(self, wall_s: float, perms: int) -> None:
+        """Fold one pack's measured throughput into the brownout rate
+        estimate and re-evaluate the brownout state (the exit path: the
+        queue just got shorter)."""
+        with self._work:
+            self._busy_s += float(wall_s)
+            self._served_perms += int(perms)
+            self._update_brownout_locked(self._drain_estimate_locked())
+
+    def _pack_ckpt_path(self, batch: list[Request], plans) -> str | None:
+        """Deterministic per-pack checkpoint path (None when
+        checkpointing is off): keyed on the members' durable identities,
+        so the same requests re-queued by ``--recover`` resume the same
+        file — any other composition recomputes, bit-identically."""
+        if self._ckpt_dir is None:
+            return None
+        if any(r.journal_key is None for r in batch):
+            return None
+        os.makedirs(self._ckpt_dir, exist_ok=True)
+        return jnl.pack_checkpoint_path(
+            self._ckpt_dir, self._engine_cfg_id,
+            [(r.journal_key, p.seed, p.n_perm, p.signature())
+             for r, p in zip(batch, plans)],
+        )
 
     def _requeue_solo(self, batch: list[Request]) -> None:
         """A failed pack is split: every member re-queues solo-only (front
@@ -663,6 +1190,20 @@ class PreservationServer:
                               reason="pack_failed", parent=r.sid)
 
     def _execute(self, batch: list[Request]) -> None:
+        if self.config.enforce_deadlines:
+            # already-expired requests are cancelled before any dispatch
+            # (the queue-side deadline check; mid-pack expiry is the
+            # monitor's chunk-boundary sweep)
+            now = time.monotonic()
+            live = []
+            for r in batch:
+                if r.deadline is not None and now > r.deadline:
+                    self._expire(r, now - r.deadline, folded=0)
+                else:
+                    live.append(r)
+            batch = live
+            if not batch:
+                return
         self._pack_seq += 1
         pack_id = f"p{self._pack_seq}"
         multi = isinstance(batch[0].plan, _MultiPlan)
@@ -730,23 +1271,49 @@ class PreservationServer:
                     pack=pack_id, n_requests=len(batch), pool_hit=hit,
                     queued_s=time.monotonic() - r.submitted_m,
                 )
+        for r, p in zip(batch, plans):
+            p.deadline = (r.deadline if self.config.enforce_deadlines
+                          else None)
+        ckpt_path = self._pack_ckpt_path(batch, plans)
+        kw = dict(
+            telemetry=self.tel, fault_policy=self._fault,
+            checkpoint_path=ckpt_path,
+            checkpoint_every=self.config.checkpoint_every,
+        )
+        t0 = time.perf_counter()
         try:
             if self.tel is not None:
                 with self.tel.span("pack", pack=pack_id,
                                    n_requests=len(batch),
                                    tenants=sorted({r.tenant
                                                    for r in batch})):
-                    results = run_pack(engine, plans, telemetry=self.tel,
-                                       fault_policy=self._fault)
+                    results = run_pack(engine, plans, **kw)
             else:
-                results = run_pack(engine, plans, fault_policy=self._fault)
+                results = run_pack(engine, plans, **kw)
         except Exception:
             # a failed run may leave the engine's device state suspect —
             # drop it from the warm pool before the error propagates
+            # (the pack checkpoint, if any, stays for the solo retries)
             self.pool.discard(key)
             raise
+        if ckpt_path is not None:
+            # the pack completed: its checkpoint is spent (leaving it
+            # would only grow the directory; a re-run recomputes exactly)
+            try:
+                os.unlink(ckpt_path)
+            except OSError:
+                pass
+        self._account_pack_locked(
+            time.perf_counter() - t0,
+            sum(int(res.get("completed", 0)) for res in results
+                if not res.get("expired")),
+        )
         for r, res in zip(batch, results):
-            self._finish(r, res, None, pack_id, len(batch), hit)
+            if res.get("expired"):
+                self._expire(r, res["deadline_miss_s"],
+                             res.get("completed", 0))
+            else:
+                self._finish(r, res, None, pack_id, len(batch), hit)
 
     def _execute_multi(self, req: Request, pack_id: str) -> None:
         from ..parallel.multitest import MultiTestEngine
@@ -781,6 +1348,9 @@ class PreservationServer:
                 queued_s=time.monotonic() - req.submitted_m,
             )
         T = len(tests)
+        plan.deadline = (req.deadline if self.config.enforce_deadlines
+                         else None)
+        t0 = time.perf_counter()
         try:
             observed = np.asarray(engine.observed(), dtype=np.float64)
             # fold the T axis into the monitor's cell axis — the
@@ -796,6 +1366,16 @@ class PreservationServer:
         except Exception:
             self.pool.discard(key)
             raise
+        self._account_pack_locked(
+            time.perf_counter() - t0,
+            0 if 0 in monitor.expired else min(int(completed), plan.n_perm),
+        )
+        if 0 in monitor.expired:
+            # the T-axis request missed its deadline mid-run (multi-test
+            # requests are their own pack, so there are no survivors)
+            self._expire(req, monitor.expired[0],
+                         min(int(monitor.folded), plan.n_perm))
+            return
         total_space = pv.total_permutations(plan.pool.size, plan.sizes)
         per_test = []
         for ti in range(T):
@@ -847,6 +1427,8 @@ class PreservationServer:
                 },
                 "inflight": self._inflight,
                 "accepting": self._accepting,
+                "brownout": self._brownout,
+                "journal": self.config.journal,
                 "pool": self.pool.stats(),
                 "packs": self._pack_seq,
             }
@@ -862,11 +1444,14 @@ class PreservationServer:
         st = self.stats()
         lines.append("# TYPE netrep_serve_requests_total counter")
         for name, t in sorted(st["tenants"].items()):
-            for outcome in ("received", "done", "failed", "rejected"):
+            for outcome in ("received", "done", "failed", "rejected",
+                            "expired", "deduped"):
                 lines.append(
                     f'netrep_serve_requests_total{{tenant="{name}",'
                     f'outcome="{outcome}"}} {t[outcome]}'
                 )
+        lines.append("# TYPE netrep_serve_brownout gauge")
+        lines.append(f'netrep_serve_brownout {int(st["brownout"])}')
         lines.append("# TYPE netrep_serve_queue_depth gauge")
         for name, t in sorted(st["tenants"].items()):
             lines.append(

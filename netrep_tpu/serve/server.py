@@ -9,11 +9,19 @@ work; this module adds the wire, the `/metrics`-style scrape surface, and
 the drain protocol:
 
 **SIGTERM/SIGINT → graceful drain**: the listener stops accepting, every
-queued and in-flight request finishes (bounded by ``--drain-timeout``),
-pooled engines release their device arrays, the telemetry
+queued and in-flight request finishes (bounded by ``--drain-timeout-s``;
+past the bound the remainder is journaled as requeued-on-restart —
+ISSUE 10), pooled engines release their device arrays, the telemetry
 ``serve_start``/``serve_end`` span closes, and the process exits 0 with a
 final ``{"serve": "drained", ...}`` line — the contract the
 ``tpu_watch.sh`` serve drill asserts.
+
+**Crash safety (ISSUE 10)**: ``--journal`` (default on) write-ahead
+journals every accepted request before admission; ``--recover`` replays
+the journal on boot. **Wire hardening**: request lines are read through
+one bounded reader (:func:`read_op_line`) — oversized lines, bad JSON,
+non-object ops, and unknown ops each get a structured error response
+plus a ``request_malformed`` event, and the connection loop stays alive.
 
 Ops::
 
@@ -24,10 +32,15 @@ Ops::
      "correlation": [[...]], "network": [[...]], "data": [[...]],
      "assignments": {"node_0": "1", ...}}
     {"op": "analyze", "tenant": "a", "discovery": "d", "test": "t",
-     "n_perm": 2000, "seed": 1, "adaptive": false}
+     "n_perm": 2000, "seed": 1, "adaptive": false,
+     "deadline_s": 30.0, "idempotency_key": "client-chosen"}
     {"op": "metrics"}   → Prometheus text exposition
     {"op": "stats"}
     {"op": "shutdown"}  → initiates the same drain as SIGTERM
+
+A rejected admission (queue full / brownout shedding) answers
+``{"ok": false, "retryable": true, "retry_after_s": <hint>}`` — the
+client backs off and retries under the SAME idempotency key.
 """
 
 from __future__ import annotations
@@ -42,14 +55,35 @@ import threading
 import numpy as np
 
 from .protocol import encode_arrays
-from .scheduler import PreservationServer, ServeConfig, ServeError
+from .scheduler import PreservationServer, QueueFull, ServeConfig, ServeError
+
+#: wire-hardening bound (ISSUE 10): one request line may not exceed this —
+#: an oversized line gets a structured error (+ ``request_malformed``
+#: telemetry) and the connection loop stays alive, instead of an
+#: unbounded read buffering a hostile payload
+MAX_LINE_BYTES = 32 * 1024 * 1024
+
+
+def _malformed(server: PreservationServer, reason: str) -> dict:
+    """Structured malformed-request response + the pinned telemetry
+    event; the handler loop continues — a bad line must never tear down
+    the connection (the ISSUE 10 wire-hardening satellite)."""
+    if server.tel is not None:
+        server.tel.emit("request_malformed", reason=reason[:200])
+    return {"ok": False, "error": f"malformed request: {reason}",
+            "malformed": True}
 
 
 def dispatch_op(server: PreservationServer, op: dict,
                 stop: threading.Event) -> dict:
     """Execute one wire op against the in-process server; returns the
     response dict (``ok`` always present). Shared by the socket and stdio
-    transports."""
+    transports. Never raises: unknown ops, bad payload shapes, and even
+    unexpected internal errors come back as structured error responses so
+    the connection loop stays alive."""
+    if not isinstance(op, dict):
+        return _malformed(server, f"op must be a JSON object, "
+                                  f"got {type(op).__name__}")
     try:
         kind = op.get("op")
         if kind == "ping":
@@ -85,7 +119,7 @@ def dispatch_op(server: PreservationServer, op: dict,
         if kind == "analyze":
             kw = {}
             for k in ("modules", "n_perm", "seed", "alternative",
-                      "adaptive", "deadline_s"):
+                      "adaptive", "deadline_s", "idempotency_key"):
                 if k in op and op[k] is not None:
                     kw[k] = op[k]
             result = server.analyze(
@@ -100,25 +134,60 @@ def dispatch_op(server: PreservationServer, op: dict,
         if kind == "shutdown":
             stop.set()
             return {"ok": True, "draining": True}
-        return {"ok": False, "error": f"unknown op {kind!r}"}
+        return _malformed(server, f"unknown op {kind!r}")
+    except QueueFull as e:
+        # admission-control rejection: retryable by contract, with the
+        # server's backlog-drain hint when it has one (ISSUE 10)
+        resp = {"ok": False, "error": f"QueueFull: {e}", "retryable": True}
+        if e.retry_after_s is not None:
+            resp["retry_after_s"] = float(e.retry_after_s)
+        return resp
     except (ServeError, TimeoutError, KeyError, TypeError,
             ValueError) as e:
         return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    except Exception as e:  # the handler loop must survive anything
+        return {"ok": False,
+                "error": f"internal error: {type(e).__name__}: {e}"}
+
+
+def read_op_line(rfile, server: PreservationServer):
+    """Read + parse one bounded request line. Returns ``(op, None)`` for
+    a parsed op, ``(None, resp)`` for a line that must be answered with a
+    structured error (oversized, bad JSON — the loop continues), and
+    ``(None, None)`` on EOF. Shared by the socket and stdio transports
+    so both survive hostile input identically."""
+    line = rfile.readline(MAX_LINE_BYTES + 1)
+    if not line:
+        return None, None
+    if len(line) > MAX_LINE_BYTES and not line.endswith("\n"):
+        # discard the rest of the oversized line so the next one parses
+        while True:
+            chunk = rfile.readline(MAX_LINE_BYTES)
+            if not chunk or chunk.endswith("\n"):
+                break
+        return None, _malformed(
+            server, f"request line exceeds {MAX_LINE_BYTES} bytes"
+        )
+    line = line.strip()
+    if not line:
+        return None, {"ok": True, "empty": True}
+    try:
+        return json.loads(line), None
+    except json.JSONDecodeError as e:
+        return None, _malformed(server, f"bad JSON: {e}")
 
 
 def _handle_conn(server: PreservationServer, conn: socket.socket,
                  stop: threading.Event) -> None:
     with conn:
         rfile = conn.makefile("r", encoding="utf-8")
-        for line in rfile:
-            line = line.strip()
-            if not line:
+        while True:
+            op, resp = read_op_line(rfile, server)
+            if op is None and resp is None:
+                return
+            if resp is not None and resp.get("empty"):
                 continue
-            try:
-                op = json.loads(line)
-            except json.JSONDecodeError as e:
-                resp = {"ok": False, "error": f"bad JSON: {e}"}
-            else:
+            if resp is None:
                 resp = dispatch_op(server, op, stop)
             try:
                 conn.sendall((json.dumps(resp) + "\n").encode("utf-8"))
@@ -133,6 +202,15 @@ def serve_daemon(args) -> int:
     docstring. Returns the process exit code."""
     from ..utils.config import EngineConfig
 
+    journal = None if args.no_journal else args.journal
+    recover = getattr(args, "recover", None)
+    if isinstance(recover, str):
+        journal = recover      # `--recover JOURNAL` names the journal
+    if recover and journal is None:
+        print("serve --recover needs a journal (use --journal PATH or "
+              "--recover JOURNAL instead of --no-journal)",
+              file=sys.stderr)
+        return 2
     cfg = ServeConfig(
         max_queue=args.max_queue,
         max_pack=args.max_pack,
@@ -141,6 +219,13 @@ def serve_daemon(args) -> int:
         default_n_perm=args.n_perm,
         telemetry=args.telemetry,
         fault_policy=True if os.environ.get("NETREP_FAULT_PLAN") else None,
+        journal=journal,
+        recover=bool(recover),
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=getattr(args, "checkpoint_every", 4096),
+        brownout_enter_s=args.brownout_enter_s,
+        brownout_exit_s=args.brownout_exit_s,
+        brownout_rate_pps=args.brownout_rate,
     )
     server = PreservationServer(cfg)
     stop = threading.Event()
@@ -184,25 +269,26 @@ def serve_daemon(args) -> int:
         # line; EOF drains. Useful for subprocess embedding and debugging.
         print(json.dumps({"serve": "ready", "stdio": True,
                           "pid": os.getpid()}), flush=True)
-        for line in sys.stdin:
-            line = line.strip()
-            if not line:
+        while True:
+            op, resp = read_op_line(sys.stdin, server)
+            if op is None and resp is None:
+                break
+            if resp is not None and resp.get("empty"):
                 continue
-            try:
-                op = json.loads(line)
-            except json.JSONDecodeError as e:
-                resp = {"ok": False, "error": f"bad JSON: {e}"}
-            else:
+            if resp is None:
                 resp = dispatch_op(server, op, stop)
             print(json.dumps(resp), flush=True)
             if stop.is_set():
                 break
 
-    # graceful drain: queued + in-flight work finishes, engines release,
-    # the serve span closes — then one final parseable line
+    # graceful drain: queued + in-flight work finishes (bounded by
+    # --drain-timeout-s: the remainder is journaled as requeued-on-restart
+    # instead of draining unboundedly), engines release, the serve span
+    # closes — then one final parseable line
     server.close(drain=True, timeout=args.drain_timeout)
     st = server.stats()
     done = sum(t["done"] for t in st["tenants"].values())
     print(json.dumps({"serve": "drained", "requests_done": done,
+                      "requests_requeued": server._last_drain_requeued,
                       "packs": st["packs"]}), flush=True)
     return 0

@@ -55,7 +55,14 @@ boundaries from a deterministic plan. Plans are compact strings —
 ``"device_lost_partial@64"`` (half the mesh's devices die; survivors
 remain — the mesh-shrink rung), ``"capacity_restored@96"`` (the lost
 capacity comes back; the loop grows the mesh at the next boundary),
-``"hang@192"``, ``"interrupt@96"``, ``"fatal@32"`` — joined with ``;``,
+``"hang@192"``, ``"interrupt@96"``, ``"fatal@32"``, ``"crash@64"`` (an
+uncatchable in-process crash — the serving tier-1 stand-in for a
+``SIGKILL``: it unwinds past every recovery handler so failure-saves
+fire but nothing recovers in-process), ``"sigkill@64"`` (the real thing:
+``os.kill(getpid(), SIGKILL)`` — the ``chaos --serve`` drill's
+plan-injected kill point; nothing after the chosen dispatch runs, not
+even a failure-save, so recovery proves the *periodic* durability story)
+— joined with ``;``,
 set via ``FaultPolicy(plan=...)`` or the ``NETREP_FAULT_PLAN`` env var
 (which also *activates* a default policy, for bench/CI runs). Injection
 state lives on the :class:`FaultRuntime`, which survives engine rebuilds
@@ -84,6 +91,7 @@ __all__ = [
     "FaultSpec",
     "CapacityRestoredError",
     "DeviceLostError",
+    "SimulatedCrash",
     "DispatchAbandonedError",
     "InjectedTransientError",
     "InjectedDeviceLost",
@@ -139,6 +147,17 @@ class CapacityRestoredError(Exception):
 
 class InjectedFatalError(RuntimeError):
     """Injected stand-in for a genuine bug-class failure — never retried."""
+
+
+class SimulatedCrash(BaseException):
+    """In-process stand-in for a ``SIGKILL`` (plan kind ``crash``): a
+    *BaseException* so it unwinds past every ``except Exception`` recovery
+    handler — the loops' failure-save hooks still fire (modeling the
+    periodic checkpoint that existed at kill time), but nothing retries,
+    degrades, or reports; the thread that hit it is simply gone. The
+    serving tier-1 kill→recover drill uses it because a test process
+    cannot SIGKILL itself (the real signal rides the ``sigkill`` kind in
+    the ``chaos --serve`` subprocess drill)."""
 
 
 class DispatchAbandonedError(RuntimeError):
@@ -222,7 +241,8 @@ def classify_error(exc: BaseException) -> str:
 # ---------------------------------------------------------------------------
 
 _KINDS = ("transient", "device_lost", "device_lost_partial",
-          "capacity_restored", "fatal", "hang", "interrupt")
+          "capacity_restored", "fatal", "hang", "interrupt", "crash",
+          "sigkill")
 
 _RAISERS = {
     "transient": lambda spec: InjectedTransientError(
@@ -502,6 +522,20 @@ class FaultRuntime:
                 )
                 if fault.kind == "interrupt":
                     raise KeyboardInterrupt
+                if fault.kind == "sigkill":
+                    # the real thing, for the chaos --serve subprocess
+                    # drill: the process dies HERE, mid-pack, with no
+                    # cleanup — recovery must come from the journal and
+                    # the periodic checkpoints alone
+                    import signal as _signal
+
+                    os.kill(os.getpid(), _signal.SIGKILL)
+                if fault.kind == "crash":
+                    # in-process SIGKILL stand-in (BaseException): the
+                    # loops' failure-save hooks run, nothing else does
+                    raise SimulatedCrash(
+                        f"injected crash at permutation {fault.at_perm}"
+                    )
                 if fault.kind == "hang":
                     hang = True
                 else:

@@ -124,6 +124,16 @@ SERVE_EVENTS = (
     "request_packed",
     "request_done",
     "request_rejected",
+    # crash-safe serving (ISSUE 10): deadline enforcement, idempotency
+    # dedup, brownout load shedding, journal replay, wire hardening —
+    # names pinned beside the PR 7 lifecycle because the recovery drill
+    # and serving dashboards key on them
+    "request_expired",
+    "request_deduped",
+    "serve_brownout_enter",
+    "serve_brownout_exit",
+    "journal_replayed",
+    "request_malformed",
 )
 
 
@@ -805,7 +815,7 @@ def tenant_summary(events: Iterable[dict]) -> dict[str, dict]:
             continue
         row = out.setdefault(str(tenant), {
             "received": 0, "packed": 0, "done": 0, "failed": 0,
-            "rejected": 0, "perms": 0,
+            "rejected": 0, "expired": 0, "deduped": 0, "perms": 0,
             "latency": [0, 0.0, float("inf"), 0.0],  # n, total, min, max
         })
         if ev == "request_received":
@@ -814,6 +824,10 @@ def tenant_summary(events: Iterable[dict]) -> dict[str, dict]:
             row["packed"] += 1
         elif ev == "request_rejected":
             row["rejected"] += 1
+        elif ev == "request_expired":
+            row["expired"] += 1
+        elif ev == "request_deduped":
+            row["deduped"] += 1
         elif ev == "request_done":
             if data.get("ok", True):
                 row["done"] += 1
@@ -841,7 +855,7 @@ def render_tenants(path: str) -> str:
     w = max(len(t) for t in rows)
     out.append(
         f"  {'':<{w}}  {'recv':>5} {'done':>5} {'fail':>5} {'rej':>5} "
-        f"{'perms':>8} {'mean_s':>8} {'max_s':>8}"
+        f"{'exp':>5} {'dedup':>5} {'perms':>8} {'mean_s':>8} {'max_s':>8}"
     )
     for t in sorted(rows):
         r = rows[t]
@@ -850,7 +864,8 @@ def render_tenants(path: str) -> str:
         hi = hi if n else float("nan")
         out.append(
             f"  {t:<{w}}  {r['received']:>5} {r['done']:>5} "
-            f"{r['failed']:>5} {r['rejected']:>5} {r['perms']:>8} "
+            f"{r['failed']:>5} {r['rejected']:>5} {r['expired']:>5} "
+            f"{r['deduped']:>5} {r['perms']:>8} "
             f"{mean:>8.3f} {hi:>8.3f}"
         )
     return "\n".join(out)

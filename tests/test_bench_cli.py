@@ -105,6 +105,26 @@ def test_sharded_microbench_smoke():
 
 
 @pytest.mark.slow
+def test_serve_kill_recover_smoke():
+    """The watcher's SERVE_CRASH_DRILL load row (ISSUE 10): a journaled
+    server killed mid-pack, recovered, parity asserted in-bench; the row
+    carries the `serve-recover` metric label (its own perf-ledger
+    fingerprint class) and the re-served/recomputed split."""
+    proc = _run_cpu_subprocess(
+        [sys.executable, "benchmarks/serve_load.py", "--smoke",
+         "--kill-recover"],
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["metric"].startswith("serve-recover")
+    assert row["time_to_recovery_s"] > 0
+    assert row["requests_reserved"] >= 1      # answered from the journal
+    assert row["requests_recomputed"] >= 1    # resumed/recomputed
+    assert row["perms_per_sec"] > 0
+
+
+@pytest.mark.slow
 def test_bf16_drift_smoke():
     """The watcher's `bf16_drift` step at tiny shapes: one parseable JSON
     line with the per-statistic drift table."""

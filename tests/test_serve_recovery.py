@@ -1,0 +1,546 @@
+"""Crash-safe serving tests (ISSUE 10) — CPU-only, in-process, tiny
+fixtures: journal round-trip (torn final line tolerated), idempotency-key
+dedup (in-flight and completed), the kill→recover bit-parity drill
+(SimulatedCrash mid-pack → fresh server with ``recover=True`` → results
+bit-identical to direct calls, partial packs resumed from checkpoint),
+deadline expiry mid-pack with survivor parity, brownout enter/exit
+ordering with ``retry_after_s``, bounded-drain journaling, wire-line
+hardening, and deterministic client retry backoff."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from netrep_tpu import module_preservation
+from netrep_tpu.data import make_mixed_pair
+from netrep_tpu.serve import (
+    InProcessClient, PreservationServer, QueueFull, ServeConfig, ServeError,
+    retry_delay,
+)
+from netrep_tpu.serve import journal as jnl
+from netrep_tpu.utils.config import EngineConfig, FaultPolicy
+from netrep_tpu.utils.faults import parse_plan
+
+#: the ONE engine config served runs and their direct-call twins share
+CFG = EngineConfig(chunk_size=16, autotune=False)
+
+
+@pytest.fixture(scope="module")
+def fx():
+    mixed = make_mixed_pair(100, 3, n_samples=16, seed=7)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    assign = {f"node_{i}": "0" for i in range(dn.shape[0])}
+    for lab, idx in mixed["specs"]:
+        for i in idx:
+            assign[f"node_{i}"] = str(lab)
+    direct_kw = dict(
+        network={"d": dn, "t": tn}, correlation={"d": dc, "t": tc},
+        data={"d": dd, "t": td}, module_assignments=assign,
+        discovery="d", test="t", config=CFG,
+    )
+    return dict(dn=dn, dc=dc, dd=dd, tn=tn, tc=tc, td=td, assign=assign,
+                direct_kw=direct_kw)
+
+
+def make_server(fx, tmp_path, *, tenants=("a",), start=True, tel="tel",
+                **cfg_kw):
+    cfg_kw.setdefault("engine", CFG)
+    cfg_kw.setdefault("telemetry", str(tmp_path / f"{tel}.jsonl"))
+    srv = PreservationServer(ServeConfig(**cfg_kw), start=start)
+    client = InProcessClient(srv)
+    for t in tenants:
+        client.register_dataset(t, "d", network=fx["dn"],
+                                correlation=fx["dc"], data=fx["dd"],
+                                assignments=fx["assign"])
+        client.register_dataset(t, "t", network=fx["tn"],
+                                correlation=fx["tc"], data=fx["td"])
+    return srv, client
+
+
+def read_events(path):
+    return [json.loads(l) for l in open(path, encoding="utf-8")]
+
+
+def direct(fx, **kw):
+    return module_preservation(**fx["direct_kw"], **kw)
+
+
+# ---------------------------------------------------------------------------
+# journal round-trip
+# ---------------------------------------------------------------------------
+
+def test_journal_round_trip_and_torn_final_line(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = jnl.RequestJournal(path)
+    j.append("tenant", tenant="a", weight=2)
+    j.append("accepted", seq=1, id="r1", key="k1", tenant="a",
+             discovery="d", test="t",
+             params={"n_perm": 64, "seed": 3})
+    j.append("accepted", seq=2, id="r2", key="k2", tenant="a",
+             discovery="d", test="t",
+             params={"n_perm": 32, "seed": 5})
+    j.append("done", seq=1, id="r1", key="k1", tenant="a",
+             digest="abc", result={"p_values": [0.1, 0.2]})
+    j.close()
+    # a crash mid-append leaves a torn final line: tolerated like the
+    # telemetry sink's
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"jv": 1, "kind": "done", "seq": 2, "key": "k2", "trunc')
+    state = jnl.scan(path)
+    assert state["tenants"] == {"a": 2}
+    assert list(state["results"]) == ["k1"]
+    assert [r["key"] for r in state["pending"]] == ["k2"]
+    assert state["n_accepted"] == 2
+
+
+def test_journal_accepted_is_durable_before_submit_returns(fx, tmp_path):
+    jpath = str(tmp_path / "j.jsonl")
+    srv, client = make_server(fx, tmp_path, start=False, journal=jpath)
+    client.submit("a", "d", "t", n_perm=32, seed=1, idempotency_key="k1")
+    # the fsynced accepted record is on disk BEFORE the worker ever runs
+    state = jnl.scan(jpath)
+    assert [r["key"] for r in state["pending"]] == ["k1"]
+    rec = state["pending"][0]
+    assert rec["tenant"] == "a" and rec["params"]["n_perm"] == 32
+    assert rec["params"]["seed"] == 1 and len(rec["digests"]) == 2
+    srv.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# idempotency dedup (the acceptance-pinned contract)
+# ---------------------------------------------------------------------------
+
+def test_idempotency_dedup_after_completion_never_recomputes(fx, tmp_path):
+    srv, client = make_server(fx, tmp_path, journal=str(tmp_path / "j"))
+    try:
+        r1 = client.analyze("a", "d", "t", n_perm=32, seed=3,
+                            idempotency_key="K", timeout=600)
+        packs_after_first = srv.stats()["packs"]
+        r2 = client.analyze("a", "d", "t", n_perm=32, seed=3,
+                            idempotency_key="K", timeout=600)
+        st = srv.stats()
+    finally:
+        srv.close()
+    # the duplicate was answered from the stored result: same object-level
+    # numbers, NO new pack dispatched, dedup counted + event emitted
+    np.testing.assert_array_equal(r1["p_values"], r2["p_values"])
+    assert r2["request_id"] == r1["request_id"]
+    assert st["packs"] == packs_after_first
+    assert st["tenants"]["a"]["deduped"] == 1
+    ev = read_events(str(tmp_path / "tel.jsonl"))
+    dedup = [e for e in ev if e["ev"] == "request_deduped"]
+    assert dedup and dedup[0]["data"]["state"] == "completed"
+    assert dedup[0]["data"]["key"] == "K"
+
+
+def test_idempotency_dedup_attaches_to_inflight_request(fx, tmp_path):
+    srv, client = make_server(fx, tmp_path, start=False)
+    h1 = client.submit("a", "d", "t", n_perm=32, seed=3,
+                       idempotency_key="K")
+    h2 = client.submit("a", "d", "t", n_perm=32, seed=3,
+                       idempotency_key="K")
+    assert h2 is h1                      # one queued computation
+    srv.start()
+    try:
+        res = client.result(h1, timeout=600)
+    finally:
+        srv.close()
+    assert res["completed"] == 32
+    ev = read_events(str(tmp_path / "tel.jsonl"))
+    dedup = [e for e in ev if e["ev"] == "request_deduped"]
+    assert dedup and dedup[0]["data"]["state"] == "inflight"
+
+
+# ---------------------------------------------------------------------------
+# kill -> recover bit parity (the tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+def _crash_server(fx, tmp_path, jpath, plan, submits, tel="tel_crash"):
+    """Boot a journaled server with an injected crash, submit, and wait
+    for the worker thread to die (the in-process SIGKILL)."""
+    srv, client = make_server(
+        fx, tmp_path, start=False, journal=jpath, checkpoint_every=16,
+        tel=tel,
+        fault_policy=FaultPolicy(plan=plan, backoff_base_s=0.0,
+                                 backoff_jitter=0.0),
+    )
+    handles = [client.submit("a", "d", "t", idempotency_key=k, **kw)
+               for k, kw in submits]
+    srv.start()
+    deadline = time.monotonic() + 300
+    while srv._worker.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not srv._worker.is_alive(), "injected crash never fired"
+    return srv, handles
+
+
+def test_kill_recover_completes_bit_identically(fx, tmp_path):
+    """SIGKILL stand-in mid-pack → restart with recover=True → every
+    journaled request completes with counts/p-values bit-identical to an
+    uninterrupted server (= the direct call, by the PR 7 parity pin) —
+    resuming the partial pack from its checkpoint, not from scratch."""
+    jpath = str(tmp_path / "j.jsonl")
+    submits = [
+        ("k1", dict(n_perm=64, seed=3)),
+        ("k2", dict(n_perm=64, seed=5)),
+        ("k3", dict(n_perm=32, seed=11, adaptive=True)),
+    ]
+    srv1, handles = _crash_server(fx, tmp_path, jpath, "crash@24", submits)
+    assert not any(h.done.is_set() for h in handles)  # all died with it
+
+    srv2 = PreservationServer(ServeConfig(
+        engine=CFG, journal=jpath, recover=True, checkpoint_every=16,
+        telemetry=str(tmp_path / "tel_rec.jsonl"),
+    ))
+    client2 = InProcessClient(srv2)
+    try:
+        results = {
+            k: client2.analyze("a", "d", "t", idempotency_key=k,
+                               timeout=600, **kw)
+            for k, kw in submits
+        }
+    finally:
+        srv2.close()
+    for k, kw in submits:
+        d = direct(fx, **kw)
+        np.testing.assert_array_equal(results[k]["observed"], d.observed)
+        np.testing.assert_array_equal(results[k]["p_values"],
+                                      np.asarray(d.p_values))
+        if kw.get("adaptive"):
+            np.testing.assert_array_equal(results[k]["n_perm_used"],
+                                          np.asarray(d.n_perm_used))
+    ev = read_events(str(tmp_path / "tel_rec.jsonl"))
+    replay = [e for e in ev if e["ev"] == "journal_replayed"]
+    assert replay and replay[0]["data"]["requeued"] == 3
+    # the partial pack resumed from its checkpoint: the crash landed past
+    # the first checkpoint_every boundary, so recovery started mid-run
+    resumed = [e for e in ev if e["ev"] == "checkpoint_resumed"]
+    assert resumed and resumed[0]["data"]["completed"] >= 16
+
+
+def test_recovery_serves_completed_requests_from_journal(fx, tmp_path):
+    """Requests that finished BEFORE the crash are answered from their
+    journaled ``done`` record on the recovered server — zero recompute
+    (no pack runs for them)."""
+    jpath = str(tmp_path / "j.jsonl")
+    srv, client = make_server(fx, tmp_path, journal=jpath)
+    try:
+        r1 = client.analyze("a", "d", "t", n_perm=32, seed=3,
+                            idempotency_key="K", timeout=600)
+    finally:
+        srv.close(drain=True)
+    # simulate the restart: fresh server, same journal
+    srv2 = PreservationServer(ServeConfig(
+        engine=CFG, journal=jpath, recover=True,
+        telemetry=str(tmp_path / "tel_rec.jsonl"),
+    ), start=False)   # worker never starts: nothing may need computing
+    client2 = InProcessClient(srv2)
+    try:
+        r2 = client2.analyze("a", "d", "t", n_perm=32, seed=3,
+                             idempotency_key="K", timeout=5)
+        st = srv2.stats()
+    finally:
+        srv2.close(drain=False)
+    np.testing.assert_array_equal(np.asarray(r1["p_values"]),
+                                  np.asarray(r2["p_values"]))
+    np.testing.assert_array_equal(np.asarray(r1["counts_hi"]),
+                                  np.asarray(r2["counts_hi"]))
+    assert st["packs"] == 0   # served purely from the journal
+    ev = read_events(str(tmp_path / "tel_rec.jsonl"))
+    assert [e["data"]["results"] for e in ev
+            if e["ev"] == "journal_replayed"] == [1]
+
+
+def test_journal_off_is_plain_pr7_serving(fx, tmp_path):
+    """--no-journal / journal=None boots carry zero new machinery:
+    no journal file, no checkpoint dir, results identical to direct."""
+    srv, client = make_server(fx, tmp_path)
+    try:
+        res = client.analyze("a", "d", "t", n_perm=32, seed=3, timeout=600)
+        assert srv.journal is None and srv._ckpt_dir is None
+    finally:
+        srv.close()
+    d = direct(fx, n_perm=32, seed=3)
+    np.testing.assert_array_equal(res["p_values"], np.asarray(d.p_values))
+    assert not list(tmp_path.glob("*.ckpt"))
+
+
+# ---------------------------------------------------------------------------
+# deadline enforcement
+# ---------------------------------------------------------------------------
+
+def test_deadline_expiry_mid_pack_with_survivor_parity(fx, tmp_path):
+    """One pack, two members: the short-deadline member is cancelled at a
+    chunk boundary (request_expired, no result); its pack-mate finishes
+    bit-identical to the direct call — retirement re-bucketing means a
+    cancelled member just stops consuming dispatches."""
+    srv, client = make_server(fx, tmp_path, start=False)
+    h_ok = client.submit("a", "d", "t", n_perm=48, seed=3, deadline_s=600)
+    # enormous budget + sub-compile-time deadline: expires at the first
+    # boundary after the deadline passes, long before its ceiling
+    h_exp = client.submit("a", "d", "t", n_perm=20000, seed=5,
+                          deadline_s=0.2)
+    srv.start()
+    try:
+        r_ok = client.result(h_ok, timeout=600)
+        with pytest.raises(ServeError, match="deadline exceeded"):
+            client.result(h_exp, timeout=600)
+        st = srv.stats()
+    finally:
+        srv.close()
+    assert r_ok["pack_size"] == 2          # they genuinely shared a pack
+    d = direct(fx, n_perm=48, seed=3)
+    np.testing.assert_array_equal(r_ok["observed"], d.observed)
+    np.testing.assert_array_equal(r_ok["p_values"], np.asarray(d.p_values))
+    assert st["tenants"]["a"]["expired"] == 1
+    ev = read_events(str(tmp_path / "tel.jsonl"))
+    exp = [e for e in ev if e["ev"] == "request_expired"]
+    assert len(exp) == 1
+    assert exp[0]["data"]["miss_s"] > 0 and exp[0]["data"]["folded"] > 0
+
+
+def test_deadline_expired_in_queue_is_cancelled_before_dispatch(fx,
+                                                                tmp_path):
+    srv, client = make_server(fx, tmp_path, start=False)
+    h = client.submit("a", "d", "t", n_perm=32, seed=3, deadline_s=0.0)
+    time.sleep(0.05)
+    srv.start()
+    with pytest.raises(ServeError, match="deadline exceeded"):
+        client.result(h, timeout=600)
+    srv.close()
+    ev = read_events(str(tmp_path / "tel.jsonl"))
+    exp = [e for e in ev if e["ev"] == "request_expired"]
+    assert exp and exp[0]["data"]["folded"] == 0
+    # it never reached a pack
+    assert not any(e["ev"] == "request_packed" for e in ev)
+
+
+def test_enforce_deadlines_off_restores_sort_key_semantics(fx, tmp_path):
+    srv, client = make_server(fx, tmp_path, enforce_deadlines=False)
+    try:
+        res = client.analyze("a", "d", "t", n_perm=32, seed=3,
+                             deadline_s=0.0, timeout=600)
+    finally:
+        srv.close()
+    assert res["completed"] == 32          # PR 7: deadline never enforced
+
+
+# ---------------------------------------------------------------------------
+# brownout (overload shedding)
+# ---------------------------------------------------------------------------
+
+def test_brownout_enter_shed_exit_ordering(fx, tmp_path):
+    """Enter past the drain-time threshold (event), shed the NEWEST
+    requests of the LOWEST-weight tenant with a retry_after_s hint while
+    heavier tenants stay admitted, exit with hysteresis once the queue
+    drains (event) — enter strictly before exit, exactly one pair."""
+    srv, client = make_server(
+        fx, tmp_path, tenants=(), start=False,
+        brownout_enter_s=1.0, brownout_rate_pps=10.0,
+    )
+    srv.register_tenant("hi", weight=2)
+    srv.register_tenant("lo", weight=1)
+    for t in ("hi", "lo"):
+        client.register_dataset(t, "d", network=fx["dn"],
+                                correlation=fx["dc"], data=fx["dd"],
+                                assignments=fx["assign"])
+        client.register_dataset(t, "t", network=fx["tn"],
+                                correlation=fx["tc"], data=fx["td"])
+    # 64 perms at an assumed 10 perms/s = 6.4s estimated drain > 1s
+    h1 = client.submit("hi", "d", "t", n_perm=64, seed=1)
+    assert srv.stats()["brownout"] is True
+    with pytest.raises(QueueFull) as exc:
+        client.submit("lo", "d", "t", n_perm=64, seed=2)
+    assert exc.value.retry_after_s is not None
+    assert exc.value.retry_after_s > 0
+    h2 = client.submit("hi", "d", "t", n_perm=64, seed=3)  # weight 2: kept
+    srv.start()
+    try:
+        client.result(h1, timeout=600)
+        client.result(h2, timeout=600)
+        st = srv.stats()
+    finally:
+        srv.close()
+    assert st["brownout"] is False and st["tenants"]["lo"]["rejected"] == 1
+    ev = read_events(str(tmp_path / "tel.jsonl"))
+    names = [e["ev"] for e in ev if e["ev"].startswith("serve_brownout")]
+    assert names == ["serve_brownout_enter", "serve_brownout_exit"]
+    rej = [e for e in ev if e["ev"] == "request_rejected"]
+    assert rej[0]["data"]["reason"] == "brownout"
+    assert rej[0]["data"]["retry_after_s"] > 0
+
+
+def test_brownout_off_by_default(fx, tmp_path):
+    srv, client = make_server(fx, tmp_path, start=False)
+    for i in range(4):
+        client.submit("a", "d", "t", n_perm=64, seed=i)
+    assert srv.stats()["brownout"] is False
+    srv.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# bounded drain (SIGTERM satellite)
+# ---------------------------------------------------------------------------
+
+def test_drain_timeout_journals_remainder_for_restart(fx, tmp_path):
+    jpath = str(tmp_path / "j.jsonl")
+    srv, client = make_server(fx, tmp_path, start=False, journal=jpath)
+    h = client.submit("a", "d", "t", n_perm=32, seed=3,
+                      idempotency_key="K")
+    # the worker never starts: the bounded drain cannot finish the queue
+    srv.close(drain=True, timeout=0.05)
+    assert srv._last_drain_requeued == 1
+    with pytest.raises(ServeError, match="journaled as requeued"):
+        client.result(h, timeout=1)
+    state = jnl.scan(jpath)
+    assert [r["key"] for r in state["pending"]] == ["K"]
+    assert state["n_drain_requeued"] == 1
+    # the next --recover boot completes the journaled remainder
+    srv2 = PreservationServer(ServeConfig(
+        engine=CFG, journal=jpath, recover=True,
+        telemetry=str(tmp_path / "tel2.jsonl"),
+    ))
+    client2 = InProcessClient(srv2)
+    try:
+        res = client2.analyze("a", "d", "t", n_perm=32, seed=3,
+                              idempotency_key="K", timeout=600)
+    finally:
+        srv2.close()
+    d = direct(fx, n_perm=32, seed=3)
+    np.testing.assert_array_equal(res["p_values"], np.asarray(d.p_values))
+
+
+# ---------------------------------------------------------------------------
+# wire hardening (server.py satellite)
+# ---------------------------------------------------------------------------
+
+def test_wire_malformed_lines_keep_the_loop_alive(fx, tmp_path):
+    import io
+
+    from netrep_tpu.serve.server import (
+        MAX_LINE_BYTES, dispatch_op, read_op_line,
+    )
+
+    srv, _client = make_server(fx, tmp_path, start=False)
+    stop = threading.Event()
+    lines = io.StringIO(
+        "not json at all\n"
+        "[1, 2, 3]\n"
+        '{"op": "launch_missiles"}\n'
+        '{"op": "ping"}\n'
+    )
+    responses = []
+    while True:
+        op, resp = read_op_line(lines, srv)
+        if op is None and resp is None:
+            break
+        if resp is None:
+            resp = dispatch_op(srv, op, stop)
+        responses.append(resp)
+    srv.close(drain=False)
+    assert [r["ok"] for r in responses] == [False, False, False, True]
+    assert responses[0]["malformed"] and "bad JSON" in responses[0]["error"]
+    assert responses[1]["malformed"]          # non-object op
+    assert "unknown op" in responses[2]["error"]
+    assert responses[3]["pong"] is True       # the loop survived it all
+    ev = read_events(str(tmp_path / "tel.jsonl"))
+    assert sum(1 for e in ev if e["ev"] == "request_malformed") == 3
+
+
+def test_wire_oversized_line_is_rejected_and_drained(fx, tmp_path,
+                                                     monkeypatch):
+    import io
+
+    from netrep_tpu.serve import server as srv_mod
+
+    monkeypatch.setattr(srv_mod, "MAX_LINE_BYTES", 64)
+    srv, _client = make_server(fx, tmp_path, start=False)
+    lines = io.StringIO('{"op": "ping", "junk": "' + "x" * 500 + '"}\n'
+                        '{"op": "ping"}\n')
+    op, resp = srv_mod.read_op_line(lines, srv)
+    assert op is None and resp["malformed"]
+    assert "exceeds" in resp["error"]
+    # the oversized line was fully drained: the NEXT line parses cleanly
+    op, resp = srv_mod.read_op_line(lines, srv)
+    srv.close(drain=False)
+    assert resp is None and op == {"op": "ping"}
+
+
+def test_queue_full_wire_response_is_retryable_with_hint(fx, tmp_path):
+    from netrep_tpu.serve.server import dispatch_op
+
+    srv, client = make_server(fx, tmp_path, start=False, max_queue=1,
+                              brownout_rate_pps=10.0)
+    client.submit("a", "d", "t", n_perm=64, seed=1)
+    resp = dispatch_op(srv, {"op": "analyze", "tenant": "a",
+                             "discovery": "d", "test": "t",
+                             "n_perm": 64, "seed": 2},
+                       threading.Event())
+    srv.close(drain=False)
+    assert resp["ok"] is False and resp["retryable"] is True
+    assert resp["retry_after_s"] > 0
+    assert "QueueFull" in resp["error"]
+
+
+# ---------------------------------------------------------------------------
+# client retry-with-backoff
+# ---------------------------------------------------------------------------
+
+def test_retry_delay_deterministic_jitter():
+    # the faults.py convention: (token, attempt) fully determine the delay
+    assert retry_delay(1, "k") == retry_delay(1, "k")
+    assert retry_delay(1, "k") != retry_delay(1, "other")
+    d1, d2, d3 = (retry_delay(a, "k", jitter=0.0) for a in (1, 2, 3))
+    assert d1 < d2 < d3 and d2 == 2 * d1      # exponential, no jitter
+    assert retry_delay(10, "k", max_s=1.5, jitter=0.0) == 1.5
+
+
+def test_client_retry_attaches_to_one_computation(fx, tmp_path):
+    """A QueueFull'd analyze retried by the client under one idempotency
+    key lands on exactly ONE computation once admitted."""
+
+    class FlakyAdmission:
+        """Server proxy whose submit rejects the first two attempts."""
+
+        def __init__(self, server):
+            self.server = server
+            self.rejections = 0
+
+        def analyze(self, tenant, discovery, test, timeout=None, **kw):
+            if self.rejections < 2:
+                self.rejections += 1
+                raise QueueFull("synthetic overload", retry_after_s=0.01)
+            return self.server.analyze(tenant, discovery, test,
+                                       timeout=timeout, **kw)
+
+    srv, _client = make_server(fx, tmp_path)
+    proxy = InProcessClient(FlakyAdmission(srv))
+    sleeps = []
+    try:
+        res = proxy.analyze("a", "d", "t", n_perm=32, seed=3,
+                            retries=3, retry_base_s=0.0,
+                            sleep=sleeps.append, timeout=600)
+        st = srv.stats()
+    finally:
+        srv.close()
+    assert res["completed"] == 32
+    assert len(sleeps) == 2 and all(s >= 0.01 for s in sleeps)
+    assert st["tenants"]["a"]["received"] == 1   # one admitted computation
+    d = direct(fx, n_perm=32, seed=3)
+    np.testing.assert_array_equal(res["p_values"], np.asarray(d.p_values))
+
+
+# ---------------------------------------------------------------------------
+# fault-plan surface for the drills
+# ---------------------------------------------------------------------------
+
+def test_crash_and_sigkill_plan_kinds_parse():
+    specs = parse_plan("crash@24;sigkill@64x1")
+    assert [(s.kind, s.at_perm) for s in specs] == [
+        ("crash", 24), ("sigkill", 64),
+    ]
+    with pytest.raises(ValueError):
+        parse_plan("explode@3")

@@ -275,6 +275,14 @@ def test_serve_event_names_pinned():
         "request_packed",
         "request_done",
         "request_rejected",
+        # crash-safe serving (ISSUE 10): deadline misses, idempotency
+        # dedup, brownout shedding, journal replay, wire hardening
+        "request_expired",
+        "request_deduped",
+        "serve_brownout_enter",
+        "serve_brownout_exit",
+        "journal_replayed",
+        "request_malformed",
     )
 
 
@@ -295,15 +303,19 @@ def test_tenant_summary_folds_serve_events():
         ev("request_received", tenant="b"),
         ev("request_rejected", tenant="b", reason="queue_full"),
         ev("request_done", tenant="b", ok=False, s=1.5, error="Boom"),
+        ev("request_expired", tenant="b", miss_s=0.2),
+        ev("request_deduped", tenant="a", state="completed"),
         ev("chunk", done=3),           # non-serve events are ignored
         ev("request_done", s=0.1),     # no tenant label: skipped
     ]
     rows = tenant_summary(events)
     assert rows["a"] == {
         "received": 1, "packed": 1, "done": 1, "failed": 0, "rejected": 0,
-        "perms": 128, "latency": [1, 0.5, 0.5, 0.5],
+        "expired": 0, "deduped": 1, "perms": 128,
+        "latency": [1, 0.5, 0.5, 0.5],
     }
     assert rows["b"]["rejected"] == 1 and rows["b"]["failed"] == 1
+    assert rows["b"]["expired"] == 1
     # the rendered section names both tenants (smoke the CLI surface)
     import json
 
