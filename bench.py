@@ -1043,12 +1043,133 @@ def bench_pallas(args):
     return emit(row)
 
 
+def _grouped_support_data(genes, samples, groups, seed=0):
+    """Cell-type-block synthetic data for the screening rows: each gene
+    expressed (zero-mean within its block) in one sample block, genes
+    sorted by block, over a small everywhere-noise floor — the sparse,
+    modular structure whose segment-norm bounds make exact screening
+    effective (ISSUE 11)."""
+    rng = np.random.default_rng(seed)
+    x = 0.01 * rng.standard_normal((samples, genes)).astype(np.float32)
+    gsz, ssz = genes // groups, samples // groups
+    for g in range(groups):
+        c0, c1 = g * gsz, (g + 1) * gsz if g < groups - 1 else genes
+        r0, r1 = g * ssz, (g + 1) * ssz if g < groups - 1 else samples
+        blk = rng.standard_normal((r1 - r0, c1 - c0))
+        fac = rng.standard_normal(r1 - r0)
+        blk += 1.5 * fac[:, None] * (rng.random(c1 - c0) < 0.5)
+        x[r0:r1, c0:c1] += (blk - blk.mean(axis=0)).astype(np.float32)
+    return x
+
+
+def bench_atlas_screen(args):
+    """Exact tile screening (ISSUE 11): a screened-vs-unscreened pair of
+    tile-pass rows on grouped-support synthetic data. On TPU the screened
+    row is the synthetic 1M-gene top-k shape (the grid the unscreened
+    pass cannot afford to visit) with the pair's shared-shape comparison
+    at 100k genes; on the CPU fallback both rows are an explicitly
+    labeled reduced-n mechanism pair. Screened/unscreened BIT-PARITY is
+    asserted in-bench before any row is emitted. Every row reports the
+    ``tiles_skipped`` fraction and ``nxn_bytes_avoided`` (correlation
+    bytes never computed); the ``atlas-screen`` metric label splits the
+    perf-ledger fingerprints from the PR 9 atlas rows."""
+    import jax
+
+    from netrep_tpu.atlas import TiledNetwork, build_sparse_network
+    from netrep_tpu.utils.config import EngineConfig
+
+    on_cpu = jax.default_backend() == "cpu"
+    top_k = 16
+    beta = 2.0
+    cfg = EngineConfig(autotune=False)
+    if on_cpu:
+        genes, samples, groups, edge = 4096, 64, 16, 128
+        if args.smoke:
+            genes, samples, groups, edge = 1536, 48, 12, 64
+        pair_genes = genes                 # pair shares the reduced shape
+        big_genes = None
+    else:
+        genes, samples, groups, edge = 100_000, 64, 16, 1024
+        pair_genes = genes                 # shared-shape pair at 100k
+        big_genes = 1_000_000              # screened headline row
+
+    def build(x, screen, **kw):
+        tn = TiledNetwork.from_data(x, beta)
+        t0 = time.perf_counter()
+        b = build_sparse_network(
+            tn, top_k=top_k, tile_edge=edge, config=cfg, screen=screen,
+            screen_segments=groups, degree=False, **kw,
+        )
+        return b, time.perf_counter() - t0
+
+    # parity gate: screened == unscreened, bit for bit, before any row
+    x = _grouped_support_data(pair_genes, samples, groups)
+    un, un_s = build(x, screen=False)
+    sc, sc_s = build(x, screen=True)
+    assert np.array_equal(un.correlation.nbr, sc.correlation.nbr) and \
+        np.array_equal(un.correlation.wgt, sc.correlation.wgt) and \
+        np.array_equal(un.adjacency.wgt, sc.adjacency.wgt), \
+        "screened tile pass diverged from the unscreened reference"
+
+    def row(build_res, wall, n_genes, screened, vs=None):
+        r = {
+            "metric": (
+                f"atlas-screen {'screened' if screened else 'unscreened'}"
+                f" tile pass ({n_genes} genes, top_k={top_k}, "
+                f"edge={build_res.tile_edge})"
+            ),
+            "value": round(wall, 3),
+            "unit": "s",
+            "vs_baseline": None,
+            "genes_per_sec": round(n_genes / wall, 1),
+            "tile_edge": build_res.tile_edge,
+            "supertile": build_res.supertile,
+            "tiles_total": build_res.tiles_total,
+            "tiles_dispatched": build_res.tiles_dispatched,
+            # the acceptance fraction: share of the grid never dispatched
+            "tiles_skipped": round(
+                build_res.tiles_skipped / max(1, build_res.tiles_total), 4
+            ),
+            "tiles_skipped_count": build_res.tiles_skipped,
+            # correlation bytes whose tiles were never computed (0 on the
+            # unscreened row — it visits the whole grid)
+            "nxn_bytes_avoided": (
+                build_res.tiles_skipped * build_res.tile_edge ** 2 * 4
+            ),
+            "strip_bytes_full": build_res.strip_bytes_full,
+            "strip_bytes_moved": build_res.strip_bytes_moved,
+            "edges_selected": build_res.selected_edges,
+            "device": str(jax.devices()[0]),
+        }
+        if vs is not None:
+            r["vs_unscreened"] = round(vs, 3)
+        if on_cpu:
+            r["tpu_fallback"] = TPU_FALLBACK
+            r["metric"] += (
+                " [CPU mechanism row, reduced n — the 1M-gene screened "
+                "shape is only measured on TPU]"
+            )
+        return emit(r)
+
+    rows = [
+        row(un, un_s, pair_genes, screened=False),
+        row(sc, sc_s, pair_genes, screened=True, vs=un_s / sc_s),
+    ]
+    if big_genes is not None:
+        xb = _grouped_support_data(big_genes, samples, groups, seed=1)
+        scb, scb_s = build(xb, screen=True)
+        rows.append(row(scb, scb_s, big_genes, screened=True))
+    return rows[-1]
+
+
 def bench_atlas(args):
     """Atlas tiled network plane (ISSUE 9): the tile-grid construction
     pass (data columns → per-row top-k SparseAdjacency + global degree,
     never materializing n×n) followed by the data-only permutation null
     (``correlation=None, network=None`` — every k×k submatrix derived
-    from gathered data columns) on the SAME synthetic data.
+    from gathered data columns) on the SAME synthetic data, then the
+    ISSUE 11 screened-vs-unscreened pair (:func:`bench_atlas_screen`;
+    ``--screen-only`` skips straight to the pair).
 
     On TPU the row is the synthetic 100k-gene / 50-module atlas shape —
     the workload class the dense path cannot represent (a 100k×100k f32
@@ -1065,6 +1186,8 @@ def bench_atlas(args):
     from netrep_tpu.utils.config import EngineConfig
     from netrep_tpu.utils.profiling import make_memory_probe
 
+    if args.screen_only:
+        return bench_atlas_screen(args)
     resolve(args, 100_000, 50, 1000)
     on_cpu = jax.default_backend() == "cpu"
     top_k = 16
@@ -1154,7 +1277,8 @@ def bench_atlas(args):
             "is only measured on TPU]"
         )
         row["vs_baseline"] = None
-    return emit(row)
+    emit(row)
+    return bench_atlas_screen(args)
 
 
 def bench_multichip_child(args):
@@ -1435,6 +1559,10 @@ def main():
                     help="EngineConfig(network_from_correlation=2.0): derive "
                          "network submatrices on device instead of storing "
                          "the n x n network (north/B/D configs)")
+    ap.add_argument("--screen-only", action="store_true",
+                    help="atlas config: emit only the ISSUE 11 "
+                         "screened-vs-unscreened tile-pass pair (skip the "
+                         "PR 9 tile+null row)")
     args = ap.parse_args()
     if args.smoke:
         args.genes, args.modules, args.perms, args.chunk, args.samples = (

@@ -86,14 +86,17 @@ QUEUE=(
   "configD_dn  3600 python bench.py --config D --derived-net"
 )
 
-# Atlas tiled-network-plane step (ISSUE 9; opt-in: ATLAS_STEP=1): the
-# tile-grid construction pass + data-only null at the synthetic
-# 100k-gene shape — a real measurement only on TPU (the CPU fallback
-# emits the labeled reduced-n mechanism row, same policy as pallas).
-# Rides the existing gate pattern: ordinary queue step, tpu_fallback
-# detection, perf-ledger row under its own `atlas` fingerprint prefix.
+# Atlas tiled-network-plane step (ISSUE 9 + 11; opt-in: ATLAS_STEP=1):
+# the tile-grid construction pass + data-only null at the synthetic
+# 100k-gene shape, followed by the ISSUE 11 screened config — the
+# screened-vs-unscreened tile-pass pair (bit-parity asserted in-bench)
+# with the screened 1M-gene top-k headline row — a real measurement
+# only on TPU (the CPU fallback emits the labeled reduced-n mechanism
+# rows, same policy as pallas). Rides the existing gate pattern:
+# ordinary queue step, tpu_fallback detection, perf-ledger rows under
+# their own `atlas` / `atlas-screen` fingerprint prefixes.
 if [ "${ATLAS_STEP:-0}" = "1" ]; then
-  QUEUE+=("configAtlas 1800 python bench.py --config atlas")
+  QUEUE+=("configAtlas 3600 python bench.py --config atlas")
 fi
 
 # Test hooks (tests/test_tpu_watch_logic.py): QUEUE_FILE replaces the

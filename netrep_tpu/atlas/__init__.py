@@ -7,7 +7,10 @@
 - :mod:`~netrep_tpu.atlas.builder` — the streaming construction pass
   (tile grid → :class:`~netrep_tpu.ops.sparse.SparseAdjacency` edges +
   global degree vectors; checkpointable, fault-covered, traced,
-  mesh-shardable, autotuned tile edge);
+  mesh-shardable, autotuned tile edge) with exact tile screening
+  (ISSUE 11: ``screen=True`` dispatches only tiles whose column-moment
+  bound clears the τ cut / running top-k floor — work proportional to
+  signal, output bit-identical to the unscreened scan);
 - :mod:`~netrep_tpu.atlas.modules` — the data-only k×k module plane the
   dense permutation engine runs on with ``correlation=None,
   network=None`` (user surface:
@@ -15,11 +18,15 @@
 """
 
 from .builder import AtlasBuild, build_sparse_network
-from .tiles import TiledNetwork, derived_net_np
+from .tiles import (
+    TiledNetwork, derived_net_np, supertile_maxima, tile_norm_maxima,
+)
 
 __all__ = [
     "AtlasBuild",
     "TiledNetwork",
     "build_sparse_network",
     "derived_net_np",
+    "supertile_maxima",
+    "tile_norm_maxima",
 ]

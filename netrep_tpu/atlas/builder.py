@@ -1,44 +1,81 @@
-"""Streaming construction pass over the tile grid (ISSUE 9 tentpole).
+"""Streaming construction pass over the tile grid (ISSUE 9 tentpole,
+ISSUE 11 exact tile screening).
 
 One scan of the tile grid of a :class:`~netrep_tpu.atlas.tiles
 .TiledNetwork` produces, without ever materializing n×n:
 
 - **thresholded edges** — per-row top-k (device ``lax.top_k`` over the
-  row strip, O(edge·k) transferred) or ``|r| ≥ τ`` (host-selected) —
+  row strip, O(edge·k) transferred) or ``|r| ≥ τ`` (device-masked: only
+  surviving entries + flat indices cross the wire, ISSUE 11 satellite) —
   emitted directly into the existing
   :class:`~netrep_tpu.ops.sparse.SparseAdjacency` neighbor-list format,
   symmetrized by union: the bridge that puts atlas-scale data-only
   inputs onto the Config E sparse engine
   (``sparse_module_preservation``) unchanged;
 - **per-node degree vectors** over the FULL derived network (every
-  column, not just the kept edges) — the global topology the seven
-  statistics' dense-path contracts are defined against, accumulated one
-  row strip at a time.
+  column, not just the kept edges) — optional (``degree=``): the global
+  topology is a sum over every tile, so it is only available on an
+  unscreened pass.
+
+**Exact tile screening** (ISSUE 11 tentpole, ``screen=True``): at 1M
+genes the grid has 100× the tiles of the 100k ceiling and — in the
+sparse, modular structure real co-expression data has — almost every
+tile is noise that provably cannot contribute an edge. The screened pass
+makes work proportional to signal while staying bit-identical to the
+unscreened scan by construction:
+
+1. **column moments**: per-column sample-segment norms of the
+   standardized data (:meth:`TiledNetwork.column_moments`) give, by
+   Cauchy–Schwarz applied per segment, an upper bound on any
+   correlation a tile can contain from O(n·P) numbers
+   (:func:`~netrep_tpu.atlas.tiles.tile_norm_maxima`);
+2. **two-resolution scan**: coarse super-tile bounds over groups of
+   ``supertile`` tiles (:func:`~netrep_tpu.atlas.tiles
+   .supertile_maxima`; τ mode prunes whole S×S blocks of the grid from
+   one precomputed super-bound table) → surviving groups refine into
+   per-tile bounds → only surviving tiles are dispatched, as a
+   fixed-shape worklist program (power-of-two bucketed, mesh-shardable
+   over the worklist axis exactly like the unscreened tile axis);
+3. **threshold floors**: a tile is skipped when its bound (plus a
+   float32 forward-error margin) falls below the active threshold — the
+   τ cut, or the **running per-row top-k floor**: the k-th best |r| each
+   row has accumulated so far, which tightens monotonically as the
+   block's groups are processed in descending-bound order. Skipping is
+   exact: every value in a skipped tile is strictly below anything that
+   could enter the output, so the screened pass emits bit-identical
+   edges (same values, same order) as the unscreened pass.
 
 Operational contract (the PR 2/4/5/6 machinery, applied to a new loop):
 
 - **chunk-checkpointable**: after every ``checkpoint_every`` row blocks
   the pass persists its accumulators through the null-checkpoint format
-  (``x_atlas_*`` extras; interrupt → resume is exact, and a checkpoint
-  from a different spec/edge/threshold refuses with the usual
-  informative error);
-- **fault-policy-covered**: each strip dispatch runs under the PR 4/6
-  recovery ladder (transient retry with deterministic backoff, hang
-  abandon, device-loss failure-save before the error propagates);
+  (``x_atlas_*`` extras — COO so-far plus the screening tally so
+  interrupt → resume replays the same tightened floors and keeps the
+  skip counters exact; a checkpoint from a different spec/edge/
+  threshold/degree refuses with the usual informative error, while the
+  **screening toggle deliberately shares the fingerprint**: screened and
+  unscreened passes produce bit-identical output, so a checkpoint from
+  either resumes under the other);
+- **fault-policy-covered**: each dispatch (full strip or screened
+  worklist group) runs under the PR 4/6 recovery ladder;
 - **traced**: a ``tile_pass_start``/``tile_pass_end`` span with one
-  ``tile`` event per row block (duration, edges kept, device-memory
-  gauges) on the PR 5 trace tree;
+  ``tile`` event per row block plus, when screening, one ``tile_screen``
+  event per row block (bound-math duration, tiles skipped/dispatched,
+  active floor) on the PR 5 trace tree; the pass-end event carries
+  ``tiles_skipped``/``nxn_bytes_avoided`` (correlation bytes never
+  computed) and the strip-transfer byte split;
 - **autotuned**: the tile edge resolves from the persistent cache
-  (:func:`netrep_tpu.utils.autotune.resolve_tile_edge`, recorded beside
-  the superchunk entry) and the measured columns/s feed back per edge;
-- **mesh-shardable**: with a mesh, the strip's column tiles spread over
+  (:func:`netrep_tpu.utils.autotune.resolve_tile_edge`) and, when
+  screening, the super-tile factor beside it
+  (:func:`netrep_tpu.utils.autotune.resolve_supertile`);
+- **mesh-shardable**: strips and screened worklists spread over
   ``config.mesh_axis`` under ``shard_map`` — each device runs the SAME
-  fixed-shape per-tile program on its tile subset, so the sharded pass
-  is bit-identical to the single-device pass (pinned in
-  tests/test_atlas.py).
+  fixed-shape per-tile program on its subset, and cross-tile folds
+  happen on the host in float64, so sharded passes (screened or not)
+  are bit-identical to the single-device pass.
 
-Device memory stays bounded by the tile working set (O(edge·n) strip +
-O(n·s) data columns); host memory is O(n·k) selected edges.
+Device memory stays bounded by the tile working set; host memory is
+O(n·k) selected edges plus the O(n·P) moment table.
 """
 
 from __future__ import annotations
@@ -57,12 +94,14 @@ from ..ops import stats as jstats
 from ..ops.sparse import SparseAdjacency
 from ..utils import faults as flt
 from ..utils import telemetry as tm
-from ..utils.autotune import make_key, resolve_tile_edge
+from ..utils.autotune import make_key, resolve_supertile, resolve_tile_edge
 from ..utils.checkpoint import (
     load_null_checkpoint, save_null_checkpoint, validate_identity,
 )
 from ..utils.config import EngineConfig
-from .tiles import TiledNetwork, derived_net_np
+from .tiles import (
+    TiledNetwork, derived_net_np, supertile_maxima, tile_norm_maxima,
+)
 
 
 @dataclasses.dataclass
@@ -73,21 +112,39 @@ class AtlasBuild:
     ``correlation`` the raw r values on the SAME neighbor structure —
     together they are the (network, sparse-correlation) pair the Config E
     engine consumes; ``degree`` is the full (unthresholded) derived-net
-    weighted degree per node."""
+    weighted degree per node, or None when the pass ran with
+    ``degree=False`` (always the case under screening: the full degree is
+    a sum over every tile, including the ones screening exists to skip).
+    The screening tally (``tiles_*``, ``strip_bytes_*``) mirrors what the
+    ``tile_pass_end`` telemetry span reports."""
 
     adjacency: SparseAdjacency
     correlation: SparseAdjacency
-    degree: np.ndarray             # (n,) float64
+    degree: np.ndarray | None
     n: int
     tile_edge: int
     n_blocks: int
     selected_edges: int            # directed selections before symmetrize
+    supertile: int = 0             # coarse group factor (0 = unscreened)
+    tiles_total: int = 0           # real tiles in the scanned grid
+    tiles_dispatched: int = 0
+    tiles_skipped: int = 0
+    strip_bytes_full: int = 0      # what full-strip transfers would move
+    strip_bytes_moved: int = 0     # what actually crossed the wire
 
 
-def _fingerprint(net: TiledNetwork, edge: int, top_k, tau) -> np.ndarray:
+def _fingerprint(net: TiledNetwork, edge: int, top_k, tau,
+                 degree: bool) -> np.ndarray:
+    """Checkpoint identity of one pass. DELIBERATELY excludes the
+    screening knobs (``screen``/``supertile``/``screen_segments``):
+    screened and unscreened passes produce bit-identical output, so they
+    share a fingerprint and a checkpoint written by either resumes under
+    the other (pinned in tests/test_atlas_screen.py). The threshold rule
+    (top_k/τ), tile edge, and the degree flag each change the output, so
+    they refuse."""
     spec = (
         f"atlas-pass|{net.spec_digest()}|n:{net.n}|edge:{int(edge)}"
-        f"|topk:{top_k}|tau:{tau}"
+        f"|topk:{top_k}|tau:{tau}|deg:{int(bool(degree))}"
     )
     return np.frombuffer(spec.encode(), dtype=np.uint8)
 
@@ -97,22 +154,51 @@ def _fingerprint(net: TiledNetwork, edge: int, top_k, tau) -> np.ndarray:
 #: tautology here rather than a special case
 _KEY_DATA = np.zeros(2, dtype=np.uint32)
 
+#: column sentinel for empty top-k candidate slots: sorts after every real
+#: column index, so tie-breaking against real candidates is never affected
+_COL_SENTINEL = np.int64(1) << 62
 
-def _build_strip_fn(edge: int, T: int, n: int, s: int, beta, top_k,
-                    mesh, mesh_axis: str) -> Callable:
-    """Jitted row-strip program: ``(zI, z_tiles, row0) -> parts``.
 
-    ``z_tiles`` is the full standardized matrix reshaped to (T, edge, s);
-    each tile is one fixed-shape (edge, s)×(s, edge) matmul, and EVERY
-    arithmetic step — correlation, pair mask, derived-net values, and the
-    per-tile partial degree — happens inside that fixed-shape per-tile
-    body. A shard_map over the tile axis therefore runs the identical
-    per-tile program on a subset: bitwise equality with the single-device
-    pass by construction (the cross-tile degree accumulation happens on
-    the HOST in float64, where summation order is fixed). Returns
-    ``(deg_parts (T, edge), idxs, r_sel, score_sel)`` in top-k mode or
-    ``(deg_parts, masked r strip)`` in threshold mode (host selects)."""
-    tile_ids = jnp.arange(T, dtype=jnp.int32)
+def _bound_margin(s: int) -> float:
+    """Safety margin added to every screening bound before comparing it to
+    a threshold: the bounds are exact for the real-valued correlations,
+    but the device computes ``r`` in float32 — a length-``s`` f32 dot
+    product of unit vectors carries forward error ≤ ~s·2⁻²⁴, so the
+    margin (16× that, plus an absolute floor) guarantees even the rounded
+    |r| of a skipped tile stays strictly below the active threshold."""
+    return 16.0 * s * 2.0 ** -24 + 1e-7
+
+
+def _bucket_width(n_work: int, ax: int) -> int:
+    """Fixed-shape worklist width for ``n_work`` surviving tiles: next
+    power of two (few distinct widths → few compiles), then rounded up to
+    a multiple of the mesh axis so a sharded dispatch splits evenly."""
+    w = 1
+    while w < n_work:
+        w <<= 1
+    if ax > 1:
+        w = -(-w // ax) * ax
+    return w
+
+
+def _tau_ceil32(tau: float) -> np.float32:
+    """Smallest float32 ≥ τ. Comparing a float32 |r| against it is
+    EXACTLY the float64 comparison ``|r| ≥ τ`` (every f32 is exact in
+    f64), so device-side selection reproduces the host-f64 criterion bit
+    for bit — including knife-edge values."""
+    t = np.float32(tau)
+    if float(t) < tau:
+        t = np.nextafter(t, np.float32(np.inf), dtype=np.float32)
+    return t
+
+
+def _tile_body(edge: int, n: int, beta, with_deg: bool) -> Callable:
+    """The fixed-shape per-tile program every dispatch composes: one
+    (edge, s)×(s, edge) MXU matmul, clip, pair-validity mask (worklist
+    padding slots carry ``tile_id = -1`` and mask out entirely), |r|
+    score, and — degree passes only — the derived-net partial degree.
+    Identical between the full-strip and worklist paths, so screened and
+    unscreened dispatches produce bit-identical tiles."""
 
     def one_tile(zI, zj, tile_id, row0):
         r = jnp.clip(
@@ -121,46 +207,144 @@ def _build_strip_fn(edge: int, T: int, n: int, s: int, beta, top_k,
         )                                              # (edge, edge)
         cols = tile_id * edge + jnp.arange(edge, dtype=jnp.int32)
         rows = row0 + jnp.arange(edge, dtype=jnp.int32)
-        # pair validity: real column, real row, not the self pair
+        # pair validity: real tile, real column, real row, not self
         mask = (
-            (cols[None, :] < n)
+            (tile_id >= 0)
+            & (cols[None, :] < n)
             & (rows[:, None] < n)
             & (cols[None, :] != rows[:, None])
         )
-        net_vals = jnp.where(mask, jstats.derived_net(r, beta), 0.0)
-        deg_part = jnp.sum(net_vals, axis=-1)          # (edge,)
         score = jnp.where(mask, jnp.abs(r), -1.0)
-        return r, score, deg_part
+        if with_deg:
+            net_vals = jnp.where(mask, jstats.derived_net(r, beta), 0.0)
+            return r, score, jnp.sum(net_vals, axis=-1)
+        return r, score
 
+    return one_tile
+
+
+def _tau_compact(s_flat, r_flat, tau32, cap: int):
+    """Device-side τ selection (ISSUE 11 satellite): instead of shipping
+    the full masked (edge, W·edge) f32 strip to the host, keep only the
+    survivors. ``top_k`` over ``N - flat_index`` (keyed to the selection
+    mask) yields the first ``cap`` surviving flat indices in ascending
+    order — exactly ``np.nonzero``'s row-major order on the host — and a
+    gather pulls their r values. The survivor count rides along so the
+    caller can detect capacity overflow and re-dispatch with a larger
+    ``cap`` (exactness is never at stake, only a recompile)."""
+    sf = s_flat.reshape(-1)
+    n_flat = sf.shape[0]
+    sel = sf >= tau32
+    cnt = jnp.sum(sel.astype(jnp.int32))
+    key = jnp.where(sel, jnp.int32(n_flat) - jnp.arange(n_flat,
+                                                        dtype=jnp.int32), 0)
+    kv, _ = jax.lax.top_k(key, cap)
+    fidx = jnp.int32(n_flat) - kv          # == n_flat at empty slots
+    rv = jnp.take(r_flat.reshape(-1), jnp.minimum(fidx, n_flat - 1))
+    return cnt, fidx, rv
+
+
+def _make_sharded_tiles(one_tile, mesh, mesh_axis):
     def tiles_body(zI, z_tiles, tids, row0):
         return jax.vmap(one_tile, in_axes=(None, 0, 0, None))(
             zI, z_tiles, tids, row0
         )
 
-    if mesh is not None:
-        from ..parallel.sharded import _NO_CHECK_KW, _shard_map
+    if mesh is None:
+        return tiles_body
+    from ..parallel.sharded import _NO_CHECK_KW, _shard_map
 
-        sharded_tiles = _shard_map(
-            tiles_body, mesh=mesh,
-            in_specs=(P(), P(mesh_axis), P(mesh_axis), P()),
-            out_specs=P(mesh_axis),
-            **_NO_CHECK_KW,
-        )
-    else:
-        sharded_tiles = tiles_body
+    return _shard_map(
+        tiles_body, mesh=mesh,
+        in_specs=(P(), P(mesh_axis), P(mesh_axis), P()),
+        out_specs=P(mesh_axis),
+        **_NO_CHECK_KW,
+    )
+
+
+def _build_strip_fn(edge: int, T: int, n: int, s: int, beta, top_k,
+                    tau32, cap, with_deg: bool, mesh,
+                    mesh_axis: str) -> Callable:
+    """Jitted FULL-STRIP program (unscreened path): ``(zI, z_tiles, row0)
+    -> parts`` over all T column tiles. Strip layout (edge, T·edge):
+    the flattened index IS the global column. Cross-tile folds (degree)
+    happen on the HOST in float64 where summation order is fixed, so a
+    shard_map over the tile axis is bitwise-equal by construction.
+    τ mode returns the device-compacted survivors (``cap`` capacity;
+    ``cap=None`` falls back to the full masked strip when the flat index
+    would overflow int32)."""
+    tile_ids = jnp.arange(T, dtype=jnp.int32)
+    one_tile = _tile_body(edge, n, beta, with_deg)
+    sharded_tiles = _make_sharded_tiles(one_tile, mesh, mesh_axis)
 
     def strip(zI, z_tiles, row0):
-        r, score, deg_parts = sharded_tiles(zI, z_tiles, tile_ids, row0)
-        # strip layout (edge, T*edge): flattened index IS the global col
+        out = sharded_tiles(zI, z_tiles, tile_ids, row0)
+        if with_deg:
+            r, score, deg_parts = out
+            head = (deg_parts,)
+        else:
+            r, score = out
+            head = ()
         r_flat = jnp.swapaxes(r, 0, 1).reshape(edge, T * edge)
         s_flat = jnp.swapaxes(score, 0, 1).reshape(edge, T * edge)
-        if top_k is None:
-            return deg_parts, jnp.where(s_flat >= 0, r_flat, 0.0)
-        vals, idxs = jax.lax.top_k(s_flat, top_k)
-        r_sel = jnp.take_along_axis(r_flat, idxs, axis=1)
-        return deg_parts, idxs, r_sel, vals
+        if top_k is not None:
+            vals, idxs = jax.lax.top_k(s_flat, top_k)
+            r_sel = jnp.take_along_axis(r_flat, idxs, axis=1)
+            return head + (idxs, r_sel, vals)
+        if cap is None:
+            return head + (jnp.where(s_flat >= 0, r_flat, 0.0),)
+        return head + _tau_compact(s_flat, r_flat, tau32, cap)
 
     return jax.jit(strip)
+
+
+def _build_group_fn(edge: int, w: int, n: int, s: int, beta, top_k,
+                    tau32, cap, mesh, mesh_axis: str) -> Callable:
+    """Jitted WORKLIST program (screened path): ``(zI, z_tiles, wids,
+    row0) -> parts`` over the ``w`` surviving tiles named by ``wids``
+    (padded with -1; padding masks out entirely). The per-tile body is
+    the SAME fixed-shape program the full strip runs — a worklist
+    dispatch computes bit-identical tiles, and a mesh shard_map over the
+    worklist axis is bit-identical for the same reason the tile axis is.
+    Top-k mode returns the group-local top-k per row (the host merges
+    groups under the running floor); τ mode device-compacts survivors."""
+    one_tile = _tile_body(edge, n, beta, False)
+    sharded_tiles = _make_sharded_tiles(one_tile, mesh, mesh_axis)
+    kk = None if top_k is None else int(min(top_k, w * edge))
+
+    def group(zI, z_tiles, wids, row0):
+        zw = jnp.take(z_tiles, jnp.maximum(wids, 0), axis=0)
+        r, score = sharded_tiles(zI, zw, wids, row0)
+        r_flat = jnp.swapaxes(r, 0, 1).reshape(edge, w * edge)
+        s_flat = jnp.swapaxes(score, 0, 1).reshape(edge, w * edge)
+        if kk is not None:
+            vals, idxs = jax.lax.top_k(s_flat, kk)
+            r_sel = jnp.take_along_axis(r_flat, idxs, axis=1)
+            return idxs, r_sel, vals
+        return _tau_compact(s_flat, r_flat, tau32, cap)
+
+    return jax.jit(group)
+
+
+def _merge_topk(cv, cc, cr, nv, nc, nr, k: int):
+    """Fold one group's per-row candidates into the running per-row
+    top-k. Two stable sorts — columns ascending, then score descending —
+    reproduce ``lax.top_k``'s exact ordering contract (value desc, ties
+    by ascending global column), so the merged sequence is bit-identical
+    to what a single full-strip top-k would have produced."""
+    v = np.concatenate([cv, nv], axis=1)
+    c = np.concatenate([cc, nc], axis=1)
+    r = np.concatenate([cr, nr], axis=1)
+    o1 = np.argsort(c, axis=1, kind="stable")
+    v = np.take_along_axis(v, o1, axis=1)
+    c = np.take_along_axis(c, o1, axis=1)
+    r = np.take_along_axis(r, o1, axis=1)
+    o2 = np.argsort(-v, axis=1, kind="stable")
+    return (
+        np.take_along_axis(v, o2, axis=1)[:, :k],
+        np.take_along_axis(c, o2, axis=1)[:, :k],
+        np.take_along_axis(r, o2, axis=1)[:, :k],
+    )
 
 
 def build_sparse_network(
@@ -171,17 +355,37 @@ def build_sparse_network(
     tile_edge: int | None = None,
     config: EngineConfig | None = None,
     mesh=None,
+    screen: bool = False,
+    supertile: int | None = None,
+    screen_segments: int = 8,
+    degree: bool | None = None,
     checkpoint_path: str | None = None,
     checkpoint_every: int = 1,
     progress: Callable[[int, int], None] | None = None,
     telemetry=None,
     fault_policy=None,
+    _screen_observer: Callable | None = None,
 ) -> AtlasBuild:
     """One streaming scan of the tile grid (module docstring). Exactly one
     of ``top_k`` (per-row strongest |r| edges, device-selected) / ``tau``
-    (``|r| ≥ τ``, τ > 0, host-selected) picks the threshold rule.
-    ``checkpoint_every`` counts ROW BLOCKS; an interrupted pass resumes
-    exactly from ``checkpoint_path``."""
+    (``|r| ≥ τ``, τ > 0, device-masked) picks the threshold rule.
+
+    ``screen=True`` turns on the exact screening pass: only tiles whose
+    moment bound clears the active threshold (τ, or the running per-row
+    top-k floor) are dispatched — output bit-identical to ``screen=False``
+    by construction. Screening requires ``degree=False`` (the full-network
+    degree is a sum over every tile); ``degree`` defaults to ``not
+    screen``. ``supertile`` overrides the autotuned coarse group factor,
+    ``screen_segments`` the number of sample segments the moment bounds
+    use (more segments = tighter bounds on support-structured data; any
+    value is exact). ``checkpoint_every`` counts ROW BLOCKS; an
+    interrupted pass resumes exactly from ``checkpoint_path``, including
+    across a screening toggle (shared fingerprint).
+
+    ``_screen_observer(block, level, tile_ids, threshold)`` is a test
+    hook: called on every skip decision with the tiles skipped and the
+    active threshold they were judged against.
+    """
     if (top_k is None) == (tau is None):
         raise ValueError("pass exactly one of top_k (int) or tau (float)")
     if top_k is not None and top_k < 1:
@@ -191,22 +395,57 @@ def build_sparse_network(
             f"tau must be > 0 (τ=0 would keep every pair — the dense "
             f"matrix the tile plane exists to avoid), got {tau}"
         )
+    if degree is None:
+        degree = not screen
+    with_deg = bool(degree)
+    if screen and with_deg:
+        raise ValueError(
+            "screen=True cannot compute the full-network degree vector — "
+            "the degree is a sum over every tile, including the ones "
+            "screening skips; pass degree=False (the screened default) "
+            "or screen=False"
+        )
     config = config or EngineConfig()
     n, s = net.n, net.n_samples
 
+    mode = "topk" if top_k is not None else "tau"
     at_key = make_key(
         jax.default_backend(), "atlas-tiles", f"n{n}s{s}", 0,
-        "topk" if top_k is not None else "tau",
+        mode + ("+screen" if screen else ""),
     )
     edge, at_cache = resolve_tile_edge(config, at_key, explicit=tile_edge)
     edge = int(min(edge, max(8, -(-n // 8) * 8)))
     T = -(-n // edge)                      # column tiles
+    ax = 1
     if mesh is not None:
         ax = mesh.shape[config.mesh_axis]
         T = -(-T // ax) * ax               # pad tile count to the mesh
     n_pad = T * edge
     B = -(-n // edge)                      # row blocks (real rows only)
+    T_real = -(-n // edge)                 # real column tiles
     k_eff = None if top_k is None else int(min(top_k, max(1, n - 1)))
+    tau32 = None if tau is None else _tau_ceil32(tau)
+    tau_cmp = None if tau32 is None else float(tau32)
+
+    # two-resolution screening tables (host float64, deterministic)
+    S_res, st_cache, st_key = 0, None, None
+    A = M = MS = SB = None
+    margin = _bound_margin(s)
+    if screen:
+        st_key = make_key(
+            jax.default_backend(), "atlas-screen", f"n{n}s{s}", 0, mode,
+        )
+        S_res, st_cache = resolve_supertile(config, st_key,
+                                            explicit=supertile)
+        S_res = int(max(1, min(S_res, T_real)))
+        A = net.column_moments(screen_segments)
+        M = tile_norm_maxima(A, edge, T_real)
+        MS = supertile_maxima(M, S_res)
+        if mode == "tau":
+            # super-row × super-col bound grid: one table prunes whole
+            # S×S blocks of the tile grid (row groups tile exactly like
+            # column groups — same gene axis, same edge)
+            SB = np.minimum(MS @ MS.T, 1.0)
 
     tel, tel_owned = tm.resolve_arg(telemetry)
     if tel is None:
@@ -220,7 +459,11 @@ def build_sparse_network(
     cols_l: list[np.ndarray] = []
     corr_l: list[np.ndarray] = []
     start_block = 0
-    fp = _fingerprint(net, edge, k_eff, tau)
+    tiles_dispatched = 0
+    tiles_skipped = 0
+    bytes_full = 0
+    bytes_moved = 0
+    fp = _fingerprint(net, edge, k_eff, tau, with_deg)
     if checkpoint_path is not None:
         ckpt = load_null_checkpoint(checkpoint_path)
         if ckpt is not None:
@@ -232,6 +475,25 @@ def build_sparse_network(
                 rows_l = [ex["atlas_rows"].astype(np.int64)]
                 cols_l = [ex["atlas_cols"].astype(np.int64)]
                 corr_l = [ex["atlas_corr"].astype(np.float64)]
+            # screening/transfer tally (ISSUE 11): resume keeps the skip
+            # counters exact across interrupts — and across a screening
+            # toggle, where a missing tally simply starts at zero
+            for name, default in (("atlas_tiles_dispatched", 0),
+                                  ("atlas_tiles_skipped", 0),
+                                  ("atlas_bytes_full", 0),
+                                  ("atlas_bytes_moved", 0)):
+                if ex.get(name) is not None:
+                    val = int(np.asarray(ex[name]).reshape(-1)[0])
+                else:
+                    val = default
+                if name == "atlas_tiles_dispatched":
+                    tiles_dispatched = val
+                elif name == "atlas_tiles_skipped":
+                    tiles_skipped = val
+                elif name == "atlas_bytes_full":
+                    bytes_full = val
+                else:
+                    bytes_moved = val
 
     def save(done: int) -> None:
         if checkpoint_path is None:
@@ -251,6 +513,10 @@ def build_sparse_network(
                     np.concatenate(corr_l) if corr_l
                     else np.empty(0, np.float64)
                 ),
+                "atlas_tiles_dispatched": np.int64(tiles_dispatched),
+                "atlas_tiles_skipped": np.int64(tiles_skipped),
+                "atlas_bytes_full": np.int64(bytes_full),
+                "atlas_bytes_moved": np.int64(bytes_moved),
             },
         )
 
@@ -261,21 +527,74 @@ def build_sparse_network(
         )
     z_dev = jnp.asarray(z)
     z_tiles = z_dev.reshape(T, edge, s)
-    strip_fn = _build_strip_fn(
-        edge, T, n, s, net.beta, k_eff, mesh, config.mesh_axis
-    )
+
+    # compiled-program memo: full strips keyed by τ capacity, worklist
+    # groups by (mode, width, capacity) — few distinct shapes per build
+    progs: dict = {}
+    strip_flat = edge * T * edge
+    # τ survivor capacity: starts small, grows (power-of-two) on overflow
+    # — a recompile and re-dispatch, never a wrong answer. The full-strip
+    # compaction needs the flat index to fit int32; past that the τ path
+    # falls back to the PR 9 full-strip transfer.
+    tau_cap = [min(strip_flat, 8192)]
+    tau_compact_ok = strip_flat < 2 ** 31 - 1
+
+    def get_strip_fn(cap):
+        key = ("strip", cap)
+        if key not in progs:
+            progs[key] = _build_strip_fn(
+                edge, T, n, s, net.beta, k_eff, tau32, cap, with_deg,
+                mesh, config.mesh_axis,
+            )
+        return progs[key]
+
+    def get_group_fn(w, cap):
+        key = ("group", w, cap)
+        if key not in progs:
+            progs[key] = _build_group_fn(
+                edge, w, n, s, net.beta, k_eff, tau32, cap, mesh,
+                config.mesh_axis,
+            )
+        return progs[key]
 
     mem = None
     sid = None
     if tel is not None:
         sid = tel.begin_span(
             "tile_pass_start", n=int(n), edge=int(edge), blocks=int(B),
-            start_block=int(start_block), samples=int(s),
-            mode="topk" if k_eff is not None else "tau",
+            start_block=int(start_block), samples=int(s), mode=mode,
+            screen=bool(screen), supertile=int(S_res),
+            degree=bool(with_deg),
         )
         from ..utils.profiling import make_memory_probe
 
         mem = make_memory_probe()
+
+    def run_dispatch(thunk, b, label):
+        if ft is None:
+            return thunk()
+        return ft.run_dispatch(
+            thunk, start=b, take=1, telemetry=tel,
+            rescue=lambda: save(done), label=label,
+        )
+
+    def grow_cap(cnt):
+        cap = tau_cap[0]
+        while cap < cnt:
+            cap <<= 1
+        tau_cap[0] = min(cap, strip_flat)
+
+    def decode_tau(cnt, fidx, rv, w, wids, lo):
+        """Map compacted flat survivors back to (row, global col, r) —
+        ascending flat order == the host np.nonzero row-major order."""
+        f = fidx[:cnt].astype(np.int64)
+        row = f // (w * edge)
+        rem = f % (w * edge)
+        if wids is None:                   # full strip: flat col IS global
+            col = rem
+        else:
+            col = wids[rem // edge].astype(np.int64) * edge + rem % edge
+        return lo + row, col, rv[:cnt].astype(np.float64)
 
     done = start_block
     last_saved = start_block
@@ -285,49 +604,296 @@ def build_sparse_network(
         for b in range(start_block, B):
             row0 = b * edge
             zI = jax.lax.dynamic_slice_in_dim(z_dev, row0, edge, axis=0)
-
-            def _dispatch(_zI=zI, _row0=row0):
-                out = strip_fn(_zI, z_tiles, jnp.int32(_row0))
-                return jax.block_until_ready(out)
-
-            t_b0 = time.perf_counter()
-            if ft is None:
-                out = _dispatch()
-            else:
-                out = ft.run_dispatch(
-                    _dispatch, start=b, take=1, telemetry=tel,
-                    rescue=lambda: save(done), label="tile_strip",
-                )
             lo = row0
             hi = min(row0 + edge, n)
             m = hi - lo
             kept = 0
-            if k_eff is not None:
-                deg_b, idxs, r_sel, score = (np.asarray(a) for a in out)
-                # cross-tile fold on the host in f64: summation order is
-                # then fixed regardless of how the tile axis was sharded
-                deg[lo:hi] += deg_b.astype(np.float64).sum(axis=0)[:m]
-                keep = score[:m] >= 0          # rows short of k candidates
-                ii, jj = np.nonzero(keep)
-                rows_l.append((lo + ii).astype(np.int64))
-                cols_l.append(idxs[:m][keep].astype(np.int64))
-                corr_l.append(r_sel[:m][keep].astype(np.float64))
-                kept = int(keep.sum())
+            disp_b = 0
+            skip_b = 0
+            moved_b = 0
+            screen_s = 0.0
+            t_b0 = time.perf_counter()
+
+            if not screen:
+                # ---- unscreened: one full-strip dispatch ----------------
+                if mode == "tau" and tau_compact_ok:
+                    while True:
+                        cap = tau_cap[0]
+                        fn = get_strip_fn(cap)
+
+                        def _dispatch(_fn=fn, _zI=zI, _row0=row0):
+                            return jax.block_until_ready(
+                                _fn(_zI, z_tiles, jnp.int32(_row0))
+                            )
+
+                        out = run_dispatch(_dispatch, b, "tile_strip")
+                        out = [np.asarray(a) for a in out]
+                        cnt = int(out[1] if with_deg else out[0])
+                        if cnt <= cap:
+                            break
+                        grow_cap(cnt)      # recompile + re-dispatch, rare
+                    if with_deg:
+                        deg_b, _cnt, fidx, rv = out
+                        deg[lo:hi] += (
+                            deg_b.astype(np.float64).sum(axis=0)[:m]
+                        )
+                    else:
+                        _cnt, fidx, rv = out
+                    br, bc, bv = decode_tau(cnt, fidx, rv, T, None, lo)
+                    rows_l.append(br)
+                    cols_l.append(bc)
+                    corr_l.append(bv)
+                    kept = int(cnt)
+                    moved_b = sum(a.nbytes for a in out)
+                else:
+                    fn = get_strip_fn(None)
+
+                    def _dispatch(_fn=fn, _zI=zI, _row0=row0):
+                        return jax.block_until_ready(
+                            _fn(_zI, z_tiles, jnp.int32(_row0))
+                        )
+
+                    out = run_dispatch(_dispatch, b, "tile_strip")
+                    out = [np.asarray(a) for a in out]
+                    moved_b = sum(a.nbytes for a in out)
+                    if with_deg:
+                        deg_b = out.pop(0)
+                        # cross-tile fold on the host in f64: summation
+                        # order is then fixed regardless of how the tile
+                        # axis was sharded
+                        deg[lo:hi] += (
+                            deg_b.astype(np.float64).sum(axis=0)[:m]
+                        )
+                    if k_eff is not None:
+                        idxs, r_sel, score = out
+                        keep = score[:m] >= 0  # rows short of k candidates
+                        ii, jj = np.nonzero(keep)
+                        rows_l.append((lo + ii).astype(np.int64))
+                        cols_l.append(idxs[:m][keep].astype(np.int64))
+                        corr_l.append(r_sel[:m][keep].astype(np.float64))
+                        kept = int(keep.sum())
+                    else:
+                        (r_strip,) = out
+                        sel = np.abs(r_strip[:m]) >= tau32
+                        ii, jj = np.nonzero(sel)
+                        rows_l.append((lo + ii).astype(np.int64))
+                        cols_l.append(jj.astype(np.int64))
+                        corr_l.append(r_strip[:m][sel].astype(np.float64))
+                        kept = int(sel.sum())
+                disp_b = T_real
             else:
-                deg_b, r_strip = (np.asarray(a) for a in out)
-                deg[lo:hi] += deg_b.astype(np.float64).sum(axis=0)[:m]
-                sel = np.abs(r_strip[:m]) >= tau
-                ii, jj = np.nonzero(sel)
-                rows_l.append((lo + ii).astype(np.int64))
-                cols_l.append(jj.astype(np.int64))
-                corr_l.append(r_strip[:m][sel].astype(np.float64))
-                kept = int(sel.sum())
+                # ---- screened: coarse → refine → worklist dispatch ------
+                t_s0 = time.perf_counter()
+                mb = M[b]                          # row-block max norms
+                cb = np.minimum(MS @ mb, 1.0)      # coarse (per group)
+                G = MS.shape[0]
+                screen_s += time.perf_counter() - t_s0
+                if k_eff is not None:
+                    cand_v = np.full((m, k_eff), -1.0, np.float32)
+                    cand_c = np.full((m, k_eff), _COL_SENTINEL, np.int64)
+                    cand_r = np.zeros((m, k_eff), np.float32)
+                    floor = -1.0
+                    t_s0 = time.perf_counter()
+                    # descending-bound order: signal groups first, so the
+                    # per-row floors tighten before noise groups are judged
+                    order = np.argsort(-cb, kind="stable")
+                    screen_s += time.perf_counter() - t_s0
+                    for g in order:
+                        t_s0 = time.perf_counter()
+                        t0g = int(g) * S_res
+                        t1g = min(t0g + S_res, T_real)
+                        n_g = t1g - t0g
+                        if cb[g] + margin < floor:
+                            skip_b += n_g
+                            screen_s += time.perf_counter() - t_s0
+                            if _screen_observer is not None:
+                                _screen_observer(
+                                    b, "coarse",
+                                    np.arange(t0g, t1g, dtype=np.int64),
+                                    float(floor),
+                                )
+                            continue
+                        tb = np.minimum(M[t0g:t1g] @ mb, 1.0)
+                        live = (tb + margin) >= floor
+                        screen_s += time.perf_counter() - t_s0
+                        if not live.all():
+                            dropped = t0g + np.flatnonzero(~live)
+                            skip_b += int(dropped.size)
+                            if _screen_observer is not None:
+                                _screen_observer(b, "refine", dropped,
+                                                 float(floor))
+                        # pending tiles of this group, strongest bound
+                        # first: while no floor exists yet, dispatch only
+                        # a bootstrap batch (just enough tiles to fill k
+                        # candidates per row), so the floor forms before
+                        # the bulk of the group is committed
+                        t_s0 = time.perf_counter()
+                        o = np.argsort(-tb[live], kind="stable")
+                        pending = (t0g + np.flatnonzero(live))[o]
+                        pbound = tb[live][o]
+                        boot = max(1, -(-2 * k_eff // edge))
+                        screen_s += time.perf_counter() - t_s0
+                        while pending.size:
+                            if floor < 0:
+                                take = pending[:boot]
+                                pending = pending[boot:]
+                                pbound = pbound[boot:]
+                            else:
+                                t_s0 = time.perf_counter()
+                                ok = (pbound + margin) >= floor
+                                screen_s += time.perf_counter() - t_s0
+                                if not ok.all():
+                                    dropped = pending[~ok]
+                                    skip_b += int(dropped.size)
+                                    if _screen_observer is not None:
+                                        _screen_observer(
+                                            b, "refine", np.sort(dropped),
+                                            float(floor),
+                                        )
+                                take = pending[ok]
+                                pending = pending[:0]
+                                if take.size == 0:
+                                    break
+                            # ascending within the dispatch: the group's
+                            # top-k tie-breaking (by worklist position)
+                            # must match the global column order
+                            take = np.sort(take)
+                            w = _bucket_width(take.size, ax)
+                            wids = np.full(w, -1, np.int32)
+                            wids[:take.size] = take
+                            fn = get_group_fn(w, None)
+
+                            def _dispatch(_fn=fn, _zI=zI, _w=wids,
+                                          _row0=row0):
+                                return jax.block_until_ready(
+                                    _fn(_zI, z_tiles, jnp.asarray(_w),
+                                        jnp.int32(_row0))
+                                )
+
+                            out = run_dispatch(_dispatch, b, "tile_group")
+                            idxs, r_sel, vals = (np.asarray(a)
+                                                 for a in out)
+                            moved_b += (idxs.nbytes + r_sel.nbytes
+                                        + vals.nbytes)
+                            idxs = idxs[:m].astype(np.int64)
+                            vals = vals[:m]
+                            r_sel = r_sel[:m]
+                            cols = (
+                                wids[idxs // edge].astype(np.int64) * edge
+                                + idxs % edge
+                            )
+                            bad = vals < 0
+                            cols[bad] = _COL_SENTINEL
+                            r_sel = np.where(bad, np.float32(0.0), r_sel)
+                            cand_v, cand_c, cand_r = _merge_topk(
+                                cand_v, cand_c, cand_r, vals, cols, r_sel,
+                                k_eff,
+                            )
+                            # the running floor: weakest k-th-best across
+                            # the block's rows (-1 until every row holds k
+                            # real candidates — no skipping before that)
+                            floor = float(cand_v[:, -1].min())
+                            disp_b += int(take.size)
+                    keep = cand_v >= 0
+                    ii, jj = np.nonzero(keep)
+                    rows_l.append((lo + ii).astype(np.int64))
+                    cols_l.append(cand_c[keep])
+                    corr_l.append(cand_r[keep].astype(np.float64))
+                    kept = int(keep.sum())
+                else:
+                    gr = b // S_res                # row super-group
+                    parts_r: list[np.ndarray] = []
+                    parts_c: list[np.ndarray] = []
+                    parts_v: list[np.ndarray] = []
+                    for g in range(G):
+                        t_s0 = time.perf_counter()
+                        t0g = g * S_res
+                        t1g = min(t0g + S_res, T_real)
+                        n_g = t1g - t0g
+                        # S×S coarse level: the super-row × super-col
+                        # bound prunes this whole group for every block
+                        # in the row group from one precomputed table
+                        if (SB[gr, g] + margin < tau_cmp
+                                or cb[g] + margin < tau_cmp):
+                            skip_b += n_g
+                            screen_s += time.perf_counter() - t_s0
+                            if _screen_observer is not None:
+                                _screen_observer(
+                                    b, "coarse",
+                                    np.arange(t0g, t1g, dtype=np.int64),
+                                    tau_cmp,
+                                )
+                            continue
+                        tb = np.minimum(M[t0g:t1g] @ mb, 1.0)
+                        live = (tb + margin) >= tau_cmp
+                        work = t0g + np.flatnonzero(live)
+                        screen_s += time.perf_counter() - t_s0
+                        if work.size < n_g:
+                            dropped = t0g + np.flatnonzero(~live)
+                            skip_b += int(dropped.size)
+                            if _screen_observer is not None:
+                                _screen_observer(b, "refine", dropped,
+                                                 tau_cmp)
+                        if work.size == 0:
+                            continue
+                        w = _bucket_width(work.size, ax)
+                        wids = np.full(w, -1, np.int32)
+                        wids[:work.size] = work
+                        while True:
+                            cap = min(tau_cap[0], edge * w * edge)
+                            fn = get_group_fn(w, cap)
+
+                            def _dispatch(_fn=fn, _zI=zI, _w=wids,
+                                          _row0=row0):
+                                return jax.block_until_ready(
+                                    _fn(_zI, z_tiles, jnp.asarray(_w),
+                                        jnp.int32(_row0))
+                                )
+
+                            out = run_dispatch(_dispatch, b, "tile_group")
+                            cnt_a, fidx, rv = (np.asarray(a) for a in out)
+                            cnt = int(cnt_a)
+                            if cnt <= cap:
+                                break
+                            grow_cap(cnt)
+                        moved_b += cnt_a.nbytes + fidx.nbytes + rv.nbytes
+                        br, bc, bv = decode_tau(cnt, fidx, rv, w, wids, lo)
+                        parts_r.append(br)
+                        parts_c.append(bc)
+                        parts_v.append(bv)
+                        disp_b += int(work.size)
+                    if parts_r:
+                        br = np.concatenate(parts_r)
+                        bc = np.concatenate(parts_c)
+                        bv = np.concatenate(parts_v)
+                        # groups dispatched out of column order reassemble
+                        # into the unscreened (row-major) emit order
+                        o = np.lexsort((bc, br))
+                        rows_l.append(br[o])
+                        cols_l.append(bc[o])
+                        corr_l.append(bv[o])
+                        kept = int(br.size)
+                if tel is not None:
+                    tel.emit(
+                        "tile_screen", parent=sid, block=int(b),
+                        s=screen_s, tiles_skipped=int(skip_b),
+                        tiles_dispatched=int(disp_b),
+                        floor=(float(floor) if k_eff is not None
+                               else tau_cmp),
+                    )
+
+            tiles_dispatched += disp_b
+            tiles_skipped += skip_b
+            bytes_full += m * T_real * edge * 4
+            bytes_moved += moved_b
             done = b + 1
             t_marks.append((done, time.perf_counter()))
             if tel is not None:
                 tel.emit(
                     "tile", parent=sid, block=int(b), blocks=int(B),
                     s=t_marks[-1][1] - t_b0, edges_kept=kept,
+                    tiles_dispatched=int(disp_b),
+                    tiles_skipped=int(skip_b),
                     **(mem() if mem is not None else {}),
                 )
             if progress is not None:
@@ -360,21 +926,39 @@ def build_sparse_network(
     correlation = SparseAdjacency.from_coo(
         rows, cols, corr, n, symmetrize=True
     )
+    tiles_total = B * T_real
     if tel is not None:
         tel.end_span(
             sid, "tile_pass_end", blocks_done=int(done), blocks=int(B),
             interrupted=False, edges=int(rows.size),
             nnz=int(adjacency.nnz), s=time.perf_counter() - t0,
+            tiles_total=int(tiles_total),
+            tiles_dispatched=int(tiles_dispatched),
+            tiles_skipped=int(tiles_skipped),
+            skip_fraction=round(tiles_skipped / max(1, tiles_total), 6),
+            nxn_bytes_avoided=int(tiles_skipped) * edge * edge * 4,
+            strip_bytes_full=int(bytes_full),
+            strip_bytes_moved=int(bytes_moved),
         )
         if tel_owned:
             tel.close()
-    if at_cache is not None and len(t_marks) >= 2:
+    if len(t_marks) >= 2:
         # steady-state gene rows/s (first block's interval absorbs the jit
         # compile, same convention as the null loops)
         (b0, tm0), (b1, tm1) = t_marks[0], t_marks[-1]
         if tm1 > tm0 and b1 > b0:
-            at_cache.record(at_key, edge, (b1 - b0) * edge / (tm1 - tm0))
+            cps = (b1 - b0) * edge / (tm1 - tm0)
+            if at_cache is not None:
+                at_cache.record(at_key, edge, cps)
+            if st_cache is not None:
+                st_cache.record(st_key, S_res, cps)
     return AtlasBuild(
-        adjacency=adjacency, correlation=correlation, degree=deg, n=n,
+        adjacency=adjacency, correlation=correlation,
+        degree=deg if with_deg else None, n=n,
         tile_edge=edge, n_blocks=B, selected_edges=int(rows.size),
+        supertile=int(S_res), tiles_total=int(tiles_total),
+        tiles_dispatched=int(tiles_dispatched),
+        tiles_skipped=int(tiles_skipped),
+        strip_bytes_full=int(bytes_full),
+        strip_bytes_moved=int(bytes_moved),
     )

@@ -190,3 +190,74 @@ class TiledNetwork:
 
         b, kind = self.beta
         return f"{content_digest([self.xc])}|beta:{b:g}|{kind}"
+
+    # -- column-moment cache (exact tile screening, ISSUE 11) --------------
+
+    def column_moments(self, segments: int = 8) -> np.ndarray:
+        """Per-column sample-segment norms of the device plane — the
+        ``(n, P)`` float64 matrix ``A`` with ``A[j, p] = ‖z_j over sample
+        segment p‖`` of the :meth:`z32` standardized columns (so
+        ``Σ_p A[j, p]² = 1`` for non-degenerate columns).
+
+        This is the moment table every screening bound derives from: by
+        Cauchy–Schwarz applied per segment,
+
+            ``|r_ij| = |Σ_p z_i⁽ᵖ⁾·z_j⁽ᵖ⁾| ≤ Σ_p A[i, p]·A[j, p]``
+
+        — an upper bound on any correlation from O(n·P) numbers, tight
+        exactly when two columns' sample support overlaps (the sparse,
+        modular structure of real co-expression data) and ≤ 1 always.
+        Computed once per (spec, P) and memoized on the instance; row
+        blocks are processed in bounded chunks so the transient float64
+        working set never scales with n.
+        """
+        P = max(1, min(int(segments), self.n_samples))
+        cache = self.__dict__.get("_moment_cache")
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_moment_cache", cache)
+        if P not in cache:
+            s = self.n_samples
+            starts = (np.arange(P, dtype=np.int64) * s) // P
+            z = self.z32()
+            A = np.empty((self.n, P), dtype=np.float64)
+            blk = 1 << 16
+            for i0 in range(0, self.n, blk):
+                zz = z[i0: i0 + blk].astype(np.float64)
+                A[i0: i0 + blk] = np.add.reduceat(zz * zz, starts, axis=1)
+            np.sqrt(A, out=A)
+            cache[P] = A
+        return cache[P]
+
+
+# -- screening bound kernels (ISSUE 11) -------------------------------------
+
+
+def tile_norm_maxima(A: np.ndarray, edge: int, n_tiles: int) -> np.ndarray:
+    """Per-tile segment-norm maxima: ``M[t, p] = max_{j in tile t} A[j, p]``
+    for ``n_tiles`` column tiles of ``edge`` genes (padding tiles past the
+    real columns are all-zero, so their bounds are 0 and they can never
+    survive a screen). With ``M`` for a row block ``I`` and a column tile
+    ``J``, ``min(1, M_I · M_J)`` bounds every ``|r_ij|`` in the (I, J)
+    tile: ``Σ_p A[i,p]A[j,p] ≤ Σ_p (max_I A[·,p])(max_J A[·,p])``."""
+    n, P = A.shape
+    M = np.zeros((n_tiles, P), dtype=np.float64)
+    full = min(n // edge, n_tiles)
+    if full:
+        M[:full] = A[: full * edge].reshape(full, edge, P).max(axis=1)
+    if full < n_tiles and full * edge < n:
+        M[full] = A[full * edge:].max(axis=0)
+    return M
+
+
+def supertile_maxima(M: np.ndarray, factor: int) -> np.ndarray:
+    """Coarse-level maxima over groups of ``factor`` consecutive tiles:
+    ``MS[g] = max over tiles g·S..(g+1)·S of M`` — the super-tile bound
+    table of the two-resolution screen. A super-tile bound dominates every
+    member tile's bound, so pruning at the coarse level is exact."""
+    T, P = M.shape
+    G = -(-T // factor)
+    MS = np.zeros((G, P), dtype=np.float64)
+    for g in range(G):
+        MS[g] = M[g * factor: (g + 1) * factor].max(axis=0)
+    return MS

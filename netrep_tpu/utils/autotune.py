@@ -237,6 +237,37 @@ def resolve_tile_edge(config, key: str, explicit: int | None = None,
     return (best if best is not None and best >= 8 else default), cache
 
 
+#: static fallback for the exact-tile-screening coarse level (ISSUE 11)
+#: when nothing has been measured yet: groups of 8 tiles keep the coarse
+#: bound table T/8 entries per row block (one fused prune decision per
+#: ~8·edge columns) while a surviving group still refines into at most 8
+#: per-tile bounds — and 8 tiles per worklist dispatch amortizes dispatch
+#: latency the same way the superchunk default does for the null loops.
+DEFAULT_SUPERTILE = 8
+
+
+def resolve_supertile(config, key: str, explicit: int | None = None,
+                      default: int = DEFAULT_SUPERTILE):
+    """Autotuned super-tile factor for the atlas screening pass
+    (:mod:`netrep_tpu.atlas.builder` — ISSUE 11, beside
+    :func:`resolve_tile_edge`): how many consecutive tiles share one
+    coarse bound (and one worklist dispatch) in the two-resolution screen.
+    An ``explicit`` factor is honored verbatim (its measured columns/s is
+    still recorded, so factor sweeps feed the cache); else the
+    best-measured factor for ``key`` replaces the static default. Returns
+    ``(factor, cache_or_None)``; ``config.autotune=False`` disables both
+    lookup and recording, exactly like the tile-edge resolution."""
+    if not getattr(config, "autotune", False):
+        return (max(1, int(explicit)) if explicit is not None else default,
+                None)
+    cache = AutotuneCache()
+    if explicit is not None:
+        return max(1, int(explicit)), cache
+    best = cache.best_setting(key)
+    _emit_lookup("supertile", key, best, default)
+    return (best if best is not None and best >= 1 else default), cache
+
+
 def resolve_fused_rowblock(config, key: str):
     """Autotuned row-block for the fused-statistics mega-kernel's DMA/
     select grid (ISSUE 8; :func:`netrep_tpu.ops.fused_stats.

@@ -117,8 +117,13 @@ def test_interrupt_resume_equals_uninterrupted(atlas_data, tmp_path):
     # the failure-save landed, with the pass state in x_atlas_* extras
     with np.load(ck) as z:
         extras = [key for key in z.files if key.startswith("x_atlas_")]
-        assert set(extras) == {"x_atlas_rows", "x_atlas_cols",
-                               "x_atlas_corr"}
+        # COO so-far plus the ISSUE 11 screening/transfer tally, so a
+        # resume replays exact skip counters too
+        assert set(extras) == {
+            "x_atlas_rows", "x_atlas_cols", "x_atlas_corr",
+            "x_atlas_tiles_dispatched", "x_atlas_tiles_skipped",
+            "x_atlas_bytes_full", "x_atlas_bytes_moved",
+        }
         assert int(z["completed"]) == 2
     resumed = build_sparse_network(
         tn, top_k=5, tile_edge=64, config=CFG,

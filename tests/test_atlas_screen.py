@@ -1,0 +1,366 @@
+"""Exact tile screening (ISSUE 11): bound correctness as a property test
+(every skipped tile's true max |r| is strictly below the active
+threshold/floor at the moment it was skipped), screened top-k/τ output
+bit-identical to the PR 9 unscreened path (dense-reference-checked),
+including mesh-sharded and interrupt→resume compositions, the deliberate
+fingerprint-sharing contract across the screening toggle (τ/top_k/degree
+changes still refuse), device-side τ selection byte accounting, the
+``tile_screen`` telemetry events, and the super-tile autotune entry."""
+
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+from netrep_tpu.atlas import TiledNetwork, build_sparse_network
+from netrep_tpu.atlas.builder import _bound_margin
+from netrep_tpu.parallel.mesh import make_mesh
+from netrep_tpu.utils.config import EngineConfig
+
+CFG = EngineConfig(autotune=False)
+BETA = 2.0
+
+
+def grouped_support(genes, samples, groups, seed=0):
+    """Cell-type-block data: each gene expressed in one sample block
+    (genes sorted by block) over a small everywhere-noise floor — the
+    sparse, modular structure whose segment-norm bounds screening is
+    built for."""
+    rng = np.random.default_rng(seed)
+    x = 0.01 * rng.standard_normal((samples, genes))
+    gsz, ssz = genes // groups, samples // groups
+    for g in range(groups):
+        c0, c1 = g * gsz, (g + 1) * gsz if g < groups - 1 else genes
+        r0, r1 = g * ssz, (g + 1) * ssz if g < groups - 1 else samples
+        blk = rng.standard_normal((r1 - r0, c1 - c0))
+        fac = rng.standard_normal(r1 - r0)
+        blk += 1.5 * fac[:, None] * (rng.random(c1 - c0) < 0.5)
+        # zero-mean within the expressing block: off-support values stay
+        # near zero after global centering, the regime the segment-norm
+        # bounds are sharp in
+        x[r0:r1, c0:c1] += blk - blk.mean(axis=0)
+    return x
+
+
+def dense_r(x):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        r = np.corrcoef(x, rowvar=False)
+    np.fill_diagonal(r, 0.0)
+    return r
+
+
+@pytest.fixture(scope="module")
+def structured():
+    # 512 genes / 8 blocks = 64 genes per block — aligned with the
+    # 64-gene tile edge the tests use, so tiles are support-coherent
+    # (the layout screening is built for: genes sorted by cluster)
+    return grouped_support(512, 40, 8, seed=11)
+
+
+# ---------------------------------------------------------------------------
+# bound correctness (property test)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kw", [dict(top_k=6), dict(tau=0.3)],
+                         ids=["topk", "tau"])
+@pytest.mark.parametrize("seed,genes,samples,groups", [
+    (11, 512, 40, 8),      # structured: screening actually fires
+    (3, 300, 20, 1),       # unstructured noise+modules: bounds near 1
+])
+def test_skipped_tiles_provably_below_threshold(kw, seed, genes, samples,
+                                                groups):
+    """The exactness property: at the moment a tile is skipped, its TRUE
+    max |r| (dense float64 reference) is strictly below the threshold the
+    skip was judged against — for both the coarse and refine levels, the
+    static τ cut, and the running top-k floor."""
+    if groups == 1:
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal((samples, genes))
+        for k in range(4):
+            x[:, k * 22:(k + 1) * 22] += (
+                1.2 * rng.standard_normal(samples)[:, None]
+            )
+    else:
+        x = grouped_support(genes, samples, groups, seed=seed)
+    r = np.abs(dense_r(x))
+    edge = 64
+    skips = []
+
+    def observer(block, level, tiles, threshold):
+        skips.append((block, level, np.asarray(tiles), float(threshold)))
+
+    build_sparse_network(
+        TiledNetwork.from_data(x, BETA), tile_edge=edge, config=CFG,
+        screen=True, supertile=3, screen_segments=8,
+        _screen_observer=observer, **kw,
+    )
+    checked = 0
+    for block, level, tiles, threshold in skips:
+        lo, hi = block * edge, min((block + 1) * edge, genes)
+        for t in tiles:
+            c0, c1 = t * edge, min((t + 1) * edge, genes)
+            assert float(r[lo:hi, c0:c1].max()) < threshold, (
+                f"block {block} skipped tile {t} at {level} level with "
+                f"threshold {threshold} but true max |r| is "
+                f"{r[lo:hi, c0:c1].max()}"
+            )
+            checked += 1
+    if groups > 1:
+        assert checked > 0  # the structured fixture must actually screen
+
+
+# ---------------------------------------------------------------------------
+# bit-identity vs the unscreened path (dense-reference-checked)
+# ---------------------------------------------------------------------------
+
+
+def test_screened_topk_bit_identical_dense_checked(structured):
+    x = structured
+    tn = TiledNetwork.from_data(x, BETA)
+    un = build_sparse_network(tn, top_k=6, tile_edge=64, config=CFG,
+                              degree=False)
+    sc = build_sparse_network(tn, top_k=6, tile_edge=64, config=CFG,
+                              screen=True, screen_segments=8)
+    assert np.array_equal(un.adjacency.to_dense(), sc.adjacency.to_dense())
+    assert np.array_equal(un.correlation.to_dense(),
+                          sc.correlation.to_dense())
+    assert sc.degree is None and un.degree is None
+    assert sc.tiles_skipped > 0
+    assert sc.tiles_dispatched + sc.tiles_skipped == sc.tiles_total
+    # dense reference: the screened selection is the true per-row top-k
+    from netrep_tpu.ops.sparse import SparseAdjacency
+
+    r, n, k = dense_r(x), x.shape[1], 6
+    rows, cols, vals = [], [], []
+    for i in range(n):
+        order = np.argsort(-np.abs(r[i]), kind="stable")[:k]
+        rows += [i] * k
+        cols += list(order)
+        vals += list(r[i, order])
+    ref = SparseAdjacency.from_coo(rows, cols, vals, n, symmetrize=True)
+    got = sc.correlation.to_dense()
+    assert ((got != 0) == (ref.to_dense() != 0)).all()
+    np.testing.assert_allclose(got, ref.to_dense(), atol=1e-6)
+
+
+def test_screened_tau_bit_identical_dense_checked(structured):
+    x = structured
+    tau = 0.3
+    tn = TiledNetwork.from_data(x, BETA)
+    un = build_sparse_network(tn, tau=tau, tile_edge=64, config=CFG,
+                              degree=False)
+    sc = build_sparse_network(tn, tau=tau, tile_edge=64, config=CFG,
+                              screen=True, screen_segments=8)
+    assert np.array_equal(un.correlation.to_dense(),
+                          sc.correlation.to_dense())
+    assert np.array_equal(un.adjacency.to_dense(), sc.adjacency.to_dense())
+    assert sc.tiles_skipped > 0
+    r = dense_r(x)
+    sel = np.abs(r) >= tau
+    got = sc.correlation.to_dense()
+    assert ((got != 0) == (sel | sel.T)).all()
+    np.testing.assert_allclose(got[sel], r[sel], atol=1e-6)
+
+
+def test_screened_structured_fixture_skips_majority(structured):
+    """The bench mechanism at test scale: on grouped-support data the
+    screened top-k pass dispatches a small minority of tiles."""
+    sc = build_sparse_network(
+        TiledNetwork.from_data(structured, BETA), top_k=6, tile_edge=64,
+        config=CFG, screen=True, screen_segments=8,
+    )
+    assert sc.tiles_skipped / sc.tiles_total >= 0.5
+    # transfer accounting rides along and is self-consistent
+    assert 0 < sc.strip_bytes_moved < sc.strip_bytes_full
+
+
+def test_mesh_sharded_screened_bit_identical(structured):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    tn = TiledNetwork.from_data(structured, BETA)
+    mesh = make_mesh(n_perm_shards=2, n_row_shards=1,
+                     devices=jax.devices()[:2])
+    for kw in (dict(top_k=5), dict(tau=0.3)):
+        single = build_sparse_network(tn, tile_edge=64, config=CFG,
+                                      screen=True, **kw)
+        sharded = build_sparse_network(tn, tile_edge=64, config=CFG,
+                                       screen=True, mesh=mesh, **kw)
+        assert np.array_equal(sharded.correlation.to_dense(),
+                              single.correlation.to_dense())
+        assert np.array_equal(sharded.adjacency.to_dense(),
+                              single.adjacency.to_dense())
+        assert sharded.tiles_skipped == single.tiles_skipped
+
+
+# ---------------------------------------------------------------------------
+# checkpoint identity: screening toggle SHARES the fingerprint
+# ---------------------------------------------------------------------------
+
+
+def _interrupt_at(stop):
+    def progress(done, total):
+        if done == stop:
+            raise KeyboardInterrupt
+    return progress
+
+
+@pytest.mark.parametrize("first,second", [(True, False), (False, True)],
+                         ids=["screened-then-plain", "plain-then-screened"])
+def test_resume_across_screening_toggle_bit_identical(structured, tmp_path,
+                                                      first, second):
+    """Screened and unscreened passes produce bit-identical output, so
+    they deliberately share a checkpoint fingerprint: a pass interrupted
+    under one toggle resumes under the other, bit for bit."""
+    tn = TiledNetwork.from_data(structured, BETA)
+    kw = dict(top_k=5, tile_edge=64, config=CFG, degree=False)
+    full = build_sparse_network(tn, **kw)
+    ck = str(tmp_path / "atlas.npz")
+    with pytest.raises(KeyboardInterrupt):
+        build_sparse_network(
+            tn, screen=first, checkpoint_path=ck, checkpoint_every=1,
+            progress=_interrupt_at(3), **kw,
+        )
+    resumed = build_sparse_network(
+        tn, screen=second, checkpoint_path=ck, checkpoint_every=1, **kw
+    )
+    assert np.array_equal(resumed.adjacency.to_dense(),
+                          full.adjacency.to_dense())
+    assert np.array_equal(resumed.correlation.to_dense(),
+                          full.correlation.to_dense())
+    # the screening tally rode the checkpoint: the toggled-resume totals
+    # still account for every real tile exactly once
+    assert resumed.tiles_dispatched + resumed.tiles_skipped == \
+        resumed.tiles_total
+
+
+def test_screened_interrupt_resume_screened(structured, tmp_path):
+    tn = TiledNetwork.from_data(structured, BETA)
+    kw = dict(tau=0.3, tile_edge=64, config=CFG)
+    full = build_sparse_network(tn, screen=True, **kw)
+    ck = str(tmp_path / "atlas.npz")
+    with pytest.raises(KeyboardInterrupt):
+        build_sparse_network(
+            tn, screen=True, checkpoint_path=ck, checkpoint_every=1,
+            progress=_interrupt_at(2), **kw,
+        )
+    resumed = build_sparse_network(
+        tn, screen=True, checkpoint_path=ck, checkpoint_every=1, **kw
+    )
+    assert np.array_equal(resumed.correlation.to_dense(),
+                          full.correlation.to_dense())
+    assert resumed.tiles_skipped == full.tiles_skipped
+    assert resumed.tiles_dispatched == full.tiles_dispatched
+
+
+def test_fingerprint_refuses_changed_threshold(structured, tmp_path):
+    """A changed τ/top_k (or degree flag) is a different problem and
+    refuses — only the screening toggle shares identity."""
+    tn = TiledNetwork.from_data(structured, BETA)
+    ck = str(tmp_path / "atlas.npz")
+    with pytest.raises(KeyboardInterrupt):
+        build_sparse_network(
+            tn, top_k=5, tile_edge=64, config=CFG, degree=False,
+            checkpoint_path=ck, progress=_interrupt_at(1),
+        )
+    for bad in (
+        dict(top_k=6, degree=False),               # changed k
+        dict(tau=0.4),                             # changed rule
+        dict(top_k=5, degree=True),                # changed outputs
+    ):
+        with pytest.raises(ValueError, match="different problem"):
+            build_sparse_network(tn, tile_edge=64, config=CFG,
+                                 checkpoint_path=ck, **bad)
+
+
+def test_screen_requires_degree_false(structured):
+    tn = TiledNetwork.from_data(structured, BETA)
+    with pytest.raises(ValueError, match="degree"):
+        build_sparse_network(tn, top_k=4, tile_edge=64, config=CFG,
+                             screen=True, degree=True)
+    # degree defaults off under screening, on without it
+    sc = build_sparse_network(tn, top_k=4, tile_edge=64, config=CFG,
+                              screen=True)
+    un = build_sparse_network(tn, top_k=4, tile_edge=64, config=CFG)
+    assert sc.degree is None
+    assert un.degree is not None and un.degree.shape == (tn.n,)
+
+
+# ---------------------------------------------------------------------------
+# device-side τ selection, telemetry, autotune
+# ---------------------------------------------------------------------------
+
+
+def test_tau_device_selection_cuts_strip_transfer(structured, tmp_path):
+    """ISSUE 11 satellite: the τ path masks on device and transfers only
+    surviving entries + indices — the byte delta lands on the tile-pass
+    span."""
+    sink = str(tmp_path / "tau.jsonl")
+    build = build_sparse_network(
+        TiledNetwork.from_data(structured, BETA), tau=0.3, tile_edge=64,
+        config=CFG, degree=False, telemetry=sink,
+    )
+    assert 0 < build.strip_bytes_moved < build.strip_bytes_full
+    end = [json.loads(l) for l in open(sink, encoding="utf-8")
+           if '"tile_pass_end"' in l][0]["data"]
+    assert end["strip_bytes_moved"] == build.strip_bytes_moved
+    assert end["strip_bytes_full"] == build.strip_bytes_full
+    assert end["tiles_skipped"] == 0   # unscreened pass, full grid
+
+
+def test_tile_screen_telemetry_events(structured, tmp_path):
+    sink = str(tmp_path / "screen.jsonl")
+    build = build_sparse_network(
+        TiledNetwork.from_data(structured, BETA), top_k=5, tile_edge=64,
+        config=CFG, screen=True, telemetry=sink,
+    )
+    events = [json.loads(l) for l in open(sink, encoding="utf-8")]
+    by_ev = {}
+    for e in events:
+        by_ev.setdefault(e["ev"], []).append(e)
+    start = by_ev["tile_pass_start"][0]["data"]
+    assert start["screen"] is True and start["supertile"] >= 1
+    sid = start["span"]
+    screens = by_ev["tile_screen"]
+    assert len(screens) == start["blocks"]       # one per row block
+    assert all(e["data"]["parent"] == sid for e in screens)
+    assert sum(e["data"]["tiles_skipped"] for e in screens) == \
+        build.tiles_skipped
+    end = by_ev["tile_pass_end"][0]["data"]
+    assert end["tiles_skipped"] == build.tiles_skipped
+    assert end["skip_fraction"] == round(
+        build.tiles_skipped / build.tiles_total, 6
+    )
+    assert end["nxn_bytes_avoided"] == build.tiles_skipped * 64 * 64 * 4
+
+
+def test_supertile_autotune_records(structured, tmp_path, monkeypatch):
+    from netrep_tpu.utils import autotune
+
+    monkeypatch.setattr(
+        autotune, "default_path", lambda: str(tmp_path / "at.json")
+    )
+    cfg = EngineConfig(autotune=True)
+    build = build_sparse_network(
+        TiledNetwork.from_data(structured, BETA), top_k=4, tile_edge=64,
+        config=cfg, screen=True, supertile=3,
+    )
+    assert build.supertile == 3
+    key = autotune.make_key(
+        jax.default_backend(), "atlas-screen",
+        f"n{structured.shape[1]}s{structured.shape[0]}", 0, "topk",
+    )
+    samples = autotune.AutotuneCache().throughput(key, 3)
+    assert samples and samples[0] > 0
+    # the recorded factor now wins the resolution for the same shape
+    factor, _cache = autotune.resolve_supertile(cfg, key)
+    assert factor == 3
+
+
+def test_bound_margin_scales_with_samples():
+    assert _bound_margin(32) < _bound_margin(1024)
+    assert _bound_margin(8) > 0

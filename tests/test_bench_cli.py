@@ -59,8 +59,14 @@ CASES = [
     # atlas tiled network plane (ISSUE 9): tile-grid construction +
     # data-only null mechanism row — guards the TiledNetwork builder and
     # the correlation=None/network=None engine path end-to-end (the
-    # opt-in ATLAS_STEP watcher step runs this config on TPU)
+    # opt-in ATLAS_STEP watcher step runs this config on TPU; it now
+    # also emits the ISSUE 11 screening pair after the PR 9 row)
     ["--config", "atlas"],
+    # exact tile screening (ISSUE 11): the screened-vs-unscreened pair
+    # alone — screened/unscreened bit-parity is asserted in-bench before
+    # any row, so this smoke case guards the screen → refine → dispatch
+    # restructure and the device-side τ/top-k selection end-to-end
+    ["--config", "atlas", "--screen-only"],
 ]
 
 
