@@ -37,6 +37,13 @@ TELEMETRY_SCHEMA = 1
 #: replacing the old habit of re-parsing raw bench tails by hand
 PERF_LEDGER_SCHEMA = 1
 
+#: invariant-lint report version this summarizer understands (mirrors
+#: netrep_tpu.analysis.linter.LINT_SCHEMA, literal for the same
+#: standalone reason) — the watcher appends one `lint --json` line per
+#: cycle; a non-ok line means rows from that tree may not carry the
+#: bit-identity guarantees and is surfaced in its own section
+LINT_SCHEMA = 1
+
 
 def rows_from(path: str) -> list[dict]:
     rows = []
@@ -70,6 +77,11 @@ def classify(row: dict) -> str:
         # perf-ledger entry (netrep_tpu.utils.perfledger): feeds the
         # "perf trend" section, never the BASELINE result table
         return "ledger"
+    if (row.get("lint_v") == LINT_SCHEMA
+            and isinstance(row.get("findings"), list)):
+        # invariant-lint report (netrep_tpu.analysis): never a
+        # measurement — summarized in its own contract-health section
+        return "lint"
     if row.get("tpu_fallback") or "error" in row or "warning" in row:
         return "dropped"
     if row.get("cached"):
@@ -144,9 +156,32 @@ def perf_trend(entries: list[dict]) -> list[str]:
     return lines
 
 
+def lint_lines(rows: list[dict]) -> list[str]:
+    """Contract-health section from `lint --json` lines: per-cycle
+    ok/finding counts plus the per-rule split of the NEWEST non-ok
+    report (the actionable one)."""
+    lines = []
+    bad = [r for r in rows if not r.get("ok")]
+    lines.append(
+        f"{len(rows)} lint cycle(s): {len(rows) - len(bad)} clean, "
+        f"{len(bad)} with findings"
+    )
+    if bad:
+        per_rule: dict[str, int] = {}
+        for f in bad[-1].get("findings", []):
+            rule = f.get("rule", "?")
+            per_rule[rule] = per_rule.get(rule, 0) + 1
+        split = ", ".join(f"{k}: {n}" for k, n in sorted(per_rule.items()))
+        lines.append(
+            f"newest findings ({split}) — rows from this tree may not "
+            "carry the bit-identity guarantees; fix before transcribing"
+        )
+    return lines
+
+
 def main(paths: list[str]) -> int:
     results, unknown, other, dropped, telemetry = [], [], [], 0, []
-    ledger = []
+    ledger, lint = [], []
     for p in paths:
         for r in rows_from(p):
             kind = classify(r)
@@ -162,6 +197,13 @@ def main(paths: list[str]) -> int:
                 telemetry.append(r)
             elif kind == "ledger":
                 ledger.append(r)
+            elif kind == "lint":
+                lint.append(r)
+    if lint:
+        print("## invariant lint (contract health)")
+        for line in lint_lines(lint):
+            print(line)
+        print()
     if ledger:
         print(f"## perf trend ({len(ledger)} ledger entries)")
         for line in perf_trend(ledger):

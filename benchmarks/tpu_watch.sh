@@ -187,6 +187,30 @@ serve_drill() {
   fi
 }
 
+# Invariant lint (ISSUE 12): once per watch cycle, run the repo's static
+# contract linter (`python -m netrep_tpu lint --json`) — backend-free,
+# seconds-scale, so it costs the window nothing. Findings are logged
+# LOUDLY but never fail the step (a watch cycle's job is measurements;
+# CI's tier-1 gate owns hard enforcement via tests/test_lint.py) — but a
+# contract violation showing up mid-watch means new rows may not carry
+# the bit-identity guarantees, so the banner says exactly that.
+# LINT_CHECK=0 disables; default 'auto': on in production, off under the
+# QUEUE_FILE state-machine test hook like the other drills.
+LINT_CHECK=${LINT_CHECK:-auto}
+lint_check() {
+  case "$LINT_CHECK" in
+    0) return 0 ;;
+    auto) [ -n "${QUEUE_FILE:-}" ] && return 0 ;;
+  esac
+  echo "--- invariant lint ($(date -u +%FT%TZ)) ---" | tee -a "$LOG"
+  if lint_out=$(timeout 120 python -m netrep_tpu lint --json 2>/dev/null); then
+    echo "$lint_out" >>"$LOG"
+  else
+    echo "$lint_out" >>"$LOG"
+    echo "--- LINT FINDINGS (an invariant contract is violated; rows from this tree may not carry the bit-identity guarantees — fix before transcribing) ---" | tee -a "$LOG"
+  fi
+}
+
 # Serve CRASH drill (ISSUE 10, opt-in: SERVE_CRASH_DRILL=auto or 1):
 # once per watch cycle, prove the crash-recovery contract end to end —
 # `chaos --serve` boots the real daemon, SIGKILLs it mid-pack at a
@@ -222,6 +246,7 @@ serve_crash_drill() {
 
 echo "== watcher start $(date -u +%FT%TZ) (log=$LOG state=$STATE) ==" | tee -a "$LOG"
 while :; do
+  lint_check
   elastic_drill
   serve_drill
   serve_crash_drill

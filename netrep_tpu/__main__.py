@@ -230,6 +230,7 @@ def _chaos_serve(args) -> int:
                     "drill", "fx_d", "fx_t", n_perm=r["n_perm"],
                     seed=r["seed"], idempotency_key=f"drill-{r['seed']}",
                 )["p_values"])
+            # netrep: allow(exception-taxonomy) — drill clients: sockets die with the SIGKILLed daemon; the retry against the recovered daemon is the assertion
             except Exception:
                 pass  # expected for requests in flight at the kill
             finally:
@@ -451,12 +452,34 @@ def main(argv=None) -> int:
                     help="[--serve] concurrent requests in the drill")
     ch.add_argument("--chunk", type=_positive, default=16,
                     help="[--serve] served EngineConfig.chunk_size")
+    ln = sub.add_parser(
+        "lint",
+        help="invariant linter (ISSUE 12): statically enforce the "
+             "repo's determinism/RNG/exception/telemetry/thread "
+             "contracts over netrep_tpu/ (exit 2 on findings; "
+             "suppressions are counted, reasons required)",
+    )
+    ln.add_argument("paths", nargs="*", default=None,
+                    help="files or directories to lint (default: the "
+                         "installed netrep_tpu package)")
+    ln.add_argument("--json", action="store_true",
+                    help="one machine-readable JSON line (lint_v schema; "
+                         "summarize_watch.py classifies it)")
+    ln.add_argument("--rule", action="append", metavar="NAME",
+                    help="run only this rule (repeatable)")
     args = ap.parse_args(argv)
     if args.cmd is None:
         # bare invocation = selftest with its own argparse defaults (ONE
         # source of defaults; bare flags are not supported — subcommand
         # flags belong after `selftest`)
         args = ap.parse_args(["selftest", *(argv or [])])
+
+    if args.cmd == "lint":
+        # backend-free: pure AST analysis, runnable on a box whose
+        # tunnel is dead (and in every tpu_watch.sh cycle)
+        from netrep_tpu.analysis.linter import main_lint
+
+        return main_lint(args)
 
     if args.cmd == "perf":
         # backend-free like the telemetry report: the regression gate must

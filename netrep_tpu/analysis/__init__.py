@@ -1,0 +1,30 @@
+"""Repo-native static analysis (ISSUE 12): the invariant linter.
+
+The contracts that make exact-reproducibility hold at fleet scale — the
+``fold_in(key, i)`` RNG discipline, no buffer donation into Pallas call
+paths, the fault-taxonomy line that bugs never silently retry, the pinned
+telemetry event schema, the ``x_`` checkpoint-extras namespace, and lock
+discipline across thread seams — are encoded as AST rules
+(:mod:`netrep_tpu.analysis.rules`) and enforced by a walker with inline,
+reasoned, counted suppressions (:mod:`netrep_tpu.analysis.linter`).
+
+Run it: ``python -m netrep_tpu lint [--json] [--rule NAME] [paths...]``
+(exit 2 on findings). The tier-1 gate ``tests/test_lint.py`` asserts the
+package itself lints clean, so every new violation must be fixed or
+justified in the same commit that introduces it.
+"""
+
+from .linter import (  # noqa: F401
+    LINT_SCHEMA, LintReport, lint_paths, lint_source,
+)
+from .rules import Finding, Module, default_rules  # noqa: F401
+
+__all__ = [
+    "LINT_SCHEMA",
+    "LintReport",
+    "Finding",
+    "Module",
+    "default_rules",
+    "lint_paths",
+    "lint_source",
+]

@@ -1,0 +1,685 @@
+"""The invariant rule catalogue (ISSUE 12).
+
+Every contract that makes the repo's bit-identity story hold — the
+``fold_in(key, i)`` RNG discipline, no buffer donation into Pallas call
+paths, the fault-taxonomy rule that bugs never silently retry, the pinned
+telemetry schema, the ``x_`` checkpoint-extras namespace, and lock
+discipline around cross-thread state — lived in CHANGES.md prose and
+whichever test happened to exercise it. This module encodes each as an
+AST-level :class:`Rule` so ``python -m netrep_tpu lint`` machine-checks
+them on every commit (the PR 8 alias-unsafe donation bug and the ADVICE r5
+tolerance-tier hole are both instances a rule here would have caught).
+
+A rule is any object with ``name``, ``description``, and
+``check(module) -> list[Finding]``; :data:`RULES` is the active set the
+walker (:mod:`netrep_tpu.analysis.linter`) runs. Rules must be pure
+functions of the parsed source — no imports of the module under analysis,
+no execution — so the linter is safe on broken/unimportable files and
+fast enough for every watch cycle.
+
+Suppressions: a finding on line *L* is silenced by a comment on *L* or
+*L-1* of the form ``# netrep: allow(<rule>) — <reason>`` (see
+:mod:`netrep_tpu.analysis.linter` for the grammar). Suppressions are
+counted and reported — a justified exception is still an exception.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Iterable, Iterator, Protocol
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a file/line."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Module:
+    """A parsed source file plus the cheap derived views rules share:
+    the import alias map, the source lines, and the path's position
+    relative to the package root (``pkg_rel`` is ``None`` for files
+    outside ``netrep_tpu/`` — rule scoping treats those as always in
+    scope, so test fixtures exercise every rule without path games)."""
+
+    def __init__(self, path: str, source: str, pkg_rel: str | None = None):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.pkg_rel = pkg_rel
+        self.aliases = _import_aliases(self.tree)
+
+    def in_scope(self, top_dirs: tuple[str, ...]) -> bool:
+        """True when this module falls under one of the package's
+        ``top_dirs`` subpackages — or is not a package file at all
+        (fixtures are always in scope)."""
+        if self.pkg_rel is None:
+            return True
+        head = self.pkg_rel.replace("\\", "/").split("/", 1)[0]
+        return head in top_dirs
+
+    def dotted(self, node: ast.AST) -> str | None:
+        """Resolve an attribute chain (``np.random.default_rng``) to its
+        canonical dotted name (``numpy.random.default_rng``) using the
+        module's import aliases; ``None`` for non-name expressions."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        root = self.aliases.get(parts[0])
+        if root is not None:
+            parts[0:1] = root.split(".")
+        return ".".join(parts)
+
+
+def _import_aliases(tree: ast.Module) -> dict[str, str]:
+    """Name → dotted-module map from every import statement in the file
+    (function-level imports included — the repo defers heavy imports)."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _imported_modules(tree: ast.Module) -> set[str]:
+    """Every module path named by an import statement (including relative
+    ``from ..ops import fused_stats`` → ``..ops.fused_stats``)."""
+    mods: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            mods.update(a.name for a in node.names)
+        elif isinstance(node, ast.ImportFrom):
+            base = ("." * node.level) + (node.module or "")
+            mods.add(base)
+            mods.update(f"{base}.{a.name}" for a in node.names)
+    return mods
+
+
+def _body_calls(body: Iterable[ast.AST]) -> Iterator[ast.AST]:
+    """Walk statement bodies without descending into nested function or
+    class definitions (their contracts are checked at their own site)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class Rule(Protocol):
+    """The rule protocol: a name (the suppression/selection key), a
+    one-line description for the catalogue, and a pure AST check."""
+
+    name: str
+    description: str
+
+    def check(self, mod: Module) -> list[Finding]:  # pragma: no cover
+        ...
+
+
+# ---------------------------------------------------------------------------
+# 1. rng-discipline
+# ---------------------------------------------------------------------------
+
+class RngDiscipline:
+    """Inside the null-path subpackages (``parallel/``, ``ops/``,
+    ``atlas/``) the ONLY legal randomness is a stream derived from the
+    run key via ``jax.random.fold_in(key, i)`` — that contract is what
+    makes results independent of chunk size, mesh shape, resume point,
+    and serve packing. Creating fresh keys (``jax.random.key`` /
+    ``PRNGKey`` / ``split``), host RNGs (``np.random.*`` /  stdlib
+    ``random.*``), or wall-clock entropy (``time.time``) on a null path
+    silently breaks bit-identity; sanctioned sites (the root-key
+    constructor, cache-busting index draws) carry a reasoned
+    suppression."""
+
+    name = "rng-discipline"
+    description = ("null-path modules may only use fold_in-derived RNG "
+                   "streams (no key creation/split, np.random, stdlib "
+                   "random, or time.time)")
+
+    SCOPE = ("parallel", "ops", "atlas")
+    #: jax.random members that CONSUME an existing key (legal) rather
+    #: than create or fork one (illegal on null paths)
+    ALLOWED_JAX_RANDOM = frozenset(
+        {"fold_in", "permutation", "key_data", "wrap_key_data"}
+    )
+
+    def check(self, mod: Module) -> list[Finding]:
+        if not mod.in_scope(self.SCOPE):
+            return []
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = mod.dotted(node.func)
+            if d is None:
+                continue
+            msg = None
+            if d.startswith("jax.random."):
+                tail = d.rsplit(".", 1)[1]
+                if tail not in self.ALLOWED_JAX_RANDOM:
+                    msg = (f"{d}() creates/forks a PRNG stream on a null "
+                           "path — only fold_in-derived streams keep "
+                           "results chunk/mesh/resume-independent")
+            elif d.startswith("numpy.random."):
+                msg = (f"{d}() is host randomness on a null path — "
+                       "results must derive from fold_in(key, i) only")
+            elif d == "time.time":
+                msg = ("time.time() is wall-clock entropy on a null path "
+                       "— use deterministic inputs (perf_counter/"
+                       "monotonic are fine for telemetry durations)")
+            elif d.startswith("random.") and mod.aliases.get(
+                    "random") == "random":
+                msg = (f"stdlib {d}() on a null path — only "
+                       "fold_in-derived jax.random streams are legal")
+            if msg is not None:
+                out.append(Finding(self.name, mod.path, node.lineno, msg))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 2. donation-alias
+# ---------------------------------------------------------------------------
+
+class DonationAlias:
+    """The PR 8 bug class: donating a buffer (``donate_argnums``) into a
+    jitted program whose call path reaches a Pallas kernel aliases input
+    and output under interpret mode — the kernel reads rows its own
+    output already overwrote. The repo's convention is a mode-gated
+    variable (``donate = () if stat_mode == 'fused' else (0,)``); an
+    UNCONDITIONAL literal donation in any module that imports Pallas or
+    the fused kernels is exactly the latent form of that bug."""
+
+    name = "donation-alias"
+    description = ("no unconditional literal donate_argnums in modules "
+                   "that reach Pallas kernels — donation must be "
+                   "mode-gated off the fused/interpret path")
+
+    PALLAS_MARKERS = ("pallas", "fused_stats", "fused_gather")
+
+    def _touches_pallas(self, mod: Module) -> bool:
+        return any(
+            marker in imported
+            for imported in _imported_modules(mod.tree)
+            for marker in self.PALLAS_MARKERS
+        )
+
+    def check(self, mod: Module) -> list[Finding]:
+        if not self._touches_pallas(mod):
+            return []
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg not in ("donate_argnums", "donate_argnames"):
+                    continue
+                v = kw.value
+                nonempty_literal = (
+                    (isinstance(v, ast.Constant)
+                     and not (v.value in ((), None) or v.value == ()))
+                    or (isinstance(v, (ast.Tuple, ast.List)) and v.elts)
+                )
+                if nonempty_literal:
+                    out.append(Finding(
+                        self.name, mod.path, kw.value.lineno,
+                        f"literal {kw.arg} in a Pallas-reaching module "
+                        "donates unconditionally — interpret-mode "
+                        "kernels alias donated buffers (PR 8 bug class); "
+                        "gate it off the fused path via a variable",
+                    ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 3. exception-taxonomy
+# ---------------------------------------------------------------------------
+
+class ExceptionTaxonomy:
+    """The fault taxonomy (``utils/faults.py``) draws one line: transient
+    device faults retry, BUGS NEVER SILENTLY RETRY (or vanish). A bare
+    ``except:`` / ``except Exception`` / ``except BaseException`` that
+    swallows is where a bug becomes a silent wrong answer. Every broad
+    handler must re-raise (any ``raise`` in the handler), route through
+    ``faults.classify_error``, or carry a reasoned suppression naming why
+    swallowing is the contract at that site (observer code that must
+    never kill the run, import-time optional dependencies)."""
+
+    name = "exception-taxonomy"
+    description = ("broad except handlers must re-raise, classify via "
+                   "faults.classify_error, or carry a justification "
+                   "suppression")
+
+    BROAD = frozenset({"Exception", "BaseException"})
+
+    def _is_broad(self, handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True
+        names = t.elts if isinstance(t, ast.Tuple) else [t]
+        return any(isinstance(n, ast.Name) and n.id in self.BROAD
+                   for n in names)
+
+    def check(self, mod: Module) -> list[Finding]:
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node):
+                continue
+            handled = False
+            for sub in _body_calls(node.body):
+                if isinstance(sub, ast.Raise):
+                    handled = True
+                    break
+                if (isinstance(sub, ast.Call)
+                        and (mod.dotted(sub.func) or "").rsplit(
+                            ".", 1)[-1] == "classify_error"):
+                    handled = True
+                    break
+            if not handled:
+                caught = ("bare except" if node.type is None
+                          else f"except {ast.unparse(node.type)}")
+                out.append(Finding(
+                    self.name, mod.path, node.lineno,
+                    f"{caught} swallows without re-raising or "
+                    "classify_error — bugs must never silently retry or "
+                    "vanish (faults.py taxonomy); narrow the type, "
+                    "re-raise, or justify with a suppression",
+                ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 4. telemetry-registry
+# ---------------------------------------------------------------------------
+
+class TelemetryRegistry:
+    """Every literal event name passed to ``emit()`` / ``begin_span()`` /
+    ``span()`` / ``end_span()`` must belong to the pinned registries in
+    ``utils/telemetry.py`` (``ENGINE_EVENTS`` / ``RECOVERY_EVENTS`` /
+    ``SERVE_EVENTS`` / ``SPAN_EVENTS``). Dashboards, ``summarize_watch``
+    and the CLI report key on these names — an unregistered emit is
+    schema drift that no test notices until a dashboard goes dark
+    (``request_requeued`` shipped exactly that way in PR 10)."""
+
+    name = "telemetry-registry"
+    description = ("literal event names in emit()/begin_span()/span()/"
+                   "end_span() must be members of the pinned telemetry "
+                   "registries")
+
+    def __init__(self, known: frozenset[str] | None = None):
+        if known is None:
+            from ..utils.telemetry import KNOWN_EVENTS
+
+            known = KNOWN_EVENTS
+        self.known = known
+
+    def check(self, mod: Module) -> list[Finding]:
+        out = []
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if attr in ("emit", "begin_span", "span"):
+                pos = 0
+            elif attr == "end_span":
+                pos = 1  # end_span(span_id, ev, ...)
+            else:
+                continue
+            if len(node.args) <= pos:
+                continue
+            arg = node.args[pos]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue  # dynamic names are the caller's responsibility
+            if arg.value not in self.known:
+                out.append(Finding(
+                    self.name, mod.path, arg.lineno,
+                    f"event name {arg.value!r} is not in any pinned "
+                    "telemetry registry (ENGINE/RECOVERY/SERVE/"
+                    "SPAN_EVENTS) — register it or the schema drifts "
+                    "silently under every dashboard keyed on it",
+                ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 5. checkpoint-extras-namespace
+# ---------------------------------------------------------------------------
+
+class CheckpointExtrasNamespace:
+    """Checkpoint auxiliary state rides ``save_null_checkpoint(...,
+    extra={...})`` and is serialized under an ``x_`` prefix so plain
+    resumes ignore it. Caller-side literal keys must therefore be bare
+    (the writer prefixes; an ``x_``-prefixed key would double-prefix and
+    orphan the state on resume) and must not shadow the reserved
+    top-level npz names. The second half of the contract: compiled-
+    program identity — any ``autotune_key()`` method must consult every
+    field that changes the compiled program (gather mode, stat mode,
+    effective chunk, bucket signature, data-only derivation), otherwise
+    two different programs share one autotune/perf-ledger fingerprint
+    and the regression gate compares apples to oranges."""
+
+    name = "checkpoint-extras-namespace"
+    description = ("checkpoint extra= keys must be bare identifiers "
+                   "(writer adds the x_ prefix) outside the reserved "
+                   "set; autotune_key() must consult every compiled-"
+                   "program-identity field")
+
+    RESERVED = frozenset(
+        {"version", "nulls", "completed", "key_data", "fingerprint"}
+    )
+    #: EngineConfig/engine fields that select a distinct compiled program
+    FINGERPRINT_FIELDS = ("gather_mode", "stat_mode", "effective_chunk",
+                          "buckets", "data_only")
+
+    def check(self, mod: Module) -> list[Finding]:
+        out = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                d = mod.dotted(node.func) or ""
+                if d.rsplit(".", 1)[-1] != "save_null_checkpoint":
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "extra" or not isinstance(kw.value,
+                                                          ast.Dict):
+                        continue
+                    for k in kw.value.keys:
+                        if not (isinstance(k, ast.Constant)
+                                and isinstance(k.value, str)):
+                            continue
+                        key = k.value
+                        bad = None
+                        if key.startswith("x_"):
+                            bad = ("already x_-prefixed — the writer "
+                                   "prefixes again and the resume path "
+                                   "never finds it")
+                        elif key in self.RESERVED:
+                            bad = ("shadows a reserved checkpoint field "
+                                   "after prefixing conventions change")
+                        elif not key.isidentifier():
+                            bad = "not a bare identifier"
+                        if bad:
+                            out.append(Finding(
+                                self.name, mod.path, k.lineno,
+                                f"checkpoint extra key {key!r} {bad}",
+                            ))
+            elif (isinstance(node, ast.ClassDef)):
+                for item in node.body:
+                    if (isinstance(item, ast.FunctionDef)
+                            and item.name == "autotune_key"):
+                        out.extend(self._check_autotune_key(mod, item))
+        return out
+
+    def _check_autotune_key(self, mod: Module,
+                            fn: ast.FunctionDef) -> list[Finding]:
+        seen: set[str] = set()
+        for node in ast.walk(fn):
+            # delegation (super().autotune_key(...) / base.autotune_key)
+            # inherits the delegate's field coverage — checked at ITS site
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "autotune_key"):
+                return []
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                seen.add(node.attr)
+            # getattr(self, "field", default) counts as consulting it
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "getattr"
+                    and len(node.args) >= 2
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id == "self"
+                    and isinstance(node.args[1], ast.Constant)):
+                seen.add(str(node.args[1].value))
+        missing = [f for f in self.FINGERPRINT_FIELDS if f not in seen]
+        if not missing:
+            return []
+        return [Finding(
+            self.name, mod.path, fn.lineno,
+            "autotune_key() does not consult compiled-program-identity "
+            f"field(s) {missing} — distinct programs would share one "
+            "autotune/perf-ledger fingerprint",
+        )]
+
+
+# ---------------------------------------------------------------------------
+# 6. thread-shared-state
+# ---------------------------------------------------------------------------
+
+class ThreadSharedState:
+    """Lock discipline over the scheduler/journal/pool/telemetry/
+    checkpoint-writer thread seams: in any class that launches a
+    ``threading.Thread`` at one of its own methods, a ``self._*``
+    attribute written on one side of the thread boundary and touched on
+    the other must only ever be accessed under that class's lock or
+    condition (``with self._lock:`` / ``with self._cond:``), inside a
+    ``*_locked``-suffixed method (the repo's caller-holds-the-lock
+    convention), or carry a reasoned suppression. Synchronization
+    primitives themselves (locks, conditions, events, thread handles)
+    are exempt — they are their own synchronization. ``__init__`` is
+    pre-thread and exempt."""
+
+    name = "thread-shared-state"
+    description = ("cross-thread self._* state in thread-launching "
+                   "classes must be accessed under the class lock/"
+                   "condition (or in *_locked methods)")
+
+    SYNC_CTORS = frozenset({"Lock", "RLock", "Condition", "Event",
+                            "Semaphore", "BoundedSemaphore", "Barrier",
+                            "Thread", "local"})
+    _GUARD_NAME = re.compile(r"lock|cond|mutex")
+
+    def check(self, mod: Module) -> list[Finding]:
+        out = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(mod, node))
+        return out
+
+    # -- helpers ----------------------------------------------------------
+
+    def _sync_attrs(self, cls: ast.ClassDef) -> set[str]:
+        """Attributes holding synchronization primitives / thread
+        handles — exempt from the guard requirement, and (for locks and
+        conditions) the guards themselves."""
+        sync: set[str] = set()
+        for node in ast.walk(cls):
+            target = None
+            value: ast.AST | None = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign):
+                target, value = node.target, node.annotation
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                # dataclass field declaration: name: threading.Event = ...
+                if (isinstance(node, ast.AnnAssign)
+                        and isinstance(node.target, ast.Name)
+                        and self._is_sync_expr(node.annotation)):
+                    sync.add(node.target.id)
+                continue
+            if value is not None and self._is_sync_expr(value):
+                sync.add(target.attr)
+        return sync
+
+    def _is_sync_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            node = node.func
+        if isinstance(node, ast.Attribute):
+            return node.attr in self.SYNC_CTORS
+        if isinstance(node, ast.Name):
+            return node.id in self.SYNC_CTORS
+        if isinstance(node, (ast.BinOp, ast.Subscript, ast.Constant)):
+            # annotations like "threading.Thread | None"
+            return any(self._is_sync_expr(c)
+                       for c in ast.iter_child_nodes(node))
+        return False
+
+    def _thread_targets(self, cls: ast.ClassDef) -> set[str]:
+        """Names of methods that RUN on a spawned thread: the methods
+        launched as Thread targets from within the class
+        (``threading.Thread(target=self._loop, ...)``) plus the
+        transitive closure of ``self.method()`` calls from them — a
+        helper invoked by the worker loop executes on the worker thread
+        even though no Thread names it."""
+        roots: set[str] = set()
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Call)
+                    and self._is_thread_ctor(node.func)):
+                continue
+            for kw in node.keywords:
+                if (kw.arg == "target"
+                        and isinstance(kw.value, ast.Attribute)
+                        and isinstance(kw.value.value, ast.Name)
+                        and kw.value.value.id == "self"):
+                    roots.add(kw.value.attr)
+        if not roots:
+            return roots
+        calls: dict[str, set[str]] = {}
+        for m in cls.body:
+            if not isinstance(m, ast.FunctionDef):
+                continue
+            callees: set[str] = set()
+            for node in ast.walk(m):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"):
+                    callees.add(node.func.attr)
+            calls[m.name] = callees
+        closed, frontier = set(roots), list(roots)
+        while frontier:
+            for callee in calls.get(frontier.pop(), ()):
+                if callee in calls and callee not in closed:
+                    closed.add(callee)
+                    frontier.append(callee)
+        return closed
+
+    @staticmethod
+    def _is_thread_ctor(func: ast.AST) -> bool:
+        return ((isinstance(func, ast.Attribute) and func.attr == "Thread")
+                or (isinstance(func, ast.Name) and func.id == "Thread"))
+
+    def _is_guard(self, expr: ast.AST, sync: set[str]) -> bool:
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id == "self":
+            return (expr.attr in sync
+                    or bool(self._GUARD_NAME.search(expr.attr)))
+        return False
+
+    def _accesses(self, method: ast.FunctionDef, sync: set[str]):
+        """Yield ``(attr, line, is_write, guarded)`` for every
+        ``self._*`` access in the method, tracking ``with self._lock:``
+        nesting (no descent into nested functions — closures run on
+        whatever thread calls them, checked at their own site if they
+        are methods)."""
+        guarded_always = method.name.endswith("_locked")
+
+        def walk(node: ast.AST, depth: int):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return
+            inner = depth
+            if isinstance(node, ast.With):
+                if any(self._is_guard(item.context_expr, sync)
+                       for item in node.items):
+                    inner += 1
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and node.attr.startswith("_")
+                    and node.attr not in sync):
+                yield (node.attr, node.lineno,
+                       isinstance(node.ctx, (ast.Store, ast.Del)),
+                       guarded_always or inner > 0)
+            for child in ast.iter_child_nodes(node):
+                yield from walk(child, inner)
+
+        for stmt in method.body:
+            yield from walk(stmt, 0)
+
+    def _check_class(self, mod: Module,
+                     cls: ast.ClassDef) -> list[Finding]:
+        targets = self._thread_targets(cls)
+        if not targets:
+            return []
+        sync = self._sync_attrs(cls)
+        methods = [n for n in cls.body if isinstance(n, ast.FunctionDef)]
+        # attr -> {"target": [...accesses...], "other": [...]}
+        by_attr: dict[str, dict[str, list]] = {}
+        for m in methods:
+            if m.name == "__init__":
+                continue  # pre-thread construction
+            side = "target" if m.name in targets else "other"
+            for attr, line, is_write, guarded in self._accesses(m, sync):
+                rec = by_attr.setdefault(attr, {"target": [], "other": []})
+                rec[side].append((m.name, line, is_write, guarded))
+        out = []
+        for attr, rec in sorted(by_attr.items()):
+            crosses = (
+                (any(w for _, _, w, _ in rec["target"])
+                 and rec["other"])
+                or (any(w for _, _, w, _ in rec["other"])
+                    and rec["target"])
+            )
+            if not crosses:
+                continue
+            for side in ("target", "other"):
+                for meth, line, is_write, guarded in rec[side]:
+                    if guarded:
+                        continue
+                    kind = "written" if is_write else "read"
+                    out.append(Finding(
+                        self.name, mod.path, line,
+                        f"self.{attr} is shared across the "
+                        f"{cls.name} thread boundary but {kind} in "
+                        f"{meth}() outside the class lock/condition — "
+                        "guard it (with self._lock / *_locked method) "
+                        "or justify with a suppression",
+                    ))
+        # deterministic order for stable reports
+        out.sort(key=lambda f: f.line)
+        return out
+
+
+def default_rules() -> tuple:
+    """The active rule set, constructed fresh (the telemetry rule loads
+    the pinned registries at construction)."""
+    return (
+        RngDiscipline(),
+        DonationAlias(),
+        ExceptionTaxonomy(),
+        TelemetryRegistry(),
+        CheckpointExtrasNamespace(),
+        ThreadSharedState(),
+    )

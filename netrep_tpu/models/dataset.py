@@ -16,6 +16,7 @@ import numpy as np
 
 try:  # pandas is optional at runtime but used when given
     import pandas as pd
+# netrep: allow(exception-taxonomy) — optional-dependency probe: ANY import-time failure (broken install included) means "run without pandas"
 except Exception:  # pragma: no cover
     pd = None
 

@@ -14,6 +14,7 @@ import numpy as np
 
 try:
     import pandas as pd
+# netrep: allow(exception-taxonomy) — optional-dependency probe: ANY import-time failure (broken install included) means "run without pandas"
 except Exception:  # pragma: no cover
     pd = None
 

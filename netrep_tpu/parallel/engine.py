@@ -90,6 +90,7 @@ def _engine_fingerprint_key(base) -> str:
         return ""
     try:
         return str(key_fn())
+    # netrep: allow(exception-taxonomy) — third-party engine key probe: '' only disables fingerprint grouping, never the run
     except Exception:
         return ""
 
@@ -345,6 +346,7 @@ def run_checkpointed_chunks(
                 outs, at, take_p, _sid = pending
                 write(nulls, outs, at, take_p)
                 completed = at + take_p
+            # netrep: allow(exception-taxonomy) — failure-unwind flush of already-computed work on a possibly-dead device; the original error re-raises just below
             except Exception:
                 pass
         if save is not None and completed > last_saved:
@@ -401,6 +403,7 @@ def _resolve_key(base, key):
     if prepare is not None:
         return prepare(key)
     if isinstance(key, int):
+        # netrep: allow(rng-discipline) — THE seeding contract's root-key site: every fold_in stream derives from exactly this key
         return jax.random.key(key)
     return key
 
@@ -1343,6 +1346,7 @@ def check_derived_network(corr, net, net_beta, what: str) -> None:
     c = np.asarray(corr).reshape(-1)
     m = np.asarray(net).reshape(-1)
     if c.size > 65536:
+        # netrep: allow(rng-discipline) — fixed-seed cache-busting probe indices for autotune timing; never touches null results
         ii = np.random.default_rng(0).integers(0, c.size, size=65536)
         c, m = c[ii], m[ii]
     # Evaluate the expected sample on the host CPU: on tunneled TPU

@@ -1048,6 +1048,7 @@ class PreservationServer:
                 # journal's accepted records and the pack checkpoints
                 # survive, for the next `--recover` boot to pick up
                 return
+            # netrep: allow(exception-taxonomy) — the worker outlives any batch failure; the error is logged and delivered to every waiter below
             except Exception:   # defensive: the worker must never die
                 logger.warning(
                     "serve worker: unhandled batch failure", exc_info=True
@@ -1204,8 +1205,11 @@ class PreservationServer:
             batch = live
             if not batch:
                 return
-        self._pack_seq += 1
-        pack_id = f"p{self._pack_seq}"
+        # under the condition: `packs` in stats() reads this counter from
+        # client threads (ISSUE 12 thread-shared-state discipline)
+        with self._work:
+            self._pack_seq += 1
+            pack_id = f"p{self._pack_seq}"
         multi = isinstance(batch[0].plan, _MultiPlan)
         # canonical member order → stable pool signatures across packs
         if not multi:
@@ -1218,6 +1222,7 @@ class PreservationServer:
                 self._execute_multi(batch[0], pack_id)
             else:
                 self._execute_pack(batch, pack_id)
+        # netrep: allow(exception-taxonomy) — serving fault boundary: the error becomes each waiter's error result (packs retry solo first); crashes (BaseException) still unwind
         except Exception as e:
             err = f"{type(e).__name__}: {e}"
             if len(batch) > 1:
@@ -1290,10 +1295,13 @@ class PreservationServer:
                     results = run_pack(engine, plans, **kw)
             else:
                 results = run_pack(engine, plans, **kw)
-        except Exception:
+        except BaseException:
             # a failed run may leave the engine's device state suspect —
             # drop it from the warm pool before the error propagates
-            # (the pack checkpoint, if any, stays for the solo retries)
+            # (the pack checkpoint, if any, stays for the solo retries).
+            # BaseException, not Exception: a KeyboardInterrupt or
+            # SimulatedCrash-class unwind mid-pack leaves the engine just
+            # as suspect, and `raise` re-raises it unchanged (ISSUE 12)
             self.pool.discard(key)
             raise
         if ckpt_path is not None:
@@ -1363,7 +1371,9 @@ class PreservationServer:
                 plan.n_perm, plan.seed, monitor, telemetry=self.tel,
                 fault_policy=self._fault,
             )
-        except Exception:
+        except BaseException:
+            # same warm-pool hygiene as _execute_pack, same
+            # BaseException rationale (ISSUE 12)
             self.pool.discard(key)
             raise
         self._account_pack_locked(

@@ -145,6 +145,7 @@ def dispatch_op(server: PreservationServer, op: dict,
     except (ServeError, TimeoutError, KeyError, TypeError,
             ValueError) as e:
         return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    # netrep: allow(exception-taxonomy) — wire boundary: one malformed/failed op becomes that client's error line, the daemon keeps serving
     except Exception as e:  # the handler loop must survive anything
         return {"ok": False,
                 "error": f"internal error: {type(e).__name__}: {e}"}

@@ -228,7 +228,13 @@ class AsyncCheckpointWriter:
                 fn()
                 with self._lock:
                     self._writes += 1
-            except Exception:
+            except BaseException as e:
+                if not isinstance(e, Exception):
+                    # KeyboardInterrupt / SimulatedCrash-class unwinds
+                    # must kill this thread like they kill the process —
+                    # absorbing one as "a failed write" would let a crash
+                    # drill report a healthy writer (ISSUE 12 taxonomy)
+                    raise
                 logger.warning(
                     "async checkpoint write failed; the next save will "
                     "retry", exc_info=True,
@@ -259,15 +265,20 @@ class AsyncCheckpointWriter:
             already = self._closed
             self._closed = True
             self._cond.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
+            thread = self._thread
+            # snapshot the tallies under the lock: the writer thread may
+            # still be mid-_loop until the join below (ISSUE 12
+            # thread-shared-state discipline)
+            writes, superseded = self._writes, self._superseded
+        if thread is not None:
+            thread.join(timeout=2.0)
         if already:
             return
         tel = self.telemetry if self.telemetry is not None else _telemetry()
         if tel is not None:
             tel.emit(
-                "checkpoint_async_flush", writes=self._writes,
-                superseded=self._superseded, waited_s=float(waited),
+                "checkpoint_async_flush", writes=writes,
+                superseded=superseded, waited_s=float(waited),
             )
 
 

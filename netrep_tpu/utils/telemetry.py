@@ -134,6 +134,63 @@ SERVE_EVENTS = (
     "serve_brownout_exit",
     "journal_replayed",
     "request_malformed",
+    # deadline-driven retirement re-bucketing (ISSUE 10) — was emitted
+    # but never registered; ISSUE 12's telemetry-registry lint rule
+    # caught the drift and pinned it here
+    "request_requeued",
+)
+
+#: engine/infrastructure event names outside the recovery and serving
+#: sets: the null-loop progress events, compile/autotune accounting,
+#: checkpoint lifecycle, and the atlas tile plane. Together with
+#: :data:`RECOVERY_EVENTS`, :data:`SERVE_EVENTS`, and :data:`SPAN_EVENTS`
+#: this is the COMPLETE schema of event names the package may emit —
+#: enforced statically by the ``telemetry-registry`` lint rule
+#: (:mod:`netrep_tpu.analysis`): an ``emit()`` of an unregistered name is
+#: a lint finding, so the schema cannot drift silently between the code
+#: and the dashboards/summarizers keyed on these names.
+ENGINE_EVENTS = (
+    "allgather",
+    "autotune_hit",
+    "autotune_miss",
+    "autotune_record",
+    "backend_probe",
+    "checkpoint_saved",
+    "checkpoint_resumed",
+    "chunk",
+    "compile_span",
+    "dispatch",
+    "distributed_init",
+    "module_retired",
+    "superchunk",
+    "tail_trim_skipped",
+    "tile",
+    "tile_screen",
+)
+
+#: span begin/end event names (:meth:`Telemetry.span`,
+#: :meth:`Telemetry.begin_span`/:meth:`Telemetry.end_span`) — the node
+#: names of the trace tree. Pinned for the same reason as
+#: :data:`ENGINE_EVENTS`: ``trace.py`` and Perfetto exports key on them.
+SPAN_EVENTS = (
+    "null_run_start",
+    "null_run_end",
+    "observed",
+    "pack",
+    "pair_start",
+    "pair_end",
+    "run_start",
+    "run_end",
+    "serve_start",
+    "serve_end",
+    "tile_pass_start",
+    "tile_pass_end",
+)
+
+#: the union the ``telemetry-registry`` lint rule checks literal event
+#: names against — every registry above, nothing else
+KNOWN_EVENTS = frozenset(
+    ENGINE_EVENTS + RECOVERY_EVENTS + SERVE_EVENTS + SPAN_EVENTS
 )
 
 
@@ -387,6 +444,7 @@ class Telemetry:
         for fn in self._subscribers:
             try:
                 fn(record)
+            # netrep: allow(exception-taxonomy) — telemetry only observes: a raising subscriber is logged, the run continues bit-identically
             except Exception:  # observers must never break the run
                 logger.warning("telemetry subscriber raised", exc_info=True)
         return record
@@ -694,6 +752,7 @@ class StallWatchdog:
             )
             try:
                 act()
+            # netrep: allow(exception-taxonomy) — escalation action is best-effort; the watchdog must keep polling for the next stall
             except Exception:  # the action must never kill the watchdog
                 logger.warning("stall watchdog action raised", exc_info=True)
         return newly
@@ -712,6 +771,7 @@ class StallWatchdog:
         while not self._stop.wait(self.poll_interval):
             try:
                 self.poll()
+            # netrep: allow(exception-taxonomy) — observer thread: a poll bug must degrade to a warning, never kill the monitored run
             except Exception:  # pragma: no cover - must never kill the run
                 logger.warning("stall watchdog poll raised", exc_info=True)
 

@@ -39,6 +39,27 @@ def test_selftest_runs_multiple_shapes():
     assert out["atol"] == 1e-4  # CPU tier
 
 
+def test_tolerance_tier_table():
+    """ISSUE 12 closes the ADVICE r5 hole for good: the loose ~2e-2 MXU
+    tier is keyed to backends KNOWN to truncate f32 matmuls to bf16
+    (tpu, and the axon tunnel — the same MXU behind a gRPC dial);
+    everything else — cuda, rocm, cpu, and any accelerator this table
+    has never seen — gets the tight 1e-4 exact-f32 tier, so a 100×
+    GPU-math regression cannot wave through under hardware-rounding
+    headroom. A genuinely truncating new backend fails loudly and is
+    added here deliberately."""
+    from netrep_tpu.utils.selftest import (
+        _ATOL_EXACT, _ATOL_MXU, _TRUNCATING_BACKENDS, tolerance_for,
+    )
+
+    assert _TRUNCATING_BACKENDS == ("tpu", "axon")
+    assert _ATOL_MXU == 2e-2 and _ATOL_EXACT == 1e-4
+    for backend in _TRUNCATING_BACKENDS:
+        assert tolerance_for(backend) == _ATOL_MXU
+    for backend in ("cpu", "cuda", "rocm", "gpu", "some_future_npu"):
+        assert tolerance_for(backend) == _ATOL_EXACT
+
+
 def test_selftest_max_shapes_bounds_work():
     """The watcher's on-chip gate runs max_shapes=1 to fit a short tunnel
     window; the bound must actually limit the shapes executed."""

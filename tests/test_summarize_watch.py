@@ -122,3 +122,37 @@ def test_cli_prints_telemetry_split(tmp_path):
     assert "superchunk: 1.250s" in proc.stdout
     assert "observed: 0.750s" in proc.stdout
     assert "north" in proc.stdout                     # result row intact
+
+
+def test_lint_rows_classified_and_summarized(tmp_path):
+    """Invariant-lint report lines (`python -m netrep_tpu lint --json`,
+    appended once per watch cycle — ISSUE 12) classify as their own
+    kind: never a measurement, never dropped as an error row even when
+    non-ok, and summarized in a contract-health section."""
+    clean = {"lint_v": 1, "ok": True, "files": 55, "rules": ["x"],
+             "findings": [], "suppressed": [], "suppressions": [],
+             "stale_suppressions": []}
+    dirty = {**clean, "ok": False, "findings": [
+        {"rule": "rng-discipline", "path": "a.py", "line": 3, "message": "m"},
+        {"rule": "rng-discipline", "path": "b.py", "line": 9, "message": "m"},
+        {"rule": "exception-taxonomy", "path": "c.py", "line": 1,
+         "message": "m"},
+    ]}
+    assert classify(clean) == "lint"
+    assert classify(dirty) == "lint"
+    # near-miss: wrong schema version falls through to the old rules
+    assert classify({"lint_v": 99, "findings": []}) != "lint"
+
+    lines = summarize_watch.lint_lines([clean, dirty])
+    assert "2 lint cycle(s): 1 clean, 1 with findings" in lines[0]
+    assert "exception-taxonomy: 1" in lines[1]
+    assert "rng-discipline: 2" in lines[1]
+
+    log = tmp_path / "watch.jsonl"
+    log.write_text(json.dumps(clean) + "\n" + json.dumps(dirty) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "benchmarks/summarize_watch.py", str(log)],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "invariant lint (contract health)" in proc.stdout

@@ -283,7 +283,31 @@ def test_serve_event_names_pinned():
         "serve_brownout_exit",
         "journal_replayed",
         "request_malformed",
+        # deadline-driven retirement re-bucketing (ISSUE 10), registered
+        # by ISSUE 12's telemetry-registry lint rule
+        "request_requeued",
     )
+
+
+def test_known_events_cover_every_emitted_name():
+    """ISSUE 12: the pinned registries (ENGINE/RECOVERY/SERVE/SPAN) are
+    the COMPLETE event-name schema. The static half of this contract is
+    the ``telemetry-registry`` lint rule; this dynamic half pins the
+    union's composition so a registry refactor cannot silently drop a
+    subset out of :data:`KNOWN_EVENTS`."""
+    from netrep_tpu.utils.telemetry import (
+        ENGINE_EVENTS, KNOWN_EVENTS, RECOVERY_EVENTS, SERVE_EVENTS,
+        SPAN_EVENTS,
+    )
+
+    union = ENGINE_EVENTS + RECOVERY_EVENTS + SERVE_EVENTS + SPAN_EVENTS
+    assert KNOWN_EVENTS == frozenset(union)
+    # no duplicates across registries: each name has one owning registry
+    assert len(union) == len(set(union))
+    # spans pair up: every *_start has its *_end in the registry
+    for name in SPAN_EVENTS:
+        if name.endswith("_start"):
+            assert name[:-6] + "_end" in SPAN_EVENTS
 
 
 def test_tenant_summary_folds_serve_events():

@@ -1,0 +1,119 @@
+"""Thread-hygiene regression tests (ISSUE 12): the dynamic counterpart
+of the ``thread-shared-state`` lint rule.
+
+The package spawns helper threads in several places — the stall
+watchdog, the async checkpoint writer, the serve worker, fault-runtime
+dispatch threads — and every one of them is supposed to be joined or
+stopped when its owner finishes. A leaked thread is a slow fleet killer:
+each served request or preservation run that leaks one grows the
+process until the scheduler drowns. These tests snapshot the live
+Python thread set, run the thread-spawning paths end to end, and assert
+the set RETURNS TO BASELINE (deliberately-leaked abandoned-dispatch
+threads excepted — they are documented as unjoinable and only exist
+when a dispatch actually hangs, which these runs never do)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from netrep_tpu import module_preservation
+from netrep_tpu.data import make_mixed_pair
+from netrep_tpu.utils.config import EngineConfig, FaultPolicy
+
+
+def _live():
+    return {t for t in threading.enumerate() if t.is_alive()}
+
+
+def _settle(baseline, timeout_s=15.0):
+    """Wait for every non-baseline thread to exit; returns the leftovers
+    (empty set = clean). Daemon helpers are joined by their owners, but
+    the join happens-before the owner's return only up to a bounded
+    timeout, so poll briefly instead of asserting instantly."""
+    deadline = time.monotonic() + timeout_s
+    extra = _live() - baseline
+    while extra and time.monotonic() < deadline:
+        time.sleep(0.05)
+        extra = _live() - baseline
+    return extra
+
+
+@pytest.fixture()
+def pair_kw():
+    mixed = make_mixed_pair(100, 3, n_samples=16, seed=7)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    assign = {f"node_{i}": "0" for i in range(dn.shape[0])}
+    for lab, idx in mixed["specs"]:
+        for i in idx:
+            assign[f"node_{i}"] = str(lab)
+    return dict(
+        network={"d": dn, "t": tn}, correlation={"d": dc, "t": tc},
+        data={"d": dd, "t": td}, module_assignments=assign,
+        discovery="d", test="t",
+        config=EngineConfig(chunk_size=16, autotune=False),
+    )
+
+
+def test_preservation_run_releases_all_threads(pair_kw, tmp_path):
+    """module_preservation with an active fault policy (stall watchdog +
+    fault runtime) and a checkpoint path (async checkpoint writer) must
+    return the process to its baseline thread set — no leaked
+    netrep-stall-watchdog / netrep-ckpt-writer / netrep-ft-dispatch
+    threads."""
+    # warm-up absorbs lazily-created long-lived threads (XLA pools,
+    # telemetry globals) so the baseline is what steady state looks like
+    module_preservation(**pair_kw, n_perm=16, seed=0)
+    baseline = _live()
+
+    res = module_preservation(
+        **pair_kw, n_perm=32, seed=0,
+        telemetry=str(tmp_path / "tel.jsonl"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_every=16,
+        fault_policy=FaultPolicy(backoff_base_s=0.0, backoff_jitter=0.0),
+    )
+    assert int(res.completed) == 32
+    leftovers = _settle(baseline)
+    assert not leftovers, (
+        f"leaked threads after module_preservation: "
+        f"{sorted(t.name for t in leftovers)}"
+    )
+
+
+def test_serve_drain_releases_all_threads(pair_kw, tmp_path):
+    """Boot the in-process server, serve one request, drain — the serve
+    worker, its watchdogs, and the pack machinery must all be gone when
+    close(drain=True) returns."""
+    from netrep_tpu.serve import InProcessClient, PreservationServer, \
+        ServeConfig
+
+    # warm-up: one full server lifecycle absorbs lazy singletons
+    srv0 = PreservationServer(
+        ServeConfig(engine=pair_kw["config"]), start=True)
+    srv0.close(drain=False)
+    baseline = _live()
+
+    srv = PreservationServer(
+        ServeConfig(engine=pair_kw["config"],
+                    telemetry=str(tmp_path / "serve_tel.jsonl")),
+        start=True,
+    )
+    client = InProcessClient(srv)
+    client.register_dataset("a", "d", network=pair_kw["network"]["d"],
+                            correlation=pair_kw["correlation"]["d"],
+                            data=pair_kw["data"]["d"],
+                            assignments=pair_kw["module_assignments"])
+    client.register_dataset("a", "t", network=pair_kw["network"]["t"],
+                            correlation=pair_kw["correlation"]["t"],
+                            data=pair_kw["data"]["t"])
+    res = client.analyze("a", "d", "t", n_perm=32, seed=3, timeout=600)
+    assert np.asarray(res["p_values"]).size
+    srv.close(drain=True)
+
+    leftovers = _settle(baseline)
+    assert not leftovers, (
+        f"leaked threads after serve drain: "
+        f"{sorted(t.name for t in leftovers)}"
+    )

@@ -174,3 +174,24 @@ def test_drained_queue_reports_drained_even_past_cutoff(tmp_path):
     assert "queue drained" in log
     assert "cutoff window reached" not in log
     assert "no step can finish" not in log
+
+
+def test_lint_step_runs_when_forced_and_stays_off_under_queue_hook(tmp_path):
+    """ISSUE 12: the per-cycle invariant lint is off under the
+    QUEUE_FILE state-machine hook (auto), runs with LINT_CHECK=1, and
+    NEVER fails the cycle — a clean tree logs its one `lint --json`
+    line and the queue still drains."""
+    # default (auto) under QUEUE_FILE: no lint banner in the log
+    proc, _, log = run_watch(tmp_path, ["one 30 echo ok-one"])
+    assert proc.returncode == 0
+    assert "invariant lint" not in log
+    # forced on: the banner and the machine line appear, queue drains
+    proc2, _, log2 = run_watch(
+        tmp_path, ["two 30 echo ok-two"], tag="lint",
+        extra_env={"LINT_CHECK": "1", "JAX_PLATFORMS": "cpu"},
+        timeout=180,
+    )
+    assert proc2.returncode == 0, proc2.stderr
+    assert "invariant lint" in log2
+    assert '"lint_v": 1' in log2
+    assert "queue drained" in log2
