@@ -222,6 +222,73 @@ def run_open_loop(client, requests, rate: float):
     return time.perf_counter() - t0, results, lats
 
 
+COST_FIELDS = ("device_s", "transfer_s", "perms", "bytes_to_host",
+               "compile_s_amortized")
+
+
+def check_cost_conservation(results):
+    """The ISSUE 13 in-bench gate, run BEFORE any row is emitted: every
+    pack's member costs must sum bit-exactly (f64, ``==``) to its pack
+    totals — a fast-but-misattributed cost row is impossible. Returns the
+    per-tenant attributed rollup table."""
+    packs = {}
+    for r, res in results:
+        c = res.get("cost")
+        if c is not None:
+            packs.setdefault(res["pack_id"], []).append(c)
+    assert packs, "no attributed costs on served results (telemetry on?)"
+    for pid, members in packs.items():
+        totals = members[0]["pack_totals"]
+        for f in COST_FIELDS:
+            s = members[0][f]
+            for m in members[1:]:
+                s = s + m[f]
+            assert s == totals[f], (
+                f"cost conservation violated in pack {pid}: "
+                f"{f} members={s!r} totals={totals[f]!r}"
+            )
+    tenants = {}
+    for r, res in results:
+        c = res.get("cost")
+        if c is None:
+            continue
+        t = tenants.setdefault(r["tenant"], {
+            "requests": 0, "device_s": 0.0, "perms": 0, "bytes_to_host": 0,
+        })
+        t["requests"] += 1
+        t["device_s"] += float(c["device_s"])
+        t["perms"] += int(c["perms"])
+        t["bytes_to_host"] += int(c["bytes_to_host"])
+    return tenants
+
+
+def cost_row(mode, args, wall, tenants_cost, device, tel_path):
+    """The per-tenant attributed-cost row (``serve-cost`` metric label:
+    its perf-ledger fingerprints never mix with the load rows; the
+    ``cost`` dict rides into the ledger as a ``cost_v`` block — the
+    fleet-admission signal)."""
+    total_dev = sum(t["device_s"] for t in tenants_cost.values())
+    total_perms = sum(t["perms"] for t in tenants_cost.values())
+    return {
+        "metric": (
+            f"serve-cost per-tenant attributed [{mode}] "
+            f"({len(tenants_cost)} tenants, chunk {args.chunk})"
+        ),
+        "value": round(total_dev, 4),
+        "unit": "device_s",
+        "perms_per_sec": round(total_perms / wall, 2) if wall > 0 else 0,
+        "cost": {
+            t: {"device_s": round(v["device_s"], 6), "perms": v["perms"],
+                "bytes_to_host": v["bytes_to_host"],
+                "requests": v["requests"]}
+            for t, v in sorted(tenants_cost.items())
+        },
+        "telemetry": tel_path,
+        "device": device,
+        "chunk": args.chunk,
+    }
+
+
 def compile_split(tel_path):
     """(cold_total_s, warm_max_s) over the run's ``compile_span`` events:
     first event per fingerprint is the cold compile, every later one must
@@ -322,6 +389,14 @@ def run_drill(args) -> int:
         res = client.analyze("drill", "fx_d", "fx_t",
                              n_perm=args.n_perm_lo, seed=1)
         ok_served = res["completed"] == args.n_perm_lo
+        # live-dashboard snapshot over the wire (ISSUE 13): the same
+        # `top --once --json` surface, captured before the drain so the
+        # watch loop archives one per drill cycle
+        from netrep_tpu.serve.top import snapshot
+
+        snap = snapshot(client.stats())
+        print(json.dumps({"metric": "serve top snapshot", "value": 1,
+                          "unit": "snapshot", "top": snap}), flush=True)
         client.close()
         proc.send_signal(signal.SIGTERM)
         out, err = proc.communicate(timeout=args.drain_wait)
@@ -546,8 +621,13 @@ def main() -> int:
             assert np.array_equal(
                 served0["p_values"], np.asarray(first_direct.p_values)
             ), "served/direct p-value mismatch"
+            # conservation gate BEFORE any row (ISSUE 13), then the
+            # per-tenant attributed-cost table beside p50/p99
+            tenants_cost = check_cost_conservation(results)
             emit(row_from("closed loop", args, wall, results, lats,
                           serial_s, srv, tel_path, device))
+            emit(cost_row("closed", args, wall, tenants_cost, device,
+                          tel_path))
         if args.mode in ("both", "open"):
             # one unreported warm-up pass: open-loop arrivals queue deeper
             # than the closed loop and mint larger pack compositions —
@@ -556,8 +636,11 @@ def main() -> int:
             run_open_loop(client, requests, args.rate)
             wall, results, lats = run_open_loop(client, requests,
                                                args.rate)
+            tenants_cost = check_cost_conservation(results)
             emit(row_from("open loop (steady state)", args, wall, results,
                           lats, serial_s, srv, tel_path, device))
+            emit(cost_row("open", args, wall, tenants_cost, device,
+                          tel_path))
     finally:
         srv.close()
     return rc

@@ -82,6 +82,18 @@ def classify(row: dict) -> str:
         # invariant-lint report (netrep_tpu.analysis): never a
         # measurement — summarized in its own contract-health section
         return "lint"
+    if isinstance(row.get("top"), dict) and "tenants" in row["top"]:
+        # `top --once --json` snapshot captured by the serve drill
+        # (ISSUE 13): an ops artifact, never a TPU measurement —
+        # summarized in the serve-observability section. Checked BEFORE
+        # the CPU drop below: the serve plane runs on CPU by design.
+        return "serve-top"
+    if (isinstance(row.get("metric"), str)
+            and row["metric"].startswith("serve-cost")
+            and isinstance(row.get("cost"), dict)):
+        # per-tenant attributed-cost row (ISSUE 13): surfaced as the
+        # cost table, not a BASELINE measurement (CPU by design)
+        return "serve-cost"
     if row.get("tpu_fallback") or "error" in row or "warning" in row:
         return "dropped"
     if row.get("cached"):
@@ -179,9 +191,42 @@ def lint_lines(rows: list[dict]) -> list[str]:
     return lines
 
 
+def serve_cost_lines(cost_rows: list[dict],
+                     top_rows: list[dict]) -> list[str]:
+    """Serve-observability section (ISSUE 13): the newest per-tenant
+    attributed-cost table per mode label, plus the newest `top` snapshot
+    headline (brownout / burn rates) — cost signals for the fleet, never
+    BASELINE measurements."""
+    lines = []
+    newest: dict[str, dict] = {}
+    for r in cost_rows:
+        newest[str(r["metric"]).split(" (", 1)[0]] = r
+    for label in sorted(newest):
+        r = newest[label]
+        lines.append(f"{r['metric']}: {r['value']}{r.get('unit', '')}")
+        for t, c in sorted(r["cost"].items()):
+            lines.append(
+                f"  {t}: device_s={c.get('device_s')} "
+                f"perms={c.get('perms')} bytes={c.get('bytes_to_host')} "
+                f"requests={c.get('requests')}"
+            )
+    if top_rows:
+        snap = top_rows[-1]["top"]
+        burn = ", ".join(
+            f"{t['tenant']}={t.get('burn_rate', 0):g}"
+            for t in snap.get("tenants", [])
+        )
+        lines.append(
+            f"newest top snapshot: {len(snap.get('tenants', []))} "
+            f"tenant(s), brownout={snap.get('brownout')}, "
+            f"burn rates [{burn}] ({len(top_rows)} snapshot(s) total)"
+        )
+    return lines
+
+
 def main(paths: list[str]) -> int:
     results, unknown, other, dropped, telemetry = [], [], [], 0, []
-    ledger, lint = [], []
+    ledger, lint, serve_cost, serve_top = [], [], [], []
     for p in paths:
         for r in rows_from(p):
             kind = classify(r)
@@ -199,6 +244,15 @@ def main(paths: list[str]) -> int:
                 ledger.append(r)
             elif kind == "lint":
                 lint.append(r)
+            elif kind == "serve-cost":
+                serve_cost.append(r)
+            elif kind == "serve-top":
+                serve_top.append(r)
+    if serve_cost or serve_top:
+        print("## serve observability (attributed cost + top snapshots)")
+        for line in serve_cost_lines(serve_cost, serve_top):
+            print(line)
+        print()
     if lint:
         print("## invariant lint (contract health)")
         for line in lint_lines(lint):

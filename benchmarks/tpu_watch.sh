@@ -185,6 +185,18 @@ serve_drill() {
        --smoke --drill >>"$LOG" 2>&1; then
     echo "--- SERVE DRILL FAILED (daemon SIGTERM drain regressed?) ---" | tee -a "$LOG"
   fi
+  # Observability artifacts (ISSUE 13), loud-never-fatal: the drill just
+  # printed one `top --once --json`-shaped snapshot row into $LOG
+  # (serve_load --drill captures it over the wire before the drain);
+  # here the cycle's serve telemetry also exports as a merged Perfetto
+  # trace artifact, so "what happened to request X" is one click away
+  # from any watch log.
+  if [ -s "$TELEMETRY" ]; then
+    if ! timeout 120 python -m netrep_tpu telemetry "$TELEMETRY" \
+         --trace "${LOG%.jsonl}_serve_trace.json" >>"$LOG" 2>&1; then
+      echo "--- SERVE TRACE EXPORT FAILED (telemetry/trace regressed?) ---" | tee -a "$LOG"
+    fi
+  fi
 }
 
 # Invariant lint (ISSUE 12): once per watch cycle, run the repo's static

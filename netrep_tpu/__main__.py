@@ -49,6 +49,68 @@ def _positive(value: str) -> int:
     return n
 
 
+def _telemetry_follow(path: str, poll_s: float = 0.25,
+                      max_polls: int | None = None) -> int:
+    """``telemetry --follow`` (ISSUE 13): tail -f the live JSONL,
+    rendering each event line as it lands (the shared
+    :func:`netrep_tpu.utils.telemetry.format_event` renderer) — the
+    poor-man's live view for non-serve runs. Ctrl-C exits cleanly and,
+    when the log carried serve events, prints the same per-tenant table
+    ``top`` renders (:mod:`netrep_tpu.serve.top` — one renderer, two
+    feeds). ``max_polls`` bounds the loop for tests."""
+    import time
+
+    from netrep_tpu.utils.telemetry import (
+        format_event, is_event, tenant_summary,
+    )
+
+    events = []
+    t0 = None
+    polls = 0
+    try:
+        with open(path, encoding="utf-8") as f:
+            while True:
+                line = f.readline()
+                if not line:
+                    polls += 1
+                    if max_polls is not None and polls >= max_polls:
+                        break
+                    time.sleep(poll_s)
+                    continue
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    e = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn in-flight line: re-read never helps
+                if not is_event(e):
+                    continue
+                if t0 is None:
+                    t0 = e["t"]
+                events.append(e)
+                print(format_event(e, t0), flush=True)
+    except KeyboardInterrupt:
+        pass
+    except OSError as e:
+        print(f"cannot follow {path!r}: {e}", file=sys.stderr)
+        return 1
+    rows = tenant_summary(events)
+    if rows:
+        from netrep_tpu.serve.top import render_tenant_table
+
+        table_rows = []
+        for t in sorted(rows):
+            r = rows[t]
+            table_rows.append({
+                "tenant": t, "done": r["done"], "failed": r["failed"],
+                "expired": r["expired"], "device_s": r["device_s"],
+            })
+        print()
+        print(render_tenant_table(table_rows))
+    return 0
+
+
 def _chaos(args) -> int:
     """The ``chaos`` subcommand: a deterministic elastic-recovery drill
     (ISSUE 6). Injects the fault plan into a toy preservation run on a
@@ -326,7 +388,10 @@ def main(argv=None) -> int:
     tl = sub.add_parser(
         "telemetry", help="aggregate a telemetry JSONL into a summary report"
     )
-    tl.add_argument("path", help="telemetry event log (JSONL)")
+    tl.add_argument("path", nargs="+",
+                    help="telemetry event log(s) (JSONL); several files "
+                         "merge in the --trace export (client log + N "
+                         "server generations → one trace, ISSUE 13)")
     tl.add_argument("--prom", action="store_true",
                     help="Prometheus text exposition instead of the table")
     tl.add_argument("--json", action="store_true",
@@ -337,7 +402,15 @@ def main(argv=None) -> int:
                          "injected faults)")
     tl.add_argument("--trace", metavar="OUT",
                     help="export the span tree as Chrome/Perfetto "
-                         "trace-event JSON to OUT")
+                         "trace-event JSON to OUT; with several input "
+                         "files, spans sharing a trace id (a request "
+                         "across a SIGKILL + --recover restart) render "
+                         "as one continuous trace")
+    tl.add_argument("--follow", action="store_true",
+                    help="tail the log live (ISSUE 13): render events/"
+                         "spans as they land — the poor-man's live view "
+                         "for non-serve runs; exits on Ctrl-C with a "
+                         "per-tenant table when the log has serve events")
     pf = sub.add_parser(
         "perf", help="per-run throughput ledger: trend / regression check"
     )
@@ -452,6 +525,24 @@ def main(argv=None) -> int:
                     help="[--serve] concurrent requests in the drill")
     ch.add_argument("--chunk", type=_positive, default=16,
                     help="[--serve] served EngineConfig.chunk_size")
+    tp = sub.add_parser(
+        "top",
+        help="live ops dashboard over a running serve daemon (ISSUE 13): "
+             "per-tenant queue depth, p50/p99 latency, attributed "
+             "device-seconds, brownout state, and SLO burn rate, "
+             "refreshed from the daemon's stats op",
+    )
+    tp.add_argument("--socket", required=True, metavar="PATH",
+                    help="the daemon's unix socket")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh interval in seconds (default 2)")
+    tp.add_argument("--once", action="store_true",
+                    help="print one frame and exit (scripts/CI)")
+    tp.add_argument("--json", action="store_true",
+                    help="emit the snapshot as one JSON line instead of "
+                         "the table")
+    tp.add_argument("--timeout", type=float, default=30.0,
+                    help="socket timeout seconds")
     ln = sub.add_parser(
         "lint",
         help="invariant linter (ISSUE 12): statically enforce the "
@@ -522,34 +613,43 @@ def main(argv=None) -> int:
         # the report you run precisely when the tunnel is dead)
         from netrep_tpu.utils.telemetry import aggregate_file, render_recovery
 
+        paths = args.path
+        path0 = paths[0]
         if args.trace:
             from netrep_tpu.utils.trace import write_perfetto
 
             try:
-                n = write_perfetto(args.path, args.trace)
+                n = write_perfetto(paths, args.trace)
             except OSError as e:
-                print(f"cannot read {args.path!r}: {e}", file=sys.stderr)
+                print(f"cannot read {paths!r}: {e}", file=sys.stderr)
                 return 1
-            print(f"wrote {n} trace events to {args.trace}")
+            print(f"wrote {n} trace events to {args.trace}"
+                  + (f" (merged from {len(paths)} files)"
+                     if len(paths) > 1 else ""))
             return 0
+        if len(paths) > 1:
+            print("multiple input files are only merged by --trace; "
+                  "reporting on the first", file=sys.stderr)
+        if args.follow:
+            return _telemetry_follow(path0)
         if args.recovery:
             try:
-                timeline = render_recovery(args.path)
+                timeline = render_recovery(path0)
             except OSError as e:
-                print(f"cannot read {args.path!r}: {e}", file=sys.stderr)
+                print(f"cannot read {path0!r}: {e}", file=sys.stderr)
                 return 1
             if not timeline:
-                print(f"no recovery events in {args.path!r}")
+                print(f"no recovery events in {path0!r}")
                 return 0
             print(timeline)
             return 0
         try:
-            reg = aggregate_file(args.path)
+            reg = aggregate_file(path0)
         except OSError as e:
-            print(f"cannot read {args.path!r}: {e}", file=sys.stderr)
+            print(f"cannot read {path0!r}: {e}", file=sys.stderr)
             return 1
         if reg.n_events == 0:
-            print(f"no telemetry events in {args.path!r}", file=sys.stderr)
+            print(f"no telemetry events in {path0!r}", file=sys.stderr)
             return 1
         if args.prom:
             sys.stdout.write(reg.render_prometheus())
@@ -560,17 +660,23 @@ def main(argv=None) -> int:
             from netrep_tpu.utils.telemetry import render_tenants
             from netrep_tpu.utils.trace import render_time_split
 
-            split = render_time_split(args.path)
+            split = render_time_split(path0)
             if split:
                 print()
                 print(split)
             # per-tenant serving section (ISSUE 7): present only for logs
             # written by `netrep serve` / the load generator
-            tenants = render_tenants(args.path)
+            tenants = render_tenants(path0)
             if tenants:
                 print()
                 print(tenants)
         return 0
+
+    if args.cmd == "top":
+        # backend-free: `top` only speaks the daemon's wire ops
+        from netrep_tpu.serve.top import run_top
+
+        return run_top(args)
 
     if args.cmd == "serve":
         # the daemon resolves its backend hang-safely like selftest below

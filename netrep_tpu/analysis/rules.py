@@ -370,6 +370,99 @@ class TelemetryRegistry:
 
 
 # ---------------------------------------------------------------------------
+# 4b. span-pairing
+# ---------------------------------------------------------------------------
+
+class SpanPairing:
+    """Loop-shaped spans (``begin_span``/``end_span``) are the one place
+    the trace tree can leak: a ``begin_span`` whose ``end_span`` never
+    ships renders every later event under a span that never closes, and
+    the time-split/Perfetto exports mis-nest silently (context-manager
+    ``span()`` cannot leak — the ``with`` closes it). The contract: a
+    function that calls ``begin_span`` must also contain the matching
+    ``end_span``; when the span id is handed off through a ``self``
+    attribute (the server-lifetime ``serve_start`` span, opened in
+    ``__init__`` and closed in ``close()``), the ``end_span`` may live in
+    any method of the same class. This is deliberately presence-based,
+    not full path analysis: the crash path MAY skip the end (begin-only
+    spans render zero-width by design — a crashed run must still
+    export); what it catches is the end never being written at all."""
+
+    name = "span-pairing"
+    description = ("every begin_span() needs its end_span in the same "
+                   "function (or, for self-attribute span ids, in the "
+                   "same class)")
+
+    @staticmethod
+    def _calls(fn: ast.FunctionDef, attr: str) -> list[ast.Call]:
+        out = []
+        for node in _body_calls(fn.body):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == attr):
+                out.append(node)
+        return out
+
+    @staticmethod
+    def _assigns_to_self(fn: ast.FunctionDef, call: ast.Call) -> bool:
+        """Whether the begin_span result is stored on ``self`` (the
+        cross-method handoff shape: ``self._sid = tel.begin_span(...)``,
+        possibly behind a conditional)."""
+        for node in _body_calls(fn.body):
+            if not isinstance(node, ast.Assign):
+                continue
+            if node.value is not call:
+                continue
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    return True
+        return False
+
+    def check(self, mod: Module) -> list[Finding]:
+        out = []
+        # class context per function: a self-attribute handoff may close
+        # in any sibling method
+        class_of: dict[ast.FunctionDef, ast.ClassDef | None] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        class_of[item] = node
+        for fn in [n for n in ast.walk(mod.tree)
+                   if isinstance(n, ast.FunctionDef)]:
+            begins = self._calls(fn, "begin_span")
+            if not begins:
+                continue
+            if self._calls(fn, "end_span"):
+                continue
+            cls = class_of.get(fn)
+            for call in begins:
+                if cls is not None and self._assigns_to_self(fn, call):
+                    closed = any(
+                        isinstance(m, ast.FunctionDef)
+                        and self._calls(m, "end_span")
+                        for m in cls.body
+                    )
+                    if closed:
+                        continue
+                ev = ""
+                if call.args and isinstance(call.args[0], ast.Constant):
+                    ev = f" ({call.args[0].value!r})"
+                out.append(Finding(
+                    self.name, mod.path, call.lineno,
+                    f"begin_span{ev} has no matching end_span in "
+                    f"{fn.name}()"
+                    + (" or its class" if cls is not None else "")
+                    + " — the span never closes and every later event "
+                      "mis-nests under it; emit the end (crash paths may "
+                      "skip it at runtime) or justify with a suppression",
+                ))
+        return out
+
+
+# ---------------------------------------------------------------------------
 # 5. checkpoint-extras-namespace
 # ---------------------------------------------------------------------------
 
@@ -680,6 +773,7 @@ def default_rules() -> tuple:
         DonationAlias(),
         ExceptionTaxonomy(),
         TelemetryRegistry(),
+        SpanPairing(),
         CheckpointExtrasNamespace(),
         ThreadSharedState(),
     )

@@ -1202,6 +1202,14 @@ def run_adaptive_chunks(
     t_marks: list[tuple[int, float]] = []
     run_sid = None
     mem = None
+    # chunk-cost hook (ISSUE 13): monitors that attribute pack costs per
+    # request (serve's PackMonitor) receive each chunk's measured
+    # dispatch/transfer seconds — resolved once, telemetry-path only, so
+    # the disabled hot loop keeps its single None check
+    note_cost = (
+        getattr(monitor, "note_chunk_cost", None)
+        if telemetry is not None else None
+    )
     if telemetry is not None:
         run_sid = telemetry.begin_span(
             "null_run_start", mode="adaptive", n_perm=int(n_perm),
@@ -1233,9 +1241,9 @@ def run_adaptive_chunks(
                 t_d0 = time.perf_counter()
                 with telemetry.pushed(sid_c):
                     outs = _dispatch()
+                disp_s = time.perf_counter() - t_d0
                 telemetry.emit(
-                    "dispatch", parent=sid_c,
-                    s=time.perf_counter() - t_d0,
+                    "dispatch", parent=sid_c, s=disp_s,
                     start=int(completed), take=int(take),
                 )
                 t_w0 = time.perf_counter()
@@ -1247,6 +1255,8 @@ def run_adaptive_chunks(
             newly = monitor.update(
                 slice_vals(nulls, completed - take, take, pos), take
             )
+            if note_cost is not None:
+                note_cost(disp_s, write_s)
             if telemetry is not None:
                 now = time.perf_counter()
                 t_marks.append((completed, now))

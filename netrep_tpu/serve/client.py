@@ -30,7 +30,7 @@ import socket
 import time
 import uuid
 
-from .protocol import decode_arrays, encode_arrays
+from .protocol import decode_arrays, encode_arrays, mint_trace_ctx
 
 
 class ServeRejected(RuntimeError):
@@ -88,7 +88,10 @@ class InProcessClient:
 
     def submit(self, tenant: str, discovery: str, test, **kw):
         """Non-blocking submit; returns the request handle for
-        :meth:`result`."""
+        :meth:`result`. Mints a trace context (ISSUE 13) unless the
+        caller supplies its own — the id the request's whole span
+        subtree carries, across processes and server restarts."""
+        kw.setdefault("trace_ctx", mint_trace_ctx())
         return self.server.submit(tenant, discovery, test, **kw)
 
     def result(self, handle, timeout: float | None = None) -> dict:
@@ -103,10 +106,13 @@ class InProcessClient:
         with deterministic backoff under ONE idempotency key — the
         server's ``retry_after_s`` hint, when present, wins over the
         computed delay. Safe by construction: the key dedups every
-        attempt onto one computation."""
+        attempt onto one computation. The trace context (ISSUE 13), like
+        the idempotency key, is minted ONCE per logical request — every
+        retry carries the same trace id."""
         from .scheduler import QueueFull
 
         key = kw.setdefault("idempotency_key", f"c-{uuid.uuid4().hex}")
+        kw.setdefault("trace_ctx", mint_trace_ctx())
         attempt = 0
         while True:
             try:
@@ -188,8 +194,12 @@ class SocketClient:
         ``retry_after_s`` hint) or a dropped/restarted daemon connection
         is retried under ONE idempotency key: after a ``serve --recover``
         boot the re-sent request is answered from the journal (or
-        attaches to its re-queued run) instead of recomputing."""
+        attaches to its re-queued run) instead of recomputing. The trace
+        context is minted once per logical request (ISSUE 13): every
+        attempt — across reconnects and daemon restarts — carries the
+        same trace id, so the merged trace is one story."""
         key = kw.setdefault("idempotency_key", f"c-{uuid.uuid4().hex}")
+        kw.setdefault("trace_ctx", mint_trace_ctx())
         attempt = 0
         while True:
             try:

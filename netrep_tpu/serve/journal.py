@@ -9,9 +9,13 @@ most the in-flight line, torn final lines are tolerated on read):
 
 - ``accepted`` — written and **fsynced before the request is admitted**
   to the queue: tenant, dataset names + content digests, the full
-  analyze params, the seed, and the client-supplied **idempotency key**
-  (auto-assigned when the client sends none). An accepted record with no
-  matching terminal record is, by definition, work the server still owes.
+  analyze params, the seed, the client-supplied **idempotency key**
+  (auto-assigned when the client sends none), and the request's **trace
+  context** (ISSUE 13: ``trace={"trace": <id>, "parent": <span>}``) — so
+  a ``--recover`` boot re-queues the request under the SAME client-
+  minted trace id and the pre- and post-crash span trees merge into one
+  continuous trace. An accepted record with no matching terminal record
+  is, by definition, work the server still owes.
 - ``done`` / ``failed`` — the terminal record: the result digest and the
   full wire-encoded result (``done``), or the error string (``failed``).
   A ``done`` record is what a duplicate submission with the same

@@ -377,6 +377,20 @@ class PackMonitor:
     :class:`StopMonitor` does for solo adaptive runs, so a ``SIGKILL``
     mid-pack resumes from the last chunk boundary — per-request child
     monitors are namespaced ``g<i>_*`` inside the pack's state.
+
+    Cost attribution (ISSUE 13): with :meth:`enable_cost_tracking` on
+    (``run_pack`` arms it whenever telemetry is), :meth:`update` records
+    each chunk's per-request live-module weights and host-pull bytes, the
+    engine loop feeds the chunk's measured dispatch/transfer seconds via
+    :meth:`note_chunk_cost`, and :meth:`request_costs` splits every
+    chunk's measured cost across the members by their EXACT
+    live-module × permutation share at that chunk — integer fields
+    (perms, bytes) by largest-remainder, float fields by
+    remainder-to-the-last-live-member, and the reported pack totals
+    DEFINED as the ordered member sums — so member costs sum bit-exactly
+    (f64 host arithmetic) to the pack totals by construction, across
+    retirement re-bucketing, deadline expiry, and checkpoint-resumed
+    recovery runs. Tracking off (telemetry off) records nothing.
     """
 
     def __init__(self, plans: list[RequestPlan], observed: np.ndarray,
@@ -398,6 +412,11 @@ class PackMonitor:
         self.telemetry = None
         #: plan index -> seconds past its deadline when it was cancelled
         self.expired: dict[int, float] = {}
+        #: cost-attribution chunk log (ISSUE 13): populated only when
+        #: :meth:`enable_cost_tracking` armed it (telemetry on), so the
+        #: telemetry-off pack path stays bit-and-behavior-identical
+        self._cost_enabled = False
+        self._cost_chunks: list[dict] = []
         self.children: list[StopMonitor | None] = []
         for p in plans:
             if p.adaptive:
@@ -426,6 +445,22 @@ class PackMonitor:
         pos = self.active_positions()
         vals = np.asarray(vals, dtype=np.float64)
         done0 = self.folded
+        if self._cost_enabled:
+            # the chunk that just landed ran with THIS active set: each
+            # member's exact share of the dispatch is its live modules ×
+            # the chunk's permutation count (the engine computed `take`
+            # rows for every active module, fold ceilings notwithstanding)
+            live = {}
+            for gi, p in enumerate(self.plans):
+                c = int(np.count_nonzero(
+                    (pos >= p.base) & (pos < p.base + p.k)
+                ))
+                if c:
+                    live[gi] = c
+            self._cost_chunks.append({
+                "take": int(take), "live": live,
+                "bytes": int(vals.nbytes),
+            })
         newly: list[np.ndarray] = []
         for p, child in zip(self.plans, self.children):
             cols = np.flatnonzero((pos >= p.base) & (pos < p.base + p.k))
@@ -475,6 +510,130 @@ class PackMonitor:
         if newly:
             return np.concatenate(newly)
         return np.empty(0, dtype=np.int64)
+
+    # -- cost attribution (ISSUE 13) ---------------------------------------
+
+    def enable_cost_tracking(self) -> None:
+        """Arm the per-chunk cost log (``run_pack`` calls this whenever
+        telemetry is on; off by default so the telemetry-off path records
+        nothing)."""
+        self._cost_enabled = True
+
+    def note_chunk_cost(self, dispatch_s: float,
+                        transfer_s: float = 0.0) -> None:
+        """Engine-loop hook: attach the measured dispatch/transfer
+        seconds of the chunk whose weights :meth:`update` just recorded.
+        The loop calls it right after folding the chunk, so the last
+        un-costed record is always the matching one."""
+        if not self._cost_enabled:
+            return
+        for rec in reversed(self._cost_chunks):
+            if "dispatch_s" not in rec:
+                rec["dispatch_s"] = float(dispatch_s)
+                rec["transfer_s"] = float(transfer_s)
+                return
+
+    #: the request_cost fields under the conservation contract
+    COST_FIELDS = ("device_s", "transfer_s", "perms", "bytes_to_host",
+                   "compile_s_amortized")
+
+    def request_costs(self) -> dict | None:
+        """Deterministic per-request cost attribution over the recorded
+        chunks; ``None`` when tracking was off or nothing ran.
+
+        Returns ``{"members": [one dict per plan, in plan order],
+        "totals": {...}, "measured_device_s": float}``. Per chunk, member
+        g's share weight is ``live_modules_g × take``; integer costs
+        (``perms``, ``bytes_to_host``) split by largest remainder, float
+        costs (``device_s``, ``transfer_s``) by remainder-to-the-last-
+        live-member, and ``compile_s_amortized`` (the first-dispatch-
+        minus-steady-median estimate) by total weight share. The
+        ``totals`` are DEFINED as the ordered (plan-order) f64 sums of
+        the member fields, so ``sum(member[f]) == totals[f]`` is an
+        identity — bit-exact, pinned in tests — while staying within one
+        rounding step of the raw measured sums (``measured_device_s``)."""
+        if not self._cost_enabled or not self._cost_chunks:
+            return None
+        G = len(self.plans)
+        perms = [0] * G
+        byts = [0] * G
+        dev = [0.0] * G
+        xfer = [0.0] * G
+        disp_series: list[float] = []
+        for c in self._cost_chunks:
+            live = c["live"]
+            take = int(c["take"])
+            if not live or take <= 0:
+                continue
+            order = sorted(live)
+            ws = {g: live[g] * take for g in order}
+            W = sum(ws.values())
+            d_s = float(c.get("dispatch_s", 0.0))
+            t_s = float(c.get("transfer_s", 0.0))
+            disp_series.append(d_s)
+            for g in order:
+                perms[g] += take
+            b = int(c["bytes"])
+            base = {g: b * ws[g] // W for g in order}
+            rem = b - sum(base.values())
+            for g in sorted(order, key=lambda g: (-(b * ws[g] % W), g)):
+                if rem <= 0:
+                    break
+                base[g] += 1
+                rem -= 1
+            for g in order:
+                byts[g] += base[g]
+            for arr, cost in ((dev, d_s), (xfer, t_s)):
+                acc = 0.0
+                for g in order[:-1]:
+                    x = cost * (ws[g] / W)
+                    arr[g] += x
+                    acc += x
+                arr[order[-1]] += cost - acc
+        # compile carve-out: the first dispatch absorbed the jit compile;
+        # steady state is the median of the rest (the engine's own
+        # compile_span convention), amortized by total weight share
+        if len(disp_series) >= 2:
+            rest = sorted(disp_series[1:])
+            comp = max(0.0, disp_series[0] - rest[len(rest) // 2])
+        else:
+            comp = 0.0
+        wtot = [
+            sum(c["live"].get(g, 0) * int(c["take"])
+                for c in self._cost_chunks)
+            for g in range(G)
+        ]
+        w_all = sum(wtot)
+        comp_g = [0.0] * G
+        if comp > 0 and w_all > 0:
+            live_gs = [g for g in range(G) if wtot[g] > 0]
+            acc = 0.0
+            for g in live_gs[:-1]:
+                x = comp * (wtot[g] / w_all)
+                comp_g[g] = x
+                acc += x
+            comp_g[live_gs[-1]] = comp - acc
+        members = [
+            {
+                "device_s": dev[g], "transfer_s": xfer[g],
+                "perms": perms[g], "bytes_to_host": byts[g],
+                "compile_s_amortized": comp_g[g],
+                "weight": int(wtot[g]),
+            }
+            for g in range(G)
+        ]
+        totals: dict = {f: 0 for f in ("perms", "bytes_to_host")}
+        totals.update({f: 0.0 for f in ("device_s", "transfer_s",
+                                        "compile_s_amortized")})
+        for m in members:
+            for f in self.COST_FIELDS:
+                totals[f] += m[f]
+        totals["weight"] = int(w_all)
+        return {
+            "members": members,
+            "totals": totals,
+            "measured_device_s": sum(disp_series),
+        }
 
     # -- checkpoint state (ISSUE 10) ---------------------------------------
 
@@ -554,6 +713,10 @@ def run_pack(engine: PackedEngine, plans: list[RequestPlan],
     valid result — the scheduler fails it as a deadline miss."""
     observed = np.asarray(engine.observed(), dtype=np.float64)
     monitor = PackMonitor(plans, observed, clock=clock)
+    if telemetry is not None:
+        # cost attribution rides the telemetry path only (ISSUE 13): the
+        # telemetry-off pack records nothing and stays PR 12-identical
+        monitor.enable_cost_tracking()
     n_perm_max = max(p.n_perm for p in plans)
     seeds = [p.seed for p in plans]
     nulls, completed, finished = engine.run_null_monitored(
@@ -561,15 +724,28 @@ def run_pack(engine: PackedEngine, plans: list[RequestPlan],
         telemetry=telemetry, fault_policy=fault_policy,
         checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
     )
+    costs = monitor.request_costs()
+
+    def cost_of(gi: int) -> dict | None:
+        if costs is None:
+            return None
+        return dict(costs["members"][gi],
+                    pack_totals=dict(costs["totals"]))
+
     out = []
     for gi, p in enumerate(plans):
         if gi in monitor.expired:
-            out.append({
+            res = {
                 "expired": True,
                 "deadline_miss_s": float(monitor.expired[gi]),
                 "n_perm": int(p.n_perm),
                 "completed": int(min(monitor.folded, p.n_perm)),
-            })
+            }
+            if costs is not None:
+                # an expired request consumed dispatches before its
+                # cancellation — its share is attributed, not vanished
+                res["cost"] = cost_of(gi)
+            out.append(res)
             continue
         obs_r = observed[p.base: p.base + p.k]
         nulls_r = nulls[: p.n_perm, p.base: p.base + p.k, :]
@@ -589,7 +765,9 @@ def run_pack(engine: PackedEngine, plans: list[RequestPlan],
         hi, lo, eff = pv.tail_counts(obs_r, nulls_r)
         n_present = np.array([p.counts[lab][0] for lab in p.labels])
         tot = np.array([p.counts[lab][1] for lab in p.labels])
+        cost = cost_of(gi)
         out.append({
+            **({"cost": cost} if cost is not None else {}),
             "module_labels": list(p.labels),
             "observed": obs_r,
             "p_values": p_values,

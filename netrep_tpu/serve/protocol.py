@@ -37,7 +37,44 @@ definition)::
 
 from __future__ import annotations
 
+import re
+import uuid
+
 import numpy as np
+
+#: trace-context wire shape (ISSUE 13, W3C-trace-context style): every
+#: ``analyze`` op may carry ``trace_ctx = {"trace": <32-hex trace id>,
+#: "parent": <caller span id | None>}``. The client mints one per LOGICAL
+#: request (stable across retries, like the idempotency key) unless the
+#: caller supplies its own; the server journals it with the ``accepted``
+#: record so a ``--recover`` boot resumes the SAME trace, and stamps it
+#: on the request's telemetry span — ``utils/trace.py`` then groups the
+#: request's whole span subtree (across processes and restarts) under
+#: this one id.
+TRACE_ID_RE = re.compile(r"^[0-9a-f]{8,64}$")
+
+
+def mint_trace_ctx(parent_span: str | None = None) -> dict:
+    """A fresh client-side trace context: a 32-hex trace id (W3C trace-id
+    sized) plus the caller's parent span id, if it has one."""
+    return {"trace": uuid.uuid4().hex, "parent": parent_span}
+
+
+def normalize_trace_ctx(ctx) -> dict | None:
+    """Validate/coerce a caller-supplied trace context; returns the
+    canonical ``{"trace", "parent"}`` dict or None for anything
+    unusable (a malformed context must never fail the request — tracing
+    only observes; the server then mints its own)."""
+    if not isinstance(ctx, dict):
+        return None
+    trace = ctx.get("trace")
+    if not (isinstance(trace, str) and TRACE_ID_RE.match(trace)):
+        return None
+    parent = ctx.get("parent")
+    if not (parent is None or isinstance(parent, str)):
+        parent = None
+    return {"trace": trace, "parent": parent}
+
 
 #: result keys the wire protocol round-trips as arrays
 ARRAY_KEYS = (

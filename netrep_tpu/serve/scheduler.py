@@ -169,6 +169,13 @@ class ServeConfig:
     #: evicted beyond this; in-flight requests never evict) — a duplicate
     #: of an evicted key recomputes, deterministically, to the same result
     idem_cache: int = 4096
+    # -- SLO burn rate (ISSUE 13) ---------------------------------------
+    #: error budget: the tolerated fraction of requests missing their
+    #: ``slo_s`` inside the sliding window; burn rate = observed miss
+    #: fraction / budget (1.0 = burning the budget exactly, >1 = on fire)
+    slo_budget: float = 0.01
+    #: sliding window (seconds) the burn rate is computed over
+    slo_window_s: float = 300.0
 
 
 @dataclasses.dataclass
@@ -188,6 +195,13 @@ class Request:
     submitted_m: float
     seq: int
     sid: str | None = None          # telemetry span id
+    #: distributed-trace identity (ISSUE 13): the client-minted (or
+    #: server-assigned) trace id + the caller's parent span id — stamped
+    #: on the request's telemetry span, journaled with ``accepted``, and
+    #: stable across a ``--recover`` restart so the request's span
+    #: subtree is one trace across process generations
+    trace: str | None = None
+    trace_parent: str | None = None
     solo_only: bool = False
     #: durable identity in the write-ahead journal (ISSUE 10): the
     #: client-supplied idempotency key, or an auto-assigned one; stable
@@ -219,6 +233,20 @@ class _Tenant:
             "received": 0, "done": 0, "failed": 0, "rejected": 0,
             "expired": 0, "deduped": 0,
         }
+        # -- per-tenant observability rollups (ISSUE 13) -----------------
+        #: request latency over the PINNED bucket boundaries — the
+        #: p50/p99 source of `top`, `stats`, and the Prometheus
+        #: histogram exposition
+        self.lat_hist = tm.BucketHistogram(tm.LATENCY_BUCKETS_S)
+        #: attributed device-seconds per request, same pinned-bucket
+        #: contract
+        self.cost_hist = tm.BucketHistogram(tm.COST_BUCKETS_S)
+        #: attributed cost totals folded from each request_cost
+        self.cost = {"device_s": 0.0, "transfer_s": 0.0, "perms": 0,
+                     "bytes_to_host": 0, "compile_s_amortized": 0.0}
+        #: (monotonic_t, missed_slo) per terminal request — the SLO
+        #: burn-rate sliding window
+        self.slo_marks: list[tuple[float, bool]] = []
 
 
 class _Dataset:
@@ -435,6 +463,10 @@ class PreservationServer:
                         adaptive=bool(params.get("adaptive", False)),
                         deadline_s=params.get("deadline_s"),
                         idempotency_key=str(rec.get("key")),
+                        # the journaled trace context: the re-queued run
+                        # continues the CALLER's trace, so pre- and
+                        # post-crash spans merge under one id (ISSUE 13)
+                        trace_ctx=rec.get("trace"),
                     )
                     requeued += 1
                 except ServeError as e:
@@ -746,7 +778,8 @@ class PreservationServer:
                seed: int = 0, alternative: str = "greater",
                adaptive: bool = False, rule=None,
                deadline_s: float | None = None,
-               idempotency_key: str | None = None) -> Request:
+               idempotency_key: str | None = None,
+               trace_ctx: dict | None = None) -> Request:
         """Validate, admit, and enqueue one analyze request; returns the
         request handle (``wait`` for the result). ``test`` may be a list
         of test-dataset names sharing a node universe — the request then
@@ -756,9 +789,19 @@ class PreservationServer:
         A duplicate submission with a seen key never recomputes — it
         attaches to the in-flight request or returns the completed
         (journaled) result. With a journal attached, the ``accepted``
-        record is fsynced before this method returns."""
+        record is fsynced before this method returns.
+
+        ``trace_ctx`` (ISSUE 13): the caller's W3C-style trace context
+        (``{"trace": <hex id>, "parent": <span id|None>}`` — the clients
+        mint one per logical request). It is journaled with the
+        ``accepted`` record (so ``--recover`` resumes the SAME trace) and
+        stamped on the request's telemetry span; a malformed context is
+        replaced by a server-minted one, never an error."""
         if alternative not in ("greater", "less", "two.sided"):
             raise ServeError(f"bad alternative {alternative!r}")
+        from .protocol import mint_trace_ctx, normalize_trace_ctx
+
+        tctx = normalize_trace_ctx(trace_ctx) or mint_trace_ctx()
         with self._work:
             dup = self._dedup_locked(idempotency_key)
             if dup is not None:
@@ -886,6 +929,7 @@ class PreservationServer:
                     "accepted", seq=self._seq, id=f"r{self._seq}",
                     key=jkey, tenant=tenant, discovery=discovery,
                     test=list(test) if multi else test,
+                    trace=dict(tctx),
                     digests=(
                         [self._dataset(tenant, discovery).digest]
                         + [self._dataset(tenant, t).digest
@@ -911,6 +955,7 @@ class PreservationServer:
                     else self.config.slo_s
                 ),
                 submitted_m=now, seq=self._seq, journal_key=jkey,
+                trace=tctx["trace"], trace_parent=tctx["parent"],
             )
             self._idem[jkey] = req
             ten.counters["received"] += 1
@@ -926,6 +971,12 @@ class PreservationServer:
                     ),
                     seed=int(seed), adaptive=bool(adaptive),
                     queue_depth=len(ten.pending) + 1,
+                    # trace-ctx stamp (ISSUE 13): build_span_tree
+                    # propagates `trace` down the request's whole
+                    # subtree, across processes and restarts
+                    trace=req.trace,
+                    **({"trace_parent": req.trace_parent}
+                       if req.trace_parent else {}),
                 )
             ten.pending.append(req)
             self._work.notify_all()
@@ -1073,13 +1124,21 @@ class PreservationServer:
                 result,
                 request_id=req.id, tenant=req.tenant,
                 discovery=req.discovery, test=req.test,
+                trace=req.trace,
                 latency_s=now - req.submitted_m,
                 pack_id=pack_id, pack_size=pack_size, pool_hit=pool_hit,
             )
             ten.counters["done"] += 1
+            latency = now - req.submitted_m
+            with self._work:
+                ten.lat_hist.observe(latency)
+                self._slo_mark_locked(ten, now, latency > self.config.slo_s)
+            self._account_cost(req, result.get("cost"))
         else:
             req.error = error
             ten.counters["failed"] += 1
+            with self._work:
+                self._slo_mark_locked(ten, now, True)
         if self.journal is not None and req.journal_key is not None:
             # terminal journal record: done carries the full encoded
             # result (what a post-restart duplicate is answered with) +
@@ -1112,6 +1171,53 @@ class PreservationServer:
         self._retire_idem(req)
         req.done.set()
 
+    def _slo_mark_locked(self, ten: _Tenant, now: float,
+                         missed: bool) -> None:
+        """Record one terminal request in the tenant's SLO sliding window
+        (caller holds the lock) and trim marks older than the window."""
+        ten.slo_marks.append((now, bool(missed)))
+        horizon = now - self.config.slo_window_s
+        while ten.slo_marks and ten.slo_marks[0][0] < horizon:
+            ten.slo_marks.pop(0)
+
+    def _burn_rate_locked(self, ten: _Tenant, now: float) -> float:
+        """SLO burn rate: miss fraction over the sliding window divided
+        by the error budget (1.0 = consuming the budget exactly at the
+        sustainable rate; 0 with no terminal requests in the window)."""
+        horizon = now - self.config.slo_window_s
+        marks = [m for t, m in ten.slo_marks if t >= horizon]
+        if not marks:
+            return 0.0
+        frac = sum(marks) / len(marks)
+        return frac / max(self.config.slo_budget, 1e-9)
+
+    def _account_cost(self, req: Request, cost: dict | None) -> None:
+        """Fold one request's attributed cost (ISSUE 13) into its
+        tenant's rollups and emit the pinned ``request_cost`` event under
+        the request's span — the per-tenant device-time signal `top`,
+        ``metrics_text()``, and fleet admission read."""
+        if cost is None:
+            return
+        ten = self._tenants[req.tenant]
+        with self._work:
+            for k in ("device_s", "transfer_s", "compile_s_amortized"):
+                ten.cost[k] += float(cost.get(k, 0.0))
+            for k in ("perms", "bytes_to_host"):
+                ten.cost[k] += int(cost.get(k, 0))
+            ten.cost_hist.observe(float(cost.get("device_s", 0.0)))
+        if self.tel is not None:
+            self.tel.emit(
+                "request_cost", parent=req.sid, tenant=req.tenant,
+                trace=req.trace, pack_weight=int(cost.get("weight", 0)),
+                device_s=float(cost.get("device_s", 0.0)),
+                transfer_s=float(cost.get("transfer_s", 0.0)),
+                perms=int(cost.get("perms", 0)),
+                bytes_to_host=int(cost.get("bytes_to_host", 0)),
+                compile_s_amortized=float(
+                    cost.get("compile_s_amortized", 0.0)
+                ),
+            )
+
     def _retire_idem(self, req: Request) -> None:
         """Bound the idempotency map: terminal requests stay answerable
         up to ``idem_cache`` of them; beyond that the oldest evict (a
@@ -1126,13 +1232,20 @@ class PreservationServer:
                 if stale is not None and stale.done.is_set():
                     del self._idem[old]
 
-    def _expire(self, req: Request, miss_s: float, folded: int) -> None:
+    def _expire(self, req: Request, miss_s: float, folded: int,
+                cost: dict | None = None) -> None:
         """Cancel a deadline-missed request (ISSUE 10): the ``expired``
         counter, a terminal ``failed`` journal record (a deadline miss
         must not resurrect on ``--recover``), the pinned
-        ``request_expired`` event with the miss, and the waiter's error."""
+        ``request_expired`` event with the miss, and the waiter's error.
+        ``cost`` (ISSUE 13) is the share of the pack the request consumed
+        before cancellation — attributed like any other, so the tenant's
+        device-time rollup never under-counts abandoned work."""
         ten = self._tenants[req.tenant]
         ten.counters["expired"] += 1
+        with self._work:
+            self._slo_mark_locked(ten, time.monotonic(), True)
+        self._account_cost(req, cost)
         error = (f"deadline exceeded by {miss_s:.2f}s "
                  f"(cancelled after {int(folded)} permutations)")
         req.error = error
@@ -1319,7 +1432,8 @@ class PreservationServer:
         for r, res in zip(batch, results):
             if res.get("expired"):
                 self._expire(r, res["deadline_miss_s"],
-                             res.get("completed", 0))
+                             res.get("completed", 0),
+                             cost=res.get("cost"))
             else:
                 self._finish(r, res, None, pack_id, len(batch), hit)
 
@@ -1367,6 +1481,8 @@ class PreservationServer:
             # same shape for fixed-n requests
             obs_cells = np.moveaxis(observed, 0, 1).reshape(plan.k, -1)
             monitor = PackMonitor([plan], obs_cells)
+            if self.tel is not None:
+                monitor.enable_cost_tracking()
             nulls, completed, finished = engine.run_null_monitored(
                 plan.n_perm, plan.seed, monitor, telemetry=self.tel,
                 fault_policy=self._fault,
@@ -1380,11 +1496,16 @@ class PreservationServer:
             time.perf_counter() - t0,
             0 if 0 in monitor.expired else min(int(completed), plan.n_perm),
         )
+        mcosts = monitor.request_costs()
+        mcost = (dict(mcosts["members"][0],
+                      pack_totals=dict(mcosts["totals"]))
+                 if mcosts is not None else None)
         if 0 in monitor.expired:
             # the T-axis request missed its deadline mid-run (multi-test
             # requests are their own pack, so there are no survivors)
             self._expire(req, monitor.expired[0],
-                         min(int(monitor.folded), plan.n_perm))
+                         min(int(monitor.folded), plan.n_perm),
+                         cost=mcost)
             return
         total_space = pv.total_permutations(plan.pool.size, plan.sizes)
         per_test = []
@@ -1410,6 +1531,7 @@ class PreservationServer:
                 "n_perm_used": n_used,
             })
         result = {
+            **({"cost": mcost} if mcost is not None else {}),
             "module_labels": list(plan.labels),
             "tests": per_test,
             "n_perm": int(plan.n_perm),
@@ -1425,6 +1547,8 @@ class PreservationServer:
     # -- ops surface -------------------------------------------------------
 
     def stats(self) -> dict:
+        now = time.monotonic()
+        uptime = now - self._started_m
         with self._work:
             return {
                 "tenants": {
@@ -1432,6 +1556,19 @@ class PreservationServer:
                         "weight": t.weight,
                         "queue_depth": len(t.pending),
                         **t.counters,
+                        # observability rollups (ISSUE 13): the tenant
+                        # rows `top` renders — pinned-bucket latency
+                        # quantiles, attributed device time (total and
+                        # per wall-second), and the SLO burn rate
+                        "p50_s": t.lat_hist.quantile(0.50),
+                        "p99_s": t.lat_hist.quantile(0.99),
+                        "latency_hist": t.lat_hist.state(),
+                        "cost": dict(t.cost),
+                        "device_s_per_s": (
+                            t.cost["device_s"] / uptime if uptime > 0
+                            else 0.0
+                        ),
+                        "burn_rate": self._burn_rate_locked(t, now),
                     }
                     for n, t in self._tenants.items()
                 },
@@ -1441,6 +1578,10 @@ class PreservationServer:
                 "journal": self.config.journal,
                 "pool": self.pool.stats(),
                 "packs": self._pack_seq,
+                "uptime_s": uptime,
+                "slo_s": self.config.slo_s,
+                "slo_budget": self.config.slo_budget,
+                "slo_window_s": self.config.slo_window_s,
             }
 
     def metrics_text(self) -> str:
@@ -1476,5 +1617,44 @@ class PreservationServer:
         )
         lines.append("# TYPE netrep_serve_packs_total counter")
         lines.append(f'netrep_serve_packs_total {st["packs"]}')
+        # per-tenant latency + attributed-cost series (ISSUE 13): PINNED
+        # bucket boundaries (tm.LATENCY_BUCKETS_S / tm.COST_BUCKETS_S —
+        # golden-shaped in tests/test_telemetry.py); burn rate = miss
+        # fraction over the sliding window / error budget
+        with self._work:
+            tenants = [(n, self._tenants[n]) for n in sorted(self._tenants)]
+            now = time.monotonic()
+            lines.append("# TYPE netrep_serve_latency_seconds histogram")
+            for name, t in tenants:
+                lines.extend(t.lat_hist.prom_lines(
+                    "netrep_serve_latency_seconds", f'tenant="{name}"'
+                ))
+            lines.append(
+                "# TYPE netrep_serve_request_device_seconds histogram"
+            )
+            for name, t in tenants:
+                lines.extend(t.cost_hist.prom_lines(
+                    "netrep_serve_request_device_seconds",
+                    f'tenant="{name}"'
+                ))
+            for metric, key, kind in (
+                ("netrep_serve_attributed_device_seconds_total",
+                 "device_s", "counter"),
+                ("netrep_serve_attributed_perms_total", "perms",
+                 "counter"),
+                ("netrep_serve_attributed_bytes_to_host_total",
+                 "bytes_to_host", "counter"),
+            ):
+                lines.append(f"# TYPE {metric} {kind}")
+                for name, t in tenants:
+                    lines.append(
+                        f'{metric}{{tenant="{name}"}} {t.cost[key]:g}'
+                    )
+            lines.append("# TYPE netrep_serve_slo_burn_rate gauge")
+            for name, t in tenants:
+                lines.append(
+                    f'netrep_serve_slo_burn_rate{{tenant="{name}"}} '
+                    f"{self._burn_rate_locked(t, now):g}"
+                )
         parts.append("\n".join(lines) + "\n")
         return "".join(parts)

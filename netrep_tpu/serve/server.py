@@ -119,7 +119,8 @@ def dispatch_op(server: PreservationServer, op: dict,
         if kind == "analyze":
             kw = {}
             for k in ("modules", "n_perm", "seed", "alternative",
-                      "adaptive", "deadline_s", "idempotency_key"):
+                      "adaptive", "deadline_s", "idempotency_key",
+                      "trace_ctx"):
                 if k in op and op[k] is not None:
                     kw[k] = op[k]
             result = server.analyze(

@@ -74,6 +74,15 @@ def default_path() -> str:
     )
 
 
+#: version of the OPTIONAL per-tenant attributed-cost block a ledger
+#: entry may carry (ISSUE 13): ``{"cost_v": 1, "cost": {tenant:
+#: {device_s, perms, bytes_to_host}}}`` appended after the pinned base
+#: keys — the fleet-admission signal (ROADMAP item 1) rides the same
+#: ledger the brownout estimator already reads. Entries without costs
+#: keep the exact PR 5 key order (golden-shape test unchanged).
+COST_VERSION = 1
+
+
 def make_entry(
     fingerprint: str,
     perms_per_sec: float,
@@ -86,9 +95,12 @@ def make_entry(
     round_n: int | None = None,
     metric: str | None = None,
     t: float | None = None,
+    cost: dict | None = None,
 ) -> dict:
-    """One ledger line, in pinned key order (golden-shape test)."""
-    return {
+    """One ledger line, in pinned key order (golden-shape test); the
+    optional ``cost`` rollup appends ``cost_v``/``cost`` after the base
+    keys so cost-carrying rows extend the schema without disturbing it."""
+    entry = {
         "perf_v": ENTRY_VERSION,
         "t": float(t) if t is not None else time.time(),
         "source": str(source),
@@ -104,6 +116,10 @@ def make_entry(
         "n_perm": int(n_perm) if n_perm is not None else None,
         "metric": metric,
     }
+    if cost is not None:
+        entry["cost_v"] = COST_VERSION
+        entry["cost"] = cost
+    return entry
 
 
 def append_entry(entry: dict, path: str | None = None) -> bool:
@@ -228,6 +244,7 @@ def entry_from_bench_row(row: dict, source: str = "bench",
         fp, pps, source, backend=_backend_class(str(row.get("device", ""))),
         mode=mode, run_id=row.get("telemetry"),
         metric=str(row.get("metric"))[:160], round_n=round_n, t=t,
+        cost=row.get("cost") if isinstance(row.get("cost"), dict) else None,
     )
 
 

@@ -88,6 +88,7 @@ EVENT_KEYS = ("v", "t", "m", "run", "ev", "data")
 #: the offline aggregator, so the two views cannot drift.
 _SUM_FIELDS = frozenset({
     "dispatches", "host_bytes", "perms", "take", "bytes", "n_retired",
+    "bytes_to_host",
 })
 
 #: recovery-path event names (ISSUE 4 fault tolerance + the backends'
@@ -138,7 +139,104 @@ SERVE_EVENTS = (
     # but never registered; ISSUE 12's telemetry-registry lint rule
     # caught the drift and pinned it here
     "request_requeued",
+    # deterministic per-request cost attribution (ISSUE 13): one event
+    # per served request, emitted by the scheduler after its pack
+    # completes, carrying the request's exact share of the pack's
+    # measured costs (``device_s``/``transfer_s``/``perms``/
+    # ``bytes_to_host``/``compile_s_amortized``) split by live-module ×
+    # permutation weight at every chunk — the conservation contract
+    # (member costs sum bit-exactly to the pack totals) is pinned in
+    # tests/test_serve_cost.py. Carries ``tenant`` + the request's
+    # ``trace`` id, so a trace tells the whole cost story end to end.
+    "request_cost",
 )
+
+#: pinned latency histogram bucket upper bounds (seconds) for the
+#: per-tenant serving series (``netrep_serve_latency_seconds`` in
+#: ``metrics_text()``; a final +Inf bucket is implicit). Changing these
+#: re-bins every dashboard keyed on the exposition — the boundaries are
+#: schema surface, pinned by tests/test_telemetry.py.
+LATENCY_BUCKETS_S = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0, 120.0,
+)
+
+#: pinned attributed-cost histogram bucket upper bounds (device-seconds
+#: per request) for ``netrep_serve_request_device_seconds`` — same
+#: pinning contract as :data:`LATENCY_BUCKETS_S`
+COST_BUCKETS_S = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0,
+)
+
+
+class BucketHistogram:
+    """Fixed-boundary cumulative-style histogram (the Prometheus shape):
+    per-bucket counts over pinned upper bounds plus a +Inf overflow
+    bucket, with count/sum and a quantile estimator — the p50/p99 the
+    serve plane's ops surface reports without storing every sample.
+
+    Quantiles interpolate linearly inside the winning bucket (0 as the
+    lower edge of the first), the standard Prometheus
+    ``histogram_quantile`` convention — an estimate bounded by the pinned
+    boundaries, not an exact order statistic."""
+
+    def __init__(self, buckets: tuple[float, ...]):
+        self.buckets = tuple(float(b) for b in buckets)
+        if list(self.buckets) != sorted(self.buckets):
+            raise ValueError("bucket boundaries must be ascending")
+        self.counts = [0] * (len(self.buckets) + 1)   # +Inf last
+        self.n = 0
+        self.total = 0.0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.n += 1
+        self.total += v
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def quantile(self, q: float) -> float | None:
+        """Estimated q-quantile (0..1), or None for an empty histogram.
+        The +Inf bucket degrades to the last finite boundary — a bounded
+        answer beats an unbounded guess on an ops dashboard."""
+        if self.n == 0:
+            return None
+        rank = q * self.n
+        cum = 0
+        for i, c in enumerate(self.counts):
+            prev = cum
+            cum += c
+            if cum >= rank and c:
+                if i >= len(self.buckets):
+                    return self.buckets[-1] if self.buckets else 0.0
+                lo = self.buckets[i - 1] if i else 0.0
+                hi = self.buckets[i]
+                return lo + (hi - lo) * ((rank - prev) / c)
+        return self.buckets[-1] if self.buckets else 0.0
+
+    def prom_lines(self, name: str, labels: str = "") -> list[str]:
+        """Prometheus histogram exposition lines (cumulative ``le``
+        buckets + ``_count``/``_sum``); ``labels`` is the pre-rendered
+        inner label list (e.g. ``tenant="a"``)."""
+        sep = "," if labels else ""
+        out = []
+        cum = 0
+        for b, c in zip(self.buckets, self.counts):
+            cum += c
+            out.append(
+                f'{name}_bucket{{{labels}{sep}le="{b:g}"}} {cum}'
+            )
+        out.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} {self.n}')
+        out.append(f"{name}_count{{{labels}}} {self.n}")
+        out.append(f"{name}_sum{{{labels}}} {self.total:g}")
+        return out
+
+    def state(self) -> dict:
+        return {"buckets": list(self.buckets), "counts": list(self.counts),
+                "n": self.n, "sum": self.total}
 
 #: engine/infrastructure event names outside the recovery and serving
 #: sets: the null-loop progress events, compile/autotune accounting,
@@ -877,8 +975,17 @@ def tenant_summary(events: Iterable[dict]) -> dict[str, dict]:
             "received": 0, "packed": 0, "done": 0, "failed": 0,
             "rejected": 0, "expired": 0, "deduped": 0, "perms": 0,
             "latency": [0, 0.0, float("inf"), 0.0],  # n, total, min, max
+            "device_s": 0.0, "cost_bytes": 0,
         })
-        if ev == "request_received":
+        if ev == "request_cost":
+            # attributed cost rollup (ISSUE 13): the offline twin of the
+            # server's per-tenant cost counters, folded from the same
+            # request_cost events
+            if _is_number(data.get("device_s")):
+                row["device_s"] += float(data["device_s"])
+            if _is_number(data.get("bytes_to_host")):
+                row["cost_bytes"] += int(data["bytes_to_host"])
+        elif ev == "request_received":
             row["received"] += 1
         elif ev == "request_packed":
             row["packed"] += 1
@@ -915,7 +1022,8 @@ def render_tenants(path: str) -> str:
     w = max(len(t) for t in rows)
     out.append(
         f"  {'':<{w}}  {'recv':>5} {'done':>5} {'fail':>5} {'rej':>5} "
-        f"{'exp':>5} {'dedup':>5} {'perms':>8} {'mean_s':>8} {'max_s':>8}"
+        f"{'exp':>5} {'dedup':>5} {'perms':>8} {'mean_s':>8} {'max_s':>8} "
+        f"{'dev_s':>8}"
     )
     for t in sorted(rows):
         r = rows[t]
@@ -926,9 +1034,27 @@ def render_tenants(path: str) -> str:
             f"  {t:<{w}}  {r['received']:>5} {r['done']:>5} "
             f"{r['failed']:>5} {r['rejected']:>5} {r['expired']:>5} "
             f"{r['deduped']:>5} {r['perms']:>8} "
-            f"{mean:>8.3f} {hi:>8.3f}"
+            f"{mean:>8.3f} {hi:>8.3f} {r['device_s']:>8.3f}"
         )
     return "\n".join(out)
+
+
+def format_event(e: dict, t0: float | None = None) -> str:
+    """One-line human rendering of an event — the shared renderer of
+    ``telemetry --follow`` and the ``top`` dashboard's event tail
+    (:mod:`netrep_tpu.serve.top`): relative offset, span markers
+    (``>`` opens a span, ``<`` closes one with its duration), event name,
+    then the data fields."""
+    d = e.get("data") or {}
+    off = f"+{e['t'] - t0:9.2f}s" if t0 is not None else f"{e['t']:.2f}"
+    mark = " "
+    if d.get("span") is not None:
+        mark = "<" if _is_number(d.get("s")) else ">"
+    parts = " ".join(
+        f"{k}={v:g}" if _is_number(v) else f"{k}={v}"
+        for k, v in d.items() if k not in ("span", "parent")
+    )
+    return f"{off} {mark} {e['ev']:<24} {parts}"
 
 
 def render_recovery(path: str) -> str:

@@ -144,11 +144,59 @@ def build_span_tree(events: Iterable[dict]) -> tuple[dict, list]:
 
     for sid in spans:
         depth(sid)
+
+    # distributed-trace propagation (ISSUE 13): a span carrying a
+    # ``trace`` arg (the client-minted W3C-style trace id the scheduler
+    # stamps on request spans) gives it to every descendant that lacks
+    # one — so the whole per-request subtree (request_packed,
+    # request_cost, request_done) is findable by the caller's trace id,
+    # and the merged multi-file export below can group one request's
+    # spans across process generations under one pid.
+    def inherit_trace(sid, seen=()):
+        node = spans[sid]
+        tr = node["args"].get("trace")
+        if tr is not None:
+            return tr
+        p = node["parent"]
+        if p in spans and p not in seen:
+            tr = inherit_trace(p, seen + (sid,))
+            if tr is not None:
+                node["args"]["trace"] = tr
+        return tr
+
+    for sid in spans:
+        inherit_trace(sid)
     return spans, instants
 
 
 def build_span_tree_file(path: str) -> tuple[dict, list]:
     return build_span_tree(read_events(path))
+
+
+def merge_events(paths) -> list[dict]:
+    """Concatenate the event streams of several telemetry JSONL files
+    into one (file order, then line order), namespacing every span id and
+    parent reference by its run id (``<run>:<sid>``) so the per-bus
+    ``s<n>`` counters of independent processes — a client-side log plus N
+    server generations of a ``--recover`` lineage — can never collide.
+    Cross-process causality is carried by the ``trace`` ids the serve
+    layer stamps on request spans (:func:`build_span_tree` propagates
+    them down each subtree), so a SIGKILL + ``--recover`` run renders as
+    ONE continuous trace keyed by the client-minted id."""
+    out = []
+    for path in paths:
+        for e in read_events(path):
+            run = e.get("run")
+            d = e.get("data") or {}
+            if run and (d.get("span") or d.get("parent")):
+                d = dict(d)
+                for k in ("span", "parent"):
+                    v = d.get(k)
+                    if isinstance(v, str) and v and ":" not in v:
+                        d[k] = f"{run}:{v}"
+                e = {**e, "data": d}
+            out.append(e)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -171,6 +219,24 @@ def render_perfetto(events: Iterable[dict]) -> dict:
         if r is not None and r not in runs:
             runs.append(r)
     pid_of = {r: i + 1 for i, r in enumerate(runs)}
+    # distributed traces (ISSUE 13): spans carrying a (propagated)
+    # ``trace`` id group under one pid PER TRACE ID, appended after the
+    # run pids — so a request's subtree renders as one continuous track
+    # even when its spans came from several processes / server
+    # generations. Logs without trace ids render exactly as before.
+    traces: list[str] = []
+    for n in spans.values():
+        tr = n["args"].get("trace")
+        if isinstance(tr, str) and tr and tr not in traces:
+            traces.append(tr)
+    trace_pid = {tr: len(runs) + i + 1 for i, tr in enumerate(traces)}
+
+    def span_pid(n: dict) -> int:
+        tr = n["args"].get("trace")
+        if isinstance(tr, str) and tr in trace_pid:
+            return trace_pid[tr]
+        return pid_of.get(n["run"], 1)
+
     ts = [n["t_start"] for n in spans.values()]
     ts += [i["t"] for i in instants if i["t"] is not None]
     ts += [float(e["t"]) for e in events if e.get("t") is not None]
@@ -185,8 +251,13 @@ def render_perfetto(events: Iterable[dict]) -> dict:
             "name": "process_name", "ph": "M", "pid": pid_of[r],
             "args": {"name": f"run {r}"},
         })
+    for tr in traces:
+        out.append({
+            "name": "process_name", "ph": "M", "pid": trace_pid[tr],
+            "args": {"name": f"trace {tr[:16]}"},
+        })
     depths = sorted({
-        (pid_of.get(n["run"], 1), n["depth"]) for n in spans.values()
+        (span_pid(n), n["depth"]) for n in spans.values()
     })
     for pid, d in depths:
         out.append({
@@ -201,7 +272,7 @@ def render_perfetto(events: Iterable[dict]) -> dict:
         rows.append({
             "name": n["name"], "ph": "X", "ts": us(t0),
             "dur": int(round(n["dur_s"] * 1e6)),
-            "pid": pid_of.get(n["run"], 1), "tid": n["depth"],
+            "pid": span_pid(n), "tid": n["depth"],
             "args": {**n["args"], "span": sid},
         })
     for i in instants:
@@ -218,9 +289,18 @@ def render_perfetto(events: Iterable[dict]) -> dict:
     return {"traceEvents": out + rows, "displayTimeUnit": "ms"}
 
 
-def write_perfetto(path: str, out_path: str) -> int:
-    """File → file export; returns the number of trace events written."""
-    trace = render_perfetto(read_events(path))
+def write_perfetto(path, out_path: str) -> int:
+    """File(s) → file export; returns the number of trace events written.
+    ``path`` may be a single JSONL path or a list of them — several files
+    merge via :func:`merge_events` (run-namespaced span ids, one pid per
+    trace id), so a client log + the pre- and post-crash server logs of a
+    ``--recover`` lineage export as one continuous trace."""
+    if isinstance(path, (list, tuple)):
+        events = (merge_events(path) if len(path) > 1
+                  else list(read_events(path[0])))
+    else:
+        events = list(read_events(path))
+    trace = render_perfetto(events)
     with open(out_path, "w", encoding="utf-8") as f:
         json.dump(trace, f)
         f.write("\n")

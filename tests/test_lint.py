@@ -118,6 +118,35 @@ def f(tel, sid):
     tel.end_span(sid, "null_run_end", s=1.0)
 """
 
+SPAN_BAD = """\
+def run(tel):
+    sid = tel.begin_span("null_run_start", n_perm=64)
+    work()
+"""
+
+SPAN_BAD_CLASS = """\
+class Server:
+    def boot(self, tel):
+        self._sid = tel.begin_span("serve_start")
+"""
+
+SPAN_OK = """\
+def run(tel):
+    sid = tel.begin_span("null_run_start", n_perm=64)
+    work()
+    tel.end_span(sid, "null_run_end", s=1.0)
+"""
+
+SPAN_OK_CLASS_HANDOFF = """\
+class Server:
+    def boot(self, tel):
+        self.tel = tel
+        self._sid = tel.begin_span("serve_start")
+
+    def close(self):
+        self.tel.end_span(self._sid, "serve_end", s=1.0)
+"""
+
 CKPT_BAD_PREFIX = """\
 from netrep_tpu.utils.checkpoint import save_null_checkpoint
 
@@ -227,6 +256,8 @@ class Worker:
     ("exception-taxonomy", EXC_BAD, 1),
     ("telemetry-registry", TEL_BAD, 1),
     ("telemetry-registry", TEL_END_SPAN_BAD, 1),
+    ("span-pairing", SPAN_BAD, 1),
+    ("span-pairing", SPAN_BAD_CLASS, 1),
     ("checkpoint-extras-namespace", CKPT_BAD_PREFIX, 1),
     ("checkpoint-extras-namespace", CKPT_BAD_RESERVED, 1),
     ("checkpoint-extras-namespace", AUTOKEY_BAD, 1),
@@ -243,7 +274,8 @@ def test_rule_fires_on_violating_fixture(rule, source, min_hits):
 
 @pytest.mark.parametrize("source", [
     RNG_OK, DONATE_OK_GATED, EXC_OK_RERAISE, EXC_OK_CLASSIFY, TEL_OK,
-    CKPT_OK, AUTOKEY_OK_DELEGATES, THREAD_OK_GUARDED,
+    SPAN_OK, SPAN_OK_CLASS_HANDOFF, CKPT_OK, AUTOKEY_OK_DELEGATES,
+    THREAD_OK_GUARDED,
 ])
 def test_compliant_fixture_is_clean(source):
     report = lint_source(source)
@@ -278,6 +310,7 @@ def _suppress(source: str, rule: str, reason="fixture-sanctioned site"):
     ("donation-alias", DONATE_BAD),
     ("exception-taxonomy", EXC_BAD),
     ("telemetry-registry", TEL_BAD),
+    ("span-pairing", SPAN_BAD),
     ("checkpoint-extras-namespace", CKPT_BAD_PREFIX),
     ("thread-shared-state", THREAD_BAD),
 ])

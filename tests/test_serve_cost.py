@@ -1,0 +1,389 @@
+"""End-to-end request tracing + deterministic cost attribution + the
+`top` ops surface (ISSUE 13) — CPU-only, in-process, tiny fixtures.
+
+The conservation contract: every pack member's attributed
+``device_s``/``transfer_s``/``perms``/``bytes_to_host``/
+``compile_s_amortized`` sum BIT-EXACTLY (f64 host arithmetic, ``==`` not
+approx) to the pack totals, in fixed-n, mixed-budget, adaptive, and
+deadline-expiry compositions (the SIGKILL→``--recover`` composition is
+pinned in tests/test_serve_recovery.py beside the parity drill). Trace
+contexts: client-minted ids ride every request's span subtree and the
+journal. Telemetry-off: no cost tracking, no new result keys — the PR 12
+behavior bit-identical."""
+
+import json
+
+import numpy as np
+import pytest
+
+from netrep_tpu.data import make_mixed_pair
+from netrep_tpu.serve import (
+    InProcessClient, PreservationServer, ServeConfig, ServeError,
+)
+from netrep_tpu.serve.packer import PackMonitor
+from netrep_tpu.serve.protocol import mint_trace_ctx, normalize_trace_ctx
+from netrep_tpu.serve.top import render, render_tenant_table, snapshot
+from netrep_tpu.utils.config import EngineConfig
+
+CFG = EngineConfig(chunk_size=16, autotune=False)
+
+COST_FIELDS = ("device_s", "transfer_s", "perms", "bytes_to_host",
+               "compile_s_amortized")
+
+
+@pytest.fixture(scope="module")
+def fx():
+    mixed = make_mixed_pair(100, 3, n_samples=16, seed=7)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    assign = {f"node_{i}": "0" for i in range(dn.shape[0])}
+    for lab, idx in mixed["specs"]:
+        for i in idx:
+            assign[f"node_{i}"] = str(lab)
+    return dict(dn=dn, dc=dc, dd=dd, tn=tn, tc=tc, td=td, assign=assign)
+
+
+def make_server(fx, tmp_path, *, tenants=("a",), start=True, tel="tel",
+                **cfg_kw):
+    cfg_kw.setdefault("engine", CFG)
+    cfg_kw.setdefault("telemetry", str(tmp_path / f"{tel}.jsonl"))
+    srv = PreservationServer(ServeConfig(**cfg_kw), start=start)
+    client = InProcessClient(srv)
+    for t in tenants:
+        client.register_dataset(t, "d", network=fx["dn"],
+                                correlation=fx["dc"], data=fx["dd"],
+                                assignments=fx["assign"])
+        client.register_dataset(t, "t", network=fx["tn"],
+                                correlation=fx["tc"], data=fx["td"])
+    return srv, client
+
+
+def read_events(path):
+    return [json.loads(l) for l in open(path, encoding="utf-8")]
+
+
+def assert_conserved(costs: list[dict]):
+    """The pinned contract: member costs sum bit-exactly (f64, ``==``)
+    to the pack totals on every field."""
+    assert costs, "no member costs to check"
+    totals = costs[0]["pack_totals"]
+    for c in costs[1:]:
+        assert c["pack_totals"] == totals, "members disagree on totals"
+    for f in COST_FIELDS:
+        s = costs[0][f]
+        for c in costs[1:]:
+            s = s + c[f]
+        assert s == totals[f], (f, s, totals[f])
+
+
+# ---------------------------------------------------------------------------
+# conservation: fixed-n, mixed budgets, adaptive, deadline expiry
+# ---------------------------------------------------------------------------
+
+def test_fixed_n_pack_costs_conserve_bit_exactly(fx, tmp_path):
+    srv, client = make_server(fx, tmp_path, tenants=("a", "b"),
+                              start=False)
+    h1 = client.submit("a", "d", "t", n_perm=64, seed=3)
+    h2 = client.submit("b", "d", "t", n_perm=32, seed=11)
+    srv.start()
+    try:
+        r1 = client.result(h1, timeout=600)
+        r2 = client.result(h2, timeout=600)
+    finally:
+        srv.close()
+    assert r1["pack_size"] == 2 and r2["pack_size"] == 2
+    assert_conserved([r1["cost"], r2["cost"]])
+    # perms = the dispatched permutations each member consumed: the
+    # 32-perm member leaves the shared dispatch at its ceiling
+    assert r1["cost"]["perms"] == 64 and r2["cost"]["perms"] == 32
+    # bytes are exactly proportional to live modules x perms (equal
+    # module counts here): the deeper member moved more
+    assert r1["cost"]["bytes_to_host"] == 2 * r2["cost"]["bytes_to_host"]
+    assert r1["cost"]["device_s"] > 0.0
+    # the identity-totals stay within float-noise of the raw measurement
+    tot = r1["cost"]["pack_totals"]
+    assert tot["device_s"] > 0.0
+
+
+def test_adaptive_member_costs_conserve(fx, tmp_path):
+    srv, client = make_server(fx, tmp_path, start=False)
+    h1 = client.submit("a", "d", "t", n_perm=64, seed=3)
+    h2 = client.submit("a", "d", "t", n_perm=64, seed=5, adaptive=True)
+    srv.start()
+    try:
+        r1 = client.result(h1, timeout=600)
+        r2 = client.result(h2, timeout=600)
+    finally:
+        srv.close()
+    assert r1["pack_size"] == 2
+    assert_conserved([r1["cost"], r2["cost"]])
+
+
+def test_expired_member_cost_is_attributed_and_conserves(fx, tmp_path):
+    """A deadline-cancelled member consumed dispatches before its exit:
+    its share is emitted via ``request_cost`` (the waiter only sees the
+    error) and the pack still conserves — expired + survivor == totals."""
+    srv, client = make_server(fx, tmp_path, start=False)
+    h_ok = client.submit("a", "d", "t", n_perm=48, seed=3, deadline_s=600)
+    h_exp = client.submit("a", "d", "t", n_perm=1_000_000, seed=5,
+                          deadline_s=0.2)
+    srv.start()
+    try:
+        res = client.result(h_ok, timeout=600)
+        with pytest.raises(ServeError, match="deadline exceeded"):
+            client.result(h_exp, timeout=600)
+        tel = srv.config.telemetry
+    finally:
+        srv.close()
+    ev = read_events(tel)
+    costs = [e["data"] for e in ev if e["ev"] == "request_cost"]
+    assert len(costs) == 2
+    # JSON round-trips f64 exactly (shortest-repr), so the event-side
+    # sums hit the same bits as the in-process ones
+    totals = res["cost"]["pack_totals"]
+    for f in COST_FIELDS:
+        s = costs[0][f]
+        for c in costs[1:]:
+            s = s + c[f]
+        assert s == totals[f], (f, s, totals[f])
+    # the expired member's device time is non-zero: it ran before expiry
+    exp_cost = next(c for c in costs
+                    if c["perms"] != res["cost"]["perms"])
+    assert exp_cost["device_s"] > 0.0
+    # tenant rollup counted BOTH (expired work is not vanished work)
+    st = srv.stats()
+    assert st["tenants"]["a"]["cost"]["device_s"] == totals["device_s"]
+
+
+def test_pack_monitor_split_is_exact_on_synthetic_weights():
+    """Unit-level conservation: hand-fed chunks with awkward weights and
+    costs still sum bit-exactly, and integer fields split by largest
+    remainder (no byte ever lost or minted)."""
+    from netrep_tpu.serve.packer import RequestPlan
+
+    plans = []
+    base = 0
+    for k in (3, 2, 1):
+        p = RequestPlan(labels=list(range(k)), specs=[None] * k,
+                        counts={}, pool=np.arange(8), n_perm=100, seed=0)
+        p.base = base
+        base += k
+        plans.append(p)
+    mon = PackMonitor.__new__(PackMonitor)
+    mon.plans = plans
+    mon._cost_enabled = True
+    mon._cost_chunks = [
+        {"take": 7, "live": {0: 3, 1: 2, 2: 1}, "bytes": 1000,
+         "dispatch_s": 0.7, "transfer_s": 0.013},
+        {"take": 7, "live": {0: 3, 2: 1}, "bytes": 997,
+         "dispatch_s": 0.1, "transfer_s": 0.007},
+        {"take": 3, "live": {2: 1}, "bytes": 331,
+         "dispatch_s": 0.05, "transfer_s": 0.001},
+    ]
+    out = mon.request_costs()
+    members, totals = out["members"], out["totals"]
+    for f in COST_FIELDS:
+        s = members[0][f]
+        for m in members[1:]:
+            s = s + m[f]
+        assert s == totals[f], (f, s, totals[f])
+    assert totals["bytes_to_host"] == 1000 + 997 + 331
+    assert totals["perms"] == (7 + 7) + 7 + (7 + 7 + 3)
+    assert members[1]["perms"] == 7          # plan 1 retired after chunk 1
+    # compile estimate: first dispatch minus steady median, attributed
+    assert out["measured_device_s"] == pytest.approx(0.85)
+
+
+def test_cost_off_without_telemetry_and_result_shape(fx, tmp_path):
+    """Telemetry-off is the PR 12 path bit-identically: no cost tracking
+    armed, no ``cost`` key in results, no telemetry file written."""
+    srv, client = make_server(fx, tmp_path, telemetry=None)
+    try:
+        res = client.analyze("a", "d", "t", n_perm=32, seed=3, timeout=600)
+    finally:
+        srv.close()
+    assert "cost" not in res
+    assert res["completed"] == 32
+    assert not list(tmp_path.glob("*.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# trace context: minting, span stamping, journal continuity
+# ---------------------------------------------------------------------------
+
+def test_trace_ctx_normalization():
+    ctx = mint_trace_ctx()
+    assert normalize_trace_ctx(ctx) == ctx
+    assert normalize_trace_ctx({"trace": "xyz!"}) is None
+    assert normalize_trace_ctx("nope") is None
+    assert normalize_trace_ctx({"trace": "a" * 32, "parent": 7}) == {
+        "trace": "a" * 32, "parent": None,
+    }
+
+
+def test_client_minted_trace_rides_request_subtree(fx, tmp_path):
+    """The caller's trace id lands on the request span, propagates to the
+    whole request subtree (request_packed / request_cost / request_done),
+    and comes back in the result."""
+    from netrep_tpu.utils.trace import build_span_tree
+
+    ctx = mint_trace_ctx(parent_span="client-span-1")
+    srv, client = make_server(fx, tmp_path)
+    try:
+        res = client.analyze("a", "d", "t", n_perm=32, seed=3,
+                             trace_ctx=ctx, timeout=600)
+        tel = srv.config.telemetry
+    finally:
+        srv.close()
+    assert res["trace"] == ctx["trace"]
+    ev = read_events(tel)
+    recv = [e for e in ev if e["ev"] == "request_received"]
+    assert recv[0]["data"]["trace"] == ctx["trace"]
+    assert recv[0]["data"]["trace_parent"] == "client-span-1"
+    spans, instants = build_span_tree(ev)
+    req_sid = recv[0]["data"]["span"]
+    assert spans[req_sid]["args"]["trace"] == ctx["trace"]
+    # every node of the request's subtree inherited the trace id
+    subtree = [s for s in spans.values() if s["parent"] == req_sid]
+    for node in subtree:
+        assert node["args"]["trace"] == ctx["trace"]
+    sub_instants = [i for i in instants if i["parent"] == req_sid]
+    assert any(i["name"] == "request_packed" for i in sub_instants)
+    # request_cost is a point event under the request span carrying it
+    costs = [e for e in ev if e["ev"] == "request_cost"]
+    assert costs[0]["data"]["trace"] == ctx["trace"]
+    assert costs[0]["data"]["parent"] == req_sid
+
+
+def test_trace_ctx_journaled_with_accepted_record(fx, tmp_path):
+    from netrep_tpu.serve import journal as jnl
+
+    jpath = str(tmp_path / "j.jsonl")
+    ctx = mint_trace_ctx()
+    srv, client = make_server(fx, tmp_path, start=False, journal=jpath)
+    client.submit("a", "d", "t", n_perm=32, seed=1, idempotency_key="k1",
+                  trace_ctx=ctx)
+    srv.close(drain=False)
+    rec = jnl.scan(jpath)["pending"][0]
+    assert rec["trace"] == ctx
+
+
+def test_malformed_trace_ctx_never_fails_the_request(fx, tmp_path):
+    srv, client = make_server(fx, tmp_path)
+    try:
+        res = client.analyze("a", "d", "t", n_perm=32, seed=3,
+                             trace_ctx={"bogus": True}, timeout=600)
+    finally:
+        srv.close()
+    # the server minted its own id instead of erroring
+    assert isinstance(res["trace"], str) and len(res["trace"]) == 32
+
+
+# ---------------------------------------------------------------------------
+# the `top` ops surface (in-process tier-1, acceptance-pinned)
+# ---------------------------------------------------------------------------
+
+def test_top_snapshot_tenant_rows_from_live_server(fx, tmp_path):
+    """`top --once --json` == ``snapshot(stats)`` + json.dumps: tenant
+    rows carry queue depth, pinned-bucket p50/p99, attributed device
+    time, brownout, and burn rate — from a live in-process daemon."""
+    srv, client = make_server(fx, tmp_path, tenants=("a", "b"))
+    try:
+        client.analyze("a", "d", "t", n_perm=32, seed=3, timeout=600)
+        client.analyze("b", "d", "t", n_perm=32, seed=5, timeout=600)
+        snap = snapshot(srv.stats())
+    finally:
+        srv.close()
+    json.dumps(snap)                       # the --json wire shape
+    assert snap["brownout"] is False and snap["packs"] >= 1
+    assert snap["uptime_s"] > 0
+    rows = {r["tenant"]: r for r in snap["tenants"]}
+    assert set(rows) == {"a", "b"}
+    for r in rows.values():
+        assert r["queue_depth"] == 0 and r["done"] == 1
+        assert r["p50_ms"] is not None and r["p99_ms"] >= r["p50_ms"]
+        assert r["device_s"] > 0.0 and r["device_s_per_s"] > 0.0
+        assert r["burn_rate"] == 0.0
+    text = render(snap)
+    assert "netrep serve" in text and "a" in text and "burn" in text
+    # the shared renderer tolerates missing quantiles (fresh tenants)
+    table = render_tenant_table([{"tenant": "x"}])
+    assert "x" in table and "-" in table
+
+
+def test_slo_burn_rate_counts_misses(fx, tmp_path):
+    """A deadline miss (and any terminal failure) burns the SLO budget:
+    with budget 0.5 and one miss out of two requests, burn = 1.0."""
+    srv, client = make_server(fx, tmp_path, start=False, slo_budget=0.5)
+    h_ok = client.submit("a", "d", "t", n_perm=32, seed=3, deadline_s=600)
+    h_exp = client.submit("a", "d", "t", n_perm=1_000_000, seed=5,
+                          deadline_s=0.2)
+    srv.start()
+    try:
+        client.result(h_ok, timeout=600)
+        with pytest.raises(ServeError):
+            client.result(h_exp, timeout=600)
+        st = srv.stats()
+    finally:
+        srv.close()
+    assert st["tenants"]["a"]["burn_rate"] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# exposition golden shape (pinned buckets, per-tenant labels)
+# ---------------------------------------------------------------------------
+
+def test_metrics_text_new_series_golden_shape(fx, tmp_path):
+    from netrep_tpu.utils.telemetry import COST_BUCKETS_S, LATENCY_BUCKETS_S
+
+    srv, client = make_server(fx, tmp_path)
+    try:
+        client.analyze("a", "d", "t", n_perm=32, seed=3, timeout=600)
+        text = srv.metrics_text()
+    finally:
+        srv.close()
+    lines = text.splitlines()
+    assert "# TYPE netrep_serve_latency_seconds histogram" in lines
+    assert "# TYPE netrep_serve_request_device_seconds histogram" in lines
+    # every pinned boundary appears as a cumulative le label, in order,
+    # plus +Inf — the exact exposition downstream quantiles key on
+    lat = [l for l in lines
+           if l.startswith('netrep_serve_latency_seconds_bucket')]
+    want = [f'le="{b:g}"' for b in LATENCY_BUCKETS_S] + ['le="+Inf"']
+    assert len(lat) == len(want)
+    for line, le in zip(lat, want):
+        assert le in line and 'tenant="a"' in line
+    cost = [l for l in lines
+            if l.startswith('netrep_serve_request_device_seconds_bucket')]
+    assert len(cost) == len(COST_BUCKETS_S) + 1
+    assert ('netrep_serve_latency_seconds_count{tenant="a"} 1' in lines)
+    assert ('netrep_serve_request_device_seconds_count{tenant="a"} 1'
+            in lines)
+    assert any(l.startswith(
+        'netrep_serve_attributed_device_seconds_total{tenant="a"}')
+        for l in lines)
+    assert any(l.startswith(
+        'netrep_serve_attributed_perms_total{tenant="a"} 32')
+        for l in lines)
+    assert any(l.startswith('netrep_serve_slo_burn_rate{tenant="a"} 0')
+               for l in lines)
+
+
+# ---------------------------------------------------------------------------
+# telemetry --follow (the shared renderer)
+# ---------------------------------------------------------------------------
+
+def test_telemetry_follow_renders_events_and_tenant_table(fx, tmp_path,
+                                                         capsys):
+    from netrep_tpu.__main__ import _telemetry_follow
+
+    srv, client = make_server(fx, tmp_path)
+    try:
+        client.analyze("a", "d", "t", n_perm=32, seed=3, timeout=600)
+        tel = srv.config.telemetry
+    finally:
+        srv.close()
+    assert _telemetry_follow(tel, poll_s=0.0, max_polls=1) == 0
+    out = capsys.readouterr().out
+    assert "request_received" in out and "request_cost" in out
+    # the exit summary reuses top's tenant-table renderer
+    assert "tenant" in out and "dev_s" in out

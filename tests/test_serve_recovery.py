@@ -254,6 +254,73 @@ def test_recovery_serves_completed_requests_from_journal(fx, tmp_path):
             if e["ev"] == "journal_replayed"] == [1]
 
 
+def test_kill_recover_trace_continuity_and_cost_conservation(fx, tmp_path):
+    """ISSUE 13 acceptance across the crash: the client-minted trace id
+    is present on the request's spans in BOTH server generations (the
+    journal carries the trace context through ``--recover``),
+    ``utils/trace.py`` merges the pre- and post-crash JSONL into ONE
+    Perfetto trace under that id, and the recovered pack's attributed
+    costs still sum bit-exactly to its totals."""
+    from netrep_tpu.utils.trace import merge_events, render_perfetto
+
+    jpath = str(tmp_path / "j.jsonl")
+    ctx = {"trace": "ab" * 16, "parent": "client-span-9"}
+    submits = [
+        ("k1", dict(n_perm=64, seed=3, trace_ctx=ctx)),
+        ("k2", dict(n_perm=64, seed=5)),
+    ]
+    srv1, handles = _crash_server(fx, tmp_path, jpath, "crash@24", submits,
+                                  tel="tel_gen1")
+    srv2 = PreservationServer(ServeConfig(
+        engine=CFG, journal=jpath, recover=True, checkpoint_every=16,
+        telemetry=str(tmp_path / "tel_gen2.jsonl"),
+    ))
+    client2 = InProcessClient(srv2)
+    try:
+        results = {
+            k: client2.analyze(
+                "a", "d", "t", idempotency_key=k, timeout=600,
+                **{kk: v for kk, v in kw.items() if kk != "trace_ctx"},
+            )
+            for k, kw in submits
+        }
+    finally:
+        srv2.close()
+    # the recovered request still answers under the CLIENT's trace id
+    assert results["k1"]["trace"] == ctx["trace"]
+    # cost conservation on the checkpoint-resumed pack
+    costs = [results[k]["cost"] for k, _ in submits]
+    totals = costs[0]["pack_totals"]
+    for f in ("device_s", "transfer_s", "perms", "bytes_to_host",
+              "compile_s_amortized"):
+        s = costs[0][f]
+        for c in costs[1:]:
+            s = s + c[f]
+        assert s == totals[f], (f, s, totals[f])
+    # the trace id is on the request spans of BOTH generations
+    p1 = str(tmp_path / "tel_gen1.jsonl")
+    p2 = str(tmp_path / "tel_gen2.jsonl")
+    for p in (p1, p2):
+        recv = [e for e in read_events(p) if e["ev"] == "request_received"]
+        assert ctx["trace"] in {e["data"].get("trace") for e in recv}, p
+    # merged export: every span carrying the trace id — from two
+    # different runs/processes — lands under ONE pid (one continuous
+    # trace), and run-namespaced span ids cannot collide
+    trace_doc = render_perfetto(merge_events([p1, p2]))
+    rows = [r for r in trace_doc["traceEvents"]
+            if r.get("ph") == "X"
+            and r.get("args", {}).get("trace") == ctx["trace"]]
+    assert rows, "no spans carry the client trace id in the merged export"
+    assert len({r["pid"] for r in rows}) == 1
+    runs_of = {str(r["args"]["span"]).split(":", 1)[0] for r in rows}
+    assert len(runs_of) == 2, "expected spans from both generations"
+    # and the pid is named after the trace
+    metas = [r for r in trace_doc["traceEvents"]
+             if r.get("name") == "process_name"
+             and r["pid"] == rows[0]["pid"]]
+    assert metas and metas[0]["args"]["name"].startswith("trace ")
+
+
 def test_journal_off_is_plain_pr7_serving(fx, tmp_path):
     """--no-journal / journal=None boots carry zero new machinery:
     no journal file, no checkpoint dir, results identical to direct."""

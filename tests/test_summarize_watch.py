@@ -53,9 +53,36 @@ def test_classify_provenance_rules():
         # other: device-attributed non-standard shape (bf16_drift table)
         ({"metric": "bf16 drift", "per_stat": {"coherence": 0.47},
           "device": tpu}, "other"),
+        # serve observability rows (ISSUE 13): CPU by design, classified
+        # BEFORE the CPU drop — cost table + top snapshot, never results
+        ({"metric": "serve-cost per-tenant attributed [closed] (3 "
+                    "tenants, chunk 32)", "value": 1.2, "unit": "device_s",
+          "cost": {"alpha": {"device_s": 0.28, "perms": 256}},
+          "device": "TFRT_CPU_0"}, "serve-cost"),
+        ({"metric": "serve top snapshot", "value": 1, "unit": "snapshot",
+          "top": {"tenants": [{"tenant": "drill", "burn_rate": 0.0}],
+                  "brownout": False}}, "serve-top"),
     ]
     for row, want in cases:
         assert classify(row) == want, (row, classify(row), want)
+
+
+def test_serve_cost_section_renders(tmp_path, capsys=None):
+    rows = [
+        {"metric": "serve-cost per-tenant attributed [closed] (3 tenants, "
+                   "chunk 32)", "value": 1.2, "unit": "device_s",
+         "cost": {"alpha": {"device_s": 0.28, "perms": 256,
+                            "bytes_to_host": 43008, "requests": 3}},
+         "device": "TFRT_CPU_0"},
+        {"metric": "serve top snapshot", "value": 1, "unit": "snapshot",
+         "top": {"tenants": [{"tenant": "drill", "burn_rate": 0.5}],
+                 "brownout": False}},
+    ]
+    lines = summarize_watch.serve_cost_lines([rows[0]], [rows[1]])
+    text = "\n".join(lines)
+    assert "serve-cost per-tenant attributed" in text
+    assert "alpha: device_s=0.28 perms=256" in text
+    assert "brownout=False" in text and "drill=0.5" in text
 
 
 def test_cli_sections_account_for_every_parseable_row(tmp_path):

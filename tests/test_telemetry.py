@@ -286,7 +286,52 @@ def test_serve_event_names_pinned():
         # deadline-driven retirement re-bucketing (ISSUE 10), registered
         # by ISSUE 12's telemetry-registry lint rule
         "request_requeued",
+        # per-request deterministic cost attribution (ISSUE 13): carries
+        # tenant + trace labels and the conservation-contract fields
+        # device_s/transfer_s/perms/bytes_to_host/compile_s_amortized
+        "request_cost",
     )
+
+
+def test_histogram_bucket_boundaries_pinned():
+    """ISSUE 13: the per-tenant latency/cost histogram boundaries are
+    exposition schema — re-binning breaks every dashboard quantile keyed
+    on the ``le`` labels, so a change must fail CI here, deliberately."""
+    from netrep_tpu.utils.telemetry import COST_BUCKETS_S, LATENCY_BUCKETS_S
+
+    assert LATENCY_BUCKETS_S == (
+        0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+        30.0, 60.0, 120.0,
+    )
+    assert COST_BUCKETS_S == (
+        0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0,
+    )
+
+
+def test_bucket_histogram_observe_quantile_and_prom_lines():
+    from netrep_tpu.utils.telemetry import BucketHistogram
+
+    h = BucketHistogram((0.1, 1.0, 10.0))
+    assert h.quantile(0.5) is None
+    for v in (0.05, 0.5, 0.5, 2.0):
+        h.observe(v)
+    assert h.counts == [1, 2, 1, 0] and h.n == 4
+    assert h.total == pytest.approx(3.05)
+    # p50 interpolates inside the (0.1, 1.0] bucket
+    assert 0.1 <= h.quantile(0.5) <= 1.0
+    # +Inf overflow degrades to the last finite boundary
+    h2 = BucketHistogram((0.1,))
+    h2.observe(5.0)
+    assert h2.quantile(0.99) == 0.1
+    lines = h.prom_lines("x_seconds", 'tenant="a"')
+    assert lines == [
+        'x_seconds_bucket{tenant="a",le="0.1"} 1',
+        'x_seconds_bucket{tenant="a",le="1"} 3',
+        'x_seconds_bucket{tenant="a",le="10"} 4',
+        'x_seconds_bucket{tenant="a",le="+Inf"} 4',
+        'x_seconds_count{tenant="a"} 4',
+        'x_seconds_sum{tenant="a"} 3.05',
+    ]
 
 
 def test_known_events_cover_every_emitted_name():
@@ -329,6 +374,8 @@ def test_tenant_summary_folds_serve_events():
         ev("request_done", tenant="b", ok=False, s=1.5, error="Boom"),
         ev("request_expired", tenant="b", miss_s=0.2),
         ev("request_deduped", tenant="a", state="completed"),
+        ev("request_cost", tenant="a", device_s=0.25, perms=128,
+           bytes_to_host=4096),
         ev("chunk", done=3),           # non-serve events are ignored
         ev("request_done", s=0.1),     # no tenant label: skipped
     ]
@@ -337,9 +384,11 @@ def test_tenant_summary_folds_serve_events():
         "received": 1, "packed": 1, "done": 1, "failed": 0, "rejected": 0,
         "expired": 0, "deduped": 1, "perms": 128,
         "latency": [1, 0.5, 0.5, 0.5],
+        "device_s": 0.25, "cost_bytes": 4096,
     }
     assert rows["b"]["rejected"] == 1 and rows["b"]["failed"] == 1
     assert rows["b"]["expired"] == 1
+    assert rows["b"]["device_s"] == 0.0
     # the rendered section names both tenants (smoke the CLI surface)
     import json
 

@@ -14,7 +14,8 @@ from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
 from netrep_tpu.utils.config import EngineConfig, FaultPolicy
 from netrep_tpu.utils.telemetry import Telemetry
 from netrep_tpu.utils.trace import (
-    build_span_tree, render_perfetto, time_split, write_perfetto,
+    build_span_tree, merge_events, render_perfetto, time_split,
+    write_perfetto,
 )
 
 
@@ -123,6 +124,59 @@ def test_write_perfetto_round_trips(tmp_path):
 # ---------------------------------------------------------------------------
 # time split
 # ---------------------------------------------------------------------------
+
+def test_trace_id_propagates_to_descendants():
+    """ISSUE 13: a span carrying ``trace`` gives it to its whole subtree
+    (the request subtree inherits the client-minted id); unrelated spans
+    stay untraced."""
+    events = [
+        _ev(100.0, "serve_start", span="s1"),
+        _ev(100.1, "request_received", span="s2", parent="s1",
+            trace="t" * 32, tenant="a"),
+        _ev(100.5, "request_done", span="s2", s=0.4, tenant="a"),
+        _ev(100.6, "pack", span="s3", parent="s1", s=0.3),
+    ]
+    spans, _ = build_span_tree(events)
+    assert spans["s2"]["args"]["trace"] == "t" * 32
+    assert "trace" not in spans["s3"]["args"]
+    assert "trace" not in spans["s1"]["args"]
+
+
+def test_merge_events_namespaces_and_groups_by_trace(tmp_path):
+    """Two files, two runs, one trace id (a client + a restarted server,
+    or two server generations): merged export namespaces the per-bus span
+    ids (no ``s1`` collision) and renders every traced span under ONE
+    trace-named pid; untraced logs keep the per-run pids unchanged."""
+    tr = "f" * 32
+    gen1 = [
+        _ev(100.0, "request_received", run="runA", span="s1", trace=tr),
+        # crashed: begin-only
+    ]
+    gen2 = [
+        _ev(200.0, "request_received", run="runB", span="s1", trace=tr),
+        _ev(200.9, "request_done", run="runB", span="s1", s=0.8),
+    ]
+    p1, p2 = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    for p, evs in ((p1, gen1), (p2, gen2)):
+        with open(p, "w", encoding="utf-8") as f:
+            for e in evs:
+                f.write(json.dumps(e) + "\n")
+    merged = merge_events([p1, p2])
+    sids = {e["data"]["span"] for e in merged}
+    assert sids == {"runA:s1", "runB:s1"}     # no collision by design
+    doc = render_perfetto(merged)
+    rows = [r for r in doc["traceEvents"] if r.get("ph") == "X"]
+    assert len(rows) == 2
+    assert len({r["pid"] for r in rows}) == 1
+    names = {m["args"]["name"] for m in doc["traceEvents"]
+             if m.get("name") == "process_name"
+             and m["pid"] == rows[0]["pid"]}
+    assert any(n.startswith("trace ") for n in names)
+    # multi-file write_perfetto drives the same merge path
+    out = str(tmp_path / "merged.json")
+    n = write_perfetto([p1, p2], out)
+    assert n == len(doc["traceEvents"])
+
 
 def test_time_split_sums_to_total():
     split = time_split(SYNTH)
