@@ -538,6 +538,161 @@ def run_kill_recover(args) -> int:
     return 0
 
 
+def run_fleet(args) -> int:
+    """Fleet scenario (ISSUE 14): the same mixed-tenant workload driven
+    through an in-process fleet coordinator, with a replica SIGKILL
+    stand-in (the ``crash`` fault plan, armed on the replica that OWNS
+    the busiest pair) landing MID-RUN. One row reports p50/p99 latency,
+    the measured failover time (``failover_done.s`` from the
+    coordinator's telemetry), and aggregate perms/s vs the SAME workload
+    on a 1-replica fleet — under the ``serve-fleet`` metric label, so
+    its perf-ledger fingerprints never mix with single-server history.
+    Parity is asserted in-bench before any row: a fast-but-wrong fleet
+    row is impossible."""
+    import tempfile as _tf
+
+    from netrep_tpu import module_preservation
+    from netrep_tpu.serve import FleetConfig, ServeConfig, build_inprocess_fleet
+    from netrep_tpu.utils.config import EngineConfig, FaultPolicy
+
+    import jax
+
+    device = str(jax.devices()[0])
+    cfg = EngineConfig(chunk_size=args.chunk, autotune=False)
+    tenants, requests = build_workload(args)
+
+    def boot(n, tag, kill=False):
+        tmp = _tf.mkdtemp(prefix=f"netrep_fleet_{tag}_")
+        tel = os.path.join(tmp, "coord_tel.jsonl")
+
+        def mk(rid, jpath, ckpt):
+            return ServeConfig(
+                engine=cfg, journal=jpath, checkpoint_dir=ckpt,
+                checkpoint_every=args.chunk, max_pack=args.max_pack,
+                pool_size=args.pool_size, pack_window_s=0.1,
+                fleet_label=rid,
+                telemetry=os.path.join(tmp, f"{rid}_tel.jsonl"),
+            )
+
+        fleet = build_inprocess_fleet(
+            n, os.path.join(tmp, "fleet"), make_config=mk,
+            fleet_config=FleetConfig(telemetry=tel, heartbeat_s=0.1),
+        )
+        for name, spec in tenants.items():
+            fleet.register_tenant(name, spec["weight"])
+            mixed, assign = spec["fixture"]
+            (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+            fleet.register_dataset(name, "d", network=dn, correlation=dc,
+                                   data=dd, assignments=assign)
+            fleet.register_dataset(name, "t", network=tn, correlation=tc,
+                                   data=td)
+        if kill:
+            home = fleet.route("alpha", "d", "t")
+            home.arm_fault_plan(FaultPolicy(
+                plan=f"crash@{3 * args.chunk // 4}",
+                backoff_base_s=0.0, backoff_jitter=0.0,
+            ))
+        return fleet, tel
+
+    def drive(fleet):
+        results, lats, errors = [], [], []
+        lock = threading.Lock()
+
+        def worker(r):
+            try:
+                res = fleet.analyze(
+                    r["tenant"], "d", "t", n_perm=r["n_perm"],
+                    seed=r["seed"], adaptive=r["adaptive"], timeout=1200,
+                )
+            except Exception as e:  # surfaced after join
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                return
+            with lock:
+                results.append((r, res))
+                lats.append(res["latency_s"])
+
+        threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+                   for r in requests]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError("fleet worker failed: " + errors[0])
+        return wall, results, lats
+
+    # 1-replica reference: same workload, same coordinator overheads —
+    # the denominator of the aggregate-perms/s comparison
+    fleet1, _tel1 = boot(1, "one")
+    try:
+        wall1, results1, _lats1 = drive(fleet1)
+    finally:
+        fleet1.close()
+    perms1 = sum(int(res["completed"]) for _r, res in results1)
+
+    n_rep = max(2, int(args.fleet))
+    fleetN, telN = boot(n_rep, "n", kill=True)
+    try:
+        wallN, resultsN, latsN = drive(fleetN)
+    finally:
+        fleetN.close()
+    permsN = sum(int(res["completed"]) for _r, res in resultsN)
+
+    # parity gate before any row: served-through-failover == direct
+    r0 = requests[0]
+    mixed, assign = tenants[r0["tenant"]]["fixture"]
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    d = module_preservation(
+        network={"d": dn, "t": tn}, correlation={"d": dc, "t": tc},
+        data={"d": dd, "t": td}, module_assignments=assign,
+        discovery="d", test="t", n_perm=r0["n_perm"], seed=r0["seed"],
+        adaptive=r0["adaptive"], config=cfg,
+    )
+    served0 = next(res for r, res in resultsN
+                   if r["tenant"] == r0["tenant"]
+                   and r["seed"] == r0["seed"])
+    assert np.array_equal(served0["p_values"], np.asarray(d.p_values)), \
+        "fleet-served/direct p-value mismatch"
+
+    failover_s = None
+    killed = False
+    try:
+        with open(telN, encoding="utf-8") as f:
+            for line in f:
+                if '"failover_done"' not in line:
+                    continue
+                e = json.loads(line)
+                if e.get("ev") == "failover_done":
+                    failover_s = float(e["data"].get("s", 0.0))
+                    killed = True
+    except (OSError, json.JSONDecodeError):
+        pass
+    assert killed, "the replica kill never fired (no failover_done)"
+
+    emit({
+        "metric": (
+            f"serve-fleet {n_rep} replicas kill-failover "
+            f"({len(requests)} req, chunk {args.chunk})"
+        ),
+        "value": round(wallN, 3),
+        "unit": "s",
+        "requests": len(resultsN),
+        "perms_per_sec": round(permsN / wallN, 2),
+        "perms_per_sec_1replica": round(perms1 / wall1, 2),
+        "vs_1_replica": round((permsN / wallN) / (perms1 / wall1), 3),
+        "p50_ms": round(1000 * float(np.percentile(latsN, 50)), 1),
+        "p99_ms": round(1000 * float(np.percentile(latsN, 99)), 1),
+        "failover_s": round(failover_s, 4),
+        "replicas": n_rep,
+        "device": device,
+        "chunk": args.chunk,
+    })
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -567,6 +722,13 @@ def main() -> int:
                          "time-to-recovery + re-served/recomputed split "
                          "after a mid-pack crash (rows labeled "
                          "serve-recover in the perf ledger)")
+    ap.add_argument("--fleet", type=int, default=None, metavar="N",
+                    help="fleet scenario instead of the load run (ISSUE "
+                         "14): the workload through an N-replica "
+                         "in-process fleet with a mid-run replica kill; "
+                         "reports p50/p99, failover time, and aggregate "
+                         "perms/s vs 1 replica (rows labeled serve-fleet "
+                         "in the perf ledger)")
     ap.add_argument("--drain-wait", type=float, default=120.0)
     args = ap.parse_args()
 
@@ -595,6 +757,8 @@ def main() -> int:
         return run_drill(args)
     if args.kill_recover:
         return run_kill_recover(args)
+    if args.fleet:
+        return run_fleet(args)
 
     device = str(jax.devices()[0])
     tenants, requests = build_workload(args)

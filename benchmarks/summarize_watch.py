@@ -94,6 +94,13 @@ def classify(row: dict) -> str:
         # per-tenant attributed-cost row (ISSUE 13): surfaced as the
         # cost table, not a BASELINE measurement (CPU by design)
         return "serve-cost"
+    if ((isinstance(row.get("metric"), str)
+         and row["metric"].startswith("serve-fleet"))
+            or "killed_replica" in row):
+        # fleet drill rows (ISSUE 14): the serve_load --fleet
+        # kill-failover row and the chaos --fleet summary — robustness
+        # signals (CPU by design), never BASELINE measurements
+        return "serve-fleet"
     if row.get("tpu_fallback") or "error" in row or "warning" in row:
         return "dropped"
     if row.get("cached"):
@@ -224,9 +231,38 @@ def serve_cost_lines(cost_rows: list[dict],
     return lines
 
 
+def fleet_lines(rows: list[dict]) -> list[str]:
+    """Fleet-drill section (ISSUE 14): the newest kill-failover load row
+    (p50/p99, failover time, aggregate vs 1 replica) and the newest
+    ``chaos --fleet`` verdict — the replication-health story in two
+    lines."""
+    lines = []
+    loads = [r for r in rows if "failover_s" in r]
+    if loads:
+        r = loads[-1]
+        lines.append(
+            f"{r['metric']}: {r['value']}{r.get('unit', '')} · "
+            f"p50={r.get('p50_ms')}ms p99={r.get('p99_ms')}ms · "
+            f"failover={r.get('failover_s')}s · "
+            f"vs_1_replica={r.get('vs_1_replica')}"
+        )
+    drills = [r for r in rows if "killed_replica" in r]
+    if drills:
+        r = drills[-1]
+        verdict = "PASSED" if r.get("ok") else "FAILED"
+        lines.append(
+            f"chaos --fleet {verdict}: killed={r.get('killed_replica')} "
+            f"recovered={r.get('recovered')} "
+            f"bit_identical={r.get('bit_identical')} "
+            f"({len(drills)} drill(s) total)"
+        )
+    return lines
+
+
 def main(paths: list[str]) -> int:
     results, unknown, other, dropped, telemetry = [], [], [], 0, []
     ledger, lint, serve_cost, serve_top = [], [], [], []
+    fleet = []
     for p in paths:
         for r in rows_from(p):
             kind = classify(r)
@@ -248,6 +284,13 @@ def main(paths: list[str]) -> int:
                 serve_cost.append(r)
             elif kind == "serve-top":
                 serve_top.append(r)
+            elif kind == "serve-fleet":
+                fleet.append(r)
+    if fleet:
+        print("## fleet drills (kill-failover health)")
+        for line in fleet_lines(fleet):
+            print(line)
+        print()
     if serve_cost or serve_top:
         print("## serve observability (attributed cost + top snapshots)")
         for line in serve_cost_lines(serve_cost, serve_top):

@@ -256,12 +256,46 @@ serve_crash_drill() {
   fi
 }
 
+# Fleet drill (ISSUE 14, opt-in: FLEET_DRILL=auto or 1): once per watch
+# cycle, prove the replication story end to end — `chaos --fleet` boots
+# the real coordinator + replica daemons, SIGKILLs a replica MID-PACK,
+# and asserts the peer completes every request bit-identically via the
+# shipped journal + shared checkpoints; then the serve_load fleet
+# scenario measures p50/p99, failover time, and aggregate perms/s vs 1
+# replica into $PERF_LEDGER under the `serve-fleet` label (its own
+# fingerprint class), gated by `perf --check` loudly but non-fatally.
+# CPU-only; off under the QUEUE_FILE test hook like the other drills.
+FLEET_DRILL=${FLEET_DRILL:-0}
+fleet_drill() {
+  case "$FLEET_DRILL" in
+    auto|1) ;;
+    *) return 0 ;;
+  esac
+  [ "$FLEET_DRILL" = auto ] && [ -n "${QUEUE_FILE:-}" ] && return 0
+  echo "--- fleet drill ($(date -u +%FT%TZ)) ---" | tee -a "$LOG"
+  if ! timeout 900 env JAX_PLATFORMS=cpu \
+       python -m netrep_tpu chaos --fleet --json >>"$LOG" 2>&1; then
+    echo "--- FLEET CHAOS DRILL FAILED (shipping/failover parity regressed?) ---" | tee -a "$LOG"
+  fi
+  if ! timeout 900 env JAX_PLATFORMS=cpu python benchmarks/serve_load.py \
+       --smoke --fleet 2 >>"$LOG" 2>&1; then
+    echo "--- FLEET LOAD SCENARIO FAILED ---" | tee -a "$LOG"
+  fi
+  if [ -s "$PERF_LEDGER" ]; then
+    if ! perf_out=$(timeout 60 python -m netrep_tpu perf "$PERF_LEDGER" --check 2>/dev/null); then
+      echo "--- PERF REGRESSION after fleet drill ---" | tee -a "$LOG"
+      echo "$perf_out" | tee -a "$LOG"
+    fi
+  fi
+}
+
 echo "== watcher start $(date -u +%FT%TZ) (log=$LOG state=$STATE) ==" | tee -a "$LOG"
 while :; do
   lint_check
   elastic_drill
   serve_drill
   serve_crash_drill
+  fleet_drill
   # drained first: with a cutoff set, an empty queue would otherwise be
   # reported as "no step can finish before cutoff" (review r5 — the test
   # harness caught the misleading exit line)

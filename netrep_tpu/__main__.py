@@ -372,6 +372,186 @@ def _chaos_serve(args) -> int:
                     p.wait()
 
 
+def _chaos_fleet(args) -> int:
+    """``chaos --fleet`` (ISSUE 14): the fleet analogue of the serving
+    drill, and the one-command proof of the whole replication story.
+    Boots the REAL fleet daemon (coordinator + N replica daemons),
+    drives concurrent journaled requests through the coordinator's one
+    socket, SIGKILLs a replica MID-PACK (picked live: the first replica
+    whose stats show inflight work), lets the coordinator's failover
+    move the shipped journal to the peer, and asserts every request
+    completes with p-values BIT-IDENTICAL to direct (unkilled) calls.
+    Prints the coordinator's ``--recovery`` timeline — replica_lost →
+    failover_start → failover_done (with the measured failover time) →
+    ring_rebalanced. Exit 0 = drill passed."""
+    import os
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+    import time
+
+    os.environ.pop("NETREP_FAULT_PLAN", None)   # the drill kills by pid
+
+    from netrep_tpu.utils.backend import resolve_backend_or_cpu
+
+    resolve_backend_or_cpu()
+    import numpy as np
+
+    from netrep_tpu import module_preservation
+    from netrep_tpu.data import make_mixed_pair
+    from netrep_tpu.utils.config import EngineConfig
+
+    genes, modules, n_samples, fseed = 100, 3, 16, 7
+    reqs = [{"seed": 100 + i, "n_perm": int(args.n_perm)}
+            for i in range(args.requests)]
+
+    # unkilled baseline: served == direct is the PR 7 parity pin, so the
+    # direct call IS the undisturbed single-replica fleet's answer
+    mixed = make_mixed_pair(genes, modules, n_samples=n_samples, seed=fseed)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    assign = {f"node_{i}": "0" for i in range(dn.shape[0])}
+    for lab, idx in mixed["specs"]:
+        for i in idx:
+            assign[f"node_{i}"] = str(lab)
+    cfg = EngineConfig(chunk_size=args.chunk, autotune=False)
+    baseline = {}
+    for r in reqs:
+        res = module_preservation(
+            network={"d": dn, "t": tn}, correlation={"d": dc, "t": tc},
+            data={"d": dd, "t": td}, module_assignments=assign,
+            discovery="d", test="t", n_perm=r["n_perm"], seed=r["seed"],
+            config=cfg,
+        )
+        baseline[r["seed"]] = np.asarray(res.p_values)
+
+    tmp = tempfile.mkdtemp(prefix="netrep_chaos_fleet_")
+    sock = os.path.join(tmp, "fleet.sock")
+    tel = os.path.join(tmp, "fleet_tel.jsonl")
+    env = {**os.environ, "JAX_PLATFORMS":
+           os.environ.get("JAX_PLATFORMS", "cpu") or "cpu"}
+    env.pop("NETREP_FAULT_PLAN", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "netrep_tpu", "serve",
+         "--fleet", str(args.replicas), "--socket", sock,
+         "--fleet-dir", os.path.join(tmp, "fleet"),
+         "--telemetry", tel, "--chunk", str(args.chunk),
+         "--checkpoint-every", str(args.chunk),
+         "--heartbeat-s", "0.2"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env,
+    )
+    summary = {"replicas": int(args.replicas), "requests": len(reqs),
+               "n_perm": int(args.n_perm)}
+    try:
+        deadline = time.monotonic() + 300
+        while not os.path.exists(sock):
+            if time.monotonic() > deadline or proc.poll() is not None:
+                print("chaos --fleet: coordinator never opened its "
+                      "socket", file=sys.stderr)
+                return 1
+            time.sleep(0.2)
+
+        from netrep_tpu.serve.client import SocketClient
+
+        reg = SocketClient(sock, timeout=600)
+        reg.register_fixture("drill", genes=genes, modules=modules,
+                             n_samples=n_samples, seed=fseed)
+        reg.close()
+
+        results = {}
+        lock = threading.Lock()
+
+        def worker(r):
+            c = None
+            try:
+                c = SocketClient(sock, timeout=900)
+                out = c.analyze("drill", "fx_d", "fx_t",
+                                n_perm=r["n_perm"], seed=r["seed"],
+                                idempotency_key=f"drill-{r['seed']}",
+                                retries=8)
+                with lock:
+                    results[r["seed"]] = np.asarray(out["p_values"])
+            # netrep: allow(exception-taxonomy) — drill clients: a request that dies with the killed replica is re-served via the journal; the parity gate below is the assertion
+            except Exception:
+                pass
+            finally:
+                if c is not None:
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+
+        threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+                   for r in reqs]
+        for t in threads:
+            t.start()
+
+        # mid-pack kill: the first replica whose stats show in-flight
+        # work gets SIGKILL — "mid-pack" by construction, not by timing
+        killed_rid = None
+        stat_c = SocketClient(sock, timeout=60)
+        kill_deadline = time.monotonic() + 240
+        while killed_rid is None and time.monotonic() < kill_deadline:
+            st = stat_c.stats()
+            for rid, row in sorted(st.get("replicas", {}).items()):
+                if (row.get("alive") and row.get("inflight")
+                        and row.get("pid")):
+                    os.kill(int(row["pid"]), signal.SIGKILL)
+                    killed_rid = rid
+                    break
+            if killed_rid is None:
+                time.sleep(0.02)
+        stat_c.close()
+        summary["killed_replica"] = killed_rid
+
+        for t in threads:
+            t.join(timeout=600)
+        identical = all(
+            s in results and np.array_equal(results[s], baseline[s])
+            for s in baseline
+        )
+        summary["recovered"] = len(results) == len(reqs)
+        summary["bit_identical"] = bool(identical)
+        summary["ok"] = bool(killed_rid and summary["recovered"]
+                             and identical)
+        c = SocketClient(sock, timeout=120)
+        c.shutdown()
+        c.close()
+        proc.wait(timeout=300)
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+
+    from netrep_tpu.utils.telemetry import render_recovery
+
+    timeline = ""
+    try:
+        timeline = render_recovery(tel)
+    except OSError:
+        pass
+    fo = [l for l in timeline.splitlines() if "failover_done" in l]
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(f"fleet chaos drill: {args.replicas} replicas, "
+              f"{len(reqs)} requests @ {args.n_perm} perms")
+        if timeline:
+            print(timeline)
+        print("fleet chaos drill "
+              + ("PASSED" if summary["ok"] else "FAILED")
+              + f": killed={summary.get('killed_replica')} "
+                f"recovered={summary['recovered']} "
+                f"bit_identical={summary['bit_identical']}"
+              + (f" ({fo[-1].strip()})" if fo else ""))
+    return 0 if summary["ok"] else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m netrep_tpu")
     sub = ap.add_subparsers(dest="cmd")
@@ -496,6 +676,42 @@ def main(argv=None) -> int:
                     help="assumed steady-state perms/s before the server "
                          "has measured its own (else the perf ledger's "
                          "serve history is consulted)")
+    # -- fleet serving (ISSUE 14) ---------------------------------------
+    sv.add_argument("--fleet", type=_positive, default=None, metavar="N",
+                    help="run N replica daemons behind a coordinator on "
+                         "--socket: consistent-hash routing by dataset "
+                         "digest (warm-pool locality), continuous "
+                         "journal shipping to a designated peer, "
+                         "heartbeat failover (the peer replays the "
+                         "shipped journal bit-identically), fleet-wide "
+                         "brownout admission, and respawn-on-death")
+    sv.add_argument("--fleet-dir", default=None, metavar="DIR",
+                    help="fleet state directory (replica journals, "
+                         "shipped copies, the SHARED pack-checkpoint "
+                         "dir); default: <socket>.fleet")
+    sv.add_argument("--fleet-route", default="proxy",
+                    choices=["proxy", "redirect"],
+                    help="proxy: the coordinator forwards analyze ops "
+                         "verbatim (clients keep one socket); redirect: "
+                         "it answers with the home replica's socket and "
+                         "the client re-sends there directly")
+    sv.add_argument("--heartbeat-s", type=float, default=0.25,
+                    help="fleet health-loop poll interval")
+    sv.add_argument("--ship-interval-s", type=float, default=0.2,
+                    help="journal-ship tail interval per replica")
+    sv.add_argument("--fleet-brownout-enter-s", type=float, default=None,
+                    help="fleet-wide brownout: shed new admissions when "
+                         "the AGGREGATE backlog drain estimate across "
+                         "replicas exceeds this")
+    sv.add_argument("--no-respawn", action="store_true",
+                    help="do not respawn a failed replica after its "
+                         "failover completes (the fleet shrinks)")
+    sv.add_argument("--fleet-label", default=None, metavar="RID",
+                    help="replica identity inside a fleet (set by the "
+                         "coordinator when spawning replicas): the first "
+                         "completed pack records its cold-start compile "
+                         "span under a fleet-labeled perf-ledger "
+                         "fingerprint")
     ch = sub.add_parser(
         "chaos",
         help="deterministic elastic-recovery drill (ISSUE 6): run a toy "
@@ -521,10 +737,21 @@ def main(argv=None) -> int:
                          "restart it with --recover, and assert every "
                          "journaled request completes bit-identically "
                          "vs an unkilled baseline")
+    ch.add_argument("--fleet", action="store_true",
+                    help="fleet chaos drill (ISSUE 14): boot the real "
+                         "fleet daemon, SIGKILL a replica MID-PACK, let "
+                         "the coordinator fail its shipped journal over "
+                         "to the peer, and assert every request "
+                         "completes bit-identically vs unkilled direct "
+                         "calls; prints the failover timeline")
+    ch.add_argument("--replicas", type=_positive, default=2,
+                    help="[--fleet] replica daemons in the drill")
     ch.add_argument("--requests", type=_positive, default=3,
-                    help="[--serve] concurrent requests in the drill")
+                    help="[--serve/--fleet] concurrent requests in the "
+                         "drill")
     ch.add_argument("--chunk", type=_positive, default=16,
-                    help="[--serve] served EngineConfig.chunk_size")
+                    help="[--serve/--fleet] served EngineConfig"
+                         ".chunk_size")
     tp = sub.add_parser(
         "top",
         help="live ops dashboard over a running serve daemon (ISSUE 13): "
@@ -670,6 +897,14 @@ def main(argv=None) -> int:
             if tenants:
                 print()
                 print(tenants)
+            # per-replica fleet section (ISSUE 14): present only for
+            # logs written by a fleet coordinator
+            from netrep_tpu.utils.telemetry import render_replicas
+
+            replicas = render_replicas(path0)
+            if replicas:
+                print()
+                print(replicas)
         return 0
 
     if args.cmd == "top":
@@ -679,20 +914,29 @@ def main(argv=None) -> int:
         return run_top(args)
 
     if args.cmd == "serve":
+        if args.telemetry is None:
+            import os
+
+            args.telemetry = os.environ.get("NETREP_TELEMETRY") or None
+        if args.fleet and args.fleet > 1:
+            # the fleet coordinator itself is backend-free (it only
+            # routes and ships journals); the replica daemons it spawns
+            # each resolve their own backend
+            from netrep_tpu.serve.fleet import fleet_daemon
+
+            return fleet_daemon(args)
         # the daemon resolves its backend hang-safely like selftest below
         # (a dead tunnel must drop the service to CPU, not hang the boot)
         from netrep_tpu.utils.backend import resolve_backend_or_cpu
 
         resolve_backend_or_cpu()
-        if args.telemetry is None:
-            import os
-
-            args.telemetry = os.environ.get("NETREP_TELEMETRY") or None
         from netrep_tpu.serve.server import serve_daemon
 
         return serve_daemon(args)
 
     if args.cmd == "chaos":
+        if getattr(args, "fleet", False):
+            return _chaos_fleet(args)
         if args.serve:
             return _chaos_serve(args)
         return _chaos(args)

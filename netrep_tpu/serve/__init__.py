@@ -15,10 +15,17 @@ Surface::
     )
 
 Daemon: ``python -m netrep_tpu serve --socket /tmp/netrep.sock``.
+Fleet (ISSUE 14): ``serve --fleet N`` — N replica daemons behind a
+coordinator with consistent-hash routing, journal shipping, replica-kill
+failover, and fleet-wide admission (:mod:`netrep_tpu.serve.fleet`).
 """
 
 from .client import InProcessClient, ServeRejected, SocketClient, retry_delay
-from .journal import RequestJournal
+from .fleet import (
+    FleetConfig, FleetCoordinator, HashRing, InProcessReplica, ReplicaLost,
+    build_inprocess_fleet,
+)
+from .journal import JournalShipper, RequestJournal
 from .packer import PackedEngine, PackMonitor, RequestPlan, run_pack
 from .pool import ProgramPool
 from .scheduler import (
@@ -31,8 +38,10 @@ __all__ = [
     "ServeError",
     "QueueFull",
     "ServeRejected",
+    "ReplicaLost",
     "Request",
     "RequestJournal",
+    "JournalShipper",
     "InProcessClient",
     "SocketClient",
     "ProgramPool",
@@ -41,4 +50,9 @@ __all__ = [
     "RequestPlan",
     "run_pack",
     "retry_delay",
+    "FleetConfig",
+    "FleetCoordinator",
+    "HashRing",
+    "InProcessReplica",
+    "build_inprocess_fleet",
 ]

@@ -36,11 +36,16 @@ from .protocol import decode_arrays, encode_arrays, mint_trace_ctx
 class ServeRejected(RuntimeError):
     """The daemon refused the request with a retryable rejection
     (``QueueFull``/brownout): back off — ``retry_after_s`` is the
-    server's drain-time hint when it has one."""
+    server's drain-time hint when it has one. ``redirect`` (ISSUE 14
+    fleet coordinator, ``--fleet-route redirect``) names another socket
+    the client should re-send the SAME op to — a routing hint, not an
+    overload signal, so the retry is immediate."""
 
-    def __init__(self, msg: str, retry_after_s: float | None = None):
+    def __init__(self, msg: str, retry_after_s: float | None = None,
+                 redirect: str | None = None):
         super().__init__(msg)
         self.retry_after_s = retry_after_s
+        self.redirect = redirect
 
 
 def retry_delay(attempt: int, token: str, base_s: float = 0.25,
@@ -167,10 +172,11 @@ class SocketClient:
             raise ConnectionError("serve daemon closed the connection")
         resp = json.loads(line)
         if not resp.get("ok", False):
-            if resp.get("retryable"):
+            if resp.get("retryable") or resp.get("redirect"):
                 raise ServeRejected(
                     resp.get("error", "serve daemon rejected the request"),
                     retry_after_s=resp.get("retry_after_s"),
+                    redirect=resp.get("redirect"),
                 )
             raise RuntimeError(resp.get("error", "serve daemon error"))
         return decode_arrays(resp)
@@ -193,20 +199,35 @@ class SocketClient:
         retryable rejection (QueueFull/brownout — honoring the server's
         ``retry_after_s`` hint) or a dropped/restarted daemon connection
         is retried under ONE idempotency key: after a ``serve --recover``
-        boot the re-sent request is answered from the journal (or
-        attaches to its re-queued run) instead of recomputing. The trace
-        context is minted once per logical request (ISSUE 13): every
-        attempt — across reconnects and daemon restarts — carries the
+        boot — or a fleet replica failover (ISSUE 14) — the re-sent
+        request is answered from the journal (or attaches to its
+        re-queued run) instead of recomputing. A coordinator
+        ``redirect`` hint re-points the connection at the named socket
+        and re-sends IMMEDIATELY (it is routing, not overload, so it
+        costs no retry attempt; hops are bounded). The trace context is
+        minted once per logical request (ISSUE 13): every attempt —
+        across reconnects, redirects, and daemon restarts — carries the
         same trace id, so the merged trace is one story."""
         key = kw.setdefault("idempotency_key", f"c-{uuid.uuid4().hex}")
         kw.setdefault("trace_ctx", mint_trace_ctx())
         attempt = 0
+        hops = 0
         while True:
             try:
                 return self.request("analyze", tenant=tenant,
                                     discovery=discovery, test=test,
                                     **kw)["result"]
             except (ServeRejected, ConnectionError, OSError) as e:
+                if getattr(e, "redirect", None) and hops < 8:
+                    # routing hint: follow to the named replica socket
+                    # under the SAME key/trace, no backoff consumed
+                    hops += 1
+                    self.path = e.redirect
+                    try:
+                        self.reconnect()
+                        continue
+                    except OSError:
+                        pass   # fall through to the retry ladder
                 attempt += 1
                 if attempt > retries:
                     raise

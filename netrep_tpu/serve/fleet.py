@@ -1,0 +1,1226 @@
+"""Fleet serving: replicated daemons behind one coordinator (ISSUE 14).
+
+One daemon is one process; the ROADMAP's "millions of users" needs N
+replicas behind a router. Everything below leans on invariants earlier
+PRs made load-bearing — requests are durable + idempotent (the PR 10
+journal), served results are bit-identical to direct calls under ANY
+pack/replica composition (PR 7), checkpoints are identity-keyed and
+composition-independent (PR 6/10), and traces survive restarts (PR 13)
+— so serve work is *migratable by construction*; this module is the
+robustness layer that actually migrates it when a replica dies.
+
+Architecture::
+
+    client ── one socket ──► FleetCoordinator ──► replica r0 (journal J0)
+                              │  consistent-hash   replica r1 (journal J1)
+                              │  ring on dataset    ...
+                              │  digests            replica rN
+                              ├─ JournalShipper per replica: J_i tails to
+                              │  the designated peer's copy (acked
+                              │  offsets, torn-line tolerant)
+                              └─ heartbeat/health loop → failover
+
+- **Routing**: (discovery digest, test digest) consistent-hashes onto
+  the replica ring — the same dataset pair always lands on the same
+  replica, so its warm ``ProgramPool`` engines keep hitting. Client ops
+  route transparently: idempotency keys and trace ids pass through
+  unchanged; registrations broadcast to every replica (cheap, bounded by
+  dataset count — and the precondition for rebalance/failover, since any
+  replica may inherit any pair).
+- **Journal shipping**: each replica's write-ahead journal continuously
+  ships to a designated peer (:class:`~netrep_tpu.serve.journal
+  .JournalShipper` — fsynced segment tailing with acked offsets). On one
+  host the copy is a file the peer replays; in a multi-host deployment
+  the same protocol lands the copy on the peer's disk.
+- **Failover**: the health loop declares a replica dead (worker exit /
+  missed heartbeats), removes it from the ring (``replica_lost`` +
+  ``ring_rebalanced`` — placement moves for the dead replica's keys
+  ONLY, never a recompute), runs a final ship pass, and has the peer
+  ``adopt_journal`` the shipped copy — the existing ``--recover`` replay
+  (re-register datasets, answer duplicates from journaled results,
+  re-queue unfinished requests, resume packs from the SHARED
+  checkpoint directory at their last chunk boundary). Counts, p-values
+  and adaptive decisions stay BIT-IDENTICAL to an undisturbed
+  single-replica run, because every recompute path already is.
+- **Fleet-wide admission**: brownout decisions read the AGGREGATE
+  backlog-drain estimate — queued permutations summed across replicas
+  over the summed per-replica rate estimates (measured, else the shared
+  perf ledger's serve history) — so one hot replica does not brown out
+  an idle fleet, and a drowning fleet sheds with an honest
+  ``retry_after_s`` hint.
+
+Surfaces: :func:`build_inprocess_fleet` (tier-1 tests, the load
+generator — CPU-only, socket-free, exactly like ``InProcessClient`` vs
+the daemon), and ``python -m netrep_tpu serve --fleet N --socket PATH``
+(:func:`fleet_daemon` — coordinator process + N replica daemons).
+``python -m netrep_tpu chaos --fleet`` is the one-command drill:
+mid-pack replica SIGKILL → failover → parity gate → timeline.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import socket as _socket
+import threading
+import time
+import uuid
+
+logger = logging.getLogger("netrep_tpu")
+
+from ..utils import telemetry as tm
+from .journal import JournalShipper
+from .scheduler import (
+    PreservationServer, QueueFull, ServeConfig, ServeError,
+)
+
+
+class ReplicaLost(ServeError):
+    """The replica holding this request died mid-flight. The coordinator
+    catches this, waits for failover to complete, and re-routes under the
+    SAME idempotency key — the peer either attaches to the adopted
+    (re-queued) computation or answers from the shipped journal, so the
+    one-computation-per-key contract survives the loss."""
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes: dataset-pair digests map
+    to replicas such that membership changes move ONLY the keys owned by
+    the joining/leaving replica (the rebalance-is-a-ring-update,
+    never-a-recompute contract, pinned in tests/test_serve_fleet.py).
+    Deterministic — no RNG, placement is a pure function of (members,
+    key)."""
+
+    def __init__(self, vnodes: int = 64):
+        self.vnodes = max(1, int(vnodes))
+        self._points: list[tuple[int, str]] = []   # sorted (hash, rid)
+
+    @staticmethod
+    def _hash(s: str) -> int:
+        return int.from_bytes(
+            hashlib.blake2b(s.encode(), digest_size=8).digest(), "big"
+        )
+
+    def add(self, rid: str) -> None:
+        for i in range(self.vnodes):
+            bisect.insort(self._points, (self._hash(f"{rid}#{i}"), rid))
+
+    def remove(self, rid: str) -> None:
+        self._points = [p for p in self._points if p[1] != rid]
+
+    def members(self) -> set[str]:
+        return {rid for _h, rid in self._points}
+
+    def route(self, key: str) -> str | None:
+        """The replica owning ``key``: first ring point at or past the
+        key's hash, wrapping at the top."""
+        if not self._points:
+            return None
+        h = self._hash(key)
+        i = bisect.bisect_left(self._points, (h, ""))
+        if i >= len(self._points):
+            i = 0
+        return self._points[i][1]
+
+    def successor(self, rid: str) -> str | None:
+        """The next DISTINCT replica clockwise from ``rid``'s first
+        point — the designated journal-ship peer."""
+        if not self._points:
+            return None
+        first = None
+        for h, r in self._points:
+            if r == rid:
+                first = h
+                break
+        if first is None:
+            return None
+        n = len(self._points)
+        i = bisect.bisect_right(self._points, (first, rid))
+        for step in range(n):
+            r = self._points[(i + step) % n][1]
+            if r != rid:
+                return r
+        return None
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Coordinator knobs (transport-independent — shared by the
+    in-process fleet and the daemon fleet)."""
+
+    #: heartbeat/health-loop poll interval; a replica is declared dead on
+    #: the first failed liveness check (the checks are cheap and the
+    #: workers fail hard — SIGKILL or SimulatedCrash — so one strike is
+    #: the honest policy; a flapping transport belongs behind retries in
+    #: the replica handle, not here)
+    heartbeat_s: float = 0.25
+    #: journal-ship tail interval per replica
+    ship_interval_s: float = 0.2
+    #: virtual nodes per replica on the hash ring
+    vnodes: int = 64
+    #: fleet-wide brownout: shed new admissions when the AGGREGATE
+    #: backlog drain estimate exceeds this (None = off); exit below
+    #: ``brownout_exit_s`` (default half — same hysteresis contract as
+    #: the per-replica brownout)
+    brownout_enter_s: float | None = None
+    brownout_exit_s: float | None = None
+    #: assumed per-replica steady rate before anything is measured
+    #: (else each replica's own estimate, else the shared perf ledger)
+    rate_pps: float | None = None
+    #: where shipped journal copies live: ``<fleet_dir>/ship/<rid>.jsonl``
+    fleet_dir: str | None = None
+    #: coordinator telemetry (fleet events land here): path / Telemetry /
+    #: True / None — same resolution as ``ServeConfig.telemetry``
+    telemetry: object = None
+    #: bound on each replica's drain when the fleet closes
+    drain_timeout_s: float = 120.0
+    #: how long a re-routed request waits for an in-progress failover
+    failover_wait_s: float = 60.0
+
+
+class InProcessReplica:
+    """One in-process fleet replica: a journaled
+    :class:`PreservationServer` plus the liveness/kill seams the
+    coordinator drives — the tier-1 fleet surface (CPU-only, socket-free
+    by design, exactly like ``InProcessClient`` vs the socket daemon)."""
+
+    def __init__(self, rid: str, server: PreservationServer):
+        self.rid = rid
+        self.server = server
+        self.journal_path = server.config.journal
+        #: set by the coordinator once failover for this replica is
+        #: underway — in-flight ``analyze`` waiters stop waiting on the
+        #: dead worker and re-route (the Event IS the synchronization)
+        self.dead = threading.Event()
+
+    def alive(self) -> bool:
+        w = self.server._worker
+        return w is not None and w.is_alive() and not self.dead.is_set()
+
+    def arm_fault_plan(self, policy) -> None:
+        """Drill hook (tests, ``serve_load --fleet``): arm a fault
+        policy — e.g. ``FaultPolicy(plan="crash@24")``, the in-process
+        SIGKILL stand-in — on the live server. The drills route first,
+        then arm the replica that owns the pair, so the kill lands on
+        the replica actually serving."""
+        from ..utils.faults import resolve_runtime
+
+        self.server._fault = resolve_runtime(policy)
+
+    # -- ops ---------------------------------------------------------------
+
+    def register_tenant(self, name: str, weight: int = 1) -> None:
+        self.server.register_tenant(name, weight)
+
+    def register_dataset(self, tenant: str, name: str, **kw) -> str:
+        return self.server.register_dataset(tenant, name, **kw)
+
+    def register_fixture(self, tenant: str, prefix: str = "fx",
+                         **kw) -> dict:
+        return self.server.register_fixture(tenant, prefix, **kw)
+
+    def analyze(self, tenant: str, discovery: str, test, *,
+                timeout: float | None = None, **kw) -> dict:
+        """Blocking analyze that stays responsive to replica death: the
+        wait polls so a mid-flight loss raises :class:`ReplicaLost`
+        instead of blocking on a request whose worker no longer exists."""
+        handle = self.server.submit(tenant, discovery, test, **kw)
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while not handle.done.wait(0.1):
+            if self.dead.is_set():
+                raise ReplicaLost(
+                    f"replica {self.rid} died while serving the request"
+                )
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"request did not finish on replica {self.rid}"
+                )
+        return self.server.wait(handle, timeout=0)
+
+    def adopt_journal(self, path: str):
+        return self.server.adopt_journal(path)
+
+    def stats(self) -> dict:
+        return self.server.stats()
+
+    def metrics_text(self) -> str:
+        return self.server.metrics_text()
+
+    def close(self, drain: bool = True,
+              timeout: float | None = None) -> None:
+        self.server.close(drain=drain, timeout=timeout)
+
+
+def _wire_line(path: str, payload: dict, timeout: float = 600.0) -> dict:
+    """One raw request/response line over a unix socket — the
+    coordinator's transparent proxy primitive: the client's op forwards
+    VERBATIM (idempotency keys and trace ids pass through unchanged) and
+    the replica's response returns verbatim."""
+    s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+    s.settimeout(timeout)
+    try:
+        s.connect(path)
+        s.sendall((json.dumps(payload) + "\n").encode("utf-8"))
+        f = s.makefile("r", encoding="utf-8")
+        line = f.readline()
+        if not line:
+            raise ConnectionError("replica closed the connection")
+        return json.loads(line)
+    finally:
+        s.close()
+
+
+class DaemonReplica:
+    """Subprocess replica handle: a ``python -m netrep_tpu serve``
+    daemon on its own unix socket. Each op opens a short-lived
+    connection (unix connects are microseconds and per-op connections
+    keep the proxy thread-safe without a connection pool)."""
+
+    def __init__(self, rid: str, socket_path: str, journal_path: str,
+                 proc=None, timeout: float = 600.0):
+        self.rid = rid
+        self.socket_path = socket_path
+        self.journal_path = journal_path
+        self.proc = proc
+        self.timeout = timeout
+        self.dead = threading.Event()
+
+    def forward(self, op: dict) -> dict:
+        """Raw proxy: the op dict forwards verbatim, the response comes
+        back verbatim (whatever ``ok`` it carries)."""
+        return _wire_line(self.socket_path, op, self.timeout)
+
+    def request(self, op_name: str, **kw) -> dict:
+        resp = self.forward({"op": op_name, **kw})
+        if not resp.get("ok", False):
+            raise ServeError(
+                f"replica {self.rid} {op_name}: "
+                f"{resp.get('error', 'unknown error')}"
+            )
+        return resp
+
+    def alive(self) -> bool:
+        if self.dead.is_set():
+            return False
+        if self.proc is not None and self.proc.poll() is not None:
+            return False
+        try:
+            # short-fused ping: liveness must answer in heartbeats, not
+            # the data-plane timeout — a wedged-but-listening daemon is
+            # as dead as a closed socket
+            resp = _wire_line(self.socket_path, {"op": "ping"},
+                              timeout=2.0)
+            return bool(resp.get("pong"))
+        except (OSError, ConnectionError, ValueError):
+            return False
+
+    # -- ops ---------------------------------------------------------------
+
+    def register_tenant(self, name: str, weight: int = 1) -> None:
+        # the wire surface creates tenants implicitly at weight 1; an
+        # explicit weight has no wire op — acceptable for daemon fleets
+        pass
+
+    def register_dataset(self, tenant: str, name: str, **kw) -> str:
+        from .client import SocketClient
+
+        c = SocketClient(self.socket_path, timeout=self.timeout)
+        try:
+            return c.register_dataset(tenant, name, **kw)
+        finally:
+            c.close()
+
+    def register_fixture(self, tenant: str, prefix: str = "fx",
+                         **kw) -> dict:
+        from .client import SocketClient
+
+        c = SocketClient(self.socket_path, timeout=self.timeout)
+        try:
+            return c.register_fixture(tenant, prefix, **kw)
+        finally:
+            c.close()
+
+    def analyze(self, tenant: str, discovery: str, test, *,
+                timeout: float | None = None, **kw) -> dict:
+        from .client import SocketClient
+
+        try:
+            c = SocketClient(self.socket_path,
+                             timeout=timeout or self.timeout)
+        except OSError as e:
+            raise ReplicaLost(f"replica {self.rid} unreachable") from e
+        try:
+            return c.analyze(tenant, discovery, test, **kw)
+        except (ConnectionError, OSError) as e:
+            raise ReplicaLost(
+                f"replica {self.rid} died while serving the request"
+            ) from e
+        finally:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def adopt_journal(self, path: str):
+        return self.request("adopt_journal", path=path).get("adopted")
+
+    def stats(self) -> dict:
+        return self.request("stats")["stats"]
+
+    def metrics_text(self) -> str:
+        return self.request("metrics")["text"]
+
+    def kill(self) -> None:
+        """SIGKILL the replica process (drills)."""
+        import signal
+
+        if self.proc is not None and self.proc.poll() is None:
+            os.kill(self.proc.pid, signal.SIGKILL)
+
+    def close(self, drain: bool = True,
+              timeout: float | None = None) -> None:
+        import subprocess
+
+        timeout = 120.0 if timeout is None else timeout
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        try:
+            if drain:
+                self.forward({"op": "shutdown"})
+        except (OSError, ConnectionError, ValueError):
+            pass
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            pass   # escalate: SIGTERM, then SIGKILL below
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+
+
+class FleetCoordinator:
+    """The fleet control plane: consistent-hash routing, per-replica
+    journal shipping, the heartbeat/health loop, replica-kill failover,
+    and fleet-wide admission (module docstring). Transport-independent:
+    replica handles are :class:`InProcessReplica` (tier-1 tests, load
+    generator) or :class:`DaemonReplica` (the ``serve --fleet``
+    daemon)."""
+
+    def __init__(self, replicas, config: FleetConfig | None = None,
+                 start: bool = True):
+        self.config = config or FleetConfig()
+        self.tel, self._tel_owned = tm.resolve_arg(self.config.telemetry)
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._health: threading.Thread | None = None
+        self._replicas: dict[str, object] = {}
+        self._dead: set[str] = set()
+        self._ring = HashRing(self.config.vnodes)
+        self._shippers: dict[str, JournalShipper] = {}
+        self._peers: dict[str, str] = {}
+        self._digests: dict[tuple[str, str], str] = {}
+        self._fo_done: dict[str, threading.Event] = {}
+        self._brownout = False
+        self._ledger_rate: float | None = None
+        self._ledger_rate_read = False
+        self._started_m = time.monotonic()
+        #: optional post-failover hook (e.g. the daemon fleet's respawn);
+        #: called OUTSIDE the lock as ``on_failover(rid, peer_rid)``
+        self.on_failover = None
+        self._serve_sid = None
+        if self.tel is not None:
+            self._serve_sid = self.tel.begin_span(
+                "serve_start", fleet=True, replicas=len(replicas),
+            )
+        for rep in replicas:
+            self.join(rep)
+        if start:
+            self.start()
+
+    # -- membership --------------------------------------------------------
+
+    def join(self, rep) -> None:
+        """Admit a replica to the ring (boot, dynamic join, or respawn):
+        ring update + shipper start + ``replica_joined``/
+        ``ring_rebalanced`` — placement moves for the new replica's keys
+        only, never a recompute."""
+        with self._lock:
+            self._replicas[rep.rid] = rep
+            self._dead.discard(rep.rid)
+            self._ring.add(rep.rid)
+            self._fo_done[rep.rid] = threading.Event()
+            self._assign_peers_locked()
+            members = sorted(self._ring.members())
+        if self.tel is not None:
+            self.tel.emit("replica_joined", replica=rep.rid,
+                          parent=self._serve_sid,
+                          journal=rep.journal_path)
+            self.tel.emit("ring_rebalanced", replica=rep.rid,
+                          parent=self._serve_sid, reason="join",
+                          members=",".join(members))
+
+    def _assign_peers_locked(self) -> None:
+        """(Re-)designate each live replica's ship peer (ring successor)
+        and make sure its shipper exists. The shipped copy's PATH is
+        canonical per source (``ship/<rid>.jsonl``) so re-designation on
+        membership change costs nothing — on one host the copy is a
+        file; a multi-host deployment ships the same protocol to the
+        peer's disk."""
+        for rid, rep in self._replicas.items():
+            if rid in self._dead:
+                continue
+            self._peers[rid] = self._ring.successor(rid)
+            if rid not in self._shippers and rep.journal_path:
+                shipper = JournalShipper(
+                    rep.journal_path, self._ship_dest(rid),
+                    interval_s=self.config.ship_interval_s,
+                    replica=rid, telemetry=self.tel,
+                )
+                self._shippers[rid] = shipper
+                if not self._stop.is_set():
+                    shipper.start()
+
+    def _ship_dest(self, rid: str) -> str:
+        base = self.config.fleet_dir or os.path.join(
+            os.getcwd(), "netrep_fleet"
+        )
+        return os.path.join(base, "ship", f"{rid}.jsonl")
+
+    def live_replicas(self) -> dict[str, object]:
+        with self._lock:
+            return {rid: rep for rid, rep in self._replicas.items()
+                    if rid not in self._dead}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        with self._lock:
+            if self._health is not None:
+                return
+            self._health = threading.Thread(
+                target=self._health_loop, name="netrep-fleet-health",
+                daemon=True,
+            )
+            self._health.start()
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the health loop and shippers (final ship pass), drain
+        every live replica, close the coordinator span/bus."""
+        self._stop.set()
+        with self._lock:
+            t, self._health = self._health, None
+        if t is not None:
+            t.join(timeout=10.0)
+        with self._lock:
+            shippers = list(self._shippers.values())
+            self._shippers.clear()
+            live = [rep for rid, rep in self._replicas.items()
+                    if rid not in self._dead]
+        for s in shippers:
+            s.stop(final_flush=True)
+        for rep in live:
+            rep.close(drain=drain, timeout=self.config.drain_timeout_s)
+        if self.tel is not None:
+            self.tel.end_span(
+                self._serve_sid, "serve_end", fleet=True,
+                drained=bool(drain),
+                s=time.monotonic() - self._started_m,
+            )
+            if self._tel_owned:
+                self.tel.close()
+
+    # -- health / failover -------------------------------------------------
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.config.heartbeat_s):
+            with self._lock:
+                live = [(rid, rep)
+                        for rid, rep in self._replicas.items()
+                        if rid not in self._dead]
+            for rid, rep in live:
+                if self._stop.is_set():
+                    return
+                if not rep.alive():
+                    self._failover(rid)
+
+    def _failover(self, rid: str) -> None:
+        """Replica death → journal-ship catch-up → peer adoption. The
+        peer's ``adopt_journal`` runs the ordinary ``--recover`` replay
+        over the shipped copy: duplicates answer from journaled results,
+        unfinished requests re-queue in original order and resume their
+        packs from the SHARED checkpoint directory — bit-identical by
+        the same contracts boot recovery is."""
+        t0 = time.perf_counter()
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None or rid in self._dead:
+                return
+            self._dead.add(rid)
+            self._ring.remove(rid)
+            shipper = self._shippers.pop(rid, None)
+            peer_rid = self._peers.pop(rid, None)
+            if peer_rid is None or peer_rid in self._dead:
+                peer_rid = self._ring.route(rid)   # any survivor
+            peer = (self._replicas.get(peer_rid)
+                    if peer_rid is not None else None)
+            self._assign_peers_locked()
+            members = sorted(self._ring.members())
+            done_evt = self._fo_done.get(rid)
+        if self.tel is not None:
+            self.tel.emit("replica_lost", replica=rid,
+                          parent=self._serve_sid, peer=peer_rid)
+            self.tel.emit("failover_start", replica=rid,
+                          parent=self._serve_sid, peer=peer_rid)
+        if shipper is not None:
+            # final catch-up: everything the dead replica fsynced before
+            # its last breath reaches the copy (torn tail excluded, as
+            # always). In a multi-host fleet this pass is a no-op — the
+            # copy already holds exactly what was acked.
+            shipper.stop(final_flush=True)
+        summary = None
+        if peer is not None:
+            try:
+                summary = peer.adopt_journal(self._ship_dest(rid))
+            except (ServeError, OSError) as e:
+                logger.warning("fleet failover: peer %s failed to adopt "
+                               "%s's journal: %s", peer_rid, rid, e)
+        rep.dead.set()
+        if done_evt is not None:
+            done_evt.set()
+        if self.tel is not None:
+            self.tel.emit(
+                "failover_done", replica=rid, parent=self._serve_sid,
+                peer=peer_rid, s=time.perf_counter() - t0,
+                requeued=(summary or {}).get("requeued", 0),
+                results=(summary or {}).get("results", 0),
+            )
+            self.tel.emit("ring_rebalanced", replica=rid,
+                          parent=self._serve_sid, reason="leave",
+                          members=",".join(members))
+        cb = self.on_failover
+        if cb is not None:
+            try:
+                cb(rid, peer_rid)
+            # netrep: allow(exception-taxonomy) — a broken respawn hook must not kill the health loop; the fleet keeps serving on the survivors
+            except Exception:
+                logger.warning("fleet on_failover hook failed",
+                               exc_info=True)
+
+    def await_failover(self, rid: str,
+                       timeout: float | None = None) -> bool:
+        """Block until failover for ``rid`` has completed (the peer has
+        adopted its journal) — what a re-routing request waits on before
+        retrying under its idempotency key."""
+        with self._lock:
+            evt = self._fo_done.get(rid)
+        if evt is None:
+            return True
+        return evt.wait(timeout if timeout is not None
+                        else self.config.failover_wait_s)
+
+    def kill_replica(self, rid: str) -> None:
+        """Drill helper: hard-kill a replica (SIGKILL for daemons; for
+        in-process replicas the fault plan does the killing — this just
+        triggers immediate detection instead of waiting a heartbeat)."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+        if rep is None:
+            return
+        kill = getattr(rep, "kill", None)
+        if kill is not None:
+            kill()
+        self._failover(rid)
+
+    # -- routing -----------------------------------------------------------
+
+    def _route_key(self, tenant: str, discovery: str, test) -> str:
+        tests = list(test) if isinstance(test, (list, tuple)) else [test]
+        with self._lock:
+            parts = [
+                self._digests.get((tenant, n), f"name:{tenant}:{n}")
+                for n in [discovery, *tests]
+            ]
+        return "|".join(parts)
+
+    def route(self, tenant: str, discovery: str, test):
+        """The live replica owning this dataset pair (locality: same
+        pair → same replica → warm pooled engines), or None when the
+        fleet is empty."""
+        key = self._route_key(tenant, discovery, test)
+        with self._lock:
+            rid = self._ring.route(key)
+            return self._replicas.get(rid) if rid is not None else None
+
+    def note_digest(self, tenant: str, name: str, digest: str) -> None:
+        """Record a dataset's content digest for ring routing (the wire
+        coordinator captures it from a broadcast ``register``
+        response)."""
+        with self._lock:
+            self._digests[(tenant, name)] = str(digest)
+
+    # -- fleet-wide admission ----------------------------------------------
+
+    def _fallback_rate_locked(self) -> float | None:
+        """Per-replica rate assumption: configured, else the shared perf
+        ledger's serve/run history (read once, cached) — None when
+        nothing is known (fleet brownout then stays off: no guessing)."""
+        if self.config.rate_pps:
+            return float(self.config.rate_pps)
+        if not self._ledger_rate_read:
+            self._ledger_rate_read = True
+            try:
+                from ..utils import perfledger
+
+                entries = [
+                    float(e["perms_per_sec"])
+                    for e in perfledger.read_entries(
+                        perfledger.default_path())
+                    if e.get("source") in ("serve", "run")
+                ][-8:]
+                if entries:
+                    self._ledger_rate = sorted(entries)[len(entries) // 2]
+            except OSError:
+                pass
+        return self._ledger_rate
+
+    def drain_estimate(self, extra_perms: int = 0) -> float | None:
+        """AGGREGATE backlog drain estimate: queued permutations summed
+        across live replicas over the summed per-replica rates — the
+        fleet-wide admission signal. None when no rate is known."""
+        backlog = extra_perms
+        rate = 0.0
+        unknown = 0
+        for rep in self.live_replicas().values():
+            try:
+                st = rep.stats()
+            except (ServeError, OSError, ConnectionError):
+                continue
+            backlog += int(st.get("backlog_perms", 0) or 0)
+            r = st.get("rate_pps")
+            if r:
+                rate += float(r)
+            else:
+                unknown += 1
+        if unknown:
+            fb = None
+            with self._lock:
+                fb = self._fallback_rate_locked()
+            if fb:
+                rate += fb * unknown
+        if rate <= 0:
+            return None
+        return backlog / rate
+
+    def admit(self, extra_perms: int = 0) -> None:
+        """Fleet-wide brownout gate, called before routing a new
+        analyze: raises :class:`QueueFull` with the aggregate drain
+        estimate as ``retry_after_s`` while browned out. Same hysteresis
+        contract as the per-replica brownout (which still applies,
+        per-tenant-weighted, at each replica behind this gate)."""
+        cfg = self.config
+        if cfg.brownout_enter_s is None:
+            return
+        est = self.drain_estimate(extra_perms)
+        if est is None:
+            return
+        exit_s = (cfg.brownout_exit_s if cfg.brownout_exit_s is not None
+                  else cfg.brownout_enter_s / 2.0)
+        with self._lock:
+            if not self._brownout and est > cfg.brownout_enter_s:
+                self._brownout = True
+                if self.tel is not None:
+                    self.tel.emit("serve_brownout_enter", fleet=True,
+                                  est_drain_s=float(est),
+                                  parent=self._serve_sid)
+            elif self._brownout and est < exit_s:
+                self._brownout = False
+                if self.tel is not None:
+                    self.tel.emit("serve_brownout_exit", fleet=True,
+                                  est_drain_s=float(est),
+                                  parent=self._serve_sid)
+            browned = self._brownout
+        if browned:
+            raise QueueFull(
+                f"fleet is browned out (aggregate backlog drain "
+                f"{est:.1f}s); retry later",
+                retry_after_s=round(est, 3),
+            )
+
+    # -- client surface ----------------------------------------------------
+
+    def register_tenant(self, name: str, weight: int = 1) -> None:
+        for rep in self.live_replicas().values():
+            rep.register_tenant(name, weight)
+
+    def register_dataset(self, tenant: str, name: str, **kw) -> str:
+        """Broadcast registration (every replica may inherit any pair on
+        failover/rebalance); records the content digest for ring
+        routing. Returns the digest — identical on every replica by the
+        digest's content-addressed definition."""
+        digest = None
+        for rep in self.live_replicas().values():
+            digest = rep.register_dataset(tenant, name, **kw)
+        if digest is None:
+            raise ServeError("no live replicas to register on")
+        with self._lock:
+            self._digests[(tenant, name)] = digest
+        return digest
+
+    def register_fixture(self, tenant: str, prefix: str = "fx",
+                         **kw) -> dict:
+        out = None
+        for rep in self.live_replicas().values():
+            out = rep.register_fixture(tenant, prefix, **kw)
+        if out is None:
+            raise ServeError("no live replicas to register on")
+        return out
+
+    def analyze(self, tenant: str, discovery: str, test, *,
+                timeout: float | None = None, **kw) -> dict:
+        """Blocking analyze through the fleet: admission gate → ring
+        route → replica. A replica death mid-flight waits for failover
+        and re-routes under the SAME idempotency key (set here when the
+        caller sent none), so the retry attaches to the adopted
+        computation or answers from the shipped journal — never a second
+        computation."""
+        kw.setdefault("idempotency_key", f"f-{uuid.uuid4().hex[:16]}")
+        n_perm = int(kw.get("n_perm") or 0)
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            self.admit(extra_perms=n_perm)
+            rep = self.route(tenant, discovery, test)
+            if rep is None:
+                raise ServeError("fleet has no live replicas")
+            left = (None if deadline is None
+                    else max(0.1, deadline - time.monotonic()))
+            try:
+                return rep.analyze(tenant, discovery, test,
+                                   timeout=left, **kw)
+            except ReplicaLost:
+                self.await_failover(rep.rid)
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "request did not finish before its timeout "
+                        "(failover consumed the budget)"
+                    ) from None
+                continue
+
+    # -- ops surface -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Fleet-level stats: one row per replica (alive/dead, backlog,
+        rate, packs, per-tenant counters) plus merged per-tenant
+        counters and the coordinator's admission state — what ``top``
+        renders as the per-replica section."""
+        with self._lock:
+            reps = dict(self._replicas)
+            dead = set(self._dead)
+            brownout = self._brownout
+            members = sorted(self._ring.members())
+        rows = {}
+        merged: dict[str, dict] = {}
+        inflight = packs = 0
+        for rid in sorted(reps):
+            if rid in dead:
+                rows[rid] = {"alive": False}
+                continue
+            try:
+                st = reps[rid].stats()
+            except (ServeError, OSError, ConnectionError):
+                rows[rid] = {"alive": False}
+                continue
+            proc = getattr(reps[rid], "proc", None)
+            rows[rid] = {
+                "alive": True,
+                "pid": proc.pid if proc is not None else None,
+                "backlog_perms": st.get("backlog_perms", 0),
+                "rate_pps": st.get("rate_pps"),
+                "inflight": st.get("inflight", 0),
+                "packs": st.get("packs", 0),
+                "brownout": st.get("brownout", False),
+                "queue_depth": sum(
+                    t.get("queue_depth", 0)
+                    for t in st.get("tenants", {}).values()
+                ),
+                "done": sum(t.get("done", 0)
+                            for t in st.get("tenants", {}).values()),
+            }
+            inflight += int(st.get("inflight", 0) or 0)
+            packs += int(st.get("packs", 0) or 0)
+            for tn, t in st.get("tenants", {}).items():
+                m = merged.setdefault(tn, {
+                    "weight": t.get("weight", 1), "queue_depth": 0,
+                    "received": 0, "done": 0, "failed": 0,
+                    "rejected": 0, "expired": 0, "deduped": 0,
+                    "cost": {"device_s": 0.0, "perms": 0,
+                             "bytes_to_host": 0},
+                    "burn_rate": 0.0,
+                })
+                for k in ("queue_depth", "received", "done", "failed",
+                          "rejected", "expired", "deduped"):
+                    m[k] += int(t.get(k, 0) or 0)
+                c = t.get("cost") or {}
+                m["cost"]["device_s"] += float(c.get("device_s", 0.0))
+                m["cost"]["perms"] += int(c.get("perms", 0) or 0)
+                m["cost"]["bytes_to_host"] += int(
+                    c.get("bytes_to_host", 0) or 0)
+                m["burn_rate"] = max(m["burn_rate"],
+                                     float(t.get("burn_rate", 0.0)))
+        return {
+            "fleet": True,
+            "replicas": rows,
+            "ring": members,
+            "tenants": merged,
+            "brownout": brownout,
+            "accepting": not self._stop.is_set(),
+            "inflight": inflight,
+            "packs": packs,
+            "uptime_s": time.monotonic() - self._started_m,
+        }
+
+    def metrics_text(self) -> str:
+        """Concatenated per-replica Prometheus expositions, each under a
+        replica-identifying comment header."""
+        parts = []
+        for rid, rep in sorted(self.live_replicas().items()):
+            try:
+                parts.append(f"# fleet replica {rid}\n"
+                             + rep.metrics_text())
+            except (ServeError, OSError, ConnectionError):
+                parts.append(f"# fleet replica {rid} unreachable\n")
+        return "".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# in-process fleet construction (tier-1 tests, load generator)
+# ---------------------------------------------------------------------------
+
+
+def build_inprocess_fleet(
+    n: int, fleet_dir: str, *, make_config=None,
+    fleet_config: FleetConfig | None = None, start: bool = True,
+    start_servers: bool = True,
+) -> FleetCoordinator:
+    """N in-process replicas under one coordinator — the socket-free
+    fleet the tier-1 tests and ``serve_load --fleet`` drive.
+
+    Layout under ``fleet_dir``: ``r<i>/journal.jsonl`` per replica,
+    ``ship/`` for the shipped copies, and ONE SHARED ``ckpt/`` — pack
+    checkpoint paths are keyed on member identity + engine config (not
+    on the replica), so the peer adopting a dead replica's requests
+    finds its mid-pack checkpoints exactly where the dead replica left
+    them and resumes from the last chunk boundary.
+
+    ``make_config(rid, journal_path, ckpt_dir) -> ServeConfig`` lets the
+    caller inject per-replica knobs (the drills inject a fault plan into
+    ONE replica this way); the default is a journaled CPU-deterministic
+    config with ``fleet_label=rid``."""
+    os.makedirs(os.path.join(fleet_dir, "ship"), exist_ok=True)
+    ckpt_dir = os.path.join(fleet_dir, "ckpt")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    if fleet_config is None:
+        fleet_config = FleetConfig()
+    if fleet_config.fleet_dir is None:
+        fleet_config = dataclasses.replace(fleet_config,
+                                           fleet_dir=fleet_dir)
+    replicas = []
+    for i in range(int(n)):
+        rid = f"r{i}"
+        rdir = os.path.join(fleet_dir, rid)
+        os.makedirs(rdir, exist_ok=True)
+        jpath = os.path.join(rdir, "journal.jsonl")
+        if make_config is not None:
+            cfg = make_config(rid, jpath, ckpt_dir)
+        else:
+            cfg = ServeConfig(journal=jpath, checkpoint_dir=ckpt_dir,
+                              fleet_label=rid)
+        replicas.append(InProcessReplica(
+            rid, PreservationServer(cfg, start=start_servers)
+        ))
+    return FleetCoordinator(replicas, fleet_config, start=start)
+
+
+# ---------------------------------------------------------------------------
+# daemon fleet (`python -m netrep_tpu serve --fleet N`)
+# ---------------------------------------------------------------------------
+
+
+def spawn_replica_daemon(rid: str, fleet_dir: str, args, *,
+                         generation: int = 0, env_extra: dict | None = None):
+    """Boot one replica daemon subprocess on its own socket, journaling
+    into the fleet layout with the SHARED checkpoint directory.
+    Respawns bump ``generation`` so a fresh journal never replays work
+    the peer already adopted."""
+    import subprocess
+    import sys
+
+    rdir = os.path.join(fleet_dir, rid)
+    os.makedirs(rdir, exist_ok=True)
+    suffix = f".g{generation}" if generation else ""
+    sock = os.path.join(rdir, f"serve{suffix}.sock")
+    jpath = os.path.join(rdir, f"journal{suffix}.jsonl")
+    cmd = [
+        sys.executable, "-m", "netrep_tpu", "serve",
+        "--socket", sock, "--journal", jpath,
+        "--checkpoint-dir", os.path.join(fleet_dir, "ckpt"),
+        "--chunk", str(args.chunk),
+        "--checkpoint-every", str(getattr(args, "checkpoint_every", 4096)),
+        "--drain-timeout", str(args.drain_timeout),
+        "--telemetry", os.path.join(rdir, f"tel{suffix}.jsonl"),
+        "--fleet-label", rid,
+    ]
+    if args.n_perm:
+        cmd += ["--n-perm", str(args.n_perm)]
+    if args.brownout_enter_s is not None:
+        cmd += ["--brownout-enter-s", str(args.brownout_enter_s)]
+    env = {k: v for k, v in os.environ.items()
+           if k != "NETREP_FAULT_PLAN"}
+    env.setdefault("JAX_PLATFORMS",
+                   os.environ.get("JAX_PLATFORMS", "") or "cpu")
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL, env=env)
+    return DaemonReplica(rid, sock, jpath, proc=proc)
+
+
+def _wait_socket(rep: DaemonReplica, budget_s: float = 180.0) -> bool:
+    deadline = time.monotonic() + budget_s
+    while not os.path.exists(rep.socket_path):
+        if (time.monotonic() > deadline
+                or (rep.proc is not None
+                    and rep.proc.poll() is not None)):
+            return False
+        time.sleep(0.1)
+    return True
+
+
+def dispatch_fleet_op(coord: FleetCoordinator, op: dict,
+                      stop: threading.Event,
+                      route_mode: str = "proxy") -> dict:
+    """Execute one wire op against the coordinator. Registrations
+    broadcast; ``analyze`` routes by the ring and PROXIES the op
+    verbatim (idempotency keys and trace ids pass through unchanged) —
+    or, under ``route_mode='redirect'``, answers with a ``redirect``
+    hint naming the home replica's socket so the client takes its data
+    plane there directly. Never raises."""
+    from .server import _malformed
+
+    if not isinstance(op, dict):
+        return _malformed(coord, f"op must be a JSON object, "
+                                 f"got {type(op).__name__}")
+    try:
+        kind = op.get("op")
+        if kind == "ping":
+            return {"ok": True, "pong": True, "fleet": True,
+                    "replicas": sorted(coord.live_replicas())}
+        if kind == "stats":
+            return {"ok": True, "stats": coord.stats()}
+        if kind == "metrics":
+            return {"ok": True, "text": coord.metrics_text()}
+        if kind == "shutdown":
+            stop.set()
+            return {"ok": True, "draining": True}
+        if kind in ("register", "register_fixture"):
+            resp = None
+            for rid, rep in sorted(coord.live_replicas().items()):
+                fwd = getattr(rep, "forward", None)
+                if fwd is None:
+                    return {"ok": False, "error": "raw broadcast needs "
+                                                  "daemon replicas"}
+                resp = fwd(op)
+                if not resp.get("ok", False):
+                    return resp
+            if resp is None:
+                return {"ok": False, "error": "no live replicas"}
+            if kind == "register" and resp.get("digest"):
+                coord.note_digest(str(op.get("tenant")),
+                                  str(op.get("name")),
+                                  str(resp["digest"]))
+            return resp
+        if kind == "analyze":
+            op.setdefault("idempotency_key",
+                          f"f-{uuid.uuid4().hex[:16]}")
+            try:
+                coord.admit(extra_perms=int(op.get("n_perm") or 0))
+            except QueueFull as e:
+                resp = {"ok": False, "error": f"QueueFull: {e}",
+                        "retryable": True}
+                if e.retry_after_s is not None:
+                    resp["retry_after_s"] = float(e.retry_after_s)
+                return resp
+            for _hop in range(8):   # bounded: re-routes per failover
+                rep = coord.route(str(op.get("tenant")),
+                                  str(op.get("discovery")),
+                                  op.get("test"))
+                if rep is None:
+                    return {"ok": False, "error": "fleet has no live "
+                                                  "replicas"}
+                if (route_mode == "redirect"
+                        and getattr(rep, "socket_path", None)):
+                    # data-plane redirect: the client re-sends the SAME
+                    # op (same key, same trace) straight to the home
+                    # replica — the coordinator stays off the hot path
+                    return {"ok": False, "retryable": True,
+                            "redirect": rep.socket_path}
+                fwd = getattr(rep, "forward", None)
+                if fwd is None:
+                    return {"ok": False,
+                            "error": "proxy needs daemon replicas"}
+                try:
+                    return fwd(op)
+                except (OSError, ConnectionError, ValueError):
+                    coord.await_failover(rep.rid)
+                    continue
+            return {"ok": False, "retryable": True,
+                    "error": "request kept losing its replica; retry",
+                    "retry_after_s": 1.0}
+        return _malformed(coord, f"unknown op {kind!r}")
+    except (ServeError, TimeoutError, KeyError, TypeError,
+            ValueError) as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    # netrep: allow(exception-taxonomy) — wire boundary, same contract as server.dispatch_op: one failed op becomes that client's error line, the coordinator keeps serving
+    except Exception as e:
+        return {"ok": False,
+                "error": f"internal error: {type(e).__name__}: {e}"}
+
+
+def fleet_daemon(args) -> int:
+    """CLI entry for ``python -m netrep_tpu serve --fleet N --socket
+    PATH``: spawn N replica daemons, run the coordinator on the main
+    socket, respawn failed replicas (fresh journal generation — the
+    peer already adopted the old one) unless ``--no-respawn``."""
+    import signal
+    import sys
+
+    if not args.socket:
+        print("serve --fleet needs --socket PATH (the coordinator "
+              "socket)", file=sys.stderr)
+        return 2
+    if args.no_journal:
+        print("serve --fleet requires journaling (the failover story "
+              "IS the journal); drop --no-journal", file=sys.stderr)
+        return 2
+    fleet_dir = args.fleet_dir or (args.socket + ".fleet")
+    os.makedirs(os.path.join(fleet_dir, "ckpt"), exist_ok=True)
+    os.makedirs(os.path.join(fleet_dir, "ship"), exist_ok=True)
+
+    # the injected fault plan (drills) reaches EXACTLY ONE replica: the
+    # coordinator and the other replicas must run clean
+    plan = os.environ.get("NETREP_FAULT_PLAN")
+    plan_replica = os.environ.get("NETREP_FLEET_FAULT_REPLICA")
+    replicas = []
+    for i in range(int(args.fleet)):
+        extra = {}
+        if plan and plan_replica is not None and str(i) == plan_replica:
+            extra["NETREP_FAULT_PLAN"] = plan
+        replicas.append(spawn_replica_daemon(f"r{i}", fleet_dir, args,
+                                             env_extra=extra))
+    for rep in replicas:
+        if not _wait_socket(rep):
+            print(f"fleet replica {rep.rid} never opened its socket",
+                  file=sys.stderr)
+            for r in replicas:
+                r.close(drain=False, timeout=5)
+            return 1
+
+    coord = FleetCoordinator(replicas, FleetConfig(
+        heartbeat_s=args.heartbeat_s,
+        ship_interval_s=args.ship_interval_s,
+        fleet_dir=fleet_dir,
+        telemetry=args.telemetry,
+        brownout_enter_s=args.fleet_brownout_enter_s,
+        rate_pps=args.brownout_rate,
+        drain_timeout_s=args.drain_timeout,
+    ))
+    generations = {rep.rid: 0 for rep in replicas}
+
+    if not args.no_respawn:
+        def respawn(rid, _peer):
+            base = rid.split(".", 1)[0]
+            generations[base] = generations.get(base, 0) + 1
+            fresh = spawn_replica_daemon(
+                f"{base}.g{generations[base]}",   # r0 -> r0.g1, r0.g2 ...
+                fleet_dir, args, generation=generations[base],
+            )
+            if _wait_socket(fresh, budget_s=120.0):
+                coord.join(fresh)
+            else:
+                logger.warning("fleet respawn of %s never came up", rid)
+
+        coord.on_failover = respawn
+
+    stop = threading.Event()
+
+    def _drain_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _drain_signal)
+    signal.signal(signal.SIGINT, _drain_signal)
+
+    from .server import read_op_line
+
+    path = args.socket
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+    listener = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+    listener.bind(path)
+    listener.listen(64)
+    listener.settimeout(0.25)
+    print(json.dumps({
+        "serve": "ready", "fleet": int(args.fleet), "socket": path,
+        "pid": os.getpid(), "fleet_dir": fleet_dir,
+        "replicas": {r.rid: r.socket_path for r in replicas},
+    }), flush=True)
+
+    def handle(conn):
+        with conn:
+            rfile = conn.makefile("r", encoding="utf-8")
+            while True:
+                op, resp = read_op_line(rfile, coord)
+                if op is None and resp is None:
+                    return
+                if resp is not None and resp.get("empty"):
+                    continue
+                if resp is None:
+                    resp = dispatch_fleet_op(coord, op, stop,
+                                             route_mode=args.fleet_route)
+                try:
+                    conn.sendall(
+                        (json.dumps(resp) + "\n").encode("utf-8"))
+                except OSError:
+                    return
+                if stop.is_set():
+                    return
+
+    try:
+        while not stop.is_set():
+            try:
+                conn, _ = listener.accept()
+            except _socket.timeout:
+                continue
+            threading.Thread(target=handle, args=(conn,),
+                             daemon=True).start()
+    finally:
+        listener.close()
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    coord.close(drain=True)
+    print(json.dumps({"serve": "fleet_drained",
+                      "replicas": sorted(generations)}), flush=True)
+    return 0

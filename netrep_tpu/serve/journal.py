@@ -187,6 +187,131 @@ def scan(path: str) -> dict:
     }
 
 
+class JournalShipper:
+    """Continuous journal replication to a designated peer (ISSUE 14
+    ``serve --fleet``): a daemon thread tails the source journal and
+    appends every newly-fsynced COMPLETE line to the peer's copy
+    (``dest_path``), fsyncing the copy before advancing the acked
+    offset — so the shipped copy is itself a valid journal a failover
+    replays with the ordinary ``scan()``/recovery machinery.
+
+    Protocol details the fleet contract depends on:
+
+    - **segment tailing with acked offsets**: each pass reads from the
+      last acked byte offset to EOF and ships only up to the last
+      newline — a torn in-flight line (the crash signature the reader
+      already tolerates) is left for the next pass, so the copy never
+      contains a record the source had not durably finished;
+    - **ack = fsynced at the peer**: the offset only advances after the
+      copy's ``fsync`` returns, and it is persisted to a sidecar
+      (``<dest>.offset``) so shipping resumes — never re-ships, never
+      skips — across a shipper (or coordinator) restart;
+    - **telemetry**: each pass that moves data emits ``journal_shipped``
+      (replica, records, bytes, offset) on the coordinator's bus — the
+      per-replica section of ``telemetry``/``top`` folds these.
+
+    A missing source file (replica not booted yet) is simply "nothing to
+    ship". The thread is owned by the fleet coordinator; ``flush()`` is
+    the synchronous one-pass entry the failover path (and tests) call
+    directly."""
+
+    def __init__(self, src_path: str, dest_path: str, *,
+                 interval_s: float = 0.2, replica: str | None = None,
+                 telemetry=None):
+        self.src_path = os.fspath(src_path)
+        self.dest_path = os.fspath(dest_path)
+        self.interval_s = float(interval_s)
+        self.replica = replica
+        self.tel = telemetry
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        d = os.path.dirname(os.path.abspath(self.dest_path))
+        os.makedirs(d, exist_ok=True)
+        self._offset_path = self.dest_path + ".offset"
+        self._offset = self._load_offset()
+
+    def _load_offset(self) -> int:
+        try:
+            with open(self._offset_path, encoding="utf-8") as f:
+                return max(0, int(f.read().strip() or 0))
+        except (OSError, ValueError):
+            return 0
+
+    @property
+    def acked_offset(self) -> int:
+        with self._lock:
+            return self._offset
+
+    def flush(self) -> int:
+        """One synchronous ship pass; returns the bytes moved. Reads the
+        source from the acked offset, ships complete lines only, fsyncs
+        the copy, then persists the new offset (crash between fsync and
+        offset write re-ships — ``scan()`` folds duplicate records to the
+        same state, so re-shipping is safe; skipping would not be)."""
+        with self._lock:
+            return self._ship_locked()
+
+    def _ship_locked(self) -> int:
+        try:
+            with open(self.src_path, "rb") as src:
+                src.seek(self._offset)
+                chunk = src.read()
+        except OSError:
+            return 0      # source not there yet / unreadable: next pass
+        if not chunk:
+            return 0
+        cut = chunk.rfind(b"\n")
+        if cut < 0:
+            return 0      # only a torn in-flight line so far
+        chunk = chunk[: cut + 1]
+        try:
+            with open(self.dest_path, "ab") as dst:
+                dst.write(chunk)
+                dst.flush()
+                os.fsync(dst.fileno())
+            self._offset += len(chunk)
+            with open(self._offset_path, "w", encoding="utf-8") as f:
+                f.write(str(self._offset))
+        except OSError as e:
+            logger.warning("journal shipper %s -> %s failed: %s",
+                           self.src_path, self.dest_path, e)
+            return 0
+        if self.tel is not None:
+            self.tel.emit(
+                "journal_shipped", replica=self.replica,
+                records=chunk.count(b"\n"), bytes=len(chunk),
+                offset=self._offset,
+            )
+        return len(chunk)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            with self._lock:
+                self._ship_locked()
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=self._loop, name="netrep-journal-shipper",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def stop(self, final_flush: bool = True) -> None:
+        """Stop the tailing thread (joined), optionally running one last
+        ship pass so everything fsynced at the source is on the copy."""
+        self._stop.set()
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+        if final_flush:
+            self.flush()
+
+
 def pack_checkpoint_path(ckpt_dir: str, cfg_id: str, members) -> str:
     """Deterministic per-pack checkpoint path: a digest of the member
     requests' durable identities (journal key, seed, n_perm, plan

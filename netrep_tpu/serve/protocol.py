@@ -32,7 +32,17 @@ definition)::
                        boundaries with ``request_expired``)
     metrics            Prometheus text exposition (the /metrics surface)
     stats              queue/pool/tenant counters as JSON
+    adopt_journal      fleet failover (ISSUE 14): replay a dead peer's
+                       shipped journal copy into this live replica —
+                       datasets re-register, completed results answer
+                       duplicates, unfinished requests re-queue
     shutdown           initiate the graceful drain (same path as SIGTERM)
+
+Fleet responses (ISSUE 14): a coordinator under ``--fleet-route
+redirect`` may answer an ``analyze`` with ``{"ok": false, "retryable":
+true, "redirect": "<replica socket>"}`` — the client re-sends the SAME
+op (same idempotency key, same trace id) to the named socket
+immediately; ``retry_after_s`` keeps its usual back-off meaning.
 """
 
 from __future__ import annotations

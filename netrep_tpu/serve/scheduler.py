@@ -176,6 +176,14 @@ class ServeConfig:
     slo_budget: float = 0.01
     #: sliding window (seconds) the burn rate is computed over
     slo_window_s: float = 300.0
+    # -- fleet serving (ISSUE 14) ---------------------------------------
+    #: replica identity inside a ``serve --fleet`` deployment (e.g.
+    #: ``"r0"``). When set, the replica's FIRST completed pack records
+    #: its cold-start compile span to the perf ledger under a
+    #: fleet-labeled fingerprint (``serve-fleet-coldstart|<label>|...``)
+    #: — the measured baseline the ROADMAP's AOT warm-start goal has to
+    #: beat. None = stand-alone server, nothing recorded.
+    fleet_label: str | None = None
 
 
 @dataclasses.dataclass
@@ -291,6 +299,8 @@ class PreservationServer:
         #: completed keys in retirement order (bounds the map's memory)
         self._idem_done: list[str] = []
         self._replaying = False
+        self._adopting = False
+        self._coldstart_done = False
         self._fixture_depth = 0
         self._last_drain_requeued = 0
         self._brownout = False
@@ -399,13 +409,38 @@ class PreservationServer:
         accepted-but-unfinished request in original ``seq`` order —
         combined with the per-pack checkpoints, a killed server resumes
         to results bit-identical to an uninterrupted one."""
+        self._replay_journal(self.config.journal, quiet=True)
+
+    def adopt_journal(self, path: str) -> dict | None:
+        """Replay a FOREIGN journal into this live server — the fleet
+        failover path (ISSUE 14): the coordinator hands the survivor its
+        dead peer's shipped journal copy, and the survivor re-registers
+        the peer's tenants/datasets, loads its completed results into
+        the idempotency map, and re-queues its unfinished requests.
+
+        Unlike boot recovery, the adopted records are NOT already in
+        this server's own journal, so re-queued requests go through the
+        ordinary journaling path (``quiet=False``): each adopted pending
+        request lands as a fresh fsynced ``accepted`` record here —
+        durable against a second failure. Admission bounds are bypassed
+        like boot recovery (the work was admitted once, on the peer).
+        Completed results stay in the in-memory map only; a duplicate
+        arriving after yet another restart recomputes, deterministically,
+        to the same answer. Returns the replay summary (or None when the
+        journal does not exist)."""
+        return self._replay_journal(path, quiet=False)
+
+    def _replay_journal(self, path: str, *, quiet: bool) -> dict | None:
+        """Shared journal-replay core of ``--recover`` (``quiet=True``:
+        the records already live in our own journal — do not re-journal)
+        and :meth:`adopt_journal` (``quiet=False``)."""
         from .protocol import decode_arrays
 
-        path = self.config.journal
-        if not os.path.exists(path):
-            return
+        if not path or not os.path.exists(path):
+            return None
         state = jnl.scan(path)
-        self._replaying = True
+        self._replaying = quiet
+        self._adopting = not quiet
         try:
             for name, weight in state["tenants"].items():
                 self.register_tenant(name, weight)
@@ -482,15 +517,20 @@ class PreservationServer:
                         )
         finally:
             self._replaying = False
+            self._adopting = False
+        summary = {
+            "tenants": len(state["tenants"]),
+            "datasets": len(state["datasets"]),
+            "results": len(state["results"]),
+            "failed": len(state["failed"]),
+            "requeued": requeued,
+        }
         if self.tel is not None:
             self.tel.emit(
                 "journal_replayed", parent=self._serve_sid,
-                tenants=len(state["tenants"]),
-                datasets=len(state["datasets"]),
-                results=len(state["results"]),
-                failed=len(state["failed"]),
-                requeued=requeued,
+                adopted=not quiet, **summary,
             )
+        return summary
 
     @staticmethod
     def _terminal_request(key: str, rec: dict, acc: dict) -> Request:
@@ -875,7 +915,7 @@ class PreservationServer:
             est = self._drain_estimate_locked(extra_perms=plan_np)
             brown = self._update_brownout_locked(est)
             retry_after = round(est, 3) if est is not None else None
-            if brown and not self._replaying:
+            if brown and not (self._replaying or self._adopting):
                 # predictable shedding: the NEWEST request of the
                 # lowest-weight tenants is refused first, with a drain-
                 # time hint — heavier tenants keep their priority
@@ -895,7 +935,7 @@ class PreservationServer:
                         retry_after_s=retry_after,
                     )
             if (len(ten.pending) >= self.config.max_queue
-                    and not self._replaying):
+                    and not (self._replaying or self._adopting)):
                 # (replayed requests were admitted once — the journal's
                 # accepted records re-queue past the bound by design)
                 ten.counters["rejected"] += 1
@@ -912,7 +952,7 @@ class PreservationServer:
                     retry_after_s=retry_after,
                 )
             if (brown and self.config.brownout_nperm_cap is not None
-                    and not self._replaying):
+                    and not (self._replaying or self._adopting)):
                 # opt-in graceful degradation: browned-out admissions run
                 # at a capped budget (documented to change results)
                 cap = int(self.config.brownout_nperm_cap)
@@ -1347,6 +1387,42 @@ class PreservationServer:
             if tel_cm is not None:
                 tel_cm.__exit__(None, None, None)
 
+    def _maybe_record_coldstart(self, results, wall_s: float,
+                                perms_done: int) -> None:
+        """Fleet cold-start baseline (ISSUE 14 satellite): a fleet
+        replica's FIRST completed pack records its compile span to the
+        perf ledger under a fleet-labeled fingerprint — the measured
+        number the still-open AOT warm-start goal (ROADMAP item 1) has
+        to beat (``compile_s → ~0 on first request`` is its pinned
+        proof). Env-gated like every ledger writer; stand-alone servers
+        (no ``fleet_label``) record nothing."""
+        if not self.config.fleet_label or self._coldstart_done:
+            return
+        self._coldstart_done = True
+        if not os.environ.get("NETREP_PERF_LEDGER"):
+            return
+        compile_s = None
+        for res in results:
+            cost = res.get("cost")
+            if cost and cost.get("pack_totals"):
+                compile_s = float(
+                    cost["pack_totals"].get("compile_s_amortized", 0.0)
+                )
+                break
+        import jax
+
+        from ..utils import perfledger
+
+        backend = jax.default_backend()
+        label = self.config.fleet_label
+        perfledger.append_entry(perfledger.make_entry(
+            f"serve-fleet-coldstart|{label}|{backend}",
+            perms_done / wall_s if wall_s > 0 else 0.0,
+            "serve", backend=backend, mode="fleet-coldstart",
+            compile_s=compile_s, n_perm=perms_done,
+            metric=f"serve-fleet coldstart {label}",
+        ))
+
     def _pool_key(self, kind: str, digests: tuple, plans) -> tuple:
         return (kind, digests, self._engine_cfg_id,
                 tuple(p.signature() for p in plans))
@@ -1424,11 +1500,11 @@ class PreservationServer:
                 os.unlink(ckpt_path)
             except OSError:
                 pass
-        self._account_pack_locked(
-            time.perf_counter() - t0,
-            sum(int(res.get("completed", 0)) for res in results
-                if not res.get("expired")),
-        )
+        wall_s = time.perf_counter() - t0
+        perms_done = sum(int(res.get("completed", 0)) for res in results
+                         if not res.get("expired"))
+        self._account_pack_locked(wall_s, perms_done)
+        self._maybe_record_coldstart(results, wall_s, perms_done)
         for r, res in zip(batch, results):
             if res.get("expired"):
                 self._expire(r, res["deadline_miss_s"],
@@ -1575,6 +1651,17 @@ class PreservationServer:
                 "inflight": self._inflight,
                 "accepting": self._accepting,
                 "brownout": self._brownout,
+                # fleet-admission inputs (ISSUE 14): the coordinator
+                # aggregates these across replicas to make brownout/shed
+                # decisions fleet-wide — queued permutation backlog plus
+                # this replica's steady-state rate estimate (measured,
+                # else the shared perf ledger's serve history)
+                "backlog_perms": sum(
+                    self._req_nperm(r)
+                    for t in self._tenants.values() for r in t.pending
+                ),
+                "rate_pps": self._rate_pps(),
+                "fleet_label": self.config.fleet_label,
                 "journal": self.config.journal,
                 "pool": self.pool.stats(),
                 "packs": self._pack_seq,

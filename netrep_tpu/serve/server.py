@@ -36,6 +36,8 @@ Ops::
      "deadline_s": 30.0, "idempotency_key": "client-chosen"}
     {"op": "metrics"}   → Prometheus text exposition
     {"op": "stats"}
+    {"op": "adopt_journal", "path": "..."}  → fleet failover (ISSUE 14):
+                          replay a dead peer's shipped journal copy
     {"op": "shutdown"}  → initiates the same drain as SIGTERM
 
 A rejected admission (queue full / brownout shedding) answers
@@ -128,6 +130,13 @@ def dispatch_op(server: PreservationServer, op: dict,
                 timeout=float(op.get("timeout", 600.0)), **kw,
             )
             return {"ok": True, "result": encode_arrays(result)}
+        if kind == "adopt_journal":
+            # fleet failover (ISSUE 14): the coordinator hands this
+            # replica its dead peer's shipped journal copy — replay it
+            # into the live server (register datasets, answer duplicates
+            # from journaled results, re-queue unfinished requests)
+            summary = server.adopt_journal(str(op["path"]))
+            return {"ok": True, "adopted": summary}
         if kind == "metrics":
             return {"ok": True, "text": server.metrics_text()}
         if kind == "stats":
@@ -228,6 +237,7 @@ def serve_daemon(args) -> int:
         brownout_enter_s=args.brownout_enter_s,
         brownout_exit_s=args.brownout_exit_s,
         brownout_rate_pps=args.brownout_rate,
+        fleet_label=getattr(args, "fleet_label", None),
     )
     server = PreservationServer(cfg)
     stop = threading.Event()

@@ -62,7 +62,7 @@ def snapshot(stats: dict) -> dict:
             "bytes_to_host": int(cost.get("bytes_to_host", 0)),
             "burn_rate": float(t.get("burn_rate", 0.0)),
         })
-    return {
+    snap = {
         "tenants": rows,
         "brownout": bool(stats.get("brownout", False)),
         "accepting": bool(stats.get("accepting", True)),
@@ -72,6 +72,24 @@ def snapshot(stats: dict) -> dict:
         "slo_s": stats.get("slo_s"),
         "slo_budget": stats.get("slo_budget"),
     }
+    # fleet coordinator stats (ISSUE 14): one row per replica — alive,
+    # queue/backlog, rate, packs — rendered as its own table section
+    if stats.get("replicas"):
+        snap["fleet"] = True
+        snap["replicas"] = [
+            {
+                "replica": rid,
+                "alive": bool(r.get("alive", False)),
+                "queue_depth": int(r.get("queue_depth", 0) or 0),
+                "backlog_perms": int(r.get("backlog_perms", 0) or 0),
+                "rate_pps": r.get("rate_pps"),
+                "packs": int(r.get("packs", 0) or 0),
+                "done": int(r.get("done", 0) or 0),
+                "brownout": bool(r.get("brownout", False)),
+            }
+            for rid, r in sorted(stats["replicas"].items())
+        ]
+    return snap
 
 
 def render_tenant_table(rows: list[dict]) -> str:
@@ -97,6 +115,43 @@ def render_tenant_table(rows: list[dict]) -> str:
     return "\n".join(out)
 
 
+#: per-replica table columns (fleet dashboards, ISSUE 14)
+_REPLICA_COLUMNS = (
+    ("replica", 10, "replica", "s"),
+    ("up", 4, "up", "s"),
+    ("q", 4, "queue_depth", "d"),
+    ("backlog", 8, "backlog_perms", "d"),
+    ("rate/s", 9, "rate_pps", ".1f"),
+    ("packs", 6, "packs", "d"),
+    ("done", 6, "done", "d"),
+)
+
+
+def render_replica_table(rows: list[dict]) -> str:
+    """The fleet's per-replica section: one row per replica over the
+    :data:`_REPLICA_COLUMNS` schema (``up`` collapses alive/brownout
+    into ``yes``/``brn``/``DEAD``)."""
+    out = []
+    out.append("  ".join(
+        f"{h:>{w}}" if fmt != "s" else f"{h:<{w}}"
+        for h, w, _k, fmt in _REPLICA_COLUMNS
+    ))
+    for r in rows:
+        state = ("DEAD" if not r.get("alive")
+                 else "brn" if r.get("brownout") else "yes")
+        cells = []
+        for _h, w, k, fmt in _REPLICA_COLUMNS:
+            v = state if k == "up" else r.get(k)
+            if fmt == "s":
+                cells.append(f"{str(v):<{w}}")
+            elif v is None:
+                cells.append(f"{'-':>{w}}")
+            else:
+                cells.append(f"{v:>{w}{fmt}}")
+        out.append("  ".join(cells))
+    return "\n".join(out)
+
+
 def render(snap: dict) -> str:
     """One dashboard frame."""
     state = []
@@ -104,16 +159,22 @@ def render(snap: dict) -> str:
     if not snap["accepting"]:
         state.append("draining")
     head = (
-        f"netrep serve · up {snap['uptime_s']:.0f}s · "
+        f"netrep serve{' fleet' if snap.get('fleet') else ''} · "
+        f"up {snap['uptime_s']:.0f}s · "
         f"inflight {snap['inflight']} · packs {snap['packs']} · "
         f"state {'/'.join(state)}"
     )
     if snap.get("slo_s") is not None:
         head += (f" · slo {snap['slo_s']:g}s "
                  f"(budget {snap.get('slo_budget', 0):g})")
-    if not snap["tenants"]:
-        return head + "\n(no tenants registered)"
-    return head + "\n" + render_tenant_table(snap["tenants"])
+    parts = [head]
+    if snap.get("replicas"):
+        parts.append(render_replica_table(snap["replicas"]))
+    if snap["tenants"]:
+        parts.append(render_tenant_table(snap["tenants"]))
+    elif not snap.get("replicas"):
+        parts.append("(no tenants registered)")
+    return "\n".join(parts)
 
 
 def run_top(args) -> int:

@@ -151,6 +151,31 @@ SERVE_EVENTS = (
     "request_cost",
 )
 
+#: fleet-serving event names (ISSUE 14 ``serve --fleet``) — the replica
+#: lifecycle the coordinator emits, each carrying a ``replica`` label in
+#: ``data``. Pinned beside :data:`SERVE_EVENTS` for the same reason: the
+#: CLI's per-replica section, ``chaos --fleet``'s timeline, and fleet
+#: dashboards key on these names, and the ``telemetry-registry`` lint
+#: rule enforces membership statically.
+FLEET_EVENTS = (
+    #: a replica entered the hash ring (boot, join, or respawn)
+    "replica_joined",
+    #: the health loop declared a replica dead (missed heartbeats /
+    #: worker exit) — always followed by a failover pair
+    "replica_lost",
+    #: the journal shipper moved newly-fsynced records to the designated
+    #: peer's copy and advanced the acked offset
+    "journal_shipped",
+    #: failover began: the dead replica's shipped journal is about to be
+    #: replayed into its peer (``failover_done.s`` = the measured
+    #: failover time the drill and the ``--recovery`` timeline report)
+    "failover_start",
+    "failover_done",
+    #: the consistent-hash ring changed (join or leave): placement moved
+    #: for the departed/arrived replica's keys ONLY — never a recompute
+    "ring_rebalanced",
+)
+
 #: pinned latency histogram bucket upper bounds (seconds) for the
 #: per-tenant serving series (``netrep_serve_latency_seconds`` in
 #: ``metrics_text()``; a final +Inf bucket is implicit). Changing these
@@ -288,7 +313,8 @@ SPAN_EVENTS = (
 #: the union the ``telemetry-registry`` lint rule checks literal event
 #: names against — every registry above, nothing else
 KNOWN_EVENTS = frozenset(
-    ENGINE_EVENTS + RECOVERY_EVENTS + SERVE_EVENTS + SPAN_EVENTS
+    ENGINE_EVENTS + RECOVERY_EVENTS + SERVE_EVENTS + FLEET_EVENTS
+    + SPAN_EVENTS
 )
 
 
@@ -1060,17 +1086,77 @@ def format_event(e: dict, t0: float | None = None) -> str:
 def render_recovery(path: str) -> str:
     """Chronological timeline of a run's recovery decisions (the
     ``python -m netrep_tpu telemetry --recovery`` view): every
-    :data:`RECOVERY_EVENTS` line with its offset from the first event in
-    the file, so "what did the run survive, and in what order" reads
-    straight off one screen. Empty string when the run never recovered
-    from anything."""
+    :data:`RECOVERY_EVENTS` — and, for fleet logs, :data:`FLEET_EVENTS`
+    (a replica loss + failover IS a recovery decision; ``failover_done``
+    carries the measured failover time as ``s``) — line with its offset
+    from the first event in the file, so "what did the run survive, and
+    in what order" reads straight off one screen. Empty string when the
+    run never recovered from anything."""
     lines = []
     t0 = None
     for e in read_events(path):
         if t0 is None:
             t0 = e["t"]
-        if e["ev"] not in RECOVERY_EVENTS:
+        if (e["ev"] not in RECOVERY_EVENTS
+                and e["ev"] not in FLEET_EVENTS):
             continue
         data = " ".join(f"{k}={v}" for k, v in e["data"].items())
         lines.append(f"+{e['t'] - t0:9.2f}s  {e['ev']:<24} {data}")
     return "\n".join(lines)
+
+
+def replica_summary(events: Iterable[dict]) -> dict[str, dict]:
+    """Per-replica aggregation of the fleet events (:data:`FLEET_EVENTS`)
+    — the offline twin of the fleet coordinator's live per-replica rows,
+    keyed on the ``replica`` label every fleet event carries: joins,
+    losses, shipped records/bytes, and failovers (count + total measured
+    seconds from ``failover_done.s``)."""
+    out: dict[str, dict] = {}
+    for e in events:
+        ev = e.get("ev")
+        if ev not in FLEET_EVENTS:
+            continue
+        data = e.get("data", {})
+        rid = data.get("replica")
+        if rid is None:
+            continue
+        row = out.setdefault(str(rid), {
+            "joined": 0, "lost": 0, "shipped_records": 0,
+            "shipped_bytes": 0, "failovers": 0, "failover_s": 0.0,
+        })
+        if ev == "replica_joined":
+            row["joined"] += 1
+        elif ev == "replica_lost":
+            row["lost"] += 1
+        elif ev == "journal_shipped":
+            row["shipped_records"] += int(data.get("records", 0) or 0)
+            row["shipped_bytes"] += int(data.get("bytes", 0) or 0)
+        elif ev == "failover_done":
+            row["failovers"] += 1
+            if _is_number(data.get("s")):
+                row["failover_s"] += float(data["s"])
+    return out
+
+
+def render_replicas(path: str) -> str:
+    """Per-replica fleet section of the CLI report (`python -m netrep_tpu
+    telemetry <run.jsonl>`), printed beside the per-tenant section for
+    logs written by a fleet coordinator. Empty string for logs without
+    fleet events."""
+    rows = replica_summary(read_events(path))
+    if not rows:
+        return ""
+    out = ["replicas:"]
+    w = max(len(r) for r in rows)
+    out.append(
+        f"  {'':<{w}}  {'join':>5} {'lost':>5} {'ship_rec':>9} "
+        f"{'ship_B':>9} {'failover':>9} {'fo_s':>8}"
+    )
+    for rid in sorted(rows):
+        r = rows[rid]
+        out.append(
+            f"  {rid:<{w}}  {r['joined']:>5} {r['lost']:>5} "
+            f"{r['shipped_records']:>9} {r['shipped_bytes']:>9} "
+            f"{r['failovers']:>9} {r['failover_s']:>8.3f}"
+        )
+    return "\n".join(out)

@@ -131,6 +131,28 @@ def test_serve_kill_recover_smoke():
 
 
 @pytest.mark.slow
+def test_serve_fleet_smoke():
+    """The watcher's FLEET_DRILL load row (ISSUE 14): the mixed-tenant
+    workload through a 2-replica in-process fleet with a mid-run replica
+    kill — parity asserted in-bench; the row carries the `serve-fleet`
+    metric label (its own perf-ledger fingerprint class), p50/p99, the
+    measured failover time, and aggregate perms/s vs 1 replica."""
+    proc = _run_cpu_subprocess(
+        [sys.executable, "benchmarks/serve_load.py", "--smoke",
+         "--fleet", "2"],
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["metric"].startswith("serve-fleet")
+    assert row["replicas"] == 2
+    assert row["failover_s"] > 0            # the kill genuinely fired
+    assert row["perms_per_sec"] > 0 and row["perms_per_sec_1replica"] > 0
+    assert row["vs_1_replica"] > 0
+    assert row["p99_ms"] >= row["p50_ms"] > 0
+
+
+@pytest.mark.slow
 def test_bf16_drift_smoke():
     """The watcher's `bf16_drift` step at tiny shapes: one parseable JSON
     line with the per-statistic drift table."""

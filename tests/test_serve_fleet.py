@@ -1,0 +1,492 @@
+"""Fleet-serving tests (ISSUE 14) — CPU-only, in-process, tiny
+fixtures: hash-ring placement stability, journal-ship round-trip
+(including a torn final segment and offset resume), replica-kill
+failover with counts/p-values/adaptive decisions BIT-IDENTICAL to an
+undisturbed run (via the shipped journal + the SHARED checkpoint
+directory), idempotency dedup across failover (zero recompute),
+fleet-wide brownout admission from the aggregate backlog estimate,
+``SocketClient`` redirect-hint following, the fleet-labeled cold-start
+perf-ledger entry, and the per-replica ``top``/``telemetry``
+sections."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from netrep_tpu import module_preservation
+from netrep_tpu.data import make_mixed_pair
+from netrep_tpu.serve import (
+    FleetConfig, HashRing, InProcessClient, PreservationServer, QueueFull,
+    ServeConfig, build_inprocess_fleet,
+)
+from netrep_tpu.serve import journal as jnl
+from netrep_tpu.serve.journal import JournalShipper
+from netrep_tpu.utils.config import EngineConfig, FaultPolicy
+
+#: the ONE engine config fleet-served runs and their direct twins share
+CFG = EngineConfig(chunk_size=16, autotune=False)
+
+
+@pytest.fixture(scope="module")
+def fx():
+    mixed = make_mixed_pair(100, 3, n_samples=16, seed=7)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    assign = {f"node_{i}": "0" for i in range(dn.shape[0])}
+    for lab, idx in mixed["specs"]:
+        for i in idx:
+            assign[f"node_{i}"] = str(lab)
+    direct_kw = dict(
+        network={"d": dn, "t": tn}, correlation={"d": dc, "t": tc},
+        data={"d": dd, "t": td}, module_assignments=assign,
+        discovery="d", test="t", config=CFG,
+    )
+    return dict(dn=dn, dc=dc, dd=dd, tn=tn, tc=tc, td=td, assign=assign,
+                direct_kw=direct_kw)
+
+
+def direct(fx, **kw):
+    return module_preservation(**fx["direct_kw"], **kw)
+
+
+def read_events(path):
+    return [json.loads(l) for l in open(path, encoding="utf-8")]
+
+
+def make_fleet(fx, tmp_path, n=2, *, register=True, tel="coord",
+               heartbeat_s=0.1, fleet_config_kw=None, start_servers=True,
+               replica_tel=True):
+    """N-replica in-process fleet over the shared fixture pair, each
+    replica journaled + telemetry'd into ``tmp_path``."""
+    fc = FleetConfig(telemetry=str(tmp_path / f"{tel}.jsonl"),
+                     heartbeat_s=heartbeat_s,
+                     **(fleet_config_kw or {}))
+
+    def mk(rid, jpath, ckpt):
+        return ServeConfig(
+            engine=CFG, journal=jpath, checkpoint_dir=ckpt,
+            checkpoint_every=16, fleet_label=rid,
+            telemetry=(str(tmp_path / f"{rid}_tel.jsonl")
+                       if replica_tel else None),
+        )
+
+    fleet = build_inprocess_fleet(
+        n, str(tmp_path / "fleet"), make_config=mk, fleet_config=fc,
+        start_servers=start_servers,
+    )
+    if register:
+        fleet.register_dataset("a", "d", network=fx["dn"],
+                               correlation=fx["dc"], data=fx["dd"],
+                               assignments=fx["assign"])
+        fleet.register_dataset("a", "t", network=fx["tn"],
+                               correlation=fx["tc"], data=fx["td"])
+    return fleet
+
+
+# ---------------------------------------------------------------------------
+# hash ring
+# ---------------------------------------------------------------------------
+
+def test_hash_ring_stability_on_leave_and_join():
+    """The consistent-hashing contract: removing a replica remaps ONLY
+    the keys it owned; adding it back restores the exact original
+    placement. Placement is deterministic (no RNG)."""
+    ring = HashRing(vnodes=64)
+    for rid in ("r0", "r1", "r2"):
+        ring.add(rid)
+    keys = [f"digest-{i}" for i in range(1000)]
+    before = {k: ring.route(k) for k in keys}
+    assert set(before.values()) == {"r0", "r1", "r2"}  # all replicas used
+    ring.remove("r1")
+    after = {k: ring.route(k) for k in keys}
+    for k in keys:
+        if before[k] != "r1":
+            assert after[k] == before[k], "a surviving replica's key moved"
+        else:
+            assert after[k] in ("r0", "r2")
+    ring.add("r1")
+    assert {k: ring.route(k) for k in keys} == before  # exact restore
+    # determinism: a fresh ring with the same members places identically
+    ring2 = HashRing(vnodes=64)
+    for rid in ("r0", "r1", "r2"):
+        ring2.add(rid)
+    assert {k: ring2.route(k) for k in keys} == before
+
+
+def test_hash_ring_successor_is_a_distinct_live_peer():
+    ring = HashRing(vnodes=8)
+    ring.add("r0")
+    assert ring.successor("r0") is None          # nobody else to ship to
+    ring.add("r1")
+    assert ring.successor("r0") == "r1"
+    assert ring.successor("r1") == "r0"
+    assert ring.route("anything") in ("r0", "r1")
+
+
+# ---------------------------------------------------------------------------
+# journal shipping
+# ---------------------------------------------------------------------------
+
+def test_journal_ship_round_trip_with_torn_segment(tmp_path):
+    """The shipped copy is a valid journal: complete lines only, the
+    torn in-flight tail waits for its completion, the acked offset
+    persists across a shipper restart (re-ship never skips, never
+    duplicates)."""
+    src = str(tmp_path / "src.jsonl")
+    dst = str(tmp_path / "ship" / "src_copy.jsonl")
+    j = jnl.RequestJournal(src)
+    j.append("tenant", tenant="a", weight=1)
+    j.append("accepted", seq=1, id="r1", key="k1", tenant="a",
+             discovery="d", test="t", params={"n_perm": 64, "seed": 3})
+    shipper = JournalShipper(src, dst, replica="r0")
+    assert shipper.flush() > 0
+    # a torn in-flight line: NOT shipped until its newline lands
+    with open(src, "a", encoding="utf-8") as f:
+        f.write('{"jv": 1, "kind": "done", "seq": 1, "key": "k1"')
+        f.flush()
+    assert shipper.flush() == 0
+    state = jnl.scan(dst)
+    assert [r["key"] for r in state["pending"]] == ["k1"]
+    assert not state["results"]
+    # the line completes; a FRESH shipper resumes from the persisted
+    # offset and ships exactly the remainder
+    with open(src, "a", encoding="utf-8") as f:
+        f.write(', "result": {"p": 1}}\n')
+    resumed = JournalShipper(src, dst, replica="r0")
+    assert resumed.acked_offset == shipper.acked_offset
+    assert resumed.flush() > 0
+    state = jnl.scan(dst)
+    assert list(state["results"]) == ["k1"] and not state["pending"]
+    # byte-identical copy (the shipped journal IS the journal)
+    assert open(dst, "rb").read() == open(src, "rb").read()
+    j.close()
+
+
+def test_journal_shipper_emits_shipped_event(tmp_path):
+    from netrep_tpu.utils.telemetry import Telemetry
+
+    src = str(tmp_path / "src.jsonl")
+    tel_path = str(tmp_path / "tel.jsonl")
+    j = jnl.RequestJournal(src)
+    j.append("tenant", tenant="a", weight=1)
+    j.close()
+    tel = Telemetry(tel_path)
+    shipper = JournalShipper(src, str(tmp_path / "dst.jsonl"),
+                             replica="r7", telemetry=tel)
+    assert shipper.flush() > 0
+    tel.close()
+    ev = [e for e in read_events(tel_path)
+          if e["ev"] == "journal_shipped"]
+    assert ev and ev[0]["data"]["replica"] == "r7"
+    assert ev[0]["data"]["records"] == 1
+    assert ev[0]["data"]["bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# routing + parity (no faults)
+# ---------------------------------------------------------------------------
+
+def test_fleet_routes_deterministically_and_serves_bit_identical(
+        fx, tmp_path):
+    fleet = make_fleet(fx, tmp_path)
+    try:
+        home = fleet.route("a", "d", "t")
+        assert home is fleet.route("a", "d", "t")   # stable placement
+        res = fleet.analyze("a", "d", "t", n_perm=32, seed=3,
+                            timeout=600)
+        res2 = fleet.analyze("a", "d", "t", n_perm=32, seed=3,
+                             timeout=600)
+        st = fleet.stats()
+    finally:
+        fleet.close()
+    d = direct(fx, n_perm=32, seed=3)
+    np.testing.assert_array_equal(res["p_values"], np.asarray(d.p_values))
+    np.testing.assert_array_equal(res2["p_values"], res["p_values"])
+    # locality: both requests ran on the SAME replica (warm pool)
+    served_on = [rid for rid, row in st["replicas"].items()
+                 if row.get("packs")]
+    assert served_on == [home.rid]
+    # the top dashboard renders the per-replica section from these stats
+    from netrep_tpu.serve.top import render, snapshot
+
+    snap = snapshot(st)
+    assert snap["fleet"] and len(snap["replicas"]) == 2
+    assert {r["replica"] for r in snap["replicas"]} == {"r0", "r1"}
+    frame = render(snap)
+    assert "replica" in frame and "r0" in frame and "fleet" in frame
+
+
+# ---------------------------------------------------------------------------
+# replica-kill failover (the tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+def test_replica_kill_failover_bit_parity(fx, tmp_path):
+    """Mid-pack replica death (the in-process SIGKILL stand-in) → the
+    health loop fails the shipped journal over to the peer → every
+    request completes with counts/p-values/adaptive decisions
+    bit-identical to direct calls (= an undisturbed single-replica run,
+    by the PR 7 parity pin), the partial pack RESUMING from the shared
+    checkpoint directory rather than restarting."""
+    fleet = make_fleet(fx, tmp_path)
+    submits = [
+        ("k1", dict(n_perm=64, seed=3)),
+        ("k2", dict(n_perm=64, seed=5)),
+        ("k3", dict(n_perm=32, seed=11, adaptive=True)),
+    ]
+    try:
+        home = fleet.route("a", "d", "t")
+        peer_rid = [r for r in ("r0", "r1") if r != home.rid][0]
+        home.arm_fault_plan(FaultPolicy(plan="crash@24",
+                                        backoff_base_s=0.0,
+                                        backoff_jitter=0.0))
+        results = {}
+        errors = []
+
+        def worker(k, kw):
+            try:
+                results[k] = fleet.analyze("a", "d", "t",
+                                           idempotency_key=k,
+                                           timeout=600, **kw)
+            except Exception as e:   # surfaced after join
+                errors.append(f"{k}: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=worker, args=s, daemon=True)
+                   for s in submits]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        assert not errors, errors
+        st = fleet.stats()
+    finally:
+        fleet.close()
+    assert st["replicas"][home.rid] == {"alive": False}
+    assert st["replicas"][peer_rid]["done"] == 3
+    for k, kw in submits:
+        d = direct(fx, **kw)
+        np.testing.assert_array_equal(results[k]["observed"], d.observed)
+        np.testing.assert_array_equal(results[k]["p_values"],
+                                      np.asarray(d.p_values))
+        if kw.get("adaptive"):
+            np.testing.assert_array_equal(results[k]["n_perm_used"],
+                                          np.asarray(d.n_perm_used))
+    # the coordinator's event story: lost → failover pair (with the
+    # measured time) → ring rebalance, all labeled with the replica
+    ev = read_events(str(tmp_path / "coord.jsonl"))
+    fo = [e for e in ev if e["ev"] in
+          ("replica_lost", "failover_start", "failover_done",
+           "ring_rebalanced") and e["data"].get("reason") != "join"]
+    assert [e["ev"] for e in fo] == [
+        "replica_lost", "failover_start", "failover_done",
+        "ring_rebalanced",
+    ]
+    done = fo[2]["data"]
+    assert done["replica"] == home.rid and done["peer"] == peer_rid
+    assert done["s"] > 0 and done["requeued"] == 3
+    # the peer ADOPTED (journal_replayed) and RESUMED the partial pack
+    # from the shared checkpoint dir — recovery started mid-run
+    pe = read_events(str(tmp_path / f"{peer_rid}_tel.jsonl"))
+    replay = [e for e in pe if e["ev"] == "journal_replayed"]
+    assert replay and replay[0]["data"]["adopted"] is True
+    assert replay[0]["data"]["requeued"] == 3
+    resumed = [e for e in pe if e["ev"] == "checkpoint_resumed"]
+    assert resumed and resumed[0]["data"]["completed"] >= 16
+    # the fleet events render in the --recovery timeline (failover time
+    # included) and in the per-replica telemetry section
+    from netrep_tpu.utils.telemetry import render_recovery, render_replicas
+
+    timeline = render_recovery(str(tmp_path / "coord.jsonl"))
+    assert "failover_done" in timeline and "replica_lost" in timeline
+    section = render_replicas(str(tmp_path / "coord.jsonl"))
+    assert home.rid in section and "failover" in section
+
+
+def test_dedup_across_failover_never_recomputes(fx, tmp_path):
+    """A request COMPLETED before its replica died is answered from the
+    shipped journal on the peer — same numbers, zero packs dispatched on
+    the peer (the one-computation-per-idempotency-key contract crosses
+    the failover boundary)."""
+    fleet = make_fleet(fx, tmp_path)
+    try:
+        home = fleet.route("a", "d", "t")
+        peer_rid = [r for r in ("r0", "r1") if r != home.rid][0]
+        r1 = fleet.analyze("a", "d", "t", n_perm=32, seed=3,
+                           idempotency_key="K", timeout=600)
+        # the replica dies AFTER completing (clean worker exit is as
+        # dead as a SIGKILL to the health loop); the final ship pass
+        # carries its `done` record to the copy
+        home.server.close(drain=True)
+        assert fleet.await_failover(home.rid, timeout=60)
+        r2 = fleet.analyze("a", "d", "t", n_perm=32, seed=3,
+                           idempotency_key="K", timeout=60)
+        st = fleet.stats()
+    finally:
+        fleet.close()
+    np.testing.assert_array_equal(np.asarray(r1["p_values"]),
+                                  np.asarray(r2["p_values"]))
+    np.testing.assert_array_equal(np.asarray(r1["counts_hi"]),
+                                  np.asarray(r2["counts_hi"]))
+    assert st["replicas"][peer_rid]["packs"] == 0   # pure journal answer
+    assert st["tenants"]["a"]["deduped"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide admission
+# ---------------------------------------------------------------------------
+
+def test_fleet_admission_sheds_from_aggregate_estimate(fx, tmp_path):
+    """Brownout goes fleet-wide: the shed decision reads the AGGREGATE
+    backlog (summed across replicas) over the summed rate estimates —
+    and answers with the honest drain-time hint."""
+    fleet = make_fleet(
+        fx, tmp_path, start_servers=False,
+        fleet_config_kw=dict(brownout_enter_s=1.0, rate_pps=10.0),
+    )
+    try:
+        # backlog forms on the HOME replica only (workers never start);
+        # the estimate is still fleet-wide: 128 perms / (2 x 10 pps)
+        home = fleet.route("a", "d", "t")
+        for i in range(2):
+            home.server.submit("a", "d", "t", n_perm=64, seed=i)
+        est = fleet.drain_estimate()
+        assert est == pytest.approx(128 / 20.0)
+        with pytest.raises(QueueFull) as exc:
+            fleet.analyze("a", "d", "t", n_perm=64, seed=9, timeout=5)
+        assert exc.value.retry_after_s is not None
+        assert exc.value.retry_after_s > 0
+    finally:
+        fleet.close(drain=False)
+    ev = read_events(str(tmp_path / "coord.jsonl"))
+    enter = [e for e in ev if e["ev"] == "serve_brownout_enter"]
+    assert enter and enter[0]["data"]["fleet"] is True
+    assert enter[0]["data"]["est_drain_s"] > 1.0
+
+
+# ---------------------------------------------------------------------------
+# SocketClient redirect hints (satellite)
+# ---------------------------------------------------------------------------
+
+def _fake_daemon(path, respond, received):
+    """One-shot line-JSON unix-socket server for client-behavior tests."""
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    listener.bind(path)
+    listener.listen(4)
+
+    def serve():
+        while True:
+            try:
+                conn, _ = listener.accept()
+            except OSError:
+                return
+            with conn:
+                f = conn.makefile("r", encoding="utf-8")
+                while True:
+                    line = f.readline()
+                    if not line:
+                        break
+                    op = json.loads(line)
+                    received.append(op)
+                    resp = respond(op)
+                    conn.sendall(
+                        (json.dumps(resp) + "\n").encode("utf-8"))
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    return listener
+
+
+def test_socket_client_follows_redirect_under_one_key(tmp_path):
+    """The coordinator's ``redirect`` hint (``--fleet-route redirect``)
+    re-points the client at the named replica socket and re-sends the
+    SAME op immediately — same idempotency key, same trace id, no retry
+    attempt consumed."""
+    from netrep_tpu.serve.client import SocketClient
+
+    coord_path = str(tmp_path / "coord.sock")
+    replica_path = str(tmp_path / "replica.sock")
+    seen_coord, seen_replica = [], []
+    l1 = _fake_daemon(
+        coord_path,
+        lambda op: {"ok": False, "retryable": True,
+                    "redirect": replica_path},
+        seen_coord,
+    )
+    l2 = _fake_daemon(
+        replica_path,
+        lambda op: {"ok": True,
+                    "result": {"p_values": [0.5], "completed": 4}},
+        seen_replica,
+    )
+    try:
+        client = SocketClient(coord_path, timeout=30)
+        res = client.analyze("a", "d", "t", n_perm=4, seed=1, retries=0)
+        assert res["completed"] == 4
+        assert client.path == replica_path    # future ops go direct
+        client.close()
+    finally:
+        l1.close()
+        l2.close()
+    assert len(seen_coord) == 1 and len(seen_replica) == 1
+    # the redirected re-send is the SAME logical request
+    assert (seen_replica[0]["idempotency_key"]
+            == seen_coord[0]["idempotency_key"])
+    assert (seen_replica[0]["trace_ctx"]["trace"]
+            == seen_coord[0]["trace_ctx"]["trace"])
+
+
+# ---------------------------------------------------------------------------
+# cold-start perf-ledger fingerprint (satellite)
+# ---------------------------------------------------------------------------
+
+def test_fleet_replica_records_coldstart_ledger_entry(fx, tmp_path,
+                                                      monkeypatch):
+    """A fleet-labeled replica's FIRST completed pack lands a
+    ``serve-fleet-coldstart|<rid>|...`` perf-ledger entry carrying the
+    measured compile span — the baseline the AOT warm-start goal
+    (ROADMAP item 1) has to beat. One entry per replica boot; the
+    second pack records nothing new."""
+    from netrep_tpu.utils import perfledger
+
+    ledger = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("NETREP_PERF_LEDGER", ledger)
+    fleet = make_fleet(fx, tmp_path)
+    try:
+        fleet.analyze("a", "d", "t", n_perm=32, seed=3, timeout=600)
+        fleet.analyze("a", "d", "t", n_perm=32, seed=4, timeout=600)
+    finally:
+        fleet.close()
+    cold = [e for e in perfledger.read_entries(ledger)
+            if e["fingerprint"].startswith("serve-fleet-coldstart|")]
+    assert len(cold) == 1
+    e = cold[0]
+    assert e["mode"] == "fleet-coldstart" and e["source"] == "serve"
+    assert e["fingerprint"].split("|")[1] in ("r0", "r1")
+    assert e["compile_s"] is not None and e["compile_s"] >= 0
+    assert e["perms_per_sec"] > 0
+    assert e["metric"].startswith("serve-fleet coldstart")
+
+
+def test_standalone_server_records_no_coldstart(fx, tmp_path, monkeypatch):
+    from netrep_tpu.utils import perfledger
+
+    ledger = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("NETREP_PERF_LEDGER", ledger)
+    srv = PreservationServer(ServeConfig(engine=CFG))
+    client = InProcessClient(srv)
+    client.register_dataset("a", "d", network=fx["dn"],
+                            correlation=fx["dc"], data=fx["dd"],
+                            assignments=fx["assign"])
+    client.register_dataset("a", "t", network=fx["tn"],
+                            correlation=fx["tc"], data=fx["td"])
+    try:
+        client.analyze("a", "d", "t", n_perm=32, seed=3, timeout=600)
+    finally:
+        srv.close()
+    entries = (perfledger.read_entries(ledger)
+               if os.path.exists(ledger) else [])
+    assert not [e for e in entries
+                if e["fingerprint"].startswith("serve-fleet-coldstart")]

@@ -62,6 +62,15 @@ def test_classify_provenance_rules():
         ({"metric": "serve top snapshot", "value": 1, "unit": "snapshot",
           "top": {"tenants": [{"tenant": "drill", "burn_rate": 0.0}],
                   "brownout": False}}, "serve-top"),
+        # fleet drill rows (ISSUE 14): the kill-failover load row and
+        # the chaos --fleet verdict — robustness signals, CPU by design
+        ({"metric": "serve-fleet 2 replicas kill-failover (9 req, "
+                    "chunk 32)", "value": 5.3, "unit": "s",
+          "failover_s": 0.25, "vs_1_replica": 2.0,
+          "device": "TFRT_CPU_0"}, "serve-fleet"),
+        ({"replicas": 2, "requests": 3, "killed_replica": "r0",
+          "recovered": True, "bit_identical": True, "ok": True},
+         "serve-fleet"),
     ]
     for row, want in cases:
         assert classify(row) == want, (row, classify(row), want)
@@ -83,6 +92,25 @@ def test_serve_cost_section_renders(tmp_path, capsys=None):
     assert "serve-cost per-tenant attributed" in text
     assert "alpha: device_s=0.28 perms=256" in text
     assert "brownout=False" in text and "drill=0.5" in text
+
+
+def test_fleet_section_renders():
+    """ISSUE 14: the fleet-drill section shows the newest kill-failover
+    load row (failover time, vs-1-replica) and the newest chaos --fleet
+    verdict."""
+    rows = [
+        {"metric": "serve-fleet 2 replicas kill-failover (9 req, "
+                   "chunk 32)", "value": 5.3, "unit": "s",
+         "p50_ms": 2100.0, "p99_ms": 3200.0, "failover_s": 0.25,
+         "vs_1_replica": 2.01, "device": "TFRT_CPU_0"},
+        {"replicas": 2, "requests": 3, "killed_replica": "r0",
+         "recovered": True, "bit_identical": True, "ok": True},
+    ]
+    text = "\n".join(summarize_watch.fleet_lines(rows))
+    assert "serve-fleet 2 replicas kill-failover" in text
+    assert "failover=0.25s" in text and "vs_1_replica=2.01" in text
+    assert "chaos --fleet PASSED" in text
+    assert "killed=r0" in text and "bit_identical=True" in text
 
 
 def test_cli_sections_account_for_every_parseable_row(tmp_path):

@@ -293,6 +293,52 @@ def test_serve_event_names_pinned():
     )
 
 
+def test_fleet_event_names_pinned():
+    """ISSUE 14 hygiene: the fleet-serving event names are schema
+    surface — the ``--recovery`` timeline, the per-replica CLI section,
+    ``chaos --fleet``, and fleet dashboards key on them (each event
+    carries a ``replica`` data label)."""
+    from netrep_tpu.utils.telemetry import FLEET_EVENTS
+
+    assert FLEET_EVENTS == (
+        "replica_joined",
+        "replica_lost",
+        "journal_shipped",
+        "failover_start",
+        "failover_done",
+        "ring_rebalanced",
+    )
+
+
+def test_replica_summary_folds_fleet_events():
+    """The per-replica offline aggregation (`telemetry` CLI section):
+    joins, losses, shipped records/bytes, and failover count + total
+    measured seconds, keyed on the ``replica`` label."""
+    from netrep_tpu.utils.telemetry import replica_summary
+
+    def ev(name, **data):
+        return {"v": 1, "t": 0.0, "m": 0.0, "run": "x", "ev": name,
+                "data": data}
+
+    events = [
+        ev("replica_joined", replica="r0"),
+        ev("replica_joined", replica="r1"),
+        ev("journal_shipped", replica="r0", records=3, bytes=120),
+        ev("journal_shipped", replica="r0", records=2, bytes=80),
+        ev("replica_lost", replica="r0", peer="r1"),
+        ev("failover_start", replica="r0", peer="r1"),
+        ev("failover_done", replica="r0", peer="r1", s=0.25, requeued=2),
+        ev("request_done", tenant="a", s=1.0),   # not a fleet event
+    ]
+    rows = replica_summary(events)
+    assert set(rows) == {"r0", "r1"}
+    assert rows["r0"]["shipped_records"] == 5
+    assert rows["r0"]["shipped_bytes"] == 200
+    assert rows["r0"]["lost"] == 1 and rows["r0"]["failovers"] == 1
+    assert rows["r0"]["failover_s"] == pytest.approx(0.25)
+    assert rows["r1"]["joined"] == 1 and rows["r1"]["failovers"] == 0
+
+
 def test_histogram_bucket_boundaries_pinned():
     """ISSUE 13: the per-tenant latency/cost histogram boundaries are
     exposition schema — re-binning breaks every dashboard quantile keyed
@@ -341,11 +387,12 @@ def test_known_events_cover_every_emitted_name():
     union's composition so a registry refactor cannot silently drop a
     subset out of :data:`KNOWN_EVENTS`."""
     from netrep_tpu.utils.telemetry import (
-        ENGINE_EVENTS, KNOWN_EVENTS, RECOVERY_EVENTS, SERVE_EVENTS,
-        SPAN_EVENTS,
+        ENGINE_EVENTS, FLEET_EVENTS, KNOWN_EVENTS, RECOVERY_EVENTS,
+        SERVE_EVENTS, SPAN_EVENTS,
     )
 
-    union = ENGINE_EVENTS + RECOVERY_EVENTS + SERVE_EVENTS + SPAN_EVENTS
+    union = (ENGINE_EVENTS + RECOVERY_EVENTS + SERVE_EVENTS
+             + FLEET_EVENTS + SPAN_EVENTS)
     assert KNOWN_EVENTS == frozenset(union)
     # no duplicates across registries: each name has one owning registry
     assert len(union) == len(set(union))

@@ -117,3 +117,49 @@ def test_serve_drain_releases_all_threads(pair_kw, tmp_path):
         f"leaked threads after serve drain: "
         f"{sorted(t.name for t in leftovers)}"
     )
+
+
+def test_fleet_drain_releases_all_threads(pair_kw, tmp_path):
+    """ISSUE 14: the fleet coordinator spawns a health-loop thread plus
+    one journal-shipper thread per replica on top of each replica's
+    serve worker — after close(drain=True) the process must return to
+    its baseline thread set (no leaked netrep-fleet-health /
+    netrep-journal-shipper / netrep-serve-worker threads)."""
+    from netrep_tpu.serve import FleetConfig, ServeConfig, \
+        build_inprocess_fleet
+
+    def mk(rid, jpath, ckpt):
+        return ServeConfig(engine=pair_kw["config"], journal=jpath,
+                           checkpoint_dir=ckpt)
+
+    # warm-up: one full fleet lifecycle absorbs lazy singletons
+    fleet0 = build_inprocess_fleet(
+        2, str(tmp_path / "warm"), make_config=mk,
+        fleet_config=FleetConfig(heartbeat_s=0.1),
+    )
+    fleet0.close(drain=False)
+    baseline = _live()
+
+    fleet = build_inprocess_fleet(
+        2, str(tmp_path / "fleet"), make_config=mk,
+        fleet_config=FleetConfig(
+            heartbeat_s=0.1,
+            telemetry=str(tmp_path / "fleet_tel.jsonl"),
+        ),
+    )
+    fleet.register_dataset("a", "d", network=pair_kw["network"]["d"],
+                           correlation=pair_kw["correlation"]["d"],
+                           data=pair_kw["data"]["d"],
+                           assignments=pair_kw["module_assignments"])
+    fleet.register_dataset("a", "t", network=pair_kw["network"]["t"],
+                           correlation=pair_kw["correlation"]["t"],
+                           data=pair_kw["data"]["t"])
+    res = fleet.analyze("a", "d", "t", n_perm=32, seed=3, timeout=600)
+    assert np.asarray(res["p_values"]).size
+    fleet.close(drain=True)
+
+    leftovers = _settle(baseline)
+    assert not leftovers, (
+        f"leaked threads after fleet drain: "
+        f"{sorted(t.name for t in leftovers)}"
+    )
