@@ -94,6 +94,12 @@ def classify(row: dict) -> str:
         # per-tenant attributed-cost row (ISSUE 13): surfaced as the
         # cost table, not a BASELINE measurement (CPU by design)
         return "serve-cost"
+    if (isinstance(row.get("metric"), str)
+            and row["metric"].startswith("serve-warmstart")):
+        # warm-start proof rows (ISSUE 15): fresh-process first-request
+        # compile span against a populated AOT store — a robustness/
+        # latency signal (CPU by design), never a BASELINE measurement
+        return "serve-warmstart"
     if ((isinstance(row.get("metric"), str)
          and row["metric"].startswith("serve-fleet"))
             or "killed_replica" in row):
@@ -231,6 +237,28 @@ def serve_cost_lines(cost_rows: list[dict],
     return lines
 
 
+def warmstart_lines(rows: list[dict]) -> list[str]:
+    """Warm-start section (ISSUE 15): the newest fresh-process proof row
+    — warm vs cold first-request compile span, the acquisition source,
+    and the delta against the PR 14 coldstart baseline."""
+    r = rows[-1]
+    verdict = "OK" if r.get("warm_ok") else "FAILED"
+    line = (
+        f"{r['metric']}: warm compile_span {r.get('value')}s "
+        f"(source={r.get('warm_source')}) vs cold "
+        f"{r.get('cold_compile_span_s')}s — {verdict}"
+    )
+    lines = [line]
+    if r.get("coldstart_baseline_s") is not None:
+        lines.append(
+            f"  vs serve-fleet-coldstart baseline "
+            f"{r['coldstart_baseline_s']}s: delta "
+            f"{r.get('coldstart_delta_s')}s "
+            f"({len(rows)} warmstart row(s) total)"
+        )
+    return lines
+
+
 def fleet_lines(rows: list[dict]) -> list[str]:
     """Fleet-drill section (ISSUE 14): the newest kill-failover load row
     (p50/p99, failover time, aggregate vs 1 replica) and the newest
@@ -263,6 +291,7 @@ def main(paths: list[str]) -> int:
     results, unknown, other, dropped, telemetry = [], [], [], 0, []
     ledger, lint, serve_cost, serve_top = [], [], [], []
     fleet = []
+    warmstart = []
     for p in paths:
         for r in rows_from(p):
             kind = classify(r)
@@ -286,6 +315,13 @@ def main(paths: list[str]) -> int:
                 serve_top.append(r)
             elif kind == "serve-fleet":
                 fleet.append(r)
+            elif kind == "serve-warmstart":
+                warmstart.append(r)
+    if warmstart:
+        print("## warm start (zero-compile first request)")
+        for line in warmstart_lines(warmstart):
+            print(line)
+        print()
     if fleet:
         print("## fleet drills (kill-failover health)")
         for line in fleet_lines(fleet):

@@ -289,6 +289,36 @@ fleet_drill() {
   fi
 }
 
+# Warm-start step (ISSUE 15, opt-in: WARMSTART=auto or 1): once per
+# watch cycle, prove the zero-compile warm start end to end — the
+# serve_load warmstart scenario exports the program grid into a fresh
+# AOT store, then measures a FRESH process's first-request compile span
+# against it and asserts `compile_span ~0` with `source: aot` (and warm
+# < cold). The row (metric label `serve-warmstart`, its own perf-ledger
+# fingerprint class) also reports the delta vs the PR 14
+# serve-fleet-coldstart baseline. A failed assertion banners LOUDLY but
+# never fails the step; CPU-only; off under the QUEUE_FILE test hook
+# like the other drills.
+WARMSTART=${WARMSTART:-0}
+warmstart_step() {
+  case "$WARMSTART" in
+    auto|1) ;;
+    *) return 0 ;;
+  esac
+  [ "$WARMSTART" = auto ] && [ -n "${QUEUE_FILE:-}" ] && return 0
+  echo "--- warmstart step ($(date -u +%FT%TZ)) ---" | tee -a "$LOG"
+  if ! timeout 900 env JAX_PLATFORMS=cpu \
+       python benchmarks/serve_load.py --smoke --warmstart >>"$LOG" 2>&1; then
+    echo "--- WARMSTART FAILED (first request compiled instead of loading from the AOT store — export/fingerprint/fallback regressed?) ---" | tee -a "$LOG"
+  fi
+  if [ -s "$PERF_LEDGER" ]; then
+    if ! perf_out=$(timeout 60 python -m netrep_tpu perf "$PERF_LEDGER" --check 2>/dev/null); then
+      echo "--- PERF REGRESSION after warmstart step ---" | tee -a "$LOG"
+      echo "$perf_out" | tee -a "$LOG"
+    fi
+  fi
+}
+
 echo "== watcher start $(date -u +%FT%TZ) (log=$LOG state=$STATE) ==" | tee -a "$LOG"
 while :; do
   lint_check
@@ -296,6 +326,7 @@ while :; do
   serve_drill
   serve_crash_drill
   fleet_drill
+  warmstart_step
   # drained first: with a cutoff set, an empty queue would otherwise be
   # reported as "no step can finish before cutoff" (review r5 — the test
   # harness caught the misleading exit line)

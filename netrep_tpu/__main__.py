@@ -128,9 +128,15 @@ def _chaos(args) -> int:
     # activate injection for it too (resolve_runtime's env activation)
     os.environ.pop("NETREP_FAULT_PLAN", None)
 
-    from netrep_tpu.utils.backend import resolve_backend_or_cpu
+    from netrep_tpu.utils.backend import (
+        enable_persistent_cache, resolve_backend_or_cpu,
+    )
 
     resolve_backend_or_cpu()
+    if os.environ.get("NETREP_PERSISTENT_CACHE", "1") != "0":
+        # drills share the repo-local compile cache (ISSUE 15): the
+        # baseline and recovered runs compile identical programs
+        enable_persistent_cache()
     import numpy as np
 
     import jax
@@ -221,9 +227,15 @@ def _chaos_serve(args) -> int:
     # the baseline below must run unkilled/unfaulted
     os.environ.pop("NETREP_FAULT_PLAN", None)
 
-    from netrep_tpu.utils.backend import resolve_backend_or_cpu
+    from netrep_tpu.utils.backend import (
+        enable_persistent_cache, resolve_backend_or_cpu,
+    )
 
     resolve_backend_or_cpu()
+    if os.environ.get("NETREP_PERSISTENT_CACHE", "1") != "0":
+        # drills share the repo-local compile cache (ISSUE 15): the
+        # baseline and recovered runs compile identical programs
+        enable_persistent_cache()
     import numpy as np
 
     from netrep_tpu import module_preservation
@@ -393,9 +405,15 @@ def _chaos_fleet(args) -> int:
 
     os.environ.pop("NETREP_FAULT_PLAN", None)   # the drill kills by pid
 
-    from netrep_tpu.utils.backend import resolve_backend_or_cpu
+    from netrep_tpu.utils.backend import (
+        enable_persistent_cache, resolve_backend_or_cpu,
+    )
 
     resolve_backend_or_cpu()
+    if os.environ.get("NETREP_PERSISTENT_CACHE", "1") != "0":
+        # drills share the repo-local compile cache (ISSUE 15): the
+        # baseline and recovered runs compile identical programs
+        enable_persistent_cache()
     import numpy as np
 
     from netrep_tpu import module_preservation
@@ -706,6 +724,10 @@ def main(argv=None) -> int:
     sv.add_argument("--no-respawn", action="store_true",
                     help="do not respawn a failed replica after its "
                          "failover completes (the fleet shrinks)")
+    sv.add_argument("--aot-export", action="store_true",
+                    help="export programs this server had to jit-compile "
+                         "into the AOT warm-start store (fleet replicas "
+                         "do this automatically; see `warmup`)")
     sv.add_argument("--fleet-label", default=None, metavar="RID",
                     help="replica identity inside a fleet (set by the "
                          "coordinator when spawning replicas): the first "
@@ -770,6 +792,48 @@ def main(argv=None) -> int:
                          "the table")
     tp.add_argument("--timeout", type=float, default=30.0,
                     help="socket timeout seconds")
+    wu = sub.add_parser(
+        "warmup",
+        help="pre-export the engine program grid into the AOT store "
+             "(ISSUE 15): trace + serialize + compile the bucketed null "
+             "programs for given fixture shapes once, so a fresh "
+             "process (or a respawned fleet replica) sharing the store "
+             "answers its first request at steady-state speed "
+             "(compile_span ~0, source: aot)",
+    )
+    wu.add_argument("--genes", type=_positive, default=120)
+    wu.add_argument("--modules", type=_positive, default=3)
+    wu.add_argument("--samples", type=_positive, default=16)
+    wu.add_argument("--fixture-seed", type=int, default=7)
+    wu.add_argument("--chunk", type=_positive, default=64,
+                    help="EngineConfig.chunk_size (must match the "
+                         "serving/run config for the entries to hit)")
+    wu.add_argument("--n-perm", type=_positive, default=None,
+                    help="request budget the serve-path plan assumes "
+                         "(program identity is n_perm-independent; this "
+                         "only sizes the plan)")
+    wu.add_argument("--grid", default=None, metavar="G:M:S[,G:M:S...]",
+                    help="warm several genes:modules:samples shapes in "
+                         "one run instead of the single-shape flags")
+    wu.add_argument("--target", default="both",
+                    choices=["serve", "direct", "both"],
+                    help="which engine construction to warm: the packed "
+                         "serve path, the direct module_preservation "
+                         "path, or both (default)")
+    wu.add_argument("--measure", action="store_true",
+                    help="measure instead of export: build the serve-"
+                         "path engine fresh in THIS process, run one "
+                         "null, and report its compile_span + source — "
+                         "run it in a fresh process against a populated "
+                         "store for the warm-start proof")
+    wu.add_argument("--store", default=None, metavar="DIR",
+                    help="AOT store directory (default: $NETREP_AOT_STORE "
+                         "or .jax_cache/<cpu-fp>/aot)")
+    wu.add_argument("--telemetry", default=None, metavar="PATH",
+                    help="append warmup_start/end spans + aot_export "
+                         "events to this JSONL")
+    wu.add_argument("--json", action="store_true",
+                    help="print the report as one JSON line")
     ln = sub.add_parser(
         "lint",
         help="invariant linter (ISSUE 12): statically enforce the "
@@ -913,11 +977,38 @@ def main(argv=None) -> int:
 
         return run_top(args)
 
-    if args.cmd == "serve":
-        if args.telemetry is None:
-            import os
+    if args.cmd == "warmup":
+        # warm start is the whole point: the persistent XLA compile
+        # cache must be on so exported programs' executables land beside
+        # the store (and the backend must resolve hang-safely first).
+        # NETREP_PERSISTENT_CACHE=0 opts out — the warmstart bench's
+        # honest cold reference measures with both layers off.
+        import os
 
+        from netrep_tpu.utils.backend import (
+            enable_persistent_cache, resolve_backend_or_cpu,
+        )
+
+        resolve_backend_or_cpu()
+        if os.environ.get("NETREP_PERSISTENT_CACHE", "1") != "0":
+            enable_persistent_cache()
+        from netrep_tpu.warmup import main_warmup
+
+        return main_warmup(args)
+
+    if args.cmd == "serve":
+        import os
+
+        if args.telemetry is None:
             args.telemetry = os.environ.get("NETREP_TELEMETRY") or None
+        if os.environ.get("NETREP_PERSISTENT_CACHE", "1") != "0":
+            # warm start (ISSUE 15): serving processes share the
+            # persistent XLA compile cache beside the AOT store, so a
+            # replica boot's compiles are cache reads when any earlier
+            # process (warmup, a peer, a previous generation) did them
+            from netrep_tpu.utils.backend import enable_persistent_cache
+
+            enable_persistent_cache()
         if args.fleet and args.fleet > 1:
             # the fleet coordinator itself is backend-free (it only
             # routes and ships journals); the replica daemons it spawns
@@ -952,9 +1043,18 @@ def main(argv=None) -> int:
     # erroring — the exact failure the driver entries guard against
     # (utils/backend.py). An explicit non-axon platform is honored; an
     # unresponsive tunnel drops to CPU.
+    import os
+
     from netrep_tpu.utils.backend import resolve_backend_or_cpu
 
     resolve_backend_or_cpu()
+    if os.environ.get("NETREP_PERSISTENT_CACHE", "1") != "0":
+        # selftest subprocesses (CI, tpu_watch, the tier-1 CLI tests)
+        # share the repo-local compile cache instead of each paying the
+        # full cold compile (ISSUE 15 tier-1 wall-clock satellite)
+        from netrep_tpu.utils.backend import enable_persistent_cache
+
+        enable_persistent_cache()
     try:
         out = netrep_tpu.selftest(
             n_perm=args.n_perm, seed=args.seed, verbose=not args.json,

@@ -95,6 +95,29 @@ def _engine_fingerprint_key(base) -> str:
         return ""
 
 
+#: which acquired program drives each null-loop mode — the ``source``
+#: tag on its ``compile_span`` event comes from that program's
+#: acquisition (:meth:`PermutationEngine._acquire_program`)
+_MODE_PROGRAM = {
+    "materialized": "chunk",
+    "adaptive": "chunk",
+    "streaming": "super",
+    "adaptive-streaming": "count",
+}
+
+
+def _run_program_source(base, mode: str) -> str:
+    """``aot`` (deserialized from the AOT store), ``memo`` (in-process
+    reuse — warm pool / repeat run), or ``jit`` (compiled fresh; also
+    every engine without the acquisition seam). Adaptive retirement
+    re-acquires shrunken programs mid-run, so the tag reflects the most
+    recent acquisition of the mode's program."""
+    srcs = getattr(base, "_program_sources", None)
+    if not srcs:
+        return "jit"
+    return srcs.get(_MODE_PROGRAM.get(mode, "chunk"), "jit")
+
+
 def _finish_run_accounting(base, telemetry, run_sid, t_marks, t_run0,
                            start_perm, n_perm, mode) -> None:
     """End-of-run compile estimate + perf-ledger feed (ISSUE 5), emitted
@@ -105,11 +128,14 @@ def _finish_run_accounting(base, telemetry, run_sid, t_marks, t_run0,
     distinction into an explicit ``compile_span`` event: the steady-state
     rate over marks 0→last prices the first chunk's *compute*, and the
     first interval's surplus over that price is the jit-compile estimate,
-    keyed by the engine's autotune/compile-cache fingerprint. The same
-    numbers feed the append-only perf ledger
-    (:mod:`netrep_tpu.utils.perfledger`) when ``NETREP_PERF_LEDGER``
-    names one — every telemetry-enabled run leaves a throughput
-    fingerprint CI can regression-check."""
+    keyed by the engine's autotune/compile-cache fingerprint. Since
+    ISSUE 15 the event also carries the run program's acquisition
+    ``source`` (``aot``/``jit``/``memo``) and the ledger fingerprint is
+    suffixed with it — warm and cold compile histories never mix in the
+    regression check or the time split. The same numbers feed the
+    append-only perf ledger (:mod:`netrep_tpu.utils.perfledger`) when
+    ``NETREP_PERF_LEDGER`` names one — every telemetry-enabled run leaves
+    a throughput fingerprint CI can regression-check."""
     if telemetry is None or len(t_marks) < 2:
         return
     (c0, t0), (c1, t1) = t_marks[0], t_marks[-1]
@@ -119,12 +145,14 @@ def _finish_run_accounting(base, telemetry, run_sid, t_marks, t_run0,
     first_s = t_marks[0][1] - t_run0
     compile_s = max(0.0, first_s - (t_marks[0][0] - start_perm) / rate)
     fp = _engine_fingerprint_key(base)
+    src = _run_program_source(base, mode)
     telemetry.emit("compile_span", parent=run_sid, s=compile_s, key=fp,
-                   mode=mode)
+                   mode=mode, source=src)
     from ..utils import perfledger
 
     perfledger.maybe_record_run(
-        run_id=telemetry.run_id, fingerprint=fp, mode=mode,
+        run_id=telemetry.run_id,
+        fingerprint=f"{fp}|src:{src}" if fp else fp, mode=mode,
         perms_per_sec=rate, compile_s=compile_s, n_perm=int(n_perm),
         backend=jax.default_backend(),
     )
@@ -1306,15 +1334,51 @@ def run_adaptive_chunks(
     return nulls, completed, finished
 
 
-@partial(jax.jit, static_argnums=(2,))
-def _perm_keys_jit(key: jax.Array, start: jax.Array, count: int) -> jax.Array:
-    return jax.vmap(lambda i: jax.random.fold_in(key, i))(
-        start + jnp.arange(count, dtype=jnp.uint32)
-    )
+#: store-backed per-(kind, static-shape) key-derivation programs: the
+#: AOT analogue of the old ``static_argnums`` jit cache — bounded by the
+#: distinct (chunk, superchunk, group) shapes a process runs, exactly
+#: like the jit cache it replaces
+_KEYS_FNS: dict = {}
 
 
-@partial(jax.jit, static_argnums=(2,))
-def _perm_keys_grouped_jit(keys_g: jax.Array, start: jax.Array, count: int):
+def _keys_fn(kind: str, static_sig: str, body, example):
+    """Resolve one grouped-keys helper program through the AOT store
+    (ISSUE 15): memoized per (kind, static shape, backend); the store
+    serves a deserialized entry when one is warm, else jits ``body`` as
+    the ``static_argnums`` decorators always did. Key derivation runs
+    once per chunk on the hot path, so the helpers' compiles are part of
+    the cold-start tax the warm start has to erase."""
+    memo_key = (kind, static_sig, jax.default_backend())
+    fn = _KEYS_FNS.get(memo_key)
+    if fn is None:
+        from ..utils import aot
+
+        store = aot.get_store()
+        if store is None:
+            fn = jax.jit(body)
+        else:
+            fn, _src = store.acquire(
+                aot.program_key(
+                    f"keys:{kind}|{static_sig}|{jax.default_backend()}",
+                    "", "mesh:none",
+                ),
+                lambda: jax.jit(body), export_fn=body, example_args=example,
+            )
+        _KEYS_FNS[memo_key] = fn
+    return fn
+
+
+def _perm_keys_jit(key: jax.Array, start, count: int) -> jax.Array:
+    def body(k, s):
+        return jax.vmap(lambda i: jax.random.fold_in(k, i))(
+            s + jnp.arange(count, dtype=jnp.uint32)
+        )
+
+    start = jnp.uint32(start)
+    return _keys_fn("perm", str(int(count)), body, (key, start))(key, start)
+
+
+def _perm_keys_grouped_jit(keys_g: jax.Array, start, count: int):
     """(C, G) per-permutation keys for one PACKED chunk (ISSUE 7): column
     g holds group g's solo-run keys ``fold_in(key_g, start + i)`` — each
     packed request keeps its own RNG stream, so its permutations are
@@ -1322,22 +1386,34 @@ def _perm_keys_grouped_jit(keys_g: jax.Array, start: jax.Array, count: int):
     layout (perm axis leading) matches what ``lax.map`` consumes in the
     packed chunk body, so no eager transpose of a typed-key array is ever
     needed."""
-    idx = start + jnp.arange(count, dtype=jnp.uint32)
-    return jax.vmap(
-        lambda i: jax.vmap(lambda kg: jax.random.fold_in(kg, i))(keys_g)
-    )(idx)
+    def body(kg, s):
+        idx = s + jnp.arange(count, dtype=jnp.uint32)
+        return jax.vmap(
+            lambda i: jax.vmap(lambda k1: jax.random.fold_in(k1, i))(kg)
+        )(idx)
+
+    start = jnp.uint32(start)
+    g = int(keys_g.shape[0])
+    return _keys_fn(
+        "grouped", f"{int(count)}x{g}", body, (keys_g, start)
+    )(keys_g, start)
 
 
-@partial(jax.jit, static_argnums=(2, 3))
-def _perm_keys2d_jit(key: jax.Array, start: jax.Array, k: int, c: int):
+def _perm_keys2d_jit(key: jax.Array, start, k: int, c: int):
     """(K, C) per-permutation keys for one superchunk — the same
     ``fold_in(key, i)`` contract as :func:`_perm_keys_jit`, reshaped
     INSIDE the jit (an eager reshape of a typed-key array would cost a
     ~1 s dispatch per superchunk on tunneled backends)."""
-    ks = jax.vmap(lambda i: jax.random.fold_in(key, i))(
-        start + jnp.arange(k * c, dtype=jnp.uint32)
-    )
-    return ks.reshape(k, c)
+    def body(k1, s):
+        ks = jax.vmap(lambda i: jax.random.fold_in(k1, i))(
+            s + jnp.arange(k * c, dtype=jnp.uint32)
+        )
+        return ks.reshape(k, c)
+
+    start = jnp.uint32(start)
+    return _keys_fn(
+        "perm2d", f"{int(k)}x{int(c)}", body, (key, start)
+    )(key, start)
 
 
 def check_derived_network(corr, net, net_beta, what: str) -> None:
@@ -1898,6 +1974,14 @@ class PermutationEngine:
         #: the same reason _chunk_fn_cached exists); invalidated by rebucket
         self._stream_super_cached: tuple | None = None
         self._stream_count_cached: tuple | None = None
+        #: per-program acquisition source (aot|jit|memo), keyed by program
+        #: name — the `source` tag on compile_span events and perf-ledger
+        #: fingerprints (ISSUE 15)
+        self._program_sources: dict[str, str] = {}
+        #: perm batch the chunk body actually closed over (resolved from
+        #: the autotune cache or the byte-budget heuristic) — a program
+        #: CONSTANT, so part of the AOT program identity
+        self._applied_perm_batch: int | None = None
 
     def _check_pool(self) -> None:
         """Permutation-pool oversubscription check. The packed serve engine
@@ -2017,6 +2101,146 @@ class PermutationEngine:
             self.effective_chunk(), extra,
         )
 
+    # ------------------------------------------------------------------
+    # AOT program acquisition (ISSUE 15)
+    # ------------------------------------------------------------------
+
+    def _program_constants(self) -> str:
+        """Closed-over constants of the jitted programs that the abstract
+        argument signature cannot see — part of the AOT entry identity
+        (:func:`netrep_tpu.utils.aot.program_key`): two engines differing
+        in ANY of these trace different programs and must never share a
+        serialized entry. The packed serve engine extends this with its
+        per-module key-group assignment."""
+        cfg = self.config
+        slices = ";".join(
+            f"{b.cap}@" + ",".join(repr(s) for s in b.slices)
+            for b in self.buckets
+        )
+        return "|".join([
+            type(self).__name__,
+            f"beta:{self.net_beta!r}",
+            f"sum:{cfg.summary_method}:{cfg.power_iters}",
+            f"dtype:{cfg.dtype}",
+            f"stat:{self.stat_mode}",
+            f"gather:{self.gather_mode}",
+            f"fx:{cfg.fused_exact}",
+            f"pb:{self._applied_perm_batch}",
+            f"data_only:{getattr(self, 'data_only', False)}",
+            f"slices:{slices}",
+        ])
+
+    def _mesh_spec_str(self) -> str:
+        if self.mesh is None:
+            return "mesh:none"
+        return "mesh:" + ",".join(
+            f"{k}={v}" for k, v in sorted(self.mesh.shape.items())
+        )
+
+    def _example_run_key(self):
+        """A shape-representative run key for abstract program signatures
+        (value irrelevant: programs take keys as arguments). The packed
+        engine's ``prepare_key`` hook stacks one per key group."""
+        return _resolve_key(self, 0)
+
+    def program_cache_key(self, name: str) -> str:
+        """The AOT store identity of one of this engine's programs —
+        every ``autotune_key()`` component (backend, gather/stat mode,
+        bucket caps, chunk), every closed-over program constant, and the
+        mesh spec participate, so engines differing in ANY of them never
+        share a serialized entry (pinned in tests/test_aot.py)."""
+        from ..utils import aot
+
+        return aot.program_key(
+            self.autotune_key(extra=f"prog:{name}"),
+            self._program_constants(), self._mesh_spec_str(),
+        )
+
+    def _acquire_program(self, name: str, body, build, example_args):
+        """The single program-acquisition seam over the jit sites: resolve
+        through the AOT store (:mod:`netrep_tpu.utils.aot`) when one is
+        active and the program is exportable (mesh-free), else jit as
+        before. Records the acquisition source for the run accounting's
+        ``compile_span`` tag. ``body`` is the unjitted program (export +
+        raw-key bridge), ``build`` the jit fallback builder,
+        ``example_args`` shape-representative call arguments (None ⇒ jit
+        only)."""
+        from ..utils import aot
+
+        store = aot.get_store()
+        if (store is None or example_args is None or body is None
+                or not self._programs_exportable()):
+            self._program_sources[name] = "jit"
+            return build()
+        fn, src = store.acquire(
+            self.program_cache_key(name),
+            build, export_fn=body, example_args=example_args,
+        )
+        self._program_sources[name] = src
+        return fn
+
+    def _programs_exportable(self) -> bool:
+        """The store only serves/exports the pristine full-module bucket
+        set: adaptive retirement re-buckets mid-run into shrunken
+        signatures that rarely recur across boots — exporting each would
+        trade a one-off trace tax per retirement step for entries nobody
+        reloads, so those re-acquisitions stay on the plain jit path
+        (exactly the PR 14 cost)."""
+        return sum(len(b.module_pos) for b in self.buckets) == self.n_modules
+
+    def warmup_export(self, n_perm: int = 0) -> dict:
+        """Pre-export this engine's program grid into the AOT store (the
+        ``netrep warmup`` CLI): the chunk body (materialized + adaptive
+        loops), the superchunk scan and the adaptive counter (streaming
+        loops), and the observed pass — each traced, serialized, and
+        compiled once so a fresh process (or a respawned fleet replica)
+        answers its first request with ``compile_span ~0``. Returns a
+        per-program ``{name: "aot"|"jit"}`` report (``jit`` = that
+        program could not be exported and stays on the fallback ladder).
+        """
+        from ..utils import aot
+
+        store = aot.get_store()
+        report: dict[str, str] = {}
+        if store is None:
+            return report
+        # force re-acquisition through the store: an engine that already
+        # ran holds its programs in the instance caches, and warmup must
+        # still reach the acquire seam to persist them
+        self._chunk_fn_cached = None
+        self._stream_super_cached = None
+        self._stream_count_cached = None
+        self._observed_fn = None
+        obs0 = np.zeros((self.n_modules, N_STATS))
+        builders = {
+            # acquire exports the primary signature on miss and loads it
+            # straight back (export parity exercised at export time, not
+            # first discovered by a later boot)
+            "chunk": self._chunk_fn,
+            "super": lambda: self._stream_super_fn(obs0),
+            "count": lambda: self._stream_count_fn(obs0),
+            "observed": self.observed,
+        }
+        with store.exporting():
+            for name in self._warm_programs():
+                try:
+                    builders[name]()
+                    report[name] = self._program_sources.get(name, "jit")
+                # netrep: allow(exception-taxonomy) — warmup is an optimization pass over a shape GRID: one unexportable/unbuildable program must report 'error' and let the rest of the grid warm, never kill the CLI
+                except Exception as e:
+                    logger.warning("warmup of %r failed (%s: %s); it "
+                                   "stays on the jit path", name,
+                                   type(e).__name__, e)
+                    report[name] = "error"
+        return report
+
+    def _warm_programs(self) -> tuple[str, ...]:
+        """Programs :meth:`warmup_export` pre-exports for this engine
+        class — the packed serve engine overrides this (its runs use the
+        materialized chunk + observed programs only; it has no grouped
+        streaming path)."""
+        return ("chunk", "super", "count", "observed")
+
     def record_chunk_throughput(self, perms_per_sec: float) -> None:
         """Steady-state chunk throughput callback from the null loop —
         persists the measurement for the (key, perm_batch) this engine
@@ -2135,42 +2359,49 @@ class PermutationEngine:
                 "the wrapping engine"
             )
         if self._observed_fn is None:
+            b0 = self.buckets[0]
             if self.data_only:
                 from ..atlas.modules import (
                     data_only_gather_and_stats, normalize_beta_static,
                 )
 
-                inner = jax.jit(
-                    jax.vmap(
-                        partial(
-                            data_only_gather_and_stats,
-                            net_beta=normalize_beta_static(self.net_beta),
-                            n_iter=self.config.power_iters,
-                            summary_method="eigh",  # observed: exact
-                        ),
-                        in_axes=(0, 0, None),
-                    )
+                body = jax.vmap(
+                    partial(
+                        data_only_gather_and_stats,
+                        net_beta=normalize_beta_static(self.net_beta),
+                        n_iter=self.config.power_iters,
+                        summary_method="eigh",  # observed: exact
+                    ),
+                    in_axes=(0, 0, None),
+                )
+                inner = self._acquire_program(
+                    "observed", body, lambda: jax.jit(body),
+                    (b0.disc, b0.obs_idx, self._test_dataT),
                 )
                 self._observed_fn = (
                     lambda disc, idx, _tc, _tn, tdT: inner(disc, idx, tdT)
                 )
             elif self.row_sharded:
+                self._program_sources["observed"] = "jit"
                 self._observed_fn = make_row_sharded_observed(
                     self._gather_rep, self.net_beta
                 )
             else:
-                self._observed_fn = jax.jit(
-                    jax.vmap(
-                        partial(
-                            jstats.gather_and_stats_mxu
-                            if self.gather_mode == "mxu"
-                            else jstats.gather_and_stats,
-                            n_iter=self.config.power_iters,
-                            summary_method="eigh",  # observed: exact, runs once
-                            net_beta=self.net_beta,
-                        ),
-                        in_axes=(0, 0, None, None, None),
-                    )
+                body = jax.vmap(
+                    partial(
+                        jstats.gather_and_stats_mxu
+                        if self.gather_mode == "mxu"
+                        else jstats.gather_and_stats,
+                        n_iter=self.config.power_iters,
+                        summary_method="eigh",  # observed: exact, runs once
+                        net_beta=self.net_beta,
+                    ),
+                    in_axes=(0, 0, None, None, None),
+                )
+                self._observed_fn = self._acquire_program(
+                    "observed", body, lambda: jax.jit(body),
+                    (b0.disc, b0.obs_idx, self._test_corr,
+                     self._test_net, self._test_dataT),
                 )
         out = np.full((self.n_modules, N_STATS), np.nan)
         for b in self.buckets:
@@ -2256,6 +2487,7 @@ class PermutationEngine:
 
         at_key = self.autotune_key()
         perm_batch, at_cache = resolve_perm_batch(cfg, at_key, heuristic)
+        self._applied_perm_batch = perm_batch
         self._autotune_record = (
             (at_cache, at_key, perm_batch) if at_cache is not None else None
         )
@@ -2369,6 +2601,7 @@ class PermutationEngine:
 
         at_key = self.autotune_key()
         perm_batch, at_cache = resolve_perm_batch(cfg, at_key, heuristic)
+        self._applied_perm_batch = perm_batch
         self._autotune_record = (
             (at_cache, at_key, perm_batch) if at_cache is not None else None
         )
@@ -2422,6 +2655,7 @@ class PermutationEngine:
             "fused", jax.default_backend(), self.effective_chunk()
         )
         perm_batch, at_cache = resolve_perm_batch(cfg, at_key, heuristic)
+        self._applied_perm_batch = perm_batch
         self._autotune_record = (
             (at_cache, at_key, perm_batch) if at_cache is not None else None
         )
@@ -2650,13 +2884,27 @@ class PermutationEngine:
                 # (committed) shardings — replicated or row-sharded
                 return jitted(to_global(keys, keys_sharding), *args)
 
+            self._program_sources["chunk"] = "jit"
             return fn
-        jitted = jax.jit(chunk)
+        # mesh-free: the chunk program resolves through the AOT store
+        # (ISSUE 15) — a warm store serves the serialized program and the
+        # first chunk dispatch pays no trace/lower/compile
+        example = (
+            self.perm_keys(self._example_run_key(), 0,
+                           self.effective_chunk()),
+        ) + args
+        jitted = self._acquire_program(
+            "chunk", chunk, lambda: jax.jit(chunk), example
+        )
         return lambda keys: jitted(keys, *args)
 
     def _chunk_fn(self) -> Callable:
         if self._chunk_fn_cached is None:
             self._chunk_fn_cached = self._build_chunk_fn()
+        else:
+            # in-process reuse (repeat run / warm-pool engine): the run's
+            # compile_span history must not mix with cold or AOT history
+            self._program_sources["chunk"] = "memo"
         return self._chunk_fn_cached
 
     def run_null(
@@ -2899,6 +3147,8 @@ class PermutationEngine:
             self._stream_super_cached = (
                 sig, self._build_stream_super(observed)
             )
+        else:
+            self._program_sources["super"] = "memo"
         return self._stream_super_cached[1]
 
     def _stream_count_fn(self, observed) -> Callable:
@@ -2912,6 +3162,8 @@ class PermutationEngine:
             self._stream_count_cached = (
                 sig, self._build_stream_count_fn(observed)
             )
+        else:
+            self._program_sources["count"] = "memo"
         return self._stream_count_cached[1]
 
     def _stream_program_parts(self, adaptive: bool):
@@ -3006,10 +3258,28 @@ class PermutationEngine:
                 )
             jitted = jax.jit(super_fn, donate_argnums=donate)
             args, obs = _globalize_replicated(self.mesh, (args, obs))
+            self._program_sources["super"] = "jit"
             return lambda tallies, keys, valid: jitted(
                 tallies, to_global(keys, ksh), valid, args, obs
             )
-        jitted = jax.jit(super_fn, donate_argnums=donate)
+        # mesh-free: resolve through the AOT store (ISSUE 15). The AOT
+        # path drops the carry donation (the exported calling convention
+        # has none) — O(K·7) int32 tallies, values identical.
+        from ..utils.autotune import peek_superchunk
+
+        K = peek_superchunk(
+            self.config, self.autotune_key(extra="superchunk")
+        )
+        C = self.effective_chunk()
+        example = (
+            self._stream_tallies_init(),
+            self.perm_keys2d(self._example_run_key(), 0, K, C),
+            np.full((K,), C, np.int32), args, obs,
+        )
+        jitted = self._acquire_program(
+            "super", super_fn,
+            lambda: jax.jit(super_fn, donate_argnums=donate), example,
+        )
         return lambda tallies, keys, valid: jitted(
             tallies, keys, valid, args, obs
         )
@@ -3046,10 +3316,20 @@ class PermutationEngine:
                 )
             jitted = jax.jit(count_fn)
             args, obs = _globalize_replicated(self.mesh, (args, obs))
+            self._program_sources["count"] = "jit"
             return lambda keys, valid: jitted(
                 to_global(keys, ksh), valid, args, obs
             )
-        jitted = jax.jit(count_fn)
+        # mesh-free: the adaptive streaming counter resolves through the
+        # AOT store (ISSUE 15)
+        C = self.effective_chunk()
+        example = (
+            self.perm_keys(self._example_run_key(), 0, C),
+            np.int32(C), args, obs,
+        )
+        jitted = self._acquire_program(
+            "count", count_fn, lambda: jax.jit(count_fn), example
+        )
         return lambda keys, valid: jitted(keys, valid, args, obs)
 
     def _stream_tallies_init(self, host=None) -> list:

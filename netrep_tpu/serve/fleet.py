@@ -987,6 +987,15 @@ def spawn_replica_daemon(rid: str, fleet_dir: str, args, *,
            if k != "NETREP_FAULT_PLAN"}
     env.setdefault("JAX_PLATFORMS",
                    os.environ.get("JAX_PLATFORMS", "") or "cpu")
+    # warm start (ISSUE 15): every replica generation — including a
+    # respawn (r0.g1) — resolves the SAME AOT store path, so programs
+    # one generation exported (fleet replicas export-on-miss via their
+    # fleet_label) are the next generation's zero-compile boot
+    from ..utils import aot
+
+    store = aot.get_store()
+    if store is not None:
+        env.setdefault(aot.STORE_ENV, store.path)
     if env_extra:
         env.update(env_extra)
     proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,

@@ -239,6 +239,27 @@ class PackedEngine(PermutationEngine):
             extra=f"{tag}|{extra}" if extra else tag
         )
 
+    def _program_constants(self) -> str:
+        """AOT program identity (ISSUE 15): the packed chunk body also
+        closes over each bucket's per-module key-group assignment — two
+        packs whose modules map to different request groups trace
+        different programs and must never share a serialized entry."""
+        groups = ";".join(
+            ",".join(str(int(self._module_group[p])) for p in b.module_pos)
+            for b in self.buckets
+        )
+        return super()._program_constants() + f"|groups:{groups}"
+
+    def _example_run_key(self):
+        return self.prepare_key([0] * self.n_groups)
+
+    def _warm_programs(self) -> tuple[str, ...]:
+        # packed runs are materialized-adaptive (run_null_monitored):
+        # chunk + observed are the programs a replica's first request
+        # compiles; the base streaming builders use the ungrouped key
+        # contract and never serve packs
+        return ("chunk", "observed")
+
     # -- chunk program -----------------------------------------------------
 
     def chunk_body(self):
@@ -297,6 +318,7 @@ class PackedEngine(PermutationEngine):
             kernel_axes = (0, 0, None, None, None)
         at_key = self.autotune_key()
         perm_batch, at_cache = resolve_perm_batch(cfg, at_key, heuristic)
+        self._applied_perm_batch = perm_batch
         self._autotune_record = (
             (at_cache, at_key, perm_batch) if at_cache is not None else None
         )
@@ -336,6 +358,8 @@ class PackedEngine(PermutationEngine):
         if fn is None:
             fn = self._build_chunk_fn()
             self._packed_fn_cache[sig] = fn
+        else:
+            self._program_sources["chunk"] = "memo"
         return fn
 
     def release(self) -> None:

@@ -184,6 +184,20 @@ class ServeConfig:
     #: — the measured baseline the ROADMAP's AOT warm-start goal has to
     #: beat. None = stand-alone server, nothing recorded.
     fleet_label: str | None = None
+    # -- AOT warm start (ISSUE 15) --------------------------------------
+    #: boot-time preload: after a ``--recover`` replay re-registered
+    #: datasets, a single bounded background thread builds the warm-pool
+    #: engine for up to ``preload_max`` registered (discovery, test)
+    #: pairs and acquires their programs through the AOT store — a
+    #: populated store then answers the first request at steady-state
+    #: speed (``compile_span ~0``, ``source: aot``). False = PR 14 boot.
+    preload_aot: bool = True
+    preload_max: int = 4
+    #: export programs this server had to jit-compile into the AOT store
+    #: (so the NEXT boot — or a respawned fleet peer — loads them).
+    #: None = auto: on exactly when ``fleet_label`` is set (fleet
+    #: replicas self-warm the shared store); True/False force it.
+    aot_export: bool | None = None
 
 
 @dataclasses.dataclass
@@ -321,8 +335,11 @@ class PreservationServer:
                 journal=bool(self.journal),
             )
         self._worker: threading.Thread | None = None
+        self._preload_thread: threading.Thread | None = None
         if self.config.recover and self.config.journal:
             self._recover()
+        if self.config.preload_aot:
+            self._start_preload()
         if start:
             self.start()
 
@@ -368,6 +385,12 @@ class PreservationServer:
         if self._worker is not None:
             self._worker.join(timeout=10.0)
             self._worker = None
+        with self._work:
+            pt, self._preload_thread = self._preload_thread, None
+        if pt is not None:
+            # the preload thread is short-lived and daemon; the drain
+            # waits for it so the thread set returns to baseline
+            pt.join(timeout=60.0)
         requeued = self._last_drain_requeued = len(remainder)
         if remainder:
             if self.journal is not None:
@@ -400,6 +423,80 @@ class PreservationServer:
             self.journal.close()
 
     # -- restart recovery (ISSUE 10) ---------------------------------------
+
+    # -- AOT warm start (ISSUE 15) ----------------------------------------
+
+    def _aot_export_scope(self):
+        """Context manager enabling AOT export-on-miss around a pack run:
+        programs this server had to jit-compile are serialized into the
+        store, so the next boot (or a respawned fleet peer) loads them
+        instead of compiling. Auto mode exports exactly on fleet
+        replicas (``fleet_label`` set)."""
+        import contextlib
+
+        from ..utils import aot
+
+        export = self.config.aot_export
+        if export is None:
+            export = self.config.fleet_label is not None
+        store = aot.get_store() if export else None
+        return store.exporting() if store is not None \
+            else contextlib.nullcontext()
+
+    def _start_preload(self) -> None:
+        """Boot-time AOT preload (ISSUE 15): for up to ``preload_max``
+        registered (discovery, test) pairs — a ``--recover`` replay or a
+        fleet journal adoption just re-registered them — build the
+        warm-pool engine and acquire its programs through the AOT store
+        on ONE background thread, so a populated store's deserialize +
+        cached-compile happens before the first request, not inside it.
+        Best-effort by construction: every failure leaves the ordinary
+        lazy path intact."""
+        with self._work:
+            if self._preload_thread is not None:
+                return
+            pairs = []
+            for ten in self._tenants.values():
+                discs = [d for d in ten.datasets.values()
+                         if d.assignments is not None]
+                tests = list(ten.datasets.values())
+                for d in discs:
+                    for t in tests:
+                        if t.name != d.name:
+                            pairs.append((d, t))
+            pairs = pairs[:max(0, int(self.config.preload_max))]
+        if not pairs:
+            return
+
+        def preload(pairs=tuple(pairs), pool=self.pool):
+            for d, t in pairs:
+                try:
+                    plan = self._build_plan(
+                        d, t, None, n_perm=self.config.default_n_perm,
+                        seed=0, alternative="greater", adaptive=False,
+                        rule=None,
+                    )
+                    plan.base = 0
+                    key = self._pool_key("packed", (d.digest, t.digest),
+                                         [plan])
+                    engine, _hit = pool.get(
+                        key, lambda: self._pack_engine(d, t, [plan])
+                    )
+                    # acquire (and, on a warm store, deserialize +
+                    # cache-compile) the chunk program; run the observed
+                    # pass once so the pooled engine is request-ready
+                    engine._chunk_fn()
+                    engine.observed()
+                # netrep: allow(exception-taxonomy) — boot-time warmup is an optimization pass: any failure (unregistered pair shape, store I/O, OOM-scale plan) must leave the lazy path to serve the request as before
+                except Exception:
+                    logger.debug("AOT preload skipped one pair",
+                                 exc_info=True)
+
+        t = threading.Thread(target=preload, name="netrep-aot-preload",
+                             daemon=True)
+        with self._work:
+            self._preload_thread = t
+        t.start()
 
     def _recover(self) -> None:
         """Replay the write-ahead journal on boot (``serve --recover``):
@@ -1434,29 +1531,34 @@ class PreservationServer:
                 pack=pack_id, n_requests=n, **self.pool.stats(),
             )
 
+    def _pack_engine(self, disc: _Dataset, test: _Dataset, plans):
+        """Build the packed engine for one (discovery, test) pair — the
+        warm-pool builder shared by pack execution and the boot-time AOT
+        preload (ISSUE 15), so preloaded engines are EXACTLY the ones the
+        first request would build."""
+        cfg = self.config.engine
+        if disc.beta is not None:
+            # data-only atlas pack (ISSUE 9): the engine derives every
+            # submatrix from data columns with the registered spec
+            cfg = dataclasses.replace(
+                cfg, network_from_correlation=disc.beta
+            )
+        return PackedEngine(
+            disc.ds.correlation, disc.ds.network, disc.ds.data,
+            test.ds.correlation, test.ds.network, test.ds.data,
+            [p.specs for p in plans], plans[0].pool,
+            config=cfg,
+        )
+
     def _execute_pack(self, batch: list[Request], pack_id: str) -> None:
         plans = [r.plan for r in batch]
         assign_bases(plans)
         disc = self._dataset(batch[0].tenant, batch[0].discovery)
         test = self._dataset(batch[0].tenant, batch[0].test)
         key = self._pool_key("packed", (disc.digest, test.digest), plans)
-
-        def build():
-            cfg = self.config.engine
-            if disc.beta is not None:
-                # data-only atlas pack (ISSUE 9): the engine derives every
-                # submatrix from data columns with the registered spec
-                cfg = dataclasses.replace(
-                    cfg, network_from_correlation=disc.beta
-                )
-            return PackedEngine(
-                disc.ds.correlation, disc.ds.network, disc.ds.data,
-                test.ds.correlation, test.ds.network, test.ds.data,
-                [p.specs for p in plans], plans[0].pool,
-                config=cfg,
-            )
-
-        engine, hit = self.pool.get(key, build)
+        engine, hit = self.pool.get(
+            key, lambda: self._pack_engine(disc, test, plans)
+        )
         self._emit_pool(hit, pack_id, len(batch))
         if self.tel is not None:
             for r in batch:
@@ -1476,14 +1578,17 @@ class PreservationServer:
         )
         t0 = time.perf_counter()
         try:
-            if self.tel is not None:
-                with self.tel.span("pack", pack=pack_id,
-                                   n_requests=len(batch),
-                                   tenants=sorted({r.tenant
-                                                   for r in batch})):
+            # export-on-miss scope (ISSUE 15): programs this pack had to
+            # jit-compile are serialized for the next boot / fleet peer
+            with self._aot_export_scope():
+                if self.tel is not None:
+                    with self.tel.span("pack", pack=pack_id,
+                                       n_requests=len(batch),
+                                       tenants=sorted({r.tenant
+                                                       for r in batch})):
+                        results = run_pack(engine, plans, **kw)
+                else:
                     results = run_pack(engine, plans, **kw)
-            else:
-                results = run_pack(engine, plans, **kw)
         except BaseException:
             # a failed run may leave the engine's device state suspect —
             # drop it from the warm pool before the error propagates
@@ -1550,19 +1655,20 @@ class PreservationServer:
                          else None)
         t0 = time.perf_counter()
         try:
-            observed = np.asarray(engine.observed(), dtype=np.float64)
-            # fold the T axis into the monitor's cell axis — the
-            # MultiTestEngine adaptive convention (a module retires only
-            # when settled in every cohort); the ceiling monitor rides the
-            # same shape for fixed-n requests
-            obs_cells = np.moveaxis(observed, 0, 1).reshape(plan.k, -1)
-            monitor = PackMonitor([plan], obs_cells)
-            if self.tel is not None:
-                monitor.enable_cost_tracking()
-            nulls, completed, finished = engine.run_null_monitored(
-                plan.n_perm, plan.seed, monitor, telemetry=self.tel,
-                fault_policy=self._fault,
-            )
+            with self._aot_export_scope():
+                observed = np.asarray(engine.observed(), dtype=np.float64)
+                # fold the T axis into the monitor's cell axis — the
+                # MultiTestEngine adaptive convention (a module retires
+                # only when settled in every cohort); the ceiling monitor
+                # rides the same shape for fixed-n requests
+                obs_cells = np.moveaxis(observed, 0, 1).reshape(plan.k, -1)
+                monitor = PackMonitor([plan], obs_cells)
+                if self.tel is not None:
+                    monitor.enable_cost_tracking()
+                nulls, completed, finished = engine.run_null_monitored(
+                    plan.n_perm, plan.seed, monitor, telemetry=self.tel,
+                    fault_policy=self._fault,
+                )
         except BaseException:
             # same warm-pool hygiene as _execute_pack, same
             # BaseException rationale (ISSUE 12)

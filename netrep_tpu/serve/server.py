@@ -238,6 +238,8 @@ def serve_daemon(args) -> int:
         brownout_exit_s=args.brownout_exit_s,
         brownout_rate_pps=args.brownout_rate,
         fleet_label=getattr(args, "fleet_label", None),
+        aot_export=(True if getattr(args, "aot_export", False)
+                    else None),
     )
     server = PreservationServer(cfg)
     stop = threading.Event()

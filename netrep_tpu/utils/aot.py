@@ -1,0 +1,703 @@
+"""Persistent serialized-executable store — zero-compile warm start (ISSUE 15).
+
+The warm :class:`~netrep_tpu.serve.pool.ProgramPool` (ISSUE 7) amortizes
+the jit-compile tax *within* a process; nothing amortizes it *across*
+processes — every replica boot, CLI run, and ``chaos --fleet`` respawn
+re-traces and re-compiles the bucketed null programs from scratch, the
+seconds-scale cost the PR 14 ``serve-fleet-coldstart`` ledger entries
+measure. This module closes that gap with a fingerprinted store of
+``jax.export``-serialized programs:
+
+- **Export** (``netrep warmup``, or any run under ``NETREP_AOT_EXPORT=1``):
+  each program (chunk body, superchunk scan, fused/adaptive counter,
+  observed pass, grouped-keys helpers) is traced once, lowered to
+  portable StableHLO, serialized to the store, and compiled once so the
+  XLA executable lands in the persistent compile cache beside it.
+- **Load** (any later process): the program deserializes — skipping
+  tracing and jax-level lowering entirely — and its XLA compile hits the
+  persistent cache, so the first request runs at steady-state speed:
+  ``compile_span → ~0`` with ``source: aot``.
+- **Fallback ladder** (never wrong, only slower): entry absent, written
+  by a different jax/jaxlib/device/PRNG environment, corrupt, or failing
+  to deserialize/compile ⇒ the normal ``jax.jit`` path compiles exactly
+  as before. Corrupt entries are quarantined (renamed ``*.bad``), never
+  fatal; environment mismatches invalidate silently with a one-shot
+  warning and an ``aot_store_miss`` telemetry event.
+
+**Bit-identity contract**: an AOT-loaded program is the SAME StableHLO
+the jit path lowers (the store serializes the traced program, it never
+re-derives it), so counts, p-values, and adaptive decisions are pinned
+bit-identical to the jit path in all four null-loop modes
+(tests/test_aot.py). Typed PRNG key arrays cross the export boundary as
+their raw ``uint32`` key data (jax 0.4's export cannot serialize extended
+dtypes in the calling convention); ``wrap_key_data``/``key_data`` are
+bit-exact inverses, so the bridge cannot perturb a single draw.
+
+**Identity discipline**: entries are keyed by the engine's
+``autotune_key()`` fingerprint × the program's closed-over constants ×
+the abstract argument signature, and validated against jax/jaxlib
+version, backend platform, device kind, and default PRNG impl recorded
+in each entry's meta sidecar — an engine differing in ANY fingerprint
+component never shares an entry (tests pin this per component). The
+store lives beside the persistent XLA compile cache
+(``.jax_cache/<cpu-fingerprint>/aot/``) under the same host isolation
+rule, and a size-bounded LRU GC (``NETREP_AOT_STORE_MAX_MB``) keeps it
+from growing without bound.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+
+logger = logging.getLogger("netrep_tpu")
+
+#: store directory override (default: ``.jax_cache/<cpu-fp>/aot`` beside
+#: the persistent XLA compile cache)
+STORE_ENV = "NETREP_AOT_STORE"
+#: ``1`` ⇒ runs export programs they had to jit-compile (the warmup CLI
+#: sets this implicitly via :meth:`ProgramStore.exporting`)
+EXPORT_ENV = "NETREP_AOT_EXPORT"
+#: ``0`` ⇒ the store is disabled entirely: every acquisition jits
+DISABLE_ENV = "NETREP_AOT"
+#: LRU GC bound for the on-disk store, in MiB (default 512)
+MAX_MB_ENV = "NETREP_AOT_STORE_MAX_MB"
+
+#: meta-sidecar format (bump deliberately, with the store tests)
+META_FORMAT = 1
+
+#: in-process memo bound: compiled program dispatchers kept alive across
+#: engine instances (the cross-engine analogue of the warm engine pool)
+_MEMO_MAX = 64
+
+_WARNED: set[str] = set()
+
+
+def _telemetry():
+    from .telemetry import current
+
+    return current()
+
+
+def _emit(ev: str, **data) -> None:
+    tel = _telemetry()
+    if tel is not None:
+        tel.emit(ev, **data)
+
+
+def _warn_once(reason: str, msg: str, *args) -> None:
+    """One-shot warning per reason class — store hygiene must be audible
+    exactly once, never a per-chunk log storm."""
+    if reason not in _WARNED:
+        _WARNED.add(reason)
+        logger.warning(msg, *args)
+
+
+def default_dir() -> str:
+    """Store beside the persistent XLA compile cache:
+    ``.jax_cache/<cpu-fingerprint>/aot`` (the same host-isolation rule —
+    see :func:`netrep_tpu.utils.backend.host_cpu_fingerprint`)."""
+    from .backend import host_cpu_fingerprint
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    return os.path.join(
+        repo_root, ".jax_cache", host_cpu_fingerprint(), "aot"
+    )
+
+
+_CODE_SIG: str | None = None
+
+
+def code_signature() -> str:
+    """Content digest of the package's own source files, computed once
+    per process. jax's persistent compile cache is content-addressed (it
+    keys on the HLO itself) and cannot serve a stale program; THIS store
+    keys on metadata, so without a code component an edit to a program
+    body whose fingerprint/constants happen not to change would silently
+    serve the pre-edit program. Any package edit therefore invalidates
+    every entry — conservative, and the store re-warms itself via
+    ``warmup`` / export-on-miss."""
+    global _CODE_SIG
+    if _CODE_SIG is None:
+        h = hashlib.sha256()
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))
+        for dirpath, dirnames, filenames in sorted(os.walk(pkg_root)):
+            dirnames.sort()
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                p = os.path.join(dirpath, fn)
+                h.update(os.path.relpath(p, pkg_root).encode())
+                try:
+                    with open(p, "rb") as f:
+                        h.update(f.read())
+                except OSError:
+                    pass
+        _CODE_SIG = h.hexdigest()[:16]
+    return _CODE_SIG
+
+
+def env_signature() -> str:
+    """The environment identity an entry is only ever valid within:
+    jax × jaxlib version, backend platform, device kind, the default
+    PRNG impl (the raw-key bridge re-wraps key data under it), and the
+    package source digest (:func:`code_signature`). Any mismatch
+    invalidates the entry — serialized StableHLO is portable in
+    principle, but cross-version/device/code reuse is exactly the
+    silent-wrong-speed risk this store refuses to take."""
+    import jax
+    import jaxlib
+
+    try:
+        dev = jax.devices()[0]
+        kind = f"{dev.platform}:{getattr(dev, 'device_kind', '?')}"
+    except RuntimeError:
+        kind = "none"
+    impl = str(jax.config.jax_default_prng_impl)
+    return (f"jax:{jax.__version__}|jaxlib:{jaxlib.__version__}"
+            f"|dev:{kind}|prng:{impl}|code:{code_signature()}")
+
+
+def program_key(autotune_key: str, constants: str, mesh_spec: str) -> str:
+    """Logical identity of one engine program: the engine's autotune/
+    compile-cache fingerprint (backend × gather/stat mode × bucket caps ×
+    chunk × program name), the program's closed-over constants (the parts
+    the abstract argument signature cannot see — slices, net_beta,
+    summary method, resolved perm batch...), and the mesh spec. The
+    environment signature is validated separately from the entry meta, so
+    a version/device mismatch is *detected* (warned + counted), not just
+    an anonymous miss."""
+    return f"{autotune_key}##{constants}##{mesh_spec}"
+
+
+def _abstract_sig(args) -> str:
+    """Stable digest of the calling convention: tree structure + per-leaf
+    (shape, dtype, weak_type). Two processes computing this for the same
+    program arrive at the same string, so variants address the same
+    entry."""
+    import jax
+    from jax.api_util import shaped_abstractify
+
+    flat, tree = jax.tree.flatten(args)
+    parts = [str(tree)]
+    for a in flat:
+        av = shaped_abstractify(a)
+        parts.append(
+            f"{av.shape}/{av.dtype}/{getattr(av, 'weak_type', False)}"
+        )
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
+def _entry_name(key: str, sig: str) -> str:
+    return hashlib.sha256(f"{key}##{sig}".encode()).hexdigest()[:32]
+
+
+_PYTREES_REGISTERED = False
+
+
+def _register_pytree_serialization() -> None:
+    """Register the custom pytree nodes that ride the engine calling
+    conventions (currently :class:`~netrep_tpu.ops.stats.DiscProps`) with
+    jax.export's serializer. Idempotent; a failure only disables export
+    of programs carrying that node (the jit fallback is unaffected)."""
+    global _PYTREES_REGISTERED
+    if _PYTREES_REGISTERED:
+        return
+    _PYTREES_REGISTERED = True
+    from jax import export as jex
+
+    from ..ops.stats import DiscProps
+
+    try:
+        jex.register_namedtuple_serialization(
+            DiscProps, serialized_name="netrep_tpu.ops.stats.DiscProps"
+        )
+    except ValueError:
+        pass  # already registered (re-imported store in one process)
+
+
+def _is_key_leaf(x) -> bool:
+    import jax
+    import jax.numpy as jnp
+
+    dt = getattr(x, "dtype", None)
+    return dt is not None and jnp.issubdtype(dt, jax.dtypes.prng_key)
+
+
+def _to_raw_leaves(leaves, key_pos):
+    import jax
+
+    return [
+        jax.random.key_data(a) if i in key_pos else a
+        for i, a in enumerate(leaves)
+    ]
+
+
+class _Dispatcher:
+    """The callable a successful :meth:`ProgramStore.acquire` returns:
+    per abstract-argument signature it serves the AOT-loaded executable
+    when the store has one, the shared jit fallback otherwise — so a
+    tail-shaped chunk (or a bucket the warmup grid never saw) can never
+    error, only compile. ``ensure`` loads-or-exports a signature without
+    executing it (the warmup path)."""
+
+    def __init__(self, store: "ProgramStore", key: str, jit_fn,
+                 export_fn):
+        self._store = store
+        self._key = key
+        self._jit = jit_fn
+        self._export_fn = export_fn
+        self._variants: dict[str, object] = {}
+        self._missed: set[str] = set()
+        self.primary_source = "jit"
+
+    def __call__(self, *args):
+        sig = _abstract_sig(args)
+        fn = self._variants.get(sig)
+        if fn is not None:
+            return fn(*args)
+        if sig not in self._missed:
+            fn = self._store._load_variant(
+                self._key, sig, args, self._export_fn
+            )
+            if fn is None and self._store.export_enabled:
+                if self._store._export_variant(
+                        self._key, sig, args, self._export_fn):
+                    fn = self._store._load_variant(
+                        self._key, sig, args, self._export_fn
+                    )
+            if fn is not None:
+                self._variants[sig] = fn
+                return fn(*args)
+            self._missed.add(sig)
+        return self._jit(*args)
+
+    def ensure(self, *args) -> str:
+        """Load (or, when exporting is enabled, export + load) the
+        variant for this argument signature without executing it.
+        Returns the resulting source class: ``aot`` when the store now
+        serves this signature, ``jit`` otherwise."""
+        sig = _abstract_sig(args)
+        if sig in self._variants:
+            return "aot"
+        fn = self._store._load_variant(self._key, sig, args,
+                                       self._export_fn)
+        if fn is None and self._store.export_enabled:
+            if self._store._export_variant(self._key, sig, args,
+                                           self._export_fn):
+                fn = self._store._load_variant(self._key, sig, args,
+                                               self._export_fn)
+        if fn is None:
+            return "jit"
+        self._variants[sig] = fn
+        self._missed.discard(sig)
+        return "aot"
+
+
+class ProgramStore:
+    """Fingerprinted store of serialized engine programs + an in-process
+    memo of their dispatchers (the cross-process and cross-engine warm
+    layers under the per-engine jit caches). Thread-safe: the serve
+    preload thread and the scheduler worker share one instance."""
+
+    def __init__(self, path: str | None = None,
+                 max_bytes: int | None = None):
+        self.path = path or os.environ.get(STORE_ENV) or default_dir()
+        if max_bytes is None:
+            try:
+                max_bytes = int(float(
+                    os.environ.get(MAX_MB_ENV, "512")
+                ) * 1024 * 1024)
+            except ValueError:
+                max_bytes = 512 * 1024 * 1024
+        self.max_bytes = max_bytes
+        self._lock = threading.RLock()
+        self._memo: dict[str, _Dispatcher] = {}
+        self._export_depth = 0
+        self._unexportable: set[str] = set()
+        # counters (stats(); tests and the warmup CLI report them)
+        self.loads = 0
+        self.misses = 0
+        self.exports = 0
+        self.quarantined = 0
+
+    # -- acquisition seam (the engine's single entry point) ---------------
+
+    @property
+    def export_enabled(self) -> bool:
+        return (self._export_depth > 0
+                or os.environ.get(EXPORT_ENV) == "1")
+
+    def exporting(self):
+        """Context manager enabling export-on-miss for the scope (the
+        warmup CLI and the serve preload thread run under it)."""
+        store = self
+
+        class _Scope:
+            def __enter__(self):
+                with store._lock:
+                    store._export_depth += 1
+                return store
+
+            def __exit__(self, *exc):
+                with store._lock:
+                    store._export_depth -= 1
+                return False
+
+        return _Scope()
+
+    def acquire(self, key: str, build, *, export_fn=None,
+                example_args=None):
+        """The program-acquisition seam: returns ``(fn, source)`` where
+        ``fn`` has the same calling convention as ``build()``'s result
+        and ``source`` is ``memo`` (in-process reuse), ``aot`` (the
+        primary signature deserialized from the store), or ``jit``
+        (compiled as before). ``export_fn`` is the unjitted program body
+        (required for export and the AOT raw-key bridge); without it —
+        or without ``example_args`` — the store only memoizes."""
+        with self._lock:
+            disp = self._memo.get(key)
+        if disp is not None:
+            if (self.export_enabled and example_args is not None
+                    and hasattr(disp, "ensure")):
+                # an exporting scope (warmup) must persist entries even
+                # for programs this process already acquired and memoized
+                # — and its report shows where the entry stands, not that
+                # this process happened to have run the program before
+                return disp, disp.ensure(*example_args)
+            return disp, "memo"
+        jit_fn = build()
+        if export_fn is None or example_args is None:
+            with self._lock:
+                self._memo_put(key, jit_fn)
+            return jit_fn, "jit"
+        disp = _Dispatcher(self, key, jit_fn, export_fn)
+        source = disp.ensure(*example_args)
+        if source == "jit" and self.export_enabled:
+            # export-on-miss (warmup / NETREP_AOT_EXPORT=1): the entry is
+            # written AND loaded back, so this very process already runs
+            # the deserialized program — export parity is exercised at
+            # export time, not first discovered by a later boot
+            source = disp.ensure(*example_args)
+        disp.primary_source = source
+        with self._lock:
+            self._memo_put(key, disp)
+        return disp, source
+
+    def _memo_put(self, key: str, fn) -> None:
+        self._memo[key] = fn
+        while len(self._memo) > _MEMO_MAX:
+            self._memo.pop(next(iter(self._memo)))
+
+    # -- on-disk entries ---------------------------------------------------
+
+    def _paths(self, key: str, sig: str) -> tuple[str, str]:
+        name = _entry_name(key, sig)
+        return (os.path.join(self.path, name + ".bin"),
+                os.path.join(self.path, name + ".json"))
+
+    def has_entry(self, key: str, sig_args) -> bool:
+        bin_path, _ = self._paths(key, _abstract_sig(sig_args))
+        return os.path.exists(bin_path)
+
+    def _quarantine(self, bin_path: str, meta_path: str,
+                    reason: str) -> None:
+        """A corrupt/undeserializable entry is renamed aside (``*.bad``)
+        — never re-tried, never fatal, observable in ``stats()``."""
+        self.quarantined += 1
+        for p in (bin_path, meta_path):
+            try:
+                os.replace(p, p + ".bad")
+            except OSError:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        _warn_once(
+            f"quarantine:{reason}",
+            "AOT store entry quarantined (%s): %s — the jit path "
+            "compiles as before", reason, bin_path,
+        )
+
+    def _load_variant(self, key: str, sig: str, args, export_fn):
+        """One signature's entry → an executable callable, or None (plain
+        absence, environment mismatch, corruption — each handled per the
+        fallback ladder). On success the entry's mtime is touched (LRU)
+        and the XLA compile is done eagerly here, off the first request's
+        critical path, through the persistent compile cache."""
+        import jax
+
+        bin_path, meta_path = self._paths(key, sig)
+        t0 = time.perf_counter()
+        try:
+            with open(meta_path, encoding="utf-8") as f:
+                meta = json.load(f)
+            if meta.get("format") != META_FORMAT:
+                raise ValueError(f"meta format {meta.get('format')!r}")
+        except OSError:
+            return None  # plain absence: the normal cold path, no event
+        except ValueError:
+            self._quarantine(bin_path, meta_path, "meta-corrupt")
+            self.misses += 1
+            _emit("aot_store_miss", key=key, reason="corrupt")
+            return None
+        if meta.get("env") != env_signature():
+            # written by another jax/jaxlib/device/PRNG environment:
+            # silently invalid here (one-shot warning + counted miss);
+            # re-exporting under this environment replaces it
+            self.misses += 1
+            _emit("aot_store_miss", key=key, reason="env-mismatch")
+            _warn_once(
+                "env-mismatch",
+                "AOT store entries were written under %r (this process: "
+                "%r); they are skipped and the jit path compiles as "
+                "before", meta.get("env"), env_signature(),
+            )
+            return None
+        try:
+            with open(bin_path, "rb") as f:
+                blob = f.read()
+            from jax import export as jex
+
+            _register_pytree_serialization()
+            exported = jex.deserialize(blob)
+        # netrep: allow(exception-taxonomy) — fallback-ladder boundary: ANY deserialization failure (foreign bytes, flatbuffer drift, unregistered node) must quarantine the entry and fall back to jit, never kill the run
+        except Exception as e:
+            self._quarantine(bin_path, meta_path,
+                             f"{type(e).__name__}")
+            self.misses += 1
+            _emit("aot_store_miss", key=key, reason="corrupt")
+            return None
+        kin = frozenset(meta.get("kin") or ())
+        kout = frozenset(meta.get("kout") or ())
+        jitted = jax.jit(exported.call)
+        flat = jax.tree.leaves(args)
+        raw = _to_raw_leaves(flat, kin)
+        compiled = None
+        try:
+            from jax.api_util import shaped_abstractify
+
+            compiled = jitted.lower(
+                *[shaped_abstractify(a) for a in raw]
+            ).compile()
+        # netrep: allow(exception-taxonomy) — fallback-ladder boundary: eager precompile is an optimization; any failure falls back to compile-on-first-call via the jitted wrapper
+        except Exception:
+            compiled = None
+        try:
+            os.utime(bin_path)  # LRU recency for the size-bounded GC
+        except OSError:
+            pass
+
+        out_wrap = None
+        if kout:
+            def out_wrap(res):
+                leaves, tree = jax.tree.flatten(res)
+                leaves = [
+                    jax.random.wrap_key_data(a) if i in kout else a
+                    for i, a in enumerate(leaves)
+                ]
+                return jax.tree.unflatten(tree, leaves)
+
+        state = {"compiled": compiled}
+
+        def fn(*call_args):
+            raw_leaves = _to_raw_leaves(
+                jax.tree.leaves(call_args), kin
+            )
+            comp = state["compiled"]
+            if comp is not None:
+                try:
+                    res = comp(*raw_leaves)
+                # netrep: allow(exception-taxonomy) — fallback-ladder boundary: a sharding/layout mismatch on the precompiled fastpath drops to the jitted wrapper (same program), never to a wrong answer
+                except Exception:
+                    state["compiled"] = None
+                    res = jitted(*raw_leaves)
+            else:
+                res = jitted(*raw_leaves)
+            return out_wrap(res) if out_wrap is not None else res
+
+        self.loads += 1
+        _emit("aot_load", key=key, s=time.perf_counter() - t0,
+              precompiled=compiled is not None,
+              bytes=len(blob))
+        return fn
+
+    def _export_variant(self, key: str, sig: str, args,
+                        export_fn) -> bool:
+        """Trace + lower + serialize one signature of ``export_fn`` into
+        the store (raw-key calling convention), then compile it once so
+        the executable lands in the persistent XLA compile cache. Returns
+        True on success; ANY failure marks the (key, sig) unexportable
+        for this process and leaves the jit path untouched."""
+        import jax
+
+        with self._lock:
+            if (key, sig) in self._unexportable:
+                return False
+        t0 = time.perf_counter()
+        try:
+            from jax import export as jex
+
+            _register_pytree_serialization()
+            flat, in_tree = jax.tree.flatten(args)
+            kin = [i for i, a in enumerate(flat) if _is_key_leaf(a)]
+            out_shape = jax.eval_shape(export_fn, *args)
+            out_leaves = jax.tree.leaves(out_shape)
+            kout = [i for i, a in enumerate(out_leaves)
+                    if _is_key_leaf(a)]
+            kin_set, kout_set = frozenset(kin), frozenset(kout)
+
+            def raw_fn(*raw_leaves):
+                leaves = [
+                    jax.random.wrap_key_data(a) if i in kin_set else a
+                    for i, a in enumerate(raw_leaves)
+                ]
+                res = export_fn(*jax.tree.unflatten(in_tree, leaves))
+                if kout_set:
+                    rl, rt = jax.tree.flatten(res)
+                    rl = [
+                        jax.random.key_data(a) if i in kout_set else a
+                        for i, a in enumerate(rl)
+                    ]
+                    res = jax.tree.unflatten(rt, rl)
+                return res
+
+            raw = _to_raw_leaves(flat, kin_set)
+            from jax.api_util import shaped_abstractify
+
+            raw_abs = [shaped_abstractify(a) for a in raw]
+            exported = jex.export(jax.jit(raw_fn))(*raw_abs)
+            blob = exported.serialize()
+            bin_path, meta_path = self._paths(key, sig)
+            os.makedirs(self.path, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".bin.tmp")
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, bin_path)
+            meta = {
+                "format": META_FORMAT, "key": key, "sig": sig,
+                "env": env_signature(), "kin": sorted(kin),
+                "kout": sorted(kout), "created": time.time(),
+                "bytes": len(blob),
+            }
+            fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".json.tmp")
+            with os.fdopen(fd, "w") as f:
+                json.dump(meta, f)
+            os.replace(tmp, meta_path)
+            # compile once NOW: the executable lands in the persistent
+            # XLA compile cache, so a warm process's eager precompile at
+            # load time is a cache read, not a compile
+            jax.jit(jex.deserialize(blob).call).lower(*raw_abs).compile()
+        # netrep: allow(exception-taxonomy) — fallback-ladder boundary: export of an unexportable program (pallas interpret callbacks, unregistered pytree, OSError on a read-only store) must leave the jit path untouched, never kill the run
+        except Exception as e:
+            with self._lock:
+                self._unexportable.add((key, sig))
+            _warn_once(
+                f"export:{type(e).__name__}",
+                "AOT export failed (%s: %s); the program stays on the "
+                "jit path", type(e).__name__, e,
+            )
+            return False
+        self.exports += 1
+        _emit("aot_export", key=key, s=time.perf_counter() - t0,
+              bytes=len(blob))
+        self.gc()
+        return True
+
+    # -- hygiene -----------------------------------------------------------
+
+    def gc(self) -> int:
+        """Size-bounded LRU GC: quarantined ``*.bad`` files go first,
+        then the least-recently-used entries beyond ``max_bytes``.
+        Returns the number of files removed. Best-effort — an unlistable
+        store directory disables nothing but the bound."""
+        removed = 0
+        try:
+            names = os.listdir(self.path)
+        except OSError:
+            return 0
+        for n in names:
+            if n.endswith(".bad"):
+                try:
+                    os.unlink(os.path.join(self.path, n))
+                    removed += 1
+                except OSError:
+                    pass
+        entries = []
+        total = 0
+        for n in names:
+            if not n.endswith(".bin"):
+                continue
+            p = os.path.join(self.path, n)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, st.st_size, p))
+            total += st.st_size
+        entries.sort()  # oldest first
+        for _mt, size, p in entries:
+            if total <= self.max_bytes:
+                break
+            for q in (p, p[:-4] + ".json"):
+                try:
+                    os.unlink(q)
+                    removed += 1
+                except OSError:
+                    pass
+            total -= size
+        return removed
+
+    def stats(self) -> dict:
+        n, total = 0, 0
+        try:
+            for name in os.listdir(self.path):
+                if name.endswith(".bin"):
+                    n += 1
+                    try:
+                        total += os.stat(
+                            os.path.join(self.path, name)
+                        ).st_size
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        return {
+            "path": self.path, "entries": n, "bytes": total,
+            "loads": self.loads, "misses": self.misses,
+            "exports": self.exports, "quarantined": self.quarantined,
+            "memo": len(self._memo),
+        }
+
+
+_STORE: ProgramStore | None = None
+_STORE_LOCK = threading.Lock()
+
+
+def get_store() -> ProgramStore | None:
+    """The process-wide store singleton, or None when ``NETREP_AOT=0``
+    (every acquisition then jits exactly as before the store existed)."""
+    if os.environ.get(DISABLE_ENV) == "0":
+        return None
+    global _STORE
+    with _STORE_LOCK:
+        if _STORE is None:
+            _STORE = ProgramStore()
+        return _STORE
+
+
+def reset_store() -> None:
+    """Drop the singleton (tests re-point ``NETREP_AOT_STORE`` between
+    cases; a long-lived process never needs this)."""
+    global _STORE
+    with _STORE_LOCK:
+        _STORE = None

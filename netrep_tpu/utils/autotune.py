@@ -205,6 +205,22 @@ def resolve_superchunk(config, key: str, default: int = DEFAULT_SUPERCHUNK):
     return (best if best is not None and best > 0 else default), cache
 
 
+def peek_superchunk(config, key: str,
+                    default: int = DEFAULT_SUPERCHUNK) -> int:
+    """The superchunk :func:`resolve_superchunk` WILL resolve for
+    ``(config, key)``, without emitting autotune telemetry or returning a
+    recording handle — the AOT program builder (ISSUE 15) needs the value
+    to shape the superchunk program's abstract signature before the
+    streaming run resolves it for real."""
+    explicit = getattr(config, "superchunk", None)
+    if explicit is not None:
+        return max(1, int(explicit))
+    if not getattr(config, "autotune", False):
+        return default
+    best = AutotuneCache().best_setting(key)
+    return best if best is not None and best > 0 else default
+
+
 #: static fallback for the atlas tile pass's tile edge (ISSUE 9) when
 #: nothing has been measured yet: a 1024-row block keeps the per-dispatch
 #: working set (one (edge, n) correlation strip + its derived-net twin in

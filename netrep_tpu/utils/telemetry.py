@@ -274,6 +274,9 @@ class BucketHistogram:
 #: and the dashboards/summarizers keyed on these names.
 ENGINE_EVENTS = (
     "allgather",
+    "aot_export",
+    "aot_load",
+    "aot_store_miss",
     "autotune_hit",
     "autotune_miss",
     "autotune_record",
@@ -308,6 +311,8 @@ SPAN_EVENTS = (
     "serve_end",
     "tile_pass_start",
     "tile_pass_end",
+    "warmup_start",
+    "warmup_end",
 )
 
 #: the union the ``telemetry-registry`` lint rule checks literal event

@@ -327,9 +327,15 @@ def time_split(events: Iterable[dict]) -> dict | None:
     - ``host_s``     — the remainder: python loop, monitor folds,
       checkpoint writes, progress callbacks.
 
+    ``compile_by_src`` splits the raw compile estimate by each
+    ``compile_span`` event's acquisition ``source`` (``aot``/``jit``/
+    ``memo``, ISSUE 15) — warm and cold compile history never mix in the
+    report (events predating the tag count as ``jit``).
+
     Returns None when the stream has no closed null run."""
     total = dispatch_raw = transfer = compile_raw = 0.0
     n_runs = 0
+    by_src: dict[str, float] = {}
     for e in events:
         d = e.get("data") or {}
         if e["ev"] == "null_run_end" and _is_num(d.get("s")):
@@ -339,6 +345,8 @@ def time_split(events: Iterable[dict]) -> dict | None:
             dispatch_raw += float(d["s"])
         elif e["ev"] == "compile_span" and _is_num(d.get("s")):
             compile_raw += float(d["s"])
+            src = str(d.get("source") or "jit")
+            by_src[src] = by_src.get(src, 0.0) + float(d["s"])
         if _is_num(d.get("transfer_s")):
             transfer += float(d["transfer_s"])
     if not n_runs:
@@ -352,6 +360,7 @@ def time_split(events: Iterable[dict]) -> dict | None:
         "dispatch_s": dispatch_raw - compile_s,
         "transfer_s": transfer,
         "host_s": host,
+        "compile_by_src": by_src,
     }
 
 
@@ -367,8 +376,17 @@ def render_time_split(path: str) -> str:
         f"({split['total_s']:.3f}s total):"
     ]
     for k in ("compile_s", "dispatch_s", "transfer_s", "host_s"):
+        src = ""
+        if k == "compile_s" and split.get("compile_by_src"):
+            # the src column (ISSUE 15): where each run's compile estimate
+            # came from — `jit` compiled cold, `aot` deserialized from the
+            # warm-start store, `memo` reused in-process
+            src = "  src: " + " ".join(
+                f"{s}={v:.3f}s"
+                for s, v in sorted(split["compile_by_src"].items())
+            )
         lines.append(
             f"  {k[:-2]:<9} {split[k]:>10.3f}s  "
-            f"{100.0 * split[k] / total:5.1f}%"
+            f"{100.0 * split[k] / total:5.1f}%{src}"
         )
     return "\n".join(lines)

@@ -1,0 +1,261 @@
+"""``python -m netrep_tpu warmup`` — pre-export the engine program grid
+(ISSUE 15).
+
+A fresh process pays a seconds-scale jit-compile tax on its first null
+run — the one cost the warm engine pool cannot amortize across replica
+boots, CLI runs, or fleet respawns. This module populates the AOT store
+(:mod:`netrep_tpu.utils.aot`) ahead of time: for each requested problem
+shape it builds the engines a serving replica (the packed serve path)
+and a direct ``module_preservation`` call would build, traces their
+bucketed null programs once (chunk body, superchunk scan, adaptive
+counter, observed pass, grouped-keys helpers), serializes them with
+``jax.export``, and compiles them once into the persistent XLA compile
+cache — after which any process sharing the store answers its first
+request at steady-state speed (``compile_span ~0``, ``source: aot``).
+
+``--measure`` is the proof half: in a (fresh) process it builds the
+serve-path engine for the same shape, runs one null, and reports the
+run's measured ``compile_span`` and its acquisition source — the number
+``benchmarks/serve_load.py --warmstart`` and the ``tpu_watch.sh``
+warmstart step assert on.
+
+Shapes are fixture-parameterized exactly like the serve plane's
+``register_fixture`` (same generator, same module assignment), so
+warming ``--genes/--modules/--samples`` warms precisely the programs a
+fixture-driven replica serves. Arbitrary registered datasets warm
+themselves instead: replicas export-on-miss (``ServeConfig.aot_export``)
+and preload at boot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+
+def _fixture(genes: int, modules: int, samples: int, seed: int):
+    """The serve plane's fixture: same generator + assignment derivation
+    as ``PreservationServer.register_fixture``, so shapes match bit-for-
+    bit."""
+    from .data import make_mixed_pair
+
+    mixed = make_mixed_pair(genes, modules, n_samples=samples, seed=seed)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    assign = {f"node_{i}": "0" for i in range(dn.shape[0])}
+    for lab, idx in mixed["specs"]:
+        for i in idx:
+            assign[f"node_{i}"] = str(lab)
+    return mixed, assign
+
+
+def _serve_engine(genes: int, modules: int, samples: int, seed: int,
+                  chunk: int, n_perm: int | None):
+    """The EXACT packed engine a serve replica's first request for this
+    fixture builds (solo pack): derived through the scheduler's own
+    registration + plan + builder path, so the program identity cannot
+    drift from production."""
+    from .serve.scheduler import PreservationServer, ServeConfig
+    from .utils.config import EngineConfig
+
+    srv = PreservationServer(
+        ServeConfig(engine=EngineConfig(chunk_size=chunk, autotune=False),
+                    journal=None, preload_aot=False),
+        start=False,
+    )
+    try:
+        names = srv.register_fixture("warmup", genes=genes,
+                                     modules=modules, n_samples=samples,
+                                     seed=seed)
+        d = srv._dataset("warmup", names["discovery"])
+        t = srv._dataset("warmup", names["test"])
+        plan = srv._build_plan(d, t, None, n_perm=n_perm, seed=0,
+                               alternative="greater", adaptive=False,
+                               rule=None)
+        plan.base = 0
+        return srv._pack_engine(d, t, [plan]), plan.n_perm
+    finally:
+        srv.close(drain=False)
+
+
+def _direct_engine(genes: int, modules: int, samples: int, seed: int,
+                   chunk: int):
+    """The engine a direct ``module_preservation`` call for this fixture
+    builds (mesh-free, replicated): same ``_overlap_setup``, same
+    constructor, same config defaults."""
+    from .models.preservation import _overlap_setup
+    from .parallel.engine import PermutationEngine
+    from .utils.config import EngineConfig
+    from . import data as dmod  # noqa: F401  (fixture import path parity)
+    from .models import dataset as ds
+
+    mixed, assign = _fixture(genes, modules, samples, seed)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    built = ds.build_datasets(
+        network={"d": dn, "t": tn}, correlation={"d": dc, "t": tc},
+        data={"d": dd, "t": td},
+    )
+    norm = ds.normalize_module_assignments(assign, built, ["d"])["d"]
+    _labels, specs, _counts, pool = _overlap_setup(
+        built["d"], built["t"], norm, None, "0", "overlap"
+    )
+    return PermutationEngine(
+        built["d"].correlation, built["d"].network, built["d"].data,
+        built["t"].correlation, built["t"].network, built["t"].data,
+        specs, pool, config=EngineConfig(chunk_size=chunk),
+    )
+
+
+def parse_grid(spec: str | None, genes: int, modules: int,
+               samples: int) -> list[tuple[int, int, int]]:
+    """``--grid "300:6:24,600:10:24"`` → shape triples; None → the single
+    shape from the scalar flags."""
+    if not spec:
+        return [(genes, modules, samples)]
+    out = []
+    for part in spec.split(","):
+        g, m, s = (int(x) for x in part.strip().split(":"))
+        out.append((g, m, s))
+    return out
+
+
+def warmup_grid(shapes, chunk: int, n_perm: int | None,
+                fixture_seed: int = 7, target: str = "both",
+                telemetry=None) -> dict:
+    """Export the program grid for every shape; returns the per-shape,
+    per-target ``{program: source}`` report plus store stats. Wrapped in
+    a ``warmup_start``/``warmup_end`` span when a telemetry bus is
+    active."""
+    from .utils import aot
+    from .utils import telemetry as tm
+
+    store = aot.get_store()
+    tel, owned = tm.resolve_arg(telemetry)
+    sid = None
+    if tel is not None:
+        sid = tel.begin_span("warmup_start", shapes=len(shapes),
+                             chunk=int(chunk), target=target)
+    t0 = time.perf_counter()
+    report: dict = {"shapes": [], "chunk": int(chunk), "target": target}
+    try:
+        for genes, modules, samples in shapes:
+            row: dict = {"genes": genes, "modules": modules,
+                         "samples": samples}
+            if target in ("serve", "both"):
+                eng, np_this = _serve_engine(
+                    genes, modules, samples, fixture_seed, chunk, n_perm
+                )
+                row["serve"] = eng.warmup_export(np_this)
+                eng.release()
+            if target in ("direct", "both"):
+                eng = _direct_engine(genes, modules, samples,
+                                     fixture_seed, chunk)
+                row["direct"] = eng.warmup_export(n_perm or 0)
+                eng.release()
+            report["shapes"].append(row)
+    finally:
+        report["s"] = round(time.perf_counter() - t0, 3)
+        if store is not None:
+            report["store"] = store.stats()
+        if tel is not None:
+            tel.end_span(sid, "warmup_end", s=report["s"],
+                         shapes=len(report["shapes"]))
+            if owned:
+                tel.close()
+    return report
+
+
+def measure_first_run(genes: int, modules: int, samples: int,
+                      fixture_seed: int, chunk: int,
+                      n_perm: int) -> dict:
+    """The warm-start proof measurement: build the serve-path engine for
+    this shape IN THIS PROCESS (run it fresh for an honest cold/warm
+    number), run one fixed-n null under a private telemetry bus, and
+    report the run's ``compile_span`` estimate, its acquisition source,
+    and the wall/steady throughput."""
+    from .utils import telemetry as tm
+
+    eng, _ = _serve_engine(genes, modules, samples, fixture_seed, chunk,
+                           n_perm)
+    fd, tel_path = tempfile.mkstemp(suffix=".jsonl",
+                                    prefix="netrep_warmup_")
+    os.close(fd)
+    try:
+        tel, _owned = tm.resolve_arg(tel_path)
+        t0 = time.perf_counter()
+        try:
+            _nulls, completed = eng.run_null(
+                n_perm, key=[0], telemetry=tel
+            )
+        finally:
+            tel.close()
+        wall = time.perf_counter() - t0
+        compile_s, source = None, None
+        with open(tel_path, encoding="utf-8") as f:
+            for line in f:
+                if '"compile_span"' not in line:
+                    continue
+                e = json.loads(line)
+                if e.get("ev") == "compile_span":
+                    compile_s = float(e["data"].get("s", 0.0))
+                    source = e["data"].get("source")
+        return {
+            "genes": int(genes), "modules": int(modules),
+            "samples": int(samples), "chunk": int(chunk),
+            "n_perm": int(n_perm), "completed": int(completed),
+            "first_run_s": round(wall, 3),
+            "compile_span_s": (round(compile_s, 4)
+                               if compile_s is not None else None),
+            "source": source,
+            "perms_per_sec": round(completed / wall, 2) if wall > 0 else 0,
+        }
+    finally:
+        eng.release()
+        try:
+            os.unlink(tel_path)
+        except OSError:
+            pass
+
+
+def main_warmup(args) -> int:
+    """CLI entry (dispatched from ``__main__``): export the grid, or
+    ``--measure`` the first-run compile span for the shape."""
+    from .utils import aot
+
+    if args.store:
+        os.environ[aot.STORE_ENV] = args.store
+        aot.reset_store()
+    if args.measure:
+        out = measure_first_run(args.genes, args.modules, args.samples,
+                                args.fixture_seed, args.chunk,
+                                args.n_perm or 256)
+        print(json.dumps(out) if args.json else (
+            f"first run {out['first_run_s']}s, compile_span "
+            f"{out['compile_span_s']}s (source: {out['source']}), "
+            f"{out['perms_per_sec']} perms/s"
+        ))
+        return 0
+    shapes = parse_grid(args.grid, args.genes, args.modules, args.samples)
+    report = warmup_grid(shapes, args.chunk, args.n_perm,
+                         fixture_seed=args.fixture_seed,
+                         target=args.target, telemetry=args.telemetry)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        for row in report["shapes"]:
+            for tgt in ("serve", "direct"):
+                if tgt in row:
+                    progs = ", ".join(
+                        f"{k}={v}" for k, v in row[tgt].items()
+                    )
+                    print(f"{row['genes']}g/{row['modules']}m/"
+                          f"{row['samples']}s [{tgt}]: {progs}")
+        st = report.get("store") or {}
+        print(f"warmup done in {report['s']}s: "
+              f"{st.get('entries', 0)} store entries "
+              f"({st.get('bytes', 0)} bytes), "
+              f"{st.get('exports', 0)} exported this run")
+    return 0

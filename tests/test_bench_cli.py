@@ -150,6 +150,72 @@ def test_serve_fleet_smoke():
     assert row["perms_per_sec"] > 0 and row["perms_per_sec_1replica"] > 0
     assert row["vs_1_replica"] > 0
     assert row["p99_ms"] >= row["p50_ms"] > 0
+    # warm-start accounting (ISSUE 15): the fleet row reports the first
+    # completed request's latency and the worst replica's first compile
+    # span (+ source) against the PR 14 coldstart ledger baseline
+    assert row["first_request_ms"] > 0
+    assert row["coldstart_compile_s"] >= 0
+    assert "coldstart_src" in row and "coldstart_baseline_s" in row
+
+
+@pytest.mark.slow
+def test_serve_warmstart_smoke():
+    """The watcher's WARMSTART step (ISSUE 15): cold fresh-process
+    first-request compile span vs the same measurement against a
+    warmup-populated store — `warm_ok` (source=aot, warm < cold) is
+    asserted by the scenario's own exit code."""
+    proc = _run_cpu_subprocess(
+        [sys.executable, "benchmarks/serve_load.py", "--smoke",
+         "--warmstart"],
+        timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["metric"].startswith("serve-warmstart")
+    assert row["warm_ok"] is True
+    assert row["warm_source"] == "aot" and row["cold_source"] == "jit"
+    assert row["value"] < row["cold_compile_span_s"]
+
+
+def test_warmstart_bench_helpers(tmp_path):
+    """Unit pins for the serve-warmstart scenario's parsers: the PR 14
+    coldstart baseline is the median of matching ledger entries, and the
+    per-replica compile-span scan keeps the worst FIRST-fingerprint span
+    with its source."""
+    import importlib
+
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    sl = importlib.import_module("serve_load")
+
+    ledger = tmp_path / "ledger.jsonl"
+    rows = [
+        {"perf_v": 1, "t": 1.0, "source": "serve", "round": None,
+         "run": None, "fingerprint": f"serve-fleet-coldstart|r0|cpu",
+         "backend": "cpu", "mode": "fleet-coldstart",
+         "perms_per_sec": 10.0, "compile_s": s, "n_perm": 32,
+         "metric": "serve-fleet coldstart r0"}
+        for s in (1.0, 3.0, 2.0)
+    ] + [{"perf_v": 1, "t": 1.0, "source": "serve", "round": None,
+          "run": None, "fingerprint": "other", "backend": "cpu",
+          "mode": None, "perms_per_sec": 5.0, "compile_s": 99.0,
+          "n_perm": 8, "metric": "x"}]
+    ledger.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    assert sl._coldstart_baseline(str(ledger)) == 2.0   # median, no mixing
+    assert sl._coldstart_baseline(None) is None
+    assert sl._coldstart_baseline(str(tmp_path / "missing")) is None
+
+    tel = tmp_path / "r0_tel.jsonl"
+    evs = [
+        {"v": 1, "t": 1.0, "m": 0.0, "run": "x", "ev": "compile_span",
+         "data": {"s": 0.8, "key": "k1", "source": "jit"}},
+        {"v": 1, "t": 2.0, "m": 0.0, "run": "x", "ev": "compile_span",
+         "data": {"s": 5.0, "key": "k1", "source": "jit"}},  # repeat key
+        {"v": 1, "t": 3.0, "m": 0.0, "run": "x", "ev": "compile_span",
+         "data": {"s": 1.2, "key": "k2", "source": "aot"}},
+    ]
+    tel.write_text("\n".join(json.dumps(e) for e in evs) + "\n")
+    worst, src = sl._first_compile_spans([str(tel)])
+    assert worst == 1.2 and src == "aot"   # repeat-key span never counts
 
 
 @pytest.mark.slow

@@ -82,7 +82,12 @@ def test_resolve_profile_dir():
     assert resolve_profile_dir("/x/y") == "/x/y"
 
 
+@pytest.mark.slow
 def test_profile_dataless_run(toy_pair_module, tmp_path):
+    # slow tier (ISSUE 15 wall-clock satellite): the dataless ENGINE path
+    # is pinned by the engine/e2e suites and the profiling machinery by
+    # test_profile_attaches_timings_and_trace — this full extra
+    # module_preservation run only re-proves their composition
     res = module_preservation(
         **_kwargs(toy_pair_module, with_data=False),
         n_perm=32, profile=str(tmp_path / "t2"),

@@ -71,6 +71,13 @@ def test_classify_provenance_rules():
         ({"replicas": 2, "requests": 3, "killed_replica": "r0",
           "recovered": True, "bit_identical": True, "ok": True},
          "serve-fleet"),
+        # warm-start proof rows (ISSUE 15): CPU by design, classified
+        # into their own section — never a BASELINE measurement, and
+        # never confused with the serve-fleet prefix
+        ({"metric": "serve-warmstart fresh-process first-request "
+                    "(100g/3m, chunk 32)", "value": 0.0031, "unit": "s",
+          "cold_compile_span_s": 1.25, "warm_source": "aot",
+          "warm_ok": True, "device": "TFRT_CPU_0"}, "serve-warmstart"),
     ]
     for row, want in cases:
         assert classify(row) == want, (row, classify(row), want)
@@ -111,6 +118,27 @@ def test_fleet_section_renders():
     assert "failover=0.25s" in text and "vs_1_replica=2.01" in text
     assert "chaos --fleet PASSED" in text
     assert "killed=r0" in text and "bit_identical=True" in text
+
+
+def test_warmstart_section_renders():
+    """ISSUE 15: the warm-start section shows the newest proof row —
+    warm vs cold compile span, source, verdict, and the delta vs the
+    PR 14 coldstart baseline when a ledger history exists."""
+    rows = [
+        {"metric": "serve-warmstart fresh-process first-request "
+                   "(100g/3m, chunk 32)", "value": 0.0031, "unit": "s",
+         "cold_compile_span_s": 1.25, "warm_source": "aot",
+         "coldstart_baseline_s": 0.9, "coldstart_delta_s": 0.8969,
+         "warm_ok": True, "device": "TFRT_CPU_0"},
+    ]
+    text = "\n".join(summarize_watch.warmstart_lines(rows))
+    assert "serve-warmstart fresh-process first-request" in text
+    assert "warm compile_span 0.0031s (source=aot)" in text
+    assert "vs cold 1.25s — OK" in text
+    assert "baseline 0.9s" in text and "delta 0.8969s" in text
+
+    rows[0]["warm_ok"] = False
+    assert "FAILED" in "\n".join(summarize_watch.warmstart_lines(rows))
 
 
 def test_cli_sections_account_for_every_parseable_row(tmp_path):
