@@ -41,6 +41,12 @@ BASELINE.md):
                      north-star problem shape at a reduced permutation count
                      (default 50) — the per-config "oracle-CPU" baseline row;
                      combine with --genes/--modules for other shapes
+    --config mixed   mixed-precision screened null (ISSUE 16,
+                     null_precision=bf16_rescue): bf16 fast pass + exact
+                     f32 rescue vs the all-f32 loop on the same problem
+                     and key — one row with both wall-clocks and the
+                     rescued fraction (pinned-equal-counts gate asserted
+                     before any number is emitted)
     --config sharded delegates to benchmarks/microbench_sharded_gather.py
 
 Usage: python bench.py [--config X] [--genes N] [--modules K] [--perms P]
@@ -914,6 +920,152 @@ def bench_superchunk(args):
     })
 
 
+def bench_mixed(args):
+    """Mixed-precision screened null row (ISSUE 16,
+    ``null_precision='bf16_rescue'``): the bf16 fast pass with exact f32
+    rescue vs the all-f32 loop on the SAME problem and key.
+
+    The pinned-equal-counts gate runs BEFORE any row is emitted — on
+    every backend, the screened run's exceedance counts must equal the
+    all-f32 run's EXACTLY (the screen's by-construction contract; no
+    tolerance, unlike the fused-kernel gate), so a fast-but-wrong row is
+    impossible. The headline row is the north-star shape at
+    ``--config mixed`` on a live TPU, where the MXU consumes bf16
+    operands at ~2x the f32 rate; on the CPU fallback the bf16 rounding
+    is emulated (the pass costs MORE, not less), so the row is an
+    explicit reduced-shape mechanism row with ``vs_baseline`` nulled —
+    parity and rescued-fraction mechanics stay honest, the wall-clock is
+    not a device measurement. Metric labels carry the ``mixed`` prefix
+    so perf-ledger fingerprints never mix precision paths."""
+    import json as _json
+    import os
+    import tempfile
+
+    import jax
+
+    from netrep_tpu.data import make_mixed_pair
+    from netrep_tpu.ops import pvalues as pv
+    from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+    from netrep_tpu.utils.config import EngineConfig
+    from netrep_tpu.utils.telemetry import Telemetry
+
+    resolve(args, 20_000, 50, 10_000)
+    on_cpu = jax.default_backend() == "cpu"
+
+    def make_engine(mixed, null_precision, chunk):
+        (dd, dc, dn) = mixed["discovery"]
+        (td, tc, tn) = mixed["test"]
+        specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+        # stat_mode pinned to the XLA composition: 'auto' resolves to the
+        # fused mega-kernel on TPU, where the screen degrades to f32
+        cfg = EngineConfig(
+            chunk_size=chunk, power_iters=40, dtype=args.dtype,
+            superchunk=8, autotune=False, stat_mode="xla",
+            gather_mode=args.gather_mode, null_precision=null_precision,
+        )
+        return PermutationEngine(
+            dc, dn, dd, tc, tn, td, specs, mixed["pool"], config=cfg
+        )
+
+    def rescued_fraction(run):
+        """Run a screened null under a scratch telemetry bus and read the
+        whole-pass rescued fraction off its ``null_pass_end`` event."""
+        with tempfile.TemporaryDirectory() as td_:
+            path = os.path.join(td_, "mixed.jsonl")
+            tel = Telemetry(path, run_id="bench-mixed")
+            out = run(tel)
+            tel.close()
+            frac = None
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    ev = _json.loads(line)
+                    if ev.get("ev") == "null_pass_end":
+                        frac = float(ev["data"]["fraction"])
+        return out, frac
+
+    # ---- pinned-equal-counts gate (every backend, before any row) -------
+    gate = make_mixed_pair(320, 6, n_samples=32, seed=7)
+    g_perms = 192
+    e32 = make_engine(gate, "f32", 32)
+    obs_g = np.asarray(e32.observed())
+    nulls_g, done_g = e32.run_null(g_perms, key=0)
+    hi_m, lo_m, eff_m = pv.tail_counts(obs_g, np.asarray(nulls_g)[:done_g])
+    ebf = make_engine(gate, "bf16_rescue", 32)
+    nulls_b, done_b = ebf.run_null(g_perms, key=0, observed=obs_g)
+    hi_b, lo_b, eff_b = pv.tail_counts(obs_g, np.asarray(nulls_b)[:done_b])
+    assert (hi_b == hi_m).all() and (lo_b == lo_m).all() and \
+        (eff_b == eff_m).all(), \
+        "screened materialized counts != all-f32 counts at the gate"
+    sc_b = ebf.run_null_streaming(g_perms, obs_g, key=0)
+    assert (sc_b.hi == hi_m).all() and (sc_b.lo == lo_m).all() and \
+        (sc_b.eff == eff_m).all(), \
+        "screened streaming tallies != all-f32 counts at the gate"
+
+    # ---- timed row ------------------------------------------------------
+    if on_cpu:
+        # emulated bf16 rounding on CPU: mechanism row, reduced shape
+        genes, modules, perms, chunk = 800, 8, 256, 64
+        if args.smoke:
+            genes, modules, perms, chunk = 400, 6, 96, 32
+    else:
+        genes, modules, perms, chunk = (
+            args.genes, args.modules, args.perms, args.chunk
+        )
+    mixed = make_mixed_pair(genes, modules, n_samples=args.samples, seed=7)
+    eng_f32 = make_engine(mixed, "f32", chunk)
+    observed = np.asarray(eng_f32.observed())
+    warm = 8 * chunk
+    _ = eng_f32.run_null_streaming(warm, observed, key=99)  # compile
+    t0 = time.perf_counter()
+    sc_ref = eng_f32.run_null_streaming(perms, observed, key=0)
+    f32_s = time.perf_counter() - t0
+    assert sc_ref.completed == perms
+
+    eng_bf = make_engine(mixed, "bf16_rescue", chunk)
+    _ = eng_bf.run_null_streaming(warm, observed, key=99)
+    t0 = time.perf_counter()
+    sc, frac = rescued_fraction(
+        lambda tel: eng_bf.run_null_streaming(
+            perms, observed, key=0, telemetry=tel
+        )
+    )
+    mixed_s = time.perf_counter() - t0
+    assert sc.completed == perms
+    assert (sc.hi == sc_ref.hi).all() and (sc.lo == sc_ref.lo).all() and \
+        (sc.eff == sc_ref.eff).all(), \
+        "screened streaming tallies != all-f32 at the timed shape"
+
+    row = {
+        "metric": (
+            f"mixed bf16-screened {perms}-perm null, {genes} genes / "
+            f"{modules} modules (null_precision=bf16_rescue streaming vs "
+            f"f32, chunk {chunk})"
+        ),
+        "value": round(mixed_s, 3),
+        "unit": "s",
+        "vs_baseline": round(TARGET_SECONDS / mixed_s, 4),
+        "f32_s": round(f32_s, 3),
+        "mixed_vs_f32_x": round(f32_s / mixed_s, 3),
+        "perms_per_sec": round(perms / mixed_s, 2),
+        "f32_perms_per_sec": round(perms / f32_s, 2),
+        "rescued_fraction": None if frac is None else round(frac, 4),
+        "counts_parity": True,  # asserted above, both shapes, exact
+        "device": str(jax.devices()[0]),
+        "dtype": args.dtype,
+        "chunk": chunk,
+    }
+    if on_cpu:
+        row["tpu_fallback"] = TPU_FALLBACK
+        row["metric"] += (
+            " [CPU emulated bf16 rounding: parity/mechanism row, reduced "
+            "shape — the screen only pays off on MXU hardware]"
+        )
+        # an emulated-rounding wall-clock must never be read against the
+        # <60 s target (it is not a device measurement)
+        row["vs_baseline"] = None
+    return emit(row)
+
+
 def bench_pallas(args):
     """Fused-statistics mega-kernel row (ISSUE 8, ``stat_mode='fused'``):
     the Pallas gather+stats+tally kernel driving the streaming executor vs
@@ -1523,7 +1675,8 @@ def main():
     ap.add_argument("--config", default="north",
                     choices=["north", "A", "B", "C", "D", "E", "oracle",
                              "native", "sharded", "adaptive", "superchunk",
-                             "multichip", "serve", "pallas", "atlas"])
+                             "multichip", "serve", "pallas", "atlas",
+                             "mixed"])
     ap.add_argument("--devices", type=int, default=None,
                     help="multichip child marker: measure ONE scaling "
                          "point on this many devices (the parent spawns "
@@ -1575,7 +1728,7 @@ def main():
 
     if (args.config in ("north", "A", "B", "C", "D", "E", "sharded",
                         "adaptive", "superchunk", "serve", "pallas",
-                        "atlas")
+                        "atlas", "mixed")
             and tunnel_expected()
             and not os.environ.get("NETREP_BENCH_NO_SUBPROC")):
         # every config that may touch the tunnel backend (A runs the JAX
@@ -1672,6 +1825,7 @@ def main():
         "C": bench_c, "D": bench_d, "E": bench_e, "oracle": bench_oracle,
         "adaptive": bench_adaptive, "superchunk": bench_superchunk,
         "pallas": bench_pallas, "atlas": bench_atlas,
+        "mixed": bench_mixed,
     }[args.config](args)
 
 

@@ -99,6 +99,21 @@ if [ "${ATLAS_STEP:-0}" = "1" ]; then
   QUEUE+=("configAtlas 3600 python bench.py --config atlas")
 fi
 
+# Mixed-precision null screening step (ISSUE 16; opt-in: MIXED_STEP=1):
+# the screened bf16 fast-pass vs the all-f32 null at the north-star
+# shape — bf16/f32 bit-parity of the tail counts is asserted in-bench
+# (materialized AND streaming) before any timed row, and the row carries
+# the rescued fraction + wall-clock ratio vs f32. A real measurement
+# only on TPU (the CPU fallback emits the labeled reduced-shape
+# mechanism row with vs_baseline nulled: bf16 rounding is emulated on
+# CPU, so the screen cannot pay off there). stat_mode is pinned 'xla'
+# in-bench — the screen feeds the existing XLA chunk body — so this
+# step deliberately does NOT ride the fused parity gate. Perf-ledger
+# rows land under the row's own `mixed` metric fingerprint.
+if [ "${MIXED_STEP:-0}" = "1" ]; then
+  QUEUE+=("configMixed 1800 python bench.py --config mixed")
+fi
+
 # Test hooks (tests/test_tpu_watch_logic.py): QUEUE_FILE replaces the
 # queue (one "<key> <timeout> <cmd...>" per line) and PROBE_CMD replaces
 # the tunnel dial, so the state machine — resume, fallback, parity

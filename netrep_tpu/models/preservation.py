@@ -333,6 +333,25 @@ def module_preservation(
     else:
         engine_cls = PermutationEngine
     config = config or EngineConfig()
+    if config.null_precision == "auto":
+        # pin the screened-null decision (ISSUE 16) ONCE for the whole
+        # analysis: the elastic ladder's CPU rung rebuilds engines on a
+        # different backend, and a precision flip there would change the
+        # checkpoint fingerprint mid-recovery and refuse its own resume.
+        # The pin mirrors the engine's own degrade conditions (fused
+        # statistics/gather, row sharding) so the explicit value never
+        # trips the engine's unsupported-combination init error.
+        import jax
+
+        platform = jax.default_backend()
+        prec = config.resolved_null_precision(platform)
+        if prec == "bf16_rescue" and (
+            config.resolved_stat_mode(platform) == "fused"
+            or config.resolved_gather_mode(platform) == "fused"
+            or config.matrix_sharding == "row"
+        ):
+            prec = "f32"
+        config = dataclasses.replace(config, null_precision=prec)
 
     ft = resolve_runtime(fault_policy)
     emergency_dir = None
@@ -484,6 +503,7 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
         nulls, completed = engine.run_null(
             np_this, key=seed, progress=prog, checkpoint_path=ck,
             checkpoint_every=checkpoint_every, fault_policy=ft,
+            observed=observed,
         )
         return nulls, None, completed, completed < np_this
 

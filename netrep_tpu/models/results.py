@@ -19,6 +19,7 @@ except Exception:  # pragma: no cover
     pd = None
 
 from ..ops.oracle import STAT_NAMES
+from ..utils import telemetry as tm
 
 
 @dataclasses.dataclass
@@ -77,6 +78,14 @@ class PreservationResult:
                                   # when no null array exists. None on
                                   # store_nulls=True runs (the null array
                                   # carries strictly more information).
+    p_tail: np.ndarray | None = None  # (n_modules, 7) generalized-Pareto
+                                  # tail p-values (Knijnenburg et al. 2009)
+                                  # beside the exact estimator — NaN where
+                                  # the fit was not attempted or refused;
+                                  # see tail_pvalues(). None until computed.
+    tail_ok: np.ndarray | None = None  # (n_modules, 7) bool: True only
+                                  # where p_tail came from a fit that
+                                  # passed the Anderson–Darling gate.
 
     @property
     def stat_names(self) -> tuple[str, ...]:
@@ -143,6 +152,10 @@ class PreservationResult:
         if pd is None:  # pragma: no cover - pandas is an extra
             raise ImportError("to_frame requires pandas")
         k, t = len(self.module_labels), len(STAT_NAMES)
+        tail_cols = {} if self.p_tail is None else {
+            "p_tail": self.p_tail.reshape(-1),
+            "tail_ok": self.tail_ok.reshape(-1),
+        }
         return pd.DataFrame({
             "discovery": self.discovery,
             "test": self.test,
@@ -154,7 +167,45 @@ class PreservationResult:
             "prop_vars_present": np.repeat(self.prop_vars_present, t),
             "total_size": np.repeat(self.total_size, t),
             "n_perm_used": np.repeat(self.module_n_perm(), t),
+            **tail_cols,
         })
+
+    def tail_pvalues(
+        self, refresh: bool = False
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Generalized-Pareto tail p-values beside the exact estimator
+        (:func:`netrep_tpu.ops.pvalues.gpd_tail_pvalues`): for cells whose
+        observed statistic lands beyond nearly every null draw, a gated GPD
+        fit over the null tail resolves p-values far below the exact
+        estimator's 1/(completed+1) floor. Computed lazily from the stored
+        null array (requires ``store_nulls=True``) and cached on the result
+        as ``p_tail``/``tail_ok`` so they persist through :meth:`save`.
+        Returns ``(p_tail, tail_ok)``; ``p_tail`` is NaN wherever
+        ``tail_ok`` is False — fall back to ``p_values`` there."""
+        if self.p_tail is not None and not refresh:
+            return self.p_tail, self.tail_ok
+        if self.nulls is None:
+            raise ValueError(
+                "tail_pvalues needs the null array; this result carries "
+                "exceedance counts only (store_nulls=False) — the GPD tail "
+                "fit reads the extreme null draws themselves"
+            )
+        from ..ops import pvalues as pv
+
+        self.p_tail, self.tail_ok = pv.gpd_tail_pvalues(
+            self.observed,
+            np.asarray(self.nulls)[: self.completed],
+            self.alternative,
+        )
+        tel = tm.current()
+        if tel is not None:
+            tel.emit(
+                "tail_fit",
+                cells=int(self.p_tail.size),
+                fitted=int(np.sum(self.tail_ok)),
+                n_perm=int(self.completed),
+            )
+        return self.p_tail, self.tail_ok
 
     def module_n_perm(self) -> np.ndarray:
         """(n_modules,) permutations backing each module's p-values:
@@ -200,7 +251,8 @@ class PreservationResult:
             {} if self.n_perm_used is None
             else {"n_perm_used": np.asarray(self.n_perm_used)}
         )
-        for name in ("counts_hi", "counts_lo", "counts_eff"):
+        for name in ("counts_hi", "counts_lo", "counts_eff",
+                     "p_tail", "tail_ok"):
             val = getattr(self, name)
             if val is not None:
                 extra[name] = np.asarray(val)
@@ -257,6 +309,8 @@ class PreservationResult:
                 counts_eff=(
                     z["counts_eff"] if "counts_eff" in z.files else None
                 ),
+                p_tail=z["p_tail"] if "p_tail" in z.files else None,
+                tail_ok=z["tail_ok"] if "tail_ok" in z.files else None,
                 p_values=z["p_values"],
                 n_vars_present=z["n_vars_present"],
                 prop_vars_present=z["prop_vars_present"],
@@ -476,7 +530,16 @@ def _combine_pair_results(results, allow_duplicate_nulls):
         r.p_type == "sequential" or r.n_perm_used is not None
         for r in results
     )
+    # tail p-values do not pool additively — refit the GPD over the pooled
+    # null tail whenever any input had computed them
+    p_tail = tail_ok = None
+    if any(r.p_tail is not None for r in results):
+        p_tail, tail_ok = pv.gpd_tail_pvalues(
+            first.observed, nulls, first.alternative
+        )
     return PreservationResult(
+        p_tail=p_tail,
+        tail_ok=tail_ok,
         n_perm_used=pv.effective_nperm(nulls) if any_seq else None,
         p_type="sequential" if any_seq else "fixed",
         discovery=first.discovery,

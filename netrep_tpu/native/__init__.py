@@ -229,6 +229,14 @@ class NativePermutationEngine:
         n_threads: int = 0,
     ):
         del mesh
+        # The bf16 screened fast-pass (ISSUE 16) is a JAX-engine feature;
+        # this backend is exact f32/f64 throughout. 'auto' means f32 here,
+        # an explicit ask refuses.
+        if getattr(config, "null_precision", "auto") == "bf16_rescue":
+            raise ValueError(
+                "null_precision='bf16_rescue' is not supported on "
+                "backend='native'; use 'auto' or 'f32'"
+            )
         self.core = NativeCore(
             np.asarray(disc_corr), np.asarray(disc_net),
             None if disc_data is None else np.asarray(disc_data),
@@ -294,7 +302,9 @@ class NativePermutationEngine:
         checkpoint_path: str | None = None,
         checkpoint_every: int = 8192,
         fault_policy=None,
+        observed=None,  # signature parity with the JAX engine; always exact
     ) -> tuple[np.ndarray, int]:
+        del observed
         # reuse the single chunked/interruptible/checkpointable loop shared
         # with the JAX engines (engine.run_checkpointed_chunks) so the
         # interrupt/resume semantics cannot drift across backends

@@ -175,6 +175,7 @@ def run_checkpointed_chunks(
     profile=None,
     telemetry=None,
     fault_policy=None,
+    extra_state=None,
 ) -> tuple[np.ndarray, int]:
     """The single chunked/interruptible/checkpointable null loop shared by
     :class:`PermutationEngine` and ``MultiTestEngine`` (one implementation so
@@ -206,6 +207,11 @@ def run_checkpointed_chunks(
     failure-save hook below. With a policy active the dispatch is also
     blocked-until-ready inside the retry scope, trading the
     double-buffer overlap for a retryable failure envelope.
+
+    ``extra_state`` (ISSUE 16): an object with ``state_arrays() -> dict``
+    / ``restore_state(extras)`` whose arrays ride the checkpoint ``extra``
+    dict — the screened-null rescue tally uses it so a resumed run
+    reports whole-run screening statistics.
     """
     key = _resolve_key(base, key)
     telemetry, profile = _telemetry_profile(telemetry, profile)
@@ -223,6 +229,8 @@ def run_checkpointed_chunks(
             nulls_init, start_perm = ckpt.validate_resume(
                 loaded, n_perm, kd, fp, checkpoint_path, perm_axis=perm_axis
             )
+            if extra_state is not None:
+                extra_state.restore_state(loaded.get("extras") or {})
         if ft is not None and ft.policy.async_checkpoint:
             # periodic saves ride a background writer so the loop never
             # stalls between dispatches on serialization (ISSUE 6);
@@ -231,8 +239,12 @@ def run_checkpointed_chunks(
             writer = ckpt.AsyncCheckpointWriter(telemetry)
 
         def save(nulls, done):
+            extra = (
+                extra_state.state_arrays() if extra_state is not None
+                else None
+            )
             ckpt.save_null_checkpoint(checkpoint_path, nulls, done, kd, fp,
-                                      writer=writer)
+                                      extra=extra, writer=writer)
 
     C = base.effective_chunk()
     # JAX engines keep the full chunk shape on the tail (fixed shapes hit the
@@ -620,6 +632,7 @@ def run_stream_superchunks(
     profile=None,
     telemetry=None,
     fault_policy=None,
+    extra_state=None,
 ) -> StreamCounts:
     """Fixed-``n_perm`` streaming loop shared by :class:`PermutationEngine`
     and ``MultiTestEngine``: dispatch one scan-fused superchunk of
@@ -678,14 +691,18 @@ def run_stream_superchunks(
             completed = min(int(loaded["completed"]), n_perm)
             host0 = (extras["stream_hi"], extras["stream_lo"],
                      extras["stream_eff"])
+            if extra_state is not None:
+                extra_state.restore_state(extras)
         if ft is not None and ft.policy.async_checkpoint:
             writer = ckpt.AsyncCheckpointWriter(telemetry)
 
         def save(hi, lo, eff, done):
+            extra = {"stream_hi": hi, "stream_lo": lo, "stream_eff": eff}
+            if extra_state is not None:
+                extra.update(extra_state.state_arrays())
             ckpt.save_null_checkpoint(
                 checkpoint_path, np.zeros((0,)), done, kd, fp,
-                extra={"stream_hi": hi, "stream_lo": lo, "stream_eff": eff},
-                writer=writer,
+                extra=extra, writer=writer,
             )
 
     tallies = init_tallies(host0)
@@ -848,6 +865,7 @@ def run_adaptive_stream_chunks(
     profile=None,
     telemetry=None,
     fault_policy=None,
+    extra_state=None,
 ) -> tuple:
     """Adaptive (sequential early-stopping) streaming loop: one chunk per
     dispatch — decisions must land at CHUNK boundaries exactly as the
@@ -892,6 +910,8 @@ def run_adaptive_stream_chunks(
         if loaded is not None:
             ckpt.validate_identity(loaded, kd, fp, checkpoint_path)
             monitor.restore_state(loaded.get("extras") or {})
+            if extra_state is not None:
+                extra_state.restore_state(loaded.get("extras") or {})
             completed = min(int(loaded["completed"]), n_perm)
         if ft is not None and ft.policy.async_checkpoint:
             writer = ckpt.AsyncCheckpointWriter(telemetry)
@@ -900,9 +920,12 @@ def run_adaptive_stream_chunks(
             # monitor state is read (and snapshotted by the writer path)
             # on THIS thread at submit time — the background write never
             # races the monitor's in-place tally folds
+            extra = monitor.state_arrays()
+            if extra_state is not None:
+                extra = {**extra, **extra_state.state_arrays()}
             ckpt.save_null_checkpoint(
                 checkpoint_path, np.zeros((0,)), done, kd, fp,
-                extra=monitor.state_arrays(), writer=writer,
+                extra=extra, writer=writer,
             )
 
     pos = monitor.active_positions()
@@ -1123,6 +1146,7 @@ def run_adaptive_chunks(
     fingerprint_extra: bytes = b"",
     telemetry=None,
     fault_policy=None,
+    extra_state=None,
 ) -> tuple[np.ndarray, int, bool]:
     """Adaptive scheduling layer around the shared chunked null loop: after
     each chunk a host-side :class:`~netrep_tpu.ops.sequential.StopMonitor`
@@ -1182,6 +1206,8 @@ def run_adaptive_chunks(
             )
             if completed:
                 monitor.restore_state(loaded.get("extras") or {})
+                if extra_state is not None:
+                    extra_state.restore_state(loaded.get("extras") or {})
                 gap = completed - monitor.folded
                 if gap > 0:
                     # an interrupt landed between a chunk's null write and
@@ -1197,9 +1223,12 @@ def run_adaptive_chunks(
             writer = ckpt.AsyncCheckpointWriter(telemetry)
 
         def save(nulls, done):
+            extra = monitor.state_arrays()
+            if extra_state is not None:
+                extra = {**extra, **extra_state.state_arrays()}
             ckpt.save_null_checkpoint(
                 checkpoint_path, nulls, done, kd, fp,
-                extra=monitor.state_arrays(), writer=writer,
+                extra=extra, writer=writer,
             )
 
     pos = monitor.active_positions()
@@ -1735,6 +1764,27 @@ class PermutationEngine:
             "xla" if self.data_only
             else config.resolved_stat_mode(jax.default_backend())
         )
+        # Screened null loop (ISSUE 16): explicit bf16_rescue is refused on
+        # the paths the screen is not taught — the fused mega-kernel folds
+        # tallies in VMEM (no per-value screen point), gather_mode='fused'
+        # DMAs rows at a precision the kernel owns, and the row-sharded
+        # ring splits the chunk over two mesh axes the rescue worklist
+        # re-dispatch does not reproduce. 'auto' silently resolves to
+        # 'f32' on those paths (checked per run in _resolve_null_precision).
+        if config.null_precision == "bf16_rescue":
+            if self.stat_mode == "fused" or config.gather_mode == "fused":
+                raise ValueError(
+                    "null_precision='bf16_rescue' screens the XLA chunk "
+                    "composition; the fused Pallas paths (stat_mode/"
+                    "gather_mode='fused') fold tallies in VMEM with no "
+                    "screen point — use null_precision='auto' or 'f32'"
+                )
+            if mesh is not None and config.matrix_sharding == "row":
+                raise ValueError(
+                    "null_precision='bf16_rescue' is not taught the "
+                    "row-sharded ring path; use matrix_sharding="
+                    "'replicated' or null_precision='f32'"
+                )
         #: fused-stats row-block override from the persistent autotune cache
         #: (None = the kernel's minimal-padding heuristic); the streaming
         #: loop records measured perms/s back against the applied block
@@ -1982,6 +2032,12 @@ class PermutationEngine:
         #: the autotune cache or the byte-budget heuristic) — a program
         #: CONSTANT, so part of the AOT program identity
         self._applied_perm_batch: int | None = None
+        #: screened null loop (ISSUE 16): True while a bf16_rescue run is
+        #: in flight — autotune/AOT/perf-ledger keys grow a precision
+        #: component so compile histories never mix precisions
+        self._screen_active: bool = False
+        #: cached max|test operand| for the screen's cushion amplitude
+        self._screen_amp: float | None = None
 
     def _check_pool(self) -> None:
         """Permutation-pool oversubscription check. The packed serve engine
@@ -2081,7 +2137,10 @@ class PermutationEngine:
         gather mode × per-bucket (cap, module count) signature × chunk.
         The fused-stats mode suffixes the mode component so its
         compile-span, perf-ledger, and throughput histories never mix
-        with the XLA composition's (ISSUE 8)."""
+        with the XLA composition's (ISSUE 8); a screened bf16_rescue run
+        suffixes it the same way (ISSUE 16) — its per-chunk cost profile
+        (bf16 fast pass + rescue dispatches) must never feed the f32
+        path's autotune/perf-ledger/AOT histories."""
         from ..utils.autotune import make_key
 
         caps = ",".join(
@@ -2096,6 +2155,8 @@ class PermutationEngine:
             mode = f"{self.gather_mode}+fusedstats"
         else:
             mode = self.gather_mode
+        if getattr(self, "_screen_active", False):
+            mode += "+bf16rescue"
         return make_key(
             jax.default_backend(), mode, caps,
             self.effective_chunk(), extra,
@@ -2127,6 +2188,8 @@ class PermutationEngine:
             f"fx:{cfg.fused_exact}",
             f"pb:{self._applied_perm_batch}",
             f"data_only:{getattr(self, 'data_only', False)}",
+            f"nullprec:"
+            f"{'bf16_rescue' if self._screen_active else 'f32'}",
             f"slices:{slices}",
         ])
 
@@ -2919,6 +2982,7 @@ class PermutationEngine:
         profile=None,
         telemetry=None,
         fault_policy=None,
+        observed: np.ndarray | None = None,
     ) -> tuple[np.ndarray, int]:
         """Compute the permutation null distribution.
 
@@ -2959,6 +3023,12 @@ class PermutationEngine:
             :class:`~netrep_tpu.utils.faults.DeviceLostError` for the
             caller's CPU-degradation ladder. None (default) is
             bit-identical to previous releases.
+        observed : optional ``(n_modules, 7)`` observed statistics —
+            required for the screened bf16_rescue null loop (ISSUE 16:
+            the screen decides exceedance comparisons against them);
+            ignored by the f32 path. ``null_precision='auto'`` without
+            ``observed`` runs the f32 path; explicit
+            ``null_precision='bf16_rescue'`` without it raises.
 
         Returns
         -------
@@ -2977,6 +3047,27 @@ class PermutationEngine:
         # and the caller passed no profile, the auto-created one must be
         # the instance `write` records transfer bytes to
         telemetry, profile = _telemetry_profile(telemetry, profile)
+        if self._resolve_null_precision(observed) == "bf16_rescue":
+            from . import screened as scr
+
+            state = scr.RescueState()
+            self._screen_active = True
+            try:
+                fn = self._screened_fn(observed, state, telemetry, profile)
+                nulls, completed = run_checkpointed_chunks(
+                    self, n_perm, key, fn,
+                    (n_perm, self.n_modules, N_STATS),
+                    self._null_write(profile),
+                    progress=progress, nulls_init=nulls_init,
+                    start_perm=start_perm, checkpoint_path=checkpoint_path,
+                    checkpoint_every=checkpoint_every, profile=profile,
+                    telemetry=telemetry, fault_policy=fault_policy,
+                    fingerprint_extra=scr.SCREEN_FP, extra_state=state,
+                )
+            finally:
+                self._screen_active = False
+            self._emit_null_pass_end(telemetry, "materialized", state)
+            return nulls, completed
         return run_checkpointed_chunks(
             self, n_perm, key, self._chunk_fn(),
             (n_perm, self.n_modules, N_STATS), self._null_write(profile),
@@ -3095,6 +3186,32 @@ class PermutationEngine:
         def slice_vals(nulls, done, take, pos):
             return nulls[done: done + take][:, pos, :]
 
+        # Screened bf16 fast pass (ISSUE 16): the monitor's observed
+        # statistics drive the screen when the shape matches the
+        # single-test layout (the packed serve monitor tallies other
+        # cell shapes — those runs stay f32 under 'auto').
+        obs_arr = getattr(monitor, "observed", None)
+        if self._resolve_null_precision(obs_arr) == "bf16_rescue":
+            from . import screened as scr
+
+            telemetry = tm.resolve(telemetry)
+            state = scr.RescueState()
+            self._screen_active = True
+            try:
+                return run_adaptive_chunks(
+                    self, n_perm, key,
+                    lambda: self._screened_fn(obs_arr, state, telemetry),
+                    (n_perm, self.n_modules, N_STATS), self._null_write(),
+                    slice_vals, monitor, self.rebucket,
+                    progress=progress, checkpoint_path=checkpoint_path,
+                    checkpoint_every=checkpoint_every, telemetry=telemetry,
+                    fault_policy=fault_policy,
+                    fingerprint_extra=scr.SCREEN_FP, extra_state=state,
+                )
+            finally:
+                self._screen_active = False
+                self.rebucket(range(self.n_modules))
+                self._emit_null_pass_end(telemetry, "adaptive", state)
         try:
             return run_adaptive_chunks(
                 self, n_perm, key, self._chunk_fn,
@@ -3374,6 +3491,398 @@ class PermutationEngine:
         hi, lo, eff = self._stream_tallies_pull(outs)
         return hi[pos], lo[pos], eff[pos]
 
+    # ------------------------------------------------------------------
+    # Mixed-precision null screening (ISSUE 16) — see parallel/screened.py
+    # ------------------------------------------------------------------
+
+    def _resolve_null_precision(self, observed) -> str:
+        """Per-run resolution of ``EngineConfig.null_precision``: the
+        screen engages only when the backend resolution says bf16_rescue,
+        the statistics path is the XLA composition (the fused Pallas and
+        row-sharded ring paths raised at init for explicit bf16_rescue
+        and degrade silently under 'auto'), and the caller supplied
+        single-test-shaped observed statistics to screen against."""
+        cfg = self.config
+        if cfg.resolved_null_precision(jax.default_backend()) != "bf16_rescue":
+            return "f32"
+        if (self.stat_mode == "fused" or self.gather_mode == "fused"
+                or self.row_sharded):
+            return "f32"
+        if observed is None:
+            if cfg.null_precision == "bf16_rescue":
+                raise ValueError(
+                    "null_precision='bf16_rescue' screens null statistics "
+                    "against the observed values — pass observed= to "
+                    "run_null (the adaptive/streaming entry points take "
+                    "it already)"
+                )
+            return "f32"
+        if np.asarray(observed).size != self.n_modules * N_STATS:
+            # caller-supplied monitors (the packed serve path) may tally
+            # other cell shapes; the screen understands only the
+            # single-test (n_modules, 7) layout
+            return "f32"
+        return "bf16_rescue"
+
+    def _screen_amplitude(self) -> float:
+        """Max |test operand| (>= 1), the cushion's operand-amplitude
+        factor — one eager reduction per engine, cached."""
+        if self._screen_amp is None:
+            vals = [1.0]
+            for a in (self._test_corr, self._test_net, self._test_dataT):
+                if a is not None:
+                    vals.append(float(jnp.max(jnp.abs(a))))
+            self._screen_amp = max(vals)
+        return self._screen_amp
+
+    def _screened_obs_cush(self, observed) -> tuple[list, list]:
+        """Per-bucket (observed, cushion) f32 device operands of the
+        screened programs — reads ``self.buckets`` at call time so the
+        adaptive loops re-slice after each retirement re-bucketing."""
+        from . import screened as scr
+
+        obs = np.asarray(observed, dtype=np.float64).reshape(
+            self.n_modules, N_STATS
+        )
+        cush = scr.null_cushions(obs, self._screen_amplitude())
+        return (
+            self._obs_buckets(obs),
+            [jnp.asarray(cush[b.module_pos]) for b in self.buckets],
+        )
+
+    def _screened_chunk_parts(self):
+        """The screened chunk evaluation shared by all four screened
+        loops: the EXISTING chunk body called on bf16-rounded test
+        operands (f32 arithmetic on rounded inputs — deterministic and
+        platform-portable, so CPU pinning tests exercise the real TPU
+        rounding), plus the per-permutation ambiguity reduction."""
+        from . import screened as scr
+
+        chunk = self.chunk_body()
+
+        def screened_outs(keys, chunk_ops):
+            pool, tc, tn, td, discs = chunk_ops
+            return chunk(
+                keys, pool, scr.bf16_round(tc), scr.bf16_round(tn),
+                scr.bf16_round(td), discs,
+            )
+
+        return screened_outs
+
+    def _build_screened_chunk_fn(self, observed) -> Callable:
+        """Jit the bf16 fast-pass program of the screened materialized
+        and adaptive loops: ``fn(keys) -> (outs, amb)`` with ``outs`` the
+        per-bucket screened statistics and ``amb`` the ``(C,)`` ambiguous
+        worklist mask. Screened programs stay on the plain jit path (the
+        AOT store's warmup grid is not extended to them); the f32 rescue
+        reuses the engine's acquired chunk program."""
+        from . import screened as scr
+
+        screened_outs = self._screened_chunk_parts()
+
+        def screened(keys, chunk_ops, obs_b, cush_b):
+            outs = screened_outs(keys, chunk_ops)
+            return outs, scr.ambiguous_perms(outs, obs_b, cush_b)
+
+        args = self.chunk_args()
+        obs_b, cush_b = self._screened_obs_cush(observed)
+        jitted = jax.jit(screened)
+        if self.mesh is not None:
+            from .distributed import to_global
+
+            ksh = NamedSharding(self.mesh, P(self.config.mesh_axis))
+            if not ksh.is_fully_addressable:
+                args, obs_b, cush_b = _globalize_replicated(
+                    self.mesh, (args, obs_b, cush_b)
+                )
+            return lambda keys: jitted(
+                to_global(keys, ksh), args, obs_b, cush_b
+            )
+        return lambda keys: jitted(keys, args, obs_b, cush_b)
+
+    def _screen_rescue_outs(self, f32_fn, keys, idx) -> list:
+        """Re-dispatch one chunk's ambiguous permutations through the f32
+        chunk program: pad the worklist to the chunk length (same
+        compiled executable — zero extra compiles), gather those keys,
+        and return the first ``len(idx)`` rows per bucket on the host."""
+        from . import screened as scr
+        from .distributed import gather_to_host
+
+        pad = scr.pad_worklist(idx, self.effective_chunk())
+        routs = f32_fn(scr.take_keys(keys, pad))
+        return [np.asarray(gather_to_host(o))[: idx.size] for o in routs]
+
+    def _screened_fn(self, observed, state, telemetry=None,
+                     profile=None) -> Callable:
+        """Screened ``fn(keys)`` for the materialized and adaptive null
+        loops: bf16 fast pass, host-side worklist gather, f32 rescue of
+        the ambiguous rows — returning host numpy per-bucket arrays whose
+        rescued rows are bit-identical to the all-f32 run (the loops'
+        write/slice paths pass numpy through unchanged). The worklist
+        synchronization trades the materialized loop's double-buffer
+        overlap for the screened fast pass."""
+        from .distributed import gather_to_host
+
+        bf = self._build_screened_chunk_fn(observed)
+        f32 = self._chunk_fn()
+
+        def fn(keys):
+            outs, amb = bf(keys)
+            amb_h = np.asarray(gather_to_host(amb)).astype(bool)
+            # np.array (copy): the device export may be read-only and
+            # rescued rows are scattered in place below
+            outs_h = [np.array(gather_to_host(o)) for o in outs]
+            state.total += int(amb_h.size)
+            idx = np.flatnonzero(amb_h)
+            if idx.size:
+                t0 = time.perf_counter()
+                routs = self._screen_rescue_outs(f32, keys, idx)
+                for oh, ro in zip(outs_h, routs):
+                    oh[idx] = ro
+                state.rescued += int(idx.size)
+                state.dispatches += 1
+                if profile is not None:
+                    profile.record_dispatch(1)
+                if telemetry is not None:
+                    telemetry.emit(
+                        "rescue_dispatch", s=time.perf_counter() - t0,
+                        rescued=int(idx.size), chunk=int(amb_h.size),
+                    )
+            return outs_h
+
+        return fn
+
+    def _build_screened_stream_super(self, observed) -> Callable:
+        """Screened superchunk scan: each chunk folds its DECIDED
+        comparisons into the on-device tally carry (the count fold's
+        validity mask additionally excludes ambiguous columns) and stacks
+        the per-chunk ambiguous masks as scan outputs — the ``(K, C)``
+        worklist the wrapper re-dispatches. ``fn(tallies, keys, valid)
+        -> (tallies, amb)``."""
+        from . import screened as scr
+
+        screened_outs = self._screened_chunk_parts()
+        count_buckets = make_count_buckets(0)
+        args = self.chunk_args()
+        obs_b, cush_b = self._screened_obs_cush(observed)
+
+        def super_fn(tallies, keys, valid, chunk_ops, obs_sc, cush_sc):
+            def body(carry, xs):
+                keys_c, valid_c = xs
+                outs = screened_outs(keys_c, chunk_ops)
+                col = jnp.arange(keys_c.shape[0], dtype=jnp.int32)
+                valid_mask = col < valid_c
+                amb = (
+                    scr.ambiguous_perms(outs, obs_sc, cush_sc) & valid_mask
+                )
+                deltas = count_buckets(outs, obs_sc, valid_mask & ~amb)
+                new = [
+                    tuple(t + d for t, d in zip(ts, ds))
+                    for ts, ds in zip(carry, deltas)
+                ]
+                return new, amb
+
+            out, amb_ys = jax.lax.scan(body, tallies, (keys, valid))
+            return out, amb_ys
+
+        jitted = jax.jit(super_fn)
+        if self.mesh is not None:
+            from .distributed import to_global
+
+            ksh = NamedSharding(
+                self.mesh, P(None, self.config.mesh_axis)
+            )
+            if not ksh.is_fully_addressable:
+                args, obs_b, cush_b = _globalize_replicated(
+                    self.mesh, (args, obs_b, cush_b)
+                )
+            return lambda tallies, keys, valid: jitted(
+                tallies, to_global(keys, ksh), valid, args, obs_b, cush_b
+            )
+        return lambda tallies, keys, valid: jitted(
+            tallies, keys, valid, args, obs_b, cush_b
+        )
+
+    def _screened_stream_fns(self, observed, state, telemetry=None,
+                             profile=None) -> tuple:
+        """``(fn, init_fn, pull_fn)`` for the screened
+        :func:`run_stream_superchunks`: device tallies hold decided
+        comparisons only; each superchunk's ambiguous worklist is rescued
+        per scan row through the f32 chunk program and its exact host
+        counts fold into wrapper-held accumulators that ``pull_fn`` adds
+        back — so pulled tallies (and therefore checkpoints and the
+        returned :class:`StreamCounts`) are bit-identical to the all-f32
+        run. The accumulator commit happens LAST in ``fn`` and
+        ``init_fn`` subtracts the accumulator from restored host tallies,
+        so the fault runtime's carry rebuild never double-counts
+        rescues."""
+        from . import screened as scr
+        from .distributed import gather_to_host
+
+        sup = self._build_screened_stream_super(observed)
+        f32 = self._chunk_fn()
+        obs = np.asarray(observed, dtype=np.float64).reshape(
+            self.n_modules, N_STATS
+        )
+        shape = (self.n_modules, N_STATS)
+        acc = {k: np.zeros(shape, np.int64) for k in ("hi", "lo", "eff")}
+
+        def fn(tallies, keys, valid):
+            new_tallies, amb = sup(tallies, keys, valid)
+            amb_h = np.asarray(gather_to_host(amb)).astype(bool)
+            state.total += int(np.sum(valid))
+            d_hi = np.zeros(shape, np.int64)
+            d_lo = np.zeros(shape, np.int64)
+            d_eff = np.zeros(shape, np.int64)
+            rescued = 0
+            t0 = time.perf_counter()
+            for r in np.flatnonzero(amb_h.any(axis=1)):
+                idx = np.flatnonzero(amb_h[r])
+                routs = self._screen_rescue_outs(f32, keys[r], idx)
+                for b, ro in zip(self.buckets, routs):
+                    hi, lo, eff = scr.host_tail_counts(
+                        ro, obs[b.module_pos]
+                    )
+                    d_hi[b.module_pos] += hi
+                    d_lo[b.module_pos] += lo
+                    d_eff[b.module_pos] += eff
+                rescued += int(idx.size)
+                state.dispatches += 1
+                if profile is not None:
+                    profile.record_dispatch(1)
+            if rescued:
+                if telemetry is not None:
+                    telemetry.emit(
+                        "rescue_dispatch", s=time.perf_counter() - t0,
+                        rescued=int(rescued), chunk=int(amb_h.size),
+                    )
+                state.rescued += rescued
+                # commit LAST: a faulted superchunk retries the whole fn
+                # from the rebuilt carry, so partial rescue work must not
+                # have leaked into the accumulators
+                acc["hi"] += d_hi
+                acc["lo"] += d_lo
+                acc["eff"] += d_eff
+            return new_tallies
+
+        def init_fn(host):
+            if host is not None:
+                host = (
+                    np.asarray(host[0]) - acc["hi"],
+                    np.asarray(host[1]) - acc["lo"],
+                    np.asarray(host[2]) - acc["eff"],
+                )
+            return self._stream_tallies_init(host)
+
+        def pull_fn(tallies):
+            hi, lo, eff = self._stream_tallies_pull(tallies)
+            return hi + acc["hi"], lo + acc["lo"], eff + acc["eff"]
+
+        return fn, init_fn, pull_fn
+
+    def _build_screened_stream_count(self, observed) -> Callable:
+        """Screened per-chunk count program of the adaptive streaming
+        path: ``fn(keys, valid) -> (deltas, amb)`` — decided counts per
+        bucket plus the chunk's ambiguous worklist mask."""
+        from . import screened as scr
+
+        screened_outs = self._screened_chunk_parts()
+        count_buckets = make_count_buckets(0)
+        args = self.chunk_args()
+        obs_b, cush_b = self._screened_obs_cush(observed)
+
+        def count_fn(keys, valid, chunk_ops, obs_sc, cush_sc):
+            outs = screened_outs(keys, chunk_ops)
+            col = jnp.arange(keys.shape[0], dtype=jnp.int32)
+            valid_mask = col < valid
+            amb = scr.ambiguous_perms(outs, obs_sc, cush_sc) & valid_mask
+            deltas = count_buckets(outs, obs_sc, valid_mask & ~amb)
+            return deltas, amb
+
+        jitted = jax.jit(count_fn)
+        if self.mesh is not None:
+            from .distributed import to_global
+
+            ksh = NamedSharding(self.mesh, P(self.config.mesh_axis))
+            if not ksh.is_fully_addressable:
+                args, obs_b, cush_b = _globalize_replicated(
+                    self.mesh, (args, obs_b, cush_b)
+                )
+            return lambda keys, valid: jitted(
+                to_global(keys, ksh), valid, args, obs_b, cush_b
+            )
+        return lambda keys, valid: jitted(keys, valid, args, obs_b, cush_b)
+
+    def _screened_count_fn_builder(self, observed, state, telemetry=None,
+                                   profile=None) -> Callable:
+        """``fn_builder`` for the screened adaptive streaming loop:
+        rebuilds the screened count program for the current bucket set
+        (re-invoked after each retirement re-bucketing); the returned
+        ``fn(keys, valid)`` rescues the chunk's ambiguous permutations
+        through the f32 chunk program BEFORE returning, so the monitor
+        folds exact counts and retirement decisions match the f32 run."""
+        from . import screened as scr
+        from .distributed import gather_to_host
+
+        obs = np.asarray(observed, dtype=np.float64).reshape(
+            self.n_modules, N_STATS
+        )
+
+        def build():
+            cf = self._build_screened_stream_count(observed)
+            f32 = self._chunk_fn()
+
+            def fn(keys, valid):
+                deltas, amb = cf(keys, valid)
+                amb_h = np.asarray(gather_to_host(amb)).astype(bool)
+                state.total += int(valid)
+                out = [
+                    tuple(
+                        np.array(gather_to_host(x), dtype=np.int64)
+                        for x in ds
+                    )
+                    for ds in deltas
+                ]
+                idx = np.flatnonzero(amb_h)
+                if idx.size:
+                    t0 = time.perf_counter()
+                    routs = self._screen_rescue_outs(f32, keys, idx)
+                    for j, (b, ro) in enumerate(
+                        zip(self.buckets, routs)
+                    ):
+                        hi, lo, eff = scr.host_tail_counts(
+                            ro, obs[b.module_pos]
+                        )
+                        h, l, e = out[j]
+                        out[j] = (h + hi, l + lo, e + eff)
+                    state.rescued += int(idx.size)
+                    state.dispatches += 1
+                    if profile is not None:
+                        profile.record_dispatch(1)
+                    if telemetry is not None:
+                        telemetry.emit(
+                            "rescue_dispatch",
+                            s=time.perf_counter() - t0,
+                            rescued=int(idx.size), chunk=int(amb_h.size),
+                        )
+                return out
+
+            return fn
+
+        return build
+
+    def _emit_null_pass_end(self, telemetry, mode: str, state) -> None:
+        """Per-run screening summary event (ISSUE 16): the rescued
+        fraction is the screen's economics — rescued·f32-cost on top of
+        total·bf16-cost vs total·f32-cost unscreened."""
+        if telemetry is not None:
+            telemetry.emit(
+                "null_pass_end", mode=mode, precision="bf16_rescue",
+                total=int(state.total), rescued=int(state.rescued),
+                rescue_dispatches=int(state.dispatches),
+                fraction=float(state.fraction()),
+            )
+
     def run_null_streaming(
         self,
         n_perm: int,
@@ -3409,6 +3918,37 @@ class PermutationEngine:
             )
         from ..utils.autotune import resolve_superchunk
 
+        telemetry, profile = _telemetry_profile(telemetry, profile)
+        if self._resolve_null_precision(observed) == "bf16_rescue":
+            from . import screened as scr
+
+            state = scr.RescueState()
+            # active BEFORE autotune_key: the superchunk depth K resolves
+            # under the precision-suffixed key, so screened and f32
+            # throughput histories never mix
+            self._screen_active = True
+            try:
+                sk_key = self.autotune_key(extra="superchunk")
+                K, cache = resolve_superchunk(self.config, sk_key)
+                self._stream_autotune_record = (
+                    (cache, sk_key, K) if cache is not None else None
+                )
+                fn, init_fn, pull_fn = self._screened_stream_fns(
+                    observed, state, telemetry, profile
+                )
+                result = run_stream_superchunks(
+                    self, n_perm, key, fn, K, self.effective_chunk(),
+                    init_fn, pull_fn,
+                    progress=progress, checkpoint_path=checkpoint_path,
+                    checkpoint_every=checkpoint_every,
+                    fingerprint_extra=scr.SCREEN_FP, profile=profile,
+                    telemetry=telemetry, fault_policy=fault_policy,
+                    extra_state=state,
+                )
+            finally:
+                self._screen_active = False
+            self._emit_null_pass_end(telemetry, "streaming", state)
+            return result
         sk_key = self.autotune_key(extra="superchunk")
         K, cache = resolve_superchunk(self.config, sk_key)
         self._stream_autotune_record = (
@@ -3458,17 +3998,41 @@ class PermutationEngine:
             ),
             alternative, rule or StopRule(),
         )
+        telemetry, profile = _telemetry_profile(telemetry, profile)
+        state = None
+        if self._resolve_null_precision(observed) == "bf16_rescue":
+            from . import screened as scr
+
+            state = scr.RescueState()
+            self._screen_active = True
         try:
-            monitor, completed, finished = run_adaptive_stream_chunks(
-                self, n_perm, key,
-                lambda: self._stream_count_fn(observed),
-                self._counts_to_active, monitor, self.rebucket,
-                progress=progress, checkpoint_path=checkpoint_path,
-                checkpoint_every=checkpoint_every, profile=profile,
-                telemetry=telemetry, fault_policy=fault_policy,
-            )
+            if state is not None:
+                monitor, completed, finished = run_adaptive_stream_chunks(
+                    self, n_perm, key,
+                    self._screened_count_fn_builder(
+                        observed, state, telemetry, profile
+                    ),
+                    self._counts_to_active, monitor, self.rebucket,
+                    progress=progress, checkpoint_path=checkpoint_path,
+                    checkpoint_every=checkpoint_every,
+                    fingerprint_extra=scr.SCREEN_FP, profile=profile,
+                    telemetry=telemetry, fault_policy=fault_policy,
+                    extra_state=state,
+                )
+            else:
+                monitor, completed, finished = run_adaptive_stream_chunks(
+                    self, n_perm, key,
+                    lambda: self._stream_count_fn(observed),
+                    self._counts_to_active, monitor, self.rebucket,
+                    progress=progress, checkpoint_path=checkpoint_path,
+                    checkpoint_every=checkpoint_every, profile=profile,
+                    telemetry=telemetry, fault_policy=fault_policy,
+                )
         finally:
+            self._screen_active = False
             self.rebucket(range(self.n_modules))
+        if state is not None:
+            self._emit_null_pass_end(telemetry, "adaptive-streaming", state)
         eff = monitor.eff if monitor.eff is not None else np.zeros_like(
             monitor.hi
         )

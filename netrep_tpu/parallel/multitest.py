@@ -147,6 +147,15 @@ class MultiTestEngine:
                 self._td = [jnp.asarray(np.asarray(d).T, dtype) for d in test_datas]
         self.config = config
         self.mesh = mesh
+        # The bf16 screened fast-pass (ISSUE 16) exists only on the single-
+        # test engine's chunk programs; the T-axis programs here always run
+        # f32. 'auto' resolves to f32 silently, an explicit ask refuses.
+        if getattr(config, "null_precision", "auto") == "bf16_rescue":
+            raise ValueError(
+                "null_precision='bf16_rescue' is not supported on the "
+                "multi-test engine (vmap_tests=True); use 'auto' or 'f32', "
+                "or run tests sequentially"
+            )
         # Statistics execution mode (ISSUE 8): the T-axis fused path loops
         # the cohorts over the shared index blocks, each cohort's rows
         # gathered+reduced by the mega-kernel. The ring-exchange row-sharded
@@ -647,12 +656,15 @@ class MultiTestEngine:
                  nulls_init=None, start_perm: int = 0,
                  checkpoint_path: str | None = None,
                  checkpoint_every: int = 8192, profile=None,
-                 telemetry=None, fault_policy=None):
+                 telemetry=None, fault_policy=None, observed=None):
         """(T, n_perm, n_modules, 7) null array + completed count; same
         chunked/interruptible/reproducible/resumable/checkpointable contract
         as the base engine (key derivation and chunk rounding are shared
         helpers on :class:`PermutationEngine` so the two paths cannot
-        drift)."""
+        drift). ``observed`` is accepted for signature parity with the base
+        engine and unused: the T-axis programs always run f32 (__init__
+        refuses an explicit bf16_rescue ask)."""
+        del observed
         from .engine import _telemetry_profile, run_checkpointed_chunks
 
         # resolve before building the write closure so an auto-created

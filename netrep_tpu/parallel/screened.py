@@ -1,0 +1,226 @@
+"""Mixed-precision null screening (ISSUE 16): bf16 fast pass with exact
+f32 rescue.
+
+The null permutation loop is where essentially all device time goes, yet
+almost no permutation's statistics land anywhere near the observed value —
+only near-threshold exceedance comparisons need full precision. The
+screened loop therefore runs each chunk through the EXISTING chunk body
+with the test-side operands rounded through bfloat16 in-program (f32
+arithmetic on bf16-rounded inputs: on TPU the MXU consumes the bf16
+operands natively at ~2x the f32 rate and half the gather/DMA bytes; on
+CPU the same rounding is emulated exactly, which is what makes the tier-1
+pinning tests meaningful). A per-(module, statistic) forward-error
+cushion — derived the same way :func:`netrep_tpu.atlas.builder._bound_margin`
+bounds the atlas tile pass — then splits every exceedance comparison into:
+
+- **decided**: the screened value clears ``observed`` by more than the
+  cushion. The f32 value provably falls on the same side of ``observed``,
+  so the ``>=`` / ``<=`` tallies are taken from the screened value as-is.
+- **ambiguous**: the screened value lands inside the cushion band. The
+  whole permutation joins a worklist that is re-dispatched through the
+  engine's existing f32 chunk program (same compiled executable, same
+  per-permutation keys), and its exact values replace the screened ones.
+
+Counts, p-values, and adaptive retirement decisions are therefore
+bit-identical to the all-f32 path BY CONSTRUCTION — the cushion only
+moves work between the fast pass and the rescue dispatch, never the
+result. Two structural caveats are accepted and documented (
+docs/architecture.md "Mixed-precision null screening"): NaN-ness of a
+statistic is assumed precision-invariant (a statistic that is NaN in f32
+is NaN under bf16-rounded inputs and vice versa — NaNs here come from
+empty masks and zero variances, which rounding does not create), and a
+cell whose OBSERVED value is NaN never tallies under any precision, so
+it is never rescued.
+
+Cushion derivation. For each statistic the screened value differs from
+the f32 value by a forward error bounded (to first order) by the bf16
+unit roundoff ``2**-9`` scaled by the operand amplitude and the
+statistic's own magnitude near the decision boundary — where the
+screened value is within the cushion of ``observed``, its magnitude is
+``~|observed|``. So, mirroring ``_bound_margin``'s shape
+(``scale * unit * amplitude + absolute_floor``):
+
+    cushion[m, s] = margin_scale * 2**-9 * A_op * max(1, |observed[m, s]|)
+                    + 1e-6
+
+with ``A_op = max(1, max|test operands|)`` folding the absolute error of
+accumulation over rounded inputs, and ``margin_scale`` (default 32, env
+override ``NETREP_NULL_MARGIN_SCALE``) the headroom multiplier for the
+condition of the seven statistic pipelines (power iteration, means,
+correlations of gathered blocks). The cushion is deliberately
+conservative: overestimating it only inflates the rescued fraction (more
+exact f32 work), never the counts.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+#: bfloat16 unit roundoff (8-bit significand).
+BF16_UNIT = 2.0 ** -9
+
+#: headroom multiplier over the first-order forward-error bound — the
+#: mixed-precision analogue of the 16x factor in
+#: :func:`netrep_tpu.atlas.builder._bound_margin`.
+DEFAULT_MARGIN_SCALE = 32.0
+
+#: absolute cushion floor (same role as ``_bound_margin``'s ``1e-7``,
+#: one decade wider for the coarser bf16 unit).
+CUSHION_FLOOR = 1e-6
+
+#: checkpoint-fingerprint suffix: a screened run's nulls carry bf16
+#: values in decided rows, so its checkpoints must never resume an
+#: all-f32 run (or vice versa) — counts agree, stored values don't.
+SCREEN_FP = b"null-precision:bf16_rescue|"
+
+
+def resolve_margin_scale() -> float:
+    """``margin_scale``, honouring the ``NETREP_NULL_MARGIN_SCALE`` env
+    override (an escape hatch for pinning-test triage: widening the
+    cushion trades rescue volume for certainty, it cannot change
+    results)."""
+    raw = os.environ.get("NETREP_NULL_MARGIN_SCALE", "")
+    try:
+        val = float(raw)
+    except ValueError:
+        return DEFAULT_MARGIN_SCALE
+    return val if val > 0 else DEFAULT_MARGIN_SCALE
+
+
+def bf16_round(x):
+    """Round an f32 operand through bfloat16 (``None`` passes through —
+    the data-only chunk body takes ``tc=tn=None``). Stays inside the
+    jitted program so the screened chunk body IS the existing chunk body
+    on rounded inputs — no second statistics implementation to keep in
+    sync."""
+    if x is None:
+        return None
+    return x.astype(jnp.bfloat16).astype(jnp.float32)
+
+
+def null_cushions(
+    observed: np.ndarray,
+    operand_amp: float,
+    margin_scale: float | None = None,
+) -> np.ndarray:
+    """Per-(module, statistic) decision cushion (f32, same shape as
+    ``observed``). NaN observed cells get NaN cushions — they never
+    tally under any precision (every comparison against NaN is False),
+    so they are excluded from the ambiguity test rather than rescued."""
+    if margin_scale is None:
+        margin_scale = resolve_margin_scale()
+    obs = np.asarray(observed, dtype=np.float64)
+    amp = max(1.0, float(operand_amp))
+    cush = (
+        margin_scale * BF16_UNIT * amp * np.maximum(1.0, np.abs(obs))
+        + CUSHION_FLOOR
+    )
+    return cush.astype(np.float32)
+
+
+def ambiguous_cells(out, obs, cush):
+    """Inside-jit ambiguity test for one bucket: ``out`` is the screened
+    ``(..., K_b, N_STATS)`` chunk output, ``obs``/``cush`` the bucket's
+    ``(K_b, N_STATS)`` observed values and cushions. A cell is DECIDED
+    when the screened value clears the cushion band on either side, when
+    both the screened value and the observed value are NaN (neither
+    precision tallies, eff agrees by the NaN-invariance assumption), or
+    when the observed value is NaN (the cell never tallies at all).
+    Everything else is ambiguous."""
+    dec_hi = out > obs + cush
+    dec_lo = out < obs - cush
+    both_nan = jnp.isnan(out) & jnp.isnan(obs)
+    decided = dec_hi | dec_lo | both_nan | jnp.isnan(obs)
+    return ~decided
+
+
+def ambiguous_perms(outs, obs_b, cush_b):
+    """OR :func:`ambiguous_cells` over every bucket and every (module,
+    statistic) cell → per-permutation ``(C,)`` bool worklist mask. One
+    ambiguous cell rescues the whole permutation: the rescue re-runs the
+    full f32 chunk body anyway, and whole-row replacement keeps the
+    stored nulls bit-identical to the f32 run for every rescued row."""
+    amb = None
+    for o, ob, cb in zip(outs, obs_b, cush_b):
+        a = ambiguous_cells(o, ob, cb).any(axis=(1, 2))
+        amb = a if amb is None else amb | a
+    return amb
+
+
+def take_keys(keys, idx: np.ndarray):
+    """Row-gather of a per-permutation PRNG key array by host indices
+    (typed key arrays don't always support ``jnp.take`` directly — fall
+    back to a key-data round-trip, which is layout-exact)."""
+    idx = jnp.asarray(np.asarray(idx, dtype=np.int64))
+    try:
+        return jnp.take(keys, idx, axis=0)
+    except (TypeError, ValueError):
+        data = jax.random.key_data(keys)
+        return jax.random.wrap_key_data(jnp.take(data, idx, axis=0))
+
+
+def pad_worklist(idx: np.ndarray, chunk: int) -> np.ndarray:
+    """Pad a rescued-permutation index list up to the chunk size (the f32
+    rescue reuses the engine's chunk program, whose key axis is the fixed
+    chunk length — padding repeats the first worklist entry, and the
+    padded rows' outputs are dropped)."""
+    idx = np.asarray(idx, dtype=np.int64)
+    pad = np.full(chunk - idx.size, idx[0], dtype=np.int64)
+    return np.concatenate([idx, pad])
+
+
+def host_tail_counts(vals: np.ndarray, obs: np.ndarray):
+    """Exact (hi, lo, eff) exceedance tallies for rescued permutations,
+    computed on the host: ``vals`` is ``(R, K_b, N_STATS)`` f32 from the
+    f32 rescue dispatch, ``obs`` the bucket's ``(K_b, N_STATS)`` f64
+    observed values. Comparisons are made at f64 after an exact f32
+    widen, which decides identically to the device's f32-vs-f32
+    compares (the engine stores observed as an exact f64→f32 cast; see
+    ``PermutationEngine._obs_buckets``)."""
+    v = np.asarray(vals, dtype=np.float64)
+    ob = (
+        np.asarray(obs, dtype=np.float64)[None]
+        .astype(np.float32)
+        .astype(np.float64)
+    )
+    with np.errstate(invalid="ignore"):
+        hi = (v >= ob).sum(axis=0).astype(np.int64)
+        lo = (v <= ob).sum(axis=0).astype(np.int64)
+    eff = (~np.isnan(v)).sum(axis=0).astype(np.int64)
+    return hi, lo, eff
+
+
+class RescueState:
+    """Running tally of the screened pass — how many permutations went
+    through the screen, how many fell in the ambiguity band and were
+    re-dispatched in f32, and in how many rescue dispatches. Rides the
+    null-loop checkpoints via the loops' ``extra_state`` hook so a
+    resumed run reports the whole run's rescued fraction, not the
+    post-resume remainder."""
+
+    def __init__(self):
+        self.total = 0
+        self.rescued = 0
+        self.dispatches = 0
+
+    def fraction(self) -> float:
+        return self.rescued / self.total if self.total else 0.0
+
+    def state_arrays(self) -> dict:
+        return {
+            "screen_total": np.int64(self.total),
+            "screen_rescued": np.int64(self.rescued),
+            "screen_dispatches": np.int64(self.dispatches),
+        }
+
+    def restore_state(self, extras: dict) -> None:
+        self.total = int(np.asarray(extras.get("screen_total", 0)))
+        self.rescued = int(np.asarray(extras.get("screen_rescued", 0)))
+        self.dispatches = int(
+            np.asarray(extras.get("screen_dispatches", 0))
+        )

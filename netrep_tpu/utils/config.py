@@ -273,6 +273,23 @@ class EngineConfig:
     #: on TPU, ``fused_exact`` restoring ~f32-exact selection), and the
     #: streaming↔materialized count contract is bit-exact within the mode.
     stat_mode: str = "auto"
+    #: null-loop precision (ISSUE 16): 'f32' runs every permutation chunk
+    #: through the full-precision chunk body (the path every earlier PR
+    #: measured); 'bf16_rescue' screens each chunk with a bf16-rounded
+    #: variant first — exceedance comparisons whose screened value clears
+    #: the observed statistic by more than a forward-error cushion are
+    #: decided as-is, and only the thin ambiguous band is re-dispatched
+    #: through the existing f32 chunk program, so counts and p-values are
+    #: bit-identical to the all-f32 path by construction (pinned in
+    #: tests/test_screened_null.py the same way screened==unscreened tile
+    #: passes were). 'auto' resolves per backend: TPU-like accelerators
+    #: (tpu/axon) take the screened pass (bf16 MXU-native arithmetic,
+    #: half the gather bytes), CPU stays on 'f32' (bf16 is emulated
+    #: there — the screen would only add work). The screened pass needs
+    #: the observed statistics up front, so runs without ``observed=``
+    #: degrade to 'f32' under 'auto' and raise under explicit
+    #: 'bf16_rescue'.
+    null_precision: str = "auto"
 
     def __post_init__(self):
         if self.network_from_correlation is not None:
@@ -317,6 +334,11 @@ class EngineConfig:
                 f"{self.summary_method!r} is not kernel-supported — use "
                 "summary_method='power' or stat_mode='xla'"
             )
+        if self.null_precision not in ("auto", "f32", "bf16_rescue"):
+            raise ValueError(
+                "null_precision must be 'auto', 'f32', or 'bf16_rescue', "
+                f"got {self.null_precision!r}"
+            )
 
     def resolved_gather_mode(self, platform: str) -> str:
         if self.gather_mode == "auto":
@@ -345,6 +367,15 @@ class EngineConfig:
                 return "fused"
             return "xla"
         return self.stat_mode
+
+    def resolved_null_precision(self, platform: str) -> str:
+        """Resolve ``null_precision`` for a backend (see the attribute
+        doc). 'auto' takes the bf16 screen + f32 rescue only on TPU-like
+        accelerators — on CPU bf16 is software-emulated, so the screened
+        pass costs more than the f32 pass it would save."""
+        if self.null_precision == "auto":
+            return "bf16_rescue" if platform in ("tpu", "axon") else "f32"
+        return self.null_precision
 
     def resolved_perm_batch(
         self,

@@ -288,7 +288,10 @@ ENGINE_EVENTS = (
     "dispatch",
     "distributed_init",
     "module_retired",
+    "null_pass_end",
+    "rescue_dispatch",
     "superchunk",
+    "tail_fit",
     "tail_trim_skipped",
     "tile",
     "tile_screen",

@@ -319,9 +319,13 @@ def time_split(events: Iterable[dict]) -> dict | None:
     - ``compile_s``  — the loops' end-of-run first-interval estimate
       (``compile_span`` events), clamped into the measured dispatch time
       it is a carve-out of;
+    - ``rescue_s``   — the screened null loops' f32 rescue re-dispatches
+      (``rescue_dispatch`` events, ISSUE 16), carved out of the dispatch
+      time they run inside;
     - ``dispatch_s`` — measured host time inside chunk/superchunk
       dispatches (key derivation + program launch; on synchronous
-      backends this includes device compute), minus the compile carve-out;
+      backends this includes device compute), minus the compile and
+      rescue carve-outs;
     - ``transfer_s`` — measured device→host pull time (chunk writes /
       tally pulls; on async backends this includes the device drain);
     - ``host_s``     — the remainder: python loop, monitor folds,
@@ -333,7 +337,7 @@ def time_split(events: Iterable[dict]) -> dict | None:
     report (events predating the tag count as ``jit``).
 
     Returns None when the stream has no closed null run."""
-    total = dispatch_raw = transfer = compile_raw = 0.0
+    total = dispatch_raw = transfer = compile_raw = rescue_raw = 0.0
     n_runs = 0
     by_src: dict[str, float] = {}
     for e in events:
@@ -343,6 +347,8 @@ def time_split(events: Iterable[dict]) -> dict | None:
             n_runs += 1
         elif e["ev"] == "dispatch" and _is_num(d.get("s")):
             dispatch_raw += float(d["s"])
+        elif e["ev"] == "rescue_dispatch" and _is_num(d.get("s")):
+            rescue_raw += float(d["s"])
         elif e["ev"] == "compile_span" and _is_num(d.get("s")):
             compile_raw += float(d["s"])
             src = str(d.get("source") or "jit")
@@ -352,12 +358,14 @@ def time_split(events: Iterable[dict]) -> dict | None:
     if not n_runs:
         return None
     compile_s = min(compile_raw, dispatch_raw)
+    rescue_s = min(rescue_raw, dispatch_raw - compile_s)
     host = max(total - dispatch_raw - transfer, 0.0)
     return {
         "n_runs": n_runs,
         "total_s": total,
         "compile_s": compile_s,
-        "dispatch_s": dispatch_raw - compile_s,
+        "rescue_s": rescue_s,
+        "dispatch_s": dispatch_raw - compile_s - rescue_s,
         "transfer_s": transfer,
         "host_s": host,
         "compile_by_src": by_src,
@@ -375,7 +383,8 @@ def render_time_split(path: str) -> str:
         f"time split over {split['n_runs']} null run(s) "
         f"({split['total_s']:.3f}s total):"
     ]
-    for k in ("compile_s", "dispatch_s", "transfer_s", "host_s"):
+    for k in ("compile_s", "rescue_s", "dispatch_s", "transfer_s",
+              "host_s"):
         src = ""
         if k == "compile_s" and split.get("compile_by_src"):
             # the src column (ISSUE 15): where each run's compile estimate

@@ -67,6 +67,11 @@ CASES = [
     # any row, so this smoke case guards the screen → refine → dispatch
     # restructure and the device-side τ/top-k selection end-to-end
     ["--config", "atlas", "--screen-only"],
+    # mixed-precision null screening (ISSUE 16): bf16-vs-f32 bit-parity of
+    # tail counts (materialized AND streaming) is asserted in-bench before
+    # any row, so this smoke case guards the screened chunk program, the
+    # rescue worklist dispatch, and the null_precision plumbing end-to-end
+    ["--config", "mixed"],
 ]
 
 

@@ -250,3 +250,23 @@ def test_to_frame_and_results_table(two_runs):
         results_table({"disc": {"test": 42}})
     with pytest.raises(ValueError, match="no results"):
         results_table({})
+
+
+def test_combine_refits_gpd_tail_over_pooled_nulls(two_runs):
+    """ISSUE 16: tail p-values never pool additively — when any input
+    carries computed `p_tail`, the combined result REFITS the GPD over
+    the pooled null tail (equal to a direct fit on the concatenated
+    array); inputs without tail columns combine to tail-less results."""
+    a, b = two_runs
+    plain = combine_analyses(a, b)
+    assert plain.p_tail is None and plain.tail_ok is None
+    a.tail_pvalues()
+    c = combine_analyses(a, b)
+    assert c.p_tail is not None and c.p_tail.shape == c.p_values.shape
+    want_p, want_ok = pv.gpd_tail_pvalues(
+        a.observed, c.nulls, a.alternative
+    )
+    np.testing.assert_array_equal(c.p_tail, want_p)
+    np.testing.assert_array_equal(c.tail_ok, want_ok)
+    # NaN exactly where the gate refused — the save/load contract
+    assert np.isnan(c.p_tail[~c.tail_ok]).all()

@@ -1,0 +1,432 @@
+"""Mixed-precision null screening (ISSUE 16) — the bf16 fast pass with
+exact f32 rescue must be *bit-identical by construction* to the all-f32
+loops: decided exceedance comparisons carry a forward-error cushion wider
+than any bf16-rounding drift, and every ambiguous permutation re-runs
+through the unchanged f32 chunk program. Pinned here on CPU (bf16
+rounding is emulated in-program, so the screen's decisions are the real
+TPU decisions): counts/p-values/retirement parity in all four null modes,
+the checkpoint fingerprint + RescueState round-trip, the perm-mesh
+shard_map case, the per-run precision resolution ladder, and the
+telemetry envelope (``rescue_dispatch`` / ``null_pass_end``).
+
+Two fixture regimes exercise both screen outcomes (both proven necessary:
+the toy pair's null bulk overlaps its observed values, so nearly every
+permutation is ambiguous → rescued; shifting the screened observed by
++0.5 separates them, so most rows decide in bf16):
+  * engine-computed observed  → rescue-dominant path
+  * observed + 0.5            → decided-dominant path
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from netrep_tpu.data import make_mixed_pair
+from netrep_tpu.ops import pvalues as pv
+from netrep_tpu.parallel import mesh as meshmod
+from netrep_tpu.parallel import screened as scr
+from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+from netrep_tpu.utils.config import EngineConfig
+from netrep_tpu.utils.telemetry import Telemetry
+
+CFG_F32 = EngineConfig(chunk_size=64, summary_method="eigh", superchunk=3,
+                       autotune=False)
+# explicit bf16_rescue: 'auto' resolves to f32 on the CPU backend these
+# tests run on — the explicit setting is the portable way to engage the
+# screen (the rounding is applied in-program, so CPU decisions are the
+# TPU decisions)
+CFG_BF16 = EngineConfig(chunk_size=64, summary_method="eigh", superchunk=3,
+                        autotune=False, null_precision="bf16_rescue")
+N_PERM = 300
+
+
+@pytest.fixture(scope="module")
+def mixed():
+    return make_mixed_pair(320, 6, n_samples=40, seed=7)
+
+
+def _engine(mixed, config=CFG_F32, mesh=None):
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    return PermutationEngine(
+        dc, dn, dd, tc, tn, td, specs, mixed["pool"], config=config,
+        mesh=mesh,
+    )
+
+
+@pytest.fixture(scope="module")
+def ref(mixed):
+    """The f32 ground truth both screen regimes are pinned against."""
+    eng = _engine(mixed)
+    observed = np.asarray(eng.observed())
+    nulls, done = eng.run_null(N_PERM, key=0)
+    assert done == N_PERM
+    return dict(observed=observed, nulls=np.asarray(nulls))
+
+
+def _counts(obs, nulls):
+    return pv.tail_counts(obs, nulls)
+
+
+# ---------------------------------------------------------------------------
+# materialized
+# ---------------------------------------------------------------------------
+
+def test_materialized_counts_bit_identical(mixed, ref):
+    """Rescue-dominant regime: same key, screened loop — identical
+    exceedance counts and Phipson–Smyth p-values."""
+    obs = ref["observed"]
+    nulls, done = _engine(mixed, CFG_BF16).run_null(
+        N_PERM, key=0, observed=obs
+    )
+    assert done == N_PERM
+    for a, b in zip(_counts(obs, ref["nulls"]), _counts(obs, nulls)):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(
+        pv.permutation_pvalues(obs, ref["nulls"], "greater"),
+        pv.permutation_pvalues(obs, np.asarray(nulls), "greater"),
+    )
+
+
+def test_materialized_decided_rows_stay_exact(mixed, ref):
+    """Decided-dominant regime (observed + 0.5 clears the null bulk):
+    decided rows carry bf16-screened values — the stored nulls genuinely
+    differ from f32 — yet every comparison against the screened observed
+    is identical (the cushion guarantee)."""
+    obs = ref["observed"] + 0.5
+    nulls, done = _engine(mixed, CFG_BF16).run_null(
+        N_PERM, key=0, observed=obs
+    )
+    assert done == N_PERM
+    nulls = np.asarray(nulls)
+    # the screen decided rows in bf16 (not a silent all-rescue run)
+    assert not np.array_equal(nulls, ref["nulls"])
+    for a, b in zip(_counts(obs, ref["nulls"]), _counts(obs, nulls)):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# streaming superchunks
+# ---------------------------------------------------------------------------
+
+def test_streaming_tallies_bit_identical(mixed, ref):
+    obs = ref["observed"]
+    f32 = _engine(mixed).run_null_streaming(N_PERM, obs, key=0)
+    bf16 = _engine(mixed, CFG_BF16).run_null_streaming(N_PERM, obs, key=0)
+    assert bf16.completed == N_PERM
+    np.testing.assert_array_equal(bf16.hi, f32.hi)
+    np.testing.assert_array_equal(bf16.lo, f32.lo)
+    np.testing.assert_array_equal(bf16.eff, f32.eff)
+    # and both equal the materialized ground truth
+    hi, lo, eff = _counts(obs, ref["nulls"])
+    np.testing.assert_array_equal(bf16.hi, hi)
+    np.testing.assert_array_equal(bf16.lo, lo)
+    np.testing.assert_array_equal(bf16.eff, eff)
+
+
+def test_streaming_decided_rows_stay_exact(mixed, ref):
+    obs = ref["observed"] + 0.5
+    f32 = _engine(mixed).run_null_streaming(N_PERM, obs, key=0)
+    bf16 = _engine(mixed, CFG_BF16).run_null_streaming(N_PERM, obs, key=0)
+    np.testing.assert_array_equal(bf16.hi, f32.hi)
+    np.testing.assert_array_equal(bf16.lo, f32.lo)
+    np.testing.assert_array_equal(bf16.eff, f32.eff)
+
+
+# ---------------------------------------------------------------------------
+# adaptive (materialized + streaming)
+# ---------------------------------------------------------------------------
+
+def test_adaptive_retirement_bit_identical(mixed):
+    eng = _engine(mixed)
+    obs = np.asarray(eng.observed())
+    ref_nulls, ref_done, ref_fin = eng.run_null_adaptive(1200, obs, key=3)
+    nulls, done, fin = _engine(mixed, CFG_BF16).run_null_adaptive(
+        1200, obs, key=3
+    )
+    assert (done, fin) == (ref_done, ref_fin)
+    ref_nulls, nulls = np.asarray(ref_nulls), np.asarray(nulls)
+    # retirement pattern (NaN rows) identical per module and statistic
+    np.testing.assert_array_equal(np.isnan(nulls), np.isnan(ref_nulls))
+    for a, b in zip(_counts(obs, ref_nulls), _counts(obs, nulls)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_adaptive_streaming_bit_identical(mixed):
+    eng = _engine(mixed)
+    obs = np.asarray(eng.observed())
+    f32 = eng.run_null_adaptive_streaming(1200, obs, key=3)
+    bf16 = _engine(mixed, CFG_BF16).run_null_adaptive_streaming(
+        1200, obs, key=3
+    )
+    assert bf16.completed == f32.completed
+    np.testing.assert_array_equal(bf16.hi, f32.hi)
+    np.testing.assert_array_equal(bf16.lo, f32.lo)
+    np.testing.assert_array_equal(bf16.eff, f32.eff)
+    np.testing.assert_array_equal(bf16.n_perm_used, f32.n_perm_used)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume
+# ---------------------------------------------------------------------------
+
+def _interrupt_after(n):
+    seen = []
+
+    def cb(done, total):
+        seen.append(done)
+        if len(seen) == n:
+            raise KeyboardInterrupt
+
+    return cb
+
+
+def test_streaming_checkpoint_resume_screened(mixed, ref, tmp_path):
+    """A screened run interrupted mid-stream resumes to the uninterrupted
+    (= f32) tallies: the checkpoint fingerprint is precision-namespaced
+    and the RescueState tally rides the extras, so the resumed run's
+    accounting includes the pre-interrupt rescues."""
+    obs = ref["observed"]
+    ck = str(tmp_path / "screened.npz")
+    part = _engine(mixed, CFG_BF16).run_null_streaming(
+        N_PERM, obs, key=0, progress=_interrupt_after(1),
+        checkpoint_path=ck, checkpoint_every=64,
+    )
+    assert 0 < part.completed < N_PERM
+    # an f32 engine must refuse the screened checkpoint (and never
+    # silently continue without the screen): precision is part of the
+    # resume fingerprint
+    with pytest.raises(ValueError):
+        _engine(mixed).run_null_streaming(
+            N_PERM, obs, key=0, checkpoint_path=ck, checkpoint_every=64,
+        )
+    fin = _engine(mixed, CFG_BF16).run_null_streaming(
+        N_PERM, obs, key=0, checkpoint_path=ck, checkpoint_every=64,
+    )
+    assert fin.completed == N_PERM
+    hi, lo, eff = _counts(obs, ref["nulls"])
+    np.testing.assert_array_equal(fin.hi, hi)
+    np.testing.assert_array_equal(fin.lo, lo)
+    np.testing.assert_array_equal(fin.eff, eff)
+
+
+def test_rescue_state_round_trip():
+    st = scr.RescueState()
+    st.total, st.rescued, st.dispatches = 640, 17, 3
+    extras = st.state_arrays()
+    st2 = scr.RescueState()
+    st2.restore_state(extras)
+    assert (st2.total, st2.rescued, st2.dispatches) == (640, 17, 3)
+    assert st2.fraction() == pytest.approx(17 / 640)
+
+
+# ---------------------------------------------------------------------------
+# perm-mesh shard_map
+# ---------------------------------------------------------------------------
+
+def test_perm_mesh_counts_bit_identical(mixed, ref):
+    """The screened programs under the perm-axis mesh (virtual 8-device
+    CPU mesh from conftest): same counts as the single-device f32 run —
+    the screen shards with the chunk and the rescue gathers global
+    worklists."""
+    obs = ref["observed"]
+    mesh = meshmod.make_mesh()
+    eng = _engine(mixed, CFG_BF16, mesh=mesh)
+    nulls, done = eng.run_null(N_PERM, key=0, observed=obs)
+    assert done == N_PERM
+    for a, b in zip(_counts(obs, ref["nulls"]),
+                    _counts(obs, np.asarray(nulls))):
+        np.testing.assert_array_equal(a, b)
+    stream = _engine(mixed, CFG_BF16, mesh=mesh).run_null_streaming(
+        N_PERM, obs, key=0
+    )
+    hi, lo, eff = _counts(obs, ref["nulls"])
+    np.testing.assert_array_equal(stream.hi, hi)
+    np.testing.assert_array_equal(stream.lo, lo)
+    np.testing.assert_array_equal(stream.eff, eff)
+
+
+# ---------------------------------------------------------------------------
+# precision resolution ladder + init validation
+# ---------------------------------------------------------------------------
+
+def test_resolution_ladder(mixed, ref):
+    obs = ref["observed"]
+    # 'auto' resolves per backend: screen on TPU-class, f32 elsewhere
+    assert CFG_F32.resolved_null_precision("tpu") == "bf16_rescue"
+    assert CFG_F32.resolved_null_precision("cpu") == "f32"
+    assert CFG_BF16.resolved_null_precision("cpu") == "bf16_rescue"
+    # per-run ladder on the explicit engine
+    eng = _engine(mixed, CFG_BF16)
+    assert eng._resolve_null_precision(obs) == "bf16_rescue"
+    # explicit bf16_rescue without observed is a caller error, not a
+    # silent f32 downgrade
+    with pytest.raises(ValueError, match="observed"):
+        eng._resolve_null_precision(None)
+    # non-single-test cell shapes (packed serve monitors) stay f32
+    assert eng._resolve_null_precision(np.zeros((3, 7))) == "f32"
+    # 'auto' without observed runs f32 quietly
+    assert _engine(mixed)._resolve_null_precision(None) == "f32"
+
+
+def test_init_refuses_unscreenable_paths(mixed):
+    cfg = EngineConfig(chunk_size=64, summary_method="eigh",
+                       autotune=False, null_precision="bf16_rescue",
+                       gather_mode="fused")
+    with pytest.raises(ValueError, match="fused"):
+        _engine(mixed, cfg)
+    cfg = EngineConfig(chunk_size=64, summary_method="power",
+                       power_iters=40, autotune=False,
+                       null_precision="bf16_rescue", stat_mode="fused")
+    with pytest.raises(ValueError, match="fused"):
+        _engine(mixed, cfg)
+    cfg = EngineConfig(chunk_size=64, summary_method="eigh",
+                       autotune=False, null_precision="bf16_rescue",
+                       matrix_sharding="row")
+    with pytest.raises(ValueError, match="row"):
+        _engine(mixed, cfg, mesh=meshmod.make_mesh(n_row_shards=4))
+
+
+def test_autotune_key_is_precision_suffixed(mixed):
+    """Screened and f32 throughput histories must never mix: the
+    autotune key carries the precision while the screen is active."""
+    eng = _engine(mixed, CFG_BF16)
+    base = eng.autotune_key(extra="superchunk")
+    eng._screen_active = True
+    try:
+        screened = eng.autotune_key(extra="superchunk")
+    finally:
+        eng._screen_active = False
+    assert screened != base
+    assert "bf16rescue" in screened
+
+
+# ---------------------------------------------------------------------------
+# telemetry envelope
+# ---------------------------------------------------------------------------
+
+def test_telemetry_rescue_events(mixed, ref, tmp_path):
+    from netrep_tpu.utils import telemetry as tm
+
+    assert {"rescue_dispatch", "null_pass_end", "tail_fit"} <= set(
+        tm.KNOWN_EVENTS
+    )
+    path = str(tmp_path / "tel.jsonl")
+    tel = Telemetry(path)
+    try:
+        _engine(mixed, CFG_BF16).run_null(
+            N_PERM, key=0, observed=ref["observed"], telemetry=tel
+        )
+    finally:
+        tel.close()
+    events = [json.loads(l) for l in open(path)]
+    ends = [e for e in events if e["ev"] == "null_pass_end"]
+    assert len(ends) == 1
+    d = ends[0]["data"]
+    assert d["mode"] == "materialized"
+    assert d["precision"] == "bf16_rescue"
+    assert 0.0 <= d["fraction"] <= 1.0
+    assert d["total"] >= N_PERM and d["rescued"] <= d["total"]
+    rescues = [e for e in events if e["ev"] == "rescue_dispatch"]
+    # rescue-dominant fixture: the worklist genuinely dispatched
+    assert rescues and d["rescue_dispatches"] == len(rescues)
+    assert all(e["data"]["rescued"] >= 1 for e in rescues)
+
+
+# ---------------------------------------------------------------------------
+# screened.py units
+# ---------------------------------------------------------------------------
+
+def test_cushion_bounds_bf16_drift():
+    """The per-cell cushion dominates the worst-case forward error of
+    bf16-rounding the operands: statistics recomputed from rounded
+    operands stay inside the cushion band, so a decided comparison can
+    never flip against exact f32."""
+    rng = np.random.default_rng(0)
+    obs = rng.standard_normal((4, 7))
+    cush = scr.null_cushions(obs, operand_amp=2.0)
+    assert (cush >= scr.CUSHION_FLOOR).all()
+    # scales with amplitude and |observed|
+    big = scr.null_cushions(obs * 100, operand_amp=2.0)
+    assert (big >= cush).all()
+    amp = scr.null_cushions(obs, operand_amp=20.0)
+    assert (amp >= cush).all()
+
+
+def test_ambiguous_perms_masks_band_only():
+    obs = np.zeros((2, 7), np.float32)
+    cush = np.full((2, 7), 0.1, np.float32)
+    import jax.numpy as jnp
+
+    outs = [jnp.asarray(np.array(
+        [[[0.5] * 7, [-0.5] * 7],      # clearly decided both modules
+         [[0.05] * 7, [0.5] * 7]],     # ambiguous in module 0
+        np.float32))]
+    amb = np.asarray(scr.ambiguous_perms(
+        outs, [jnp.asarray(obs)], [jnp.asarray(cush)]
+    ))
+    np.testing.assert_array_equal(amb, [False, True])
+
+
+def test_pad_worklist_and_host_tail_counts():
+    idx = np.array([3, 9], np.int32)
+    pad = np.asarray(scr.pad_worklist(idx, 8))
+    assert pad.shape == (8,)
+    np.testing.assert_array_equal(pad[:2], idx)
+    obs = np.array([[0.0, np.nan]], np.float64)
+    vals = np.array([[[1.0, 1.0]], [[-1.0, np.nan]]], np.float32)
+    hi, lo, eff = scr.host_tail_counts(vals, obs)
+    np.testing.assert_array_equal(hi, [[1, 0]])   # NaN obs never exceeds
+    np.testing.assert_array_equal(lo, [[1, 0]])
+    np.testing.assert_array_equal(eff, [[2, 1]])  # NaN draw drops from eff
+
+
+# ---------------------------------------------------------------------------
+# preservation end-to-end + GPD tail persistence
+# ---------------------------------------------------------------------------
+
+def test_preservation_pvalues_bit_identical(toy_pair_module, tmp_path):
+    """module_preservation with null_precision='bf16_rescue' returns the
+    exact f32 p-values (counts identity end-to-end through the model
+    layer), and the GPD tail columns computed on it round-trip through
+    save/load and to_frame."""
+    from netrep_tpu import module_preservation
+    from netrep_tpu.data import pair_frames
+    from netrep_tpu.models.results import PreservationResult
+
+    d, t = pair_frames(toy_pair_module)
+    kwargs = dict(
+        network={"disc": d["network"], "test": t["network"]},
+        data={"disc": d["data"], "test": t["data"]},
+        correlation={"disc": d["correlation"], "test": t["correlation"]},
+        module_assignments=toy_pair_module["labels"],
+        discovery="disc", test="test", n_perm=96, seed=5,
+    )
+    base = EngineConfig(chunk_size=32, summary_method="eigh",
+                        autotune=False)
+    res_f32 = module_preservation(
+        **kwargs, config=base
+    )
+    res_bf16 = module_preservation(
+        **kwargs,
+        config=EngineConfig(chunk_size=32, summary_method="eigh",
+                            autotune=False,
+                            null_precision="bf16_rescue"),
+    )
+    np.testing.assert_array_equal(res_f32.p_values, res_bf16.p_values)
+
+    p_tail, tail_ok = res_bf16.tail_pvalues()
+    assert p_tail.shape == res_bf16.p_values.shape
+    assert tail_ok.dtype == bool
+    assert np.isnan(p_tail[~tail_ok]).all()
+    path = str(tmp_path / "res.npz")
+    res_bf16.save(path)
+    loaded = PreservationResult.load(path)
+    np.testing.assert_array_equal(loaded.p_tail, p_tail)
+    np.testing.assert_array_equal(loaded.tail_ok, tail_ok)
+    try:
+        frame = loaded.to_frame()
+    except ImportError:
+        pytest.skip("pandas not installed")
+    assert "p_tail" in frame.columns and "tail_ok" in frame.columns

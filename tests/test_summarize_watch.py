@@ -239,3 +239,60 @@ def test_lint_rows_classified_and_summarized(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr
     assert "invariant lint (contract health)" in proc.stdout
+
+
+def _mixed_row(**over):
+    row = {
+        "metric": "mixed bf16-screened 96-perm null, 400 genes / 6 modules "
+                  "(null_precision=bf16_rescue streaming vs f32, chunk 32)",
+        "value": 0.394, "unit": "s", "vs_baseline": 1.8, "f32_s": 0.04,
+        "mixed_vs_f32_x": 1.8, "rescued_fraction": 0.02,
+        "counts_parity": True, "device": "TPU v5 lite",
+    }
+    row.update(over)
+    return row
+
+
+def test_mixed_rows_classified_and_rendered(tmp_path):
+    """ISSUE 16: the CPU run of --config mixed is a deliberate
+    parity/mechanism row (bf16 rounding emulated, vs_baseline nulled
+    in-bench) — it must land in the screening-health section, never be
+    silently dropped as a CPU row; a real TPU measurement still flows to
+    the BASELINE result table."""
+    cpu = _mixed_row(
+        device="TFRT_CPU_0", vs_baseline=None, mixed_vs_f32_x=0.1,
+        rescued_fraction=1.0,
+        metric=_mixed_row()["metric"] + " [CPU emulated bf16 rounding: "
+        "parity/mechanism row, reduced shape — the screen only pays off "
+        "on MXU hardware]",
+    )
+    assert classify(cpu) == "mixed"
+    # probe-race fallback variant keeps its mechanism value too
+    assert classify(_mixed_row(tpu_fallback=True)) == "mixed"
+    # a real TPU measurement is a BASELINE result, not a mechanism row
+    assert classify(_mixed_row()) == "result"
+    # near-miss: a mixed-prefixed row WITHOUT the screening fields is not
+    # hijacked into the section (an ordinary CPU row still drops)
+    assert classify({"metric": "mixed something", "value": 1.0,
+                     "device": "TFRT_CPU_0"}) == "dropped"
+
+    text = "\n".join(summarize_watch.mixed_lines([cpu]))
+    assert "rescued_fraction=1.0" in text
+    assert "vs f32 0.1x" in text and "(f32 0.04s)" in text
+    assert "counts bit-identical" in text
+    bad = "\n".join(summarize_watch.mixed_lines(
+        [_mixed_row(counts_parity=False)]))
+    assert "COUNTS PARITY FAILED" in bad
+
+    log = tmp_path / "watch.jsonl"
+    log.write_text(json.dumps(cpu) + "\n" + json.dumps(_mixed_row()) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "benchmarks/summarize_watch.py", str(log)],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "mixed-precision screening (bf16 fast-pass health)" in proc.stdout
+    # the TPU row made the BASELINE table while the CPU row stayed in its
+    # section — both visible, neither misattributed
+    assert "BASELINE.md table snippet" in proc.stdout
+    assert "TPU v5 lite" in proc.stdout

@@ -227,3 +227,48 @@ def test_lint_step_runs_when_forced_and_stays_off_under_queue_hook(tmp_path):
     assert "invariant lint" in log2
     assert '"lint_v": 1' in log2
     assert "queue drained" in log2
+
+
+def test_mixed_step_opt_in_joins_production_queue(tmp_path):
+    """ISSUE 16: MIXED_STEP=1 appends the configMixed step to the
+    PRODUCTION queue. The QUEUE_FILE hook replaces the queue entirely
+    (which is also why the opt-in is inert under the other state-machine
+    tests), so this runs the real queue against a stub `python` that
+    answers every step with one clean TPU-attributed row — end to end
+    through the gate/cutoff machinery, seconds not hours."""
+    import time
+
+    shim_dir = tmp_path / "bin"
+    shim_dir.mkdir()
+    shim = shim_dir / "python"
+    shim.write_text('#!/bin/sh\necho \'{"metric": "stub", "value": 1, '
+                    '"device": "TPU v5 lite"}\'\n')
+    shim.chmod(0o755)
+    for flag, d in (("1", "on"), ("0", "off")):
+        work = tmp_path / d
+        work.mkdir()
+        log, state = work / "log.jsonl", work / "state"
+        proc = subprocess.run(
+            ["bash", WATCH, str(log), str(state)],
+            env={
+                **os.environ,
+                "PROBE_CMD": "true", "SLEEP": "0", "PROBE_TIMEOUT": "1",
+                # past configD's 3600 s timeout so every step is startable
+                "CUTOFF_EPOCH": str(int(time.time()) + 7200),
+                "MIXED_STEP": flag,
+                # the per-cycle drills would hit the stub python too —
+                # their loud-never-fatal banners are not under test here
+                "ELASTIC_DRILL": "0", "LINT_CHECK": "0",
+                "PATH": f"{shim_dir}:{os.environ['PATH']}",
+            },
+            timeout=120, capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stderr
+        log_text = log.read_text()
+        state_text = state.read_text()
+        assert "queue drained" in log_text
+        if flag == "1":
+            assert "configMixed: python bench.py --config mixed" in log_text
+            assert "configMixed PASS" in state_text
+        else:
+            assert "configMixed" not in log_text + state_text
