@@ -47,6 +47,13 @@ BASELINE.md):
                      and key — one row with both wall-clocks and the
                      rescued fraction (pinned-equal-counts gate asserted
                      before any number is emitted)
+    --config grid    all-pairs preservation atlas (ISSUE 17,
+                     grid_preservation): the packed D x D grid vs the
+                     D*(D-1) sequential solo loop on the same cohorts,
+                     plus a one-cohort incremental delta against the
+                     grid manifest — one row with all three wall-clocks
+                     (per-cell bit-identity to the solo runs asserted
+                     before any number is emitted)
     --config sharded delegates to benchmarks/microbench_sharded_gather.py
 
 Usage: python bench.py [--config X] [--genes N] [--modules K] [--perms P]
@@ -1066,6 +1073,174 @@ def bench_mixed(args):
     return emit(row)
 
 
+def bench_grid(args):
+    """All-pairs preservation atlas row (ISSUE 17, ``grid_preservation``):
+    the packed D×D grid vs the D·(D−1) sequential ``module_preservation``
+    loop on the SAME cohorts, seed, and adaptive rule.
+
+    Three measurements ride one row:
+
+    - **sequential baseline** — every ordered (discovery, test) pair as
+      its own solo run (what a user scripts today);
+    - **cold grid** — one ``grid_preservation`` call over the same
+      cohorts with a fresh ``grid_dir``: cross-pair packing amortizes
+      the per-column dispatch streams, the observed-stat cache dedups
+      row-shared discovery work;
+    - **one-cohort delta** — the last cohort's data is regenerated and
+      the grid re-run against the SAME ``grid_dir``: unchanged cells
+      answer from the digest-keyed manifest, the changed row+column
+      recompute with the prior run's count tallies seeding the stop
+      monitors.
+
+    The bit-identity gate runs BEFORE any row is emitted: every cold
+    grid cell must equal its solo run exactly (p-values, observed,
+    per-module permutation counts — the two-identity packing contract),
+    and every delta-run unchanged cell must equal the cold cell. The
+    delta's evaluated permutations are asserted under 25%% of the cold
+    grid's (the incremental re-analysis acceptance). Metric labels carry
+    the ``grid`` prefix so perf-ledger fingerprints keep atlas rows in
+    their own history."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from netrep_tpu import grid_preservation, module_preservation
+    from netrep_tpu.ops.sequential import StopRule
+    from netrep_tpu.utils.config import EngineConfig
+
+    resolve(args, 1000, 8, 1000)
+    cohorts = 6  # the acceptance shape: the delta recomputes 2/D of the
+    #              cells, so the <25% bound needs the full-width grid
+    genes, modules, perms = args.genes, args.modules, args.perms
+    samples = args.samples
+    if args.smoke:
+        # keep the 6-cohort width (the bound under test scales with D);
+        # shrink everything else
+        genes, modules, perms, samples = 300, 4, 96, 32
+    rule = StopRule(min_perms=max(8, perms // 32))
+    cfg = EngineConfig(chunk_size=args.chunk, power_iters=40,
+                       gather_mode=args.gather_mode, autotune=False)
+
+    names = [f"c{i}" for i in range(cohorts)]
+
+    def make_cohort(seed):
+        """Independent cohorts: cross-cohort module preservation is then
+        null-typical, so the adaptive monitors retire modules early and
+        the warm-start priors have decided tallies to seed — the
+        workload the incremental re-analysis is built for. (Preserved
+        modules run to the ceiling in every arm equally; they would only
+        dilute the delta measurement.)"""
+        r = np.random.default_rng(seed)
+        d = r.normal(size=(samples, genes))
+        corr = np.corrcoef(d, rowvar=False)
+        return np.abs(corr) ** 2, corr, d
+
+    network, correlation, data = {}, {}, {}
+    for i, n in enumerate(names):
+        network[n], correlation[n], data[n] = make_cohort(100 + i)
+    # every cohort is a row: contiguous equal blocks, same labels per
+    # cohort (node names are the default node_<j> of array inputs)
+    assign = {
+        n: {f"node_{j}": str(1 + j * modules // genes)
+            for j in range(genes)}
+        for n in names
+    }
+    n_cells = cohorts * (cohorts - 1)
+
+    def solo(d, t):
+        return module_preservation(
+            network, data=data, correlation=correlation,
+            module_assignments=assign[d], discovery=d, test=t,
+            n_perm=perms, null="all", seed=11, config=cfg,
+            simplify=False, adaptive=True, adaptive_rule=rule,
+        )[d][t]
+
+    # ---- sequential baseline: D·(D−1) solo runs -------------------------
+    t0 = time.perf_counter()
+    solo_cells = {
+        (d, t): solo(d, t) for d in names for t in names if t != d
+    }
+    seq_s = time.perf_counter() - t0
+    seq_perms = int(sum(
+        r.module_n_perm().sum() for r in solo_cells.values()
+    ))
+
+    gdir = tempfile.mkdtemp(prefix="bench_grid_")
+    try:
+        # ---- cold grid --------------------------------------------------
+        t0 = time.perf_counter()
+        g = grid_preservation(
+            network, data=data, correlation=correlation,
+            module_assignments=assign, n_perm=perms, null="all", seed=11,
+            config=cfg, adaptive=True, adaptive_rule=rule, grid_dir=gdir,
+        )
+        grid_s = time.perf_counter() - t0
+        grid_perms = int(g.stats["perms_evaluated"])
+        for (d, t), ref in solo_cells.items():
+            cell = g.cell(d, t)
+            assert (
+                np.array_equal(cell.p_values, ref.p_values)
+                and np.array_equal(cell.observed, ref.observed)
+                and np.array_equal(cell.n_perm_used, ref.n_perm_used)
+            ), f"grid cell {d}->{t} != solo run (packing parity broken)"
+
+        # ---- one-cohort delta -------------------------------------------
+        changed = names[-1]
+        network[changed], correlation[changed], data[changed] = (
+            make_cohort(999)
+        )
+        t0 = time.perf_counter()
+        g2 = grid_preservation(
+            network, data=data, correlation=correlation,
+            module_assignments=assign, n_perm=perms, null="all", seed=11,
+            config=cfg, adaptive=True, adaptive_rule=rule, grid_dir=gdir,
+        )
+        delta_s = time.perf_counter() - t0
+        delta_perms = int(g2.stats["perms_evaluated"])
+        for d in names:
+            for t in names:
+                if t == d or changed in (d, t):
+                    continue
+                assert np.array_equal(
+                    g2.cell(d, t).p_values, g.cell(d, t).p_values
+                ), f"unchanged cell {d}->{t} changed under the delta run"
+        assert delta_perms < 0.25 * grid_perms, (
+            f"one-cohort delta evaluated {delta_perms} permutations — "
+            f">= 25% of the cold grid's {grid_perms}; the manifest reuse "
+            "or warm-start priors are not engaging"
+        )
+    finally:
+        shutil.rmtree(gdir, ignore_errors=True)
+
+    return emit({
+        "metric": (
+            f"grid all-pairs atlas, {cohorts} cohorts / {genes} genes / "
+            f"{modules} modules, ceiling {perms} perms "
+            f"({n_cells} cells, adaptive, packed vs sequential)"
+        ),
+        "value": round(grid_s, 3),
+        "unit": "s",
+        "vs_baseline": round(seq_s / grid_s, 3),  # speedup over sequential
+        "sequential_s": round(seq_s, 3),
+        "perms_per_sec": round(grid_perms / grid_s, 2),
+        "grid_perms_evaluated": grid_perms,
+        "sequential_perms_evaluated": seq_perms,
+        "delta_s": round(delta_s, 3),
+        "delta_perms_evaluated": delta_perms,
+        "delta_perm_fraction": round(delta_perms / grid_perms, 4),
+        "cells": n_cells,
+        "cells_reused_on_delta": int(g2.stats["cells_reused"]),
+        "cells_warmstarted_on_delta": int(g2.stats["cells_warmstarted"]),
+        "dedup_hits": int(g.stats["dedup"]["hits"]),
+        "packs": int(g.stats["packs"]),
+        "bit_identical_to_solo": True,  # asserted above, every cell
+        "device": str(jax.devices()[0]),
+        "dtype": args.dtype,
+        "chunk": args.chunk,
+    })
+
+
 def bench_pallas(args):
     """Fused-statistics mega-kernel row (ISSUE 8, ``stat_mode='fused'``):
     the Pallas gather+stats+tally kernel driving the streaming executor vs
@@ -1676,7 +1851,7 @@ def main():
                     choices=["north", "A", "B", "C", "D", "E", "oracle",
                              "native", "sharded", "adaptive", "superchunk",
                              "multichip", "serve", "pallas", "atlas",
-                             "mixed"])
+                             "mixed", "grid"])
     ap.add_argument("--devices", type=int, default=None,
                     help="multichip child marker: measure ONE scaling "
                          "point on this many devices (the parent spawns "
@@ -1728,7 +1903,7 @@ def main():
 
     if (args.config in ("north", "A", "B", "C", "D", "E", "sharded",
                         "adaptive", "superchunk", "serve", "pallas",
-                        "atlas", "mixed")
+                        "atlas", "mixed", "grid")
             and tunnel_expected()
             and not os.environ.get("NETREP_BENCH_NO_SUBPROC")):
         # every config that may touch the tunnel backend (A runs the JAX
@@ -1825,7 +2000,7 @@ def main():
         "C": bench_c, "D": bench_d, "E": bench_e, "oracle": bench_oracle,
         "adaptive": bench_adaptive, "superchunk": bench_superchunk,
         "pallas": bench_pallas, "atlas": bench_atlas,
-        "mixed": bench_mixed,
+        "mixed": bench_mixed, "grid": bench_grid,
     }[args.config](args)
 
 

@@ -334,6 +334,36 @@ warmstart_step() {
   fi
 }
 
+# All-pairs grid step (ISSUE 17, opt-in: GRID_STEP=auto or 1): once per
+# watch cycle, bench the D×D preservation atlas at the smoke shape —
+# cold packed grid vs the sequential per-pair baseline, then the
+# one-cohort digest-delta re-analysis. The bench itself asserts every
+# cell bit-identical to solo module_preservation and the delta under
+# 25% of the cold permutation work, so a pass here certifies packing,
+# dedup, manifest reuse and warm-start priors in one row (perf-ledger
+# fingerprint prefix `grid`). Runs on the chip when one is up (bench.py
+# falls back to a labeled CPU row otherwise). A failed assertion
+# banners LOUDLY but never fails the step; off under the QUEUE_FILE
+# test hook like the other drills.
+GRID_STEP=${GRID_STEP:-0}
+grid_step() {
+  case "$GRID_STEP" in
+    auto|1) ;;
+    *) return 0 ;;
+  esac
+  [ "$GRID_STEP" = auto ] && [ -n "${QUEUE_FILE:-}" ] && return 0
+  echo "--- grid step ($(date -u +%FT%TZ)) ---" | tee -a "$LOG"
+  if ! timeout 1800 python bench.py --smoke --config grid >>"$LOG" 2>&1; then
+    echo "--- GRID STEP FAILED (cell/solo bit-parity or the <25% delta re-analysis bound regressed?) ---" | tee -a "$LOG"
+  fi
+  if [ -s "$PERF_LEDGER" ]; then
+    if ! perf_out=$(timeout 60 python -m netrep_tpu perf "$PERF_LEDGER" --check 2>/dev/null); then
+      echo "--- PERF REGRESSION after grid step ---" | tee -a "$LOG"
+      echo "$perf_out" | tee -a "$LOG"
+    fi
+  fi
+}
+
 echo "== watcher start $(date -u +%FT%TZ) (log=$LOG state=$STATE) ==" | tee -a "$LOG"
 while :; do
   lint_check
@@ -342,6 +372,7 @@ while :; do
   serve_crash_drill
   fleet_drill
   warmstart_step
+  grid_step
   # drained first: with a cutoff set, an empty queue would otherwise be
   # reported as "no step can finish before cutoff" (review r5 — the test
   # harness caught the misleading exit line)

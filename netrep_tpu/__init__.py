@@ -5,6 +5,7 @@ datasets (SURVEY.md; BASELINE.json:5).
 Public API (mirrors the reference's exported surface, SURVEY.md §2.1):
 
 - :func:`module_preservation`   — the main entry point (permutation test).
+- :func:`grid_preservation`     — the all-pairs D×D atlas over datasets.
 - :func:`network_properties`    — observed per-module topological properties.
 - :func:`required_perms`        — permutations needed for a significance level.
 """
@@ -17,6 +18,8 @@ __all__ = [
     "STAT_NAMES",
     "TOPOLOGY_STATS",
     "module_preservation",
+    "grid_preservation",
+    "GridResult",
     "network_properties",
     "required_perms",
     "permp",
@@ -63,6 +66,10 @@ def __getattr__(name):
             "network_properties": properties.network_properties,
             "properties_table": properties.properties_table,
         }[name]
+    if name in ("grid_preservation", "GridResult"):
+        from .models import grid
+
+        return getattr(grid, name)
     if name in ("required_perms", "permp"):
         from .ops import pvalues
 

@@ -969,6 +969,14 @@ def main(argv=None) -> int:
             if replicas:
                 print()
                 print(replicas)
+            # all-pairs grid section (ISSUE 17): present only for logs
+            # written by `grid_preservation`
+            from netrep_tpu.utils.telemetry import render_grid
+
+            grid = render_grid(path0)
+            if grid:
+                print()
+                print(grid)
         return 0
 
     if args.cmd == "top":

@@ -1,0 +1,570 @@
+"""All-pairs preservation atlas (ISSUE 17): the D×D grid as ONE job.
+
+The paper's unit of work is one (discovery, test) pair; real consortia
+ask the D·(D−1) question — is every module of every cohort preserved in
+every other cohort? — and re-ask it every time one cohort grows.
+:func:`grid_preservation` runs that grid with every amortization the
+serving stack already proved out, while keeping each cell's numbers
+BIT-IDENTICAL to a solo :func:`~netrep_tpu.models.preservation
+.module_preservation` call with the same seed (pinned in
+tests/test_grid.py):
+
+- **cross-pair packing** — cells sharing a test dataset (a grid COLUMN)
+  and a byte-equal permutation pool ride one
+  :class:`~netrep_tpu.serve.packer.GridPackedEngine`: shared
+  module-size-bucket dispatch streams, per-request discovery props,
+  request-local slice offsets, per-request RNG key groups;
+- **discovery-side dedup** — one
+  :class:`~netrep_tpu.parallel.engine.ObservedCache` spans the whole
+  grid, so cells sharing a discovery dataset (a grid ROW) compute their
+  per-bucket discovery property arrays once (digest-keyed; hits emit
+  ``grid_dedup_hit``);
+- **grid checkpoint** — with ``grid_dir`` set, the grid persists as a
+  digest-keyed JSON manifest of per-cell results (each a
+  :class:`~netrep_tpu.models.results.PreservationResult` ``.npz``) plus
+  per-pack count-space chunk checkpoints, so an interrupted grid resumes
+  across tunnel windows and a FINISHED cell is never recomputed;
+- **fleet spread** — ``fleet=`` routes each cell through a
+  :class:`~netrep_tpu.serve.fleet.FleetCoordinator`: rows land on
+  replicas by the PR 14 content-digest hash ring (locality: one
+  replica's warm engines keep serving the same cohort pair);
+- **incremental re-analysis** — when one dataset's ``content_digest``
+  changes, only its row and column recompute; each recomputed adaptive
+  cell's :class:`~netrep_tpu.ops.sequential.StopMonitor` is seeded with
+  the prior run's per-module count-space tallies
+  (:meth:`~netrep_tpu.ops.sequential.StopMonitor.seed_priors`, emitted
+  as ``grid_warmstart_seeded``), so a stable cell retires in hundreds of
+  fresh permutations while every REPORTED number stays fresh-draw-only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import time
+
+import numpy as np
+
+from ..ops import pvalues as pv
+from ..parallel.engine import ObservedCache
+from ..utils import telemetry as tm
+from ..utils.checkpoint import content_digest
+from ..utils.config import EngineConfig
+from . import dataset as ds
+from .preservation import _overlap_setup
+from .results import PreservationResult
+
+logger = logging.getLogger("netrep_tpu")
+
+MANIFEST_NAME = "grid_manifest.json"
+_MANIFEST_VERSION = 1
+
+
+@dataclasses.dataclass
+class GridResult:
+    """The grid's results plus its execution accounting.
+
+    ``results[discovery][test]`` is the cell's
+    :class:`~netrep_tpu.models.results.PreservationResult` — bit-identical
+    to the solo call. ``stats`` records how the grid earned its speed:
+    ``cells_total``/``cells_computed``/``cells_reused``/
+    ``cells_warmstarted``, ``perms_evaluated`` (fresh permutations ×
+    modules actually folded, the bench's <25%-delta meter), and the
+    observed-cache ``dedup`` counters."""
+
+    results: dict
+    stats: dict
+    manifest_path: str | None = None
+
+    def cell(self, discovery, test) -> PreservationResult:
+        return self.results[str(discovery)][str(test)]
+
+    def __getitem__(self, key):
+        return self.results[str(key)]
+
+    def cells(self):
+        for d, row in self.results.items():
+            for t, res in row.items():
+                yield d, t, res
+
+
+def _cfg_id(config: EngineConfig) -> str:
+    return hashlib.blake2b(repr(config).encode(),
+                           digest_size=8).hexdigest()
+
+
+def _pool_sig(pool: np.ndarray) -> str:
+    return hashlib.blake2b(
+        np.ascontiguousarray(pool, dtype=np.int64), digest_size=8
+    ).hexdigest()
+
+
+def _cell_key(d: str, t: str) -> str:
+    return f"{d}→{t}"
+
+
+def _safe(name: str) -> str:
+    import re
+
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", str(name))
+
+
+def _auto_n_perm(labels, with_data: bool) -> int:
+    # the library's Bonferroni auto rule (models/preservation.py),
+    # mirrored per cell so a grid cell defaults exactly like a solo call
+    n_stats_eff = 7 if with_data else 3
+    return max(1000, pv.required_perms(0.05, n_tests=len(labels) * n_stats_eff))
+
+
+def _result_from_pack(res: dict, d: str, t: str) -> PreservationResult:
+    """One run_pack / serve result dict → the PreservationResult the solo
+    call would shape (count-space: the grid never materializes nulls)."""
+    n_used = res.get("n_perm_used")
+    return PreservationResult(
+        n_perm_used=None if n_used is None else np.asarray(n_used),
+        p_type=str(res["p_type"]),
+        discovery=d,
+        test=t,
+        module_labels=[str(l) for l in res["module_labels"]],
+        observed=np.asarray(res["observed"]),
+        nulls=None,
+        counts_hi=np.asarray(res["counts_hi"]),
+        counts_lo=np.asarray(res["counts_lo"]),
+        counts_eff=np.asarray(res["counts_eff"]),
+        p_values=np.asarray(res["p_values"]),
+        n_vars_present=np.asarray(res["n_vars_present"]),
+        prop_vars_present=np.asarray(res["prop_vars_present"]),
+        total_size=np.asarray(res["total_size"]),
+        alternative=str(res["alternative"]),
+        n_perm=int(res["n_perm"]),
+        completed=int(res["completed"]),
+        total_space=res["total_space"],
+    )
+
+
+def _cell_perms(res: PreservationResult) -> int:
+    """Fresh permutation-work meter for one cell: per-module counts for
+    adaptive runs, completed × modules for fixed ones."""
+    if res.n_perm_used is not None:
+        return int(np.asarray(res.n_perm_used, dtype=np.int64).sum())
+    return int(res.completed) * len(res.module_labels)
+
+
+def _priors_from(prev: PreservationResult, labels) -> tuple | None:
+    """Warm-start tallies from a prior run of the same cell — None when
+    the stored result cannot seed this run's monitor (module set changed,
+    non-adaptive prior, or counts missing)."""
+    if prev.p_type != "sequential" or prev.n_perm_used is None:
+        return None
+    if prev.counts_hi is None or prev.counts_lo is None:
+        return None
+    if [str(l) for l in prev.module_labels] != [str(l) for l in labels]:
+        return None
+    return (
+        np.asarray(prev.counts_hi, dtype=np.int64),
+        np.asarray(prev.counts_lo, dtype=np.int64),
+        np.asarray(prev.n_perm_used, dtype=np.int64),
+    )
+
+
+def _load_manifest(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            m = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if m.get("version") != _MANIFEST_VERSION:
+        return None
+    return m
+
+
+def _write_manifest(path: str, manifest: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def grid_preservation(
+    network=None,
+    data=None,
+    correlation=None,
+    module_assignments=None,
+    modules=None,
+    background_label: str = "0",
+    datasets=None,
+    n_perm: int | None = None,
+    null: str = "overlap",
+    alternative: str = "greater",
+    seed: int = 0,
+    config: EngineConfig | None = None,
+    adaptive: bool = False,
+    adaptive_rule=None,
+    grid_dir: str | None = None,
+    fleet=None,
+    fleet_tenant: str = "grid",
+    telemetry=None,
+    verbose: bool = False,
+    checkpoint_every: int = 8192,
+) -> GridResult:
+    """Run the all-pairs preservation grid (module docstring).
+
+    Inputs follow :func:`~netrep_tpu.models.preservation
+    .module_preservation`'s dict-keyed surface: ``network`` /
+    ``correlation`` / ``data`` map dataset names to matrices, and
+    ``module_assignments`` maps each DISCOVERY dataset name to its
+    node→module mapping — every assigned dataset is a grid row, every
+    dataset is a grid column, and the cells are all ordered pairs
+    (row, column) with row ≠ column. ``datasets`` optionally narrows
+    the grid to a subset of names (rows and columns).
+
+    - ``grid_dir`` — persistence root: the digest-keyed manifest, one
+      ``cell_<d>__<t>.npz`` result per finished cell, and ``ckpt/`` pack
+      checkpoints. Re-running with the same directory resumes: finished
+      cells whose dataset digests (and analysis parameters) still match
+      load from disk with ZERO permutations; a changed dataset
+      invalidates exactly its row + column, and (``adaptive=True``) each
+      invalidated cell's fresh monitor is seeded with the stored run's
+      tallies as priors.
+    - ``fleet`` — a :class:`~netrep_tpu.serve.fleet.FleetCoordinator`
+      (e.g. :func:`~netrep_tpu.serve.fleet.build_inprocess_fleet`):
+      cells route to replicas via the content-digest hash ring instead
+      of running in-process. The coordinator's serve config must carry
+      the same ``EngineConfig`` for bit-parity (the serve contract).
+      Grid-side manifest reuse still applies; warm-start priors and the
+      cross-grid observed cache are local-execution features.
+    - ``adaptive`` / ``adaptive_rule`` / ``n_perm`` / ``null`` /
+      ``alternative`` / ``seed`` — per-cell analysis knobs, exactly as
+      the solo call interprets them (every cell shares the one seed,
+      like ``module_preservation`` across pairs).
+    """
+    if null not in ("overlap", "all"):
+        raise ValueError(f"null must be 'overlap' or 'all', got {null!r}")
+    if alternative not in ("greater", "less", "two.sided"):
+        raise ValueError(
+            "alternative must be one of 'greater', 'less', 'two.sided', "
+            f"got {alternative!r}"
+        )
+    config = config or EngineConfig()
+    if config.network_from_correlation is not None:
+        raise ValueError(
+            "grid_preservation v1 runs on materialized matrices; "
+            "data-only (derived-network) grids run cell-by-cell via "
+            "module_preservation"
+        )
+    built = ds.build_datasets(network, data=data, correlation=correlation)
+    names = (
+        list(built) if datasets is None else [str(n) for n in datasets]
+    )
+    for n in names:
+        if n not in built:
+            raise ValueError(f"datasets names unknown dataset {n!r}")
+    if not isinstance(module_assignments, dict) or not module_assignments:
+        raise ValueError(
+            "grid_preservation needs module_assignments as a dict keyed "
+            "by discovery dataset name (each value the node→module "
+            "mapping)"
+        )
+    rows = [n for n in names if n in module_assignments]
+    if not rows:
+        raise ValueError(
+            "no grid dataset carries module assignments; nothing to test"
+        )
+    assign = ds.normalize_module_assignments(
+        {k: module_assignments[k] for k in rows}, built, rows
+    )
+    cells = [(d, t) for d in rows for t in names if t != d]
+    if not cells:
+        raise ValueError("the grid needs at least two datasets")
+
+    digests = {
+        n: content_digest(
+            [built[n].correlation, built[n].network, built[n].data]
+        )
+        for n in names
+    }
+    cfg_id = _cfg_id(config)
+    params = {
+        "null": null, "alternative": alternative, "seed": int(seed),
+        "adaptive": bool(adaptive), "cfg": cfg_id,
+        "n_perm": None if n_perm is None else int(n_perm),
+        "rule": repr(adaptive_rule) if adaptive_rule is not None else None,
+    }
+
+    manifest_path = None
+    manifest = None
+    prior_cells: dict[str, dict] = {}
+    if grid_dir is not None:
+        os.makedirs(os.path.join(grid_dir, "ckpt"), exist_ok=True)
+        manifest_path = os.path.join(grid_dir, MANIFEST_NAME)
+        manifest = _load_manifest(manifest_path)
+        if manifest is not None and manifest.get("params") == params:
+            prior_cells = dict(manifest.get("cells", {}))
+        manifest = {
+            "version": _MANIFEST_VERSION, "params": params,
+            "datasets": dict(digests), "cells": {},
+        }
+
+    tel, tel_owned = tm.resolve_arg(telemetry)
+    tel_cm = tel.activate() if tel is not None else None
+    grid_sid = None
+    if tel_cm is not None:
+        tel_cm.__enter__()
+        grid_sid = tel.begin_span(
+            "grid_start", datasets=len(names), rows=len(rows),
+            cells=len(cells), adaptive=bool(adaptive),
+            fleet=fleet is not None, resumable=grid_dir is not None,
+        )
+    t0 = time.perf_counter()
+    cache = ObservedCache()
+    stats = {
+        "cells_total": len(cells), "cells_computed": 0,
+        "cells_reused": 0, "cells_warmstarted": 0,
+        "perms_evaluated": 0, "packs": 0,
+    }
+    try:
+        results, computed = _run_grid(
+            built, names, rows, cells, assign, modules, background_label,
+            null, alternative, n_perm, seed, config, adaptive,
+            adaptive_rule, grid_dir, manifest, prior_cells, digests,
+            fleet, fleet_tenant, tel, cache, stats, verbose,
+            checkpoint_every,
+        )
+        if manifest_path is not None:
+            _write_manifest(manifest_path, manifest)
+        stats["dedup"] = cache.stats()
+        stats["wall_s"] = time.perf_counter() - t0
+        if tel is not None:
+            tel.end_span(
+                grid_sid, "grid_end",
+                cells_computed=stats["cells_computed"],
+                cells_reused=stats["cells_reused"],
+                cells_warmstarted=stats["cells_warmstarted"],
+                perms_evaluated=stats["perms_evaluated"],
+                s=stats["wall_s"],
+            )
+        return GridResult(results=results, stats=stats,
+                          manifest_path=manifest_path)
+    finally:
+        if tel_cm is not None:
+            tel_cm.__exit__(None, None, None)
+            if tel_owned:
+                tel.close()
+
+
+def _run_grid(built, names, rows, cells, assign, modules, background_label,
+              null, alternative, n_perm, seed, config, adaptive,
+              adaptive_rule, grid_dir, manifest, prior_cells, digests,
+              fleet, fleet_tenant, tel, cache, stats, verbose,
+              checkpoint_every):
+    """Grid execution body: resolve every cell's plan, reuse finished
+    cells from the manifest, then run the remaining cells column-packed
+    (or fleet-routed) and persist."""
+    from ..serve.packer import (
+        GridPackedEngine, RequestPlan, assign_bases, run_pack,
+    )
+
+    def cell_path(d, t):
+        if grid_dir is None:
+            return None
+        return os.path.join(grid_dir, f"cell_{_safe(d)}__{_safe(t)}.npz")
+
+    # -- resolve plans -----------------------------------------------------
+    plans: dict[tuple[str, str], dict] = {}
+    for d, t in cells:
+        labels, specs, counts, pool = _overlap_setup(
+            built[d], built[t], assign[d], modules, background_label, null
+        )
+        with_data = built[d].data is not None and built[t].data is not None
+        np_this = (
+            int(n_perm) if n_perm is not None
+            else _auto_n_perm(labels, with_data)
+        )
+        plans[(d, t)] = {
+            "labels": labels, "specs": specs, "counts": counts,
+            "pool": pool, "n_perm": np_this, "with_data": with_data,
+        }
+
+    # -- manifest reuse + warm-start priors --------------------------------
+    results: dict[str, dict] = {d: {} for d in rows}
+    todo: list[tuple[str, str]] = []
+    priors: dict[tuple[str, str], tuple] = {}
+    for d, t in cells:
+        key = _cell_key(d, t)
+        ent = prior_cells.get(key)
+        path = cell_path(d, t)
+        fresh = (
+            ent is not None and path is not None
+            and ent.get("disc_digest") == digests[d]
+            and ent.get("test_digest") == digests[t]
+            and int(ent.get("n_perm", -1)) == plans[(d, t)]["n_perm"]
+            and os.path.exists(ent.get("path") or path)
+        )
+        if fresh:
+            try:
+                res = PreservationResult.load(ent.get("path") or path)
+            except (OSError, ValueError):
+                fresh = False
+            else:
+                results[d][t] = res
+                stats["cells_reused"] += 1
+                if manifest is not None:
+                    manifest["cells"][key] = dict(ent)
+                if tel is not None:
+                    tel.emit("grid_cell_done", discovery=str(d),
+                             test=str(t), source="manifest", perms=0)
+        if not fresh:
+            todo.append((d, t))
+            if adaptive and ent is not None:
+                stored = ent.get("path") or path
+                if stored and os.path.exists(stored):
+                    try:
+                        prev = PreservationResult.load(stored)
+                    except (OSError, ValueError):
+                        prev = None
+                    p = (None if prev is None
+                         else _priors_from(prev, plans[(d, t)]["labels"]))
+                    if p is not None:
+                        priors[(d, t)] = p
+
+    def finish_cell(d, t, res: PreservationResult):
+        results[d][t] = res
+        stats["cells_computed"] += 1
+        perms = _cell_perms(res)
+        stats["perms_evaluated"] += perms
+        path = cell_path(d, t)
+        if path is not None:
+            res.save(path)
+            manifest["cells"][_cell_key(d, t)] = {
+                "discovery": str(d), "test": str(t),
+                "disc_digest": digests[d], "test_digest": digests[t],
+                "n_perm": int(plans[(d, t)]["n_perm"]),
+                "completed": int(res.completed),
+                "p_type": res.p_type, "path": path,
+                "warmstarted": (d, t) in priors,
+            }
+        if tel is not None:
+            tel.emit("grid_cell_done", discovery=str(d), test=str(t),
+                     source="computed", perms=int(perms),
+                     warmstarted=(d, t) in priors)
+
+    # -- fleet spread ------------------------------------------------------
+    if fleet is not None and todo:
+        _run_fleet(fleet, fleet_tenant, built, assign, todo, plans, null,
+                   alternative, seed, adaptive, adaptive_rule, tel,
+                   finish_cell, verbose)
+        return results, todo
+
+    # -- column-packed local execution -------------------------------------
+    # group the remaining cells by (test dataset, pool signature, data
+    # presence): the GridPackedEngine compatibility identity. Cells of a
+    # group share one engine; groups of one run as single-request packs
+    # through the same code path.
+    groups: dict[tuple, list[tuple[str, str]]] = {}
+    for t in names:
+        for d, tt in todo:
+            if tt != t:
+                continue
+            p = plans[(d, t)]
+            gkey = (t, _pool_sig(p["pool"]), p["with_data"])
+            groups.setdefault(gkey, []).append((d, t))
+    for (t, psig, with_data), members in groups.items():
+        req_plans = []
+        sources = []
+        for d, _t in members:
+            p = plans[(d, t)]
+            req_plans.append(RequestPlan(
+                labels=p["labels"], specs=p["specs"], counts=p["counts"],
+                pool=p["pool"], n_perm=p["n_perm"], seed=int(seed),
+                alternative=alternative, adaptive=bool(adaptive),
+                rule=adaptive_rule, priors=priors.get((d, t)),
+            ))
+            dd = built[d]
+            sources.append((
+                dd.correlation, dd.network,
+                dd.data if with_data else None,
+            ))
+        assign_bases(req_plans)
+        tds = built[t]
+        engine = GridPackedEngine(
+            sources, tds.correlation, tds.network,
+            tds.data if with_data else None,
+            [p.specs for p in req_plans], req_plans[0].pool,
+            config=config, observed_cache=cache,
+        )
+        ck = None
+        if grid_dir is not None:
+            h = hashlib.blake2b(digest_size=8)
+            for (d, _t), p in zip(members, req_plans):
+                h.update(f"{d}|{t}|{p.seed}|{p.n_perm}|".encode())
+                h.update(p.signature().encode())
+            ck = os.path.join(grid_dir, "ckpt",
+                              f"pack_{_safe(t)}_{h.hexdigest()}.npz")
+        if verbose:
+            logger.info(
+                "grid column %r: %d cell(s) packed (%s)", t, len(members),
+                ", ".join(d for d, _ in members),
+            )
+        for d, _t in members:
+            if tel is not None:
+                tel.emit("grid_cell_start", discovery=str(d), test=str(t),
+                         pack_size=len(members),
+                         n_modules=len(plans[(d, t)]["labels"]),
+                         warmstarted=(d, t) in priors)
+            if (d, t) in priors:
+                stats["cells_warmstarted"] += 1
+                if tel is not None:
+                    tel.emit(
+                        "grid_warmstart_seeded", discovery=str(d),
+                        test=str(t),
+                        prior_perms=int(priors[(d, t)][2].sum()),
+                    )
+        stats["packs"] += 1
+        pack_res = run_pack(
+            engine, req_plans, telemetry=tel, checkpoint_path=ck,
+            checkpoint_every=checkpoint_every,
+        )
+        if ck is not None:
+            # the pack finished: its chunk checkpoint is spent
+            try:
+                os.unlink(ck)
+            except OSError:
+                pass
+        for (d, _t), res in zip(members, pack_res):
+            finish_cell(d, t, _result_from_pack(res, d, t))
+    return results, todo
+
+
+def _run_fleet(fleet, tenant, built, assign, todo, plans, null,
+               alternative, seed, adaptive, adaptive_rule, tel,
+               finish_cell, verbose):
+    """Fleet-spread execution: register every grid dataset once (the
+    coordinator broadcasts and records content digests for ring
+    routing), then route each cell to the replica the hash ring owns it
+    on. The serve path's own pack/bit-parity contract applies on each
+    replica; cells sharing a replica and test dataset pack there."""
+    needed = sorted({d for d, _ in todo} | {t for _, t in todo})
+    for n in needed:
+        dset = built[n]
+        fleet.register_dataset(
+            tenant, n, network=dset.network, correlation=dset.correlation,
+            data=dset.data, assignments=assign.get(n),
+        )
+    for d, t in todo:
+        if tel is not None:
+            tel.emit("grid_cell_start", discovery=str(d), test=str(t),
+                     pack_size=1, fleet=True,
+                     n_modules=len(plans[(d, t)]["labels"]))
+        if verbose:
+            rep = fleet.route(tenant, d, t)
+            logger.info("grid cell %r→%r routed to replica %s", d, t,
+                        getattr(rep, "rid", "?"))
+        res = fleet.analyze(
+            tenant, d, t, n_perm=plans[(d, t)]["n_perm"], seed=int(seed),
+            alternative=alternative, adaptive=bool(adaptive),
+            rule=adaptive_rule,
+        )
+        finish_cell(d, t, _result_from_pack(res, d, t))

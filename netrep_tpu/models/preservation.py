@@ -71,7 +71,7 @@ def _overlap_setup(disc_ds, test_ds, assignments, modules, background_label, nul
 
 def _make_result(d_name, t_name, labels, counts, observed, nulls, completed,
                  np_this, alternative, total_space, profile=None,
-                 p_type="fixed", stream=None):
+                 p_type="fixed", stream=None, nulls_exact=True):
     hi = lo = eff = None
     if stream is not None:
         # streaming run (store_nulls=False): exact Phipson–Smyth from the
@@ -118,7 +118,27 @@ def _make_result(d_name, t_name, labels, counts, observed, nulls, completed,
         completed=completed,
         profile=profile,
         total_space=total_space,
+        nulls_exact=nulls_exact,
     )
+
+
+def _nulls_exact(engine, observed, nulls) -> bool:
+    """Whether a pair's materialized null array carries exact f32 VALUES.
+
+    The bf16 screened fast-pass (ISSUE 16) keeps counts and p-values
+    bit-identical to the f32 run but stores decided permutations'
+    bf16-rounded statistics — so a screened run's null array must not
+    feed the GPD tail fit (:meth:`PreservationResult.tail_pvalues`).
+    Resolution is asked of the engine the run STARTED on: a mid-run
+    elastic downgrade to CPU flips later chunks to f32, but the earlier
+    screened chunks already quantized part of the array — the
+    conservative answer stays False."""
+    if nulls is None:
+        return True  # streaming runs carry counts only; nothing to gate
+    resolve = getattr(engine, "_resolve_null_precision", None)
+    if resolve is None:
+        return True
+    return resolve(observed) != "bf16_rescue"
 
 
 def module_preservation(
@@ -149,6 +169,7 @@ def module_preservation(
     profile=None,
     adaptive: bool = False,
     adaptive_rule=None,
+    adaptive_priors=None,
     store_nulls: bool = True,
     telemetry=None,
     fault_policy=None,
@@ -206,6 +227,20 @@ def module_preservation(
       :class:`~netrep_tpu.ops.sequential.StopRule` overriding the stopping
       knobs (exceedance budget ``h``, decision ``alpha``, CP interval
       ``confidence``, ``min_perms`` floor).
+    - ``adaptive_priors`` — warm-start tallies for ONE (discovery, test)
+      pair's adaptive run (ISSUE 17 incremental re-analysis): a
+      ``(counts_hi, counts_lo, n_perm_used)`` triple from a prior run of
+      the same cell, seeded into the
+      :class:`~netrep_tpu.ops.sequential.StopMonitor` decision rules
+      (:meth:`~netrep_tpu.ops.sequential.StopMonitor.seed_priors`)
+      before any fresh permutation folds. Decisions then settle on
+      prior+fresh evidence — a stable module retires after a few hundred
+      fresh draws instead of re-earning its whole tally — while every
+      REPORTED number (counts, p-values, ``n_perm_used``) stays
+      fresh-draw-only, so the result is a valid standalone analysis at
+      its own (smaller) permutation count. Requires ``adaptive=True``,
+      the default ``backend='jax'``, ``store_nulls=True``, and exactly
+      one (discovery, test) pair.
     - ``store_nulls`` — ``False`` streams the null: the engine fuses
       ``config.superchunk`` chunks per device dispatch (``jax.lax.scan``)
       and folds per-(module, statistic) exceedance tallies on device, so
@@ -380,6 +415,23 @@ def module_preservation(
         else ds.build_datasets(network, data=data, correlation=correlation)
     )
     pairs = ds.resolve_pairs(datasets, discovery, test, self_preservation)
+    if adaptive_priors is not None:
+        if not adaptive:
+            raise ValueError(
+                "adaptive_priors seeds the sequential stop monitor; it "
+                "requires adaptive=True"
+            )
+        if backend != "jax" or not store_nulls:
+            raise ValueError(
+                "adaptive_priors requires the default backend='jax' with "
+                "store_nulls=True (the materialized adaptive path)"
+            )
+        if len(pairs) != 1:
+            raise ValueError(
+                "adaptive_priors carries ONE cell's prior tallies; got "
+                f"{len(pairs)} (discovery, test) pairs — warm-start each "
+                "pair separately (grid_preservation does this per cell)"
+            )
     disc_names = sorted({d for d, _ in pairs}, key=list(datasets).index)
     assign = ds.normalize_module_assignments(
         module_assignments, datasets, disc_names
@@ -424,6 +476,7 @@ def module_preservation(
             vmap_tests, backend, seed, progress, ckpt_path, checkpoint_every,
             verbose, simplify, results, trace_dir, profiling,
             adaptive, adaptive_rule, store_nulls, tel, ft,
+            adaptive_priors=adaptive_priors,
         )
         if tel is not None:
             tel.end_span(
@@ -448,7 +501,7 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                vmap_tests, backend, seed, progress, ckpt_path,
                checkpoint_every, verbose, simplify, results, trace_dir,
                profiling, adaptive=False, adaptive_rule=None,
-               store_nulls=True, tel=None, ft=None):
+               store_nulls=True, tel=None, ft=None, adaptive_priors=None):
     """Pair-loop body of :func:`module_preservation` (split out so the
     profiler trace context can bracket it without deep nesting)."""
 
@@ -498,6 +551,7 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                 np_this, observed, key=seed, alternative=alternative,
                 rule=adaptive_rule, progress=prog, checkpoint_path=ck,
                 checkpoint_every=checkpoint_every, fault_policy=ft,
+                priors=adaptive_priors,
             )
             return nulls, None, completed, not finished
         nulls, completed = engine.run_null(
@@ -746,6 +800,7 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                             eff=stream.eff[ti],
                         )
                     ),
+                    nulls_exact=_nulls_exact(engine, observed, nulls),
                 )
             continue
 
@@ -813,6 +868,7 @@ def _run_pairs(by_disc, datasets, assign, modules, background_label, null,
                 ),
                 p_type="sequential" if adaptive else "fixed",
                 stream=stream,
+                nulls_exact=_nulls_exact(engine, observed, nulls),
             )
             if was_interrupted:
                 # Ctrl-C aborts the whole multi-pair run, not just the

@@ -86,6 +86,14 @@ class PreservationResult:
     tail_ok: np.ndarray | None = None  # (n_modules, 7) bool: True only
                                   # where p_tail came from a fit that
                                   # passed the Anderson–Darling gate.
+    nulls_exact: bool = True      # False when the stored null VALUES went
+                                  # through the bf16 screened fast-pass
+                                  # (ISSUE 16): decided permutations keep
+                                  # their bf16-rounded statistics — counts
+                                  # and p-values are exact by construction,
+                                  # the value array is not. Gates the GPD
+                                  # tail fit, which reads the extreme
+                                  # values themselves (see tail_pvalues()).
 
     @property
     def stat_names(self) -> tuple[str, ...]:
@@ -181,7 +189,14 @@ class PreservationResult:
         null array (requires ``store_nulls=True``) and cached on the result
         as ``p_tail``/``tail_ok`` so they persist through :meth:`save`.
         Returns ``(p_tail, tail_ok)``; ``p_tail`` is NaN wherever
-        ``tail_ok`` is False — fall back to ``p_values`` there."""
+        ``tail_ok`` is False — fall back to ``p_values`` there.
+
+        Raises when the stored null values came through the bf16 screened
+        fast-pass (``nulls_exact=False``, ISSUE 16): decided permutations
+        keep their bf16-rounded statistics, and a GPD fit over that
+        quantized tail is meaningless even though the counts-based
+        ``p_values`` remain exact. Rerun with
+        ``EngineConfig(null_precision='f32')`` for a fittable array."""
         if self.p_tail is not None and not refresh:
             return self.p_tail, self.tail_ok
         if self.nulls is None:
@@ -196,6 +211,7 @@ class PreservationResult:
             self.observed,
             np.asarray(self.nulls)[: self.completed],
             self.alternative,
+            nulls_exact=self.nulls_exact,
         )
         tel = tm.current()
         if tel is not None:
@@ -246,6 +262,9 @@ class PreservationResult:
             # the flag (additive key, same format version) tells load() to
             # restore nulls=None instead of the empty placeholder below
             "store_nulls": self.nulls is not None,
+            # additive key: files written before the bf16 screen existed
+            # always carried exact f32 null values
+            "nulls_exact": bool(self.nulls_exact),
         }
         extra = (
             {} if self.n_perm_used is None
@@ -311,6 +330,7 @@ class PreservationResult:
                 ),
                 p_tail=z["p_tail"] if "p_tail" in z.files else None,
                 tail_ok=z["tail_ok"] if "tail_ok" in z.files else None,
+                nulls_exact=bool(meta.get("nulls_exact", True)),
                 p_values=z["p_values"],
                 n_vars_present=z["n_vars_present"],
                 prop_vars_present=z["prop_vars_present"],
@@ -531,15 +551,20 @@ def _combine_pair_results(results, allow_duplicate_nulls):
         for r in results
     )
     # tail p-values do not pool additively — refit the GPD over the pooled
-    # null tail whenever any input had computed them
+    # null tail whenever any input had computed them. Exactness is a
+    # conjunction: one screened block quantizes part of the pooled tail,
+    # so the refit is dropped rather than fitted over quantized draws
+    # (tail_pvalues() on the combined result raises with the guidance).
+    nulls_exact = all(r.nulls_exact for r in results)
     p_tail = tail_ok = None
-    if any(r.p_tail is not None for r in results):
+    if nulls_exact and any(r.p_tail is not None for r in results):
         p_tail, tail_ok = pv.gpd_tail_pvalues(
             first.observed, nulls, first.alternative
         )
     return PreservationResult(
         p_tail=p_tail,
         tail_ok=tail_ok,
+        nulls_exact=nulls_exact,
         n_perm_used=pv.effective_nperm(nulls) if any_seq else None,
         p_type="sequential" if any_seq else "fixed",
         discovery=first.discovery,

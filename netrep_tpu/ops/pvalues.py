@@ -350,6 +350,7 @@ def gpd_tail_pvalues(
     observed: np.ndarray,
     nulls: np.ndarray,
     alternative: str = "greater",
+    nulls_exact: bool = True,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Generalized-Pareto tail p-values (Knijnenburg et al. 2009) beside the
     exact permutation estimator.
@@ -368,6 +369,15 @@ def gpd_tail_pvalues(
         :func:`exceedance_counts`).
     alternative : 'greater' | 'less' | 'two.sided' (min tail doubled,
         capped at 1 — the convention of :func:`permutation_pvalues`).
+    nulls_exact : pass False when the null VALUES came through the bf16
+        screened fast-pass (ISSUE 16: decided permutations keep their
+        bf16-rounded statistics). The call then refuses: the GPD fit
+        reads the extreme draws themselves, and bf16 quantization
+        (8-bit significand) collapses the tail onto a handful of
+        plateaus — the threshold excess distribution degenerates and the
+        Anderson–Darling gate no longer measures what it gates. Exact
+        counts-based p-values are unaffected; rerun with
+        ``null_precision='f32'`` for a tail-fittable null array.
 
     Returns
     -------
@@ -377,6 +387,16 @@ def gpd_tail_pvalues(
     is only attempted where fewer than 10 null draws reach the observed
     value (the exact estimator already resolves denser cells).
     """
+    if not nulls_exact:
+        raise ValueError(
+            "gpd_tail_pvalues refuses bf16-screened null values "
+            "(nulls_exact=False): the screened fast-pass stores decided "
+            "permutations' bf16-rounded statistics, whose quantized tail "
+            "plateaus break the GPD threshold-excess fit. The exact "
+            "p_values (exceedance counts) are unaffected — use them, or "
+            "rerun with EngineConfig(null_precision='f32') to materialize "
+            "a tail-fittable f32 null array"
+        )
     observed = np.asarray(observed, dtype=np.float64)
     nulls = np.asarray(nulls, dtype=np.float64)
     if alternative not in ("greater", "less", "two.sided"):

@@ -136,6 +136,12 @@ class StopMonitor:
         #: the two can never diverge across a checkpoint.
         self.folded = 0
         self._nan_cells = np.isnan(self.observed)
+        #: warm-start pseudo-counts from a PRIOR run of the same cell
+        #: (:meth:`seed_priors`) — consulted ONLY by the decision rules;
+        #: reported tallies/p-values stay fresh-draw-only
+        self.prior_hi: np.ndarray | None = None
+        self.prior_lo: np.ndarray | None = None
+        self.prior_n: np.ndarray | None = None
 
     # -- state ------------------------------------------------------------
 
@@ -149,6 +155,55 @@ class StopMonitor:
 
     def any_active(self) -> bool:
         return bool(self.active.any())
+
+    def seed_priors(
+        self, hi: np.ndarray, lo: np.ndarray, n_used: np.ndarray
+    ) -> None:
+        """Seed the DECISION rules with per-cell tallies from a prior run
+        of the same cell — the grid's incremental re-analysis warm start
+        (ISSUE 17): when a dataset's content changed only incrementally,
+        the prior run's exceedance proportions are an informative sample
+        of the same-side-of-alpha question, so pooling them into the
+        Besag–Clifford ``h`` rule and the Clopper–Pearson decided-at-alpha
+        interval lets stable cells retire after ``min_perms`` fresh draws
+        (hundreds of permutations) instead of re-earning the full budget.
+
+        Semantics, pinned by tests/test_grid.py:
+
+        - priors enter ``_decided`` ONLY — reported tallies (``hi``/
+          ``lo``/``eff``), ``n_used``, and the Phipson–Smyth p-values are
+          computed from FRESH draws exclusively, so a warm-started
+          result's numbers are exact estimators at its realized stopping
+          point;
+        - the ``min_perms`` floor applies to fresh draws, so every
+          warm-started cell still sees a floor sample of the NEW data
+          before any decision can fire;
+        - priors ride :meth:`state_arrays`/:meth:`restore_state`
+          (``seq_prior_*`` keys), so an interrupted warm-started run
+          resumes with identical decisions.
+
+        Must be called before any fold (priors folded mid-run would make
+        decisions depend on call order)."""
+        if self.folded:
+            raise ValueError(
+                "seed_priors must be called before any chunk is folded"
+            )
+        hi = np.asarray(hi, dtype=np.int64)
+        lo = np.asarray(lo, dtype=np.int64)
+        n_used = np.asarray(n_used, dtype=np.int64).ravel()
+        if hi.shape != self.hi.shape or lo.shape != self.lo.shape:
+            raise ValueError(
+                f"prior tallies have shapes {hi.shape}/{lo.shape}, "
+                f"expected {self.hi.shape}"
+            )
+        if n_used.shape != self.n_used.shape:
+            raise ValueError(
+                f"prior n_used has shape {n_used.shape}, expected "
+                f"{self.n_used.shape}"
+            )
+        if (hi < 0).any() or (lo < 0).any() or (n_used < 0).any():
+            raise ValueError("prior tallies must be non-negative")
+        self.prior_hi, self.prior_lo, self.prior_n = hi, lo, n_used
 
     def counts(self) -> np.ndarray:
         """(n_modules, n_cells) tail-resolved exceedance counts — the same
@@ -172,6 +227,10 @@ class StopMonitor:
         }
         if self.eff is not None:
             out["seq_eff"] = self.eff
+        if self.prior_n is not None:
+            out["seq_prior_hi"] = self.prior_hi
+            out["seq_prior_lo"] = self.prior_lo
+            out["seq_prior_n"] = self.prior_n
         return out
 
     def restore_state(self, extras: dict) -> None:
@@ -201,6 +260,16 @@ class StopMonitor:
             np.asarray(extras["seq_eff"], dtype=np.int64)
             if "seq_eff" in extras else None
         )
+        # warm-start priors ride the checkpoint (additive keys): a resumed
+        # warm-started run must decide exactly as the uninterrupted run —
+        # restored BEFORE the self-heal below, which consults them
+        if "seq_prior_n" in extras:
+            self.prior_hi = np.asarray(extras["seq_prior_hi"],
+                                       dtype=np.int64)
+            self.prior_lo = np.asarray(extras["seq_prior_lo"],
+                                       dtype=np.int64)
+            self.prior_n = np.asarray(extras["seq_prior_n"],
+                                      dtype=np.int64)
         # self-heal: decisions are a pure function of the tallies, so
         # retire anything already decided — covers an interrupt that
         # landed between a fold and its retirement flags
@@ -362,16 +431,26 @@ class StopMonitor:
         out = np.zeros(pos.size, dtype=bool)
         for j, p in enumerate(pos):
             n = int(self.n_used[p])
+            # the min_perms floor is on FRESH draws: a warm-started cell
+            # still samples the new data before any decision can fire
             if n < rule.min_perms:
                 continue
+            # warm-start priors (seed_priors) pool into the DECISION
+            # counts only — fresh tallies/p-values are reported unchanged
+            if self.prior_n is not None:
+                hi_c = self.hi[p] + self.prior_hi[p]
+                lo_c = self.lo[p] + self.prior_lo[p]
+                n = n + int(self.prior_n[p])
+            else:
+                hi_c, lo_c = self.hi[p], self.lo[p]
             if self.alternative == "greater":
-                c, thresh = self.hi[p], rule.alpha
+                c, thresh = hi_c, rule.alpha
             elif self.alternative == "less":
-                c, thresh = self.lo[p], rule.alpha
+                c, thresh = lo_c, rule.alpha
             else:
                 # two-sided p is min-tail doubled: the decision boundary on
                 # the min-tail proportion is alpha/2
-                c, thresh = np.minimum(self.hi[p], self.lo[p]), rule.alpha / 2
+                c, thresh = np.minimum(hi_c, lo_c), rule.alpha / 2
             by_h = c >= rule.h
             cp_lo, cp_hi = _cp_bounds(c, n, 1.0 - rule.confidence)
             by_cp = (cp_lo > thresh) | (cp_hi < thresh)

@@ -1635,6 +1635,95 @@ def _idx_blocks(perm, cap: int, slices) -> jnp.ndarray:
     return jnp.stack(cols, axis=-2)
 
 
+class ObservedCache:
+    """Digest-keyed dedup cache for the all-pairs grid (ISSUE 17).
+
+    Two maps, both content-addressed so a stale hit is impossible:
+
+    - **discovery props** — per-bucket discovery-side property pytrees
+      (:meth:`PermutationEngine._bucket_disc_props`), keyed on the
+      discovery matrices' content digest + the bucket's padded module
+      index/mask bytes + the mode bits that change the computation.
+      Every cell of one grid row (same discovery dataset, same module
+      assignments) maps to the same keys, so the row's module buckets
+      are built ONCE and the device arrays are shared across engines.
+    - **observed stats** — the (n_modules, 7) observed array, keyed on
+      the full six-matrix engine fingerprint + the module spec digest:
+      re-building an engine for the same cell (checkpoint resume, grid
+      re-entry) skips the observed pass entirely.
+
+    Hits emit a ``grid_dedup_hit`` telemetry event (ambient bus) with
+    the map kind; ``stats()`` reports hit/miss counters for the bench.
+    Thread-safe: the grid's fleet spread may build engines concurrently.
+    """
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._props: dict = {}
+        self._obs: dict = {}
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _digest(*arrays) -> str:
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=8)
+        for a in arrays:
+            a = np.ascontiguousarray(a)
+            h.update(str(a.shape).encode() + str(a.dtype).encode())
+            h.update(a.tobytes())
+        return h.hexdigest()
+
+    def props_key(self, disc_digest: str, mode: str, cap: int,
+                  didx: np.ndarray, mask: np.ndarray) -> tuple:
+        return ("props", disc_digest, mode, int(cap),
+                self._digest(didx, mask))
+
+    def observed_key(self, fingerprint: str, spec_sig: str,
+                     mode: str) -> tuple:
+        return ("observed", fingerprint, spec_sig, mode)
+
+    def _note(self, hit: bool, kind: str) -> None:
+        if hit:
+            self.hits += 1
+            tel = tm.current()
+            if tel is not None:
+                tel.emit("grid_dedup_hit", kind=kind)
+        else:
+            self.misses += 1
+
+    def get_props(self, key: tuple):
+        with self._lock:
+            v = self._props.get(key)
+        self._note(v is not None, "props")
+        return v
+
+    def put_props(self, key: tuple, props) -> None:
+        with self._lock:
+            self._props[key] = props
+
+    def get_observed(self, key: tuple) -> np.ndarray | None:
+        with self._lock:
+            v = self._obs.get(key)
+        self._note(v is not None, "observed")
+        return None if v is None else v.copy()
+
+    def put_observed(self, key: tuple, observed: np.ndarray) -> None:
+        with self._lock:
+            self._obs[key] = np.asarray(observed, dtype=np.float64).copy()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": int(self.hits), "misses": int(self.misses),
+                "props_entries": len(self._props),
+                "observed_entries": len(self._obs),
+            }
+
+
 class PermutationEngine:
     """Permutation-null engine for one (discovery, test) dataset pair.
 
@@ -1667,12 +1756,21 @@ class PermutationEngine:
         config: EngineConfig = EngineConfig(),
         mesh: Mesh | None = None,
         discovery_only: bool = False,
+        observed_cache: "ObservedCache | None" = None,
     ):
         """``discovery_only=True`` builds only the discovery-side buckets and
         pool bookkeeping (test matrices may be None) — used by wrappers like
         :class:`~netrep_tpu.parallel.multitest.MultiTestEngine` that supply
-        their own test side; ``observed``/``run_null`` must not be called."""
+        their own test side; ``observed``/``run_null`` must not be called.
+
+        ``observed_cache`` (ISSUE 17, the grid's discovery-side dedup): a
+        shared :class:`ObservedCache` — per-bucket discovery properties
+        and observed statistics are looked up by content digest before
+        being recomputed, so engines over the same discovery dataset
+        (one grid row) build their module buckets once. None (default)
+        computes everything locally, bit-identically."""
         self.config = config
+        self._observed_cache = observed_cache
         self.mesh = mesh
         self.modules = list(modules)
         self.discovery_only = discovery_only
@@ -1736,6 +1834,9 @@ class PermutationEngine:
         self._fingerprint_digest = content_digest(
             [disc_corr, disc_net, disc_data, test_corr, test_net, test_data]
         )
+        #: discovery-side-only digest — the ObservedCache key component
+        #: shared by every engine over the same discovery dataset
+        self._disc_digest = content_digest([disc_corr, disc_net, disc_data])
 
         self.row_sharded = (
             mesh is not None and config.matrix_sharding == "row"
@@ -1987,6 +2088,13 @@ class PermutationEngine:
                 )
                 return jstats.make_disc_props(corr_b, net_b, data_b, mask)
 
+        # the closure + its device operands are kept so the bucket-props
+        # hook below (and subclass overrides — the grid packed engine
+        # substitutes per-request discovery sources) can recompute props
+        # for arbitrary module subsets
+        self._disc_bucket_fn = _disc_bucket
+        self._d_corr, self._d_net, self._d_data = d_corr, d_net, d_data
+
         self.buckets: list[_Bucket] = []
         for cap in sorted(by_cap):
             pos = by_cap[cap]
@@ -2000,9 +2108,8 @@ class PermutationEngine:
                 obs_b.append(_pad_to(mod.test_idx.astype(np.int32), cap, (0,)))
                 slices.append((int(offsets[k]), mod.size))
 
-            disc = _disc_bucket(
-                d_corr, d_net, d_data,
-                jnp.asarray(np.stack(didx_b)), jnp.asarray(np.stack(mask_b))
+            disc = self._bucket_disc_props(
+                cap, pos, np.stack(didx_b), np.stack(mask_b)
             )
             self.buckets.append(
                 _Bucket(cap, pos, disc, jnp.asarray(np.stack(obs_b)), slices)
@@ -2038,6 +2145,53 @@ class PermutationEngine:
         self._screen_active: bool = False
         #: cached max|test operand| for the screen's cushion amplitude
         self._screen_amp: float | None = None
+
+    def _bucket_disc_props(self, cap: int, pos, didx: np.ndarray,
+                           mask: np.ndarray):
+        """Discovery-side properties for one module-size bucket — ``pos``
+        are the bucket's global module positions and ``didx``/``mask``
+        the (K, cap) padded discovery index / node mask stacks. Consults
+        the shared :class:`ObservedCache` (when one was given) before
+        computing: props depend only on the discovery matrices and the
+        module index content, so every engine of one grid row reuses the
+        first one's device arrays. Overridden by the grid packed engine
+        (serve/packer.py) to substitute per-request discovery sources."""
+        return self._props_for(
+            self._disc_digest, self._d_corr, self._d_net, self._d_data,
+            cap, didx, mask,
+        )
+
+    def _props_for(self, disc_digest: str, dc, dn, dd, cap: int,
+                   didx: np.ndarray, mask: np.ndarray):
+        """Cache-aware props computation for ONE discovery source — the
+        shared core of :meth:`_bucket_disc_props` and the grid packed
+        engine's per-request override."""
+        cache = self._observed_cache
+        if cache is None:
+            return self._disc_bucket_fn(
+                dc, dn, dd, jnp.asarray(didx), jnp.asarray(mask)
+            )
+        key = cache.props_key(disc_digest, self._props_mode(),
+                              cap, didx, mask)
+        hit = cache.get_props(key)
+        if hit is not None:
+            return hit
+        props = self._disc_bucket_fn(
+            dc, dn, dd, jnp.asarray(didx), jnp.asarray(mask)
+        )
+        cache.put_props(key, props)
+        return props
+
+    def _props_mode(self) -> str:
+        """Cache-key mode bits for :meth:`_bucket_disc_props`: anything
+        beyond (discovery content, module indices) that changes the
+        computed props must appear here, or two engines could share props
+        they'd compute differently."""
+        return (
+            f"{'data_only' if self.data_only else 'dense'}|"
+            f"row:{int(self.row_sharded)}|beta:{self.net_beta!r}|"
+            f"data:{int(self.has_data)}"
+        )
 
     def _check_pool(self) -> None:
         """Permutation-pool oversubscription check. The packed serve engine
@@ -2414,6 +2568,18 @@ class PermutationEngine:
         re-derived)."""
         return _perm_keys2d_jit(key, jnp.uint32(start), int(k), int(c))
 
+    def _module_sig(self) -> str:
+        """Content digest of the module specs (labels, sizes, index sets)
+        — the ObservedCache key component beside the matrix fingerprint."""
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=8)
+        for m in self.modules:
+            h.update(str(m.label).encode() + b"|")
+            h.update(np.ascontiguousarray(m.disc_idx, dtype=np.int64))
+            h.update(np.ascontiguousarray(m.test_idx, dtype=np.int64))
+        return h.hexdigest()
+
     def observed(self) -> np.ndarray:
         """(n_modules, 7) observed statistics on the actual overlap sets."""
         if self.discovery_only:
@@ -2421,6 +2587,19 @@ class PermutationEngine:
                 "engine was built discovery_only; test-side passes live in "
                 "the wrapping engine"
             )
+        cache = self._observed_cache
+        # only the pristine full-module bucket list is cacheable — a
+        # retirement-filtered engine would compute (and poison) NaN rows
+        okey = None
+        if cache is not None and self.buckets is self._buckets_full:
+            okey = cache.observed_key(
+                self._fingerprint_digest, self._module_sig(),
+                f"{self._props_mode()}|g:{self.gather_mode}"
+                f"|dt:{self.config.dtype}",
+            )
+            hit = cache.get_observed(okey)
+            if hit is not None:
+                return hit
         if self._observed_fn is None:
             b0 = self.buckets[0]
             if self.data_only:
@@ -2473,6 +2652,8 @@ class PermutationEngine:
                 self._test_dataT,
             )
             out[b.module_pos] = np.asarray(res, dtype=np.float64)
+        if okey is not None:
+            cache.put_observed(okey, out)
         return out
 
     # ------------------------------------------------------------------
@@ -3114,6 +3295,7 @@ class PermutationEngine:
         checkpoint_every: int = 8192,
         telemetry=None,
         fault_policy=None,
+        priors=None,
     ) -> tuple[np.ndarray, int, bool]:
         """Sequential early-stopping variant of :meth:`run_null`
         (:func:`run_adaptive_chunks`): ``n_perm`` becomes a *ceiling* —
@@ -3124,7 +3306,12 @@ class PermutationEngine:
 
         ``observed`` are this engine's observed statistics (the monitor
         tallies exceedances against them) and ``alternative`` must match
-        the tail the final p-values will use. Returns ``(nulls, completed,
+        the tail the final p-values will use. ``priors`` — optional
+        ``(hi, lo, n_used)`` count-space tallies from a prior run of the
+        same cell, seeded into the stop monitor's decision rules
+        (:meth:`~netrep_tpu.ops.sequential.StopMonitor.seed_priors`, the
+        grid's incremental-re-analysis warm start); reported tallies and
+        p-values stay fresh-draw-only. Returns ``(nulls, completed,
         finished)`` — ``completed`` is the *deepest* module's permutation
         count, ``finished`` False only on ``KeyboardInterrupt``.
         """
@@ -3141,6 +3328,8 @@ class PermutationEngine:
             ),
             alternative, rule or StopRule(),
         )
+        if priors is not None:
+            monitor.seed_priors(*priors)
         return self.run_null_monitored(
             n_perm, key, monitor, progress=progress,
             checkpoint_path=checkpoint_path,

@@ -90,6 +90,14 @@ class RequestPlan:
     #: re-bucketing exit a statistical decision takes, so pack survivors
     #: are untouched); None = never expires (the PR 7 behavior)
     deadline: float | None = None
+    #: warm-start priors (ISSUE 17 incremental re-analysis): optional
+    #: ``(hi, lo, n_used)`` count-space tallies from a prior run of this
+    #: cell, seeded into the adaptive child monitor's decision rules
+    #: (:meth:`~netrep_tpu.ops.sequential.StopMonitor.seed_priors`);
+    #: ignored for non-adaptive plans. Reported tallies/p-values stay
+    #: fresh-draw-only, so packed warm-started results remain
+    #: bit-identical to the solo warm-started run.
+    priors: object | None = None
 
     @property
     def k(self) -> int:
@@ -142,7 +150,8 @@ class PackedEngine(PermutationEngine):
 
     def __init__(self, disc_corr, disc_net, disc_data, test_corr, test_net,
                  test_data, request_modules, pool,
-                 config: EngineConfig = EngineConfig(), mesh=None):
+                 config: EngineConfig = EngineConfig(), mesh=None,
+                 observed_cache=None):
         if mesh is not None or config.matrix_sharding == "row":
             raise ValueError(
                 "packed serve engines run replicated and mesh-free (v1); "
@@ -169,7 +178,8 @@ class PackedEngine(PermutationEngine):
         self._module_group = np.asarray(groups, dtype=np.int64)
         self.n_groups = len(request_modules)
         super().__init__(disc_corr, disc_net, disc_data, test_corr, test_net,
-                         test_data, mods, pool, config=config, mesh=None)
+                         test_data, mods, pool, config=config, mesh=None,
+                         observed_cache=observed_cache)
         # packed chunks draw one pool shuffle PER KEY GROUP (the overridden
         # chunk_body below); the fused-stats mega-kernel's chunk/counter
         # builders draw the base engine's single-group stream and would
@@ -367,6 +377,121 @@ class PackedEngine(PermutationEngine):
         super().release()
 
 
+class GridPackedEngine(PackedEngine):
+    """Cross-pair pack (ISSUE 17): :class:`PackedEngine` generalized from
+    one shared (discovery, test) pair to one shared TEST dataset with a
+    per-request DISCOVERY source — the engine behind a grid column, where
+    every cell tests a different cohort's modules in the same test
+    cohort.
+
+    ``disc_sources`` is one ``(corr, net, data)`` triple per packed
+    request, aligned with ``request_modules``. The feasibility argument,
+    pinned bit-identical in tests/test_grid.py: discovery matrices enter
+    the chunk program only through the per-bucket *discovery property*
+    arrays (plain data operands, one row per module), and the kernels are
+    vmapped per module — so a union bucket whose rows were computed from
+    each request's own matrices runs every module's numerics exactly as
+    its solo engine would. The permutation side (request-local slice
+    offsets, per-request RNG key groups) is :class:`PackedEngine`'s
+    existing two-identity contract, unchanged.
+
+    Requirements beyond PackedEngine's: every request must share the
+    permutation pool byte-for-byte (``null='all'``, or overlap pools that
+    coincide — the grid groups cells by pool signature before packing),
+    every discovery source must agree on data presence, and matrices must
+    be materialized (data-only cells run per-pair)."""
+
+    def __init__(self, disc_sources, test_corr, test_net, test_data,
+                 request_modules, pool,
+                 config: EngineConfig = EngineConfig(), mesh=None,
+                 observed_cache=None):
+        if len(disc_sources) != len(request_modules):
+            raise ValueError(
+                f"got {len(disc_sources)} discovery sources for "
+                f"{len(request_modules)} packed requests"
+            )
+        if any(s[0] is None or s[1] is None for s in disc_sources):
+            raise ValueError(
+                "cross-pair grid packs need materialized discovery "
+                "matrices; data-only cells run per-pair"
+            )
+        presence = {s[2] is not None for s in disc_sources}
+        if len(presence) != 1 or (test_data is not None) not in presence:
+            raise ValueError(
+                "cross-pair grid packs need every discovery source and "
+                "the test dataset to agree on data presence"
+            )
+        from ..parallel.engine import check_derived_network
+
+        beta = config.network_from_correlation
+        if beta is not None:
+            # the base engine sample-checks source 0 only
+            for i, (dc, dn, _dd) in enumerate(disc_sources[1:], start=1):
+                check_derived_network(dc, dn, beta, f"discovery[{i}]")
+        self._disc_sources = list(disc_sources)
+        self._grid_dev: list | None = None
+        self._grid_digests: list[str] | None = None
+        super().__init__(
+            disc_sources[0][0], disc_sources[0][1], disc_sources[0][2],
+            test_corr, test_net, test_data, request_modules, pool,
+            config=config, mesh=mesh, observed_cache=observed_cache,
+        )
+        # checkpoint/AOT identity must cover EVERY discovery source (the
+        # base init digested source 0 only)
+        from ..utils.checkpoint import content_digest
+
+        self._fingerprint_digest = content_digest(
+            [a for s in disc_sources for a in s]
+            + [test_corr, test_net, test_data]
+        )
+
+    def _bucket_disc_props(self, cap, pos, didx, mask):
+        """Per-request discovery props: the bucket's module positions are
+        request-contiguous (union order is request-major, by-cap grouping
+        preserves ascending position), so the (K, cap) stacks split into
+        per-request segments whose rows are computed from that request's
+        own matrices — each segment byte-identical to the solo engine's
+        bucket build, which is also what makes the ObservedCache keys
+        line up across grid and solo runs."""
+        if self._grid_dev is None:
+            import jax.numpy as jnp
+
+            from ..utils.checkpoint import content_digest
+
+            dev, digs = [], []
+            for dc, dn, dd in self._disc_sources:
+                dev.append((
+                    jnp.asarray(dc, jnp.float32),
+                    (None if self.net_beta is not None
+                     else jnp.asarray(dn, jnp.float32)),
+                    (jnp.asarray(dd, jnp.float32)
+                     if self.has_data else None),
+                ))
+                digs.append(content_digest([dc, dn, dd]))
+            self._grid_dev, self._grid_digests = dev, digs
+        groups = self._module_group[np.asarray(pos, dtype=np.int64)]
+        parts = []
+        start = 0
+        while start < len(groups):
+            g = int(groups[start])
+            end = start
+            while end < len(groups) and int(groups[end]) == g:
+                end += 1
+            dc, dn, dd = self._grid_dev[g]
+            parts.append(self._props_for(
+                self._grid_digests[g], dc, dn, dd, cap,
+                didx[start:end], mask[start:end],
+            ))
+            start = end
+        if len(parts) == 1:
+            return parts[0]
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *parts
+        )
+
+
 class PackMonitor:
     """Retirement controller for a packed run — the
     :class:`~netrep_tpu.ops.sequential.StopMonitor`-shaped object
@@ -444,10 +569,16 @@ class PackMonitor:
         self.children: list[StopMonitor | None] = []
         for p in plans:
             if p.adaptive:
-                self.children.append(StopMonitor(
+                child = StopMonitor(
                     self.observed[p.base: p.base + p.k],
                     p.alternative, p.rule or StopRule(),
-                ))
+                )
+                if p.priors is not None:
+                    # warm start (ISSUE 17): decision rules see the prior
+                    # tallies exactly as the solo warm-started run's
+                    # monitor does — same chunk boundaries, same decisions
+                    child.seed_priors(*p.priors)
+                self.children.append(child)
             else:
                 self.children.append(None)
 

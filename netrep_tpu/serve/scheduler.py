@@ -64,6 +64,7 @@ labels, and Prometheus exposition (:meth:`PreservationServer
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import logging
 import os
 import threading
@@ -83,7 +84,10 @@ from ..utils.checkpoint import content_digest
 from ..utils.config import EngineConfig
 from ..utils.faults import SimulatedCrash, resolve_runtime
 from . import journal as jnl
-from .packer import PackedEngine, PackMonitor, RequestPlan, assign_bases, run_pack
+from .packer import (
+    GridPackedEngine, PackedEngine, PackMonitor, RequestPlan, assign_bases,
+    run_pack,
+)
 from .pool import ProgramPool
 
 
@@ -198,6 +202,16 @@ class ServeConfig:
     #: None = auto: on exactly when ``fleet_label`` is set (fleet
     #: replicas self-warm the shared store); True/False force it.
     aot_export: bool | None = None
+    # -- cross-pair packing (ISSUE 17) ----------------------------------
+    #: widen the pack key from the (discovery, test) pair to the TEST
+    #: dataset + permutation-pool signature: requests testing DIFFERENT
+    #: cohorts' modules in the same test cohort then share one dispatch
+    #: stream (:class:`~netrep_tpu.serve.packer.GridPackedEngine`) — the
+    #: grid-column workload. Results stay bit-identical to solo calls
+    #: (per-request discovery props + the two-identity contract); only
+    #: applies to single-test dense requests (data-only pairs keep the
+    #: pairwise key). Off by default: the pack key stays pairwise.
+    cross_pair_packing: bool = False
 
 
 @dataclasses.dataclass
@@ -992,8 +1006,24 @@ class PreservationServer:
                                     alternative, adaptive, rule)
             # compatibility identity: same matrices + same engine config
             # => same pool, same kernels, one shared dispatch stream
-            pack_key = (disc.digest, tds.digest, self.config.null,
-                        self._engine_cfg_id)
+            if (self.config.cross_pair_packing and disc.beta is None
+                    and tds.beta is None):
+                # cross-pair key (ISSUE 17): the GRID identity — shared
+                # test matrices + byte-equal permutation pool + agreeing
+                # data presence. Discovery matrices drop out of the key
+                # because GridPackedEngine substitutes each request's own
+                # per-bucket discovery props (data-only pairs keep the
+                # pairwise key: their kernel closes over the data columns)
+                pool_sig = hashlib.blake2b(
+                    np.ascontiguousarray(plan.pool, dtype=np.int64),
+                    digest_size=8,
+                ).hexdigest()
+                pack_key = ("xpair", tds.digest, pool_sig,
+                            disc.ds.data is not None, self.config.null,
+                            self._engine_cfg_id)
+            else:
+                pack_key = (disc.digest, tds.digest, self.config.null,
+                            self._engine_cfg_id)
         now = time.monotonic()
         with self._work:
             # authoritative dedup under the lock (a concurrent duplicate
@@ -1550,15 +1580,40 @@ class PreservationServer:
             config=cfg,
         )
 
+    def _grid_pack_engine(self, discs, test: _Dataset, plans):
+        """Cross-pair pack builder (ISSUE 17): one discovery source per
+        request, shared test matrices — the grid-column engine. Only
+        dense members reach here (the cross-pair key excludes beta
+        registrations)."""
+        return GridPackedEngine(
+            [(d.ds.correlation, d.ds.network, d.ds.data) for d in discs],
+            test.ds.correlation, test.ds.network, test.ds.data,
+            [p.specs for p in plans], plans[0].pool,
+            config=self.config.engine,
+        )
+
     def _execute_pack(self, batch: list[Request], pack_id: str) -> None:
         plans = [r.plan for r in batch]
         assign_bases(plans)
-        disc = self._dataset(batch[0].tenant, batch[0].discovery)
+        discs = [self._dataset(r.tenant, r.discovery) for r in batch]
+        disc = discs[0]
         test = self._dataset(batch[0].tenant, batch[0].test)
-        key = self._pool_key("packed", (disc.digest, test.digest), plans)
-        engine, hit = self.pool.get(
-            key, lambda: self._pack_engine(disc, test, plans)
-        )
+        if any(d.digest != disc.digest for d in discs[1:]):
+            # cross-pair pack (ISSUE 17): members share the test dataset
+            # and pool but carry per-request discovery matrices
+            key = self._pool_key(
+                "gridpacked",
+                (tuple(d.digest for d in discs), test.digest), plans,
+            )
+            engine, hit = self.pool.get(
+                key, lambda: self._grid_pack_engine(discs, test, plans)
+            )
+        else:
+            key = self._pool_key("packed", (disc.digest, test.digest),
+                                 plans)
+            engine, hit = self.pool.get(
+                key, lambda: self._pack_engine(disc, test, plans)
+            )
         self._emit_pool(hit, pack_id, len(batch))
         if self.tel is not None:
             for r in batch:

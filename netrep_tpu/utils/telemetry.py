@@ -318,11 +318,30 @@ SPAN_EVENTS = (
     "warmup_end",
 )
 
+#: all-pairs grid event names (ISSUE 17 ``grid_preservation``) — the
+#: atlas lifecycle: the grid span brackets the whole D×D job, each cell
+#: emits start/done (``source`` says whether it was computed or answered
+#: from the digest-keyed manifest), ``grid_dedup_hit`` counts
+#: observed-stat/module-bucket cache hits across cells sharing a
+#: discovery dataset, and ``grid_warmstart_seeded`` records a
+#: recomputed cell's monitor receiving a prior run's count-space
+#: tallies. Pinned beside the other registries: the CLI's grid section
+#: and the watcher's grid classification key on these names, and the
+#: ``telemetry-registry`` lint rule enforces membership statically.
+GRID_EVENTS = (
+    "grid_start",
+    "grid_end",
+    "grid_cell_start",
+    "grid_cell_done",
+    "grid_dedup_hit",
+    "grid_warmstart_seeded",
+)
+
 #: the union the ``telemetry-registry`` lint rule checks literal event
 #: names against — every registry above, nothing else
 KNOWN_EVENTS = frozenset(
     ENGINE_EVENTS + RECOVERY_EVENTS + SERVE_EVENTS + FLEET_EVENTS
-    + SPAN_EVENTS
+    + SPAN_EVENTS + GRID_EVENTS
 )
 
 
@@ -1167,4 +1186,79 @@ def render_replicas(path: str) -> str:
             f"{r['shipped_records']:>9} {r['shipped_bytes']:>9} "
             f"{r['failovers']:>9} {r['failover_s']:>8.3f}"
         )
+    return "\n".join(out)
+
+
+def grid_summary(events: Iterable[dict]) -> dict:
+    """Aggregation of the all-pairs grid events (:data:`GRID_EVENTS`) —
+    one row per DISCOVERY dataset (a grid row shares its discovery-side
+    work, so that is the axis along which dedup and warm starts pay off)
+    plus grid-level totals: dedup hits, grid count, and summed grid wall
+    time from the ``grid_end`` span duration. Returns
+    ``{"rows": {discovery: {...}}, "grids", "dedup_hits", "wall_s"}``;
+    ``rows`` is empty when the log has no grid events."""
+    rows: dict[str, dict] = {}
+    out = {"rows": rows, "grids": 0, "dedup_hits": 0, "wall_s": 0.0}
+    for e in events:
+        ev = e.get("ev")
+        if ev not in GRID_EVENTS:
+            continue
+        data = e.get("data", {})
+        if ev == "grid_dedup_hit":
+            out["dedup_hits"] += 1
+            continue
+        if ev == "grid_end":
+            out["grids"] += 1
+            if _is_number(data.get("s")):
+                out["wall_s"] += float(data["s"])
+            continue
+        d = data.get("discovery")
+        if d is None:
+            continue
+        row = rows.setdefault(str(d), {
+            "started": 0, "computed": 0, "manifest": 0,
+            "warmstarted": 0, "perms": 0, "prior_perms": 0,
+        })
+        if ev == "grid_cell_start":
+            row["started"] += 1
+        elif ev == "grid_cell_done":
+            src = data.get("source")
+            if src == "manifest":
+                row["manifest"] += 1
+            else:
+                row["computed"] += 1
+            row["perms"] += int(data.get("perms", 0) or 0)
+        elif ev == "grid_warmstart_seeded":
+            row["warmstarted"] += 1
+            row["prior_perms"] += int(data.get("prior_perms", 0) or 0)
+    return out
+
+
+def render_grid(path: str) -> str:
+    """All-pairs grid section of the CLI report (`python -m netrep_tpu
+    telemetry <run.jsonl>`): per-discovery-row cell outcomes (computed vs
+    answered from the manifest, warm starts, permutations evaluated) and
+    a totals line with the dedup hit count and grid wall time. Empty
+    string for logs without grid events."""
+    s = grid_summary(read_events(path))
+    if not s["rows"] and not s["grids"]:
+        return ""
+    out = ["grid:"]
+    out.append(
+        f"  grids={s['grids']} dedup_hits={s['dedup_hits']} "
+        f"wall_s={s['wall_s']:.3f}"
+    )
+    if s["rows"]:
+        w = max(len(d) for d in s["rows"])
+        out.append(
+            f"  {'':<{w}}  {'cells':>5} {'comp':>5} {'manif':>5} "
+            f"{'warm':>5} {'perms':>9} {'prior':>9}"
+        )
+        for d in sorted(s["rows"]):
+            r = s["rows"][d]
+            out.append(
+                f"  {d:<{w}}  {r['started']:>5} {r['computed']:>5} "
+                f"{r['manifest']:>5} {r['warmstarted']:>5} "
+                f"{r['perms']:>9} {r['prior_perms']:>9}"
+            )
     return "\n".join(out)

@@ -72,6 +72,12 @@ CASES = [
     # any row, so this smoke case guards the screened chunk program, the
     # rescue worklist dispatch, and the null_precision plumbing end-to-end
     ["--config", "mixed"],
+    # all-pairs grid atlas (ISSUE 17): per-cell bit-identity to the solo
+    # runs AND the <25% incremental-delta bound are asserted in-bench
+    # before any row, so this smoke case guards the cross-pair packing,
+    # observed-stat dedup, manifest reuse, and warm-start prior path
+    # end-to-end
+    ["--config", "grid"],
 ]
 
 

@@ -1,0 +1,311 @@
+"""All-pairs preservation atlas (ISSUE 17) — the one acceptance that
+matters is BIT-IDENTITY: every grid cell, however it was produced, must
+equal the solo ``module_preservation`` run of the same (discovery, test)
+pair with the same seed. Pinned here for each production path:
+
+  * packed + deduped cold grid (cells sharing a test column ride one
+    shared dispatch stream; observed stats come from the digest-keyed
+    ``ObservedCache``),
+  * resumed-from-checkpoint grid (every cell reloaded from the manifest,
+    nothing recomputed),
+  * digest-incremental re-analysis (one changed cohort → only its
+    row+column recomputes, warm-started from the prior run's tallies),
+  * fleet-spread grid (cells dispatched across PR 14 replicas),
+  * serve-side cross-pair packing (two tenant submissions against one
+    test dataset share a pack id).
+
+Plus the :meth:`StopMonitor.seed_priors` contract the warm start rests
+on: priors enter the DECISION rules only, reported tallies/p-values stay
+fresh-draw-only, the ``min_perms`` floor applies to fresh draws, and the
+priors ride the checkpoint state round-trip.
+"""
+
+import shutil
+import tempfile
+
+import numpy as np
+import pytest
+
+from netrep_tpu import grid_preservation, module_preservation
+from netrep_tpu.ops.sequential import StopMonitor, StopRule
+from netrep_tpu.utils.config import EngineConfig
+
+N, S = 30, 40
+NPERM = 64
+SEED = 7
+CFG = EngineConfig(chunk_size=16, autotune=False)
+RULE = StopRule(min_perms=8)
+NAMES = ["a", "b", "c"]
+#: the 4 computable cells: a/b carry assignments, c is test-only
+PAIRS = [("a", "b"), ("a", "c"), ("b", "a"), ("b", "c")]
+
+
+def _mk(seed):
+    r = np.random.default_rng(seed)
+    data = r.normal(size=(S, N))
+    corr = np.corrcoef(data, rowvar=False)
+    return np.abs(corr) ** 2, corr, data
+
+
+def _cohorts():
+    network, correlation, data = {}, {}, {}
+    for i, n in enumerate(NAMES):
+        network[n], correlation[n], data[n] = _mk(100 + i)
+    assign = {
+        "a": {f"node_{i}": str(1 + (i % 3)) for i in range(N)},
+        "b": {f"node_{i}": str(1 + (i % 4)) for i in range(N)},
+    }
+    return network, correlation, data, assign
+
+
+def _solo(network, correlation, data, assign, d, t, *, n_perm=NPERM,
+          adaptive=False, priors=None):
+    kw = {}
+    if adaptive:
+        kw = {"adaptive": True, "adaptive_rule": RULE}
+        if priors is not None:
+            kw["adaptive_priors"] = priors
+    return module_preservation(
+        network, data=data, correlation=correlation,
+        module_assignments=assign[d], discovery=d, test=t,
+        n_perm=n_perm, null="all", seed=SEED, config=CFG,
+        simplify=False, **kw,
+    )[d][t]
+
+
+def _same_cell(cell, solo):
+    return (np.array_equal(cell.observed, solo.observed)
+            and np.array_equal(cell.p_values, solo.p_values)
+            and np.array_equal(cell.n_perm_used, solo.n_perm_used))
+
+
+@pytest.fixture(scope="module")
+def atlas():
+    """One cold adaptive grid in a persistent grid_dir, plus the solo
+    adaptive reference for every cell — shared by the cold/resume/delta
+    tests (the delta test re-runs into the SAME dir, which is exactly
+    the production shape: one atlas directory, successive analyses)."""
+    network, correlation, data, assign = _cohorts()
+    gdir = tempfile.mkdtemp(prefix="grid_atlas_")
+    g = grid_preservation(
+        network, data=data, correlation=correlation,
+        module_assignments=assign, n_perm=NPERM, null="all", seed=SEED,
+        config=CFG, adaptive=True, adaptive_rule=RULE, grid_dir=gdir,
+    )
+    solo = {
+        (d, t): _solo(network, correlation, data, assign, d, t,
+                      adaptive=True)
+        for d, t in PAIRS
+    }
+    yield g, gdir, (network, correlation, data, assign), solo
+    shutil.rmtree(gdir, ignore_errors=True)
+
+
+def test_cold_grid_cells_bit_identical_to_solo(atlas):
+    """Packed + deduped cold grid: every cell equals the solo adaptive
+    run — p-values, observed, and realized stopping points all exact."""
+    g, _, _, solo = atlas
+    for d, t in PAIRS:
+        assert _same_cell(g.cell(d, t), solo[(d, t)]), (d, t)
+    st = g.stats
+    assert st["cells_total"] == len(PAIRS)
+    assert st["cells_computed"] == len(PAIRS)
+    assert st["cells_reused"] == 0
+    assert st["perms_evaluated"] > 0
+    # packing happened: cells sharing a test column rode shared streams
+    assert st["packs"] < st["cells_computed"]
+    # dedup happened: each discovery cohort's observed stats computed
+    # once, reused across its row (a->b and a->c share a's digest)
+    assert st["dedup"]["hits"] > 0
+
+
+def test_grid_resume_reuses_every_cell_bit_identically(atlas):
+    """Re-running into the same grid_dir reloads every cell from the
+    digest-keyed manifest — zero permutations, identical results."""
+    g, gdir, (network, correlation, data, assign), solo = atlas
+    g2 = grid_preservation(
+        network, data=data, correlation=correlation,
+        module_assignments=assign, n_perm=NPERM, null="all", seed=SEED,
+        config=CFG, adaptive=True, adaptive_rule=RULE, grid_dir=gdir,
+    )
+    assert g2.stats["cells_reused"] == len(PAIRS)
+    assert g2.stats["cells_computed"] == 0
+    assert g2.stats["perms_evaluated"] == 0
+    for d, t in PAIRS:
+        assert _same_cell(g2.cell(d, t), solo[(d, t)]), (d, t)
+
+
+def test_incremental_delta_recomputes_only_changed_row_and_column(atlas):
+    """Changing one cohort's content digest recomputes only the cells
+    touching it (warm-started from the prior tallies); untouched cells
+    come back from the manifest byte-identical — and the warm-started
+    cells still equal the solo run given the same priors."""
+    g, gdir, (network, correlation, data, assign), solo = atlas
+    network2, correlation2, data2 = (
+        dict(network), dict(correlation), dict(data)
+    )
+    # c is test-only: its change dirties a->c and b->c, leaves a<->b
+    network2["c"], correlation2["c"], data2["c"] = _mk(999)
+    g3 = grid_preservation(
+        network2, data=data2, correlation=correlation2,
+        module_assignments=assign, n_perm=NPERM, null="all", seed=SEED,
+        config=CFG, adaptive=True, adaptive_rule=RULE, grid_dir=gdir,
+    )
+    assert g3.stats["cells_computed"] == 2
+    assert g3.stats["cells_reused"] == 2
+    assert g3.stats["cells_warmstarted"] == 2
+    for d, t in [("a", "b"), ("b", "a")]:
+        assert _same_cell(g3.cell(d, t), solo[(d, t)]), (d, t)
+    # warm-started cell == solo module_preservation fed the same priors
+    for d in ["a", "b"]:
+        prev = g.cell(d, "c")
+        priors = (np.asarray(prev.counts_hi, np.int64),
+                  np.asarray(prev.counts_lo, np.int64),
+                  np.asarray(prev.n_perm_used, np.int64))
+        want = _solo(network2, correlation2, data2, assign, d, "c",
+                     adaptive=True, priors=priors)
+        assert _same_cell(g3.cell(d, "c"), want), d
+
+
+def test_fleet_spread_cells_bit_identical_to_solo(tmp_path):
+    """Cells dispatched across an in-process 2-replica fleet (PR 14)
+    return the same bytes as the local solo runs."""
+    from netrep_tpu.serve.fleet import build_inprocess_fleet
+    from netrep_tpu.serve.scheduler import ServeConfig
+
+    network, correlation, data, assign = _cohorts()
+    n_perm = 48
+
+    def make_config(rid, jpath, ckpt):
+        return ServeConfig(journal=jpath, checkpoint_dir=ckpt,
+                           fleet_label=rid, engine=CFG, null="all")
+
+    coord = build_inprocess_fleet(2, str(tmp_path), make_config=make_config)
+    try:
+        g = grid_preservation(
+            network, data=data, correlation=correlation,
+            module_assignments=assign, n_perm=n_perm, null="all",
+            seed=SEED, config=CFG, fleet=coord,
+        )
+        for d, t in PAIRS:
+            want = _solo(network, correlation, data, assign, d, t,
+                         n_perm=n_perm)
+            cell = g.cell(d, t)
+            assert np.array_equal(cell.observed, want.observed), (d, t)
+            assert np.array_equal(cell.p_values, want.p_values), (d, t)
+    finally:
+        coord.close()
+
+
+def test_serve_cross_pair_packing_shares_pack_and_matches_solo():
+    """Two tenant submissions against the same test dataset inside the
+    pack window ride ONE shared dispatch stream (same pack id, size 2)
+    and still return solo-identical numbers — the two-identity contract
+    of the cross-pair packer."""
+    from netrep_tpu.serve.scheduler import PreservationServer, ServeConfig
+
+    network, correlation, data, assign = _cohorts()
+    n_perm = 48
+    srv = PreservationServer(ServeConfig(
+        engine=CFG, null="all", cross_pair_packing=True,
+        pack_window_s=0.3,
+    ), start=False)
+    srv.register_tenant("t")
+    for n in NAMES:
+        srv.register_dataset("t", n, network=network[n],
+                             correlation=correlation[n], data=data[n],
+                             assignments=assign.get(n))
+    h1 = srv.submit("t", "a", "c", n_perm=n_perm, seed=SEED)
+    h2 = srv.submit("t", "b", "c", n_perm=n_perm, seed=SEED)
+    srv.start()
+    try:
+        r1 = srv.wait(h1)
+        r2 = srv.wait(h2)
+    finally:
+        srv.close()
+    assert r1["pack_id"] == r2["pack_id"]
+    assert r1["pack_size"] == 2 and r2["pack_size"] == 2
+    for d, r in (("a", r1), ("b", r2)):
+        want = _solo(network, correlation, data, assign, d, "c",
+                     n_perm=n_perm)
+        assert np.array_equal(r["observed"], want.observed), d
+        assert np.array_equal(r["p_values"], want.p_values), d
+
+
+# -- seed_priors contract (the warm start's statistical foundation) ------
+
+
+def _monitor(rule=None):
+    obs = np.array([[0.5, 0.5], [0.5, 0.5]])
+    return StopMonitor(obs, "greater", rule or RULE)
+
+
+def test_seed_priors_validation():
+    m = _monitor()
+    with pytest.raises(ValueError, match="non-negative"):
+        m.seed_priors(np.full((2, 2), -1), np.zeros((2, 2)), np.zeros(2))
+    with pytest.raises(ValueError, match="shapes"):
+        m.seed_priors(np.zeros((3, 2)), np.zeros((3, 2)), np.zeros(3))
+    with pytest.raises(ValueError, match="shape"):
+        m.seed_priors(np.zeros((2, 2)), np.zeros((2, 2)), np.zeros(3))
+    # priors folded mid-run would make decisions depend on call order
+    m.update(np.full((4, 2, 2), 9.0), 4)
+    with pytest.raises(ValueError, match="before any chunk"):
+        m.seed_priors(np.zeros((2, 2)), np.zeros((2, 2)), np.zeros(2))
+
+
+def test_seed_priors_decide_early_but_report_fresh_only():
+    """A cell whose prior run clearly exceeded alpha retires after the
+    min_perms FRESH floor instead of re-earning the full Besag–Clifford
+    budget — while its reported tallies, n_used, and p-values count the
+    fresh draws exclusively."""
+    rule = StopRule(min_perms=8, h=16)
+    warm = _monitor(rule)
+    cold = _monitor(rule)
+    # prior: 200 draws, every one exceeding (p clearly >> alpha)
+    warm.seed_priors(np.full((2, 2), 200), np.zeros((2, 2)),
+                     np.full(2, 200))
+    # ambiguous fresh chunk: 2 of 8 draws exceed — fresh hi=2 < h=16 and
+    # the CP interval for 2/8 straddles alpha, so fresh-only can't decide
+    chunk = np.full((8, 2, 2), -9.0)
+    chunk[:2] = 9.0
+    retired_warm = warm.update(chunk, 8)
+    retired_cold = cold.update(chunk, 8)
+    # warm: h rule fires at min_perms via the pooled counts (2+200 >= 16)
+    assert retired_warm.tolist() == [0, 1]
+    assert retired_cold.size == 0
+    # reported state is fresh-only: priors never leak into the tallies
+    assert warm.hi.tolist() == [[2, 2], [2, 2]]
+    assert warm.n_used.tolist() == [8, 8]
+    assert np.array_equal(warm.hi, cold.hi)
+
+
+def test_seed_priors_respect_min_perms_floor():
+    """Even an overwhelming prior cannot retire a module before the
+    fresh-draw floor — every warm-started cell samples the NEW data."""
+    rule = StopRule(min_perms=8, h=16)
+    m = _monitor(rule)
+    m.seed_priors(np.full((2, 2), 10_000), np.zeros((2, 2)),
+                  np.full(2, 10_000))
+    assert m.update(np.full((4, 2, 2), 9.0), 4).size == 0  # n=4 < floor
+    assert m.active.all()
+    assert m.update(np.full((4, 2, 2), 9.0), 4).tolist() == [0, 1]
+
+
+def test_seed_priors_ride_checkpoint_state_roundtrip():
+    """An interrupted warm-started run must resume with identical
+    decisions: the priors travel in the seq_prior_* checkpoint keys."""
+    rule = StopRule(min_perms=8, h=16)
+    m = _monitor(rule)
+    hi = np.full((2, 2), 200, dtype=np.int64)
+    m.seed_priors(hi, np.zeros((2, 2), np.int64), np.full(2, 200))
+    state = {k: np.copy(v) for k, v in m.state_arrays().items()}
+    assert "seq_prior_n" in state
+    m2 = _monitor(rule)
+    m2.restore_state(state)
+    assert np.array_equal(m2.prior_hi, hi)
+    assert np.array_equal(m2.prior_n, np.full(2, 200))
+    # and the restored monitor decides exactly like the original
+    a = m.update(np.full((8, 2, 2), 9.0), 8)
+    b = m2.update(np.full((8, 2, 2), 9.0), 8)
+    assert np.array_equal(a, b) and a.tolist() == [0, 1]

@@ -229,3 +229,82 @@ def test_gpd_tail_less_and_two_sided_mirror_greater():
     assert p_2s[0] == pytest.approx(min(2.0 * p_hi[0], 1.0))
     with pytest.raises(ValueError):
         pv.gpd_tail_pvalues(np.array([1.0]), nulls, alternative="bogus")
+
+
+def test_gpd_tail_refuses_bf16_screened_nulls():
+    """ISSUE 17 satellite (the ISSUE 16 caveat): the screened fast-pass
+    stores decided permutations' bf16-rounded VALUES — exceedance counts
+    stay exact, but the GPD threshold-excess fit reads the extreme draws
+    themselves, and the quantized tail plateaus make it meaningless. The
+    fit must refuse loudly, not produce a confident wrong extrapolation."""
+    rng = np.random.default_rng(0)
+    nulls = rng.exponential(size=(10_000, 1))
+    with pytest.raises(ValueError, match="bf16-screened"):
+        pv.gpd_tail_pvalues(np.array([18.0]), nulls, nulls_exact=False)
+    # the exact counts path is explicitly unaffected by screening: the
+    # same array fits fine when flagged exact (the default)
+    p, ok = pv.gpd_tail_pvalues(np.array([18.0]), nulls, nulls_exact=True)
+    assert ok[0] and np.isfinite(p[0])
+
+
+def test_result_nulls_exact_gates_tail_and_roundtrips(tmp_path):
+    """A result flagged ``nulls_exact=False`` refuses ``tail_pvalues()``
+    with the f32-rerun guidance, and the flag survives save/load (an
+    additive meta key: old files default to exact)."""
+    from netrep_tpu.models.results import PreservationResult
+
+    rng = np.random.default_rng(1)
+    k = 1
+    nulls = rng.exponential(size=(2_000, k, 7))
+    obs = np.full((k, 7), 30.0)
+    kw = dict(
+        discovery="a", test="b", module_labels=["1"], observed=obs,
+        p_values=np.full((k, 7), 1e-3), n_vars_present=np.array([5]),
+        prop_vars_present=np.array([1.0]), total_size=np.array([5]),
+        alternative="greater", n_perm=2_000, completed=2_000,
+    )
+    screened = PreservationResult(nulls=nulls, nulls_exact=False, **kw)
+    with pytest.raises(ValueError, match="null_precision='f32'"):
+        screened.tail_pvalues()
+    screened.save(str(tmp_path / "r.npz"))
+    back = PreservationResult.load(str(tmp_path / "r.npz"))
+    assert back.nulls_exact is False
+    with pytest.raises(ValueError, match="bf16"):
+        back.tail_pvalues()
+    # exact result: fits, persists, and reloads as exact
+    exact = PreservationResult(nulls=nulls, **kw)
+    p_tail, ok = exact.tail_pvalues()
+    assert p_tail.shape == (k, 7)
+    exact.save(str(tmp_path / "e.npz"))
+    assert PreservationResult.load(str(tmp_path / "e.npz")).nulls_exact is True
+
+
+def test_combine_drops_tail_refit_when_any_block_screened():
+    """Pooling an exact block with a screened block quantizes part of the
+    pooled tail: combine_analyses must not refit the GPD over it — the
+    combined result carries ``nulls_exact=False`` and no ``p_tail``."""
+    from netrep_tpu.models.results import PreservationResult, combine_analyses
+
+    rng = np.random.default_rng(2)
+    k = 1
+
+    def block(seed, exact):
+        r = np.random.default_rng(seed)
+        return PreservationResult(
+            discovery="a", test="b", module_labels=["1"],
+            observed=np.full((k, 7), 30.0),
+            nulls=r.exponential(size=(2_000, k, 7)),
+            nulls_exact=exact,
+            p_values=np.full((k, 7), 1e-3), n_vars_present=np.array([5]),
+            prop_vars_present=np.array([1.0]), total_size=np.array([5]),
+            alternative="greater", n_perm=2_000, completed=2_000,
+        )
+
+    a, b = block(10, True), block(11, False)
+    a.tail_pvalues()  # the exact block had a tail fit before pooling
+    merged = combine_analyses(a, b)
+    assert merged.nulls_exact is False
+    assert merged.p_tail is None
+    assert merged.completed == 4_000
+    with pytest.raises(ValueError, match="bf16"):
+        merged.tail_pvalues()

@@ -416,12 +416,24 @@ def test_preservation_pvalues_bit_identical(toy_pair_module, tmp_path):
     )
     np.testing.assert_array_equal(res_f32.p_values, res_bf16.p_values)
 
-    p_tail, tail_ok = res_bf16.tail_pvalues()
-    assert p_tail.shape == res_bf16.p_values.shape
+    # ISSUE 17 satellite (the ISSUE 16 caveat): counts are exact but the
+    # screened run's STORED null values are bf16-rounded for decided
+    # permutations — the GPD tail fit must refuse them, before and after
+    # a save/load round-trip (the flag is persisted meta)
+    assert res_bf16.nulls_exact is False and res_f32.nulls_exact is True
+    with pytest.raises(ValueError, match="bf16"):
+        res_bf16.tail_pvalues()
+    bpath = str(tmp_path / "res_bf16.npz")
+    res_bf16.save(bpath)
+    with pytest.raises(ValueError, match="null_precision='f32'"):
+        PreservationResult.load(bpath).tail_pvalues()
+
+    p_tail, tail_ok = res_f32.tail_pvalues()
+    assert p_tail.shape == res_f32.p_values.shape
     assert tail_ok.dtype == bool
     assert np.isnan(p_tail[~tail_ok]).all()
     path = str(tmp_path / "res.npz")
-    res_bf16.save(path)
+    res_f32.save(path)
     loaded = PreservationResult.load(path)
     np.testing.assert_array_equal(loaded.p_tail, p_tail)
     np.testing.assert_array_equal(loaded.tail_ok, tail_ok)

@@ -296,3 +296,63 @@ def test_mixed_rows_classified_and_rendered(tmp_path):
     # section — both visible, neither misattributed
     assert "BASELINE.md table snippet" in proc.stdout
     assert "TPU v5 lite" in proc.stdout
+
+
+def _grid_row(**over):
+    row = {
+        "metric": "grid all-pairs atlas, 6 cohorts / 300 genes / 4 modules, "
+                  "ceiling 96 perms (30 cells, adaptive, packed vs "
+                  "sequential)",
+        "value": 21.4, "unit": "s", "vs_baseline": 1.263,
+        "sequential_s": 27.0, "perms_per_sec": 150.0,
+        "grid_perms_evaluated": 3210, "sequential_perms_evaluated": 3210,
+        "delta_s": 4.1, "delta_perms_evaluated": 579,
+        "delta_perm_fraction": 0.1803, "cells": 30,
+        "cells_reused_on_delta": 20, "cells_warmstarted_on_delta": 6,
+        "dedup_hits": 25, "packs": 6, "bit_identical_to_solo": True,
+        "device": "TPU v5 lite",
+    }
+    row.update(over)
+    return row
+
+
+def test_grid_rows_classified_and_rendered(tmp_path):
+    """ISSUE 17: the CPU run of --config grid carries real mechanism
+    verdicts (per-cell bit-parity vs solo and the <25% delta bound are
+    asserted in-bench on any backend) — it must land in the atlas-health
+    section, never be silently dropped as a CPU row; a real TPU
+    measurement still flows to the BASELINE result table."""
+    cpu = _grid_row(device="TFRT_CPU_0")
+    assert classify(cpu) == "grid"
+    # probe-race fallback variant keeps its mechanism value too
+    assert classify(_grid_row(tpu_fallback=True)) == "grid"
+    # a real TPU measurement is a BASELINE result, not a mechanism row
+    assert classify(_grid_row()) == "result"
+    # near-miss: a grid-prefixed row WITHOUT the parity marker is not
+    # hijacked into the section (an ordinary CPU row still drops)
+    assert classify({"metric": "grid something", "value": 1.0,
+                     "device": "TFRT_CPU_0"}) == "dropped"
+
+    text = "\n".join(summarize_watch.grid_lines([cpu]))
+    assert "vs sequential 1.263x" in text and "(seq 27.0s)" in text
+    assert "delta_perm_fraction=0.1803" in text
+    assert "reused=20" in text and "warmstarted=6" in text
+    assert "dedup_hits=25" in text and "packs=6" in text
+    assert "cells bit-identical to solo" in text
+    bad = "\n".join(summarize_watch.grid_lines(
+        [_grid_row(bit_identical_to_solo=False)]))
+    assert "CELL/SOLO PARITY FAILED" in bad
+
+    log = tmp_path / "watch.jsonl"
+    log.write_text(json.dumps(cpu) + "\n" + json.dumps(_grid_row()) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "benchmarks/summarize_watch.py", str(log)],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "all-pairs atlas (grid packing + delta re-analysis health)" \
+        in proc.stdout
+    # the TPU row made the BASELINE table while the CPU row stayed in its
+    # section — both visible, neither misattributed
+    assert "BASELINE.md table snippet" in proc.stdout
+    assert "TPU v5 lite" in proc.stdout

@@ -339,6 +339,61 @@ def test_replica_summary_folds_fleet_events():
     assert rows["r1"]["joined"] == 1 and rows["r1"]["failovers"] == 0
 
 
+def test_grid_events_registered():
+    """ISSUE 17: the all-pairs grid events are a pinned registry (the
+    lint rule and the CLI grid section both key off these names)."""
+    from netrep_tpu.utils.telemetry import GRID_EVENTS, KNOWN_EVENTS
+
+    assert GRID_EVENTS == (
+        "grid_start",
+        "grid_end",
+        "grid_cell_start",
+        "grid_cell_done",
+        "grid_dedup_hit",
+        "grid_warmstart_seeded",
+    )
+    assert set(GRID_EVENTS) <= KNOWN_EVENTS
+
+
+def test_grid_summary_folds_grid_events():
+    """The all-pairs grid offline aggregation (`telemetry` CLI section):
+    per-discovery-row cell outcomes (computed vs manifest, warm starts,
+    permutations), plus grid-level dedup hits and wall time."""
+    from netrep_tpu.utils.telemetry import grid_summary
+
+    def ev(name, **data):
+        return {"v": 1, "t": 0.0, "m": 0.0, "run": "x", "ev": name,
+                "data": data}
+
+    events = [
+        ev("grid_start", span="s1", datasets=3, cells=4),
+        ev("grid_cell_start", discovery="a", test="c", pack_size=2),
+        ev("grid_warmstart_seeded", discovery="a", test="c",
+           prior_perms=40),
+        ev("grid_cell_done", discovery="a", test="c", source="computed",
+           perms=64, warmstarted=True),
+        ev("grid_cell_done", discovery="a", test="b", source="manifest",
+           perms=0),
+        ev("grid_cell_start", discovery="b", test="c", pack_size=2),
+        ev("grid_cell_done", discovery="b", test="c", source="computed",
+           perms=48),
+        ev("grid_dedup_hit", kind="props"),
+        ev("grid_dedup_hit", kind="observed"),
+        ev("grid_end", span="s1", s=1.5, cells_computed=2),
+        ev("request_done", tenant="a", s=1.0),   # not a grid event
+    ]
+    s = grid_summary(events)
+    assert s["grids"] == 1 and s["dedup_hits"] == 2
+    assert s["wall_s"] == pytest.approx(1.5)
+    assert set(s["rows"]) == {"a", "b"}
+    a = s["rows"]["a"]
+    assert a["started"] == 1 and a["computed"] == 1
+    assert a["manifest"] == 1 and a["warmstarted"] == 1
+    assert a["perms"] == 64 and a["prior_perms"] == 40
+    assert s["rows"]["b"]["computed"] == 1
+    assert s["rows"]["b"]["perms"] == 48
+
+
 def test_histogram_bucket_boundaries_pinned():
     """ISSUE 13: the per-tenant latency/cost histogram boundaries are
     exposition schema — re-binning breaks every dashboard quantile keyed
@@ -387,12 +442,12 @@ def test_known_events_cover_every_emitted_name():
     union's composition so a registry refactor cannot silently drop a
     subset out of :data:`KNOWN_EVENTS`."""
     from netrep_tpu.utils.telemetry import (
-        ENGINE_EVENTS, FLEET_EVENTS, KNOWN_EVENTS, RECOVERY_EVENTS,
-        SERVE_EVENTS, SPAN_EVENTS,
+        ENGINE_EVENTS, FLEET_EVENTS, GRID_EVENTS, KNOWN_EVENTS,
+        RECOVERY_EVENTS, SERVE_EVENTS, SPAN_EVENTS,
     )
 
     union = (ENGINE_EVENTS + RECOVERY_EVENTS + SERVE_EVENTS
-             + FLEET_EVENTS + SPAN_EVENTS)
+             + FLEET_EVENTS + SPAN_EVENTS + GRID_EVENTS)
     assert KNOWN_EVENTS == frozenset(union)
     # no duplicates across registries: each name has one owning registry
     assert len(union) == len(set(union))

@@ -208,6 +208,39 @@ def test_warmstart_step_off_under_queue_hook_and_loud_never_fatal(tmp_path):
     assert "queue drained" in log2
 
 
+def test_grid_step_off_under_queue_hook_and_loud_never_fatal(tmp_path):
+    """ISSUE 17: the all-pairs atlas step is off by default and under
+    the QUEUE_FILE hook (auto); forced on, a failing bench (cell/solo
+    parity or the delta bound tripping in-bench) banners LOUDLY but
+    never fails the cycle — the queue still drains."""
+    # default off / auto under QUEUE_FILE: no grid banner
+    proc, _, log = run_watch(tmp_path, ["one 30 echo ok-one"])
+    assert proc.returncode == 0
+    assert "grid step" not in log
+    proc_a, _, log_a = run_watch(
+        tmp_path, ["oneauto 30 echo ok-one"], tag="gridauto",
+        extra_env={"GRID_STEP": "auto"},
+    )
+    assert proc_a.returncode == 0
+    assert "grid step" not in log_a
+    # forced on with a python shim that fails the bench: the step
+    # banners and the cycle still completes (loud-never-fatal)
+    shim_dir = tmp_path / "bin"
+    shim_dir.mkdir()
+    shim = shim_dir / "python"
+    shim.write_text("#!/bin/sh\nexit 1\n")
+    shim.chmod(0o755)
+    proc2, _, log2 = run_watch(
+        tmp_path, ["two 30 echo ok-two"], tag="grid",
+        extra_env={"GRID_STEP": "1",
+                   "PATH": f"{shim_dir}:{os.environ['PATH']}"},
+    )
+    assert proc2.returncode == 0, proc2.stderr
+    assert "grid step" in log2
+    assert "GRID STEP FAILED" in log2
+    assert "queue drained" in log2
+
+
 def test_lint_step_runs_when_forced_and_stays_off_under_queue_hook(tmp_path):
     """ISSUE 12: the per-cycle invariant lint is off under the
     QUEUE_FILE state-machine hook (auto), runs with LINT_CHECK=1, and
