@@ -307,6 +307,24 @@ def emit(payload):
             payload.setdefault(k, v)
         if FALLBACK_REASON is not None:
             payload.setdefault("fallback_reason", FALLBACK_REASON)
+        if isinstance(payload.get("perms_per_sec"), (int, float)):
+            # roofline provenance on every throughput row (ISSUE 18):
+            # the engine's end-of-run accounting leaves its roofline
+            # block as a process note; CONSUME it so a stale note from
+            # an earlier benchmark never lands on an unrelated row.
+            # Telemetry-off runs leave no note — fields are then null,
+            # never guessed.
+            from netrep_tpu.utils import costmodel
+
+            note = costmodel.last_run_note(consume=True)
+            payload.setdefault("flops",
+                               note.get("flops") if note else None)
+            payload.setdefault("bytes_hbm",
+                               note.get("bytes_hbm") if note else None)
+            payload.setdefault("utilisation",
+                               note.get("utilisation") if note else None)
+            if note is not None:
+                payload.setdefault("roofline", note)
         if os.environ.get("NETREP_PERF_LEDGER"):
             # feed the perf-regression ledger (best-effort, never fails
             # the bench): one throughput fingerprint per measured row

@@ -353,6 +353,48 @@ def grid_lines(rows: list[dict]) -> list[str]:
     ]
 
 
+def roofline_lines(events: list[dict]) -> list[str]:
+    """Roofline section (ISSUE 18) from ``roofline`` telemetry events:
+    per program family, the newest achieved-vs-speed-of-light verdict.
+    Rows whose ``device_kind`` has no peak entry (CPU, unknown —
+    utilisation null, never a guess) are classified as MECHANISM checks:
+    the cost accounting ran and reconciled, but the utilisation number is
+    not a TPU measurement and must never be transcribed as one. Rows
+    with a real utilisation are the measured roofline story BASELINE's
+    hand-written predictions graduate into."""
+    measured: dict[str, dict] = {}
+    mechanism: dict[str, dict] = {}
+    for e in events:
+        d = e.get("data") or {}
+        fam = d.get("family")
+        if not isinstance(fam, str):
+            continue
+        if isinstance(d.get("utilisation"), (int, float)):
+            measured[fam] = d
+        else:
+            mechanism[fam] = d
+    lines = []
+    for fam in sorted(measured):
+        d = measured[fam]
+        lines.append(
+            f"{fam} [{d.get('device_kind')}]: utilisation "
+            f"{d.get('utilisation')} of speed of light "
+            f"({d.get('achieved_pps')} / {d.get('sol_pps')} perms/s, "
+            f"{d.get('flops_per_perm')} flops/perm, "
+            f"{d.get('bytes_per_perm')} bytes/perm)"
+        )
+    for fam in sorted(mechanism):
+        d = mechanism[fam]
+        lines.append(
+            f"{fam} [{d.get('device_kind')}]: MECHANISM row — cost "
+            f"accounting ran ({d.get('flops_per_perm')} flops/perm, "
+            f"{d.get('achieved_pps')} perms/s) but no peak entry for "
+            "this device kind; utilisation null, never transcribe as a "
+            "TPU measurement"
+        )
+    return lines
+
+
 def main(paths: list[str]) -> int:
     results, unknown, other, dropped, telemetry = [], [], [], 0, []
     ledger, lint, serve_cost, serve_top = [], [], [], []
@@ -422,6 +464,13 @@ def main(paths: list[str]) -> int:
     if ledger:
         print(f"## perf trend ({len(ledger)} ledger entries)")
         for line in perf_trend(ledger):
+            print(line)
+        print()
+    roofline = [r for r in telemetry if r.get("ev") == "roofline"]
+    if roofline:
+        print(f"## roofline (achieved vs speed of light, "
+              f"{len(roofline)} run(s))")
+        for line in roofline_lines(roofline):
             print(line)
         print()
     if telemetry:

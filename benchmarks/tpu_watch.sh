@@ -364,6 +364,34 @@ grid_step() {
   fi
 }
 
+# Roofline drift gate (ISSUE 18): once per watch cycle, run the
+# speed-of-light check over the cycle's perf ledger — the newest
+# roofline-bearing entry's utilisation (achieved perms/s on device kinds
+# without a peak entry, i.e. CPU mechanism rows) against the robust
+# median of its matching history. Exit 2 = the same program family is
+# now further from the roofline than it historically was — a perf
+# regression wall-clock alone can hide behind shape drift. Logged LOUDLY
+# but never fails the cycle (the measurements are real; the drift is for
+# a human or CI to act on). ROOFLINE_CHECK=0 disables; default 'auto':
+# on in production, off under the QUEUE_FILE state-machine test hook
+# like the other drills.
+ROOFLINE_CHECK=${ROOFLINE_CHECK:-auto}
+roofline_check() {
+  case "$ROOFLINE_CHECK" in
+    0) return 0 ;;
+    auto) [ -n "${QUEUE_FILE:-}" ] && return 0 ;;
+  esac
+  [ -s "$PERF_LEDGER" ] || return 0
+  echo "--- roofline check ($(date -u +%FT%TZ)) ---" | tee -a "$LOG"
+  if roofline_out=$(timeout 60 python -m netrep_tpu roofline \
+       --ledger "$PERF_LEDGER" --check 2>/dev/null); then
+    echo "$roofline_out" >>"$LOG"
+  else
+    echo "--- ROOFLINE DRIFT (utilisation regressed vs this fingerprint's history) ---" | tee -a "$LOG"
+    echo "$roofline_out" | tee -a "$LOG"
+  fi
+}
+
 echo "== watcher start $(date -u +%FT%TZ) (log=$LOG state=$STATE) ==" | tee -a "$LOG"
 while :; do
   lint_check
@@ -373,6 +401,7 @@ while :; do
   fleet_drill
   warmstart_step
   grid_step
+  roofline_check
   # drained first: with a cutoff set, an empty queue would otherwise be
   # reported as "no step can finish before cutoff" (review r5 — the test
   # harness caught the misleading exit line)

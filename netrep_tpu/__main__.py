@@ -33,6 +33,13 @@ backend) needs the equivalent one-liner. Commands:
   per-step gate), ``--ingest BENCH_r0*.json`` seeds the ledger from the
   driver-bench trajectory files. The ledger path defaults from
   ``NETREP_PERF_LEDGER``. Also backend-free.
+- ``roofline [<run.jsonl>] [--ledger L --check]`` — the speed-of-light
+  view (ISSUE 18; :mod:`netrep_tpu.utils.costmodel`): folds a telemetry
+  run's per-chunk cost fields into a per-family achieved-vs-roofline
+  table sorted by headroom (with the span-sum vs ``null_run_end``
+  reconciliation verdict), and ``--check`` gates the newest
+  roofline-bearing ledger entry's utilisation against the robust median
+  of its matching history, exit 2 on drift. Also backend-free.
 """
 
 from __future__ import annotations
@@ -628,6 +635,33 @@ def main(argv=None) -> int:
     pf.add_argument("--ingest", nargs="+", metavar="BENCH_JSON",
                     help="append entries converted from driver "
                          "BENCH_r0*.json files before any other action")
+    rf = sub.add_parser(
+        "roofline",
+        help="per-family achieved-vs-speed-of-light table from a "
+             "telemetry run + utilisation drift gate over the perf "
+             "ledger (ISSUE 18)",
+    )
+    rf.add_argument("path", nargs="?", default=None, metavar="RUN_JSONL",
+                    help="telemetry run JSONL: fold its chunk/superchunk "
+                         "cost fields into the per-family headroom table "
+                         "(sorted by headroom, reconciliation verdict "
+                         "appended)")
+    rf.add_argument("--ledger", default=None, metavar="LEDGER",
+                    help="perf ledger for --check (default: "
+                         "$NETREP_PERF_LEDGER or "
+                         "./netrep_perf_ledger.jsonl)")
+    rf.add_argument("--check", action="store_true",
+                    help="compare the newest roofline-bearing ledger "
+                         "entry's utilisation (achieved perms/s when the "
+                         "device kind has no peak entry) against the "
+                         "robust median of matching priors; exit 2 on "
+                         "regression beyond --threshold")
+    rf.add_argument("--threshold", type=float, default=None,
+                    help="fail when newest/median < 1 - THRESHOLD "
+                         "(default 0.4)")
+    rf.add_argument("--window", type=int, default=None,
+                    help="median over at most this many most-recent "
+                         "matching entries (default 8)")
     sv = sub.add_parser(
         "serve",
         help="always-on multi-tenant preservation service (ISSUE 7): "
@@ -897,6 +931,46 @@ def main(argv=None) -> int:
             except OSError as e:
                 print(f"cannot read {ledger!r}: {e}", file=sys.stderr)
                 return 1
+        return 0
+
+    if args.cmd == "roofline":
+        # backend-free like `perf`: the headroom table and drift gate
+        # must run on a box whose tunnel is dead
+        from netrep_tpu.utils import costmodel, perfledger
+        from netrep_tpu.utils.telemetry import read_events
+
+        if args.path is None and not args.check:
+            print("roofline: nothing to do — pass a telemetry run JSONL "
+                  "and/or --check", file=sys.stderr)
+            return 1
+        if args.path is not None:
+            try:
+                folded = costmodel.fold_roofline_events(
+                    read_events(args.path)
+                )
+            except OSError as e:
+                print(f"cannot read {args.path!r}: {e}", file=sys.stderr)
+                return 1
+            print(costmodel.render_roofline(folded))
+        if args.check:
+            ledger = args.ledger or perfledger.default_path()
+            try:
+                ok, report = perfledger.check_roofline(
+                    ledger,
+                    threshold=(
+                        args.threshold if args.threshold is not None
+                        else perfledger.DEFAULT_THRESHOLD
+                    ),
+                    window=(
+                        args.window if args.window is not None
+                        else perfledger.DEFAULT_WINDOW
+                    ),
+                )
+            except OSError as e:
+                print(f"cannot read {ledger!r}: {e}", file=sys.stderr)
+                return 1
+            print(report)
+            return 0 if ok else 2
         return 0
 
     if args.cmd == "telemetry":

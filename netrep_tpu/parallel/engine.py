@@ -118,8 +118,21 @@ def _run_program_source(base, mode: str) -> str:
     return srcs.get(_MODE_PROGRAM.get(mode, "chunk"), "jit")
 
 
+def _run_cost_tracker(base, telemetry):
+    """Per-run roofline cost tracker (ISSUE 18), resolved once inside the
+    telemetry branch — the disabled hot path keeps its single ``None``
+    check (PR 3 contract) and native engines without the analytic model
+    get None (cost fields omitted, never guessed)."""
+    if telemetry is None:
+        return None
+    from ..utils import costmodel
+
+    return costmodel.tracker_for(base)
+
+
 def _finish_run_accounting(base, telemetry, run_sid, t_marks, t_run0,
-                           start_perm, n_perm, mode) -> None:
+                           start_perm, n_perm, mode,
+                           tracker=None) -> None:
     """End-of-run compile estimate + perf-ledger feed (ISSUE 5), emitted
     only when telemetry is on and at least two chunks landed.
 
@@ -148,13 +161,25 @@ def _finish_run_accounting(base, telemetry, run_sid, t_marks, t_run0,
     src = _run_program_source(base, mode)
     telemetry.emit("compile_span", parent=run_sid, s=compile_s, key=fp,
                    mode=mode, source=src)
+    roofline = None
+    if tracker is not None:
+        # the run's roofline verdict (ISSUE 18): the analytic per-perm
+        # model against the device's speed of light, judged at the
+        # steady-state rate (same marks as the ledger entry). Recorded as
+        # the process's last-run note so bench rows and fleet stats()
+        # read the gauge without re-deriving it.
+        from ..utils import costmodel
+
+        roofline = tracker.roofline_block(rate)
+        telemetry.emit("roofline", parent=run_sid, mode=mode, **roofline)
+        costmodel.record_run_note(roofline)
     from ..utils import perfledger
 
     perfledger.maybe_record_run(
         run_id=telemetry.run_id,
         fingerprint=f"{fp}|src:{src}" if fp else fp, mode=mode,
         perms_per_sec=rate, compile_s=compile_s, n_perm=int(n_perm),
-        backend=jax.default_backend(),
+        backend=jax.default_backend(), roofline=roofline,
     )
 
 
@@ -287,6 +312,7 @@ def run_checkpointed_chunks(
     d0, b0 = prev_d, prev_b = _profile_totals(profile)
     run_sid = None
     mem = None
+    tracker = _run_cost_tracker(base, telemetry)
     if telemetry is not None:
         run_sid = telemetry.begin_span(
             "null_run_start", mode="materialized", n_perm=int(n_perm),
@@ -350,6 +376,9 @@ def run_checkpointed_chunks(
                         take=int(take_p), s=now - prev_t,
                         dispatches=d - prev_d, host_bytes=b - prev_b,
                         transfer_s=now - t_w0, span=sid_p, parent=run_sid,
+                        **(tracker.chunk_fields(int(take_p), now - prev_t,
+                                                profile)
+                           if tracker is not None else {}),
                         **(mem() if mem is not None else {}),
                     )
                     prev_t, prev_d, prev_b = now, d, b
@@ -405,12 +434,14 @@ def run_checkpointed_chunks(
     if telemetry is not None:
         d, b = _profile_totals(profile)
         _finish_run_accounting(base, telemetry, run_sid, t_marks, t_run0,
-                               start_perm, n_perm, "materialized")
+                               start_perm, n_perm, "materialized",
+                               tracker=tracker)
+        el = time.perf_counter() - t_run0
         telemetry.end_span(
             run_sid, "null_run_end", mode="materialized",
             completed=int(completed), n_perm=int(n_perm),
-            s=time.perf_counter() - t_run0,
-            dispatches=d - d0, host_bytes=b - b0,
+            s=el, dispatches=d - d0, host_bytes=b - b0,
+            **(tracker.run_fields(el) if tracker is not None else {}),
         )
     record = getattr(base, "record_chunk_throughput", None)
     if record is not None:
@@ -736,6 +767,7 @@ def run_stream_superchunks(
     start0 = completed
     run_sid = None
     mem = None
+    tracker = _run_cost_tracker(base, telemetry)
     if telemetry is not None:
         run_sid = telemetry.begin_span(
             "null_run_start", mode="streaming", n_perm=int(n_perm),
@@ -802,6 +834,9 @@ def run_stream_superchunks(
                     perms=int(take), s=now - prev_t, dispatches=2,
                     host_bytes=int(hi.nbytes + lo.nbytes + eff.nbytes),
                     transfer_s=now - t_p0, span=sid_c, parent=run_sid,
+                    **(tracker.chunk_fields(int(take), now - prev_t,
+                                            profile)
+                       if tracker is not None else {}),
                     **(mem() if mem is not None else {}),
                 )
                 prev_t = now
@@ -840,12 +875,14 @@ def run_stream_superchunks(
     if telemetry is not None:
         d, b = _profile_totals(profile)
         _finish_run_accounting(base, telemetry, run_sid, t_marks, t_run0,
-                               start0, n_perm, "streaming")
+                               start0, n_perm, "streaming",
+                               tracker=tracker)
+        el = time.perf_counter() - t_run0
         telemetry.end_span(
             run_sid, "null_run_end", mode="streaming",
             completed=int(completed), n_perm=int(n_perm),
-            s=time.perf_counter() - t_run0,
-            dispatches=d - d0, host_bytes=b - b0,
+            s=el, dispatches=d - d0, host_bytes=b - b0,
+            **(tracker.run_fields(el) if tracker is not None else {}),
         )
     return StreamCounts(hi=hi, lo=lo, eff=eff, completed=completed)
 
@@ -956,6 +993,7 @@ def run_adaptive_stream_chunks(
     t_marks: list[tuple[int, float]] = []
     run_sid = None
     mem = None
+    tracker = _run_cost_tracker(base, telemetry)
     if telemetry is not None:
         run_sid = telemetry.begin_span(
             "null_run_start", mode="adaptive-streaming", n_perm=int(n_perm),
@@ -1015,6 +1053,9 @@ def run_adaptive_stream_chunks(
                     ),
                     active_modules=int(monitor.active.sum()),
                     transfer_s=pull_s, span=sid_c, parent=run_sid,
+                    **(tracker.chunk_fields(int(take), now - prev_t,
+                                            profile)
+                       if tracker is not None else {}),
                     **(mem() if mem is not None else {}),
                 )
                 prev_t = now
@@ -1024,6 +1065,10 @@ def run_adaptive_stream_chunks(
             if newly.size and monitor.any_active():
                 rebucket(monitor.active_positions())
                 fn = fn_builder()
+                if tracker is not None:
+                    # retirement shrank the bucket list — re-price the
+                    # chunk program so later spans carry the smaller cost
+                    tracker.refresh(base)
             if save is not None and completed - last_saved >= checkpoint_every:
                 save(completed)
                 last_saved = completed
@@ -1049,12 +1094,15 @@ def run_adaptive_stream_chunks(
     if telemetry is not None:
         d, b = _profile_totals(profile)
         _finish_run_accounting(base, telemetry, run_sid, t_marks, t_run0,
-                               start0, n_perm, "adaptive-streaming")
+                               start0, n_perm, "adaptive-streaming",
+                               tracker=tracker)
+        el = time.perf_counter() - t_run0
         telemetry.end_span(
             run_sid, "null_run_end", mode="adaptive-streaming",
             completed=int(completed), n_perm=int(n_perm),
-            s=time.perf_counter() - t_run0, dispatches=d - d0,
+            s=el, dispatches=d - d0,
             host_bytes=b - b0, perms_evaluated=int(monitor.total_evaluated()),
+            **(tracker.run_fields(el) if tracker is not None else {}),
         )
     return monitor, completed, finished
 
@@ -1267,6 +1315,7 @@ def run_adaptive_chunks(
         getattr(monitor, "note_chunk_cost", None)
         if telemetry is not None else None
     )
+    tracker = _run_cost_tracker(base, telemetry)
     if telemetry is not None:
         run_sid = telemetry.begin_span(
             "null_run_start", mode="adaptive", n_perm=int(n_perm),
@@ -1322,6 +1371,8 @@ def run_adaptive_chunks(
                     take=int(take), s=now - prev_t,
                     active_modules=int(monitor.active.sum()),
                     transfer_s=write_s, span=sid_c, parent=run_sid,
+                    **(tracker.chunk_fields(int(take), now - prev_t)
+                       if tracker is not None else {}),
                     **(mem() if mem is not None else {}),
                 )
                 prev_t = now
@@ -1331,6 +1382,10 @@ def run_adaptive_chunks(
             if newly.size and monitor.any_active():
                 rebucket(monitor.active_positions())
                 fn = fn_builder()
+                if tracker is not None:
+                    # retirement shrank the bucket list — re-price the
+                    # chunk program so later spans carry the smaller cost
+                    tracker.refresh(base)
             if save is not None and completed - last_saved >= checkpoint_every:
                 save(nulls, completed)
                 last_saved = completed
@@ -1353,12 +1408,15 @@ def run_adaptive_chunks(
         save(nulls, completed)
     if telemetry is not None:
         _finish_run_accounting(base, telemetry, run_sid, t_marks, t_run0,
-                               start0, n_perm, "adaptive")
+                               start0, n_perm, "adaptive",
+                               tracker=tracker)
+        el = time.perf_counter() - t_run0
         telemetry.end_span(
             run_sid, "null_run_end", mode="adaptive",
             completed=int(completed),
-            n_perm=int(n_perm), s=time.perf_counter() - t_run0,
+            n_perm=int(n_perm), s=el,
             perms_evaluated=int(monitor.total_evaluated()),
+            **(tracker.run_fields(el) if tracker is not None else {}),
         )
     return nulls, completed, finished
 

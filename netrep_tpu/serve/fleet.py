@@ -845,6 +845,7 @@ class FleetCoordinator:
                 "pid": proc.pid if proc is not None else None,
                 "backlog_perms": st.get("backlog_perms", 0),
                 "rate_pps": st.get("rate_pps"),
+                "utilisation": st.get("utilisation"),
                 "inflight": st.get("inflight", 0),
                 "packs": st.get("packs", 0),
                 "brownout": st.get("brownout", False),

@@ -868,7 +868,12 @@ class PreservationServer:
         """Steady-state serving throughput estimate (perms/s): configured
         assumption, else the server's own measured rate, else the perf
         ledger's serve/run history (read once, cached) — None when
-        nothing is known (brownout then stays off: no guessing)."""
+        nothing is known (brownout then stays off: no guessing). The
+        roofline note (ISSUE 18) deliberately does NOT feed this chain:
+        it is process-wide, so in a multi-server process (fleet tests,
+        embedded use) an unrelated engine run's rate would masquerade as
+        THIS server's serving rate and corrupt the drain estimate — the
+        note stays a display gauge (``stats()`` utilisation)."""
         if self.config.brownout_rate_pps:
             return float(self.config.brownout_rate_pps)
         if self._busy_s > 0 and self._served_perms > 0:
@@ -889,6 +894,15 @@ class PreservationServer:
             except OSError:
                 pass
         return self._ledger_rate
+
+    @staticmethod
+    def _roofline_note() -> dict | None:
+        """The most recent engine run's roofline block (PEEK semantics —
+        `stats()` is polled, so the note must stay readable; bench rows
+        are the consuming reader)."""
+        from ..utils import costmodel
+
+        return costmodel.last_run_note(consume=False)
 
     def _drain_estimate_locked(self, extra_perms: int = 0) -> float | None:
         rate = self._rate_pps()
@@ -1822,6 +1836,14 @@ class PreservationServer:
                     for t in self._tenants.values() for r in t.pending
                 ),
                 "rate_pps": self._rate_pps(),
+                # roofline gauge (ISSUE 18): this replica's most recent
+                # engine run's achieved fraction of speed of light (null
+                # on unknown device kinds / before the first telemetry-on
+                # run) — the coordinator copies it into its per-replica
+                # rows and `top` shows it as the util column
+                "utilisation": (
+                    (self._roofline_note() or {}).get("utilisation")
+                ),
                 "fleet_label": self.config.fleet_label,
                 "journal": self.config.journal,
                 "pool": self.pool.stats(),

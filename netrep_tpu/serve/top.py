@@ -83,6 +83,7 @@ def snapshot(stats: dict) -> dict:
                 "queue_depth": int(r.get("queue_depth", 0) or 0),
                 "backlog_perms": int(r.get("backlog_perms", 0) or 0),
                 "rate_pps": r.get("rate_pps"),
+                "utilisation": r.get("utilisation"),
                 "packs": int(r.get("packs", 0) or 0),
                 "done": int(r.get("done", 0) or 0),
                 "brownout": bool(r.get("brownout", False)),
@@ -122,6 +123,10 @@ _REPLICA_COLUMNS = (
     ("q", 4, "queue_depth", "d"),
     ("backlog", 8, "backlog_perms", "d"),
     ("rate/s", 9, "rate_pps", ".1f"),
+    # roofline gauge (ISSUE 18): achieved fraction of speed of light
+    # from the replica's last engine run — `-` until one has run or on
+    # device kinds without a peak-table entry (null, never a guess)
+    ("util", 5, "utilisation", ".2f"),
     ("packs", 6, "packs", "d"),
     ("done", 6, "done", "d"),
 )

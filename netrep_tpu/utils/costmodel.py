@@ -1,0 +1,645 @@
+"""Analytic FLOP/HBM-byte cost model + roofline gauges (ISSUE 18).
+
+Every perf claim the repo makes — fused kernel, ring DMA, tile
+screening, bf16 rescue, AOT warm start — was judged only by wall-clock
+and the hand-written roofline *predictions* in BASELINE.md. This module
+is the measurement side: an analytic per-permutation FLOP/byte model per
+program family, a per-device-kind peak-rate table, and the run-time
+tracker the null loops thread through every chunk/superchunk span so
+"what fraction of speed of light did this run achieve" is a recorded
+number, not prose.
+
+Model contract (docs/architecture.md § Roofline observability):
+
+- costs are **integers per permutation** derived from the engine's
+  bucket signature (cap, module count), matrix width ``n``, sample count
+  ``s``, power-iteration count ``p``, and dtype width — the SAME integer
+  feeds the chunk event, the :class:`~netrep_tpu.utils.profiling.NullProfile`
+  accumulator, and the ``null_run_end`` totals, so per-family span sums
+  reconcile with profile totals *exactly* (no float re-derivation);
+- the model is cross-checkable against ``Compiled.cost_analysis()``
+  where the installed jax exposes it (:func:`xla_cost_analysis`, guarded
+  like the PR 5 xplane probes). XLA counts ``lax.scan``/``while`` bodies
+  ONCE regardless of trip count (verified on the installed jax), so
+  :attr:`ProgramCost.xla_flops_per_perm` prices scan-carried terms (the
+  power iteration) at one trip for that comparison while
+  :attr:`ProgramCost.flops_per_perm` prices the work actually executed;
+- peak rates come from :data:`PEAK_TABLE` keyed by ``device_kind`` (the
+  public per-chip dense-matmul and HBM-bandwidth specs), overridable via
+  the ``NETREP_PEAK_OVERRIDES`` env JSON; an unknown kind (CPU included)
+  reports utilisation as **null, never a guess** — the bench/watch
+  summarizers classify those rows as mechanism checks, not measurements.
+
+Telemetry-off runs never reach this module (the engine resolves the
+tracker inside its single telemetry ``None`` check — the PR 3 contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+
+logger = logging.getLogger("netrep_tpu")
+
+#: roofline-block schema version: the ``roofline`` telemetry event, the
+#: optional perf-ledger ``roofline`` block, and bench rows all carry
+#: blocks of this shape (bump deliberately, with the pinned tests)
+ROOFLINE_VERSION = 1
+
+#: env var holding a JSON object of per-device-kind peak-rate overrides:
+#: ``{"<device_kind>": [flops_per_s, hbm_bytes_per_s]}`` (a two-element
+#: array, or an object with ``"flops"``/``"bw"`` keys). Lets a deployment
+#: calibrate the table to its chips — and lets CPU CI give the ``cpu``
+#: kind a peak so utilisation gauges are exercised in tier-1.
+PEAK_OVERRIDES_ENV = "NETREP_PEAK_OVERRIDES"
+
+#: per-device-kind peak rates ``(dense flops/s, HBM bytes/s)`` per chip —
+#: the public spec numbers (dense bf16 matmul peak; XLA's default-precision
+#: f32 matmul runs on the same MXU passes, so this is the honest ceiling
+#: for the gather/stat matmuls). Keys are normalized lowercase
+#: ``device_kind`` strings. CPU and unknown kinds are deliberately absent:
+#: utilisation is then null, never a guess (override via env to opt in).
+PEAK_TABLE: dict[str, tuple[float, float]] = {
+    "tpu v2": (45e12, 700e9),
+    "tpu v3": (123e12, 900e9),
+    "tpu v4": (275e12, 1228e9),
+    "tpu v5 lite": (197e12, 819e9),
+    "tpu v5e": (197e12, 819e9),
+    "tpu v5": (459e12, 2765e9),
+    "tpu v5p": (459e12, 2765e9),
+    "tpu v6 lite": (918e12, 1640e9),
+    "tpu v6e": (918e12, 1640e9),
+}
+
+_OVERRIDES_WARNED = False
+
+
+def device_kind() -> str:
+    """``device_kind`` of the default backend's first device, or
+    ``"unknown"`` when no backend resolves — the peak-table key."""
+    try:
+        import jax
+
+        return str(jax.devices()[0].device_kind)
+    # netrep: allow(exception-taxonomy) — backend probe: no resolvable device just disables utilisation gauges
+    except Exception:
+        return "unknown"
+
+
+def _peak_overrides() -> dict[str, tuple[float, float]]:
+    global _OVERRIDES_WARNED
+    raw = os.environ.get(PEAK_OVERRIDES_ENV)
+    if not raw:
+        return {}
+    try:
+        doc = json.loads(raw)
+        if not isinstance(doc, dict):
+            raise ValueError("not a JSON object")
+        out = {}
+        for kind, v in doc.items():
+            if isinstance(v, dict):
+                pair = (float(v["flops"]), float(v["bw"]))
+            else:
+                pair = (float(v[0]), float(v[1]))
+            out[str(kind).strip().lower()] = pair
+        return out
+    except (ValueError, TypeError, KeyError, IndexError) as e:
+        if not _OVERRIDES_WARNED:
+            _OVERRIDES_WARNED = True
+            logger.warning(
+                "%s is not a valid peak-override JSON object (%s: %s); "
+                "ignoring it", PEAK_OVERRIDES_ENV, type(e).__name__, e,
+            )
+        return {}
+
+
+def device_peaks(kind: str | None = None) -> tuple[float, float] | None:
+    """``(peak_flops_per_s, peak_hbm_bytes_per_s)`` for a device kind
+    (default: the current backend's), or None when the kind is unknown —
+    callers then report utilisation as null. Env overrides win over the
+    built-in table."""
+    k = (kind if kind is not None else device_kind()).strip().lower()
+    over = _peak_overrides()
+    if k in over:
+        return over[k]
+    return PEAK_TABLE.get(k)
+
+
+# ---------------------------------------------------------------------------
+# the analytic per-permutation model
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramCost:
+    """Per-permutation cost of one engine's null-chunk program family.
+
+    ``flops_per_perm`` prices the work executed (power iteration × its
+    trip count); ``xla_flops_per_perm`` prices scan-carried terms at ONE
+    trip — the number comparable against ``Compiled.cost_analysis()``,
+    which counts loop bodies once on the installed jax. Both are integers
+    so downstream sums reconcile exactly.
+    """
+
+    family: str
+    flops_per_perm: int
+    bytes_per_perm: int
+    xla_flops_per_perm: int
+    n_tests: int = 1
+
+
+def _stats_flops(m: int, s: int | None, p: int, summary: str,
+                 topo: bool = True) -> tuple[int, int]:
+    """Seven-statistic body flops per (module × permutation) at bucket
+    cap ``m``: returns ``(executed, xla_equivalent)``. Topology terms
+    (avg weight, degree, corr-of-corr) sum/correlate over the m×m
+    submatrices; data terms standardize the (s, m) slice, build the
+    node-space Gram, power-iterate it ``p`` times (the scan XLA counts
+    once), and correlate node contributions (ops/stats.py)."""
+    f = fx = 0
+    if topo:
+        f += 16 * m * m + 10 * m
+    if s:
+        f += 6 * m * s            # standardize_masked
+        f += 2 * m * m * s        # gram Z^T Z
+        f += 10 * m * s           # profile + node-contribution einsums/norms
+        if topo:
+            f += 2 * m * m        # avg_cor sign-weighted sum
+        f += 40 * m               # masked pearsons / means over nodes
+        it = 12 * m ** 3 if summary == "eigh" else 2 * m * m + 5 * m
+        fx = f + it               # scan body priced once
+        f += it if summary == "eigh" else p * it
+    else:
+        fx = f
+    return f, fx
+
+
+def _module_cost(family: str, m: int, n: int | None, s: int | None,
+                 p: int, n_mats: int, derived: bool, itemsize: int,
+                 summary: str) -> tuple[int, int, int]:
+    """(flops, bytes, xla_flops) per (module × permutation) at cap ``m``.
+
+    Gather pricing per family (docs/architecture.md for the derivation):
+
+    - ``mxu``: sorted row gather (m·n bytes/matrix) + one-hot column
+      matmul (2·m²·n) + unsort rotation PᵀSP (4·m³); data slice adds an
+      m·s row gather + 2·m²·s unsort matmul;
+    - ``direct``: exact 2D advanced-index gather — m² bytes/matrix,
+      negligible flops;
+    - ``fused`` (pallas gather and/or mega-kernel): streams whole m·n row
+      blocks tile-by-tile with mask-select compares (~2·m·n);
+    - ``data-only``: no stored n×n matrices at all — the m·s data slice,
+      the test-side k×k correlation reusing the node-space Gram the data
+      statistics already price, plus the soft-threshold network
+      construction (2·m²), then the full seven statistics;
+    - derived networks (``net_beta``) drop one stored matrix from the
+      row traffic and add an elementwise |corr|**β (2·m²).
+    """
+    topo = n is not None
+    gf = by = 0
+    if topo and family != "data-only":
+        if family.startswith("mxu"):
+            gf += n_mats * (2 * m * m * n + 4 * m ** 3)
+            if s:
+                gf += 2 * m * m * s
+            by += n_mats * m * n * itemsize
+        elif family.startswith("fused"):
+            gf += n_mats * 2 * m * n
+            by += n_mats * m * n * itemsize
+        else:                      # direct 2D gather
+            by += n_mats * m * m * itemsize
+        if derived:
+            gf += 2 * m * m
+    if family == "data-only" and s:
+        gf += 2 * m * m
+    if s:
+        by += m * s * itemsize
+    sf, sfx = _stats_flops(m, s, p, summary, topo=topo)
+    return gf + sf, by, gf + sfx
+
+
+def _first(x):
+    return x[0] if isinstance(x, (list, tuple)) else x
+
+
+def _test_shapes(engine) -> tuple[int | None, int | None]:
+    """(n nodes, s samples) of the test side — single-test attrs first,
+    then the multi-test stacked/ragged layouts (first dataset's shape;
+    sample counts are uniform across cohorts on the hot paths)."""
+    n = s = None
+    tc = getattr(engine, "_test_corr", None)
+    if tc is None:
+        tc = _first(getattr(engine, "_tc", None))
+        if tc is not None:
+            n = int(tc.shape[-1])
+    else:
+        n = int(tc.shape[-1])
+    td = getattr(engine, "_test_dataT", None)
+    if td is None:
+        td = _first(getattr(engine, "_td", None))
+    if td is not None:
+        s = int(td.shape[-1])
+        if n is None:
+            n = int(td.shape[-2])
+    return n, s
+
+
+def _dtype_itemsize(config) -> int:
+    dt = getattr(config, "dtype", "float32")
+    try:
+        import numpy as np
+
+        return int(np.dtype(dt).itemsize)
+    except TypeError:
+        try:
+            import jax.numpy as jnp
+
+            return int(jnp.dtype(dt).itemsize)
+        except (ImportError, TypeError):
+            return 4
+
+
+def resolve_engine_cost(engine) -> ProgramCost | None:
+    """Analytic per-permutation cost of ``engine``'s null-chunk program,
+    or None for engines without the JAX bucket structure (the native C++
+    tier) — cost fields are then simply omitted, never guessed. Every
+    attribute access is getattr-guarded: a cost model that cannot resolve
+    must not fail the run that asked for it."""
+    base = getattr(engine, "_base", None) or engine
+    buckets = getattr(engine, "buckets", None)
+    if not buckets:
+        buckets = getattr(base, "buckets", None)
+    config = getattr(engine, "config", None)
+    if config is None:
+        config = getattr(base, "config", None)
+    if not buckets or config is None:
+        return None
+    data_only = bool(getattr(base, "data_only", False)
+                     or getattr(engine, "data_only", False))
+    gather_mode = str(getattr(engine, "gather_mode", None)
+                      or getattr(base, "gather_mode", "direct"))
+    stat_mode = str(getattr(engine, "stat_mode", None)
+                    or getattr(base, "stat_mode", "xla"))
+    net_beta = getattr(engine, "net_beta", None)
+    n, s = _test_shapes(engine)
+    if n is None:
+        return None
+    if data_only:
+        family = "data-only"
+    elif stat_mode == "fused":
+        family = f"{gather_mode}+fusedstats"
+    else:
+        family = gather_mode
+    itemsize = _dtype_itemsize(config)
+    if getattr(engine, "_screen_active", False):
+        # bf16 screened fast pass (ISSUE 16): the chunk dispatch wraps
+        # the bf16 pass + the exact rescue of flagged permutations; the
+        # model prices the pass every permutation pays (bf16-width row
+        # traffic) — rescue cost is excluded, documented, since the
+        # rescued fraction is data-dependent and telemetry already
+        # counts rescue_dispatch events separately.
+        family += "+bf16rescue"
+        itemsize = 2
+    T = int(getattr(engine, "T", 1) or 1)
+    p = int(getattr(config, "power_iters", 60) or 60)
+    summary = str(getattr(config, "summary_method", "power") or "power")
+    n_mats = 1 if net_beta is not None else 2
+    f = by = fx = 0
+    for bkt in buckets:
+        k = len(getattr(bkt, "module_pos", ()) or ())
+        m = int(getattr(bkt, "cap", 0) or 0)
+        if not k or not m:
+            continue
+        mf, mb, mfx = _module_cost(family, m, n, s, p, n_mats,
+                                   net_beta is not None, itemsize, summary)
+        f += k * mf
+        by += k * mb
+        fx += k * mfx
+    if not f and not by:
+        return None
+    return ProgramCost(family, int(f) * T, int(by) * T, int(fx) * T, T)
+
+
+# ---------------------------------------------------------------------------
+# roofline math
+# ---------------------------------------------------------------------------
+
+
+def sol_pps(flops_per_perm: int, bytes_per_perm: int,
+            peaks: tuple[float, float] | None) -> float | None:
+    """Speed-of-light permutations/s: 1 / max(compute time, HBM time)
+    per permutation — the roofline ceiling. None when peaks are unknown."""
+    if peaks is None:
+        return None
+    pf, pb = peaks
+    if pf <= 0 or pb <= 0:
+        return None
+    sol_s = max(flops_per_perm / pf, bytes_per_perm / pb)
+    return (1.0 / sol_s) if sol_s > 0 else None
+
+
+def utilisation(achieved_pps: float | None,
+                sol: float | None) -> float | None:
+    """Achieved fraction of speed of light (null when either side is
+    unknown — never a guess)."""
+    if achieved_pps is None or sol is None or sol <= 0:
+        return None
+    return achieved_pps / sol
+
+
+class RunCostTracker:
+    """Per-run cost accumulator the null loops thread through their
+    telemetry branch: prices each chunk/superchunk with the SAME integers
+    it feeds the :class:`~netrep_tpu.utils.profiling.NullProfile`, so
+    span sums and profile totals reconcile exactly. Resolved only when
+    telemetry is on (the PR 3 single-None-check contract); adaptive loops
+    call :meth:`refresh` after a rebucket so shrunken bucket lists are
+    re-priced mid-run."""
+
+    def __init__(self, cost: ProgramCost, kind: str | None = None):
+        self.cost = cost
+        self.device_kind = kind if kind is not None else device_kind()
+        self.peaks = device_peaks(self.device_kind)
+        self.flops = 0
+        self.bytes_hbm = 0
+        self.perms = 0
+
+    def refresh(self, engine) -> None:
+        cost = resolve_engine_cost(engine)
+        if cost is not None:
+            self.cost = cost
+
+    def chunk_fields(self, take: int, seconds: float,
+                     profile=None) -> dict:
+        """Accumulate one chunk/superchunk and return its event fields
+        (``family``/``flops``/``bytes_hbm``/``achieved_pps``/
+        ``utilisation``)."""
+        f = self.cost.flops_per_perm * int(take)
+        b = self.cost.bytes_per_perm * int(take)
+        self.flops += f
+        self.bytes_hbm += b
+        self.perms += int(take)
+        if profile is not None:
+            profile.record_cost(f, b, self.cost.family, int(take))
+        pps = (take / seconds) if seconds > 0 else None
+        sol = sol_pps(self.cost.flops_per_perm, self.cost.bytes_per_perm,
+                      self.peaks)
+        return {
+            "family": self.cost.family,
+            "flops": int(f),
+            "bytes_hbm": int(b),
+            "achieved_pps": pps,
+            "utilisation": utilisation(pps, sol),
+        }
+
+    def run_fields(self, elapsed_s: float) -> dict:
+        """``null_run_end`` extras: accumulated totals + whole-run rate."""
+        pps = (self.perms / elapsed_s) if elapsed_s > 0 else None
+        sol = sol_pps(self.cost.flops_per_perm, self.cost.bytes_per_perm,
+                      self.peaks)
+        return {
+            "family": self.cost.family,
+            "flops": int(self.flops),
+            "bytes_hbm": int(self.bytes_hbm),
+            "achieved_pps": pps,
+            "utilisation": utilisation(pps, sol),
+        }
+
+    def roofline_block(self, achieved_pps: float | None) -> dict:
+        """The additive ledger/bench/event block (``ROOFLINE_VERSION``
+        shape): the per-perm model, the peak table row it was judged
+        against, and the achieved-vs-speed-of-light verdict."""
+        pf, pb = self.peaks if self.peaks is not None else (None, None)
+        sol = sol_pps(self.cost.flops_per_perm, self.cost.bytes_per_perm,
+                      self.peaks)
+        util = utilisation(achieved_pps, sol)
+        rnd = lambda v: round(float(v), 4) if v is not None else None
+        return {
+            "family": self.cost.family,
+            "flops_per_perm": int(self.cost.flops_per_perm),
+            "bytes_per_perm": int(self.cost.bytes_per_perm),
+            "flops": int(self.flops),
+            "bytes_hbm": int(self.bytes_hbm),
+            "device_kind": self.device_kind,
+            "peak_flops": pf,
+            "peak_bw": pb,
+            "sol_pps": rnd(sol),
+            "achieved_pps": rnd(achieved_pps),
+            "utilisation": rnd(util),
+        }
+
+
+def tracker_for(engine) -> RunCostTracker | None:
+    """The engine-loop entry point: a tracker when the analytic model
+    resolves, else None (native engines — cost fields omitted)."""
+    cost = resolve_engine_cost(engine)
+    return RunCostTracker(cost) if cost is not None else None
+
+
+# ---------------------------------------------------------------------------
+# last-run note: the in-process seam bench rows and fleet stats() read
+# ---------------------------------------------------------------------------
+
+_NOTE_LOCK = threading.Lock()
+_LAST_RUN_NOTE: dict | None = None
+
+
+def record_run_note(note: dict) -> None:
+    """Record the most recent telemetry-on run's roofline block —
+    written by the engine's end-of-run accounting, read by bench rows
+    (consume semantics, so a stale note never leaks onto an unrelated
+    row) and by the serve scheduler's ``stats()`` (peek semantics)."""
+    global _LAST_RUN_NOTE
+    with _NOTE_LOCK:
+        _LAST_RUN_NOTE = dict(note)
+
+
+def last_run_note(consume: bool = False) -> dict | None:
+    global _LAST_RUN_NOTE
+    with _NOTE_LOCK:
+        note = _LAST_RUN_NOTE
+        if consume:
+            _LAST_RUN_NOTE = None
+        return dict(note) if note is not None else None
+
+
+# ---------------------------------------------------------------------------
+# guarded XLA cross-check probes (the PR 5 xplane-probe pattern)
+# ---------------------------------------------------------------------------
+
+_COST_ANALYSIS_WARNED = False
+
+
+def xla_cost_analysis(compiled) -> dict | None:
+    """``Compiled.cost_analysis()`` where the installed jax exposes it,
+    normalized to ``{"flops", "bytes_accessed"}`` floats. The return
+    shape shifts across releases (a list of dicts on the installed
+    version, a bare dict on others); any incompatibility degrades to None
+    with one warning — the analytic model stands alone, the XLA number is
+    a cross-check."""
+    global _COST_ANALYSIS_WARNED
+    fn = getattr(compiled, "cost_analysis", None)
+    if not callable(fn):
+        return None
+    try:
+        ca = fn()
+    # netrep: allow(exception-taxonomy) — optional-API probe: an incompatible jax only disables the cross-check
+    except Exception as e:
+        if not _COST_ANALYSIS_WARNED:
+            _COST_ANALYSIS_WARNED = True
+            logger.warning("cost_analysis() unavailable on this jax "
+                           "(%s: %s); analytic model is not cross-checked",
+                           type(e).__name__, e)
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out = {}
+    for src, dst in (("flops", "flops"), ("bytes accessed", "bytes_accessed")):
+        v = ca.get(src)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[dst] = float(v)
+    return out or None
+
+
+def xla_memory_analysis(compiled) -> dict | None:
+    """``Compiled.memory_analysis()`` normalized to plain ints (argument/
+    output/temp/code sizes), or None where unsupported — same guard
+    policy as :func:`xla_cost_analysis`."""
+    fn = getattr(compiled, "memory_analysis", None)
+    if not callable(fn):
+        return None
+    try:
+        ma = fn()
+    # netrep: allow(exception-taxonomy) — optional-API probe: an incompatible jax only disables the cross-check
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for attr in ("argument_size_in_bytes", "output_size_in_bytes",
+                 "temp_size_in_bytes", "generated_code_size_in_bytes"):
+        v = getattr(ma, attr, None)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[attr] = int(v)
+    return out or None
+
+
+# ---------------------------------------------------------------------------
+# run-JSONL folding (the `roofline` CLI's table source)
+# ---------------------------------------------------------------------------
+
+
+def fold_roofline_events(events) -> dict:
+    """Fold a telemetry run's events into the per-family roofline view:
+
+    - ``families``: per-family accumulators summed over every chunk/
+      superchunk span carrying cost fields (perms, flops, bytes_hbm,
+      wall seconds, span count);
+    - ``run_totals``: the ``null_run_end`` totals per family (the
+      reconciliation counterpart — span sums must equal these exactly);
+    - ``runs``: each ``roofline`` event's block (per-perm model, peaks,
+      utilisation verdict).
+    """
+    fams: dict[str, dict] = {}
+    run_totals: dict[str, dict] = {}
+    runs: list[dict] = []
+    for e in events:
+        if not isinstance(e, dict):
+            continue
+        ev = e.get("ev")
+        d = e.get("data") or {}
+        if ev in ("chunk", "superchunk") and isinstance(d.get("family"), str):
+            fl = d.get("flops")
+            if not isinstance(fl, (int, float)) or isinstance(fl, bool):
+                continue
+            a = fams.setdefault(d["family"], {
+                "perms": 0, "flops": 0, "bytes_hbm": 0, "s": 0.0,
+                "spans": 0, "utilisation": None,
+            })
+            a["perms"] += int(d.get("take") or d.get("perms") or 0)
+            a["flops"] += int(fl)
+            a["bytes_hbm"] += int(d.get("bytes_hbm") or 0)
+            a["s"] += float(d.get("s") or 0.0)
+            a["spans"] += 1
+            if isinstance(d.get("utilisation"), (int, float)):
+                a["utilisation"] = float(d["utilisation"])
+        elif ev == "null_run_end" and isinstance(d.get("family"), str):
+            t = run_totals.setdefault(d["family"],
+                                      {"flops": 0, "bytes_hbm": 0})
+            t["flops"] += int(d.get("flops") or 0)
+            t["bytes_hbm"] += int(d.get("bytes_hbm") or 0)
+        elif ev == "roofline":
+            runs.append(dict(d))
+    return {"families": fams, "run_totals": run_totals, "runs": runs}
+
+
+def _fmt(v, spec: str = ".3g") -> str:
+    if v is None:
+        return "-"
+    return format(float(v), spec)
+
+
+def render_roofline(folded: dict) -> str:
+    """The ``roofline`` CLI's per-family headroom table, sorted by
+    headroom (1 − utilisation) descending — the biggest optimization
+    targets first; families whose device has no peak entry render
+    utilisation/headroom as ``-`` and sort as full headroom. Ends with
+    the reconciliation verdict: per-family span sums vs the
+    ``null_run_end`` totals, which the model contract says must match
+    *exactly*."""
+    fams = folded.get("families") or {}
+    totals = folded.get("run_totals") or {}
+    runs = folded.get("runs") or []
+    if not fams and not runs:
+        return "roofline: no cost-carrying chunk/superchunk events"
+    latest: dict[str, dict] = {}
+    for r in runs:
+        if isinstance(r.get("family"), str):
+            latest[r["family"]] = r
+    kinds = {str(r.get("device_kind")) for r in runs
+             if r.get("device_kind") is not None}
+    rows = []
+    for fam, a in fams.items():
+        ach = (a["perms"] / a["s"]) if a.get("s") else None
+        r = latest.get(fam, {})
+        sol = r.get("sol_pps")
+        util = (utilisation(ach, float(sol))
+                if isinstance(sol, (int, float)) else None)
+        head = (1.0 - util) if util is not None else None
+        rows.append((fam, a, ach, sol, util, head))
+    rows.sort(key=lambda x: (-(x[5] if x[5] is not None else 1.0), x[0]))
+    lines = [
+        f"roofline: {len(rows)} famil{'y' if len(rows) == 1 else 'ies'}, "
+        f"device kind {'/'.join(sorted(kinds)) or 'unknown'}",
+        f"  {'family':<22} {'spans':>5} {'perms':>9} {'flops':>9} "
+        f"{'bytes':>9} {'pps':>9} {'sol_pps':>9} {'util':>6} {'head':>6}",
+    ]
+    for fam, a, ach, sol, util, head in rows:
+        lines.append(
+            f"  {fam:<22} {a.get('spans', 0):>5} {a.get('perms', 0):>9} "
+            f"{_fmt(a.get('flops')):>9} {_fmt(a.get('bytes_hbm')):>9} "
+            f"{_fmt(ach):>9} {_fmt(sol):>9} "
+            f"{_fmt(util, '.2f'):>6} {_fmt(head, '.2f'):>6}"
+        )
+    if totals:
+        bad = [
+            fam for fam, t in totals.items()
+            if (fams.get(fam, {}).get("flops") != t.get("flops")
+                or fams.get(fam, {}).get("bytes_hbm") != t.get("bytes_hbm"))
+        ]
+        if bad:
+            lines.append(
+                "  RECONCILIATION MISMATCH: span sums != null_run_end "
+                f"totals for {', '.join(sorted(bad))}"
+            )
+        else:
+            lines.append(
+                f"  reconciled: span sums == null_run_end totals for "
+                f"{len(totals)} famil{'y' if len(totals) == 1 else 'ies'}"
+            )
+    return "\n".join(lines)

@@ -82,6 +82,15 @@ def default_path() -> str:
 #: keep the exact PR 5 key order (golden-shape test unchanged).
 COST_VERSION = 1
 
+#: version of the OPTIONAL roofline block a ledger entry may carry
+#: (ISSUE 18): ``{"roofline_v": 1, "roofline": {family, flops_per_perm,
+#: bytes_per_perm, flops, bytes_hbm, device_kind, peak_flops, peak_bw,
+#: sol_pps, achieved_pps, utilisation}}`` appended after the pinned base
+#: keys (and after any ``cost`` block) — the measured speed-of-light
+#: record ``roofline --ledger --check`` gates on. Entries without it keep
+#: the exact PR 5 key order (golden-shape test unchanged).
+ROOFLINE_VERSION = 1
+
 
 def make_entry(
     fingerprint: str,
@@ -96,10 +105,13 @@ def make_entry(
     metric: str | None = None,
     t: float | None = None,
     cost: dict | None = None,
+    roofline: dict | None = None,
 ) -> dict:
     """One ledger line, in pinned key order (golden-shape test); the
-    optional ``cost`` rollup appends ``cost_v``/``cost`` after the base
-    keys so cost-carrying rows extend the schema without disturbing it."""
+    optional ``cost`` rollup appends ``cost_v``/``cost`` and the optional
+    ``roofline`` block appends ``roofline_v``/``roofline`` after the base
+    keys so measurement-carrying rows extend the schema without
+    disturbing it."""
     entry = {
         "perf_v": ENTRY_VERSION,
         "t": float(t) if t is not None else time.time(),
@@ -119,6 +131,9 @@ def make_entry(
     if cost is not None:
         entry["cost_v"] = COST_VERSION
         entry["cost"] = cost
+    if roofline is not None:
+        entry["roofline_v"] = ROOFLINE_VERSION
+        entry["roofline"] = roofline
     return entry
 
 
@@ -171,6 +186,7 @@ def maybe_record_run(
     compile_s: float | None = None,
     n_perm: int | None = None,
     run_id: str | None = None,
+    roofline: dict | None = None,
 ) -> bool:
     """Engine-loop hook: append a run entry when ``NETREP_PERF_LEDGER``
     names a ledger; silently a no-op otherwise (the env-gated contract —
@@ -181,7 +197,7 @@ def maybe_record_run(
     return append_entry(
         make_entry(fingerprint, perms_per_sec, "run", backend=backend,
                    mode=mode, compile_s=compile_s, n_perm=n_perm,
-                   run_id=run_id),
+                   run_id=run_id, roofline=roofline),
         path,
     )
 
@@ -245,6 +261,8 @@ def entry_from_bench_row(row: dict, source: str = "bench",
         mode=mode, run_id=row.get("telemetry"),
         metric=str(row.get("metric"))[:160], round_n=round_n, t=t,
         cost=row.get("cost") if isinstance(row.get("cost"), dict) else None,
+        roofline=(row.get("roofline")
+                  if isinstance(row.get("roofline"), dict) else None),
     )
 
 
@@ -346,6 +364,79 @@ def check(path: str, threshold: float = DEFAULT_THRESHOLD,
     if ratio < 1.0 - threshold:
         return False, (
             f"{body}\nPERF REGRESSION: the newest entry is "
+            f"{(1.0 - ratio) * 100.0:.0f}% below its history's median"
+        )
+    return True, f"{body}\nOK"
+
+
+def _roofline_signal(entry: dict) -> tuple[str, float] | None:
+    """The gauged quantity of a roofline-bearing entry: ``("utilisation",
+    u)`` when the device's speed of light is known, else
+    ``("achieved_pps", pps)`` so CPU mechanism runs (utilisation null —
+    never a guess) still form a checkable history. Returns None for
+    entries without a roofline block or without a positive signal."""
+    rb = entry.get("roofline")
+    if not isinstance(rb, dict):
+        return None
+    util = rb.get("utilisation")
+    if isinstance(util, (int, float)) and util > 0:
+        return "utilisation", float(util)
+    pps = rb.get("achieved_pps")
+    if isinstance(pps, (int, float)) and pps > 0:
+        return "achieved_pps", float(pps)
+    return None
+
+
+def check_roofline(path: str, threshold: float = DEFAULT_THRESHOLD,
+                   window: int = DEFAULT_WINDOW) -> tuple[bool, str]:
+    """Speed-of-light drift gate (ISSUE 18): compare the NEWEST
+    roofline-bearing entry's utilisation against the robust median of the
+    prior roofline entries sharing its fingerprint (most recent
+    ``window``). Same contract shape as :func:`check`:
+
+    - no roofline entries → ok (nothing to judge);
+    - no matching history → ok, noted (baseline);
+    - newest and priors judged on utilisation when the peak table knows
+      the device, on achieved_pps otherwise (CPU/unknown kinds) — priors
+      whose signal kind differs from the newest's are skipped, so a CPU
+      mechanism row never gates against TPU utilisation history;
+    - ratio newest/median < 1 - threshold → **not ok** (CLI exits 2).
+    """
+    entries = [e for e in read_entries(path)
+               if _roofline_signal(e) is not None]
+    if not entries:
+        return True, f"perf ledger {path!r}: no roofline entries"
+    newest = entries[-1]
+    kind, val = _roofline_signal(newest)
+    fp = newest["fingerprint"]
+    fam = (newest.get("roofline") or {}).get("family")
+    priors = []
+    for e in entries[:-1]:
+        if e["fingerprint"] != fp:
+            continue
+        k, v = _roofline_signal(e)
+        if k == kind:
+            priors.append(v)
+    priors = priors[-int(window):]
+    head = (
+        f"newest roofline: {kind}={val:g} "
+        f"[family={fam}] {fp}"
+    )
+    if not priors:
+        return True, (
+            f"{head}\nno prior roofline entries with this fingerprint — "
+            "recorded as the baseline"
+        )
+    med = _median(priors)
+    ratio = val / med if med > 0 else 1.0
+    body = (
+        f"{head}\nhistory: {len(priors)} matching entr"
+        f"{'y' if len(priors) == 1 else 'ies'}, median {kind} {med:g} "
+        f"→ ratio {ratio:.3f} (fail below {1.0 - threshold:.2f})"
+    )
+    if ratio < 1.0 - threshold:
+        return False, (
+            f"{body}\nROOFLINE REGRESSION: the newest entry's {kind} is "
             f"{(1.0 - ratio) * 100.0:.0f}% below its history's median"
         )
     return True, f"{body}\nOK"

@@ -48,6 +48,15 @@ class NullProfile:
     host_bytes: int = 0
     #: per-superchunk records: {"dispatches", "host_bytes", "perms"}
     superchunks: list = dataclasses.field(default_factory=list)
+    #: modeled FLOPs executed (ISSUE 18: fed by the null loops with the
+    #: SAME integers their chunk/superchunk events carry, so per-family
+    #: span sums reconcile with these totals exactly; telemetry-on runs
+    #: only — the cost model is never resolved on the disabled path)
+    flops: int = 0
+    #: modeled HBM bytes touched (same exact-reconciliation contract)
+    cost_bytes: int = 0
+    #: per-program-family rollup: {family: {"flops", "bytes_hbm", "perms"}}
+    families: dict = dataclasses.field(default_factory=dict)
 
     def record_dispatch(self, n: int = 1) -> None:
         self.dispatches += int(n)
@@ -63,12 +72,33 @@ class NullProfile:
             "perms": int(perms),
         })
 
+    def record_cost(self, flops: int, bytes_hbm: int, family: str,
+                    perms: int) -> None:
+        """Fold one chunk/superchunk's modeled cost (the integers its
+        telemetry event carries) into the run totals and the per-family
+        rollup (:mod:`netrep_tpu.utils.costmodel`)."""
+        self.flops += int(flops)
+        self.cost_bytes += int(bytes_hbm)
+        fam = self.families.setdefault(
+            str(family), {"flops": 0, "bytes_hbm": 0, "perms": 0})
+        fam["flops"] += int(flops)
+        fam["bytes_hbm"] += int(bytes_hbm)
+        fam["perms"] += int(perms)
+
     def as_dict(self) -> dict:
-        return {
+        out = {
             "dispatches": self.dispatches,
             "host_bytes": self.host_bytes,
             "superchunks": list(self.superchunks),
         }
+        if self.families:
+            # additive (ISSUE 18): cost keys appear only on telemetry-on
+            # runs that resolved a model, so the PR 2 payload shape is
+            # unchanged everywhere else
+            out["flops"] = self.flops
+            out["bytes_hbm"] = self.cost_bytes
+            out["families"] = {k: dict(v) for k, v in self.families.items()}
+        return out
 
 
 @contextlib.contextmanager

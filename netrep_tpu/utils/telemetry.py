@@ -290,6 +290,7 @@ ENGINE_EVENTS = (
     "module_retired",
     "null_pass_end",
     "rescue_dispatch",
+    "roofline",
     "superchunk",
     "tail_fit",
     "tail_trim_skipped",

@@ -480,6 +480,25 @@ def test_bench_probe_fields_and_perf_ledger(tmp_path):
     probes = [json.loads(l) for l in open(tel)
               if '"backend_probe"' in l]
     assert any(p["data"].get("source") == "bench" for p in probes)
+    # roofline provenance (ISSUE 18): every throughput row carries the
+    # cost fields (None when no engine note was pending — never absent),
+    # and the row that consumed the run's note carries the full block,
+    # which the ledger entry picks up verbatim
+    rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
+            if l.startswith("{")]
+    pps_rows = [r for r in rows
+                if isinstance(r.get("perms_per_sec"), (int, float))]
+    assert pps_rows
+    for r in pps_rows:
+        assert "flops" in r and "bytes_hbm" in r and "utilisation" in r
+    noted = [r for r in pps_rows if isinstance(r.get("roofline"), dict)]
+    assert noted and isinstance(noted[0]["roofline"]["family"], str)
+    assert isinstance(noted[0]["flops"], int)
+    rl_entries = [e for e in perfledger.read_entries(ledger)
+                  if e["source"] == "bench"
+                  and isinstance(e.get("roofline"), dict)]
+    assert rl_entries
+    assert rl_entries[0]["roofline_v"] == perfledger.ROOFLINE_VERSION
 
 
 @pytest.mark.slow

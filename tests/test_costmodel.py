@@ -1,0 +1,396 @@
+"""Roofline observability plane (ISSUE 18): the analytic FLOP/byte
+model vs XLA's own ``cost_analysis()`` within a pinned tolerance per
+program family, EXACT per-family reconciliation (chunk-span sums ==
+NullProfile totals == ``null_run_end`` totals — the same integers, no
+float re-derivation), peak-table / override semantics (unknown kinds
+report utilisation as null, never a guess), the last-run note seam, the
+``roofline`` CLI (headroom table render + ledger drift gate with exit 2
+on a synthetic utilisation degrade), and the registry pin that keeps the
+ISSUE 12 telemetry-registry lint package-clean."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from netrep_tpu.data import make_mixed_pair
+from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+from netrep_tpu.utils import costmodel as cm
+from netrep_tpu.utils import perfledger as pl
+from netrep_tpu.utils.config import EngineConfig
+from netrep_tpu.utils.profiling import NullProfile
+from netrep_tpu.utils.telemetry import KNOWN_EVENTS, Telemetry, read_events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_PERM = 96
+
+
+@pytest.fixture(scope="module")
+def mixed():
+    return make_mixed_pair(200, 4, n_samples=24, seed=7)
+
+
+def _engine(mixed, **cfg_kw):
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    cfg_kw.setdefault("chunk_size", 32)
+    cfg_kw.setdefault("summary_method", "power")
+    cfg_kw.setdefault("autotune", False)
+    if cfg_kw.pop("data_only", False):
+        cfg = EngineConfig(network_from_correlation=6.0, **cfg_kw)
+        return PermutationEngine(None, None, dd, None, None, td, specs,
+                                 mixed["pool"], config=cfg)
+    cfg = EngineConfig(**cfg_kw)
+    return PermutationEngine(dc, dn, dd, tc, tn, td, specs, mixed["pool"],
+                             config=cfg)
+
+
+# ---------------------------------------------------------------------------
+# peak table / overrides: null, never a guess
+# ---------------------------------------------------------------------------
+
+def test_peak_table_known_kinds_and_unknown_null(monkeypatch):
+    monkeypatch.delenv(cm.PEAK_OVERRIDES_ENV, raising=False)
+    pf, pb = cm.device_peaks("TPU v4")  # normalized lowercase
+    assert pf == 275e12 and pb == 1228e9
+    # CPU and unknown kinds are deliberately absent: utilisation must
+    # come back null, never a guessed number
+    assert cm.device_peaks("cpu") is None
+    assert cm.device_peaks("unknown") is None
+    assert cm.utilisation(100.0, None) is None
+    assert cm.sol_pps(10, 10, None) is None
+
+
+def test_peak_overrides_env_wins_and_bad_json_ignored(monkeypatch):
+    monkeypatch.setenv(cm.PEAK_OVERRIDES_ENV,
+                       json.dumps({"cpu": [50e9, 10e9],
+                                   "tpu v4": {"flops": 1e12, "bw": 1e11}}))
+    assert cm.device_peaks("cpu") == (50e9, 10e9)
+    assert cm.device_peaks("tpu v4") == (1e12, 1e11)  # override beats table
+    monkeypatch.setenv(cm.PEAK_OVERRIDES_ENV, "{not json")
+    assert cm.device_peaks("cpu") is None  # degrades to the table, warns
+
+
+def test_sol_and_utilisation_roofline_math():
+    # compute-bound: 1e9 flops/perm at 1e12 flops/s -> 1ms/perm
+    assert cm.sol_pps(10**9, 10**3, (1e12, 1e9)) == pytest.approx(1000.0)
+    # memory-bound: 1e6 bytes/perm at 1e9 B/s dominates 1e6 flops @ 1e12
+    assert cm.sol_pps(10**6, 10**6, (1e12, 1e9)) == pytest.approx(1000.0)
+    assert cm.utilisation(500.0, 1000.0) == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# the analytic model: families, integers, XLA cross-check
+# ---------------------------------------------------------------------------
+
+def test_resolve_engine_cost_families_and_integers(mixed):
+    for kw, family in ((dict(gather_mode="direct"), "direct"),
+                       (dict(gather_mode="mxu"), "mxu"),
+                       (dict(data_only=True), "data-only")):
+        cost = cm.resolve_engine_cost(_engine(mixed, **kw))
+        assert cost is not None and cost.family == family
+        assert isinstance(cost.flops_per_perm, int)
+        assert isinstance(cost.bytes_per_perm, int)
+        assert cost.flops_per_perm > 0 and cost.bytes_per_perm > 0
+        # the scan-once XLA equivalent never exceeds the executed count
+        assert 0 < cost.xla_flops_per_perm <= cost.flops_per_perm
+    # an object without the bucket structure (native tier): None, never
+    # a guessed cost
+    assert cm.resolve_engine_cost(object()) is None
+
+
+def test_analytic_model_vs_xla_cost_analysis(mixed):
+    """The acceptance cross-check: per program family, the analytic
+    model's scan-once flop count agrees with ``Compiled.cost_analysis()``
+    within a pinned ratio tolerance on a small shape (measured 0.81-0.98
+    on the installed jax; [0.6, 1.5] leaves drift margin while still
+    catching an order-of-magnitude modeling error). Byte traffic is a
+    deliberate LOWER bound: the model prices fundamental gather/slice
+    movement, XLA's ``bytes accessed`` counts every intermediate."""
+    import jax
+
+    for kw in (dict(gather_mode="direct"), dict(gather_mode="mxu"),
+               dict(data_only=True)):
+        eng = _engine(mixed, chunk_size=16, **kw)
+        cost = cm.resolve_engine_cost(eng)
+        K = 16
+        keys = eng.perm_keys(eng._example_run_key(), 0, K)
+        compiled = jax.jit(eng.chunk_body()).lower(
+            keys, *eng.chunk_args()
+        ).compile()
+        ca = cm.xla_cost_analysis(compiled)
+        if ca is None or not ca.get("flops"):
+            pytest.skip("installed jax exposes no cost_analysis()")
+        ratio = (cost.xla_flops_per_perm * K) / ca["flops"]
+        assert 0.6 < ratio < 1.5, (cost.family, ratio)
+        if ca.get("bytes_accessed"):
+            assert cost.bytes_per_perm * K <= ca["bytes_accessed"], \
+                cost.family
+        ma = cm.xla_memory_analysis(compiled)
+        if ma is not None:
+            assert ma["argument_size_in_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: spans carry cost fields; sums reconcile EXACTLY
+# ---------------------------------------------------------------------------
+
+def _run_with_telemetry(eng, path, streaming=False):
+    tel = Telemetry(path, run_id="roofline")
+    prof = NullProfile()
+    if streaming:
+        observed = np.asarray(eng.observed())
+        eng.run_null_streaming(N_PERM, observed, key=0, profile=prof,
+                               telemetry=tel)
+    else:
+        eng.run_null(N_PERM, key=0, profile=prof, telemetry=tel)
+    tel.close()
+    return prof, list(read_events(str(path)))
+
+
+@pytest.mark.parametrize("streaming", [False, True],
+                         ids=["materialized", "streaming"])
+def test_span_sums_reconcile_exactly_with_profile(mixed, tmp_path,
+                                                  streaming):
+    eng = _engine(mixed, superchunk=2)
+    prof, events = _run_with_telemetry(
+        eng, tmp_path / f"run{int(streaming)}.jsonl", streaming=streaming
+    )
+    spans = [e["data"] for e in events
+             if e["ev"] in ("chunk", "superchunk")]
+    assert spans, "no chunk/superchunk spans emitted"
+    for d in spans:
+        # every span carries the cost fields (acceptance criterion)
+        assert isinstance(d["family"], str)
+        assert isinstance(d["flops"], int) and d["flops"] > 0
+        assert isinstance(d["bytes_hbm"], int) and d["bytes_hbm"] > 0
+        assert d["achieved_pps"] is None or d["achieved_pps"] > 0
+        assert "utilisation" in d  # null on CPU — present, never absent
+    # EXACT reconciliation: span sums == NullProfile totals == run totals
+    span_f = sum(d["flops"] for d in spans)
+    span_b = sum(d["bytes_hbm"] for d in spans)
+    assert span_f == prof.flops
+    assert span_b == prof.cost_bytes
+    fam = spans[0]["family"]
+    assert prof.families[fam]["flops"] == span_f
+    assert prof.families[fam]["bytes_hbm"] == span_b
+    assert prof.families[fam]["perms"] == N_PERM
+    ends = [e["data"] for e in events if e["ev"] == "null_run_end"]
+    assert ends and ends[0]["flops"] == span_f
+    assert ends[0]["bytes_hbm"] == span_b
+    # the profile payload carries the rollup (additive — only when used)
+    d = prof.as_dict()
+    assert d["flops"] == span_f and d["families"][fam]["perms"] == N_PERM
+
+
+def test_roofline_event_and_last_run_note(mixed, tmp_path):
+    eng = _engine(mixed)
+    cm.record_run_note({"stale": True})
+    _, events = _run_with_telemetry(eng, tmp_path / "note.jsonl")
+    rl = [e["data"] for e in events if e["ev"] == "roofline"]
+    assert len(rl) == 1
+    d = rl[0]
+    for k in ("family", "flops_per_perm", "bytes_per_perm", "flops",
+              "bytes_hbm", "device_kind", "peak_flops", "peak_bw",
+              "sol_pps", "achieved_pps", "utilisation"):
+        assert k in d
+    assert d["achieved_pps"] > 0
+    # CPU tier-1: no peak entry -> utilisation null, never a guess
+    assert d["utilisation"] is None and d["peak_flops"] is None
+    # the run replaced the stale note; bench rows CONSUME it
+    note = cm.last_run_note(consume=True)
+    assert note is not None and note["family"] == d["family"]
+    assert cm.last_run_note() is None  # consumed — stale never leaks
+
+
+def test_fold_and_render_roofline(mixed, tmp_path):
+    eng = _engine(mixed)
+    _, events = _run_with_telemetry(eng, tmp_path / "fold.jsonl")
+    folded = cm.fold_roofline_events(events)
+    fam = next(iter(folded["families"]))
+    assert folded["families"][fam]["perms"] == N_PERM
+    assert folded["run_totals"][fam]["flops"] == \
+        folded["families"][fam]["flops"]
+    assert len(folded["runs"]) == 1
+    out = cm.render_roofline(folded)
+    assert fam in out and "reconciled" in out
+    # a tampered total renders the mismatch loudly
+    bad = dict(folded, run_totals={fam: {"flops": 1, "bytes_hbm": 1}})
+    assert "RECONCILIATION MISMATCH" in cm.render_roofline(bad)
+    assert "no cost-carrying" in cm.render_roofline(
+        {"families": {}, "run_totals": {}, "runs": []}
+    )
+
+
+def test_known_events_include_roofline():
+    # ISSUE 12 registry lint stays package-clean: the new event name is
+    # registered, so an emit() of it is never a lint finding
+    assert "roofline" in KNOWN_EVENTS
+
+
+def test_utilisation_gauged_under_cpu_peak_override(mixed, tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv(cm.PEAK_OVERRIDES_ENV,
+                       json.dumps({"cpu": [50e9, 10e9]}))
+    eng = _engine(mixed)
+    _, events = _run_with_telemetry(eng, tmp_path / "util.jsonl")
+    rl = [e["data"] for e in events if e["ev"] == "roofline"]
+    assert rl and isinstance(rl[0]["utilisation"], float)
+    assert rl[0]["utilisation"] > 0
+    spans = [e["data"] for e in events if e["ev"] == "chunk"]
+    assert any(isinstance(d["utilisation"], float) for d in spans)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the CLI — headroom table render, drift gate exit codes
+# ---------------------------------------------------------------------------
+
+def _cli(args, **env):
+    return subprocess.run(
+        [sys.executable, "-m", "netrep_tpu", "roofline", *args],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", **env},
+    )
+
+
+def test_roofline_cli_acceptance(mixed, tmp_path):
+    """The acceptance flow end to end: a telemetry-enabled CPU run's
+    JSONL renders the headroom table; `--ledger --check` passes on the
+    ingested history (baseline) but exits 2 on a synthetic utilisation
+    degrade."""
+    eng = _engine(mixed)
+    run_path = tmp_path / "run.jsonl"
+    ledger = str(tmp_path / "ledger.jsonl")
+    os.environ["NETREP_PERF_LEDGER"] = ledger
+    try:
+        _run_with_telemetry(eng, run_path)
+    finally:
+        os.environ.pop("NETREP_PERF_LEDGER", None)
+    # table render from the run JSONL
+    r = _cli([str(run_path)])
+    assert r.returncode == 0, r.stderr
+    assert "roofline:" in r.stdout and "reconciled" in r.stdout
+    # the engine run left a roofline-bearing ledger entry -> baseline OK
+    entries = pl.read_entries(ledger)
+    assert entries and entries[-1]["roofline_v"] == pl.ROOFLINE_VERSION
+    r = _cli(["--ledger", ledger, "--check"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "baseline" in r.stdout
+    # synthetic degrade: same fingerprint, signal 10x lower -> exit 2
+    e = dict(entries[-1])
+    rb = dict(e["roofline"])
+    key = "utilisation" if rb.get("utilisation") else "achieved_pps"
+    rb[key] = rb[key] / 10.0
+    e["roofline"] = rb
+    with open(ledger, "a") as f:
+        f.write(json.dumps(e) + "\n")
+    r = _cli(["--ledger", ledger, "--check"])
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "ROOFLINE REGRESSION" in r.stdout
+    # no inputs at all: usage error, not a silent success
+    assert _cli([]).returncode == 1
+
+
+# ---------------------------------------------------------------------------
+# ledger block + drift gate unit surface
+# ---------------------------------------------------------------------------
+
+def _rl_entry(util=None, pps=100.0, fp="cpu|direct|x", kind="cpu"):
+    return pl.make_entry(
+        fp, pps, "run", backend="cpu", mode="materialized", t=0.0,
+        roofline={"family": "direct", "flops_per_perm": 10,
+                  "bytes_per_perm": 4, "flops": 1000, "bytes_hbm": 400,
+                  "device_kind": kind, "peak_flops": None, "peak_bw": None,
+                  "sol_pps": None, "achieved_pps": pps,
+                  "utilisation": util},
+    )
+
+
+def test_ledger_roofline_block_appends_after_pinned_keys():
+    # the PR 13 cost_v pattern: base key order untouched, the roofline
+    # block appended after — golden-shape consumers never see a shift
+    base = pl.make_entry("fp", 1.0, "run", t=0.0)
+    e = _rl_entry()
+    assert list(e)[:len(list(base))] == list(base)
+    assert list(e)[-2:] == ["roofline_v", "roofline"]
+    assert e["roofline_v"] == pl.ROOFLINE_VERSION == 1
+
+
+def test_check_roofline_gate_and_signal_kind_separation(tmp_path):
+    path = str(tmp_path / "led.jsonl")
+    # empty ledger: nothing to judge
+    open(path, "w").close()
+    ok, rep = pl.check_roofline(path)
+    assert ok and "no roofline entries" in rep
+    # pps-gauged history (CPU: utilisation null), steady then degraded
+    for pps in (100.0, 110.0, 95.0):
+        pl.append_entry(_rl_entry(pps=pps), path)
+    ok, rep = pl.check_roofline(path)
+    assert ok
+    pl.append_entry(_rl_entry(pps=10.0), path)
+    ok, rep = pl.check_roofline(path)
+    assert not ok and "ROOFLINE REGRESSION" in rep
+    # a utilisation-gauged entry (device now known) must NOT be judged
+    # against the pps history — different signal kind, new baseline
+    pl.append_entry(_rl_entry(util=0.4, kind="tpu v4"), path)
+    ok, rep = pl.check_roofline(path)
+    assert ok and "baseline" in rep
+    pl.append_entry(_rl_entry(util=0.38, kind="tpu v4"), path)
+    ok, _ = pl.check_roofline(path)
+    assert ok
+    pl.append_entry(_rl_entry(util=0.04, kind="tpu v4"), path)
+    ok, rep = pl.check_roofline(path)
+    assert not ok and "utilisation" in rep
+
+
+def test_serve_replica_util_column_and_note_peek():
+    """The serve plane's utilisation gauge: `top` renders a `util`
+    column from replica rows (``-`` until a run lands or when the
+    device kind has no peak entry), and the scheduler reads the last-run
+    note with PEEK semantics — ``stats()`` is polled, so the note must
+    survive repeated reads (bench rows are the consuming reader)."""
+    from netrep_tpu.serve.scheduler import PreservationServer
+    from netrep_tpu.serve.top import render, render_replica_table, snapshot
+
+    snap = snapshot({
+        "uptime_s": 1.0, "accepting": True, "brownout": False,
+        "queue_depth": 0, "done": 0, "tenants": {},
+        "replicas": {
+            "r0": {"alive": True, "queue_depth": 0, "backlog_perms": 0,
+                   "rate_pps": 100.0, "utilisation": 0.42, "packs": 1,
+                   "done": 2},
+            "r1": {"alive": True, "queue_depth": 0, "backlog_perms": 0,
+                   "rate_pps": 50.0, "utilisation": None, "packs": 0,
+                   "done": 0},
+        },
+    })
+    assert [r["utilisation"] for r in snap["replicas"]] == [0.42, None]
+    table = render_replica_table(snap["replicas"])
+    assert "util" in table.splitlines()[0]
+    r0_line, r1_line = table.splitlines()[1:3]
+    assert "0.42" in r0_line
+    assert " - " in r1_line  # null, never a guess
+    assert "util" in render(snap)
+    # the scheduler's note seam: peek leaves the note in place
+    cm.record_run_note({"family": "direct", "achieved_pps": 123.0,
+                        "utilisation": None})
+    try:
+        assert PreservationServer._roofline_note()["achieved_pps"] == 123.0
+        assert PreservationServer._roofline_note() is not None  # still there
+    finally:
+        cm.last_run_note(consume=True)
+
+
+def test_entry_from_bench_row_carries_roofline():
+    row = {"metric": "north", "perms_per_sec": 50.0, "device": "TPU v4",
+           "roofline": {"family": "mxu", "utilisation": 0.3}}
+    e = pl.entry_from_bench_row(row)
+    assert e is not None and e["roofline"]["family"] == "mxu"
+    assert pl.entry_from_bench_row(
+        {"metric": "north", "perms_per_sec": 50.0, "device": "TPU v4",
+         "roofline": "bogus"}
+    ).get("roofline") is None

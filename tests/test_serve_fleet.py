@@ -210,6 +210,9 @@ def test_fleet_routes_deterministically_and_serves_bit_identical(
     served_on = [rid for rid, row in st["replicas"].items()
                  if row.get("packs")]
     assert served_on == [home.rid]
+    # every live replica row carries the roofline gauge (ISSUE 18) —
+    # None on device kinds without a peak-table entry, never absent
+    assert all("utilisation" in row for row in st["replicas"].values())
     # the top dashboard renders the per-replica section from these stats
     from netrep_tpu.serve.top import render, snapshot
 

@@ -356,3 +356,42 @@ def test_grid_rows_classified_and_rendered(tmp_path):
     # section — both visible, neither misattributed
     assert "BASELINE.md table snippet" in proc.stdout
     assert "TPU v5 lite" in proc.stdout
+
+
+def test_roofline_section_mechanism_vs_measurement(tmp_path):
+    """ISSUE 18: roofline telemetry events get their own section, with
+    the safety-critical split — a CPU/no-peak-entry row (utilisation
+    null) is a MECHANISM check of the cost accounting, never a TPU
+    measurement; only utilisation-bearing rows read as the measured
+    roofline story."""
+    measured = _tel_event(
+        "roofline", family="mxu", device_kind="tpu v4", utilisation=0.31,
+        achieved_pps=5100.0, sol_pps=16400.0, flops_per_perm=1898752,
+        bytes_per_perm=45056, flops=10, bytes_hbm=4,
+        peak_flops=275e12, peak_bw=1228e9,
+    )
+    mech = _tel_event(
+        "roofline", family="direct", device_kind="cpu", utilisation=None,
+        achieved_pps=800.0, sol_pps=None, flops_per_perm=1898752,
+        bytes_per_perm=45056, flops=10, bytes_hbm=4,
+        peak_flops=None, peak_bw=None,
+    )
+    lines = summarize_watch.roofline_lines([measured, mech])
+    m_line = [ln for ln in lines if ln.startswith("mxu")][0]
+    c_line = [ln for ln in lines if ln.startswith("direct")][0]
+    assert "utilisation 0.31 of speed of light" in m_line
+    assert "MECHANISM" not in m_line
+    assert "MECHANISM row" in c_line
+    assert "never transcribe as a TPU measurement" in c_line
+    # both classify as telemetry (never unknown-provenance measurements)
+    assert classify(measured) == "telemetry"
+    # end-to-end: the section renders above the per-phase split
+    log = tmp_path / "watch.jsonl"
+    log.write_text(json.dumps(measured) + "\n" + json.dumps(mech) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "benchmarks/summarize_watch.py", str(log)],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "## roofline (achieved vs speed of light, 2 run(s))" \
+        in proc.stdout

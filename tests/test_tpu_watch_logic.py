@@ -305,3 +305,49 @@ def test_mixed_step_opt_in_joins_production_queue(tmp_path):
             assert "configMixed PASS" in state_text
         else:
             assert "configMixed" not in log_text + state_text
+
+
+def test_roofline_check_off_under_queue_hook_and_loud_never_fatal(tmp_path):
+    """ISSUE 18: the per-cycle roofline drift gate is off by default and
+    under the QUEUE_FILE hook (auto), skips silently on an empty ledger,
+    and — forced on over a non-empty ledger with a failing check —
+    banners the drift LOUDLY but never fails the cycle."""
+    ledger = tmp_path / "perf_ledger.jsonl"
+    ledger.write_text('{"perf_v": 1}\n')
+    # default off / auto under QUEUE_FILE: no roofline banner
+    proc, _, log = run_watch(
+        tmp_path, ["one 30 echo ok-one"],
+        extra_env={"PERF_LEDGER": str(ledger)},
+    )
+    assert proc.returncode == 0
+    assert "roofline check" not in log
+    proc_a, _, log_a = run_watch(
+        tmp_path, ["oneauto 30 echo ok-one"], tag="rfauto",
+        extra_env={"ROOFLINE_CHECK": "auto", "PERF_LEDGER": str(ledger)},
+    )
+    assert proc_a.returncode == 0
+    assert "roofline check" not in log_a
+    # forced on but the cycle produced no ledger yet: silent skip
+    proc_e, _, log_e = run_watch(
+        tmp_path, ["oneempty 30 echo ok-one"], tag="rfempty",
+        extra_env={"ROOFLINE_CHECK": "1",
+                   "PERF_LEDGER": str(tmp_path / "empty_ledger.jsonl")},
+    )
+    assert proc_e.returncode == 0
+    assert "roofline check" not in log_e
+    # forced on over a non-empty ledger with a python shim that fails the
+    # drift gate: the banner appears and the cycle still completes
+    shim_dir = tmp_path / "bin"
+    shim_dir.mkdir()
+    shim = shim_dir / "python"
+    shim.write_text("#!/bin/sh\nexit 2\n")
+    shim.chmod(0o755)
+    proc2, _, log2 = run_watch(
+        tmp_path, ["two 30 echo ok-two"], tag="rf",
+        extra_env={"ROOFLINE_CHECK": "1", "PERF_LEDGER": str(ledger),
+                   "PATH": f"{shim_dir}:{os.environ['PATH']}"},
+    )
+    assert proc2.returncode == 0, proc2.stderr
+    assert "roofline check" in log2
+    assert "ROOFLINE DRIFT" in log2
+    assert "queue drained" in log2
