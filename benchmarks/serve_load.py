@@ -854,6 +854,241 @@ def run_fleet(args) -> int:
     return 0
 
 
+def _replica_seconds(tel_path) -> float:
+    """Total replica-up seconds billed from the coordinator's
+    ``replica_state`` stream: each replica is billed from its ``ready``
+    transition to its ``dead`` one (close transitions every survivor to
+    dead, so nothing is left unbilled). The autoscale row's
+    replica-hours metric."""
+    ready: dict[str, float] = {}
+    total = 0.0
+    try:
+        with open(tel_path, encoding="utf-8") as f:
+            for line in f:
+                if '"replica_state"' not in line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if e.get("ev") != "replica_state":
+                    continue
+                d = e.get("data", {})
+                rid, t = d.get("replica"), float(e.get("t", 0.0))
+                if d.get("to") == "ready":
+                    ready[rid] = t
+                elif d.get("to") == "dead" and rid in ready:
+                    total += t - ready.pop(rid)
+    except OSError:
+        pass
+    return total
+
+
+def run_autoscale(args) -> int:
+    """Autoscale scenario (ISSUE 19): the SAME square-wave arrival trace
+    driven through (a) a static fleet of the peak size and (b) an
+    autoscaled fleet (min 1, max peak) with N forced eviction notices
+    landing mid-trace. One ``serve-autoscale`` row reports p99 for both
+    fleets, the replica-seconds each consumed (billed ready→dead from
+    the lifecycle telemetry), and the zero-lost-requests count across
+    the evictions. Parity is asserted in-bench (one served result per
+    tenant vs its direct call) before any number is emitted; the row's
+    ``ok`` requires zero lost requests, every forced eviction performed,
+    and measurably fewer replica-seconds than the static fleet."""
+    import tempfile as _tf
+
+    from netrep_tpu import module_preservation
+    from netrep_tpu.serve import FleetConfig, ServeConfig, build_inprocess_fleet
+    from netrep_tpu.serve.fleet import Autoscaler, AutoscaleConfig, inprocess_spawner
+    from netrep_tpu.utils.config import EngineConfig
+
+    import jax
+
+    device = str(jax.devices()[0])
+    cfg = EngineConfig(chunk_size=args.chunk, autotune=False)
+    tenants, requests = build_workload(args)
+    peak = max(2, int(args.autoscale_peak))
+    evictions_target = max(0, int(args.evictions))
+
+    # square-wave arrivals: bursts of back-to-back submissions separated
+    # by idle gaps — the 10x traffic swing in miniature
+    cycles = 2
+    per = max(1, len(requests) // cycles)
+    burst_gap = 1.0 / float(args.burst_rate)
+    quiet_s = float(args.quiet_s)
+    offsets = []
+    for i in range(len(requests)):
+        cyc, j = divmod(i, per)
+        offsets.append(cyc * (per * burst_gap + quiet_s) + j * burst_gap)
+    trace_s = offsets[-1] + quiet_s
+
+    def boot(n, tag, autoscale):
+        tmp = _tf.mkdtemp(prefix=f"netrep_autoscale_{tag}_")
+        tel = os.path.join(tmp, "coord_tel.jsonl")
+        fdir = os.path.join(tmp, "fleet")
+
+        def mk(rid, jpath, ckpt):
+            return ServeConfig(
+                engine=cfg, journal=jpath, checkpoint_dir=ckpt,
+                checkpoint_every=args.chunk, max_pack=args.max_pack,
+                pool_size=args.pool_size, pack_window_s=0.1,
+                fleet_label=rid,
+            )
+
+        fleet = build_inprocess_fleet(
+            n, fdir, make_config=mk,
+            fleet_config=FleetConfig(telemetry=tel, heartbeat_s=0.25,
+                                     rate_pps=200.0),
+        )
+        for name, spec in tenants.items():
+            fleet.register_tenant(name, spec["weight"])
+            mixed, assign = spec["fixture"]
+            (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+            fleet.register_dataset(name, "d", network=dn, correlation=dc,
+                                   data=dd, assignments=assign)
+            fleet.register_dataset(name, "t", network=tn, correlation=tc,
+                                   data=td)
+        scaler = None
+        if autoscale:
+            scaler = Autoscaler(
+                fleet, inprocess_spawner(fdir, make_config=mk),
+                AutoscaleConfig(
+                    scale_up_drain_s=0.5, scale_down_idle_s=0.75,
+                    min_replicas=1, max_replicas=peak,
+                    cooldown_s=0.25, tick_s=0.05,
+                ),
+            )
+        return fleet, tel, scaler
+
+    def drive(fleet, evict=0):
+        results, lats, errors, evicted = [], [], [], []
+        lock = threading.Lock()
+        t0 = time.perf_counter()
+
+        def worker(r, offset):
+            delay = offset - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                res = fleet.analyze(
+                    r["tenant"], "d", "t", n_perm=r["n_perm"],
+                    seed=r["seed"], adaptive=r["adaptive"], timeout=1200,
+                )
+            except Exception as e:  # surfaced after join
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                return
+            with lock:
+                results.append((r, res))
+                lats.append(res["latency_s"])
+
+        def evictor(at_s):
+            delay = at_s - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            # evict the newest live replica — revoked capacity does not
+            # get to choose a convenient victim, but the notice makes
+            # the departure a handoff either way
+            deadline = time.perf_counter() + trace_s
+            while time.perf_counter() < deadline:
+                live = sorted(fleet.live_replicas())
+                if live:
+                    out = fleet.evict_notice(live[-1], grace_s=30.0)
+                    if out is not None:
+                        with lock:
+                            evicted.append(out["replica"])
+                        return
+                time.sleep(0.05)
+
+        threads = [
+            threading.Thread(target=worker, args=(r, off), daemon=True)
+            for r, off in zip(requests, offsets)
+        ]
+        threads += [
+            threading.Thread(target=evictor,
+                             args=(trace_s * (0.25 + 0.35 * k),),
+                             daemon=True)
+            for k in range(evict)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError("autoscale worker failed: " + errors[0])
+        return wall, results, lats, evicted
+
+    # static reference: the peak-size fleet for the whole trace
+    fleet_s, tel_s, _ = boot(peak, "static", autoscale=False)
+    try:
+        wall_s, results_s, lats_s, _ev = drive(fleet_s, evict=0)
+    finally:
+        fleet_s.close()
+
+    # autoscaled run: min 1 / max peak, forced evictions mid-trace
+    fleet_a, tel_a, scaler = boot(1, "auto", autoscale=True)
+    try:
+        wall_a, results_a, lats_a, evicted = drive(
+            fleet_a, evict=evictions_target)
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        fleet_a.close()
+
+    # parity gate before any number: one served result per tenant from
+    # the AUTOSCALED run (the one that survived evictions) vs direct
+    for name in tenants:
+        r0 = next(r for r in requests if r["tenant"] == name)
+        mixed, assign = tenants[name]["fixture"]
+        (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+        d = module_preservation(
+            network={"d": dn, "t": tn}, correlation={"d": dc, "t": tc},
+            data={"d": dd, "t": td}, module_assignments=assign,
+            discovery="d", test="t", n_perm=r0["n_perm"], seed=r0["seed"],
+            adaptive=r0["adaptive"], config=cfg,
+        )
+        served = next(res for r, res in results_a
+                      if r["tenant"] == name and r["seed"] == r0["seed"])
+        assert np.array_equal(served["p_values"], np.asarray(d.p_values)), \
+            f"autoscaled/direct p-value mismatch (tenant {name})"
+
+    rs_static = _replica_seconds(tel_s)
+    rs_auto = _replica_seconds(tel_a)
+    p99_s = float(np.percentile(lats_s, 99))
+    p99_a = float(np.percentile(lats_a, 99))
+    lost = len(requests) - len(results_a)
+    ok = (lost == 0 and len(evicted) == evictions_target
+          and rs_auto < rs_static)
+    emit({
+        "metric": (
+            f"serve-autoscale square-wave min1/max{peak} "
+            f"({len(requests)} req, {evictions_target} evictions, "
+            f"chunk {args.chunk})"
+        ),
+        "value": round(wall_a, 3),
+        "unit": "s",
+        "requests": len(requests),
+        "lost_requests": lost,
+        "evictions": len(evicted),
+        "evicted": evicted,
+        "p99_ms": round(1000 * p99_a, 1),
+        "p99_static_ms": round(1000 * p99_s, 1),
+        "p99_vs_static": (round(p99_a / p99_s, 3) if p99_s > 0
+                          else None),
+        "p99_within_2x": bool(p99_a <= 2.0 * p99_s),
+        "replica_seconds": round(rs_auto, 3),
+        "replica_seconds_static": round(rs_static, 3),
+        "replica_seconds_saved": round(rs_static - rs_auto, 3),
+        "static_wall_s": round(wall_s, 3),
+        "peak_replicas": peak,
+        "ok": bool(ok),
+        "device": device,
+        "chunk": args.chunk,
+    })
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -890,6 +1125,28 @@ def main() -> int:
                          "reports p50/p99, failover time, and aggregate "
                          "perms/s vs 1 replica (rows labeled serve-fleet "
                          "in the perf ledger)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="autoscale scenario instead of the load run "
+                         "(ISSUE 19): a square-wave arrival trace "
+                         "through an autoscaled fleet (min 1, max "
+                         "--autoscale-peak) vs a static fleet of the "
+                         "peak size, with --evictions forced eviction "
+                         "notices mid-trace; the row (labeled "
+                         "serve-autoscale) reports p99 vs static, "
+                         "replica-seconds consumed, and zero lost "
+                         "requests")
+    ap.add_argument("--autoscale-peak", type=int, default=3,
+                    help="[--autoscale] static fleet size and the "
+                         "autoscaler's max_replicas")
+    ap.add_argument("--evictions", type=int, default=2,
+                    help="[--autoscale] forced eviction notices during "
+                         "the autoscaled trace")
+    ap.add_argument("--burst-rate", type=float, default=12.0,
+                    help="[--autoscale] arrival rate inside a burst, "
+                         "req/s")
+    ap.add_argument("--quiet-s", type=float, default=None,
+                    help="[--autoscale] idle gap between bursts "
+                         "(default 1.5; smoke 1.0)")
     ap.add_argument("--warmstart", action="store_true",
                     help="warm-start scenario instead of the load run "
                          "(ISSUE 15): cold fresh-process first-request "
@@ -913,6 +1170,8 @@ def main() -> int:
     for k, v in small_defaults.items():
         if getattr(args, k) is None:
             setattr(args, k, v)
+    if args.quiet_s is None:
+        args.quiet_s = 1.0 if args.smoke else 1.5
 
     from netrep_tpu.utils.backend import (
         enable_persistent_cache, resolve_backend_or_cpu,
@@ -928,6 +1187,8 @@ def main() -> int:
         return run_kill_recover(args)
     if args.fleet:
         return run_fleet(args)
+    if args.autoscale:
+        return run_autoscale(args)
     if args.warmstart:
         return run_warmstart(args)
 

@@ -101,6 +101,16 @@ def classify(row: dict) -> str:
         # latency signal (CPU by design), never a BASELINE measurement
         return "serve-warmstart"
     if ((isinstance(row.get("metric"), str)
+         and row["metric"].startswith("serve-autoscale"))
+            or "evicted_replica" in row):
+        # autoscale / noticed-eviction rows (ISSUE 19): the serve_load
+        # --autoscale square-wave row and the chaos --fleet --evict
+        # handoff summary — checked BEFORE the serve-fleet classifier
+        # below so an eviction verdict never folds into the
+        # kill-failover story. Robustness signals (CPU by design),
+        # never BASELINE measurements.
+        return "serve-autoscale"
+    if ((isinstance(row.get("metric"), str)
          and row["metric"].startswith("serve-fleet"))
             or "killed_replica" in row):
         # fleet drill rows (ISSUE 14): the serve_load --fleet
@@ -314,6 +324,41 @@ def fleet_lines(rows: list[dict]) -> list[str]:
     return lines
 
 
+def autoscale_lines(rows: list[dict]) -> list[str]:
+    """Autoscale section (ISSUE 19): the newest square-wave load row —
+    autoscaled p99 vs the static peak fleet, the replica-seconds each
+    consumed, and the zero-lost gate across forced evictions — plus the
+    newest ``chaos --fleet --evict`` verdict (zero recomputed packs =
+    ``evict_handoff_done`` on the timeline with no ``failover_start``).
+    The elastic-fleet health story in two lines."""
+    lines = []
+    loads = [r for r in rows if "replica_seconds" in r]
+    if loads:
+        r = loads[-1]
+        lines.append(
+            f"{r['metric']}: {r.get('value')}{r.get('unit', '')} · "
+            f"p99={r.get('p99_ms')}ms vs static {r.get('p99_static_ms')}ms "
+            f"(within_2x={r.get('p99_within_2x')}) · "
+            f"replica_s={r.get('replica_seconds')} vs static "
+            f"{r.get('replica_seconds_static')} "
+            f"(saved={r.get('replica_seconds_saved')}) · "
+            f"lost={r.get('lost_requests')} "
+            f"evictions={r.get('evictions')}"
+        )
+    drills = [r for r in rows if "evicted_replica" in r]
+    if drills:
+        r = drills[-1]
+        verdict = "PASSED" if r.get("ok") else "FAILED"
+        lines.append(
+            f"chaos --fleet --evict {verdict}: "
+            f"evicted={r.get('evicted_replica')} "
+            f"zero_recompute={r.get('zero_recompute')} "
+            f"bit_identical={r.get('bit_identical')} "
+            f"({len(drills)} drill(s) total)"
+        )
+    return lines
+
+
 def mixed_lines(rows: list[dict]) -> list[str]:
     """Mixed-precision screening section (ISSUE 16): the newest
     bf16-screened null mechanism row — rescued fraction, wall-clock ratio
@@ -399,6 +444,7 @@ def main(paths: list[str]) -> int:
     results, unknown, other, dropped, telemetry = [], [], [], 0, []
     ledger, lint, serve_cost, serve_top = [], [], [], []
     fleet = []
+    autoscale = []
     warmstart = []
     mixed = []
     grid = []
@@ -425,6 +471,8 @@ def main(paths: list[str]) -> int:
                 serve_top.append(r)
             elif kind == "serve-fleet":
                 fleet.append(r)
+            elif kind == "serve-autoscale":
+                autoscale.append(r)
             elif kind == "serve-warmstart":
                 warmstart.append(r)
             elif kind == "mixed":
@@ -444,6 +492,11 @@ def main(paths: list[str]) -> int:
     if warmstart:
         print("## warm start (zero-compile first request)")
         for line in warmstart_lines(warmstart):
+            print(line)
+        print()
+    if autoscale:
+        print("## autoscale drills (elastic-fleet + noticed-eviction health)")
+        for line in autoscale_lines(autoscale):
             print(line)
         print()
     if fleet:

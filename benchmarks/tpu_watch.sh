@@ -304,6 +304,43 @@ fleet_drill() {
   fi
 }
 
+# Autoscale drill (ISSUE 19, opt-in: AUTOSCALE_DRILL=auto or 1): once
+# per watch cycle, prove the elastic fleet story end to end — the
+# serve_load autoscale scenario drives square-wave traffic (burst /
+# quiet / burst) through an autoscaled fleet with forced noticed
+# evictions landing mid-trace, and its row gates zero lost requests +
+# fewer replica-seconds than the static peak fleet (label
+# `serve-autoscale`, its own perf-ledger fingerprint class); then
+# `chaos --fleet --evict` boots the real daemons, sends a replica an
+# eviction NOTICE mid-pack, and asserts the handoff completed every
+# request bit-identically with ZERO recomputed packs (evict_handoff_done
+# on the timeline, failover_start absent). A failed assertion banners
+# LOUDLY but never fails the step; CPU-only; off under the QUEUE_FILE
+# state-machine test hook like the other drills.
+AUTOSCALE_DRILL=${AUTOSCALE_DRILL:-0}
+autoscale_drill() {
+  case "$AUTOSCALE_DRILL" in
+    auto|1) ;;
+    *) return 0 ;;
+  esac
+  [ "$AUTOSCALE_DRILL" = auto ] && [ -n "${QUEUE_FILE:-}" ] && return 0
+  echo "--- autoscale drill ($(date -u +%FT%TZ)) ---" | tee -a "$LOG"
+  if ! timeout 900 env JAX_PLATFORMS=cpu python benchmarks/serve_load.py \
+       --smoke --autoscale >>"$LOG" 2>&1; then
+    echo "--- AUTOSCALE LOAD SCENARIO FAILED (scale-up/retire/scale-to-zero or eviction handoff regressed?) ---" | tee -a "$LOG"
+  fi
+  if ! timeout 900 env JAX_PLATFORMS=cpu \
+       python -m netrep_tpu chaos --fleet --evict --json >>"$LOG" 2>&1; then
+    echo "--- EVICTION DRILL FAILED (noticed eviction recomputed or lost work?) ---" | tee -a "$LOG"
+  fi
+  if [ -s "$PERF_LEDGER" ]; then
+    if ! perf_out=$(timeout 60 python -m netrep_tpu perf "$PERF_LEDGER" --check 2>/dev/null); then
+      echo "--- PERF REGRESSION after autoscale drill ---" | tee -a "$LOG"
+      echo "$perf_out" | tee -a "$LOG"
+    fi
+  fi
+}
+
 # Warm-start step (ISSUE 15, opt-in: WARMSTART=auto or 1): once per
 # watch cycle, prove the zero-compile warm start end to end — the
 # serve_load warmstart scenario exports the program grid into a fresh
@@ -399,6 +436,7 @@ while :; do
   serve_drill
   serve_crash_drill
   fleet_drill
+  autoscale_drill
   warmstart_step
   grid_step
   roofline_check

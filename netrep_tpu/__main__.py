@@ -512,23 +512,43 @@ def _chaos_fleet(args) -> int:
         for t in threads:
             t.start()
 
-        # mid-pack kill: the first replica whose stats show in-flight
-        # work gets SIGKILL — "mid-pack" by construction, not by timing
-        killed_rid = None
+        # pick the victim live: the first replica whose stats show
+        # in-flight work — "mid-pack" by construction, not by timing
+        victim = None
         stat_c = SocketClient(sock, timeout=60)
         kill_deadline = time.monotonic() + 240
-        while killed_rid is None and time.monotonic() < kill_deadline:
+        while victim is None and time.monotonic() < kill_deadline:
             st = stat_c.stats()
             for rid, row in sorted(st.get("replicas", {}).items()):
                 if (row.get("alive") and row.get("inflight")
                         and row.get("pid")):
-                    os.kill(int(row["pid"]), signal.SIGKILL)
-                    killed_rid = rid
+                    victim = (rid, int(row["pid"]))
                     break
-            if killed_rid is None:
+            if victim is None:
                 time.sleep(0.02)
         stat_c.close()
-        summary["killed_replica"] = killed_rid
+        if getattr(args, "evict", False):
+            # noticed eviction (ISSUE 19): the notice runs the FULL
+            # handoff — ring removal, bounded drain, journal-tail ship,
+            # peer adoption — before the process dies, so nothing is
+            # lost and nothing recomputes; the receipt proves it
+            summary["evicted_replica"] = victim[0] if victim else None
+            if victim is not None:
+                ec = SocketClient(sock, timeout=900)
+                receipt = ec.request(
+                    "evict_notice", replica=victim[0],
+                    grace_s=float(getattr(args, "grace", 60.0)),
+                )
+                ec.close()
+                summary["handoff"] = {
+                    k: receipt.get(k)
+                    for k in ("ok", "peer", "s", "requeued", "results")
+                }
+        else:
+            # unnoticed eviction: SIGKILL mid-pack, the failover path
+            if victim is not None:
+                os.kill(victim[1], signal.SIGKILL)
+            summary["killed_replica"] = victim[0] if victim else None
 
         for t in threads:
             t.join(timeout=600)
@@ -538,7 +558,7 @@ def _chaos_fleet(args) -> int:
         )
         summary["recovered"] = len(results) == len(reqs)
         summary["bit_identical"] = bool(identical)
-        summary["ok"] = bool(killed_rid and summary["recovered"]
+        summary["ok"] = bool(victim and summary["recovered"]
                              and identical)
         c = SocketClient(sock, timeout=120)
         c.shutdown()
@@ -560,18 +580,32 @@ def _chaos_fleet(args) -> int:
         timeline = render_recovery(tel)
     except OSError:
         pass
+    evict = bool(getattr(args, "evict", False))
+    if evict:
+        # the zero-recompute pin: a NOTICED eviction must complete as a
+        # handoff (evict_handoff_done on the timeline) without ever
+        # entering the failover path (no failover_start anywhere)
+        summary["zero_recompute"] = bool(
+            "evict_handoff_done" in timeline
+            and "failover_start" not in timeline
+        )
+        summary["ok"] = bool(summary["ok"] and summary["zero_recompute"])
     fo = [l for l in timeline.splitlines() if "failover_done" in l]
     if args.json:
         print(json.dumps(summary))
     else:
-        print(f"fleet chaos drill: {args.replicas} replicas, "
+        kind = "noticed eviction" if evict else "replica-kill"
+        print(f"fleet chaos drill ({kind}): {args.replicas} replicas, "
               f"{len(reqs)} requests @ {args.n_perm} perms")
         if timeline:
             print(timeline)
+        tail = (f": evicted={summary.get('evicted_replica')} "
+                f"zero_recompute={summary.get('zero_recompute')} "
+                if evict else
+                f": killed={summary.get('killed_replica')} ")
         print("fleet chaos drill "
-              + ("PASSED" if summary["ok"] else "FAILED")
-              + f": killed={summary.get('killed_replica')} "
-                f"recovered={summary['recovered']} "
+              + ("PASSED" if summary["ok"] else "FAILED") + tail
+              + f"recovered={summary['recovered']} "
                 f"bit_identical={summary['bit_identical']}"
               + (f" ({fo[-1].strip()})" if fo else ""))
     return 0 if summary["ok"] else 1
@@ -758,6 +792,30 @@ def main(argv=None) -> int:
     sv.add_argument("--no-respawn", action="store_true",
                     help="do not respawn a failed replica after its "
                          "failover completes (the fleet shrinks)")
+    # -- autoscaling (ISSUE 19) ------------------------------------------
+    sv.add_argument("--autoscale", action="store_true",
+                    help="[--fleet] run the autoscaler control loop: "
+                         "spawn replicas when the aggregate backlog-"
+                         "drain estimate exceeds --scale-up-drain-s, "
+                         "drain-and-retire idle replicas, and (with "
+                         "--autoscale-min 0) scale to zero — the "
+                         "journal + AOT store are the fleet state, and "
+                         "a submission against the empty fleet spawns "
+                         "on demand and queues behind the boot")
+    sv.add_argument("--autoscale-min", type=int, default=0,
+                    metavar="N",
+                    help="[--autoscale] fleet-size floor (0 = allow "
+                         "scale-to-zero)")
+    sv.add_argument("--autoscale-max", type=_positive, default=None,
+                    metavar="N",
+                    help="[--autoscale] fleet-size ceiling (default: "
+                         "max(4, --fleet N))")
+    sv.add_argument("--scale-up-drain-s", type=float, default=10.0,
+                    help="[--autoscale] spawn when the aggregate "
+                         "backlog-drain estimate exceeds this "
+                         "(hysteresis: retire only below half)")
+    sv.add_argument("--scale-down-idle-s", type=float, default=30.0,
+                    help="[--autoscale] retire a replica idle this long")
     sv.add_argument("--aot-export", action="store_true",
                     help="export programs this server had to jit-compile "
                          "into the AOT warm-start store (fleet replicas "
@@ -802,6 +860,16 @@ def main(argv=None) -> int:
                          "calls; prints the failover timeline")
     ch.add_argument("--replicas", type=_positive, default=2,
                     help="[--fleet] replica daemons in the drill")
+    ch.add_argument("--evict", action="store_true",
+                    help="[--fleet] noticed-eviction drill (ISSUE 19): "
+                         "instead of SIGKILL, send an eviction notice "
+                         "for a mid-pack replica and assert the handoff "
+                         "(ring removal → drain → journal-tail ship → "
+                         "peer adoption) completes with ZERO recompute "
+                         "— no failover events — and bit-parity")
+    ch.add_argument("--grace", type=float, default=60.0,
+                    help="[--fleet --evict] eviction notice grace "
+                         "period in seconds")
     ch.add_argument("--requests", type=_positive, default=3,
                     help="[--serve/--fleet] concurrent requests in the "
                          "drill")
@@ -1091,10 +1159,13 @@ def main(argv=None) -> int:
             from netrep_tpu.utils.backend import enable_persistent_cache
 
             enable_persistent_cache()
-        if args.fleet and args.fleet > 1:
+        if args.fleet and (args.fleet > 1
+                           or getattr(args, "autoscale", False)):
             # the fleet coordinator itself is backend-free (it only
             # routes and ships journals); the replica daemons it spawns
-            # each resolve their own backend
+            # each resolve their own backend. A fleet of ONE under
+            # --autoscale still gets the coordinator: the autoscaler is
+            # what grows it (ISSUE 19)
             from netrep_tpu.serve.fleet import fleet_daemon
 
             return fleet_daemon(args)
@@ -1110,6 +1181,10 @@ def main(argv=None) -> int:
     if args.cmd == "chaos":
         if getattr(args, "fleet", False):
             return _chaos_fleet(args)
+        if getattr(args, "evict", False):
+            print("chaos --evict is a fleet drill; add --fleet",
+                  file=sys.stderr)
+            return 2
         if args.serve:
             return _chaos_serve(args)
         return _chaos(args)

@@ -18,14 +18,20 @@ Daemon: ``python -m netrep_tpu serve --socket /tmp/netrep.sock``.
 Fleet (ISSUE 14): ``serve --fleet N`` — N replica daemons behind a
 coordinator with consistent-hash routing, journal shipping, replica-kill
 failover, and fleet-wide admission (:mod:`netrep_tpu.serve.fleet`).
+Autoscaling (ISSUE 19): ``--autoscale`` adds the closed loop — an
+explicit replica lifecycle state machine
+(:mod:`netrep_tpu.serve.lifecycle`), backlog-driven scale-up,
+idle-driven drain-and-retire, scale-to-zero with spawn-on-demand, and
+first-class eviction notices that hand off instead of failing over.
 """
 
 from .client import InProcessClient, ServeRejected, SocketClient, retry_delay
 from .fleet import (
-    FleetConfig, FleetCoordinator, HashRing, InProcessReplica, ReplicaLost,
-    build_inprocess_fleet,
+    AutoscaleConfig, Autoscaler, FleetConfig, FleetCoordinator, HashRing,
+    InProcessReplica, ReplicaLost, build_inprocess_fleet, inprocess_spawner,
 )
 from .journal import JournalShipper, RequestJournal
+from .lifecycle import IllegalTransition, ReplicaLifecycle
 from .packer import PackedEngine, PackMonitor, RequestPlan, run_pack
 from .pool import ProgramPool
 from .scheduler import (
@@ -55,4 +61,9 @@ __all__ = [
     "HashRing",
     "InProcessReplica",
     "build_inprocess_fleet",
+    "inprocess_spawner",
+    "Autoscaler",
+    "AutoscaleConfig",
+    "ReplicaLifecycle",
+    "IllegalTransition",
 ]

@@ -73,7 +73,9 @@ import uuid
 logger = logging.getLogger("netrep_tpu")
 
 from ..utils import telemetry as tm
+from . import lifecycle as lc
 from .journal import JournalShipper
+from .lifecycle import ReplicaLifecycle
 from .scheduler import (
     PreservationServer, QueueFull, ServeConfig, ServeError,
 )
@@ -188,10 +190,14 @@ class InProcessReplica:
     coordinator drives — the tier-1 fleet surface (CPU-only, socket-free
     by design, exactly like ``InProcessClient`` vs the socket daemon)."""
 
-    def __init__(self, rid: str, server: PreservationServer):
+    def __init__(self, rid: str, server: PreservationServer,
+                 generation: int = 0):
         self.rid = rid
         self.server = server
         self.journal_path = server.config.journal
+        #: the explicit state machine (ISSUE 19) every membership change
+        #: routes through — the coordinator drives the transitions
+        self.lifecycle = ReplicaLifecycle(rid, generation=generation)
         #: set by the coordinator once failover for this replica is
         #: underway — in-flight ``analyze`` waiters stop waiting on the
         #: dead worker and re-route (the Event IS the synchronization)
@@ -240,10 +246,20 @@ class InProcessReplica:
                 raise TimeoutError(
                     f"request did not finish on replica {self.rid}"
                 )
+        if getattr(handle, "requeued_on_drain", False):
+            # a bounded drain (eviction grace) journaled this request as
+            # requeued instead of finishing it — inside a fleet that is
+            # a migration, not a failure: the peer adopts the journaled
+            # record, so re-route under the same idempotency key
+            raise ReplicaLost(
+                f"replica {self.rid} drained away mid-request; the "
+                f"journaled record migrates with the handoff"
+            )
         return self.server.wait(handle, timeout=0)
 
-    def adopt_journal(self, path: str):
-        return self.server.adopt_journal(path)
+    def adopt_journal(self, path: str, datasets_only: bool = False):
+        return self.server.adopt_journal(path,
+                                         datasets_only=datasets_only)
 
     def stats(self) -> dict:
         return self.server.stats()
@@ -282,12 +298,14 @@ class DaemonReplica:
     keep the proxy thread-safe without a connection pool)."""
 
     def __init__(self, rid: str, socket_path: str, journal_path: str,
-                 proc=None, timeout: float = 600.0):
+                 proc=None, timeout: float = 600.0,
+                 generation: int = 0):
         self.rid = rid
         self.socket_path = socket_path
         self.journal_path = journal_path
         self.proc = proc
         self.timeout = timeout
+        self.lifecycle = ReplicaLifecycle(rid, generation=generation)
         self.dead = threading.Event()
 
     def forward(self, op: dict) -> dict:
@@ -366,8 +384,9 @@ class DaemonReplica:
             except OSError:
                 pass
 
-    def adopt_journal(self, path: str):
-        return self.request("adopt_journal", path=path).get("adopted")
+    def adopt_journal(self, path: str, datasets_only: bool = False):
+        return self.request("adopt_journal", path=path,
+                            datasets_only=datasets_only).get("adopted")
 
     def stats(self) -> dict:
         return self.request("stats")["stats"]
@@ -424,6 +443,15 @@ class FleetCoordinator:
         self._health: threading.Thread | None = None
         self._replicas: dict[str, object] = {}
         self._dead: set[str] = set()
+        #: replicas mid-drain (retire / eviction handoff): off the ring
+        #: and invisible to the health loop, but not yet dead
+        self._draining: set[str] = set()
+        #: the last drained replica's shipped journal copy — the
+        #: persistent state a scale-to-zero fleet spawns back from
+        self.last_journal: str | None = None
+        #: attached :class:`Autoscaler` (None = static fleet); an empty
+        #: fleet then spawns on demand instead of rejecting
+        self.autoscaler = None
         self._ring = HashRing(self.config.vnodes)
         self._shippers: dict[str, JournalShipper] = {}
         self._peers: dict[str, str] = {}
@@ -452,14 +480,21 @@ class FleetCoordinator:
         """Admit a replica to the ring (boot, dynamic join, or respawn):
         ring update + shipper start + ``replica_joined``/
         ``ring_rebalanced`` — placement moves for the new replica's keys
-        only, never a recompute."""
+        only, never a recompute. Routes through the lifecycle machine:
+        a spawning replica becomes ``ready`` here."""
         with self._lock:
             self._replicas[rep.rid] = rep
             self._dead.discard(rep.rid)
+            self._draining.discard(rep.rid)
             self._ring.add(rep.rid)
             self._fo_done[rep.rid] = threading.Event()
             self._assign_peers_locked()
             members = sorted(self._ring.members())
+        cycle = getattr(rep, "lifecycle", None)
+        if cycle is not None:
+            cycle.bind(self.tel, self._serve_sid)
+            if cycle.state == lc.SPAWNING:
+                cycle.transition(lc.READY, reason="join")
         if self.tel is not None:
             self.tel.emit("replica_joined", replica=rep.rid,
                           parent=self._serve_sid,
@@ -476,7 +511,7 @@ class FleetCoordinator:
         file; a multi-host deployment ships the same protocol to the
         peer's disk."""
         for rid, rep in self._replicas.items():
-            if rid in self._dead:
+            if rid in self._dead or rid in self._draining:
                 continue
             self._peers[rid] = self._ring.successor(rid)
             if rid not in self._shippers and rep.journal_path:
@@ -496,9 +531,12 @@ class FleetCoordinator:
         return os.path.join(base, "ship", f"{rid}.jsonl")
 
     def live_replicas(self) -> dict[str, object]:
+        """Replicas still serving: not dead, not mid-drain (a draining
+        replica is off the ring and counts as departed capacity)."""
         with self._lock:
             return {rid: rep for rid, rep in self._replicas.items()
-                    if rid not in self._dead}
+                    if rid not in self._dead
+                    and rid not in self._draining}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -513,8 +551,12 @@ class FleetCoordinator:
             self._health.start()
 
     def close(self, drain: bool = True) -> None:
-        """Stop the health loop and shippers (final ship pass), drain
-        every live replica, close the coordinator span/bus."""
+        """Stop the autoscaler and health loop, stop the shippers
+        (final ship pass), drain every live replica through the
+        lifecycle machine, close the coordinator span/bus."""
+        scaler = self.autoscaler
+        if scaler is not None:
+            scaler.stop()
         self._stop.set()
         with self._lock:
             t, self._health = self._health, None
@@ -524,11 +566,18 @@ class FleetCoordinator:
             shippers = list(self._shippers.values())
             self._shippers.clear()
             live = [rep for rid, rep in self._replicas.items()
-                    if rid not in self._dead]
+                    if rid not in self._dead
+                    and rid not in self._draining]
         for s in shippers:
             s.stop(final_flush=True)
         for rep in live:
+            cycle = getattr(rep, "lifecycle", None)
+            if cycle is not None and cycle.state == lc.READY:
+                cycle.transition(lc.DRAINING, reason="fleet_close")
             rep.close(drain=drain, timeout=self.config.drain_timeout_s)
+            if cycle is not None and cycle.state in (lc.DRAINING,
+                                                     lc.SPAWNING):
+                cycle.transition(lc.DEAD, reason="drained")
         if self.tel is not None:
             self.tel.end_span(
                 self._serve_sid, "serve_end", fleet=True,
@@ -545,7 +594,8 @@ class FleetCoordinator:
             with self._lock:
                 live = [(rid, rep)
                         for rid, rep in self._replicas.items()
-                        if rid not in self._dead]
+                        if rid not in self._dead
+                        and rid not in self._draining]
             for rid, rep in live:
                 if self._stop.is_set():
                     return
@@ -575,6 +625,9 @@ class FleetCoordinator:
             self._assign_peers_locked()
             members = sorted(self._ring.members())
             done_evt = self._fo_done.get(rid)
+        cycle = getattr(rep, "lifecycle", None)
+        if cycle is not None and cycle.state != lc.DEAD:
+            cycle.transition(lc.DEAD, reason="lost")
         if self.tel is not None:
             self.tel.emit("replica_lost", replica=rid,
                           parent=self._serve_sid, peer=peer_rid)
@@ -586,6 +639,8 @@ class FleetCoordinator:
             # always). In a multi-host fleet this pass is a no-op — the
             # copy already holds exactly what was acked.
             shipper.stop(final_flush=True)
+        with self._lock:
+            self.last_journal = self._ship_dest(rid)
         summary = None
         if peer is not None:
             try:
@@ -639,6 +694,121 @@ class FleetCoordinator:
         if kill is not None:
             kill()
         self._failover(rid)
+
+    # -- planned departures: retire + eviction handoff (ISSUE 19) ----------
+
+    def ship_flush(self, rid: str) -> str | None:
+        """Synchronously ship ``rid``'s journal tail and return the
+        copy's path (None when the replica ships nothing) — what a
+        freshly spawned replica adopts its registrations from."""
+        with self._lock:
+            shipper = self._shippers.get(rid)
+        if shipper is None:
+            return None
+        shipper.flush()
+        return self._ship_dest(rid)
+
+    def _handoff(self, rid: str, *, reason: str,
+                 grace_s: float | None = None) -> dict | None:
+        """Planned departure — the shared core of autoscale retirement
+        and the noticed-eviction handoff, the zero-recompute twin of
+        :meth:`_failover`:
+
+        1. ring removal FIRST (no new routes land on the leaver),
+        2. bounded drain (in-flight and queued work finishes inside the
+           grace; what cannot finish is journaled ``drain_requeued``),
+        3. pre-ship of the journal tail (results + requeue marker reach
+           the copy),
+        4. peer adoption (duplicates answer from journaled results;
+           anything requeued resumes from the SHARED checkpoint
+           directory at its last chunk boundary — a handoff, never a
+           recompute).
+
+        Only THEN may the process be killed. Returns the handoff
+        summary dict, or None when ``rid`` is not a live replica."""
+        t0 = time.perf_counter()
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if (rep is None or rid in self._dead
+                    or rid in self._draining):
+                return None
+            self._draining.add(rid)
+            self._ring.remove(rid)
+            shipper = self._shippers.pop(rid, None)
+            peer_rid = self._peers.pop(rid, None)
+            if peer_rid is None or peer_rid in self._dead:
+                peer_rid = self._ring.route(rid)   # any survivor
+            peer = (self._replicas.get(peer_rid)
+                    if peer_rid is not None else None)
+            self._assign_peers_locked()
+            members = sorted(self._ring.members())
+            done_evt = self._fo_done.get(rid)
+        cycle = getattr(rep, "lifecycle", None)
+        if cycle is not None and cycle.state == lc.READY:
+            cycle.transition(lc.DRAINING, reason=reason)
+        if self.tel is not None:
+            self.tel.emit("ring_rebalanced", replica=rid,
+                          parent=self._serve_sid, reason=reason,
+                          members=",".join(members))
+        rep.close(drain=True,
+                  timeout=(grace_s if grace_s is not None
+                           else self.config.drain_timeout_s))
+        if shipper is not None:
+            shipper.stop(final_flush=True)
+        with self._lock:
+            self.last_journal = self._ship_dest(rid)
+        summary = None
+        if peer is not None:
+            try:
+                summary = peer.adopt_journal(self._ship_dest(rid))
+            except (ServeError, OSError) as e:
+                logger.warning("fleet handoff: peer %s failed to adopt "
+                               "%s's journal: %s", peer_rid, rid, e)
+        with self._lock:
+            self._dead.add(rid)
+            self._draining.discard(rid)
+        if cycle is not None and cycle.state != lc.DEAD:
+            cycle.transition(lc.DEAD, reason="drained")
+        rep.dead.set()
+        if done_evt is not None:
+            done_evt.set()
+        if self.tel is not None and not members:
+            self.tel.emit("scale_to_zero", replica=rid,
+                          parent=self._serve_sid,
+                          journal=self._ship_dest(rid))
+        return {
+            "replica": rid,
+            "peer": peer_rid,
+            "s": time.perf_counter() - t0,
+            "requeued": (summary or {}).get("requeued", 0),
+            "results": (summary or {}).get("results", 0),
+        }
+
+    def retire_replica(self, rid: str) -> dict | None:
+        """Drain-and-retire one replica (the autoscaler's scale-down
+        move): planned departure under the full drain timeout."""
+        return self._handoff(rid, reason="retire")
+
+    def evict_notice(self, rid: str, grace_s: float = 30.0) -> dict | None:
+        """First-class eviction notice (wire op ``evict_notice`` /
+        ``NETREP_FLEET_EVICT`` drill env): the capacity under ``rid``
+        will be revoked in ``grace_s`` seconds. Runs the full handoff —
+        ring removal, bounded drain, journal-tail pre-ship, peer
+        adoption — BEFORE the kill, so a noticed eviction loses zero
+        work and recomputes nothing; the SIGKILL drill (``chaos
+        --fleet``) remains the unnoticed-eviction fallback. Returns the
+        handoff summary (None when ``rid`` is not live)."""
+        if self.tel is not None:
+            self.tel.emit("evict_notice", replica=rid,
+                          parent=self._serve_sid,
+                          grace_s=float(grace_s))
+        out = self._handoff(rid, reason="evict", grace_s=grace_s)
+        if out is not None and self.tel is not None:
+            self.tel.emit("evict_handoff_done", replica=rid,
+                          parent=self._serve_sid, peer=out["peer"],
+                          s=out["s"], requeued=out["requeued"],
+                          results=out["results"])
+        return out
 
     # -- routing -----------------------------------------------------------
 
@@ -800,6 +970,20 @@ class FleetCoordinator:
             self.admit(extra_perms=n_perm)
             rep = self.route(tenant, discovery, test)
             if rep is None:
+                # scale-to-zero (ISSUE 19): an empty autoscaled fleet
+                # spawns on demand and the request queues behind the
+                # boot — never a rejection while under the brownout
+                # threshold (the admit gate above still applies)
+                scaler = self.autoscaler
+                if scaler is not None and scaler.request_spawn():
+                    if (deadline is not None
+                            and time.monotonic() > deadline):
+                        raise TimeoutError(
+                            "request timed out waiting for a "
+                            "spawn-on-demand replica"
+                        )
+                    time.sleep(0.05)
+                    continue
                 raise ServeError("fleet has no live replicas")
             left = (None if deadline is None
                     else max(0.1, deadline - time.monotonic()))
@@ -831,17 +1015,25 @@ class FleetCoordinator:
         merged: dict[str, dict] = {}
         inflight = packs = 0
         for rid in sorted(reps):
+            cycle = getattr(reps[rid], "lifecycle", None)
+            state = cycle.state if cycle is not None else None
+            gen = cycle.generation if cycle is not None else 0
             if rid in dead:
-                rows[rid] = {"alive": False}
+                rows[rid] = {"alive": False,
+                             "state": state or "dead", "gen": gen}
                 continue
             try:
                 st = reps[rid].stats()
             except (ServeError, OSError, ConnectionError):
-                rows[rid] = {"alive": False}
+                rows[rid] = {"alive": False,
+                             "state": state or "dead", "gen": gen}
                 continue
             proc = getattr(reps[rid], "proc", None)
             rows[rid] = {
                 "alive": True,
+                "state": state or "ready",
+                "gen": gen,
+                "idle_s": st.get("idle_s"),
                 "pid": proc.pid if proc is not None else None,
                 "backlog_perms": st.get("backlog_perms", 0),
                 "rate_pps": st.get("rate_pps"),
@@ -903,8 +1095,282 @@ class FleetCoordinator:
 
 
 # ---------------------------------------------------------------------------
+# autoscaling (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AutoscaleConfig:
+    """Autoscaler knobs. The scaling signal is the coordinator's
+    AGGREGATE backlog-drain estimate (the same number the fleet-wide
+    brownout reads), with brownout-style hysteresis: scale up above
+    ``scale_up_drain_s``, allow scale-down only below
+    ``scale_up_exit_s`` (default half) — plus a cooldown between
+    actions and a per-replica idle requirement, so the loop never
+    flaps."""
+
+    #: spawn a replica when the aggregate drain estimate exceeds this
+    scale_up_drain_s: float = 10.0
+    #: hysteresis exit: retirement is only considered below this
+    #: (None = half of ``scale_up_drain_s``)
+    scale_up_exit_s: float | None = None
+    #: retire a replica after it has been idle (no inflight work, no
+    #: backlog) this long — measured on the autoscaler's own clock
+    scale_down_idle_s: float = 30.0
+    #: fleet-size bounds; ``min_replicas=0`` enables scale-to-zero
+    min_replicas: int = 0
+    max_replicas: int = 4
+    #: minimum spacing between scaling actions (either direction)
+    cooldown_s: float = 5.0
+    #: control-loop poll interval (the threaded loop; tests drive
+    #: :meth:`Autoscaler.tick` directly under a fake clock)
+    tick_s: float = 0.25
+
+
+class Autoscaler:
+    """The closed loop that makes replicas cattle (ISSUE 19): grow the
+    fleet when the aggregate backlog-drain estimate says the queue is
+    outrunning capacity, drain-and-retire idle replicas (the PR 10
+    bounded SIGTERM drain, through :meth:`FleetCoordinator
+    .retire_replica`), and — with ``min_replicas=0`` — scale to zero,
+    where the journal + the AOT warm store ARE the fleet state: a
+    submission against the empty fleet triggers spawn-on-demand and
+    queues behind the boot.
+
+    ``spawn(index) -> replica`` is the capacity source (an in-process
+    replica factory in tier-1 / the load generator, a
+    :func:`spawn_replica_daemon` wrapper under ``serve --fleet
+    --autoscale``). A freshly spawned replica adopts a live peer's
+    shipped journal copy (datasets only) — or, from zero, the LAST
+    drained replica's full copy — before it enters the ring, so it
+    knows every registration and answers duplicates without recompute.
+
+    Deterministic under test: ``clock`` is injectable and
+    :meth:`tick` runs one decision pass synchronously — tier-1 drives
+    it with a fake clock and ``start=False`` (no thread)."""
+
+    def __init__(self, coord: FleetCoordinator, spawn,
+                 config: AutoscaleConfig | None = None, *,
+                 clock=time.monotonic, start: bool = True):
+        self.coord = coord
+        self._spawn_fn = spawn
+        self.config = config or AutoscaleConfig()
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_action: float | None = None
+        self._spawning = False
+        #: clock time each replica was last seen busy (first sight
+        #: counts as busy — a replica must prove an idle PERIOD)
+        self._last_busy: dict[str, float] = {}
+        # the next spawn index must clear EVERY replica id the
+        # coordinator has ever seen (dead ones included) — a fresh
+        # spawn reusing a dead rid would collide in the ring, the ship
+        # directory, and the telemetry fold
+        seen = [int(rid[1:].split(".")[0])
+                for rid in coord.stats().get("replicas", {})
+                if rid.startswith("r")
+                and rid[1:].split(".")[0].isdigit()]
+        self._next_index = max(seen) + 1 if seen else 0
+        coord.autoscaler = self
+        if start:
+            self.start()
+
+    # -- loop lifecycle ----------------------------------------------------
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=self._loop, name="netrep-fleet-autoscale",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=30.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.tick_s):
+            try:
+                self.tick()
+            except (ServeError, OSError, ConnectionError):
+                logger.warning("autoscaler tick failed", exc_info=True)
+
+    # -- the control loop --------------------------------------------------
+
+    def _cooldown_over(self, now: float) -> bool:
+        return (self._last_action is None
+                or now - self._last_action >= self.config.cooldown_s)
+
+    def tick(self, now: float | None = None) -> str | None:
+        """One decision pass: returns ``"up"``, ``"down"``, or None.
+        Deterministic given the fleet's stats and the injected clock —
+        the tier-1 contract."""
+        cfg = self.config
+        now = self._clock() if now is None else float(now)
+        with self._lock:
+            if self._spawning:
+                return None
+            cooldown_ok = self._cooldown_over(now)
+        live = self.coord.live_replicas()
+        # idle bookkeeping on the autoscaler's own clock: a replica is
+        # busy while it has inflight work or queued backlog
+        for rid, rep in live.items():
+            try:
+                st = rep.stats()
+            except (ServeError, OSError, ConnectionError):
+                continue
+            busy = bool(st.get("inflight", 0)
+                        or st.get("backlog_perms", 0))
+            if busy or rid not in self._last_busy:
+                self._last_busy[rid] = now
+        for rid in list(self._last_busy):
+            if rid not in live:
+                del self._last_busy[rid]
+        if not cooldown_ok:
+            return None
+        est = self.coord.drain_estimate()
+        # below the floor (an eviction can sink the fleet under it):
+        # restore capacity regardless of backlog
+        if len(live) < cfg.min_replicas:
+            if self._do_spawn(reason="min_replicas", est=est):
+                return "up"
+            return None
+        if (est is not None and est > cfg.scale_up_drain_s
+                and len(live) < cfg.max_replicas):
+            if self._do_spawn(reason="backlog", est=est):
+                return "up"
+            return None
+        exit_s = (cfg.scale_up_exit_s if cfg.scale_up_exit_s is not None
+                  else cfg.scale_up_drain_s / 2.0)
+        if (len(live) > cfg.min_replicas
+                and (est is None or est < exit_s)):
+            idle = [rid for rid in live
+                    if now - self._last_busy.get(rid, now)
+                    >= cfg.scale_down_idle_s]
+            if idle:
+                rid = sorted(idle)[-1]   # newest id retires first
+                if self.coord.tel is not None:
+                    self.coord.tel.emit(
+                        "autoscale_down", replica=rid,
+                        parent=self.coord._serve_sid,
+                        idle_s=now - self._last_busy.get(rid, now),
+                        replicas=len(live) - 1,
+                    )
+                self.coord.retire_replica(rid)
+                with self._lock:
+                    self._last_action = now
+                return "down"
+        return None
+
+    # -- spawning ----------------------------------------------------------
+
+    def request_spawn(self) -> bool:
+        """Spawn-on-demand entry (the coordinator calls this when a
+        request finds the fleet empty): True means a replica is coming
+        (spawned here, already mid-spawn, or already joined) and the
+        caller should keep queueing behind it; False means the
+        autoscaler cannot add capacity (``max_replicas`` is 0)."""
+        if self.coord.live_replicas():
+            return True
+        if self.config.max_replicas < 1:
+            return False
+        with self._lock:
+            in_flight = self._spawning
+        if in_flight:
+            return True
+        self._do_spawn(reason="empty_fleet", event="spawn_on_demand")
+        return True
+
+    def _do_spawn(self, *, reason: str, est: float | None = None,
+                  event: str = "autoscale_up") -> bool:
+        with self._lock:
+            if self._spawning:
+                return False
+            self._spawning = True
+            idx = self._next_index
+            self._next_index += 1
+        try:
+            rep = self._spawn_fn(idx)
+            # seed the newcomer BEFORE it enters the ring: a live
+            # peer's shipped copy replays registrations (datasets
+            # only — its pending work is its own); from zero, the last
+            # drained replica's copy replays EVERYTHING, including
+            # requests the drain journaled as requeued
+            live = sorted(self.coord.live_replicas())
+            src = (self.coord.ship_flush(live[0]) if live
+                   else self.coord.last_journal)
+            if src:
+                try:
+                    rep.adopt_journal(src, datasets_only=bool(live))
+                except (ServeError, OSError) as e:
+                    logger.warning("autoscale spawn: %s failed to adopt "
+                                   "%s: %s", rep.rid, src, e)
+            self.coord.join(rep)
+            if self.coord.tel is not None:
+                data = {"replica": rep.rid, "reason": reason,
+                        "replicas": len(self.coord.live_replicas())}
+                if est is not None:
+                    data["est_drain_s"] = float(est)
+                if event == "autoscale_up":
+                    self.coord.tel.emit(
+                        "autoscale_up", parent=self.coord._serve_sid,
+                        **data)
+                else:
+                    self.coord.tel.emit(
+                        "spawn_on_demand",
+                        parent=self.coord._serve_sid, **data)
+            return True
+        finally:
+            with self._lock:
+                self._spawning = False
+                self._last_action = self._clock()
+
+
+# ---------------------------------------------------------------------------
 # in-process fleet construction (tier-1 tests, load generator)
 # ---------------------------------------------------------------------------
+
+
+def _make_inprocess_replica(i: int, fleet_dir: str, make_config=None,
+                            start_servers: bool = True) -> InProcessReplica:
+    """One in-process replica in the fleet layout (``r<i>/journal
+    .jsonl`` + the SHARED ``ckpt/``) — the construction
+    :func:`build_inprocess_fleet` and :func:`inprocess_spawner`
+    share."""
+    ckpt_dir = os.path.join(fleet_dir, "ckpt")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    rid = f"r{i}"
+    rdir = os.path.join(fleet_dir, rid)
+    os.makedirs(rdir, exist_ok=True)
+    jpath = os.path.join(rdir, "journal.jsonl")
+    if make_config is not None:
+        cfg = make_config(rid, jpath, ckpt_dir)
+    else:
+        cfg = ServeConfig(journal=jpath, checkpoint_dir=ckpt_dir,
+                          fleet_label=rid)
+    return InProcessReplica(
+        rid, PreservationServer(cfg, start=start_servers)
+    )
+
+
+def inprocess_spawner(fleet_dir: str, *, make_config=None,
+                      start_servers: bool = True):
+    """The :class:`Autoscaler` ``spawn`` callable for in-process
+    fleets: ``spawn(index)`` boots ``r<index>`` into the same fleet
+    layout (same shared checkpoint directory, same ``make_config``
+    knobs) the static replicas use."""
+    def spawn(index: int) -> InProcessReplica:
+        return _make_inprocess_replica(index, fleet_dir, make_config,
+                                       start_servers=start_servers)
+    return spawn
 
 
 def build_inprocess_fleet(
@@ -934,20 +1400,11 @@ def build_inprocess_fleet(
     if fleet_config.fleet_dir is None:
         fleet_config = dataclasses.replace(fleet_config,
                                            fleet_dir=fleet_dir)
-    replicas = []
-    for i in range(int(n)):
-        rid = f"r{i}"
-        rdir = os.path.join(fleet_dir, rid)
-        os.makedirs(rdir, exist_ok=True)
-        jpath = os.path.join(rdir, "journal.jsonl")
-        if make_config is not None:
-            cfg = make_config(rid, jpath, ckpt_dir)
-        else:
-            cfg = ServeConfig(journal=jpath, checkpoint_dir=ckpt_dir,
-                              fleet_label=rid)
-        replicas.append(InProcessReplica(
-            rid, PreservationServer(cfg, start=start_servers)
-        ))
+    replicas = [
+        _make_inprocess_replica(i, fleet_dir, make_config,
+                                start_servers=start_servers)
+        for i in range(int(n))
+    ]
     return FleetCoordinator(replicas, fleet_config, start=start)
 
 
@@ -984,8 +1441,10 @@ def spawn_replica_daemon(rid: str, fleet_dir: str, args, *,
         cmd += ["--n-perm", str(args.n_perm)]
     if args.brownout_enter_s is not None:
         cmd += ["--brownout-enter-s", str(args.brownout_enter_s)]
+    # a replica never inherits the coordinator's fault plan or its
+    # eviction drill — both address the FLEET, not the child process
     env = {k: v for k, v in os.environ.items()
-           if k != "NETREP_FAULT_PLAN"}
+           if k not in ("NETREP_FAULT_PLAN", "NETREP_FLEET_EVICT")}
     env.setdefault("JAX_PLATFORMS",
                    os.environ.get("JAX_PLATFORMS", "") or "cpu")
     # warm start (ISSUE 15): every replica generation — including a
@@ -1001,7 +1460,8 @@ def spawn_replica_daemon(rid: str, fleet_dir: str, args, *,
         env.update(env_extra)
     proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL, env=env)
-    return DaemonReplica(rid, sock, jpath, proc=proc)
+    return DaemonReplica(rid, sock, jpath, proc=proc,
+                         generation=generation)
 
 
 def _wait_socket(rep: DaemonReplica, budget_s: float = 180.0) -> bool:
@@ -1041,6 +1501,21 @@ def dispatch_fleet_op(coord: FleetCoordinator, op: dict,
         if kind == "shutdown":
             stop.set()
             return {"ok": True, "draining": True}
+        if kind == "evict_notice":
+            # noticed preemption (ISSUE 19): handoff, not failover —
+            # the reply carries the handoff receipt (peer, seconds,
+            # requeued/result counts) so drills can assert zero loss
+            rid = str(op.get("replica") or "")
+            if rid not in coord.live_replicas():
+                return {"ok": False,
+                        "error": f"no live replica {rid!r}"}
+            grace = float(op.get("grace_s") or 30.0)
+            summary = coord.evict_notice(rid, grace_s=grace)
+            if summary is None:
+                return {"ok": False,
+                        "error": f"replica {rid!r} left before the "
+                                 f"notice landed"}
+            return {"ok": True, "evicted": rid, **summary}
         if kind in ("register", "register_fixture"):
             resp = None
             for rid, rep in sorted(coord.live_replicas().items()):
@@ -1088,10 +1563,21 @@ def dispatch_fleet_op(coord: FleetCoordinator, op: dict,
                     return {"ok": False,
                             "error": "proxy needs daemon replicas"}
                 try:
-                    return fwd(op)
+                    resp = fwd(op)
                 except (OSError, ConnectionError, ValueError):
                     coord.await_failover(rep.rid)
                     continue
+                if (not resp.get("ok", False)
+                        and "requeued-on-restart"
+                        in str(resp.get("error", ""))):
+                    # the home replica drained away (retire/evict,
+                    # ISSUE 19) with this request still queued: the
+                    # journaled record migrates with the handoff — wait
+                    # for the peer to adopt it, then retry the SAME
+                    # idempotency key there (dedup, never a recompute)
+                    coord.await_failover(rep.rid)
+                    continue
+                return resp
             return {"ok": False, "retryable": True,
                     "error": "request kept losing its replica; retry",
                     "retry_after_s": 1.0}
@@ -1170,7 +1656,57 @@ def fleet_daemon(args) -> int:
 
         coord.on_failover = respawn
 
+    if getattr(args, "autoscale", False):
+        def spawn_daemon(index: int):
+            rid = f"r{index}"
+            generations.setdefault(rid, 0)
+            fresh = spawn_replica_daemon(rid, fleet_dir, args)
+            if not _wait_socket(fresh, budget_s=120.0):
+                fresh.close(drain=False, timeout=5)
+                raise ServeError(
+                    f"autoscale spawn of {rid} never opened its socket")
+            return fresh
+
+        Autoscaler(coord, spawn_daemon, AutoscaleConfig(
+            scale_up_drain_s=float(
+                getattr(args, "scale_up_drain_s", 10.0) or 10.0),
+            scale_down_idle_s=float(
+                getattr(args, "scale_down_idle_s", 30.0) or 30.0),
+            min_replicas=int(getattr(args, "autoscale_min", 0) or 0),
+            max_replicas=int(getattr(args, "autoscale_max", 0)
+                             or max(4, int(args.fleet))),
+        ))
+
     stop = threading.Event()
+
+    # eviction drill (ISSUE 19): NETREP_FLEET_EVICT=rid[:grace[:after]]
+    # fires ONE noticed eviction against the live fleet — the drill
+    # thread is loud-never-fatal, the daemon keeps serving either way
+    evict_spec = os.environ.get("NETREP_FLEET_EVICT")
+    if evict_spec:
+        def _evict_drill():
+            try:
+                parts = evict_spec.split(":")
+                rid = parts[0]
+                grace = (float(parts[1])
+                         if len(parts) > 1 and parts[1] else 30.0)
+                after = (float(parts[2])
+                         if len(parts) > 2 and parts[2] else 1.0)
+            except ValueError:
+                logger.warning("bad NETREP_FLEET_EVICT spec %r",
+                               evict_spec)
+                return
+            if stop.wait(after):
+                return
+            try:
+                coord.evict_notice(rid, grace_s=grace)
+            except (ServeError, OSError):
+                logger.warning("eviction drill on %s failed", rid,
+                               exc_info=True)
+
+        threading.Thread(target=_evict_drill,
+                         name="netrep-fleet-evict-drill",
+                         daemon=True).start()
 
     def _drain_signal(signum, frame):
         stop.set()

@@ -36,7 +36,17 @@ definition)::
                        shipped journal copy into this live replica —
                        datasets re-register, completed results answer
                        duplicates, unfinished requests re-queue
+                       (``datasets_only: true`` replays registrations
+                       alone — how a freshly autoscaled replica is
+                       seeded before it enters the ring, ISSUE 19)
     shutdown           initiate the graceful drain (same path as SIGTERM)
+    evict_notice       noticed preemption (ISSUE 19). On a replica:
+                       begin the bounded drain now (``grace_s``). On a
+                       FLEET socket: ``{"replica": "r1", "grace_s": 30}``
+                       runs the full handoff — ring removal first, then
+                       drain, journal-tail ship, and peer adoption — so
+                       the eviction loses zero work and recomputes
+                       nothing; the reply carries the handoff receipt
 
 Fleet responses (ISSUE 14): a coordinator under ``--fleet-route
 redirect`` may answer an ``analyze`` with ``{"ok": false, "retryable":

@@ -243,6 +243,12 @@ class Request:
     #: client-supplied idempotency key, or an auto-assigned one; stable
     #: across restarts (recovery re-queues under the original key)
     journal_key: str | None = None
+    #: set when a bounded drain journaled this request as
+    #: ``drain_requeued`` instead of finishing it (ISSUE 19): the work
+    #: migrates with the journal handoff, so a fleet caller retries the
+    #: SAME idempotency key at the adopting peer rather than surfacing
+    #: the drain as a client error
+    requeued_on_drain: bool = False
     done: threading.Event = dataclasses.field(
         default_factory=threading.Event
     )
@@ -331,6 +337,9 @@ class PreservationServer:
         self._coldstart_done = False
         self._fixture_depth = 0
         self._last_drain_requeued = 0
+        #: autoscaler idle signal (ISSUE 19): wall clock of the last
+        #: accepted or finished request — `stats()['idle_s']`
+        self._last_active_m = self._started_m
         self._brownout = False
         self._served_perms = 0.0     # measured steady-state rate inputs
         self._busy_s = 0.0
@@ -413,12 +422,15 @@ class PreservationServer:
                     keys=[r.journal_key for r in remainder],
                 )
             for r in remainder:
-                r.error = (
-                    "drain timeout: request journaled as requeued-on-"
-                    "restart (serve --recover completes it)"
-                    if self.journal is not None
-                    else "drain timeout: request dropped (no journal)"
-                )
+                if self.journal is not None:
+                    r.requeued_on_drain = True
+                    r.error = (
+                        "drain timeout: request journaled as requeued-"
+                        "on-restart (serve --recover completes it)"
+                    )
+                else:
+                    r.error = ("drain timeout: request dropped "
+                               "(no journal)")
                 r.done.set()
         self.pool.clear()
         if self.tel is not None:
@@ -522,7 +534,8 @@ class PreservationServer:
         to results bit-identical to an uninterrupted one."""
         self._replay_journal(self.config.journal, quiet=True)
 
-    def adopt_journal(self, path: str) -> dict | None:
+    def adopt_journal(self, path: str, *,
+                      datasets_only: bool = False) -> dict | None:
         """Replay a FOREIGN journal into this live server — the fleet
         failover path (ISSUE 14): the coordinator hands the survivor its
         dead peer's shipped journal copy, and the survivor re-registers
@@ -538,10 +551,18 @@ class PreservationServer:
         Completed results stay in the in-memory map only; a duplicate
         arriving after yet another restart recomputes, deterministically,
         to the same answer. Returns the replay summary (or None when the
-        journal does not exist)."""
-        return self._replay_journal(path, quiet=False)
+        journal does not exist).
 
-    def _replay_journal(self, path: str, *, quiet: bool) -> dict | None:
+        ``datasets_only`` replays registrations but neither results nor
+        pendings — the seeding mode for a freshly SPAWNED replica
+        (ISSUE 19) adopting a *live* peer's shipped copy: the newcomer
+        must know every tenant/dataset before the ring routes to it,
+        but the peer's requests are the peer's to finish."""
+        return self._replay_journal(path, quiet=False,
+                                    datasets_only=datasets_only)
+
+    def _replay_journal(self, path: str, *, quiet: bool,
+                        datasets_only: bool = False) -> dict | None:
         """Shared journal-replay core of ``--recover`` (``quiet=True``:
         the records already live in our own journal — do not re-journal)
         and :meth:`adopt_journal` (``quiet=False``)."""
@@ -581,14 +602,16 @@ class PreservationServer:
             # terminal records -> idempotency map: a duplicate of a
             # completed request gets the journaled result, of a failed
             # one its error — never a recompute
-            for key, rec in state["results"].items():
+            for key, rec in ({} if datasets_only
+                             else state["results"]).items():
                 acc = state["accepted"].get(key) or {}
                 req = self._terminal_request(key, rec, acc)
                 req.result = decode_arrays(rec.get("result") or {})
                 req.done.set()
                 self._idem[key] = req
                 self._retire_idem(req)
-            for key, rec in state["failed"].items():
+            for key, rec in ({} if datasets_only
+                             else state["failed"]).items():
                 acc = state["accepted"].get(key) or {}
                 req = self._terminal_request(key, rec, acc)
                 req.error = str(rec.get("error", "failed before restart"))
@@ -596,7 +619,7 @@ class PreservationServer:
                 self._idem[key] = req
                 self._retire_idem(req)
             requeued = 0
-            for rec in state["pending"]:
+            for rec in ([] if datasets_only else state["pending"]):
                 params = rec.get("params") or {}
                 try:
                     self.submit(
@@ -632,8 +655,8 @@ class PreservationServer:
         summary = {
             "tenants": len(state["tenants"]),
             "datasets": len(state["datasets"]),
-            "results": len(state["results"]),
-            "failed": len(state["failed"]),
+            "results": 0 if datasets_only else len(state["results"]),
+            "failed": 0 if datasets_only else len(state["failed"]),
             "requeued": requeued,
         }
         if self.tel is not None:
@@ -1140,6 +1163,7 @@ class PreservationServer:
             )
             self._idem[jkey] = req
             ten.counters["received"] += 1
+            self._last_active_m = now
             if self.tel is not None:
                 req.sid = self.tel.new_span_id()
                 self.tel.emit(
@@ -1312,6 +1336,7 @@ class PreservationServer:
             ten.counters["done"] += 1
             latency = now - req.submitted_m
             with self._work:
+                self._last_active_m = now
                 ten.lat_hist.observe(latency)
                 self._slo_mark_locked(ten, now, latency > self.config.slo_s)
             self._account_cost(req, result.get("cost"))
@@ -1319,6 +1344,7 @@ class PreservationServer:
             req.error = error
             ten.counters["failed"] += 1
             with self._work:
+                self._last_active_m = now
                 self._slo_mark_locked(ten, now, True)
         if self.journal is not None and req.journal_key is not None:
             # terminal journal record: done carries the full encoded
@@ -1836,6 +1862,13 @@ class PreservationServer:
                     for t in self._tenants.values() for r in t.pending
                 ),
                 "rate_pps": self._rate_pps(),
+                # autoscaler idle signal (ISSUE 19): zero while anything
+                # is queued or running, else seconds since the last
+                # accepted/finished request
+                "idle_s": (
+                    0.0 if (self._inflight or self._any_pending_locked())
+                    else max(0.0, now - self._last_active_m)
+                ),
                 # roofline gauge (ISSUE 18): this replica's most recent
                 # engine run's achieved fraction of speed of light (null
                 # on unknown device kinds / before the first telemetry-on

@@ -36,9 +36,17 @@ Ops::
      "deadline_s": 30.0, "idempotency_key": "client-chosen"}
     {"op": "metrics"}   → Prometheus text exposition
     {"op": "stats"}
-    {"op": "adopt_journal", "path": "..."}  → fleet failover (ISSUE 14):
-                          replay a dead peer's shipped journal copy
+    {"op": "adopt_journal", "path": "...", "datasets_only": false}
+                        → fleet failover (ISSUE 14): replay a dead
+                          peer's shipped journal copy (datasets_only
+                          seeds a fresh autoscaled replica, ISSUE 19)
     {"op": "shutdown"}  → initiates the same drain as SIGTERM
+    {"op": "evict_notice", "grace_s": 30.0}
+                        → noticed preemption (ISSUE 19): start the
+                          bounded drain now. Against a FLEET socket it
+                          takes {"replica": "r1"} and performs the full
+                          handoff (ring removal → drain → journal-tail
+                          ship → peer adoption) before the host dies
 
 A rejected admission (queue full / brownout shedding) answers
 ``{"ok": false, "retryable": true, "retry_after_s": <hint>}`` — the
@@ -134,8 +142,13 @@ def dispatch_op(server: PreservationServer, op: dict,
             # fleet failover (ISSUE 14): the coordinator hands this
             # replica its dead peer's shipped journal copy — replay it
             # into the live server (register datasets, answer duplicates
-            # from journaled results, re-queue unfinished requests)
-            summary = server.adopt_journal(str(op["path"]))
+            # from journaled results, re-queue unfinished requests).
+            # datasets_only (ISSUE 19) seeds a freshly spawned replica
+            # with registrations alone — the peer keeps its own work
+            summary = server.adopt_journal(
+                str(op["path"]),
+                datasets_only=bool(op.get("datasets_only", False)),
+            )
             return {"ok": True, "adopted": summary}
         if kind == "metrics":
             return {"ok": True, "text": server.metrics_text()}
@@ -144,6 +157,14 @@ def dispatch_op(server: PreservationServer, op: dict,
         if kind == "shutdown":
             stop.set()
             return {"ok": True, "draining": True}
+        if kind == "evict_notice":
+            # single-replica eviction notice (ISSUE 19): the host is
+            # going away in grace_s — begin the same bounded drain as
+            # SIGTERM now (the fleet coordinator uses its own handoff
+            # path; this op is the standalone-daemon form)
+            stop.set()
+            return {"ok": True, "draining": True, "evict": True,
+                    "grace_s": float(op.get("grace_s") or 30.0)}
         return _malformed(server, f"unknown op {kind!r}")
     except QueueFull as e:
         # admission-control rejection: retryable by contract, with the

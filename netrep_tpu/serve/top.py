@@ -80,6 +80,12 @@ def snapshot(stats: dict) -> dict:
             {
                 "replica": rid,
                 "alive": bool(r.get("alive", False)),
+                # lifecycle state machine (ISSUE 19): the pinned
+                # spawning/ready/draining/dead state + respawn
+                # generation, straight from the coordinator's rows
+                "state": r.get("state"),
+                "gen": int(r.get("gen", 0) or 0),
+                "idle_s": r.get("idle_s"),
                 "queue_depth": int(r.get("queue_depth", 0) or 0),
                 "backlog_perms": int(r.get("backlog_perms", 0) or 0),
                 "rate_pps": r.get("rate_pps"),
@@ -120,6 +126,10 @@ def render_tenant_table(rows: list[dict]) -> str:
 _REPLICA_COLUMNS = (
     ("replica", 10, "replica", "s"),
     ("up", 4, "up", "s"),
+    # lifecycle columns (ISSUE 19): the state machine's word for the
+    # replica (spawning/ready/draining/dead) + its respawn generation
+    ("state", 9, "state", "s"),
+    ("gen", 4, "gen", "d"),
     ("q", 4, "queue_depth", "d"),
     ("backlog", 8, "backlog_perms", "d"),
     ("rate/s", 9, "rate_pps", ".1f"),
@@ -148,7 +158,7 @@ def render_replica_table(rows: list[dict]) -> str:
         for _h, w, k, fmt in _REPLICA_COLUMNS:
             v = state if k == "up" else r.get(k)
             if fmt == "s":
-                cells.append(f"{str(v):<{w}}")
+                cells.append(f"{str(v if v is not None else '-'):<{w}}")
             elif v is None:
                 cells.append(f"{'-':>{w}}")
             else:

@@ -174,6 +174,31 @@ FLEET_EVENTS = (
     #: the consistent-hash ring changed (join or leave): placement moved
     #: for the departed/arrived replica's keys ONLY — never a recompute
     "ring_rebalanced",
+    # -- replica lifecycle + autoscaling (ISSUE 19) ---------------------
+    #: one event per lifecycle state-machine transition
+    #: (``serve/lifecycle.py``): ``prev``/``to``/``gen``/``reason``
+    #: beside the ``replica`` label — the machine renders straight off
+    #: the event stream
+    "replica_state",
+    #: the autoscaler decided to grow the fleet (aggregate backlog-drain
+    #: estimate above ``scale_up_drain_s``); ``replica`` = the spawned id
+    "autoscale_up",
+    #: the autoscaler drained-and-retired an idle replica
+    "autoscale_down",
+    #: the fleet drained to ZERO replicas — ``journal`` names the last
+    #: shipped copy, the persistent state a spawn-on-demand boots from
+    "scale_to_zero",
+    #: a submission against an empty fleet triggered a spawn; the
+    #: request queues behind the boot instead of being rejected
+    "spawn_on_demand",
+    #: an eviction notice arrived (wire op / ``NETREP_FLEET_EVICT``):
+    #: the replica leaves the ring BEFORE the kill and hands its work
+    #: off — ``grace_s`` bounds the drain
+    "evict_notice",
+    #: the noticed-eviction handoff completed (tail pre-shipped, peer
+    #: adopted): ``s`` = measured handoff time, ``requeued``/``results``
+    #: = what the peer took over — zero recompute, unlike a failover
+    "evict_handoff_done",
 )
 
 #: pinned latency histogram bucket upper bounds (seconds) for the
@@ -1137,8 +1162,11 @@ def replica_summary(events: Iterable[dict]) -> dict[str, dict]:
     """Per-replica aggregation of the fleet events (:data:`FLEET_EVENTS`)
     — the offline twin of the fleet coordinator's live per-replica rows,
     keyed on the ``replica`` label every fleet event carries: joins,
-    losses, shipped records/bytes, and failovers (count + total measured
-    seconds from ``failover_done.s``)."""
+    losses, shipped records/bytes, failovers (count + total measured
+    seconds from ``failover_done.s``), noticed evictions (count + total
+    handoff seconds from ``evict_handoff_done.s``), and the replica's
+    LAST lifecycle state/generation from the ``replica_state`` stream
+    (ISSUE 19)."""
     out: dict[str, dict] = {}
     for e in events:
         ev = e.get("ev")
@@ -1151,6 +1179,7 @@ def replica_summary(events: Iterable[dict]) -> dict[str, dict]:
         row = out.setdefault(str(rid), {
             "joined": 0, "lost": 0, "shipped_records": 0,
             "shipped_bytes": 0, "failovers": 0, "failover_s": 0.0,
+            "evictions": 0, "handoff_s": 0.0, "state": None, "gen": 0,
         })
         if ev == "replica_joined":
             row["joined"] += 1
@@ -1163,6 +1192,14 @@ def replica_summary(events: Iterable[dict]) -> dict[str, dict]:
             row["failovers"] += 1
             if _is_number(data.get("s")):
                 row["failover_s"] += float(data["s"])
+        elif ev == "evict_notice":
+            row["evictions"] += 1
+        elif ev == "evict_handoff_done":
+            if _is_number(data.get("s")):
+                row["handoff_s"] += float(data["s"])
+        elif ev == "replica_state":
+            row["state"] = data.get("to")
+            row["gen"] = int(data.get("gen", 0) or 0)
     return out
 
 
@@ -1177,15 +1214,18 @@ def render_replicas(path: str) -> str:
     out = ["replicas:"]
     w = max(len(r) for r in rows)
     out.append(
-        f"  {'':<{w}}  {'join':>5} {'lost':>5} {'ship_rec':>9} "
-        f"{'ship_B':>9} {'failover':>9} {'fo_s':>8}"
+        f"  {'':<{w}}  {'state':>8} {'gen':>3} {'join':>5} {'lost':>5} "
+        f"{'ship_rec':>9} {'ship_B':>9} {'failover':>9} {'fo_s':>8} "
+        f"{'evict':>5} {'ho_s':>8}"
     )
     for rid in sorted(rows):
         r = rows[rid]
         out.append(
-            f"  {rid:<{w}}  {r['joined']:>5} {r['lost']:>5} "
+            f"  {rid:<{w}}  {(r['state'] or '-'):>8} {r['gen']:>3} "
+            f"{r['joined']:>5} {r['lost']:>5} "
             f"{r['shipped_records']:>9} {r['shipped_bytes']:>9} "
-            f"{r['failovers']:>9} {r['failover_s']:>8.3f}"
+            f"{r['failovers']:>9} {r['failover_s']:>8.3f} "
+            f"{r['evictions']:>5} {r['handoff_s']:>8.3f}"
         )
     return "\n".join(out)
 

@@ -170,6 +170,30 @@ def test_serve_fleet_smoke():
 
 
 @pytest.mark.slow
+def test_serve_autoscale_smoke():
+    """The watcher's AUTOSCALE_DRILL load row (ISSUE 19): square-wave
+    traffic through an autoscaled fleet (min 1, max peak) with forced
+    noticed evictions landing mid-trace, against the same workload on a
+    static peak fleet — parity asserted in-bench; the row carries the
+    `serve-autoscale` metric label (its own perf-ledger fingerprint
+    class) and gates zero lost requests, every eviction performed, and
+    fewer replica-seconds than the static fleet via its exit code."""
+    proc = _run_cpu_subprocess(
+        [sys.executable, "benchmarks/serve_load.py", "--smoke",
+         "--autoscale"],
+        timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["metric"].startswith("serve-autoscale")
+    assert row["ok"] is True
+    assert row["lost_requests"] == 0
+    assert row["evictions"] == 2 and len(row["evicted"]) == 2
+    assert row["replica_seconds"] < row["replica_seconds_static"]
+    assert row["p99_ms"] > 0 and row["p99_static_ms"] > 0
+
+
+@pytest.mark.slow
 def test_serve_warmstart_smoke():
     """The watcher's WARMSTART step (ISSUE 15): cold fresh-process
     first-request compile span vs the same measurement against a

@@ -1,0 +1,413 @@
+"""Autoscaling-fleet tests (ISSUE 19) — CPU-only, in-process, tiny
+fixtures: the replica lifecycle transition matrix pinned exactly (an
+edge added or removed is a contract change), the autoscaler's
+up/down/cooldown decisions deterministic under an injected fake clock,
+the scale-to-zero round trip (journal + warm store ARE the fleet state:
+a spawn-on-demand replica answers a pre-retirement duplicate from the
+adopted journal with ZERO packs and computes fresh keys bit-identical),
+and the noticed-eviction handoff: a mid-pack crash followed by
+``evict_notice`` migrates every request to the peer with zero lost
+work, the partial pack resuming from the SHARED checkpoint directory —
+and NO failover events, because a noticed departure is a handoff."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from netrep_tpu import module_preservation
+from netrep_tpu.data import make_mixed_pair
+from netrep_tpu.serve import (
+    AutoscaleConfig, Autoscaler, FleetConfig, IllegalTransition,
+    ReplicaLifecycle, ServeConfig, build_inprocess_fleet,
+    inprocess_spawner,
+)
+from netrep_tpu.serve.lifecycle import LEGAL_TRANSITIONS, STATES
+from netrep_tpu.utils.config import EngineConfig, FaultPolicy
+
+#: the ONE engine config fleet-served runs and their direct twins share
+CFG = EngineConfig(chunk_size=16, autotune=False)
+
+
+@pytest.fixture(scope="module")
+def fx():
+    mixed = make_mixed_pair(100, 3, n_samples=16, seed=7)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    assign = {f"node_{i}": "0" for i in range(dn.shape[0])}
+    for lab, idx in mixed["specs"]:
+        for i in idx:
+            assign[f"node_{i}"] = str(lab)
+    direct_kw = dict(
+        network={"d": dn, "t": tn}, correlation={"d": dc, "t": tc},
+        data={"d": dd, "t": td}, module_assignments=assign,
+        discovery="d", test="t", config=CFG,
+    )
+    return dict(dn=dn, dc=dc, dd=dd, tn=tn, tc=tc, td=td, assign=assign,
+                direct_kw=direct_kw)
+
+
+def direct(fx, **kw):
+    return module_preservation(**fx["direct_kw"], **kw)
+
+
+def read_events(path):
+    return [json.loads(l) for l in open(path, encoding="utf-8")]
+
+
+def _mk_config(tmp_path):
+    """Per-replica ServeConfig factory shared by the static fleet AND
+    the autoscaler's spawner — a spawned replica must look exactly like
+    a built one (same engine, journal layout, shared checkpoint dir)."""
+    def mk(rid, jpath, ckpt):
+        return ServeConfig(
+            engine=CFG, journal=jpath, checkpoint_dir=ckpt,
+            checkpoint_every=16, fleet_label=rid,
+            telemetry=str(tmp_path / f"{rid}_tel.jsonl"),
+        )
+    return mk
+
+
+def make_fleet(fx, tmp_path, n=2, *, register=True, heartbeat_s=30.0,
+               fleet_config_kw=None, start_servers=True):
+    """N-replica in-process fleet over the shared fixture pair. The
+    heartbeat defaults LONG: these tests drive planned departures and
+    fake-clock ticks, and the health loop must never mistake an
+    unstarted or mid-drill replica for an unnoticed loss."""
+    fc = FleetConfig(telemetry=str(tmp_path / "coord.jsonl"),
+                     heartbeat_s=heartbeat_s,
+                     **(fleet_config_kw or {}))
+    fleet = build_inprocess_fleet(
+        n, str(tmp_path / "fleet"), make_config=_mk_config(tmp_path),
+        fleet_config=fc, start_servers=start_servers,
+    )
+    if register:
+        fleet.register_dataset("a", "d", network=fx["dn"],
+                               correlation=fx["dc"], data=fx["dd"],
+                               assignments=fx["assign"])
+        fleet.register_dataset("a", "t", network=fx["tn"],
+                               correlation=fx["tc"], data=fx["td"])
+    return fleet
+
+
+# ---------------------------------------------------------------------------
+# lifecycle state machine
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_transition_matrix_pinned(tmp_path):
+    """The legal-move table, pinned EXACTLY (the contract lifecycle.py
+    points here for): 6 edges, every other ordered pair raises, a
+    failed move leaves the state untouched, dead→spawning bumps the
+    generation, and every legal transition emits ONE ``replica_state``
+    event carrying replica/prev/to/gen/reason."""
+    assert STATES == ("spawning", "ready", "draining", "dead")
+    assert LEGAL_TRANSITIONS == frozenset({
+        ("spawning", "ready"),
+        ("spawning", "dead"),
+        ("ready", "draining"),
+        ("ready", "dead"),
+        ("draining", "dead"),
+        ("dead", "spawning"),
+    })
+    # exhaustive sweep: a fresh machine forced into each origin state
+    for prev in STATES:
+        for to in STATES:
+            cycle = ReplicaLifecycle("rX")
+            cycle._state = prev           # test-only: set the origin
+            if (prev, to) in LEGAL_TRANSITIONS:
+                assert cycle.transition(to, reason="pin") == to
+                assert cycle.state == to
+            else:
+                with pytest.raises(IllegalTransition):
+                    cycle.transition(to, reason="pin")
+                assert cycle.state == prev   # rejected move = no move
+    with pytest.raises(IllegalTransition):
+        ReplicaLifecycle("rX").transition("zombie")
+
+    # the respawn path bumps the generation and the event stream shows
+    # the full walk — one event per transition, nothing else
+    from netrep_tpu.utils.telemetry import Telemetry
+
+    tel_path = str(tmp_path / "tel.jsonl")
+    tel = Telemetry(tel_path)
+    cycle = ReplicaLifecycle("r9", telemetry=tel)
+    assert cycle.generation == 0
+    cycle.transition("ready", reason="join")
+    cycle.transition("dead", reason="lost")
+    cycle.transition("spawning", reason="respawn")
+    assert cycle.generation == 1
+    tel.close()
+    ev = [e for e in read_events(tel_path) if e["ev"] == "replica_state"]
+    assert [(e["data"]["prev"], e["data"]["to"], e["data"]["gen"],
+             e["data"]["reason"]) for e in ev] == [
+        ("spawning", "ready", 0, "join"),
+        ("ready", "dead", 0, "lost"),
+        ("dead", "spawning", 1, "respawn"),
+    ]
+    assert all(e["data"]["replica"] == "r9" for e in ev)
+
+
+# ---------------------------------------------------------------------------
+# autoscaler decisions under a fake clock
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_up_down_cooldown_under_fake_clock(fx, tmp_path):
+    """The control loop, tick by tick on an injected clock (workers
+    never start, so the backlog is whatever the test queues): backlog
+    above the drain threshold scales up to ``max_replicas`` with the
+    cooldown between actions; a drained-and-idle fleet retires one
+    replica per cooldown window, newest id first, all the way to ZERO —
+    leaving ``last_journal`` as the state a future spawn adopts."""
+    fleet = make_fleet(fx, tmp_path, n=1, start_servers=False,
+                      fleet_config_kw=dict(rate_pps=10.0))
+    clk = {"t": 0.0}
+    spawn = inprocess_spawner(str(tmp_path / "fleet"),
+                              make_config=_mk_config(tmp_path),
+                              start_servers=False)
+    scaler = Autoscaler(
+        fleet, spawn,
+        AutoscaleConfig(scale_up_drain_s=10.0, scale_down_idle_s=10.0,
+                        min_replicas=0, max_replicas=3, cooldown_s=2.0),
+        clock=lambda: clk["t"], start=False,
+    )
+    assert fleet.autoscaler is scaler
+    try:
+        home = fleet.route("a", "d", "t")
+        assert home.rid == "r0"
+        for i in range(3):
+            home.server.submit("a", "d", "t", n_perm=256, seed=i)
+        # 768 queued perms / 10 pps = 76.8s drain, far above the 10s
+        # enter threshold: scale up
+        assert scaler.tick(now=0.0) == "up"
+        assert sorted(fleet.live_replicas()) == ["r0", "r1"]
+        # still 38.4s with two replicas, but the cooldown holds
+        assert scaler.tick(now=1.0) is None
+        clk["t"] = 3.0
+        assert scaler.tick(now=3.0) == "up"
+        assert sorted(fleet.live_replicas()) == ["r0", "r1", "r2"]
+        # at max_replicas: the signal still says up, the bound wins
+        clk["t"] = 6.0
+        assert scaler.tick(now=6.0) is None
+        # the backlog drains (cleared in place — workers never ran)
+        with home.server._work:
+            for t in home.server._tenants.values():
+                t.pending.clear()
+        clk["t"] = 7.0
+        assert scaler.tick(now=7.0) is None     # idle periods just began
+        # every replica has now been idle >= 10s: retire ONE per
+        # cooldown window, newest id first
+        clk["t"] = 17.0
+        assert scaler.tick(now=17.0) == "down"
+        assert sorted(fleet.live_replicas()) == ["r0", "r1"]
+        clk["t"] = 18.0
+        assert scaler.tick(now=18.0) is None    # cooldown again
+        clk["t"] = 20.0
+        assert scaler.tick(now=20.0) == "down"
+        assert sorted(fleet.live_replicas()) == ["r0"]
+        clk["t"] = 23.0
+        assert scaler.tick(now=23.0) == "down"  # min_replicas=0: to zero
+        assert fleet.live_replicas() == {}
+        # scale-to-zero left the persistent state behind
+        assert fleet.last_journal is not None
+        assert os.path.exists(fleet.last_journal)
+        st = fleet.stats()
+    finally:
+        fleet.close(drain=False)
+    assert all(row["alive"] is False and row["state"] == "dead"
+               for row in st["replicas"].values())
+    ev = read_events(str(tmp_path / "coord.jsonl"))
+    ups = [e["data"] for e in ev if e["ev"] == "autoscale_up"]
+    downs = [e["data"] for e in ev if e["ev"] == "autoscale_down"]
+    assert [u["replica"] for u in ups] == ["r1", "r2"]
+    assert all(u["reason"] == "backlog" and u["est_drain_s"] > 10.0
+               for u in ups)
+    assert [d["replica"] for d in downs] == ["r2", "r1", "r0"]
+    assert [d["replicas"] for d in downs] == [2, 1, 0]
+    assert all(d["idle_s"] >= 10.0 for d in downs)
+    zero = [e["data"] for e in ev if e["ev"] == "scale_to_zero"]
+    assert len(zero) == 1 and zero[0]["replica"] == "r0"
+    assert zero[0]["journal"] == fleet.last_journal
+    # the retired replicas walked the machine: ready→draining(retire)→dead
+    r2_states = [(e["data"]["prev"], e["data"]["to"], e["data"]["reason"])
+                 for e in ev if e["ev"] == "replica_state"
+                 and e["data"]["replica"] == "r2"]
+    assert r2_states == [
+        ("spawning", "ready", "join"),
+        ("ready", "draining", "retire"),
+        ("draining", "dead", "drained"),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# scale to zero and back: journal + warm store ARE the fleet state
+# ---------------------------------------------------------------------------
+
+def test_scale_to_zero_round_trip_bit_identical(fx, tmp_path):
+    """Retire the last replica (scale-to-zero), then submit against the
+    EMPTY fleet: the attached autoscaler spawns on demand, the newcomer
+    adopts the last drained replica's full journal copy, a duplicate of
+    a pre-retirement request answers from the adopted journal with ZERO
+    packs dispatched, and a fresh key computes bit-identical to a
+    direct call — nothing about the fleet's death and rebirth is
+    observable in the numbers."""
+    fleet = make_fleet(fx, tmp_path, n=1)
+    spawn = inprocess_spawner(str(tmp_path / "fleet"),
+                              make_config=_mk_config(tmp_path))
+    Autoscaler(fleet, spawn,
+               AutoscaleConfig(min_replicas=0, max_replicas=2,
+                               cooldown_s=0.0),
+               start=False)
+    try:
+        r1 = fleet.analyze("a", "d", "t", n_perm=32, seed=3,
+                           idempotency_key="K", timeout=600)
+        out = fleet.retire_replica("r0")
+        assert out is not None and out["replica"] == "r0"
+        assert fleet.live_replicas() == {}
+        assert fleet.last_journal and os.path.exists(fleet.last_journal)
+        # the empty-fleet submit queues behind a spawn-on-demand boot
+        r2 = fleet.analyze("a", "d", "t", n_perm=32, seed=3,
+                           idempotency_key="K", timeout=600)
+        st = fleet.stats()
+        fresh = fleet.analyze("a", "d", "t", n_perm=32, seed=5,
+                              timeout=600)
+    finally:
+        fleet.close()
+    np.testing.assert_array_equal(np.asarray(r1["p_values"]),
+                                  np.asarray(r2["p_values"]))
+    np.testing.assert_array_equal(np.asarray(r1["counts_hi"]),
+                                  np.asarray(r2["counts_hi"]))
+    # the duplicate was a pure journal answer on the newcomer
+    assert sorted(st["replicas"]) == ["r0", "r1"]
+    assert st["replicas"]["r0"]["state"] == "dead"
+    assert st["replicas"]["r1"]["alive"] is True
+    assert st["replicas"]["r1"]["packs"] == 0
+    d = direct(fx, n_perm=32, seed=5)
+    np.testing.assert_array_equal(fresh["observed"], d.observed)
+    np.testing.assert_array_equal(fresh["p_values"],
+                                  np.asarray(d.p_values))
+    ev = read_events(str(tmp_path / "coord.jsonl"))
+    names = [e["ev"] for e in ev]
+    assert "scale_to_zero" in names
+    sod = [e["data"] for e in ev if e["ev"] == "spawn_on_demand"]
+    assert sod and sod[0]["replica"] == "r1"
+    assert sod[0]["reason"] == "empty_fleet"
+    # a planned departure is NOT a failover
+    assert "replica_lost" not in names
+    assert "failover_start" not in names
+    r0_states = [(e["data"]["to"], e["data"]["reason"]) for e in ev
+                 if e["ev"] == "replica_state"
+                 and e["data"]["replica"] == "r0"]
+    assert ("draining", "retire") in r0_states
+
+
+# ---------------------------------------------------------------------------
+# noticed eviction: handoff, not failover
+# ---------------------------------------------------------------------------
+
+def test_evict_notice_mid_pack_handoff_zero_recompute(fx, tmp_path):
+    """The tentpole acceptance for preemption: a replica crashes
+    mid-pack (checkpoint at 16, SimulatedCrash at 24 — the in-process
+    SIGKILL stand-in), the platform's eviction notice lands, and the
+    handoff — ring removal, bounded drain, journal-tail pre-ship, peer
+    adoption — migrates all three requests: counts/p-values/adaptive
+    decisions bit-identical to direct calls, the partial pack RESUMED
+    from the shared checkpoint directory, and the coordinator's event
+    story is evict_notice → rebalance → evict_handoff_done with NO
+    failover events at all (the health loop never fires — the notice
+    preempted it)."""
+    fleet = make_fleet(fx, tmp_path, n=2)
+    submits = [
+        ("k1", dict(n_perm=64, seed=3)),
+        ("k2", dict(n_perm=64, seed=5)),
+        ("k3", dict(n_perm=32, seed=11, adaptive=True)),
+    ]
+    try:
+        home = fleet.route("a", "d", "t")
+        peer_rid = [r for r in ("r0", "r1") if r != home.rid][0]
+        home.arm_fault_plan(FaultPolicy(plan="crash@24",
+                                        backoff_base_s=0.0,
+                                        backoff_jitter=0.0))
+        results = {}
+        errors = []
+
+        def worker(k, kw):
+            try:
+                results[k] = fleet.analyze("a", "d", "t",
+                                           idempotency_key=k,
+                                           timeout=600, **kw)
+            except Exception as e:   # surfaced after join
+                errors.append(f"{k}: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=worker, args=s, daemon=True)
+                   for s in submits]
+        for t in threads:
+            t.start()
+        # wait for the crash to land mid-pack (the worker thread dies
+        # at permutation 24, after the 16-perm checkpoint)
+        deadline = time.monotonic() + 120
+        while home.alive() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not home.alive(), "SimulatedCrash never fired"
+        # the eviction notice for the doomed capacity
+        out = fleet.evict_notice(home.rid, grace_s=1.0)
+        for t in threads:
+            t.join(timeout=600)
+        assert not errors, errors
+        st = fleet.stats()
+    finally:
+        fleet.close()
+    assert out is not None
+    assert out["replica"] == home.rid and out["peer"] == peer_rid
+    assert out["s"] > 0 and out["requeued"] == 3
+    dead_row = st["replicas"][home.rid]
+    assert dead_row["alive"] is False and dead_row["state"] == "dead"
+    assert st["replicas"][peer_rid]["done"] == 3
+    for k, kw in submits:
+        d = direct(fx, **kw)
+        np.testing.assert_array_equal(results[k]["observed"], d.observed)
+        np.testing.assert_array_equal(results[k]["p_values"],
+                                      np.asarray(d.p_values))
+        if kw.get("adaptive"):
+            np.testing.assert_array_equal(results[k]["n_perm_used"],
+                                          np.asarray(d.n_perm_used))
+    ev = read_events(str(tmp_path / "coord.jsonl"))
+    names = [e["ev"] for e in ev]
+    # handoff, not failover: the noticed departure never shows up as a
+    # loss
+    assert "replica_lost" not in names
+    assert "failover_start" not in names
+    assert "failover_done" not in names
+    notice = [e["data"] for e in ev if e["ev"] == "evict_notice"]
+    assert notice and notice[0]["replica"] == home.rid
+    assert notice[0]["grace_s"] == pytest.approx(1.0)
+    reb = [e["data"] for e in ev if e["ev"] == "ring_rebalanced"
+           and e["data"].get("reason") == "evict"]
+    assert reb and home.rid not in reb[0]["members"]
+    done = [e["data"] for e in ev if e["ev"] == "evict_handoff_done"]
+    assert done and done[0]["peer"] == peer_rid
+    assert done[0]["requeued"] == 3 and done[0]["s"] > 0
+    home_states = [(e["data"]["to"], e["data"]["reason"]) for e in ev
+                   if e["ev"] == "replica_state"
+                   and e["data"]["replica"] == home.rid]
+    assert ("draining", "evict") in home_states
+    assert home_states[-1] == ("dead", "drained")
+    # the peer ADOPTED (journal_replayed) and RESUMED the partial pack
+    # from the shared checkpoint dir — zero recompute of perms 1..16
+    pe = read_events(str(tmp_path / f"{peer_rid}_tel.jsonl"))
+    replay = [e for e in pe if e["ev"] == "journal_replayed"]
+    assert replay and replay[0]["data"]["adopted"] is True
+    assert replay[0]["data"]["requeued"] == 3
+    resumed = [e for e in pe if e["ev"] == "checkpoint_resumed"]
+    assert resumed and resumed[0]["data"]["completed"] >= 16
+    # the ops surfaces tell the eviction story
+    from netrep_tpu.utils.telemetry import render_recovery, \
+        render_replicas
+
+    timeline = render_recovery(str(tmp_path / "coord.jsonl"))
+    assert "evict_notice" in timeline
+    assert "evict_handoff_done" in timeline
+    assert "failover" not in timeline
+    section = render_replicas(str(tmp_path / "coord.jsonl"))
+    assert home.rid in section and "evict" in section

@@ -267,7 +267,10 @@ def test_replica_kill_failover_bit_parity(fx, tmp_path):
         st = fleet.stats()
     finally:
         fleet.close()
-    assert st["replicas"][home.rid] == {"alive": False}
+    # dead rows now also carry the lifecycle state + generation (ISSUE 19)
+    dead_row = st["replicas"][home.rid]
+    assert dead_row["alive"] is False
+    assert dead_row["state"] == "dead" and dead_row["gen"] == 0
     assert st["replicas"][peer_rid]["done"] == 3
     for k, kw in submits:
         d = direct(fx, **kw)
@@ -345,8 +348,11 @@ def test_fleet_admission_sheds_from_aggregate_estimate(fx, tmp_path):
     """Brownout goes fleet-wide: the shed decision reads the AGGREGATE
     backlog (summed across replicas) over the summed rate estimates —
     and answers with the honest drain-time hint."""
+    # heartbeat LONG: the workers deliberately never start, and the
+    # health loop must not declare them lost mid-test on a slow machine
+    # (this test is about the admission math, not liveness)
     fleet = make_fleet(
-        fx, tmp_path, start_servers=False,
+        fx, tmp_path, start_servers=False, heartbeat_s=30.0,
         fleet_config_kw=dict(brownout_enter_s=1.0, rate_pps=10.0),
     )
     try:

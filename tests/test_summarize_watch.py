@@ -71,6 +71,16 @@ def test_classify_provenance_rules():
         ({"replicas": 2, "requests": 3, "killed_replica": "r0",
           "recovered": True, "bit_identical": True, "ok": True},
          "serve-fleet"),
+        # autoscale / noticed-eviction rows (ISSUE 19): the square-wave
+        # load row and the chaos --fleet --evict summary — their own
+        # section, never folded into the kill-failover story
+        ({"metric": "serve-autoscale square-wave min1/max3 (9 req, "
+                    "2 evictions, chunk 32)", "value": 6.1, "unit": "s",
+          "replica_seconds": 8.2, "replica_seconds_static": 67.3,
+          "lost_requests": 0, "device": "TFRT_CPU_0"}, "serve-autoscale"),
+        ({"replicas": 2, "requests": 3, "evicted_replica": "r1",
+          "zero_recompute": True, "recovered": True,
+          "bit_identical": True, "ok": True}, "serve-autoscale"),
         # warm-start proof rows (ISSUE 15): CPU by design, classified
         # into their own section — never a BASELINE measurement, and
         # never confused with the serve-fleet prefix
@@ -118,6 +128,48 @@ def test_fleet_section_renders():
     assert "failover=0.25s" in text and "vs_1_replica=2.01" in text
     assert "chaos --fleet PASSED" in text
     assert "killed=r0" in text and "bit_identical=True" in text
+
+
+def test_autoscale_section_renders(tmp_path):
+    """ISSUE 19: the autoscale section shows the newest square-wave load
+    row (p99 vs the static peak fleet, replica-seconds saved, zero-lost
+    gate) and the newest chaos --fleet --evict verdict — and an evicted
+    summary never classifies into the serve-fleet kill section."""
+    rows = [
+        {"metric": "serve-autoscale square-wave min1/max3 (9 req, "
+                   "2 evictions, chunk 32)", "value": 6.1, "unit": "s",
+         "p99_ms": 2400.0, "p99_static_ms": 1900.0, "p99_within_2x": True,
+         "replica_seconds": 8.2, "replica_seconds_static": 67.3,
+         "replica_seconds_saved": 59.1, "lost_requests": 0,
+         "evictions": 2, "device": "TFRT_CPU_0"},
+        {"replicas": 2, "requests": 3, "evicted_replica": "r1",
+         "zero_recompute": True, "recovered": True, "bit_identical": True,
+         "ok": True},
+    ]
+    text = "\n".join(summarize_watch.autoscale_lines(rows))
+    assert "serve-autoscale square-wave" in text
+    assert "p99=2400.0ms vs static 1900.0ms" in text
+    assert "within_2x=True" in text
+    assert "replica_s=8.2 vs static 67.3 (saved=59.1)" in text
+    assert "lost=0" in text and "evictions=2" in text
+    assert "chaos --fleet --evict PASSED" in text
+    assert "evicted=r1" in text and "zero_recompute=True" in text
+    bad = "\n".join(summarize_watch.autoscale_lines(
+        [{**rows[1], "ok": False, "zero_recompute": False}]))
+    assert "FAILED" in bad and "zero_recompute=False" in bad
+
+    log = tmp_path / "watch.jsonl"
+    log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "benchmarks/summarize_watch.py", str(log)],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "autoscale drills (elastic-fleet + noticed-eviction health)" \
+        in out
+    # never folded into the kill-failover section
+    assert "fleet drills (kill-failover health)" not in out
 
 
 def test_warmstart_section_renders():

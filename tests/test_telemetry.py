@@ -307,6 +307,17 @@ def test_fleet_event_names_pinned():
         "failover_start",
         "failover_done",
         "ring_rebalanced",
+        # replica lifecycle + autoscaling (ISSUE 19): the state machine
+        # emits one replica_state per transition; the autoscaler's
+        # decisions, the scale-to-zero checkpoint, spawn-on-demand, and
+        # the noticed-eviction handoff pair are all first-class names
+        "replica_state",
+        "autoscale_up",
+        "autoscale_down",
+        "scale_to_zero",
+        "spawn_on_demand",
+        "evict_notice",
+        "evict_handoff_done",
     )
 
 
@@ -329,6 +340,16 @@ def test_replica_summary_folds_fleet_events():
         ev("failover_start", replica="r0", peer="r1"),
         ev("failover_done", replica="r0", peer="r1", s=0.25, requeued=2),
         ev("request_done", tenant="a", s=1.0),   # not a fleet event
+        # lifecycle + eviction fold (ISSUE 19): the LAST replica_state
+        # wins (state/gen), evict_notice counts, evict_handoff_done
+        # accumulates its measured seconds
+        ev("replica_state", replica="r1", prev="spawning", to="ready",
+           gen=0, reason="joined"),
+        ev("replica_state", replica="r1", prev="ready", to="draining",
+           gen=0, reason="evict"),
+        ev("evict_notice", replica="r1", grace_s=30.0),
+        ev("evict_handoff_done", replica="r1", peer="r0", s=0.5,
+           requeued=1, results=2),
     ]
     rows = replica_summary(events)
     assert set(rows) == {"r0", "r1"}
@@ -337,6 +358,10 @@ def test_replica_summary_folds_fleet_events():
     assert rows["r0"]["lost"] == 1 and rows["r0"]["failovers"] == 1
     assert rows["r0"]["failover_s"] == pytest.approx(0.25)
     assert rows["r1"]["joined"] == 1 and rows["r1"]["failovers"] == 0
+    assert rows["r1"]["state"] == "draining" and rows["r1"]["gen"] == 0
+    assert rows["r1"]["evictions"] == 1
+    assert rows["r1"]["handoff_s"] == pytest.approx(0.5)
+    assert rows["r0"]["evictions"] == 0 and rows["r0"]["state"] is None
 
 
 def test_grid_events_registered():

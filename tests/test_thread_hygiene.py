@@ -163,3 +163,63 @@ def test_fleet_drain_releases_all_threads(pair_kw, tmp_path):
         f"leaked threads after fleet drain: "
         f"{sorted(t.name for t in leftovers)}"
     )
+
+
+def test_autoscale_cycle_releases_all_threads(pair_kw, tmp_path):
+    """ISSUE 19: the autoscaler adds its own control-loop thread
+    (netrep-fleet-autoscale) on top of the fleet's, and a scale-down
+    retirement drains a whole replica (worker + shipper) mid-session —
+    after one live autoscale cycle (serve, idle, retire down to the
+    floor) and close(drain=True), the process must return to its
+    baseline thread set."""
+    from netrep_tpu.serve import AutoscaleConfig, Autoscaler, \
+        FleetConfig, ServeConfig, build_inprocess_fleet, \
+        inprocess_spawner
+
+    def mk(rid, jpath, ckpt):
+        return ServeConfig(engine=pair_kw["config"], journal=jpath,
+                           checkpoint_dir=ckpt)
+
+    # warm-up: one full fleet lifecycle absorbs lazy singletons
+    fleet0 = build_inprocess_fleet(
+        2, str(tmp_path / "warm"), make_config=mk,
+        fleet_config=FleetConfig(heartbeat_s=0.1),
+    )
+    fleet0.close(drain=False)
+    baseline = _live()
+
+    fleet = build_inprocess_fleet(
+        2, str(tmp_path / "fleet"), make_config=mk,
+        fleet_config=FleetConfig(
+            heartbeat_s=0.1,
+            telemetry=str(tmp_path / "fleet_tel.jsonl"),
+        ),
+    )
+    Autoscaler(
+        fleet, inprocess_spawner(str(tmp_path / "fleet"), make_config=mk),
+        AutoscaleConfig(scale_down_idle_s=0.5, cooldown_s=0.1,
+                        tick_s=0.05, min_replicas=1, max_replicas=2),
+    )
+    fleet.register_dataset("a", "d", network=pair_kw["network"]["d"],
+                           correlation=pair_kw["correlation"]["d"],
+                           data=pair_kw["data"]["d"],
+                           assignments=pair_kw["module_assignments"])
+    fleet.register_dataset("a", "t", network=pair_kw["network"]["t"],
+                           correlation=pair_kw["correlation"]["t"],
+                           data=pair_kw["data"]["t"])
+    res = fleet.analyze("a", "d", "t", n_perm=32, seed=3, timeout=600)
+    assert np.asarray(res["p_values"]).size
+    # the loop notices the idle fleet and retires down to the floor —
+    # a live mid-session drain of one replica's worker + shipper
+    deadline = time.monotonic() + 60
+    while (len(fleet.live_replicas()) > 1
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    assert len(fleet.live_replicas()) == 1
+    fleet.close(drain=True)   # stops the autoscaler thread first
+
+    leftovers = _settle(baseline)
+    assert not leftovers, (
+        f"leaked threads after autoscale cycle: "
+        f"{sorted(t.name for t in leftovers)}"
+    )

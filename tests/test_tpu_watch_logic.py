@@ -241,6 +241,40 @@ def test_grid_step_off_under_queue_hook_and_loud_never_fatal(tmp_path):
     assert "queue drained" in log2
 
 
+def test_autoscale_drill_off_under_queue_hook_and_loud_never_fatal(tmp_path):
+    """ISSUE 19: the autoscale drill is off by default and under the
+    QUEUE_FILE hook (auto); forced on, a failing scenario (scale loop,
+    scale-to-zero, or the noticed-eviction handoff) banners LOUDLY but
+    never fails the cycle — the queue still drains."""
+    # default off / auto under QUEUE_FILE: no autoscale banner
+    proc, _, log = run_watch(tmp_path, ["one 30 echo ok-one"])
+    assert proc.returncode == 0
+    assert "autoscale drill" not in log
+    proc_a, _, log_a = run_watch(
+        tmp_path, ["oneauto 30 echo ok-one"], tag="asauto",
+        extra_env={"AUTOSCALE_DRILL": "auto"},
+    )
+    assert proc_a.returncode == 0
+    assert "autoscale drill" not in log_a
+    # forced on with a python shim that fails both scenarios: each step
+    # banners and the cycle still completes (loud-never-fatal)
+    shim_dir = tmp_path / "bin"
+    shim_dir.mkdir()
+    shim = shim_dir / "python"
+    shim.write_text("#!/bin/sh\nexit 1\n")
+    shim.chmod(0o755)
+    proc2, _, log2 = run_watch(
+        tmp_path, ["two 30 echo ok-two"], tag="as",
+        extra_env={"AUTOSCALE_DRILL": "1",
+                   "PATH": f"{shim_dir}:{os.environ['PATH']}"},
+    )
+    assert proc2.returncode == 0, proc2.stderr
+    assert "autoscale drill" in log2
+    assert "AUTOSCALE LOAD SCENARIO FAILED" in log2
+    assert "EVICTION DRILL FAILED" in log2
+    assert "queue drained" in log2
+
+
 def test_lint_step_runs_when_forced_and_stays_off_under_queue_hook(tmp_path):
     """ISSUE 12: the per-cycle invariant lint is off under the
     QUEUE_FILE state-machine hook (auto), runs with LINT_CHECK=1, and
