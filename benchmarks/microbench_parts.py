@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import functools
+import json
 import os
 import sys
 import time
@@ -328,6 +329,86 @@ def stats_dispatch_overhead(n: int, cap: int, K: int, B: int, reps: int,
     return True
 
 
+def flightrec_overhead(genes=2000, n_perm=512, chunk=128, reps=3,
+                       bound=0.02):
+    """The always-on tax, measured where it bites (ISSUE 20): a real
+    streaming null loop with the flight recorder installed vs fully
+    uninstalled. The recorder is host-side only (ring append per emitted
+    event, nothing device-side), so the measured overhead must stay under
+    ``bound`` — asserted BEFORE any row is printed, so a regression can
+    never ride the ledger as a legitimate measurement. The recorder-on
+    rate is the row (that is the shipped configuration), under the
+    ``flightrec`` metric label."""
+    from netrep_tpu.data import make_mixed_pair
+    from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+    from netrep_tpu.utils import flightrec, perfledger
+    from netrep_tpu.utils.config import EngineConfig
+
+    mixed = make_mixed_pair(genes, 3, n_samples=16, seed=7)
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    specs = [ModuleSpec(lab, i, i) for lab, i in mixed["specs"]]
+    eng = PermutationEngine(
+        dc, dn, dd, tc, tn, td, specs, mixed["pool"],
+        config=EngineConfig(chunk_size=chunk, autotune=False),
+    )
+    observed = np.asarray(eng.observed())
+
+    def run():
+        sc = eng.run_null_streaming(n_perm, observed, key=0)
+        assert sc.completed == n_perm
+        return sc
+
+    def timed():
+        t0 = time.perf_counter()
+        run()
+        return time.perf_counter() - t0
+
+    assert flightrec.recorder() is not None, \
+        "flightrec_overhead needs the recorder installed (the default)"
+    run()                                 # warmup: compile + caches
+    # interleave the arms (on, off, on, off, ...) and keep each arm's
+    # best: a sequential A-then-B layout hands arm B every cache the
+    # warmup missed and fabricates an "overhead" that is really drift
+    on_s, off_s = [], []
+    try:
+        for _ in range(reps):
+            flightrec.install()
+            on_s.append(timed())
+            flightrec.uninstall()
+            off_s.append(timed())
+    finally:
+        flightrec.install()
+    t_on, t_off = min(on_s), min(off_s)
+    overhead = t_on / t_off - 1.0
+    assert overhead < bound, (
+        f"flight recorder overhead {overhead * 100:.2f}% exceeds the "
+        f"{bound * 100:.0f}% bound (on={t_on:.4f}s off={t_off:.4f}s "
+        f"over {reps} interleaved rep(s) each) — fix the ring before "
+        "publishing a rate"
+    )
+    row = {
+        "metric": "flightrec",
+        "device": str(jax.devices()[0]),
+        "chunk": chunk,
+        "perms_per_sec": n_perm / t_on,
+        "perms_per_sec_off": n_perm / t_off,
+        "overhead_pct": round(overhead * 100, 3),
+        "bound_pct": bound * 100,
+        "n_perm": n_perm,
+        "genes": genes,
+    }
+    if os.environ.get("NETREP_PERF_LEDGER"):
+        entry = perfledger.entry_from_bench_row(row)
+        if entry is not None:
+            perfledger.append_entry(entry,
+                                    os.environ["NETREP_PERF_LEDGER"])
+    print(json.dumps(row), flush=True)
+    print(f"flightrec overhead: {overhead * 100:+.2f}% "
+          f"(on {n_perm / t_on:,.0f} perms/s, off {n_perm / t_off:,.0f} "
+          f"perms/s, bound {bound * 100:.0f}%)", flush=True)
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--genes", type=int, default=20_000)
@@ -342,8 +423,17 @@ def main():
         "any fused benchmark row, sized to fit the short (~5-7 min) tunnel "
         "windows that the full decomposition sweep does not",
     )
+    ap.add_argument(
+        "--flightrec-only", action="store_true",
+        help="measure ONLY the flight recorder's streaming-loop overhead "
+        "(ISSUE 20): recorder-on vs recorder-off perms/s, asserted under "
+        "its bound before the row is printed/ledgered",
+    )
     args = ap.parse_args()
     ensure_backend()
+    if args.flightrec_only:
+        flightrec_overhead(reps=max(1, args.reps))
+        return
     print(f"device={jax.devices()[0]} matmul_default={jax.config.jax_default_matmul_precision}")
 
     n, cap, K, B = args.genes, args.cap, args.K, args.batch

@@ -440,6 +440,31 @@ def roofline_lines(events: list[dict]) -> list[str]:
     return lines
 
 
+def anomaly_lines(events: list[dict]) -> list[str]:
+    """Anomalies section (ISSUE 20) from ``anomaly_detected`` telemetry
+    events — the pinned detector registry's firings during the watch
+    window, grouped per detector with the count and the newest
+    occurrence's detail. This is the triage headline: a cycle whose rows
+    all parsed can still have burned SLO budget, degraded to CPU, or
+    refused a checkpoint resume, and those verdicts must never be
+    scrolled past."""
+    by_det: dict[str, list[dict]] = {}
+    for e in events:
+        d = e.get("data") or {}
+        by_det.setdefault(str(d.get("detector", "-")), []).append(e)
+    lines = []
+    for det in sorted(by_det):
+        evs = by_det[det]
+        last = evs[-1].get("data") or {}
+        detail = " ".join(
+            f"{k}={v}" for k, v in last.items()
+            if k not in ("detector", "span", "parent")
+        )
+        lines.append(f"{det}: fired x{len(evs)}"
+                     + (f" — last: {detail}" if detail else ""))
+    return lines
+
+
 def main(paths: list[str]) -> int:
     results, unknown, other, dropped, telemetry = [], [], [], 0, []
     ledger, lint, serve_cost, serve_top = [], [], [], []
@@ -517,6 +542,13 @@ def main(paths: list[str]) -> int:
     if ledger:
         print(f"## perf trend ({len(ledger)} ledger entries)")
         for line in perf_trend(ledger):
+            print(line)
+        print()
+    anomalies = [r for r in telemetry if r.get("ev") == "anomaly_detected"]
+    if anomalies:
+        print(f"## anomalies ({len(anomalies)} detector firing(s) — "
+              "triage before transcribing any row above)")
+        for line in anomaly_lines(anomalies):
             print(line)
         print()
     roofline = [r for r in telemetry if r.get("ev") == "roofline"]

@@ -31,6 +31,29 @@ export NETREP_TELEMETRY="$TELEMETRY"
 # real; the regression is for a human or CI to act on).
 PERF_LEDGER=${PERF_LEDGER:-${LOG%.jsonl}_perf_ledger.jsonl}
 export NETREP_PERF_LEDGER="$PERF_LEDGER"
+# Diagnostic bundles on failed/flagged steps (ISSUE 20): a nonzero step
+# rc or a perf-regression flag drops a `netrep_tpu bundle --collect`
+# artifact (flight ring, env, ledger tail, stacks) beside the log, so
+# the step's last minutes survive the tunnel that killed it. Loud but
+# never fatal; BUNDLE_STEP=0 disables; default 'auto' is on in
+# production and off under the QUEUE_FILE state-machine test hook.
+BUNDLE_DIR=${BUNDLE_DIR:-${LOG%.jsonl}_bundles}
+BUNDLE_STEP=${BUNDLE_STEP:-auto}
+step_bundle() {
+  # $1 = step key, $2 = why (failed | perf-regression | selftest-halt)
+  case "$BUNDLE_STEP" in
+    0) return 0 ;;
+    auto) [ -n "${QUEUE_FILE:-}" ] && return 0 ;;
+  esac
+  mkdir -p "$BUNDLE_DIR" 2>/dev/null || true
+  if bpath=$(timeout 60 python -m netrep_tpu bundle \
+      --collect "$BUNDLE_DIR/$1-$(date -u +%Y%m%dT%H%M%SZ)" \
+      --reason "step-$1-$2" 2>/dev/null); then
+    echo "--- diagnostic bundle for $1 ($2): $bpath ---" | tee -a "$LOG"
+  else
+    echo "--- diagnostic bundle for $1 ($2) FAILED to collect (non-fatal) ---" | tee -a "$LOG"
+  fi
+}
 # 45/45 defaults (was 60/150): windows run ~5-7 min, so a dead-tunnel
 # probe cycle must stay well under a window or most of it is lost before
 # the queue even starts (BASELINE.md measurement-session note). A live
@@ -534,6 +557,7 @@ while :; do
         if ! perf_out=$(timeout 60 python -m netrep_tpu perf "$PERF_LEDGER" --check 2>/dev/null); then
           echo "--- PERF REGRESSION after $key ---" | tee -a "$LOG"
           echo "$perf_out" | tee -a "$LOG"
+          step_bundle "$key" perf-regression
         fi
       fi
       # bench.py exits 0 on its own probe-race CPU-fallback rows, and the
@@ -579,8 +603,15 @@ while :; do
          grep -q 'selftest FAILED' "$step_out"; then
         echo "== DEVICE FAILED NUMERICAL SELFTEST; halting queue $(date -u +%FT%TZ) ==" | tee -a "$LOG"
         echo '{"warning": "device failed numerical selftest; queue halted - rows after this point would be untrusted"}' >>"$LOG"
+        step_bundle "$key" selftest-halt
         rm -f "$step_out"
         exit 3
+      fi
+      # any other genuinely failed step (nonzero rc, not a probe-race CPU
+      # fallback) gets its forensics bundle before the state machine
+      # decides what to do with it
+      if [ "$rc" -ne 0 ] && [ "$fellback" -eq 0 ]; then
+        step_bundle "$key" failed
       fi
       rm -f "$step_out"
       if [ "$rc" -eq 0 ] && [ "$fellback" -eq 0 ]; then

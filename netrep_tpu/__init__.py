@@ -11,6 +11,13 @@ Public API (mirrors the reference's exported surface, SURVEY.md §2.1):
 """
 
 from .ops.oracle import STAT_NAMES, TOPOLOGY_STATS
+from .utils import flightrec as _flightrec
+
+# always-on black-box flight recorder (ISSUE 20): a bounded in-memory
+# ring of recent telemetry events plus the ambient flight bus feeding it,
+# installed once per process. Stdlib-only and host-side only — import
+# stays light, numerics stay bit-identical, NETREP_FLIGHTREC=0 opts out.
+_flightrec.install()
 
 __version__ = "0.1.0"
 

@@ -951,6 +951,21 @@ def main(argv=None) -> int:
                          "summarize_watch.py classifies it)")
     ln.add_argument("--rule", action="append", metavar="NAME",
                     help="run only this rule (repeatable)")
+    bu = sub.add_parser(
+        "bundle",
+        help="diagnostic bundles (ISSUE 20): render a collected "
+             "bundle's triage report (timeline, detector verdicts, time "
+             "split), or --collect one from this process",
+    )
+    bu.add_argument("path", nargs="?", default=None,
+                    help="bundle directory to render (or the destination "
+                         "with --collect)")
+    bu.add_argument("--collect", action="store_true",
+                    help="collect a bundle now instead of rendering "
+                         "(dest = path, else netrep-bundle-<reason> in "
+                         "the CWD)")
+    bu.add_argument("--reason", default="manual",
+                    help="reason slug stamped on a --collect bundle")
     args = ap.parse_args(argv)
     if args.cmd is None:
         # bare invocation = selftest with its own argparse defaults (ONE
@@ -964,6 +979,26 @@ def main(argv=None) -> int:
         from netrep_tpu.analysis.linter import main_lint
 
         return main_lint(args)
+
+    if args.cmd == "bundle":
+        # backend-free forensics (ISSUE 20): rendering — and host-side
+        # collection — must work on a box whose tunnel is dead
+        from netrep_tpu.utils import bundle as fbundle
+
+        if args.collect:
+            path = fbundle.collect(dest=args.path, reason=args.reason)
+            print(path)
+            return 0
+        if args.path is None:
+            print("bundle: pass a bundle directory to render, or "
+                  "--collect", file=sys.stderr)
+            return 1
+        try:
+            print(fbundle.render_report(args.path))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"cannot render {args.path!r}: {e}", file=sys.stderr)
+            return 1
+        return 0
 
     if args.cmd == "perf":
         # backend-free like the telemetry report: the regression gate must
@@ -992,6 +1027,13 @@ def main(argv=None) -> int:
                 print(f"cannot read {ledger!r}: {e}", file=sys.stderr)
                 return 1
             print(report)
+            if not ok:
+                # the drift verdict is a pinned anomaly (ISSUE 20): emit
+                # it through the detector registry so the flight ring /
+                # an auto-bundle records WHY a watch cycle flagged
+                from netrep_tpu.utils import detectors
+
+                detectors.fire("perf_drift", ledger=ledger)
             return 0 if ok else 2
         if not args.ingest:
             try:
@@ -1038,6 +1080,11 @@ def main(argv=None) -> int:
                 print(f"cannot read {ledger!r}: {e}", file=sys.stderr)
                 return 1
             print(report)
+            if not ok:
+                # same pinned-anomaly routing as `perf --check` above
+                from netrep_tpu.utils import detectors
+
+                detectors.fire("roofline_drift", ledger=ledger)
             return 0 if ok else 2
         return 0
 

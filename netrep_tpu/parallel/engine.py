@@ -72,8 +72,10 @@ def _profile_totals(profile) -> tuple[int, int]:
 def _mem_probe(telemetry):
     """Per-run device-memory gauge probe (None when telemetry is off or
     the backend exposes no memory accounting) — resolved once per run so
-    the per-chunk cost is a dict build, not a capability probe."""
-    if telemetry is None:
+    the per-chunk cost is a dict build, not a capability probe. The
+    always-on flight bus (ISSUE 20) does NOT arm the probe: flight-only
+    capture must stay pure host-side bookkeeping."""
+    if telemetry is None or getattr(telemetry, "flight_only", False):
         return None
     from ..utils.profiling import make_memory_probe
 
@@ -122,8 +124,10 @@ def _run_cost_tracker(base, telemetry):
     """Per-run roofline cost tracker (ISSUE 18), resolved once inside the
     telemetry branch — the disabled hot path keeps its single ``None``
     check (PR 3 contract) and native engines without the analytic model
-    get None (cost fields omitted, never guessed)."""
-    if telemetry is None:
+    get None (cost fields omitted, never guessed). Flight-only runs
+    (ISSUE 20) get None too: the recorder must not write the process
+    roofline note an explicitly-instrumented run would otherwise own."""
+    if telemetry is None or getattr(telemetry, "flight_only", False):
         return None
     from ..utils import costmodel
 
@@ -173,6 +177,11 @@ def _finish_run_accounting(base, telemetry, run_sid, t_marks, t_run0,
         roofline = tracker.roofline_block(rate)
         telemetry.emit("roofline", parent=run_sid, mode=mode, **roofline)
         costmodel.record_run_note(roofline)
+    if getattr(telemetry, "flight_only", False):
+        # flight-only runs (ISSUE 20) never feed the perf ledger: the
+        # always-on recorder must not grow regression history that only
+        # deliberately-instrumented runs used to produce
+        return
     from ..utils import perfledger
 
     perfledger.maybe_record_run(

@@ -530,6 +530,32 @@ class FleetCoordinator:
         )
         return os.path.join(base, "ship", f"{rid}.jsonl")
 
+    def _collect_bundle(self, rid: str, reason: str) -> str | None:
+        """Auto-collect the departed replica's diagnostic bundle
+        (ISSUE 20): flight ring, env, and the shipped journal copy's
+        REDACTED tail, under ``<fleet_dir>/bundles/``. Loud-never-fatal —
+        forensics must never block a failover or handoff."""
+        base = self.config.fleet_dir or os.path.join(
+            os.getcwd(), "netrep_fleet"
+        )
+        from ..utils import bundle
+
+        try:
+            path = bundle.collect(
+                os.path.join(base, "bundles",
+                             f"netrep-bundle-{reason}-{rid}"),
+                reason=reason, telemetry=self.tel,
+                journal=self._ship_dest(rid),
+            )
+        # netrep: allow(exception-taxonomy) — bundle collection is best-effort forensics; the fleet keeps serving either way
+        except Exception:
+            logger.warning("fleet: bundle collection for departed "
+                           "replica %s failed", rid, exc_info=True)
+            return None
+        logger.info("fleet: collected diagnostic bundle for %s at %s",
+                    rid, path)
+        return path
+
     def live_replicas(self) -> dict[str, object]:
         """Replicas still serving: not dead, not mid-drain (a draining
         replica is off the ring and counts as departed capacity)."""
@@ -661,6 +687,7 @@ class FleetCoordinator:
             self.tel.emit("ring_rebalanced", replica=rid,
                           parent=self._serve_sid, reason="leave",
                           members=",".join(members))
+        self._collect_bundle(rid, "replica_failover")
         cb = self.on_failover
         if cb is not None:
             try:
@@ -808,6 +835,8 @@ class FleetCoordinator:
                           parent=self._serve_sid, peer=out["peer"],
                           s=out["s"], requeued=out["requeued"],
                           results=out["results"])
+        if out is not None:
+            self._collect_bundle(rid, "replica_evicted")
         return out
 
     # -- routing -----------------------------------------------------------

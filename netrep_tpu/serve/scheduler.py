@@ -289,6 +289,10 @@ class _Tenant:
         #: (monotonic_t, missed_slo) per terminal request — the SLO
         #: burn-rate sliding window
         self.slo_marks: list[tuple[float, bool]] = []
+        #: hysteresis latch for the ``slo_burn`` anomaly detector
+        #: (ISSUE 20): fire once when the burn rate first crosses 1.0,
+        #: re-arm only after it drops back under budget
+        self.burn_flagged = False
 
 
 class _Dataset:
@@ -1386,6 +1390,21 @@ class PreservationServer:
         horizon = now - self.config.slo_window_s
         while ten.slo_marks and ten.slo_marks[0][0] < horizon:
             ten.slo_marks.pop(0)
+        # slo_burn anomaly (ISSUE 20), latched per excursion: the first
+        # mark that pushes the tenant past its error budget fires the
+        # pinned detector; recovery below budget re-arms it. Same
+        # emit-under-lock precedent as the brownout transition events.
+        burn = self._burn_rate_locked(ten, now)
+        if missed and burn > 1.0 and not ten.burn_flagged:
+            ten.burn_flagged = True
+            from ..utils import detectors
+
+            detectors.fire("slo_burn", telemetry=self.tel,
+                           tenant=ten.name, burn_rate=round(burn, 4),
+                           window_s=self.config.slo_window_s,
+                           budget=self.config.slo_budget)
+        elif burn <= 1.0:
+            ten.burn_flagged = False
 
     def _burn_rate_locked(self, ten: _Tenant, now: float) -> float:
         """SLO burn rate: miss fraction over the sliding window divided

@@ -165,6 +165,19 @@ def dispatch_op(server: PreservationServer, op: dict,
             stop.set()
             return {"ok": True, "draining": True, "evict": True,
                     "grace_s": float(op.get("grace_s") or 30.0)}
+        if kind == "dump":
+            # live-forensics wire op (ISSUE 20): collect a diagnostic
+            # bundle from the running server — flight ring, env, and the
+            # journal's REDACTED tail — without stopping anything
+            from ..utils import bundle
+
+            path = bundle.collect(
+                dest=(str(op["dest"]) if op.get("dest") else None),
+                reason=str(op.get("reason") or "dump"),
+                telemetry=server.tel,
+                journal=server.config.journal,
+            )
+            return {"ok": True, "bundle": path}
         return _malformed(server, f"unknown op {kind!r}")
     except QueueFull as e:
         # admission-control rejection: retryable by contract, with the
@@ -270,6 +283,27 @@ def serve_daemon(args) -> int:
 
     signal.signal(signal.SIGTERM, _drain_signal)
     signal.signal(signal.SIGINT, _drain_signal)
+
+    if hasattr(signal, "SIGUSR2"):
+        def _dump_signal(signum, frame):
+            # live forensics on demand (ISSUE 20): `kill -USR2 <pid>`
+            # drops a diagnostic bundle beside the process without
+            # touching the serve loop — same collection as the `dump`
+            # wire op, loud-never-fatal
+            from ..utils import bundle
+
+            try:
+                path = bundle.collect(reason="sigusr2",
+                                      telemetry=server.tel,
+                                      journal=server.config.journal)
+                print(f"SIGUSR2: diagnostic bundle at {path}",
+                      file=sys.stderr, flush=True)
+            # netrep: allow(exception-taxonomy) — a forensics failure inside a signal handler must never kill a serving daemon
+            except Exception as e:
+                print(f"SIGUSR2: bundle collection failed: {e}",
+                      file=sys.stderr, flush=True)
+
+        signal.signal(signal.SIGUSR2, _dump_signal)
 
     if args.socket:
         path = args.socket

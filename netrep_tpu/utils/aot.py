@@ -427,6 +427,12 @@ class ProgramStore:
             "AOT store entry quarantined (%s): %s — the jit path "
             "compiles as before", reason, bin_path,
         )
+        # a quarantined entry is a pinned anomaly (ISSUE 20): a store
+        # that silently sheds entries is exactly the cold-start slip the
+        # flight ring should explain after the fact
+        from . import detectors
+
+        detectors.fire("aot_refused", reason=reason, path=str(bin_path))
 
     def _load_variant(self, key: str, sig: str, args, export_fn):
         """One signature's entry → an executable callable, or None (plain

@@ -47,6 +47,15 @@ def _telemetry():
     return current()
 
 
+def _refused(path: str, why: str) -> None:
+    """A refused resume is a pinned anomaly (ISSUE 20): route it through
+    the detector registry before the raise so the flight ring records
+    WHY the run restarted from zero."""
+    from . import detectors
+
+    detectors.fire("checkpoint_refused", path=str(path), why=why)
+
+
 def content_digest(arrays) -> str:
     """Cheap content digest of problem matrices: shapes plus a strided
     sample of up to 4096 elements per array. Catches "same module layout,
@@ -288,12 +297,14 @@ def load_null_checkpoint(path: str) -> dict | None:
         return None
     with np.load(path) as z:
         if "version" not in z.files:
+            _refused(path, "no_version_marker")
             raise ValueError(
                 f"{path!r} is not a null checkpoint (no version marker — "
                 "saved PreservationResult files and other .npz files cannot "
                 "be resumed from)"
             )
         if int(z["version"]) != _FORMAT_VERSION:
+            _refused(path, "format_version")
             raise ValueError(
                 f"checkpoint {path!r} has format version {int(z['version'])}, "
                 f"this build reads version {_FORMAT_VERSION}"
@@ -358,6 +369,7 @@ def validate_identity(
     fp = ckpt["fingerprint"]
     if fp.shape != fingerprint.shape or not np.array_equal(fp, fingerprint):
         if not _DEGRADED_ACCEPT:
+            _refused(path, "fingerprint_mismatch")
             raise ValueError(
                 f"checkpoint {path!r} was written for a different problem "
                 "(module set, sizes, pool, data presence, or store_nulls "
@@ -379,6 +391,7 @@ def validate_identity(
         )
     kd = np.asarray(ckpt["key_data"])
     if kd.shape != np.asarray(key_data).shape or not np.array_equal(kd, key_data):
+        _refused(path, "prng_key_mismatch")
         raise ValueError(
             f"checkpoint {path!r} was written with a different PRNG key/seed; "
             "resuming would splice two different null distributions — use the "

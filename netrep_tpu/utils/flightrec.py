@@ -1,0 +1,219 @@
+"""Black-box flight recorder (ISSUE 20): an always-on, bounded, in-memory
+ring of recent telemetry events.
+
+Every observability plane before this one (JSONL telemetry, spans,
+tracing, roofline) is opt-in and OFF by default, so the minutes before an
+incident — a stall escalation, a replica death mid-pack, a drift verdict
+in a watch cycle — were simply gone. This module closes that gap with two
+pieces that ride the existing ambient-bus seam without touching the
+engine's hot loop:
+
+- :class:`FlightRecorder` — a deterministic ring of serialized event
+  lines bounded by BOTH an entry count and a byte budget
+  (``NETREP_FLIGHTREC_ENTRIES`` / ``NETREP_FLIGHTREC_BYTES``): append one,
+  evict oldest-first until both bounds hold again, never below one entry.
+  It is fed by the process-wide flight observer hook
+  (:func:`netrep_tpu.utils.telemetry.set_flight_observer`), which fires
+  for every event emitted on ANY bus — so the ring captures a run's chunk
+  beats, span opens/closes, and gauges even when no JSONL sink exists.
+
+- :class:`FlightBus` — a sink-less :class:`~netrep_tpu.utils.telemetry.
+  Telemetry` installed at the BOTTOM of the ambient stack by
+  :func:`install` (package import does this once; ``NETREP_FLIGHTREC=0``
+  opts out). ``resolve()``/``current()`` therefore return it only when no
+  user bus is active — an explicit or activated bus still wins (innermost
+  = last), so every existing telemetry contract is preserved. The bus is
+  marked ``flight_only = True``; the engine uses that flag to keep
+  flight-only runs out of the perf ledger, the roofline note, and the
+  device-memory probe, which keeps recorder-on runs bit-identical to
+  recorder-off runs (host-side capture only — nothing device-side ever
+  depends on the recorder).
+
+The ring is drained into ``flight_ring.jsonl`` by a diagnostic bundle
+(:mod:`netrep_tpu.utils.bundle`) — the black box a post-incident session
+reads first.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+
+from . import telemetry as tm
+
+#: master opt-out: ``NETREP_FLIGHTREC=0`` disables install() entirely
+ENV_TOGGLE = "NETREP_FLIGHTREC"
+#: ring entry bound override (int > 0)
+ENV_ENTRIES = "NETREP_FLIGHTREC_ENTRIES"
+#: ring byte bound override (int > 0; bytes of serialized JSONL)
+ENV_BYTES = "NETREP_FLIGHTREC_BYTES"
+
+#: default bounds: enough for several minutes of chunk beats around an
+#: incident while staying invisible in a long-lived server's RSS
+DEFAULT_ENTRIES = 2048
+DEFAULT_BYTES = 2 << 20
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+class FlightRecorder:
+    """Bounded ring of serialized telemetry event lines.
+
+    Entries are stored as the JSON text that would have hit a JSONL sink,
+    so byte accounting is exact and a bundle dump is a straight write.
+    Eviction is deterministic: strictly oldest-first, until both the
+    entry bound and the byte bound hold, but never below one entry (the
+    newest event is always retained even if it alone exceeds the byte
+    budget). Thread-safe — the observer hook fires from whatever thread
+    emitted the event."""
+
+    def __init__(self, max_entries: int | None = None,
+                 max_bytes: int | None = None):
+        self.max_entries = (max_entries if max_entries is not None
+                            else _env_int(ENV_ENTRIES, DEFAULT_ENTRIES))
+        self.max_bytes = (max_bytes if max_bytes is not None
+                          else _env_int(ENV_BYTES, DEFAULT_BYTES))
+        if self.max_entries < 1 or self.max_bytes < 1:
+            raise ValueError("flight ring bounds must be >= 1")
+        self._lock = threading.Lock()
+        self._ring: deque[str] = deque()
+        self._bytes = 0
+        self.n_seen = 0
+        self.n_evicted = 0
+
+    def record(self, record: dict) -> None:
+        """Append one event record (already-shaped telemetry dict)."""
+        try:
+            line = json.dumps(record, default=tm._json_default)
+        except (TypeError, ValueError):
+            return  # an unserializable observer payload is not worth a crash
+        nb = len(line.encode("utf-8", errors="replace"))
+        with self._lock:
+            self.n_seen += 1
+            self._ring.append(line)
+            self._bytes += nb
+            while len(self._ring) > 1 and (
+                len(self._ring) > self.max_entries
+                or self._bytes > self.max_bytes
+            ):
+                old = self._ring.popleft()
+                self._bytes -= len(old.encode("utf-8", errors="replace"))
+                self.n_evicted += 1
+
+    def lines(self) -> list[str]:
+        """Ring contents, oldest first, as serialized JSON lines."""
+        with self._lock:
+            return list(self._ring)
+
+    def snapshot(self) -> list[dict]:
+        """Ring contents, oldest first, as parsed event dicts."""
+        return [json.loads(line) for line in self.lines()]
+
+    def dump_jsonl(self, path: str) -> int:
+        """Write the ring to ``path`` as JSONL; returns entries written."""
+        lines = self.lines()
+        with open(path, "w", encoding="utf-8") as f:
+            for line in lines:
+                f.write(line + "\n")
+        return len(lines)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._ring),
+                "bytes": self._bytes,
+                "max_entries": self.max_entries,
+                "max_bytes": self.max_bytes,
+                "n_seen": self.n_seen,
+                "n_evicted": self.n_evicted,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._bytes = 0
+
+
+class FlightBus(tm.Telemetry):
+    """Sink-less ambient bus the recorder installs at the bottom of the
+    telemetry stack: runs that would otherwise resolve no bus resolve
+    this one, so their events reach the flight ring (via the observer)
+    instead of vanishing. ``flight_only`` marks it for the engine's
+    accounting gates — a flight-only run must never write perf-ledger
+    history or the process roofline note."""
+
+    flight_only = True
+
+    def __init__(self):
+        super().__init__(path=None, run_id="flight")
+
+
+#: module singletons managed by install()/uninstall()
+_RECORDER: FlightRecorder | None = None
+_BUS: FlightBus | None = None
+
+
+def enabled() -> bool:
+    """Whether the always-on recorder is allowed in this process."""
+    return os.environ.get(ENV_TOGGLE, "1") != "0"
+
+
+def _observe(bus: tm.Telemetry, record: dict) -> None:
+    """Process-wide flight observer: ring capture + anomaly scan."""
+    rec = _RECORDER
+    if rec is None:
+        return
+    rec.record(record)
+    from . import detectors
+
+    detectors.scan(bus, record)
+
+
+def install() -> FlightRecorder | None:
+    """Install the always-on recorder (idempotent): create the ring,
+    register the flight observer, and seat the :class:`FlightBus` at the
+    bottom of the ambient stack. Called once at package import; returns
+    the recorder, or None when ``NETREP_FLIGHTREC=0`` opted out."""
+    global _RECORDER, _BUS
+    if not enabled():
+        return None
+    if _RECORDER is not None:
+        return _RECORDER
+    _RECORDER = FlightRecorder()
+    _BUS = FlightBus()
+    tm._ACTIVE.insert(0, _BUS)
+    tm.set_flight_observer(_observe)
+    return _RECORDER
+
+
+def uninstall() -> None:
+    """Tear the recorder down (tests; also the bit-identity drill's
+    recorder-off arm)."""
+    global _RECORDER, _BUS
+    tm.set_flight_observer(None)
+    if _BUS is not None and _BUS in tm._ACTIVE:
+        tm._ACTIVE.remove(_BUS)
+    _RECORDER = None
+    _BUS = None
+
+
+def recorder() -> FlightRecorder | None:
+    """The installed ring, or None when the recorder is off."""
+    return _RECORDER
+
+
+def bus() -> FlightBus | None:
+    """The installed ambient flight bus, or None when the recorder is
+    off."""
+    return _BUS

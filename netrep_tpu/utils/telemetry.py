@@ -363,11 +363,27 @@ GRID_EVENTS = (
     "grid_warmstart_seeded",
 )
 
+#: incident-forensics event names (ISSUE 20 flight recorder):
+#: ``anomaly_detected`` is the one event every pinned detector
+#: (:data:`netrep_tpu.utils.detectors.DETECTORS`) fires, always carrying
+#: a ``detector`` label; ``flightrec_dump`` marks the flight ring being
+#: drained (the mark itself lands in the ring first, so a dumped ring is
+#: self-describing); ``bundle_written`` records a diagnostic bundle
+#: landing on disk with its ``reason`` and path. Pinned beside the other
+#: registries for the same reason: the ``--recovery`` timeline, the
+#: watcher's anomalies section, and the ``telemetry-registry`` lint rule
+#: all key on these names.
+FORENSIC_EVENTS = (
+    "anomaly_detected",
+    "flightrec_dump",
+    "bundle_written",
+)
+
 #: the union the ``telemetry-registry`` lint rule checks literal event
 #: names against — every registry above, nothing else
 KNOWN_EVENTS = frozenset(
     ENGINE_EVENTS + RECOVERY_EVENTS + SERVE_EVENTS + FLEET_EVENTS
-    + SPAN_EVENTS + GRID_EVENTS
+    + SPAN_EVENTS + GRID_EVENTS + FORENSIC_EVENTS
 )
 
 
@@ -624,6 +640,13 @@ class Telemetry:
             # netrep: allow(exception-taxonomy) — telemetry only observes: a raising subscriber is logged, the run continues bit-identically
             except Exception:  # observers must never break the run
                 logger.warning("telemetry subscriber raised", exc_info=True)
+        hook = _FLIGHT_OBSERVER
+        if hook is not None:
+            try:
+                hook(self, record)
+            # netrep: allow(exception-taxonomy) — the flight recorder only observes: a ring/detector bug must never break the run it records
+            except Exception:
+                logger.warning("flight observer raised", exc_info=True)
         return record
 
     # -- hierarchical spans (ISSUE 5) --------------------------------------
@@ -751,6 +774,20 @@ def _json_default(v):
 #: ambient telemetry stack (innermost active bus wins)
 _ACTIVE: list[Telemetry] = []
 
+#: process-wide flight-recorder observer (ISSUE 20): called as
+#: ``hook(bus, record)`` with every event emitted on ANY bus — outside
+#: the bus lock, after subscribers, exception-suppressed. One slot, not a
+#: list: the flight recorder is a singleton plane, and a single slot
+#: keeps the disabled path a None check.
+_FLIGHT_OBSERVER = None
+
+
+def set_flight_observer(fn) -> None:
+    """Install (or clear, with None) the process-wide flight observer —
+    the seam :mod:`netrep_tpu.utils.flightrec` captures through."""
+    global _FLIGHT_OBSERVER
+    _FLIGHT_OBSERVER = fn
+
 
 def current() -> Telemetry | None:
     """The ambient :class:`Telemetry`, or None when telemetry is off."""
@@ -860,9 +897,15 @@ class StallWatchdog:
                 now - self._last
                 if self._fired and self._last is not None else None
             )
-            if self._last is not None and self._beats >= 1:
+            if (self._last is not None and self._beats >= 1
+                    and stalled_s is None):
                 # the interval ending at beat 1 absorbed the first chunk's
-                # compile — steady state starts at beat 2
+                # compile — steady state starts at beat 2. An interval
+                # that ends a FIRED stall episode is excluded too: folding
+                # the stalled duration into the median silently inflates
+                # steady_s, and a second comparable stall then never
+                # crosses factor × steady — the re-armed warning and
+                # action would go quiet exactly when they matter.
                 self._intervals.append(now - self._last)
             self._beats += 1
             beats = self._beats
@@ -922,10 +965,16 @@ class StallWatchdog:
                 elapsed, self.factor, steady,
             )
         if act is not None:
-            logger.warning(
-                "stall escalation: no chunk in %.1fs (> %.0fx steady) — "
-                "checkpointing completed work and abandoning the hung "
-                "dispatch", elapsed, self.action_factor,
+            # the escalation is an anomaly verdict, not just a log line:
+            # route it through the pinned detector registry so it emits
+            # `anomaly_detected` and can trigger a diagnostic bundle
+            from . import detectors
+
+            detectors.fire(
+                "stall_escalation", telemetry=self.telemetry,
+                elapsed_s=float(elapsed), steady_chunk_s=float(steady),
+                action_factor=float(self.action_factor),
+                chunks_done=int(beats),
             )
             try:
                 act()
@@ -1151,10 +1200,17 @@ def render_recovery(path: str) -> str:
         if t0 is None:
             t0 = e["t"]
         if (e["ev"] not in RECOVERY_EVENTS
-                and e["ev"] not in FLEET_EVENTS):
+                and e["ev"] not in FLEET_EVENTS
+                and e["ev"] not in FORENSIC_EVENTS):
             continue
-        data = " ".join(f"{k}={v}" for k, v in e["data"].items())
-        lines.append(f"+{e['t'] - t0:9.2f}s  {e['ev']:<24} {data}")
+        d = dict(e["data"])
+        label = ""
+        if e["ev"] in FORENSIC_EVENTS:
+            # anomaly verdicts read as first-class timeline entries with
+            # their detector name up front (ISSUE 20)
+            label = f" [detector={d.pop('detector', '-')}]"
+        data = " ".join(f"{k}={v}" for k, v in d.items())
+        lines.append(f"+{e['t'] - t0:9.2f}s  {e['ev']:<24}{label} {data}")
     return "\n".join(lines)
 
 

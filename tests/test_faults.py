@@ -644,10 +644,15 @@ def test_cli_recovery_timeline(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr
     lines = proc.stdout.strip().splitlines()
-    assert len(lines) == 3  # the chunk event is not a recovery event
+    # the chunk event is not a recovery event; the degraded_to_cpu rung
+    # additionally fires its pinned anomaly detector (ISSUE 20), whose
+    # verdict renders with the detector label
+    assert len(lines) == 4
     assert "fault_injected" in lines[0]
     assert "retry_attempt" in lines[1]
     assert "degraded_to_cpu" in lines[2]
+    assert "anomaly_detected" in lines[3]
+    assert "[detector=degraded_to_cpu]" in lines[3]
     # summary table leads with the recovery section
     table = subprocess.run(
         [sys.executable, "-m", "netrep_tpu", "telemetry", str(path)],

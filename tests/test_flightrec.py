@@ -1,0 +1,535 @@
+"""Black-box flight recorder, pinned anomaly detectors, and diagnostic
+bundles (ISSUE 20).
+
+Covers: ring overflow determinism (entry AND byte bounds, oldest-first
+eviction, never below one entry), the pinned detector catalogue, one
+readable bundle per detector, ``NETREP_FAULT_PLAN`` device-loss drills
+across all four null-loop modes (ring captures the trigger plus the
+preceding chunk beats WITHOUT any JSONL sink), bundle redaction (journal
+tails carry digests, never raw payloads), the ``dump`` wire op and
+SIGUSR2 on a live server, coordinator bundle collection on fleet kill
+and eviction handoff, auto-bundle cooldown, and the pinned bit-identity
+guarantee: recorder-on results equal recorder-off results.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from netrep_tpu.data import make_mixed_pair
+from netrep_tpu.parallel.engine import ModuleSpec, PermutationEngine
+from netrep_tpu.utils import bundle, detectors, flightrec
+from netrep_tpu.utils import telemetry as tm
+from netrep_tpu.utils.config import EngineConfig, FaultPolicy
+from netrep_tpu.utils.faults import DeviceLostError
+from netrep_tpu.utils.telemetry import Telemetry
+
+CFG = EngineConfig(chunk_size=16, summary_method="eigh", superchunk=2,
+                   autotune=False)
+N_PERM = 64
+
+MODES = ("fixed", "adaptive", "stream", "adaptive_stream")
+
+
+@pytest.fixture(scope="module")
+def mixed():
+    return make_mixed_pair(120, 3, n_samples=16, seed=7)
+
+
+@pytest.fixture(scope="module")
+def eng(mixed):
+    (dd, dc, dn), (td, tc, tn) = mixed["discovery"], mixed["test"]
+    specs = [ModuleSpec(lab, idx, idx) for lab, idx in mixed["specs"]]
+    return PermutationEngine(
+        dc, dn, dd, tc, tn, td, specs, mixed["pool"], config=CFG
+    )
+
+
+@pytest.fixture(scope="module")
+def observed(eng):
+    return np.asarray(eng.observed())
+
+
+def _run(eng, mode, observed, **kw):
+    if mode == "fixed":
+        nulls, done = eng.run_null(N_PERM, key=0, **kw)
+        return "mat", nulls, done, done == N_PERM
+    if mode == "adaptive":
+        nulls, done, fin = eng.run_null_adaptive(
+            N_PERM, observed, key=0, **kw
+        )
+        return "mat", nulls, done, fin
+    if mode == "stream":
+        sc = eng.run_null_streaming(N_PERM, observed, key=0, **kw)
+        return "sc", sc, sc.completed, sc.completed == N_PERM
+    sc = eng.run_null_adaptive_streaming(N_PERM, observed, key=0, **kw)
+    return "sc", sc, sc.completed, sc.finished
+
+
+@pytest.fixture(autouse=True)
+def forensics():
+    """Every test starts with the always-on recorder installed (package
+    import did that), an empty ring, and armed detector cooldowns."""
+    assert flightrec.recorder() is not None, \
+        "package import must install the flight recorder"
+    flightrec.recorder().clear()
+    detectors.reset()
+    yield
+    detectors.reset()
+
+
+def _record(i, payload=None):
+    return {"v": 1, "t": float(i), "m": {}, "run": "r",
+            "ev": f"e{i}", "data": payload or {"i": i}}
+
+
+# ---------------------------------------------------------------------------
+# ring bounds + determinism
+# ---------------------------------------------------------------------------
+
+def test_ring_entry_bound_evicts_oldest_first():
+    rec = flightrec.FlightRecorder(max_entries=4, max_bytes=1 << 20)
+    for i in range(10):
+        rec.record(_record(i))
+    evs = [e["ev"] for e in rec.snapshot()]
+    assert evs == ["e6", "e7", "e8", "e9"]   # strictly the newest suffix
+    st = rec.stats()
+    assert st["entries"] == 4 and st["n_seen"] == 10
+    assert st["n_evicted"] == 6
+
+
+def test_ring_byte_bound_honored_never_below_one_entry():
+    line_len = len(json.dumps(_record(0)).encode())
+    rec = flightrec.FlightRecorder(max_entries=1 << 20,
+                                   max_bytes=3 * line_len)
+    for i in range(10):
+        rec.record(_record(i))
+    st = rec.stats()
+    assert st["bytes"] <= 3 * line_len
+    assert [e["ev"] for e in rec.snapshot()] == ["e7", "e8", "e9"]
+    # one entry bigger than the whole budget is still retained: the
+    # newest event must never be dropped by its own size
+    tiny = flightrec.FlightRecorder(max_entries=8, max_bytes=4)
+    tiny.record(_record(0, {"pad": "x" * 100}))
+    assert tiny.stats()["entries"] == 1
+    assert tiny.snapshot()[0]["ev"] == "e0"
+
+
+def test_ring_eviction_is_deterministic():
+    a = flightrec.FlightRecorder(max_entries=5, max_bytes=400)
+    b = flightrec.FlightRecorder(max_entries=5, max_bytes=400)
+    for i in range(50):
+        a.record(_record(i))
+        b.record(_record(i))
+    assert a.lines() == b.lines()
+    assert a.stats() == b.stats()
+
+
+def test_ring_env_bounds(monkeypatch):
+    monkeypatch.setenv(flightrec.ENV_ENTRIES, "7")
+    monkeypatch.setenv(flightrec.ENV_BYTES, "12345")
+    rec = flightrec.FlightRecorder()
+    assert rec.max_entries == 7 and rec.max_bytes == 12345
+    monkeypatch.setenv(flightrec.ENV_ENTRIES, "bogus")
+    monkeypatch.setenv(flightrec.ENV_BYTES, "-1")
+    rec = flightrec.FlightRecorder()
+    assert rec.max_entries == flightrec.DEFAULT_ENTRIES
+    assert rec.max_bytes == flightrec.DEFAULT_BYTES
+
+
+def test_ring_dump_round_trips(tmp_path):
+    rec = flightrec.FlightRecorder(max_entries=8, max_bytes=1 << 20)
+    for i in range(5):
+        rec.record(_record(i))
+    out = str(tmp_path / "ring.jsonl")
+    assert rec.dump_jsonl(out) == 5
+    assert [json.loads(ln)["ev"] for ln in open(out)] == [
+        "e0", "e1", "e2", "e3", "e4"]
+
+
+# ---------------------------------------------------------------------------
+# pinned detector catalogue
+# ---------------------------------------------------------------------------
+
+def test_detector_catalogue_is_pinned():
+    assert detectors.DETECTORS == (
+        "stall_escalation", "device_lost", "degraded_to_cpu", "slo_burn",
+        "brownout", "replica_failover", "replica_evicted", "perf_drift",
+        "roofline_drift", "checkpoint_refused", "aot_refused",
+    )
+    # every event-mapped trigger resolves to a pinned detector, off a
+    # known event name
+    for ev, name in detectors.EVENT_DETECTORS.items():
+        assert name in detectors.DETECTORS
+        assert ev in tm.KNOWN_EVENTS
+    with pytest.raises(ValueError, match="unknown detector"):
+        detectors.fire("made_up_detector")
+
+
+def test_every_detector_produces_a_readable_bundle(tmp_path, monkeypatch):
+    """The acceptance loop: each of the pinned detectors, when fired,
+    yields one bundle whose ring holds the anomaly and whose rendered
+    report names the detector."""
+    monkeypatch.setenv(detectors.BUNDLE_DIR_ENV, str(tmp_path))
+    tel = Telemetry(run_id="drill")
+    for name in detectors.DETECTORS:
+        path = detectors.fire(name, telemetry=tel, probe=1)
+        assert path is not None and os.path.isdir(path), name
+        man = json.load(open(os.path.join(path, "manifest.json")))
+        assert man["reason"] == name and man["format"] == bundle.FORMAT_VERSION
+        ring = [json.loads(ln)
+                for ln in open(os.path.join(path, "flight_ring.jsonl"))]
+        fired = [e for e in ring if e["ev"] == "anomaly_detected"
+                 and e["data"].get("detector") == name]
+        assert fired and fired[-1]["data"]["probe"] == 1, name
+        report = bundle.render_report(path)
+        assert name in report and "detector verdicts:" in report, name
+
+
+def test_scan_maps_events_and_never_retriggers_on_forensics(tmp_path,
+                                                            monkeypatch):
+    monkeypatch.setenv(detectors.BUNDLE_DIR_ENV, str(tmp_path))
+    path = str(tmp_path / "t.jsonl")
+    tel = Telemetry(path, run_id="scan")
+    # an event-mapped anomaly riding a user bus: the flight observer
+    # scans it and the detector answers ON THAT BUS
+    tel.emit("serve_brownout_enter", backlog_s=9.0)
+    tel.close()
+    evs = [json.loads(ln) for ln in open(path)]
+    anom = [e for e in evs if e["ev"] == "anomaly_detected"]
+    assert len(anom) == 1
+    assert anom[0]["data"]["detector"] == "brownout"
+    assert anom[0]["data"]["backlog_s"] == 9.0
+    # exactly one anomaly in the ring too: the anomaly_detected /
+    # flightrec_dump / bundle_written events it caused were not
+    # themselves re-scanned into more anomalies
+    ring = flightrec.recorder().snapshot()
+    assert len([e for e in ring if e["ev"] == "anomaly_detected"]) == 1
+    assert os.path.isdir(str(tmp_path / "netrep-bundle-brownout"))
+
+
+def test_auto_bundle_cooldown_limits_storms(tmp_path, monkeypatch):
+    monkeypatch.setenv(detectors.BUNDLE_DIR_ENV, str(tmp_path))
+    tel = Telemetry(run_id="storm")
+    first = detectors.fire("device_lost", telemetry=tel, take=16)
+    assert first is not None
+    # a storm of the same detector inside the cooldown: no second bundle
+    for _ in range(5):
+        assert detectors.fire("device_lost", telemetry=tel, take=16) is None
+    # a DIFFERENT detector is on its own clock
+    assert detectors.fire("slo_burn", telemetry=tel) is not None
+    # reset re-arms (what tests and a new incident window rely on)
+    detectors.reset()
+    second = detectors.fire("device_lost", telemetry=tel, take=16)
+    assert second is not None and second != first
+
+
+def test_checkpoint_refusal_fires_detector(tmp_path, monkeypatch):
+    monkeypatch.setenv(detectors.BUNDLE_DIR_ENV, str(tmp_path))
+    from netrep_tpu.utils.checkpoint import validate_identity
+
+    ck = {"fingerprint": np.frombuffer(b"old", dtype=np.uint8),
+          "key_data": np.zeros(2, np.uint32), "completed": 8}
+    with pytest.raises(ValueError, match="different problem"):
+        validate_identity(ck, np.zeros(2, np.uint32),
+                          np.frombuffer(b"new", dtype=np.uint8), "p")
+    ring = flightrec.recorder().snapshot()
+    fired = [e for e in ring if e["ev"] == "anomaly_detected"]
+    assert fired and fired[-1]["data"]["detector"] == "checkpoint_refused"
+    assert fired[-1]["data"]["why"] == "fingerprint_mismatch"
+    assert os.path.isdir(str(tmp_path / "netrep-bundle-checkpoint_refused"))
+
+
+# ---------------------------------------------------------------------------
+# NETREP_FAULT_PLAN drills: all four null-loop modes, no JSONL sink
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", MODES)
+def test_fault_plan_drill_bundles_with_ring_context(eng, observed, mode,
+                                                    tmp_path, monkeypatch):
+    """The headline capability: NO telemetry sink anywhere, a device loss
+    injected by the env drill switch alone — and the auto-collected
+    bundle's ring still holds the chunk beats leading up to the trigger,
+    the trigger itself, and the detector verdict."""
+    monkeypatch.setenv("NETREP_FAULT_PLAN", "device_lost@32")
+    monkeypatch.setenv(detectors.BUNDLE_DIR_ENV, str(tmp_path))
+    with pytest.raises(DeviceLostError):
+        _run(eng, mode, observed,
+             fault_policy=FaultPolicy(backoff_base_s=0.0,
+                                      backoff_jitter=0.0))
+    bdir = str(tmp_path / "netrep-bundle-device_lost")
+    assert os.path.isdir(bdir)
+    ring = [json.loads(ln)
+            for ln in open(os.path.join(bdir, "flight_ring.jsonl"))]
+    evs = [e["ev"] for e in ring]
+    assert "device_lost" in evs, mode
+    trigger = evs.index("device_lost")
+    # permutations [0, 32) completed before the injected loss: the ring
+    # shows the run's heartbeat (dispatch beats plus the committed
+    # chunk/superchunk) leading INTO the incident
+    beats = [ev for ev in evs[:trigger]
+             if ev in ("dispatch", "chunk", "superchunk")]
+    assert len(beats) >= 2, (mode, evs[:trigger])
+    assert any(ev in ("chunk", "superchunk") for ev in beats), \
+        (mode, evs[:trigger])
+    verdicts = [e for e in ring if e["ev"] == "anomaly_detected"]
+    assert verdicts and verdicts[-1]["data"]["detector"] == "device_lost"
+    report = bundle.render_report(bdir)
+    assert "device_lost" in report and "timeline" in report
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: recorder on == recorder off, telemetry off throughout
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ("fixed", "adaptive_stream"))
+def test_recorder_on_bit_identical_to_recorder_off(eng, observed, mode):
+    """The pinned guarantee that lets the recorder stay always-on: a
+    telemetry-off run with the flight recorder installed produces
+    results bit-identical to one with it fully uninstalled."""
+    kind_on, on, done_on, fin_on = _run(eng, mode, observed)
+    assert flightrec.recorder().stats()["n_seen"] > 0  # it DID observe
+    flightrec.uninstall()
+    try:
+        assert tm.current() is None   # ambient stack truly empty again
+        kind_off, off, done_off, fin_off = _run(eng, mode, observed)
+    finally:
+        flightrec.install()
+    assert (done_on, fin_on) == (done_off, fin_off)
+    if kind_on == "mat":
+        np.testing.assert_array_equal(np.asarray(on), np.asarray(off))
+    else:
+        assert (on.hi == off.hi).all() and (on.lo == off.lo).all()
+        assert (on.eff == off.eff).all()
+        if on.n_perm_used is not None:
+            np.testing.assert_array_equal(on.n_perm_used, off.n_perm_used)
+
+
+def test_flightrec_env_opt_out(monkeypatch):
+    flightrec.uninstall()
+    try:
+        monkeypatch.setenv(flightrec.ENV_TOGGLE, "0")
+        assert flightrec.install() is None
+        assert flightrec.recorder() is None and flightrec.bus() is None
+        monkeypatch.delenv(flightrec.ENV_TOGGLE)
+    finally:
+        flightrec.install()
+    assert flightrec.recorder() is not None
+
+
+# ---------------------------------------------------------------------------
+# bundle redaction: digests only, never raw payloads
+# ---------------------------------------------------------------------------
+
+def test_bundle_journal_tail_is_redacted(tmp_path):
+    journal = str(tmp_path / "journal.jsonl")
+    secret_row = [1234.5678, 8765.4321]
+    with open(journal, "w") as f:
+        f.write(json.dumps({
+            "op": "register", "tenant": "acme", "n_perm": 64,
+            "matrix": [secret_row, [2.5, 3.5]],
+            "note": "q" * 400,
+        }) + "\n")
+        f.write("not json — torn tail line\n")
+    out = bundle.collect(str(tmp_path / "b"), reason="redaction",
+                         journal=journal)
+    tail = [json.loads(ln)
+            for ln in open(os.path.join(out, "journal_tail.jsonl"))]
+    assert len(tail) == 1      # the torn line is dropped, not shipped raw
+    rec = tail[0]
+    # scalars survive; every sequence / oversized string is digest-only
+    assert rec["tenant"] == "acme" and rec["n_perm"] == 64
+    assert rec["matrix"]["redacted"] == "sequence"
+    assert set(rec["matrix"]) == {"redacted", "items", "sha256", "bytes"}
+    assert rec["note"]["redacted"] == "text" and rec["note"]["chars"] == 400
+    raw = open(os.path.join(out, "journal_tail.jsonl")).read()
+    assert "1234.5678" not in raw and "qqqq" not in raw
+
+
+def test_bundle_env_snapshot_is_filtered(tmp_path, monkeypatch):
+    monkeypatch.setenv("NETREP_FLIGHTREC_ENTRIES", "2048")
+    monkeypatch.setenv("SECRET_TOKEN", "hunter2")
+    out = bundle.collect(str(tmp_path / "envb"), reason="env")
+    env = json.load(open(os.path.join(out, "env.json")))
+    assert "NETREP_FLIGHTREC_ENTRIES" in env["env"]
+    assert "SECRET_TOKEN" not in env["env"]
+    assert "hunter2" not in json.dumps(env)
+
+
+def test_bundle_collision_suffix_never_overwrites(tmp_path):
+    a = bundle.collect(str(tmp_path / "dup"), reason="x")
+    b = bundle.collect(str(tmp_path / "dup"), reason="x")
+    assert a != b and os.path.isdir(a) and os.path.isdir(b)
+    assert b.endswith("-2")
+
+
+def test_render_report_rejects_non_bundle(tmp_path):
+    with pytest.raises(ValueError, match="not a diagnostic bundle"):
+        bundle.render_report(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# live-server forensics: dump wire op + SIGUSR2
+# ---------------------------------------------------------------------------
+
+def test_dump_wire_op_collects_bundle(tmp_path):
+    from netrep_tpu.serve import PreservationServer, ServeConfig
+    from netrep_tpu.serve.server import dispatch_op
+
+    journal = str(tmp_path / "serve_journal.jsonl")
+    with open(journal, "w") as f:
+        f.write(json.dumps({"kind": "submit", "payload": [1, 2, 3]}) + "\n")
+    server = PreservationServer(
+        ServeConfig(journal=journal,
+                    telemetry=str(tmp_path / "tel.jsonl")),
+        start=False,
+    )
+    try:
+        resp = dispatch_op(
+            server,
+            {"op": "dump", "dest": str(tmp_path / "wired"), "reason": "wire"},
+            threading.Event(),
+        )
+    finally:
+        server.close(drain=False)
+    assert resp["ok"] is True
+    out = resp["bundle"]
+    assert os.path.isdir(out)
+    man = json.load(open(os.path.join(out, "manifest.json")))
+    assert man["reason"] == "wire"
+    # the server's journal rode along, redacted
+    tail = [json.loads(ln)
+            for ln in open(os.path.join(out, "journal_tail.jsonl"))]
+    assert tail and tail[0]["payload"]["redacted"] == "sequence"
+    assert "reason=wire" in bundle.render_report(out)
+
+
+def test_sigusr2_dumps_bundle_on_live_daemon(tmp_path):
+    if not hasattr(signal, "SIGUSR2"):
+        pytest.skip("no SIGUSR2 on this platform")
+    sock = str(tmp_path / "s.sock")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "netrep_tpu", "serve",
+         "--socket", sock, "--no-journal"],
+        cwd=str(tmp_path), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=env,
+    )
+    try:
+        deadline = time.monotonic() + 180
+        while not os.path.exists(sock):
+            assert proc.poll() is None, proc.stderr.read()
+            assert time.monotonic() < deadline, "daemon never bound"
+            time.sleep(0.1)
+        proc.send_signal(signal.SIGUSR2)
+        bdir = tmp_path / "netrep-bundle-sigusr2"
+        deadline = time.monotonic() + 60
+        while not (bdir / "manifest.json").is_file():
+            assert proc.poll() is None, proc.stderr.read()
+            assert time.monotonic() < deadline, "no bundle after SIGUSR2"
+            time.sleep(0.1)
+    finally:
+        try:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=60)
+        except (subprocess.TimeoutExpired, OSError):
+            proc.kill()
+            proc.wait()
+    man = json.load(open(bdir / "manifest.json"))
+    assert man["reason"] == "sigusr2" and man["pid"] == proc.pid
+    assert "reason=sigusr2" in bundle.render_report(str(bdir))
+
+
+# ---------------------------------------------------------------------------
+# fleet: the coordinator collects the departed replica's bundle
+# ---------------------------------------------------------------------------
+
+def _mk_fleet(tmp_path, n=2):
+    from netrep_tpu.serve import (
+        FleetConfig, ServeConfig, build_inprocess_fleet,
+    )
+
+    def mk(rid, jpath, ckpt):
+        return ServeConfig(engine=CFG, journal=jpath, checkpoint_dir=ckpt,
+                           fleet_label=rid)
+
+    return build_inprocess_fleet(
+        n, str(tmp_path / "fleet"), make_config=mk,
+        fleet_config=FleetConfig(
+            telemetry=str(tmp_path / "coord.jsonl"), heartbeat_s=0.1,
+        ),
+    )
+
+
+def test_fleet_failover_collects_departed_replica_bundle(tmp_path):
+    fleet = _mk_fleet(tmp_path)
+    try:
+        home = fleet.route("a", "d", "t")
+        fleet.kill_replica(home.rid)
+        assert fleet.await_failover(home.rid, timeout=60)
+    finally:
+        fleet.close()
+    bdir = (tmp_path / "fleet" / "bundles"
+            / f"netrep-bundle-replica_failover-{home.rid}")
+    assert bdir.is_dir()
+    man = json.load(open(bdir / "manifest.json"))
+    assert man["reason"] == "replica_failover"
+    # the coordinator's own JSONL tells the anomaly story: the scanned
+    # replica_lost event fired the replica_failover detector
+    evs = [json.loads(ln) for ln in open(tmp_path / "coord.jsonl")]
+    anom = [e for e in evs if e["ev"] == "anomaly_detected"
+            and e["data"].get("detector") == "replica_failover"]
+    assert anom and anom[0]["data"]["replica"] == home.rid
+    assert "replica_failover" in bundle.render_report(str(bdir))
+
+
+def test_fleet_evict_handoff_collects_bundle(tmp_path):
+    fleet = _mk_fleet(tmp_path)
+    try:
+        home = fleet.route("a", "d", "t")
+        out = fleet.evict_notice(home.rid, grace_s=1.0)
+        assert out is not None
+    finally:
+        fleet.close()
+    bdir = (tmp_path / "fleet" / "bundles"
+            / f"netrep-bundle-replica_evicted-{home.rid}")
+    assert bdir.is_dir()
+    assert json.load(open(bdir / "manifest.json"))["reason"] == \
+        "replica_evicted"
+    evs = [json.loads(ln) for ln in open(tmp_path / "coord.jsonl")]
+    anom = [e for e in evs if e["ev"] == "anomaly_detected"
+            and e["data"].get("detector") == "replica_evicted"]
+    assert anom and anom[0]["data"]["replica"] == home.rid
+
+
+# ---------------------------------------------------------------------------
+# one-command CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_bundle_collect_then_render(tmp_path):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    dest = str(tmp_path / "clib")
+    out = subprocess.run(
+        [sys.executable, "-m", "netrep_tpu", "bundle",
+         "--collect", dest, "--reason", "cli-drill"],
+        capture_output=True, text=True, env=env, timeout=180,
+    )
+    assert out.returncode == 0, out.stderr
+    assert dest in out.stdout
+    rendered = subprocess.run(
+        [sys.executable, "-m", "netrep_tpu", "bundle", dest],
+        capture_output=True, text=True, env=env, timeout=180,
+    )
+    assert rendered.returncode == 0, rendered.stderr
+    assert "reason=cli-drill" in rendered.stdout
+    # the collecting process never loaded a backend for forensics
+    assert "jax=not-loaded" in rendered.stdout

@@ -447,3 +447,35 @@ def test_roofline_section_mechanism_vs_measurement(tmp_path):
     assert proc.returncode == 0, proc.stderr
     assert "## roofline (achieved vs speed of light, 2 run(s))" \
         in proc.stdout
+
+
+def test_anomalies_section_groups_by_detector(tmp_path):
+    """ISSUE 20: ``anomaly_detected`` telemetry events get their own
+    triage section — per pinned detector, the firing count and the newest
+    occurrence's detail — and classify as telemetry (never as
+    unknown-provenance measurement rows)."""
+    events = [
+        _tel_event("anomaly_detected", detector="device_lost",
+                   start=32, take=16, error="InjectedDeviceLost"),
+        _tel_event("anomaly_detected", detector="device_lost",
+                   start=48, take=16, error="InjectedDeviceLost"),
+        _tel_event("anomaly_detected", detector="slo_burn",
+                   tenant="acme", burn_rate=2.5),
+    ]
+    for e in events:
+        assert classify(e) == "telemetry"
+    lines = summarize_watch.anomaly_lines(events)
+    dl = [ln for ln in lines if ln.startswith("device_lost")][0]
+    burn = [ln for ln in lines if ln.startswith("slo_burn")][0]
+    assert "fired x2" in dl and "start=48" in dl     # newest detail wins
+    assert "fired x1" in burn and "tenant=acme" in burn
+    # end-to-end: the section renders, count visible, above the split
+    log = tmp_path / "watch.jsonl"
+    log.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "benchmarks/summarize_watch.py", str(log)],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "## anomalies (3 detector firing(s)" in proc.stdout
+    assert "device_lost: fired x2" in proc.stdout

@@ -156,7 +156,9 @@ def test_disabled_telemetry_bit_identical(mixed, tmp_path):
                                   np.asarray(nulls_off))
     assert (sc_on.hi == sc_off.hi).all() and (sc_on.lo == sc_off.lo).all()
     assert (sc_on.eff == sc_off.eff).all()
-    assert current() is None  # no ambient bus leaked out of the runs
+    # no USER bus leaked out of the runs — only the always-on flight bus
+    # (ISSUE 20) may remain ambient
+    assert current() is None or getattr(current(), "flight_only", False)
 
 
 def test_materialized_chunk_events_match_profile(mixed, tmp_path):
@@ -238,6 +240,42 @@ def test_watchdog_silent_before_steady_state_measured():
     wd.beat()                       # only ONE steady interval so far
     clock.t += 1000.0
     assert not wd.poll()            # still below min_intervals
+
+
+def test_watchdog_action_refires_across_stall_episodes():
+    """ISSUE 20 satellite: the interval that ends a FIRED stall episode
+    must NOT fold into the steady-state median. Before the fix, each
+    recovery beat appended the stalled duration to the interval list;
+    once the stalled values reached the upper-middle of the sorted list
+    the median silently jumped to the stalled duration, and the next
+    comparable stall never crossed factor × steady — with a customized
+    ``action_factor``, the re-armed warning AND the action callback went
+    permanently quiet mid-run."""
+    clock = FakeClock()
+    tel = Telemetry(clock=clock)    # registry-only bus
+    acted = []
+    wd = StallWatchdog(tel, factor=2.0, min_intervals=2, poll_interval=0,
+                       clock=clock, action=lambda: acted.append(clock.t),
+                       action_factor=4.0)
+    wd.arm()
+    clock.t = 1.0
+    wd.beat()                       # first chunk: includes compile
+    for _ in range(2):              # steady state: 2 s / chunk
+        clock.t += 2.0
+        wd.beat()
+    assert wd.steady_s() == 2.0
+    for episode in range(1, 4):
+        clock.t += 26.0             # 26 s > action_factor(4) x 2 s steady
+        assert wd.poll(), f"episode {episode} went silent"
+        assert len(acted) == episode, f"episode {episode} never acted"
+        wd.beat()                   # recovery: re-arms warning + action
+        # the stalled interval is excluded from the steady-state samples
+        assert wd.steady_s() == 2.0
+    assert tel.metrics.counters["stall_suspected.count"] == 3
+    assert tel.metrics.counters["stall_recovered.count"] == 3
+    # the escalation rides the pinned detector registry (ISSUE 20)
+    assert tel.metrics.counters["anomaly_detected.count"] == 3
+    assert tel.metrics.gauges["anomaly_detected.action_factor"] == 4.0
 
 
 def test_recovery_event_names_pinned():
@@ -467,12 +505,16 @@ def test_known_events_cover_every_emitted_name():
     union's composition so a registry refactor cannot silently drop a
     subset out of :data:`KNOWN_EVENTS`."""
     from netrep_tpu.utils.telemetry import (
-        ENGINE_EVENTS, FLEET_EVENTS, GRID_EVENTS, KNOWN_EVENTS,
-        RECOVERY_EVENTS, SERVE_EVENTS, SPAN_EVENTS,
+        ENGINE_EVENTS, FLEET_EVENTS, FORENSIC_EVENTS, GRID_EVENTS,
+        KNOWN_EVENTS, RECOVERY_EVENTS, SERVE_EVENTS, SPAN_EVENTS,
     )
 
     union = (ENGINE_EVENTS + RECOVERY_EVENTS + SERVE_EVENTS
-             + FLEET_EVENTS + SPAN_EVENTS + GRID_EVENTS)
+             + FLEET_EVENTS + SPAN_EVENTS + GRID_EVENTS
+             + FORENSIC_EVENTS)
+    # the forensic registry (ISSUE 20) is pinned: these exact names
+    assert FORENSIC_EVENTS == ("anomaly_detected", "flightrec_dump",
+                               "bundle_written")
     assert KNOWN_EVENTS == frozenset(union)
     # no duplicates across registries: each name has one owning registry
     assert len(union) == len(set(union))
@@ -590,7 +632,9 @@ def test_module_preservation_telemetry(toy_pair_module, tmp_path):
                "null_run_end", "pair_end", "run_end"):
         assert reg.counters.get(f"{ev}.count", 0) >= 1, ev
     assert reg.counters["chunk.take"] == 64
-    assert current() is None  # ambient bus deactivated and closed
+    # user bus deactivated and closed — only the always-on flight bus
+    # (ISSUE 20) may remain ambient
+    assert current() is None or getattr(current(), "flight_only", False)
 
 
 # ---------------------------------------------------------------------------
